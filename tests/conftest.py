@@ -1,0 +1,32 @@
+"""Test bootstrap: force CPU with a virtual 8-device mesh.
+
+The container's sitecustomize registers the TPU PJRT plugin at
+interpreter startup and the environment pins JAX_PLATFORMS to it, so env
+vars set here are too late for platform selection — but backends
+initialise lazily, so `jax.config.update` before the first operation
+still wins. XLA_FLAGS *is* read at CPU-backend creation, so the virtual
+8-device flag works from here as long as no jax op ran yet.
+
+This is the mesh-without-hardware strategy from SURVEY.md §4: shard_map /
+ppermute island logic gets CI coverage with no TPU attached.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,96 @@
+"""Slow, obviously-correct numpy evaluators used as test oracles.
+
+These mirror the semantics of vrpms_tpu.core.cost with plain Python
+loops over decoded routes, so any padded-index/masking bug in the
+compiled kernels (the #1 bug farm per SURVEY.md §7) shows up as a
+mismatch against these.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vrpms_tpu.core.encoding import routes_from_giant
+
+
+def naive_eval(giant, inst):
+    """Reference evaluation of one giant tour. Returns a dict with the
+    same components as cost.CostBreakdown."""
+    d = np.asarray(inst.durations)
+    demands = np.asarray(inst.demands)
+    capacities = np.asarray(inst.capacities)
+    ready = np.asarray(inst.ready)
+    due = np.asarray(inst.due)
+    service = np.asarray(inst.service)
+    starts = np.asarray(inst.start_times)
+    t_slices = d.shape[0]
+    slice_minutes = inst.slice_minutes
+    time_dependent = t_slices > 1
+    timed = time_dependent or inst.has_tw
+
+    routes = routes_from_giant(giant)
+    distance = 0.0
+    lateness = 0.0
+    cap_excess = 0.0
+    route_durations = []
+    for r, route in enumerate(routes):
+        load = sum(demands[c] for c in route)
+        cap_excess += max(0.0, load - capacities[r])
+        path = [0] + route + [0]
+        if not timed:
+            dur = 0.0
+            for a, b in zip(path[:-1], path[1:]):
+                distance += d[0, a, b]
+                dur += d[0, a, b] + service[a]
+            route_durations.append(dur)
+        else:
+            clock = starts[r]
+            arrival = clock
+            for idx, (a, b) in enumerate(zip(path[:-1], path[1:])):
+                depart = clock if idx == 0 else arrival + service[a]
+                if time_dependent:
+                    s = int(depart // slice_minutes) % t_slices
+                else:
+                    s = 0
+                travel = d[s, a, b]
+                distance += travel
+                arrival = max(depart + travel, ready[b])
+                lateness += max(0.0, arrival - due[b])
+            route_durations.append(max(arrival - starts[r], 0.0))
+    return {
+        "distance": distance,
+        "route_durations": np.asarray(route_durations),
+        "cap_excess": cap_excess,
+        "tw_lateness": lateness,
+    }
+
+
+def naive_greedy_split(perm, inst):
+    """Greedy capacity split of a customer order; returns (cost, n_routes)."""
+    d = np.asarray(inst.durations)[0]
+    demands = np.asarray(inst.demands)
+    q = float(np.asarray(inst.capacities)[0])
+    routes = [[]]
+    load = 0.0
+    for c in np.asarray(perm):
+        c = int(c)
+        if load + demands[c] > q and routes[-1]:
+            routes.append([])
+            load = 0.0
+        routes[-1].append(c)
+        load += demands[c]
+    cost = 0.0
+    for route in routes:
+        path = [0] + route + [0]
+        cost += sum(d[a, b] for a, b in zip(path[:-1], path[1:]))
+    return cost, len(routes)
+
+
+def route_list_cost(routes, inst):
+    """Distance of an explicit route list (used to check split decode)."""
+    d = np.asarray(inst.durations)[0]
+    cost = 0.0
+    for route in routes:
+        path = [0] + list(route) + [0]
+        cost += sum(d[a, b] for a, b in zip(path[:-1], path[1:]))
+    return cost

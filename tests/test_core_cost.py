@@ -1,0 +1,189 @@
+"""Unit + property tests for the cost kernels (SURVEY.md §4 items 1-2)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vrpms_tpu.core import make_instance, evaluate_giant
+from vrpms_tpu.core.cost import evaluate_batch, total_cost, CostWeights
+from vrpms_tpu.core.encoding import (
+    giant_length,
+    random_giant,
+    random_giant_batch,
+    routes_from_giant,
+    giant_from_routes,
+    is_valid_giant,
+)
+from tests.oracle import naive_eval
+
+
+def tiny_instance(**kw):
+    # 1 depot + 3 customers, asymmetric durations, hand-checkable.
+    d = [
+        [0.0, 10.0, 20.0, 30.0],
+        [12.0, 0.0, 5.0, 9.0],
+        [21.0, 6.0, 0.0, 4.0],
+        [33.0, 8.0, 3.0, 0.0],
+    ]
+    defaults = dict(demands=[0, 4, 5, 6], capacities=[10, 10])
+    defaults.update(kw)
+    return make_instance(d, **defaults)
+
+
+def random_instance(rng, n=8, v=3, tw=False, t_slices=1):
+    d = rng.uniform(1, 50, size=(t_slices, n, n))
+    kw = dict(
+        slice_axis="first",
+        demands=rng.uniform(1, 5, size=n),
+        capacities=rng.uniform(8, 15, size=v),
+        service=rng.uniform(0, 3, size=n),
+        start_times=rng.uniform(0, 5, size=v),
+    )
+    if tw:
+        kw["ready"] = rng.uniform(0, 40, size=n)
+        kw["due"] = kw["ready"] + rng.uniform(10, 60, size=n)
+    return make_instance(d, **kw)
+
+
+class TestFastPath:
+    def test_hand_checked_distance(self):
+        inst = tiny_instance()
+        giant = jnp.asarray([0, 1, 2, 0, 3, 0], dtype=jnp.int32)
+        c = evaluate_giant(giant, inst)
+        # route 0: 0->1->2->0 = 10+5+21 = 36 ; route 1: 0->3->0 = 30+33 = 63
+        assert np.isclose(float(c.distance), 36 + 63)
+        np.testing.assert_allclose(np.asarray(c.route_durations), [36.0, 63.0])
+        assert float(c.cap_excess) == 0.0
+        assert float(c.tw_lateness) == 0.0
+        assert np.isclose(float(c.duration_max), 63.0)
+        assert np.isclose(float(c.duration_sum), 99.0)
+
+    def test_capacity_excess(self):
+        inst = tiny_instance(capacities=[8, 5])
+        giant = jnp.asarray([0, 1, 2, 0, 3, 0], dtype=jnp.int32)
+        c = evaluate_giant(giant, inst)
+        # loads: 9 vs 8 -> +1 ; 6 vs 5 -> +1
+        assert np.isclose(float(c.cap_excess), 2.0)
+        w = CostWeights.make(cap=100.0)
+        assert np.isclose(float(total_cost(c, w)), 99.0 + 200.0)
+
+    def test_empty_route_is_free(self):
+        inst = tiny_instance(capacities=[30, 30])
+        all_in_one = jnp.asarray([0, 1, 2, 3, 0, 0], dtype=jnp.int32)
+        c = evaluate_giant(all_in_one, inst)
+        # 0->1->2->3->0 = 10+5+4+33 = 52; second vehicle unused
+        assert np.isclose(float(c.distance), 52.0)
+        np.testing.assert_allclose(np.asarray(c.route_durations), [52.0, 0.0])
+
+
+class TestTimeWindows:
+    def test_hand_checked_waiting_and_lateness(self):
+        inst = tiny_instance(
+            capacities=[30],
+            ready=[0, 15, 0, 0],
+            due=[1000, 100, 16, 100],
+            service=[0, 2, 2, 2],
+        )
+        giant = jnp.asarray([0, 1, 2, 3, 0], dtype=jnp.int32)
+        c = evaluate_giant(giant, inst)
+        # depart depot t=0; arrive 1 at max(10, 15)=15 (wait), late 0
+        # depart 1 at 17; arrive 2 at 17+5=22, late 22-16=6
+        # depart 2 at 24; arrive 3 at 24+4=28, late 0
+        # depart 3 at 30; arrive depot at 30+33=63
+        assert np.isclose(float(c.tw_lateness), 6.0)
+        assert np.isclose(float(c.distance), 10 + 5 + 4 + 33)
+        np.testing.assert_allclose(np.asarray(c.route_durations), [63.0])
+
+    def test_parallel_routes_reset_clock(self):
+        # Route 1 must start at its own shift start, not after route 0.
+        inst = tiny_instance(
+            ready=[0, 0, 0, 0],
+            due=[1000, 1000, 1000, 35],
+            start_times=[0.0, 2.0],
+        )
+        giant = jnp.asarray([0, 1, 2, 0, 3, 0], dtype=jnp.int32)
+        c = evaluate_giant(giant, inst)
+        # vehicle 1 departs at t=2, arrives 3 at 2+30=32 < due 35 -> no lateness
+        assert np.isclose(float(c.tw_lateness), 0.0)
+        np.testing.assert_allclose(np.asarray(c.route_durations), [36.0, 63.0])
+
+
+class TestTimeDependent:
+    def test_slice_selection(self):
+        # Two slices of 30 min: first slice doubles every duration.
+        base = np.array(
+            [
+                [0.0, 10, 20, 30],
+                [12, 0, 5, 9],
+                [21, 6, 0, 4],
+                [33, 8, 3, 0],
+            ]
+        )
+        d = np.stack([2 * base, base])  # [T, N, N]
+        inst = make_instance(d, n_vehicles=1, slice_minutes=30.0)
+        giant = jnp.asarray([0, 1, 2, 3, 0], dtype=jnp.int32)
+        c = evaluate_giant(giant, inst)
+        # depart 0 at t=0 (slice 0): travel 20 -> arrive 1 at 20
+        # depart 1 at 20 (slice 0): travel 10 -> arrive 2 at 30
+        # depart 2 at 30 (slice 1): travel 4  -> arrive 3 at 34
+        # depart 3 at 34 (slice 1): travel 33 -> arrive 0 at 67
+        assert np.isclose(float(c.distance), 20 + 10 + 4 + 33)
+        np.testing.assert_allclose(np.asarray(c.route_durations), [67.0])
+
+
+class TestPropertyVsOracle:
+    @pytest.mark.parametrize("tw", [False, True])
+    @pytest.mark.parametrize("t_slices", [1, 3])
+    def test_matches_naive_eval(self, rng, tw, t_slices):
+        for trial in range(10):
+            n = int(rng.integers(3, 12))
+            v = int(rng.integers(1, 4))
+            inst = random_instance(rng, n=n, v=v, tw=tw, t_slices=t_slices)
+            key = jax.random.key(trial)
+            giant = random_giant(key, n - 1, v)
+            got = evaluate_giant(giant, inst)
+            want = naive_eval(giant, inst)
+            np.testing.assert_allclose(
+                float(got.distance), want["distance"], rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                float(got.cap_excess), want["cap_excess"], rtol=1e-5, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                float(got.tw_lateness), want["tw_lateness"], rtol=1e-4, atol=1e-3
+            )
+            np.testing.assert_allclose(
+                np.asarray(got.route_durations),
+                want["route_durations"],
+                rtol=1e-5,
+                atol=1e-3,
+            )
+
+    def test_batch_matches_single(self, rng):
+        inst = random_instance(rng, n=10, v=3)
+        giants = random_giant_batch(jax.random.key(7), 16, 9, 3)
+        batch = evaluate_batch(giants, inst)
+        for b in range(16):
+            single = evaluate_giant(giants[b], inst)
+            np.testing.assert_allclose(
+                float(batch.distance[b]), float(single.distance), rtol=1e-6
+            )
+
+
+class TestEncoding:
+    def test_random_giant_valid(self):
+        for seed in range(5):
+            g = random_giant(jax.random.key(seed), 9, 3)
+            assert is_valid_giant(g, 9, 3)
+
+    def test_roundtrip(self):
+        routes = [[3, 1], [], [2, 5, 4]]
+        g = giant_from_routes(routes, 5, 3)
+        assert is_valid_giant(g, 5, 3)
+        assert routes_from_giant(g) == routes
+
+    def test_lengths(self):
+        assert giant_length(5, 3) == 9
+        g = giant_from_routes([[1, 2, 3, 4, 5]], 5, 1)
+        assert g.shape == (7,)

@@ -1,0 +1,140 @@
+"""Tests for neighborhood moves and permutation splitting."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vrpms_tpu.core.encoding import is_valid_giant, random_giant
+from vrpms_tpu.core.split import (
+    greedy_split_cost,
+    greedy_split_giant,
+    optimal_split_cost,
+    optimal_split_routes,
+)
+from vrpms_tpu.core.cost import evaluate_giant
+from vrpms_tpu.moves import (
+    reverse_segment,
+    rotate_segment,
+    swap_positions,
+    random_move,
+)
+from tests.oracle import naive_greedy_split, route_list_cost
+from tests.test_core_cost import random_instance
+
+
+class TestMoves:
+    def setup_method(self):
+        self.g = jnp.asarray([0, 3, 1, 0, 4, 2, 5, 0], dtype=jnp.int32)
+
+    def test_reverse(self):
+        out = reverse_segment(self.g, 2, 5)
+        assert out.tolist() == [0, 3, 2, 4, 0, 1, 5, 0]
+
+    def test_reverse_identity(self):
+        assert reverse_segment(self.g, 4, 4).tolist() == self.g.tolist()
+
+    def test_rotate(self):
+        # left-rotate [1,0,4,2] by 1 -> [0,4,2,1]
+        out = rotate_segment(self.g, 2, 5, 1)
+        assert out.tolist() == [0, 3, 0, 4, 2, 1, 5, 0]
+
+    def test_swap(self):
+        out = swap_positions(self.g, 1, 6)
+        assert out.tolist() == [0, 5, 1, 0, 4, 2, 3, 0]
+
+    def test_random_move_preserves_validity(self):
+        g = random_giant(jax.random.key(0), 12, 4)
+        for seed in range(50):
+            g = random_move(jax.random.key(seed), g)
+        assert is_valid_giant(g, 12, 4)
+
+    def test_random_move_pins_endpoints(self):
+        g = random_giant(jax.random.key(1), 12, 4)
+        moved = jax.vmap(random_move, in_axes=(0, None))(
+            jax.random.split(jax.random.key(2), 64), g
+        )
+        assert bool((moved[:, 0] == 0).all())
+        assert bool((moved[:, -1] == 0).all())
+
+
+class TestSplit:
+    def test_greedy_matches_oracle(self, rng):
+        for trial in range(10):
+            n = int(rng.integers(4, 12))
+            inst = random_instance(rng, n=n, v=3)
+            perm = jnp.asarray(
+                rng.permutation(np.arange(1, n)), dtype=jnp.int32
+            )
+            cost, n_routes = greedy_split_cost(perm, inst)
+            want_cost, want_routes = naive_greedy_split(perm, inst)
+            np.testing.assert_allclose(float(cost), want_cost, rtol=1e-5)
+            assert int(n_routes) == want_routes
+
+    def test_greedy_giant_consistent(self, rng):
+        for trial in range(10):
+            n = int(rng.integers(4, 12))
+            inst = random_instance(rng, n=n, v=4)
+            perm = jnp.asarray(rng.permutation(np.arange(1, n)), dtype=jnp.int32)
+            giant = greedy_split_giant(perm, inst)
+            assert is_valid_giant(giant, n - 1, 4)
+            cost, n_routes = greedy_split_cost(perm, inst)
+            if int(n_routes) <= 4:
+                c = evaluate_giant(giant, inst)
+                np.testing.assert_allclose(
+                    float(c.distance), float(cost), rtol=1e-5
+                )
+
+    def test_optimal_not_worse_than_greedy(self, rng):
+        for trial in range(10):
+            n = int(rng.integers(4, 12))
+            inst = random_instance(rng, n=n, v=4)
+            perm = jnp.asarray(rng.permutation(np.arange(1, n)), dtype=jnp.int32)
+            greedy, n_routes = greedy_split_cost(perm, inst)
+            opt = optimal_split_cost(perm, inst)
+            if int(n_routes) <= 4:
+                assert float(opt) <= float(greedy) + 1e-4
+
+    def test_optimal_matches_enumeration(self, rng):
+        # Exhaustively enumerate all split-point subsets on small orders.
+        import itertools
+
+        for trial in range(5):
+            n = 7
+            inst = random_instance(rng, n=n, v=3)
+            perm = list(rng.permutation(np.arange(1, n)))
+            q = float(np.asarray(inst.capacities)[0])
+            demands = np.asarray(inst.demands)
+            best = np.inf
+            for n_cuts in range(0, 3):  # up to 3 routes
+                for cuts in itertools.combinations(range(1, n - 1), n_cuts):
+                    bounds = [0, *cuts, n - 1]
+                    routes = [
+                        perm[a:b] for a, b in zip(bounds[:-1], bounds[1:])
+                    ]
+                    if any(
+                        sum(demands[c] for c in r) > q for r in routes
+                    ):
+                        continue
+                    best = min(best, route_list_cost(routes, inst))
+            got = float(
+                optimal_split_cost(jnp.asarray(perm, dtype=jnp.int32), inst)
+            )
+            if np.isfinite(best):
+                np.testing.assert_allclose(got, best, rtol=1e-5)
+
+    def test_reconstruction_matches_cost(self, rng):
+        for trial in range(10):
+            n = int(rng.integers(5, 12))
+            inst = random_instance(rng, n=n, v=4)
+            perm = jnp.asarray(rng.permutation(np.arange(1, n)), dtype=jnp.int32)
+            opt = float(optimal_split_cost(perm, inst))
+            if opt >= 1e8:  # infeasible (some customer over capacity)
+                continue
+            routes = optimal_split_routes(perm, inst)
+            assert sorted(c for r in routes for c in r) == sorted(
+                int(c) for c in perm
+            )
+            np.testing.assert_allclose(
+                route_list_cost(routes, inst), opt, rtol=1e-5
+            )

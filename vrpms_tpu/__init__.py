@@ -1,0 +1,20 @@
+"""vrpms_tpu — a TPU-native Vehicle Routing / Traveling Salesman framework.
+
+A from-scratch JAX/XLA implementation of the capability surface of the
+reference VRP microservice (metehkaya/vrpms): the {vrp, tsp} x {ga, sa,
+aco, bf} solver matrix behind its 9 HTTP endpoints (reference anchors:
+/root/reference/api/vrp/*/index.py, api/tsp/*/index.py), with the solver
+core the reference left as stubs (reference src/solver.py:18-27) realised
+as jit/vmap/shard_map-compiled metaheuristic search.
+
+Layout:
+  core/     problem representation, cost kernels, penalties, split
+  moves/    neighborhood moves as batched index transforms
+  solvers/  bf, local_search, sa, ga, aco — compiled search loops
+  mesh/     island-model parallelism over a jax.sharding.Mesh
+  kernels/  Pallas TPU kernels for the hot route-evaluation path
+  io/       instance loaders (CVRPLIB, Solomon, JSON) + schemas
+  native/   C++ components (exact oracle, parsers) via ctypes
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+from vrpms_tpu.core.instance import Instance, make_instance
+from vrpms_tpu.core.encoding import (
+    giant_length,
+    random_giant,
+    routes_from_giant,
+    giant_from_routes,
+)
+from vrpms_tpu.core.cost import evaluate_giant, CostWeights, total_cost

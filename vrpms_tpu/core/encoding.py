@@ -1,0 +1,102 @@
+"""Giant-tour encoding with depot separators — the fixed-shape route tensor.
+
+A solution is one int32 vector `giant[L]`, `L = n + V + 1`:
+
+    [0, c, c, 0, c, c, c, 0, ..., 0]
+
+Position 0 and L-1 are pinned to the depot (node 0); the V-1 interior
+zeros are route separators, so the array always contains every customer
+exactly once and exactly V+1 zeros delimiting exactly V (possibly empty)
+routes. TSP is the V == 1 special case `[0, c, ..., c, 0]`.
+
+Why this shape: XLA requires static shapes, and this single flat vector
+makes every neighborhood move (reverse / rotate / swap — see
+vrpms_tpu.moves) a pure index transform, every cost term a gather plus a
+segment reduction, and batching a trivial leading axis for vmap. It is the
+TPU-native answer to the `[0] + tour + [0]` list the reference's stub
+emits (reference src/solver.py:22-24) — same concept, tensorised.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def giant_length(n_customers: int, n_vehicles: int) -> int:
+    return n_customers + n_vehicles + 1
+
+
+def random_giant(key: jax.Array, n_customers: int, n_vehicles: int) -> jax.Array:
+    """Uniformly random giant tour: shuffled customers + separators."""
+    interior = jnp.concatenate(
+        [
+            jnp.arange(1, n_customers + 1, dtype=jnp.int32),
+            jnp.zeros(n_vehicles - 1, dtype=jnp.int32),
+        ]
+    )
+    interior = jax.random.permutation(key, interior)
+    zero = jnp.zeros(1, dtype=jnp.int32)
+    return jnp.concatenate([zero, interior, zero])
+
+
+def random_giant_batch(key: jax.Array, batch: int, n_customers: int, n_vehicles: int):
+    keys = jax.random.split(key, batch)
+    return jax.vmap(lambda k: random_giant(k, n_customers, n_vehicles))(keys)
+
+
+def route_ids(giant: jax.Array) -> jax.Array:
+    """Route index for every position; the leg leaving position k belongs
+    to route `route_ids(giant)[k]`. A route's closing depot-zero carries
+    the next route's id (it is position-of-departure for that route)."""
+    return jnp.cumsum((giant == 0).astype(jnp.int32)) - 1
+
+def routes_from_giant(giant) -> list[list[int]]:
+    """Host-side decode: split on zeros into V customer lists."""
+    g = np.asarray(giant).tolist()
+    routes: list[list[int]] = []
+    cur: list[int] = []
+    for node in g[1:]:
+        if node == 0:
+            routes.append(cur)
+            cur = []
+        else:
+            cur.append(int(node))
+    return routes
+
+
+def giant_from_routes(
+    routes: list[list[int]], n_customers: int, n_vehicles: int
+) -> jax.Array:
+    """Host-side encode: customer lists -> padded giant tour."""
+    if len(routes) > n_vehicles:
+        raise ValueError(f"{len(routes)} routes > {n_vehicles} vehicles")
+    flat: list[int] = [0]
+    for r in routes:
+        flat.extend(int(c) for c in r)
+        flat.append(0)
+    flat.extend([0] * (n_vehicles - len(routes)))
+    expect = giant_length(n_customers, n_vehicles)
+    if len(flat) != expect:
+        raise ValueError(f"routes encode to length {len(flat)}, expected {expect}")
+    return jnp.asarray(flat, dtype=jnp.int32)
+
+
+def perm_from_giant(giant) -> np.ndarray:
+    """Host-side: customer visit order with separators stripped."""
+    g = np.asarray(giant)
+    return g[g != 0]
+
+
+def is_valid_giant(giant, n_customers: int, n_vehicles: int) -> bool:
+    """Host-side structural check: every customer once, V+1 zeros, pinned ends."""
+    g = np.asarray(giant)
+    if g.shape != (giant_length(n_customers, n_vehicles),):
+        return False
+    if g[0] != 0 or g[-1] != 0:
+        return False
+    counts = np.bincount(g, minlength=n_customers + 1)
+    if counts[0] != n_vehicles + 1:
+        return False
+    return bool(np.all(counts[1:] == 1)) and g.max() <= n_customers

@@ -1,0 +1,190 @@
+"""Problem instance representation — one device-resident bundle of arrays.
+
+The reference keeps the travel-duration structure in two places: a random
+per-pair stub (reference src/solver.py:7-15, `calculate_duration(source,
+target, time_of_day=0)`) and a per-request `durations` matrix fetched from
+its database (reference api/database.py:38-48, `row['matrix']`). Here the
+two are unified into a single time-sliced tensor `durations[T, N, N]`
+placed on device once per solve, per SURVEY.md §3.5.
+
+Everything is fixed-shape and functional so solvers can be jit-compiled:
+node 0 is always the depot, customers are 1..n, and the number of vehicles
+V is derivable from `capacities.shape`. Static facts that change trace
+behavior (whether time windows exist) live in metadata fields so jit
+re-specializes only when they change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# A number treated as "infinite" time/capacity while staying well inside
+# float32 range even after a few additions.
+BIG = 1e9
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "durations",
+        "demands",
+        "capacities",
+        "ready",
+        "due",
+        "service",
+        "start_times",
+    ],
+    meta_fields=["has_tw", "slice_minutes"],
+)
+@dataclasses.dataclass(frozen=True)
+class Instance:
+    """A VRP/TSP instance as a JAX pytree.
+
+    durations:    f32[T, N, N] travel durations; slice t applies to legs
+                  departing within time-of-day slice t (cyclic). T == 1
+                  means time-independent.
+    demands:      f32[N], demands[0] == 0 (depot).
+    capacities:   f32[V] per-vehicle capacities (BIG => uncapacitated).
+                  V == 1 with capacity BIG models plain TSP.
+    ready/due:    f32[N] time-window bounds (0 / BIG when absent).
+    service:      f32[N] service durations (0 when absent).
+    start_times:  f32[V] vehicle shift start times.
+    has_tw:       static bool — whether the TW propagation path is traced.
+    slice_minutes:static float — wall-minutes per time-of-day slice.
+    """
+
+    durations: jax.Array
+    demands: jax.Array
+    capacities: jax.Array
+    ready: jax.Array
+    due: jax.Array
+    service: jax.Array
+    start_times: jax.Array
+    has_tw: bool
+    slice_minutes: float
+
+    @property
+    def n_nodes(self) -> int:
+        return self.durations.shape[-1]
+
+    @property
+    def n_customers(self) -> int:
+        return self.n_nodes - 1
+
+    @property
+    def n_vehicles(self) -> int:
+        return self.capacities.shape[0]
+
+    @property
+    def n_slices(self) -> int:
+        return self.durations.shape[0]
+
+    @property
+    def time_dependent(self) -> bool:
+        return self.n_slices > 1
+
+
+def make_instance(
+    durations,
+    demands=None,
+    capacities=None,
+    n_vehicles: int | None = None,
+    ready=None,
+    due=None,
+    service=None,
+    start_times=None,
+    slice_minutes: float = 60.0,
+    slice_axis: str = "auto",
+    dtype=jnp.float32,
+) -> Instance:
+    """Build an Instance from loosely-typed host data.
+
+    `durations` may be [N,N] or [T,N,N] (nested lists or arrays). The
+    service layer feeds the database matrix (reference api/database.py:45
+    `row['matrix']`) straight in; time-sliced matrices arrive as a list of
+    per-slice rows or an [N,N,T] nesting, both normalised here.
+
+    `slice_axis` pins where the time axis sits for 3-D input: "first"
+    ([T,N,N]), "last" ([N,N,T]), or "auto" to infer from the square pair
+    of axes. "auto" is ambiguous when T == N, so explicit callers (the
+    service layer knows its JSON nesting) should pass "last"/"first".
+    """
+    d = jnp.asarray(durations, dtype=dtype)
+    if d.ndim == 2:
+        d = d[None]
+    elif d.ndim == 3:
+        if slice_axis == "last":
+            d = jnp.moveaxis(d, -1, 0)
+        elif slice_axis == "auto":
+            # [N, N, T] (per-pair list of slice durations, the natural
+            # JSON nesting for matrix[i][j] == [t0, t1, ...]) -> T first.
+            if d.shape[0] == d.shape[1] and d.shape[1] != d.shape[2]:
+                d = jnp.moveaxis(d, -1, 0)
+            elif d.shape[0] == d.shape[1] == d.shape[2]:
+                raise ValueError(
+                    "ambiguous cubic durations (T == N); pass "
+                    "slice_axis='first' or 'last'"
+                )
+        elif slice_axis != "first":
+            raise ValueError(f"slice_axis must be auto/first/last, got {slice_axis!r}")
+    else:
+        raise ValueError(f"durations must be [N,N] or time-sliced 3-D, got {d.shape}")
+    n = d.shape[-1]
+    if d.shape[-2] != n:
+        raise ValueError(f"durations must be square, got {d.shape}")
+    # Depot self-loop must be free: adjacent separator zeros in the giant
+    # tour encode an unused vehicle, whose legs are (0, 0).
+    d = d.at[:, 0, 0].set(0.0)
+
+    demands = (
+        jnp.zeros(n, dtype) if demands is None else jnp.asarray(demands, dtype)
+    )
+    demands = demands.at[0].set(0.0)
+    if capacities is None:
+        v = n_vehicles or 1
+        capacities = jnp.full((v,), BIG, dtype)
+    else:
+        capacities = jnp.asarray(capacities, dtype).reshape(-1)
+    v = capacities.shape[0]
+
+    # Ready times alone also require the timed path (arrival waiting).
+    has_tw = due is not None or ready is not None
+    ready = jnp.zeros(n, dtype) if ready is None else jnp.asarray(ready, dtype)
+    due = jnp.full(n, BIG, dtype) if due is None else jnp.asarray(due, dtype)
+    service = jnp.zeros(n, dtype) if service is None else jnp.asarray(service, dtype)
+    service = service.at[0].set(0.0)  # no service at the depot
+    start_times = (
+        jnp.zeros(v, dtype)
+        if start_times is None
+        else jnp.asarray(start_times, dtype).reshape(-1)
+    )
+    if start_times.shape[0] != v:
+        raise ValueError(
+            f"start_times has {start_times.shape[0]} entries for {v} vehicles"
+        )
+    for name, arr in (
+        ("demands", demands),
+        ("ready", ready),
+        ("due", due),
+        ("service", service),
+    ):
+        if arr.shape != (n,):
+            # JAX clamps out-of-range gathers silently, so a wrong-length
+            # array would corrupt costs instead of erroring — reject here.
+            raise ValueError(f"{name} must have shape ({n},), got {arr.shape}")
+
+    return Instance(
+        durations=d,
+        demands=demands,
+        capacities=capacities,
+        ready=ready,
+        due=due,
+        service=service,
+        start_times=start_times,
+        has_tw=bool(has_tw),
+        slice_minutes=float(slice_minutes),
+    )

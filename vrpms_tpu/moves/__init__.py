@@ -1,0 +1,7 @@
+from vrpms_tpu.moves.moves import (
+    reverse_segment,
+    rotate_segment,
+    swap_positions,
+    random_move,
+    N_MOVE_TYPES,
+)

@@ -1,0 +1,72 @@
+"""Neighborhood moves as pure index transforms on the giant tour.
+
+Classic VRP local-search moves (2-opt, or-opt, swap — the set SURVEY.md
+§2.2 requires for SA) reshaped for XLA: no dynamic slices, no in-place
+surgery — each move builds a static-shape source-index map with
+`jnp.where` arithmetic and performs one gather. That keeps every move
+jit-compatible, O(L), and trivially vmappable across thousands of chains.
+
+Because the giant tour interleaves customers and depot separators
+(core.encoding), the same three transforms cover both intra-route moves
+and inter-route moves (a reversal or rotation spanning a separator
+reassigns customers between vehicles) — no special cross-route cases.
+
+Positions 0 and L-1 are pinned (depot anchors); moves touch [1, L-2].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+N_MOVE_TYPES = 3  # reverse (2-opt), rotate (or-opt relocation), swap
+
+
+def reverse_segment(giant: jax.Array, i: jax.Array, j: jax.Array) -> jax.Array:
+    """2-opt: reverse positions i..j (inclusive). Identity when i >= j."""
+    k = jnp.arange(giant.shape[0])
+    inside = (k >= i) & (k <= j)
+    src = jnp.where(inside, i + j - k, k)
+    return giant[src]
+
+
+def rotate_segment(
+    giant: jax.Array, i: jax.Array, j: jax.Array, m: jax.Array
+) -> jax.Array:
+    """Or-opt: left-rotate the subarray [i..j] by m — relocates the m-long
+    block at the front of the window to its back, i.e. moves a segment
+    elsewhere in the tour without reversing it."""
+    k = jnp.arange(giant.shape[0])
+    span = jnp.maximum(j - i + 1, 1)
+    inside = (k >= i) & (k <= j)
+    src = jnp.where(inside, i + (k - i + m) % span, k)
+    return giant[src]
+
+
+def swap_positions(giant: jax.Array, i: jax.Array, j: jax.Array) -> jax.Array:
+    k = jnp.arange(giant.shape[0])
+    src = jnp.where(k == i, j, jnp.where(k == j, i, k))
+    return giant[src]
+
+
+def random_move(key: jax.Array, giant: jax.Array) -> jax.Array:
+    """Sample and apply one uniformly-chosen move; used as the SA proposal.
+
+    vmap this over (keys, giants) for batched chains.
+    """
+    length = giant.shape[0]
+    k_pos, k_type, k_rot = jax.random.split(key, 3)
+    ij = jax.random.randint(k_pos, (2,), 1, length - 1)
+    i = jnp.minimum(ij[0], ij[1])
+    j = jnp.maximum(ij[0], ij[1])
+    m = jax.random.randint(k_rot, (), 1, 4)
+    move_type = jax.random.randint(k_type, (), 0, N_MOVE_TYPES)
+    return jax.lax.switch(
+        move_type,
+        [
+            lambda g: reverse_segment(g, i, j),
+            lambda g: rotate_segment(g, i, j, m),
+            lambda g: swap_positions(g, i, j),
+        ],
+        giant,
+    )
