@@ -1,0 +1,132 @@
+"""Golden tests: exact brute force vs itertools, local search behavior."""
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
+from vrpms_tpu.core.encoding import is_valid_giant, random_giant
+from vrpms_tpu.solvers import solve_tsp_bf, solve_vrp_bf, solve_nn_2opt, local_search
+from vrpms_tpu.solvers.bf import MAX_BF_CUSTOMERS
+from vrpms_tpu.solvers.local_search import nearest_neighbor_perm
+from tests.oracle import route_list_cost
+from tests.test_core_cost import random_instance
+
+
+def python_tsp_optimum(d):
+    n = d.shape[0] - 1
+    best = np.inf
+    for perm in itertools.permutations(range(1, n + 1)):
+        path = [0, *perm, 0]
+        best = min(best, sum(d[a, b] for a, b in zip(path[:-1], path[1:])))
+    return best
+
+
+def python_vrp_optimum(d, demands, q, v):
+    n = d.shape[0] - 1
+    best = np.inf
+    for perm in itertools.permutations(range(1, n + 1)):
+        for n_cuts in range(0, v):
+            for cuts in itertools.combinations(range(1, n), n_cuts):
+                bounds = [0, *cuts, n]
+                routes = [list(perm[a:b]) for a, b in zip(bounds[:-1], bounds[1:])]
+                if any(sum(demands[c] for c in r) > q for r in routes):
+                    continue
+                cost = 0.0
+                for r in routes:
+                    path = [0, *r, 0]
+                    cost += sum(d[a, b] for a, b in zip(path[:-1], path[1:]))
+                best = min(best, cost)
+    return best
+
+
+class TestBruteForce:
+    def test_tsp_matches_itertools(self, rng):
+        n = 7
+        d = rng.uniform(1, 50, size=(n, n))
+        np.fill_diagonal(d, 0)
+        inst = make_instance(d, n_vehicles=1)
+        res = solve_tsp_bf(inst)
+        assert np.isclose(float(res.cost), python_tsp_optimum(d), rtol=1e-5)
+        assert is_valid_giant(res.giant, n - 1, 1)
+        assert int(res.evals) == 720
+
+    def test_tsp_asymmetric(self, rng):
+        n = 6
+        d = rng.uniform(1, 50, size=(n, n))  # asymmetric on purpose
+        np.fill_diagonal(d, 0)
+        inst = make_instance(d, n_vehicles=1)
+        res = solve_tsp_bf(inst)
+        assert np.isclose(float(res.cost), python_tsp_optimum(d), rtol=1e-5)
+
+    def test_vrp_matches_itertools(self, rng):
+        n = 7
+        d = rng.uniform(1, 50, size=(n, n))
+        np.fill_diagonal(d, 0)
+        demands = np.array([0, 3, 4, 2, 5, 3, 4], dtype=float)
+        inst = make_instance(d, demands=demands, capacities=[9, 9, 9])
+        res = solve_vrp_bf(inst)
+        want = python_vrp_optimum(d, demands, 9.0, 3)
+        assert np.isclose(float(res.breakdown.distance), want, rtol=1e-5)
+        assert is_valid_giant(res.giant, n - 1, 3)
+        assert float(res.breakdown.cap_excess) == 0.0
+
+    def test_rejects_large(self, rng):
+        inst = random_instance(rng, n=MAX_BF_CUSTOMERS + 2, v=1)
+        with pytest.raises(ValueError, match="exceeds"):
+            solve_tsp_bf(inst)
+
+    def test_vrp_tw_runs_and_beats_random(self, rng):
+        inst = random_instance(rng, n=6, v=2, tw=True)
+        res = solve_vrp_bf(inst)
+        w = CostWeights.make()
+        for seed in range(20):
+            g = random_giant(jax.random.key(seed), 5, 2)
+            assert float(res.cost) <= float(total_cost(evaluate_giant(g, inst), w)) + 1e-3
+
+
+class TestLocalSearch:
+    def test_improves_and_valid(self, rng):
+        inst = random_instance(rng, n=12, v=3)
+        g0 = random_giant(jax.random.key(3), 11, 3)
+        w = CostWeights.make()
+        c0 = float(total_cost(evaluate_giant(g0, inst), w))
+        res = local_search(g0, inst, w)
+        assert float(res.cost) <= c0
+        assert is_valid_giant(res.giant, 11, 3)
+        assert int(res.evals) > 0
+
+    def test_local_search_reaches_bf_on_tiny_tsp(self, rng):
+        # On very small instances steepest descent from NN often hits the
+        # optimum; at minimum it must be within a loose factor.
+        n = 7
+        d = rng.uniform(1, 50, size=(n, n))
+        d = (d + d.T) / 2
+        np.fill_diagonal(d, 0)
+        inst = make_instance(d, n_vehicles=1)
+        opt = float(solve_tsp_bf(inst).cost)
+        got = float(solve_nn_2opt(inst).cost)
+        assert got <= opt * 1.2 + 1e-3
+
+    def test_nn_2opt_tsp50(self, rng):
+        pts = rng.uniform(0, 100, size=(51, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        inst = make_instance(d, n_vehicles=1)
+        order = nearest_neighbor_perm(inst)
+        assert sorted(np.asarray(order).tolist()) == list(range(1, 51))
+        zero = jnp.zeros(1, dtype=jnp.int32)
+        nn_giant = jnp.concatenate([zero, order, zero])
+        w = CostWeights.make()
+        nn_cost = float(total_cost(evaluate_giant(nn_giant, inst), w))
+        res = solve_nn_2opt(inst, w)
+        assert float(res.cost) < nn_cost  # 2-opt must strictly help on random points
+        assert is_valid_giant(res.giant, 50, 1)
+
+    def test_nn_2opt_vrp(self, rng):
+        inst = random_instance(rng, n=15, v=4)
+        res = solve_nn_2opt(inst)
+        assert is_valid_giant(res.giant, 14, 4)

@@ -1,0 +1,90 @@
+"""GA and ACO golden tests vs the BF oracle, plus operator unit tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core.encoding import is_valid_giant
+from vrpms_tpu.solvers import solve_vrp_bf, solve_tsp_bf
+from vrpms_tpu.solvers.ga import GAParams, order_crossover, mutate, solve_ga
+from vrpms_tpu.solvers.aco import ACOParams, solve_aco, _construct_orders
+from tests.test_sa import euclidean_cvrp
+from tests.test_core_cost import random_instance
+
+
+def _is_perm(x, n):
+    return sorted(np.asarray(x).tolist()) == list(range(1, n + 1))
+
+
+class TestOperators:
+    def test_order_crossover_is_permutation(self):
+        n = 12
+        rng = np.random.default_rng(1)
+        for seed in range(20):
+            p1 = jnp.asarray(rng.permutation(np.arange(1, n + 1)), dtype=jnp.int32)
+            p2 = jnp.asarray(rng.permutation(np.arange(1, n + 1)), dtype=jnp.int32)
+            child = order_crossover(p1, p2, jax.random.key(seed))
+            assert _is_perm(child, n)
+
+    def test_crossover_inherits_segment(self):
+        # With identical parents the child must equal them.
+        p = jnp.arange(1, 11, dtype=jnp.int32)
+        child = order_crossover(p, p, jax.random.key(0))
+        assert child.tolist() == p.tolist()
+
+    def test_mutate_is_permutation(self):
+        n = 10
+        p = jnp.arange(1, n + 1, dtype=jnp.int32)
+        for seed in range(20):
+            m = mutate(p, jax.random.key(seed), rate=1.0)
+            assert _is_perm(m, n)
+
+    def test_construct_orders_are_permutations(self):
+        n_nodes = 9
+        tau = jnp.ones((n_nodes, n_nodes))
+        eta = jnp.ones((n_nodes, n_nodes))
+        orders = _construct_orders(jax.random.key(0), tau, eta, 16)
+        assert orders.shape == (16, n_nodes - 1)
+        for a in range(16):
+            assert _is_perm(orders[a], n_nodes - 1)
+
+
+class TestGA:
+    def test_near_optimal_cvrp(self, rng):
+        inst = euclidean_cvrp(rng, n=8, v=3, q=8)
+        opt = float(solve_vrp_bf(inst).cost)
+        res = solve_ga(inst, key=0, params=GAParams(population=128, generations=300))
+        assert is_valid_giant(res.giant, 7, 3)
+        assert float(res.cost) <= opt * 1.05 + 1e-3
+        assert float(res.breakdown.cap_excess) == 0.0
+
+    def test_respects_population_and_generations(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        res = solve_ga(inst, key=1, params=GAParams(population=32, generations=50))
+        assert int(res.evals) == 32 * 50
+
+    def test_tw_instance(self, rng):
+        inst = random_instance(rng, n=8, v=2, tw=True)
+        res = solve_ga(inst, key=2, params=GAParams(population=64, generations=100))
+        assert is_valid_giant(res.giant, 7, 2)
+
+
+class TestACO:
+    def test_near_optimal_tsp(self, rng):
+        n = 8
+        pts = rng.uniform(0, 100, size=(n, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        from vrpms_tpu.core import make_instance
+
+        inst = make_instance(d, n_vehicles=1)
+        opt = float(solve_tsp_bf(inst).cost)
+        res = solve_aco(inst, key=0, params=ACOParams(n_ants=64, n_iters=150))
+        assert is_valid_giant(res.giant, n - 1, 1)
+        assert float(res.cost) <= opt * 1.05 + 1e-3
+
+    def test_near_optimal_cvrp(self, rng):
+        inst = euclidean_cvrp(rng, n=8, v=3, q=8)
+        opt = float(solve_vrp_bf(inst).cost)
+        res = solve_aco(inst, key=1, params=ACOParams(n_ants=64, n_iters=150))
+        assert float(res.cost) <= opt * 1.10 + 1e-3
+        assert float(res.breakdown.cap_excess) == 0.0

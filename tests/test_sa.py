@@ -1,0 +1,62 @@
+"""SA golden tests: near-optimality vs the BF oracle (SURVEY.md §4 item 3)."""
+
+import numpy as np
+import jax
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
+from vrpms_tpu.core.encoding import is_valid_giant, random_giant_batch
+from vrpms_tpu.solvers import solve_tsp_bf, solve_vrp_bf
+from vrpms_tpu.solvers.sa import SAParams, solve_sa
+from tests.test_core_cost import random_instance
+
+
+def euclidean_cvrp(rng, n, v, q):
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    demands = np.concatenate([[0], rng.uniform(1, 4, size=n - 1)])
+    return make_instance(d, demands=demands, capacities=[q] * v)
+
+
+class TestSA:
+    def test_hits_bf_optimum_tsp(self, rng):
+        n = 8
+        d = rng.uniform(1, 50, size=(n, n))
+        np.fill_diagonal(d, 0)
+        inst = make_instance(d, n_vehicles=1)
+        opt = float(solve_tsp_bf(inst).cost)
+        res = solve_sa(inst, key=0, params=SAParams(n_chains=64, n_iters=3000))
+        assert is_valid_giant(res.giant, n - 1, 1)
+        assert float(res.cost) <= opt * 1.02 + 1e-3
+
+    def test_near_optimal_cvrp(self, rng):
+        inst = euclidean_cvrp(rng, n=8, v=3, q=8)
+        opt = float(solve_vrp_bf(inst).cost)
+        res = solve_sa(inst, key=1, params=SAParams(n_chains=64, n_iters=4000))
+        assert float(res.breakdown.cap_excess) == 0.0
+        assert float(res.cost) <= opt * 1.05 + 1e-3
+
+    def test_beats_random_and_respects_feasibility(self, rng):
+        inst = euclidean_cvrp(rng, n=20, v=4, q=12)
+        w = CostWeights.make()
+        rand = random_giant_batch(jax.random.key(9), 64, 19, 4)
+        rand_best = min(
+            float(total_cost(evaluate_giant(g, inst), w)) for g in rand
+        )
+        res = solve_sa(inst, key=2, params=SAParams(n_chains=128, n_iters=4000), weights=w)
+        assert float(res.cost) < rand_best * 0.8
+        assert is_valid_giant(res.giant, 19, 4)
+        assert float(res.breakdown.cap_excess) == 0.0
+
+    def test_deterministic_given_key(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=15)
+        p = SAParams(n_chains=32, n_iters=500)
+        a = solve_sa(inst, key=5, params=p)
+        b = solve_sa(inst, key=5, params=p)
+        assert float(a.cost) == float(b.cost)
+        assert np.array_equal(np.asarray(a.giant), np.asarray(b.giant))
+
+    def test_tw_instance(self, rng):
+        inst = random_instance(rng, n=9, v=2, tw=True)
+        res = solve_sa(inst, key=3, params=SAParams(n_chains=32, n_iters=1500))
+        assert is_valid_giant(res.giant, 8, 2)

@@ -28,14 +28,14 @@ from vrpms_tpu.core.encoding import giant_length
 from vrpms_tpu.core.instance import BIG, Instance
 
 
-def greedy_split_cost(perm: jax.Array, inst: Instance):
-    """Distance of the greedy-split solution for one customer order.
+def _greedy_fresh(perm: jax.Array, inst: Instance) -> jax.Array:
+    """bool[n]: does position k open a fresh route under the greedy rule?
 
-    Returns (cost, n_routes). Feasible w.r.t. capacity by construction
-    (unless a single customer exceeds capacity); callers penalise
-    `n_routes > V` to respect the fleet bound.
+    The single source of truth for the greedy route-opening rule, shared
+    by cost and reconstruction so they can never disagree. fresh[0] is
+    only True when perm[0] alone exceeds capacity (and is not counted as
+    an extra route by callers).
     """
-    d = inst.durations[0]
     q = inst.capacities[0]
     dem = inst.demands[perm]
 
@@ -44,6 +44,18 @@ def greedy_split_cost(perm: jax.Array, inst: Instance):
         return jnp.where(fresh, dk, load + dk), fresh
 
     _, fresh = jax.lax.scan(step, jnp.float32(0.0), dem)
+    return fresh
+
+
+def greedy_split_cost(perm: jax.Array, inst: Instance):
+    """Distance of the greedy-split solution for one customer order.
+
+    Returns (cost, n_routes). Feasible w.r.t. capacity by construction
+    (unless a single customer exceeds capacity); callers penalise
+    `n_routes > V` to respect the fleet bound.
+    """
+    d = inst.durations[0]
+    fresh = _greedy_fresh(perm, inst)
     prev, cur = perm[:-1], perm[1:]
     via_depot = d[prev, 0] + d[0, cur]
     direct = d[prev, cur]
@@ -113,14 +125,7 @@ def greedy_split_giant(perm: jax.Array, inst: Instance) -> jax.Array:
     """
     n = perm.shape[0]
     v = inst.n_vehicles
-    q = inst.capacities[0]
-    dem = inst.demands[perm]
-
-    def step(load, dk):
-        fresh = load + dk > q
-        return jnp.where(fresh, dk, load + dk), fresh
-
-    _, fresh = jax.lax.scan(step, jnp.float32(0.0), dem)
+    fresh = _greedy_fresh(perm, inst)
     rid = jnp.minimum(jnp.cumsum(fresh.astype(jnp.int32)) - fresh[0], v - 1)
     pos = 1 + jnp.arange(n) + rid
     giant = jnp.zeros(giant_length(n, v), dtype=jnp.int32)

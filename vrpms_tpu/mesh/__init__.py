@@ -1,0 +1,6 @@
+from vrpms_tpu.mesh.islands import (
+    make_mesh,
+    solve_sa_islands,
+    solve_ga_islands,
+    IslandParams,
+)

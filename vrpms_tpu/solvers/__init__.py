@@ -1,0 +1,10 @@
+from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
+from vrpms_tpu.solvers.bf import solve_tsp_bf, solve_vrp_bf
+from vrpms_tpu.solvers.local_search import (
+    nearest_neighbor_perm,
+    local_search,
+    solve_nn_2opt,
+)
+from vrpms_tpu.solvers.sa import SAParams, solve_sa
+from vrpms_tpu.solvers.ga import GAParams, solve_ga
+from vrpms_tpu.solvers.aco import ACOParams, solve_aco

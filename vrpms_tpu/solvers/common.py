@@ -1,0 +1,45 @@
+"""Shared result container for all solvers.
+
+Every solver — bf, local_search, sa, ga, aco — returns the same
+SolveResult so the service layer (the api->solver boundary the reference
+prescribes at README.md:31-33 but never wired) is algorithm-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core.cost import CostBreakdown, CostWeights, evaluate_giant, total_cost
+from vrpms_tpu.core.instance import Instance
+from vrpms_tpu.core.split import greedy_split_cost, greedy_split_giant
+
+
+class SolveResult(NamedTuple):
+    giant: jax.Array          # best giant tour found (core.encoding layout)
+    cost: jax.Array           # scalar weighted objective of `giant`
+    breakdown: CostBreakdown  # its cost components (distance, penalties, ...)
+    evals: jax.Array          # candidate evaluations performed (throughput metric)
+
+
+def perm_fitness_fn(inst: Instance, w: CostWeights, fleet_penalty: float = 1_000.0):
+    """Batched fitness for permutation genomes (GA population, ACO ants).
+
+    Plain CVRP: greedy split distance + penalty per route over the fleet
+    bound. Timed instances (TW or time-dependent durations): full
+    giant-tour evaluation so waiting/lateness are priced.
+    """
+    timed = inst.has_tw or inst.time_dependent
+    v = inst.n_vehicles
+
+    def fit(perm):
+        if timed:
+            giant = greedy_split_giant(perm, inst)
+            return total_cost(evaluate_giant(giant, inst), w)
+        cost, n_routes = greedy_split_cost(perm, inst)
+        overflow = jnp.maximum(n_routes - v, 0).astype(jnp.float32)
+        return cost + fleet_penalty * overflow
+
+    return jax.vmap(fit)

@@ -1,0 +1,171 @@
+"""Genetic algorithm over permutation genomes, fully vectorised.
+
+Fills the reference's GA endpoints — its richest contract: the VRP GA
+is the only endpoint with algorithm parameters (`multiThreaded`,
+`randomPermutationCount`, `iterationCount`, reference api/parameters.py:
+18-23) and the only one with CORS preflight. Parameter mapping here:
+`randomPermutationCount` -> population size (a population IS a set of
+random permutations), `iterationCount` -> generations, `multiThreaded`
+-> accepted and ignored (the population axis is always data-parallel on
+TPU; SURVEY.md §2.3).
+
+Genome = customer permutation; fitness = greedy capacity split
+(core.split) on plain CVRP, or full giant-tour evaluation when time
+windows / time-dependence require it. Every operator is index
+arithmetic so one generation is a handful of vmapped gathers:
+
+  * tournament selection — random [P, k] index draws, argmin by fitness;
+  * order crossover (OX) — child keeps p1's cut segment, fills the rest
+    with p2's order via a stable argsort compaction (no host loops);
+  * mutation — segment reversal / rotation on the genome.
+
+The generation loop is one `lax.scan`; islands across devices are layered
+on by vrpms_tpu.mesh (ring elite migration), not inside this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
+from vrpms_tpu.core.instance import Instance
+from vrpms_tpu.core.split import greedy_split_giant
+from vrpms_tpu.moves.moves import reverse_segment, rotate_segment
+from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
+
+
+@dataclasses.dataclass(frozen=True)
+class GAParams:
+    population: int = 256       # reference: randomPermutationCount
+    generations: int = 500      # reference: iterationCount
+    tournament: int = 4
+    crossover_rate: float = 0.9
+    mutation_rate: float = 0.3
+    elites: int = 16
+    fleet_penalty: float = 1_000.0  # per route beyond the fleet bound
+
+
+def _random_perms(key, pop: int, n: int) -> jax.Array:
+    base = jnp.arange(1, n + 1, dtype=jnp.int32)
+    return jax.vmap(lambda k: jax.random.permutation(k, base))(
+        jax.random.split(key, pop)
+    )
+
+
+def order_crossover(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Array:
+    """OX: keep p1[i..j], fill remaining slots with p2's order."""
+    n = p1.shape[0]
+    ij = jax.random.randint(key, (2,), 0, n)
+    i, j = jnp.minimum(ij[0], ij[1]), jnp.maximum(ij[0], ij[1])
+    pos = jnp.arange(n)
+    in_seg = (pos >= i) & (pos <= j)
+    # Mark genome values inside the kept segment (ids are 1..n; slot 0 is
+    # a scatter dump for masked-out positions).
+    in_seg_val = (
+        jnp.zeros(n + 1, dtype=bool)
+        .at[jnp.where(in_seg, p1, 0)]
+        .set(True)
+        .at[0]
+        .set(False)
+    )
+    keep = ~in_seg_val[p2]
+    compact = p2[jnp.argsort(~keep, stable=True)]  # kept elements, in p2 order
+    rank = jnp.cumsum(~in_seg) - 1
+    return jnp.where(in_seg, p1, compact[rank]).astype(jnp.int32)
+
+
+def mutate(perm: jax.Array, key: jax.Array, rate: float) -> jax.Array:
+    n = perm.shape[0]
+    k_do, k_pos, k_type = jax.random.split(key, 3)
+    ij = jax.random.randint(k_pos, (2,), 0, n)
+    i, j = jnp.minimum(ij[0], ij[1]), jnp.maximum(ij[0], ij[1])
+    mutated = jax.lax.switch(
+        jax.random.randint(k_type, (), 0, 2),
+        [
+            lambda p: reverse_segment(p, i, j),
+            lambda p: rotate_segment(p, i, j, 1),
+        ],
+        perm,
+    )
+    do = jax.random.uniform(k_do) < rate
+    return jnp.where(do, mutated, perm)
+
+
+def ga_generation(perms, fits, key, gen, fitness, params: GAParams):
+    """One generation: selection -> OX -> mutation -> elitism.
+
+    Standalone so the island driver (vrpms_tpu.mesh) can wrap it with
+    migration while reusing the identical update rule.
+    """
+    pop = perms.shape[0]
+    k_gen = jax.random.fold_in(key, gen)
+    k_t1, k_t2, k_cx, k_cxdo, k_mut = jax.random.split(k_gen, 5)
+
+    def tournament(k):
+        draws = jax.random.randint(k, (pop, params.tournament), 0, pop)
+        return draws[jnp.arange(pop), jnp.argmin(fits[draws], axis=1)]
+
+    pa = perms[tournament(k_t1)]
+    pb = perms[tournament(k_t2)]
+    children = jax.vmap(order_crossover)(pa, pb, jax.random.split(k_cx, pop))
+    do_cx = jax.random.uniform(k_cxdo, (pop,)) < params.crossover_rate
+    children = jnp.where(do_cx[:, None], children, pa)
+    children = jax.vmap(mutate, in_axes=(0, 0, None))(
+        children, jax.random.split(k_mut, pop), params.mutation_rate
+    )
+    # Elitism: overwrite the first E children with the current best E.
+    elite_idx = jnp.argsort(fits)[: params.elites]
+    children = children.at[: params.elites].set(perms[elite_idx])
+    new_fits = fitness(children)
+    return children, new_fits
+
+
+def solve_ga(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    params: GAParams = GAParams(),
+    weights: CostWeights | None = None,
+    init_perms: jax.Array | None = None,
+) -> SolveResult:
+    w = weights or CostWeights.make()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    n = inst.n_customers
+    pop = params.population
+    fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
+    k_init, k_run = jax.random.split(key)
+    perms0 = _random_perms(k_init, pop, n) if init_perms is None else init_perms
+
+    @jax.jit
+    def run(perms, key):
+        fits = fitness(perms)
+
+        def step(state, gen):
+            perms, fits, best_p, best_f = state
+            perms, fits = ga_generation(perms, fits, key, gen, fitness, params)
+            champ = jnp.argmin(fits)
+            better = fits[champ] < best_f
+            best_p = jnp.where(better, perms[champ], best_p)
+            best_f = jnp.where(better, fits[champ], best_f)
+            return (perms, fits, best_p, best_f), None
+
+        champ0 = jnp.argmin(fits)
+        state = (perms, fits, perms[champ0], fits[champ0])
+        (perms, fits, best_p, best_f), _ = jax.lax.scan(
+            step, state, jnp.arange(params.generations)
+        )
+        return best_p, best_f
+
+    best_perm, _ = run(perms0, k_run)
+    giant = greedy_split_giant(best_perm, inst)
+    bd = evaluate_giant(giant, inst)
+    return SolveResult(
+        giant,
+        total_cost(bd, w),
+        bd,
+        # evals from the actual population (init_perms may differ)
+        jnp.int32(perms0.shape[0] * params.generations),
+    )

@@ -1,0 +1,115 @@
+"""Simulated annealing: thousands of independent Metropolis chains in one jit.
+
+Fills the reference's SA endpoints (`# TODO: Run algorithm`, reference
+api/vrp/sa/index.py:40-45, api/tsp/sa/index.py) with the TPU-shaped
+design from SURVEY.md §2.3: the anneal is a single `lax.scan` over
+iterations whose body proposes one random move per chain (vmap over the
+chain axis), evaluates candidates with the batched cost kernel, and
+applies the Metropolis rule — so the entire search runs on device with
+one host sync at the end. Chain-parallelism replaces the reference's
+parsed-but-unused `multiThreaded` flag (reference api/parameters.py:20).
+
+PRNG discipline: one fold-in per iteration, one split per chain, so no
+key is ever reused across chains or steps (SURVEY.md §5 "race detection"
+analog for a functional runtime).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core.cost import CostWeights, evaluate_giant, objective_batch, total_cost
+from vrpms_tpu.core.encoding import random_giant_batch
+from vrpms_tpu.core.instance import Instance
+from vrpms_tpu.moves import random_move
+from vrpms_tpu.solvers.common import SolveResult
+
+
+@dataclasses.dataclass(frozen=True)
+class SAParams:
+    n_chains: int = 1024
+    n_iters: int = 20_000
+    t_initial: float | None = None  # None: scaled from mean duration
+    t_final: float | None = None
+
+
+def _auto_temps(inst: Instance, params: SAParams) -> tuple[float, float]:
+    scale = float(jnp.mean(inst.durations[0]))
+    t0 = params.t_initial if params.t_initial is not None else 0.8 * scale
+    t1 = params.t_final if params.t_final is not None else max(1e-3, 0.002 * scale)
+    return float(t0), float(t1)
+
+
+def sa_chain_step(giants, costs, key, it, t0, t1, n_iters, inst, w):
+    """One Metropolis sweep of every chain; the flagship compiled step.
+
+    Exposed standalone (not just inside solve_sa's scan) so the graft
+    entry point and the island-model driver can reuse the exact same
+    step function.
+    """
+    b = giants.shape[0]
+    frac = it.astype(jnp.float32) / max(n_iters - 1, 1)
+    temp = t0 * (t1 / t0) ** frac
+    k_it = jax.random.fold_in(key, it)
+    k_moves, k_accept = jax.random.split(k_it)
+    cands = jax.vmap(random_move)(jax.random.split(k_moves, b), giants)
+    cand_costs = objective_batch(cands, inst, w)
+    u = jax.random.uniform(k_accept, (b,))
+    accept = (cand_costs < costs) | (
+        u < jnp.exp(jnp.minimum((costs - cand_costs) / temp, 0.0))
+    )
+    giants = jnp.where(accept[:, None], cands, giants)
+    costs = jnp.where(accept, cand_costs, costs)
+    return giants, costs
+
+
+def solve_sa(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    params: SAParams = SAParams(),
+    weights: CostWeights | None = None,
+    init_giants: jax.Array | None = None,
+) -> SolveResult:
+    """Batched-chain SA; returns the best solution over all chains."""
+    w = weights or CostWeights.make()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    t0, t1 = _auto_temps(inst, params)
+    k_init, k_run = jax.random.split(key)
+    if init_giants is None:
+        giants = random_giant_batch(
+            k_init, params.n_chains, inst.n_customers, inst.n_vehicles
+        )
+    else:
+        giants = init_giants
+    n_iters = params.n_iters
+
+    @jax.jit
+    def run(giants, key):
+        costs = objective_batch(giants, inst, w)
+        best_g, best_c = giants, costs
+
+        def step(state, it):
+            giants, costs, best_g, best_c = state
+            giants, costs = sa_chain_step(
+                giants, costs, key, it, t0, t1, n_iters, inst, w
+            )
+            better = costs < best_c
+            best_g = jnp.where(better[:, None], giants, best_g)
+            best_c = jnp.where(better, costs, best_c)
+            return (giants, costs, best_g, best_c), None
+
+        state, _ = jax.lax.scan(
+            step, (giants, costs, best_g, best_c), jnp.arange(n_iters)
+        )
+        _, _, best_g, best_c = state
+        champ = jnp.argmin(best_c)
+        return best_g[champ], best_c[champ]
+
+    g, c = run(giants, k_run)
+    bd = evaluate_giant(g, inst)
+    # evals from the actual batch (init_giants may differ from n_chains)
+    return SolveResult(g, total_cost(bd, w), bd, jnp.int32(giants.shape[0] * n_iters))
