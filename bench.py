@@ -1,0 +1,114 @@
+"""Benchmark entry: one JSON line on stdout for the round driver.
+
+Measures the framework's primary throughput metric (BASELINE.json):
+candidate route evaluations per second per chip, on the X-n200-k36-
+shaped synthetic CVRP (200 nodes, 36 vehicles — CVRPLIB files can't be
+fetched in this zero-egress container; vrpms_tpu.io.synth generates the
+same statistical shape deterministically).
+
+vs_baseline = accelerator throughput / single-host CPU throughput of the
+identical compiled search. The reference publishes no solver numbers at
+all (BASELINE.md: every endpoint is a stub), so the honest baseline is
+the same workload on the host CPU — the hardware class the reference's
+pure-Python/serverless design targets.
+
+Diagnostics go to stderr; stdout carries exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _pick_device():
+    try:
+        dev = jax.devices()[0]
+        return dev, dev.platform
+    except RuntimeError as e:
+        print(f"[bench] default backend unavailable ({e}); forcing CPU", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
+        return dev, dev.platform
+
+
+def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
+    """routes/sec of the compiled SA sweep on `device` (compile excluded)."""
+    from vrpms_tpu.core.cost import CostWeights, objective_batch
+    from vrpms_tpu.core.encoding import random_giant_batch
+    from vrpms_tpu.solvers.sa import _auto_temps, sa_chain_step, SAParams
+
+    w = CostWeights.make()
+    t0, t1 = _auto_temps(inst, SAParams())
+    inst = jax.device_put(inst, device)
+
+    def chunk(giants, costs, key, start):
+        def body(state, i):
+            giants, costs = state
+            return sa_chain_step(
+                giants, costs, key, start + i, t0, t1, n_iters, inst, w
+            ), None
+
+        (giants, costs), _ = jax.lax.scan(
+            body, (giants, costs), jnp.arange(n_iters)
+        )
+        return giants, costs
+
+    run = jax.jit(chunk, device=device)
+    key = jax.random.key(seed)
+    giants = jax.device_put(
+        random_giant_batch(key, n_chains, inst.n_customers, inst.n_vehicles), device
+    )
+    costs = objective_batch(giants, inst, w)
+
+    # Warmup/compile
+    g, c = run(giants, costs, key, jnp.int32(0))
+    jax.block_until_ready(c)
+    t_start = time.perf_counter()
+    g, c = run(g, c, key, jnp.int32(n_iters))
+    jax.block_until_ready(c)
+    elapsed = time.perf_counter() - t_start
+    routes_per_sec = n_chains * n_iters / elapsed
+    return routes_per_sec, elapsed, float(jnp.min(c))
+
+
+def main():
+    dev, platform = _pick_device()
+    print(f"[bench] device: {dev} ({platform})", file=sys.stderr)
+
+    from vrpms_tpu.io.synth import synth_cvrp
+
+    inst = synth_cvrp(200, 36, seed=0)
+
+    if platform == "cpu":
+        value, elapsed, best = _throughput(inst, dev, n_chains=256, n_iters=200)
+        cpu_rps = value
+    else:
+        value, elapsed, best = _throughput(inst, dev, n_chains=4096, n_iters=1000)
+        try:
+            cpu_dev = jax.devices("cpu")[0]
+            cpu_rps, _, _ = _throughput(inst, cpu_dev, n_chains=256, n_iters=100)
+        except Exception as e:  # CPU fallback baseline unavailable
+            print(f"[bench] cpu baseline failed: {e}", file=sys.stderr)
+            cpu_rps = value
+
+    result = {
+        "metric": "candidate_routes_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "routes/s/chip",
+        "vs_baseline": round(value / cpu_rps, 3),
+        "device": platform,
+        "instance": "synth-X-n200-k36",
+        "best_cost": round(best, 1),
+        "measure_seconds": round(elapsed, 3),
+        "cpu_routes_per_sec": round(cpu_rps, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""The BASELINE.md benchmark ladder, runnable end-to-end.
+
+    python -m benchmarks.ladder [--quick] [--configs 1,2,3] [--cpu]
+
+Five configs (BASELINE.md table):
+  1  TSP-50 NN+2-opt through the api/tsp -> solver boundary (contract+core)
+  2  CVRP A-n32-k5-shaped, single-population SA
+  3  CVRP X-n200-k36-shaped, vmap population-parallel SA
+  4  CVRP GA island model over the device mesh
+  5  VRPTW Solomon-R101-shaped, TW penalty in the batched cost kernel
+
+CVRPLIB/Solomon files are welcome where available (pass --vrp/--solomon
+paths); the zero-egress default uses vrpms_tpu.io.synth stand-ins of the
+same shape. Each config prints a JSON line with cost/gap/throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _result(config, name, **kw):
+    line = {"config": config, "name": name}
+    line.update(kw)
+    print(json.dumps(line))
+    return line
+
+
+def config1_tsp50(quick=False):
+    """TSP-50 via the HTTP service boundary into NN+2-opt-grade search."""
+    import threading
+    import urllib.request
+
+    import store.memory as mem
+    from service.app import serve
+    from vrpms_tpu.io.synth import synth_tsp
+    from vrpms_tpu.solvers import solve_nn_2opt
+
+    inst = synth_tsp(51, seed=10)
+    d = np.asarray(inst.durations[0])
+    mem.seed_locations("l", [{"id": i} for i in range(51)])
+    mem.seed_durations("d", d.tolist())
+
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    body = {
+        "solutionName": "bench",
+        "solutionDescription": "config1",
+        "locationsKey": "l",
+        "durationsKey": "d",
+        "customers": list(range(1, 51)),
+        "startNode": 0,
+        "startTime": 0,
+        "seed": 0,
+        "iterationCount": 2000 if quick else 20000,
+    }
+    t0 = time.perf_counter()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/tsp/sa",
+        data=json.dumps(body).encode(),
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        payload = json.load(resp)
+    elapsed = time.perf_counter() - t0
+    srv.shutdown()
+    served = payload["message"]["duration"]
+    local = float(solve_nn_2opt(inst).cost)
+    return _result(
+        1,
+        "tsp50-api-to-solver",
+        service_duration=round(served, 1),
+        nn2opt_duration=round(local, 1),
+        seconds=round(elapsed, 2),
+    )
+
+
+def _sa_gap(inst, name, config, n_chains, n_iters, seed=0):
+    from vrpms_tpu.solvers.sa import SAParams, solve_sa
+
+    t0 = time.perf_counter()
+    res = solve_sa(inst, key=seed, params=SAParams(n_chains=n_chains, n_iters=n_iters))
+    elapsed = time.perf_counter() - t0
+    return _result(
+        config,
+        name,
+        cost=round(float(res.breakdown.distance), 1),
+        cap_excess=float(res.breakdown.cap_excess),
+        tw_lateness=round(float(res.breakdown.tw_lateness), 2),
+        seconds=round(elapsed, 2),
+        routes_per_sec=round(int(res.evals) / elapsed, 1),
+    )
+
+
+def config2_small_cvrp(quick=False):
+    from vrpms_tpu.io.synth import synth_cvrp
+
+    inst = synth_cvrp(32, 5, seed=11)
+    return _sa_gap(inst, "cvrp-n32-k5-sa", 2, 128, 2000 if quick else 20000)
+
+
+def config3_big_cvrp(quick=False):
+    from vrpms_tpu.io.synth import synth_cvrp
+
+    inst = synth_cvrp(200, 36, seed=0)
+    return _sa_gap(inst, "cvrp-n200-k36-vmap-sa", 3, 256 if quick else 2048,
+                   2000 if quick else 20000)
+
+
+def config4_ga_islands(quick=False):
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.mesh import IslandParams, solve_ga_islands
+    from vrpms_tpu.solvers.ga import GAParams
+
+    inst = synth_cvrp(100, 12, seed=12)
+    t0 = time.perf_counter()
+    res = solve_ga_islands(
+        inst,
+        key=0,
+        params=GAParams(population=256, generations=100 if quick else 1000, elites=4),
+        island_params=IslandParams(migrate_every=25, n_migrants=2),
+    )
+    elapsed = time.perf_counter() - t0
+    return _result(
+        4,
+        "cvrp-n100-ga-islands",
+        cost=round(float(res.breakdown.distance), 1),
+        cap_excess=float(res.breakdown.cap_excess),
+        seconds=round(elapsed, 2),
+        evals_per_sec=round(int(res.evals) / elapsed, 1),
+    )
+
+
+def config5_vrptw(quick=False, solomon_path=None):
+    if solomon_path:
+        from vrpms_tpu.io import load_solomon
+
+        inst, _ = load_solomon(solomon_path)
+        name = "vrptw-solomon"
+    else:
+        from vrpms_tpu.io.synth import synth_vrptw
+
+        inst = synth_vrptw(101, 19, seed=13)
+        name = "vrptw-r101-shaped"
+    return _sa_gap(inst, name, 5, 256, 2000 if quick else 30000)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--cpu", action="store_true", help="force CPU platform")
+    ap.add_argument("--solomon", help="path to a Solomon instance for config 5")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    wanted = {int(c) for c in args.configs.split(",")}
+    if 1 in wanted:
+        config1_tsp50(args.quick)
+    if 2 in wanted:
+        config2_small_cvrp(args.quick)
+    if 3 in wanted:
+        config3_big_cvrp(args.quick)
+    if 4 in wanted:
+        config4_ga_islands(args.quick)
+    if 5 in wanted:
+        config5_vrptw(args.quick, args.solomon)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""HTTP service layer: the reference's 9-endpoint contract, solver-backed.
+
+Routes (reference vercel.json deployment model, SURVEY.md §1 L1):
+  GET/POST /api                 health banner
+  GET/POST /api/vrp/{ga,sa,aco,bf}
+  GET/POST /api/tsp/{ga,sa,aco,bf}
+
+Envelope parity (reference api/helpers.py:16-29):
+  400 {"success": false, "errors": [{"what", "reason"}, ...]}
+  200 {"success": true, "message": {...result...}}
+
+Where the reference's handlers end in `# TODO: Run algorithm`
+(e.g. reference api/vrp/ga/index.py:48), these dispatch across the
+api->solver boundary into vrpms_tpu's compiled search.
+"""

@@ -1,0 +1,12 @@
+"""TSP Ant Colony Optimization endpoint (reference api/tsp/aco/index.py)."""
+
+from service.handler_base import SolveHandler
+from service.parameters import parse_common_tsp_parameters, parse_tsp_aco_parameters
+
+
+class handler(SolveHandler):
+    problem = "tsp"
+    algorithm = "aco"
+    banner = "Hi, this is the TSP Ant Colony Optimization endpoint"
+    parse_common = staticmethod(parse_common_tsp_parameters)
+    parse_algo = staticmethod(parse_tsp_aco_parameters)

@@ -1,0 +1,12 @@
+"""TSP Brute Force endpoint (reference api/tsp/bf/index.py)."""
+
+from service.handler_base import SolveHandler
+from service.parameters import parse_common_tsp_parameters
+
+
+class handler(SolveHandler):
+    problem = "tsp"
+    algorithm = "bf"
+    banner = "Hi, this is the TSP Brute Force endpoint"
+    parse_common = staticmethod(parse_common_tsp_parameters)
+    parse_algo = None

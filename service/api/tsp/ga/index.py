@@ -1,0 +1,12 @@
+"""TSP Genetic Algorithm endpoint (reference api/tsp/ga/index.py)."""
+
+from service.handler_base import SolveHandler
+from service.parameters import parse_common_tsp_parameters, parse_tsp_ga_parameters
+
+
+class handler(SolveHandler):
+    problem = "tsp"
+    algorithm = "ga"
+    banner = "Hi, this is the TSP Genetic Algorithm endpoint"
+    parse_common = staticmethod(parse_common_tsp_parameters)
+    parse_algo = staticmethod(parse_tsp_ga_parameters)

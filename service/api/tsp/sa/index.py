@@ -1,0 +1,12 @@
+"""TSP Simulated Annealing endpoint (reference api/tsp/sa/index.py)."""
+
+from service.handler_base import SolveHandler
+from service.parameters import parse_common_tsp_parameters, parse_tsp_sa_parameters
+
+
+class handler(SolveHandler):
+    problem = "tsp"
+    algorithm = "sa"
+    banner = "Hi, this is the TSP Simulated Annealing endpoint"
+    parse_common = staticmethod(parse_common_tsp_parameters)
+    parse_algo = staticmethod(parse_tsp_sa_parameters)

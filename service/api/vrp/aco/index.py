@@ -1,0 +1,12 @@
+"""VRP Ant Colony Optimization endpoint (reference api/vrp/aco/index.py)."""
+
+from service.handler_base import SolveHandler
+from service.parameters import parse_common_vrp_parameters, parse_vrp_aco_parameters
+
+
+class handler(SolveHandler):
+    problem = "vrp"
+    algorithm = "aco"
+    banner = "Hi, this is the VRP Ant Colony Optimization endpoint"
+    parse_common = staticmethod(parse_common_vrp_parameters)
+    parse_algo = staticmethod(parse_vrp_aco_parameters)

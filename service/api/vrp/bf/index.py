@@ -1,0 +1,12 @@
+"""VRP Brute Force endpoint (reference api/vrp/bf/index.py)."""
+
+from service.handler_base import SolveHandler
+from service.parameters import parse_common_vrp_parameters
+
+
+class handler(SolveHandler):
+    problem = "vrp"
+    algorithm = "bf"
+    banner = "Hi, this is the VRP Brute Force endpoint"
+    parse_common = staticmethod(parse_common_vrp_parameters)
+    parse_algo = None

@@ -1,0 +1,12 @@
+"""VRP Genetic Algorithm endpoint (reference api/vrp/ga/index.py)."""
+
+from service.handler_base import SolveHandler, CORSPreflightMixin
+from service.parameters import parse_common_vrp_parameters, parse_vrp_ga_parameters
+
+
+class handler(CORSPreflightMixin, SolveHandler):
+    problem = "vrp"
+    algorithm = "ga"
+    banner = "Hi, this is the VRP Genetic Algorithm endpoint"
+    parse_common = staticmethod(parse_common_vrp_parameters)
+    parse_algo = staticmethod(parse_vrp_ga_parameters)

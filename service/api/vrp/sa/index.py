@@ -1,0 +1,12 @@
+"""VRP Simulated Annealing endpoint (reference api/vrp/sa/index.py)."""
+
+from service.handler_base import SolveHandler
+from service.parameters import parse_common_vrp_parameters, parse_vrp_sa_parameters
+
+
+class handler(SolveHandler):
+    problem = "vrp"
+    algorithm = "sa"
+    banner = "Hi, this is the VRP Simulated Annealing endpoint"
+    parse_common = staticmethod(parse_common_vrp_parameters)
+    parse_algo = staticmethod(parse_vrp_sa_parameters)

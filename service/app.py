@@ -1,0 +1,102 @@
+"""Local HTTP server: one router standing in for Vercel's path mapping.
+
+The reference deploys each api/**/index.py as a serverless function
+routed by file path (reference README.md:69-72, vercel.json). For
+self-hosted/local serving this router reproduces that mapping in one
+threading HTTP server:
+
+    python -m service.app --port 8080 [--fixtures fixtures.json] [--store memory]
+
+Routes: /api, /api/{vrp,tsp}/{ga,sa,aco,bf}. Unknown paths -> 404.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from service.api.index import handler as health_handler
+from service.api.vrp.ga.index import handler as vrp_ga
+from service.api.vrp.sa.index import handler as vrp_sa
+from service.api.vrp.aco.index import handler as vrp_aco
+from service.api.vrp.bf.index import handler as vrp_bf
+from service.api.tsp.ga.index import handler as tsp_ga
+from service.api.tsp.sa.index import handler as tsp_sa
+from service.api.tsp.aco.index import handler as tsp_aco
+from service.api.tsp.bf.index import handler as tsp_bf
+
+ROUTES = {
+    "/api": health_handler,
+    "/api/vrp/ga": vrp_ga,
+    "/api/vrp/sa": vrp_sa,
+    "/api/vrp/aco": vrp_aco,
+    "/api/vrp/bf": vrp_bf,
+    "/api/tsp/ga": tsp_ga,
+    "/api/tsp/sa": tsp_sa,
+    "/api/tsp/aco": tsp_aco,
+    "/api/tsp/bf": tsp_bf,
+}
+
+
+class Router(BaseHTTPRequestHandler):
+    """Delegates each request to the per-route handler class by rebinding
+    the handler instance's class — the per-route classes keep the exact
+    shape Vercel expects (a BaseHTTPRequestHandler subclass per file), and
+    the router stays a thin dispatch layer."""
+
+    def log_message(self, format, *args):  # noqa: A002
+        pass
+
+    def _dispatch(self, method: str):
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        cls = ROUTES.get(path)
+        if cls is None:
+            self.send_response(404)
+            self.send_header("Content-type", "text/plain")
+            self.end_headers()
+            self.wfile.write(b"Not found")
+            return
+        self.__class__ = cls
+        getattr(self, f"do_{method}")()
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_OPTIONS(self):
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        cls = ROUTES.get(path)
+        if cls is None or not hasattr(cls, "do_OPTIONS"):
+            self.send_response(501)
+            self.end_headers()
+            return
+        self.__class__ = cls
+        self.do_OPTIONS()
+
+
+def serve(port: int = 8080):
+    server = ThreadingHTTPServer(("0.0.0.0", port), Router)
+    return server
+
+
+def main():
+    parser = argparse.ArgumentParser(description="vrpms_tpu service")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--fixtures", help="JSON fixture file for the memory store")
+    parser.add_argument("--store", choices=["memory", "supabase"])
+    args = parser.parse_args()
+    if args.store:
+        os.environ["VRPMS_STORE"] = args.store
+    if args.fixtures:
+        os.environ["VRPMS_FIXTURES"] = args.fixtures
+        os.environ.setdefault("VRPMS_STORE", "memory")
+    server = serve(args.port)
+    print(f"vrpms_tpu service on :{args.port} (store={os.environ.get('VRPMS_STORE', 'auto')})")
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

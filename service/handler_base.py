@@ -1,0 +1,132 @@
+"""Shared endpoint pipeline (the reference repeats this skeleton 8 times;
+here it lives once and each endpoint module binds its constants).
+
+Pipeline parity with reference handlers (SURVEY.md §3.1): read body ->
+parse params (error accumulation) -> 400 ladder -> fetch locations +
+durations from the store -> run algorithm -> save-if-authenticated ->
+200 envelope. The VRP save filters ignored/completed locations exactly
+like the reference (api/vrp/ga/index.py:57-65); the TSP save does not
+(api/tsp/bf/index.py:46-53).
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler
+
+import store
+from service.helpers import fail, remove_unused_locations, success
+from service.parameters import parse_solver_options
+from service.solve import run_tsp, run_vrp
+
+
+class SolveHandler(BaseHTTPRequestHandler):
+    """Base for all solve endpoints; subclasses set problem/algorithm/
+    banner and (for VRP GA) CORS preflight."""
+
+    problem: str = "vrp"       # 'vrp' | 'tsp'
+    algorithm: str = "sa"      # 'ga' | 'sa' | 'aco' | 'bf'
+    banner: str = "Hi!"
+    parse_common = None        # staticmethod set by subclass
+    parse_algo = None          # staticmethod or None
+
+    # Quiet request logging (BaseHTTPRequestHandler logs to stderr).
+    def log_message(self, format, *args):  # noqa: A002
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-type", "text/plain")
+        self.end_headers()
+        self.wfile.write(self.banner.encode("utf-8"))
+
+    def do_POST(self):
+        # Read
+        content_length = int(self.headers.get("Content-Length", 0))
+        content_string = str(self.rfile.read(content_length).decode("utf-8"))
+        try:
+            content = json.loads(content_string) if content_string else dict()
+        except json.JSONDecodeError as e:
+            fail(self, [{"what": "Bad request", "reason": f"invalid JSON: {e}"}])
+            return
+
+        # Parse parameters
+        errors: list = []
+        params = type(self).parse_common(content, errors)
+        algo_params = type(self).parse_algo(content, errors) if type(self).parse_algo else {}
+        opts = parse_solver_options(content, errors)
+
+        if len(errors) > 0:
+            fail(self, errors)
+            return
+
+        # Retrieve data from the store
+        try:
+            database = store.get_database(self.problem, params["auth"])
+        except Exception as e:
+            fail(self, [{"what": "Database error", "reason": str(e)}])
+            return
+        locations = database.get_locations_by_id(params["locations_key"], errors)
+        durations = database.get_durations_by_id(params["durations_key"], errors)
+
+        if len(errors) > 0:
+            fail(self, errors)
+            return
+
+        # Run algorithm (the reference's TODO hole, realised)
+        if self.problem == "vrp":
+            result = run_vrp(
+                self.algorithm, params, opts, algo_params, locations, durations, errors
+            )
+        else:
+            result = run_tsp(
+                self.algorithm, params, opts, algo_params, locations, durations, errors
+            )
+        if result is None or len(errors) > 0:
+            fail(self, errors)
+            return
+
+        # Save results
+        if params["auth"]:
+            if self.problem == "vrp":
+                database.save_solution(
+                    name=params["name"],
+                    description=params["description"],
+                    locations=remove_unused_locations(
+                        locations,
+                        params["ignored_customers"],
+                        params["completed_customers"],
+                    ),
+                    vehicles=result["vehicles"],
+                    duration_max=result["durationMax"],
+                    duration_sum=result["durationSum"],
+                    errors=errors,
+                )
+            else:
+                database.save_solution(
+                    name=params["name"],
+                    description=params["description"],
+                    locations=locations,
+                    vehicle=result["vehicle"],
+                    duration=result["duration"],
+                    errors=errors,
+                )
+
+        if len(errors) > 0:
+            fail(self, errors)
+            return
+
+        # Respond
+        success(self, result)
+
+
+class CORSPreflightMixin:
+    """The reference exposes OPTIONS preflight only on VRP GA
+    (api/vrp/ga/index.py:16-22, vercel.json:4-11)."""
+
+    def do_OPTIONS(self):
+        self.send_response(200, "ok")
+        self.send_header("Access-Control-Allow-Origin", "*")
+        self.send_header("Access-Control-Allow-Methods", "*")
+        self.send_header("Access-Control-Allow-Headers", "*")
+        self.end_headers()

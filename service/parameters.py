@@ -1,0 +1,90 @@
+"""Request-body schemas: the reference's keys, plus optional solver knobs.
+
+Required/optional split and camelCase->snake_case mapping preserved
+exactly from the reference (api/parameters.py) so existing clients work
+unchanged. New *optional* keys extend the reference's per-request flag
+system (SURVEY.md §5 "config"): solver hyperparameters and a backend
+selector, all defaulted so omitting them reproduces reference behavior.
+"""
+
+from __future__ import annotations
+
+from service.helpers import get_parameter
+
+
+def parse_common_vrp_parameters(content: dict, errors):
+    return {
+        "name": get_parameter("solutionName", content, errors),
+        "auth": get_parameter("auth", content, errors, optional=True),
+        "description": get_parameter("solutionDescription", content, errors),
+        "locations_key": get_parameter("locationsKey", content, errors),
+        "durations_key": get_parameter("durationsKey", content, errors),
+        "capacities": get_parameter("capacities", content, errors),
+        "start_times": get_parameter("startTimes", content, errors),
+        "ignored_customers": get_parameter("ignoredCustomers", content, errors),
+        "completed_customers": get_parameter("completedCustomers", content, errors),
+    }
+
+
+def parse_vrp_ga_parameters(content: dict, errors):
+    return {
+        "multi_threaded": get_parameter("multiThreaded", content, errors),
+        "random_permutationCount": get_parameter(
+            "randomPermutationCount", content, errors
+        ),
+        "iteration_count": get_parameter("iterationCount", content, errors),
+    }
+
+
+def parse_vrp_sa_parameters(content: dict, errors):
+    return {}
+
+
+def parse_vrp_aco_parameters(content: dict, errors):
+    return {}
+
+
+def parse_common_tsp_parameters(content: dict, errors):
+    return {
+        "name": get_parameter("solutionName", content, errors),
+        "auth": get_parameter("auth", content, errors, optional=True),
+        "description": get_parameter("solutionDescription", content, errors),
+        "locations_key": get_parameter("locationsKey", content, errors),
+        "durations_key": get_parameter("durationsKey", content, errors),
+        "customers": get_parameter("customers", content, errors),
+        "start_node": get_parameter("startNode", content, errors),
+        "start_time": get_parameter("startTime", content, errors),
+    }
+
+
+def parse_tsp_ga_parameters(content: dict, errors):
+    return {}
+
+
+def parse_tsp_sa_parameters(content: dict, errors):
+    return {}
+
+
+def parse_tsp_aco_parameters(content: dict, errors):
+    return {}
+
+
+def parse_solver_options(content: dict, errors):
+    """Optional extension keys (absent in the reference; all defaulted).
+
+    backend:            "tpu" | "cpu" — device preference for the solve
+    seed:               PRNG seed (determinism for a given request)
+    iterationCount:     iteration/generation budget (GA endpoints already
+                        require it; optional everywhere else)
+    populationSize:     SA chains / GA population / ACO ants
+    timeSliceDuration:  minutes per time-of-day slice of a 3-D matrix
+    """
+    return {
+        "backend": get_parameter("backend", content, errors, optional=True),
+        "seed": get_parameter("seed", content, errors, optional=True),
+        "iteration_count": get_parameter("iterationCount", content, errors, optional=True),
+        "population_size": get_parameter("populationSize", content, errors, optional=True),
+        "time_slice_duration": get_parameter(
+            "timeSliceDuration", content, errors, optional=True
+        ),
+    }

@@ -1,0 +1,290 @@
+"""The api->solver bridge: request data in, contract-shaped results out.
+
+This module stands exactly where the reference's `# TODO: Run algorithm`
+holes sit (reference api/vrp/ga/index.py:48-53, api/tsp/bf/index.py:39-43)
+and where its README prescribes the api->src call boundary (reference
+README.md:31-33). It:
+
+  1. compacts the request's locations + durations matrix into a
+     device-ready Instance (excluding ignored/completed customers — the
+     reference's dynamic re-solve inputs, api/parameters.py:13-14);
+  2. dispatches to the requested solver (bf/sa/ga/aco) with hyper-
+     parameters from the request (GA's reference-required params map to
+     population/generations; everything else has TPU-sized defaults);
+  3. decodes the winning giant tour back to original location ids and
+     shapes the result to the endpoint contract: VRP
+     {durationMax, durationSum, vehicles}, TSP {duration, vehicle}.
+
+Location schema (the reference stores opaque location dicts with an 'id',
+api/helpers.py:11-13; solver-relevant optional keys defined here):
+  {'id': int, 'demand': num (default 1), 'serviceTime': num (default 0),
+   'timeWindow': [ready, due] (optional)}
+The durations matrix is indexed by position in the locations list; a
+3-D nesting matrix[i][j] == [per-slice durations] is time-of-day data.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import sys
+import traceback
+
+import numpy as np
+import jax
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core.encoding import routes_from_giant
+from vrpms_tpu.solvers import (
+    ACOParams,
+    GAParams,
+    SAParams,
+    solve_aco,
+    solve_ga,
+    solve_sa,
+    solve_tsp_bf,
+    solve_vrp_bf,
+)
+
+DEFAULT_SLICE_MINUTES = 60.0
+
+
+def _device_ctx(backend):
+    """Best-effort device preference; default platform otherwise."""
+    if backend in ("cpu", "tpu"):
+        try:
+            dev = jax.devices(backend)[0]
+            return jax.default_device(dev)
+        except RuntimeError:
+            pass
+    return contextlib.nullcontext()
+
+
+def _as_float(x):
+    return float(np.asarray(x))
+
+
+def _enveloped(fn):
+    """Any unexpected failure becomes a Data error in the envelope — a
+    request must never take down the connection without the contract's
+    400 JSON body (reference api/helpers.py:16-21)."""
+
+    @functools.wraps(fn)
+    def wrapper(algorithm, params, opts, ga_params, locations, matrix, errors):
+        try:
+            return fn(algorithm, params, opts, ga_params, locations, matrix, errors)
+        except Exception as e:
+            traceback.print_exc(file=sys.stderr)
+            errors += [
+                {"what": "Data error", "reason": f"{type(e).__name__}: {e}"}
+            ]
+            return None
+
+    return wrapper
+
+
+def _build_arrays(locations, matrix, active_pos, errors, slice_minutes):
+    """Sub-select the duration matrix and per-location fields for the
+    active positions (depot first)."""
+    arr = np.asarray(matrix, dtype=np.float64)
+    n_all = len(locations)
+    if arr.ndim not in (2, 3) or arr.shape[0] != n_all or arr.shape[1] != n_all:
+        errors += [
+            {
+                "what": "Data error",
+                "reason": f"durations matrix shape {arr.shape} does not match "
+                f"{n_all} locations",
+            }
+        ]
+        return None
+    sub = arr[np.ix_(active_pos, active_pos)]
+    locs = [locations[i] for i in active_pos]
+    demands = [0.0] + [float(loc.get("demand", 1)) for loc in locs[1:]]
+    service = [float(loc.get("serviceTime", 0)) for loc in locs]
+    tws = [loc.get("timeWindow") for loc in locs]
+    has_tw = any(tw is not None for tw in tws)
+    ready = due = None
+    if has_tw:
+        big = 1e9
+        ready = [float(tw[0]) if tw else 0.0 for tw in tws]
+        due = [float(tw[1]) if tw else big for tw in tws]
+    return {
+        "durations": sub,
+        "demands": demands,
+        "service": service,
+        "ready": ready,
+        "due": due,
+        "slice_axis": "last" if sub.ndim == 3 else "auto",
+        "slice_minutes": slice_minutes,
+    }
+
+
+def _solve_instance(inst, algorithm, opts, ga_params, errors, problem):
+    """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
+    seed = int(opts.get("seed") or 0)
+    iters = opts.get("iteration_count")
+    pop = opts.get("population_size")
+    try:
+        if algorithm == "bf":
+            if problem == "tsp":
+                return solve_tsp_bf(inst)
+            return solve_vrp_bf(inst)
+        if algorithm == "sa":
+            p = SAParams(
+                n_chains=int(pop or 128),
+                n_iters=int(iters or 5000),
+            )
+            return solve_sa(inst, key=seed, params=p)
+        if algorithm == "aco":
+            p = ACOParams(n_ants=int(pop or 64), n_iters=int(iters or 200))
+            return solve_aco(inst, key=seed, params=p)
+        if algorithm == "ga":
+            population = int(pop or (ga_params or {}).get("random_permutationCount") or 128)
+            generations = int(iters or (ga_params or {}).get("iteration_count") or 300)
+            p = GAParams(
+                population=max(population, 8),
+                generations=max(generations, 1),
+                elites=max(2, min(16, population // 8)),
+            )
+            return solve_ga(inst, key=seed, params=p)
+        raise ValueError(f"unknown algorithm {algorithm!r}")
+    except ValueError as e:
+        errors += [{"what": "Solver error", "reason": str(e)}]
+        return None
+
+
+@_enveloped
+def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors):
+    """Solve a VRP request; returns the contract result dict or None."""
+    capacities = params["capacities"]
+    start_times = params["start_times"]
+    if not isinstance(capacities, list) or not capacities:
+        errors += [
+            {"what": "Data error", "reason": "'capacities' must be a non-empty list"}
+        ]
+        return None
+    if not isinstance(start_times, list) or len(start_times) != len(capacities):
+        errors += [
+            {
+                "what": "Data error",
+                "reason": "'startTimes' must be a list with one entry per vehicle",
+            }
+        ]
+        return None
+
+    ids = [loc.get("id") for loc in locations]
+    depot_pos = ids.index(0) if 0 in ids else 0
+    excluded = set((params["ignored_customers"] or []) + (params["completed_customers"] or []))
+    active_pos = [depot_pos] + [
+        i
+        for i, loc in enumerate(locations)
+        if i != depot_pos and loc.get("id") not in excluded
+    ]
+    slice_minutes = float(opts.get("time_slice_duration") or DEFAULT_SLICE_MINUTES)
+    arrays = _build_arrays(locations, matrix, active_pos, errors, slice_minutes)
+    if arrays is None:
+        return None
+
+    n_customers = len(active_pos) - 1
+    if n_customers == 0:
+        return {"durationMax": 0, "durationSum": 0, "vehicles": []}
+
+    inst = make_instance(
+        arrays["durations"],
+        demands=arrays["demands"],
+        capacities=[float(c) for c in capacities],
+        ready=arrays["ready"],
+        due=arrays["due"],
+        service=arrays["service"],
+        start_times=[float(t) for t in start_times],
+        slice_minutes=slice_minutes,
+        slice_axis=arrays["slice_axis"],
+    )
+    with _device_ctx(opts.get("backend")):
+        res = _solve_instance(inst, algorithm, opts, ga_params, errors, "vrp")
+    if res is None:
+        return None
+
+    bd = res.breakdown
+    route_durs = np.asarray(bd.route_durations)
+    demands = np.asarray(inst.demands)
+    orig_ids = [locations[i]["id"] for i in active_pos]
+    depot_id = locations[depot_pos]["id"]
+    vehicles = []
+    for r, route in enumerate(routes_from_giant(res.giant)):
+        if not route:
+            continue
+        vehicles.append(
+            {
+                "id": r,
+                "capacity": float(capacities[r]),
+                "tour": [depot_id] + [orig_ids[c] for c in route] + [depot_id],
+                "duration": float(route_durs[r]),
+                "load": float(sum(demands[c] for c in route)),
+            }
+        )
+    return {
+        "durationMax": _as_float(bd.duration_max),
+        "durationSum": _as_float(bd.duration_sum),
+        "vehicles": vehicles,
+    }
+
+
+@_enveloped
+def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors):
+    """Solve a TSP request; returns the contract result dict or None."""
+    customers = params["customers"]
+    start_node = params["start_node"]
+    if not isinstance(customers, list):
+        errors += [{"what": "Data error", "reason": "'customers' must be a list"}]
+        return None
+    customers = list(dict.fromkeys(customers))  # dedupe, preserving order
+    ids = [loc.get("id") for loc in locations]
+    if start_node not in ids:
+        errors += [
+            {"what": "Data error", "reason": f"startNode {start_node} not in locations"}
+        ]
+        return None
+    missing = [c for c in customers if c not in ids]
+    if missing:
+        errors += [
+            {"what": "Data error", "reason": f"customers {missing} not in locations"}
+        ]
+        return None
+
+    depot_pos = ids.index(start_node)
+    active_pos = [depot_pos] + [
+        ids.index(c) for c in customers if c != start_node
+    ]
+    slice_minutes = float(opts.get("time_slice_duration") or DEFAULT_SLICE_MINUTES)
+    arrays = _build_arrays(locations, matrix, active_pos, errors, slice_minutes)
+    if arrays is None:
+        return None
+
+    if len(active_pos) == 1:
+        return {"duration": 0, "vehicle": []}
+
+    start_time = float(params["start_time"] or 0)
+    inst = make_instance(
+        arrays["durations"],
+        demands=None,
+        n_vehicles=1,
+        ready=arrays["ready"],
+        due=arrays["due"],
+        service=arrays["service"],
+        start_times=[start_time],
+        slice_minutes=slice_minutes,
+        slice_axis=arrays["slice_axis"],
+    )
+    with _device_ctx(opts.get("backend")):
+        res = _solve_instance(inst, algorithm, opts, ga_params, errors, "tsp")
+    if res is None:
+        return None
+
+    orig_ids = [locations[i]["id"] for i in active_pos]
+    routes = routes_from_giant(res.giant)
+    tour = [start_node] + [orig_ids[c] for c in routes[0]] + [start_node]
+    return {
+        "duration": _as_float(res.breakdown.duration_sum),
+        "vehicle": tour,
+    }

@@ -1,0 +1,38 @@
+"""Pluggable persistence keeping the reference's table/row shapes.
+
+The reference binds its handlers directly to a Supabase client
+(reference api/database.py). Here the same interface — get_locations_by_id,
+get_durations_by_id, save_solution with identical row shapes — is a seam
+(store.base.Database) with two implementations:
+
+  * store.memory  — in-process fake for tests/local runs (the clean seam
+    SURVEY.md §4 item 4 calls for; no network, seedable);
+  * store.supabase_store — the real adapter, import-gated so the
+    framework runs without the supabase SDK installed.
+
+Selection: VRPMS_STORE env var ("memory" | "supabase"); default is
+"supabase" when SUPABASE_URL is configured (reference parity), else
+"memory".
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def get_database(problem: str, auth=None):
+    """Factory: problem is 'vrp' or 'tsp'; returns the configured store."""
+    kind = os.environ.get("VRPMS_STORE")
+    if kind is None:
+        kind = "supabase" if os.environ.get("SUPABASE_URL") else "memory"
+    if kind == "memory":
+        from store.memory import InMemoryDatabaseTSP, InMemoryDatabaseVRP
+
+        cls = InMemoryDatabaseVRP if problem == "vrp" else InMemoryDatabaseTSP
+        return cls(auth)
+    if kind == "supabase":
+        from store.supabase_store import SupabaseDatabaseTSP, SupabaseDatabaseVRP
+
+        cls = SupabaseDatabaseVRP if problem == "vrp" else SupabaseDatabaseTSP
+        return cls(auth)
+    raise ValueError(f"unknown VRPMS_STORE {kind!r}")

@@ -1,0 +1,124 @@
+"""The Database seam: reference row shapes, backend-agnostic.
+
+Shapes preserved from the reference (SURVEY.md §2.2 "persistence"):
+  locations row:  {'id': ..., 'locations': [...]}   -> returns row['locations']
+                  (reference api/database.py:26-36)
+  durations row:  {'id': ..., 'matrix': [[...]]}    -> returns row['matrix']
+                  (reference api/database.py:38-48)
+  VRP solution:   {'name', 'description', 'owner', 'durationMax',
+                   'durationSum', 'locations', 'vehicles'}
+                  (reference api/database.py:69-77)
+  TSP solution:   {'name', 'description', 'owner', 'duration',
+                   'locations', 'vehicle'}
+                  (reference api/database.py:102-109)
+
+Errors are accumulated into the caller's mutable list as
+{'what': ..., 'reason': ...} dicts — the reference's error idiom.
+"""
+
+from __future__ import annotations
+
+
+class Database:
+    """Abstract store. Subclasses implement _fetch_row / _insert_solution
+    and _owner_email; the public methods provide the shared error
+    envelope semantics."""
+
+    def __init__(self, auth=None):
+        self.auth = auth
+
+    # -- backend primitives -------------------------------------------------
+    def _fetch_row(self, table: str, row_id):
+        raise NotImplementedError
+
+    def _insert_solution(self, data: dict):
+        raise NotImplementedError
+
+    def _owner_email(self) -> str | None:
+        raise NotImplementedError
+
+    # -- reference-shaped API ----------------------------------------------
+    def get_locations_by_id(self, id, errors):
+        try:
+            row = self._fetch_row("locations", id)
+            if row is None:
+                raise Exception(
+                    f"No location set found with given id {id}. "
+                    "Make sure you are accessing public data or data owned "
+                    "by you. Check if your authentication token has expired."
+                )
+            return row["locations"]
+        except Exception as exception:
+            errors += [{"what": "Database read error", "reason": str(exception)}]
+            return None
+
+    def get_durations_by_id(self, id, errors):
+        try:
+            row = self._fetch_row("durations", id)
+            if row is None:
+                raise Exception(
+                    f"No duration matrix found with given id {id}. "
+                    "Make sure you are accessing public data or data owned "
+                    "by you. Check if your authentication token has expired."
+                )
+            return row["matrix"]
+        except Exception as exception:
+            errors += [{"what": "Database read error", "reason": str(exception)}]
+            return None
+
+    def _save(self, data: dict, errors):
+        try:
+            email = self._owner_email()
+        except Exception as exception:
+            # e.g. supabase get_user() raising on an expired token; must
+            # surface as the error envelope, not a dropped connection.
+            errors += [{"what": "Database auth error", "reason": str(exception)}]
+            return None
+        if not email:
+            errors += [
+                {
+                    "what": "Not permitted",
+                    "reason": "An authentication token is required to save "
+                    "solutions to database. Please provide 'auth' with a "
+                    "valid JWT token in the request body. If you have "
+                    "already provided a token, it has very likely expired.",
+                }
+            ]
+            return None
+        data = dict(data, owner=email)
+        try:
+            return self._insert_solution(data)
+        except Exception as exception:
+            errors += [{"what": "Database write error", "reason": str(exception)}]
+            return None
+
+
+class DatabaseVRP(Database):
+    def save_solution(
+        self, name, description, locations, vehicles, duration_max, duration_sum, errors
+    ):
+        return self._save(
+            {
+                "name": name,
+                "description": description,
+                "durationMax": duration_max,
+                "durationSum": duration_sum,
+                "locations": locations,
+                "vehicles": vehicles,
+            },
+            errors,
+        )
+
+
+class DatabaseTSP(Database):
+    def save_solution(self, name, description, locations, vehicle, duration, errors):
+        return self._save(
+            {
+                "name": name,
+                "description": description,
+                "duration": duration,
+                "locations": locations,
+                "vehicle": vehicle,
+            },
+            errors,
+        )

@@ -1,0 +1,103 @@
+"""In-memory store: the fake database for tests and local serving.
+
+A process-wide table dict mirrors the reference's Supabase tables
+(locations / durations / solutions — reference api/database.py:28,40,80)
+and a token registry stands in for JWT auth: a token maps to an email,
+which becomes the solution's `owner` exactly like the reference derives
+it from the JWT session (reference api/database.py:54-55).
+
+Seed programmatically (seed_locations / seed_durations / register_token)
+or from a JSON fixture file via VRPMS_FIXTURES:
+
+    {"locations": {"key": [...]},
+     "durations": {"key": [[...]]},
+     "tokens": {"token": "user@example.com"}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from store.base import Database, DatabaseTSP, DatabaseVRP
+
+_lock = threading.Lock()
+_tables: dict = {"locations": {}, "durations": {}, "solutions": []}
+_tokens: dict = {}
+_fixtures_loaded = False
+
+
+def reset():
+    with _lock:
+        _tables["locations"].clear()
+        _tables["durations"].clear()
+        _tables["solutions"].clear()
+        _tokens.clear()
+        global _fixtures_loaded
+        _fixtures_loaded = False
+
+
+def seed_locations(key, locations: list):
+    with _lock:
+        _tables["locations"][str(key)] = {"id": key, "locations": locations}
+
+
+def seed_durations(key, matrix: list):
+    with _lock:
+        _tables["durations"][str(key)] = {"id": key, "matrix": matrix}
+
+
+def register_token(token: str, email: str):
+    with _lock:
+        _tokens[token] = email
+
+
+def saved_solutions() -> list:
+    return list(_tables["solutions"])
+
+
+_fixtures_lock = threading.Lock()
+
+
+def _ensure_fixtures():
+    global _fixtures_loaded
+    if _fixtures_loaded:
+        return
+    with _fixtures_lock:  # serialize first loads; flag only set on success
+        if _fixtures_loaded:
+            return
+        path = os.environ.get("VRPMS_FIXTURES")
+        if path:
+            with open(path) as f:
+                fx = json.load(f)
+            for key, locs in fx.get("locations", {}).items():
+                seed_locations(key, locs)
+            for key, matrix in fx.get("durations", {}).items():
+                seed_durations(key, matrix)
+            for token, email in fx.get("tokens", {}).items():
+                register_token(token, email)
+        _fixtures_loaded = True
+
+
+class _InMemoryMixin(Database):
+    def _fetch_row(self, table: str, row_id):
+        _ensure_fixtures()
+        return _tables[table].get(str(row_id))
+
+    def _insert_solution(self, data: dict):
+        with _lock:
+            _tables["solutions"].append(data)
+        return data
+
+    def _owner_email(self):
+        _ensure_fixtures()
+        return _tokens.get(self.auth) if self.auth else None
+
+
+class InMemoryDatabaseVRP(_InMemoryMixin, DatabaseVRP):
+    pass
+
+
+class InMemoryDatabaseTSP(_InMemoryMixin, DatabaseTSP):
+    pass

@@ -1,0 +1,64 @@
+"""Supabase adapter: reference-parity persistence, import-gated.
+
+Mirrors the reference's client usage (reference api/database.py): anon
+client from SUPABASE_URL/SUPABASE_KEY, JWT login via set_session with
+swallowed failure (:18-23), per-table select-by-id, owner email from the
+authenticated user. The supabase SDK is imported lazily so environments
+without it (this framework's solver core has no network dependency) can
+still import the package; constructing the store without the SDK raises
+a clear error.
+"""
+
+from __future__ import annotations
+
+import os
+
+from store.base import Database, DatabaseTSP, DatabaseVRP
+
+
+class _SupabaseMixin(Database):
+    def __init__(self, auth=None):
+        super().__init__(auth)
+        try:
+            from supabase.client import create_client
+            from supabase.lib.client_options import ClientOptions
+        except ImportError as e:  # pragma: no cover - environment dependent
+            raise RuntimeError(
+                "supabase SDK not installed; set VRPMS_STORE=memory or "
+                "install supabase to use the hosted store"
+            ) from e
+        url = os.environ.get("SUPABASE_URL") or ""
+        key = os.environ.get("SUPABASE_KEY") or ""
+        self.client = create_client(
+            url, key, options=ClientOptions(persist_session=False)
+        )
+        if auth:
+            try:
+                self.client.auth.set_session(access_token=auth, refresh_token=auth)
+            except Exception:
+                # Reference parity: login failures surface later as
+                # missing-owner / row-level-security errors, not here.
+                pass
+
+    def _fetch_row(self, table: str, row_id):
+        result = self.client.table(table).select("*").eq("id", row_id).execute()
+        if not len(result.data):
+            return None
+        return result.data[0]
+
+    def _insert_solution(self, data: dict):
+        return self.client.table("solutions").insert(data).execute()
+
+    def _owner_email(self):
+        user = self.client.auth.get_user()
+        if not user:
+            return None
+        return user.model_dump()["user"]["email"]
+
+
+class SupabaseDatabaseVRP(_SupabaseMixin, DatabaseVRP):
+    pass
+
+
+class SupabaseDatabaseTSP(_SupabaseMixin, DatabaseTSP):
+    pass

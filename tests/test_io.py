@@ -1,0 +1,141 @@
+"""Loader tests: hand-written fixtures in both standard formats."""
+
+import numpy as np
+import pytest
+
+from vrpms_tpu.io import (
+    gap_percent,
+    parse_cvrplib,
+    parse_solomon,
+    synth_cvrp,
+    synth_tsp,
+    synth_vrptw,
+)
+from vrpms_tpu.solvers import solve_sa
+from vrpms_tpu.solvers.sa import SAParams
+
+CVRP_TEXT = """NAME : TINY-n5-k2
+COMMENT : hand-written fixture
+TYPE : CVRP
+DIMENSION : 5
+EDGE_WEIGHT_TYPE : EUC_2D
+CAPACITY : 10
+NODE_COORD_SECTION
+ 1 0 0
+ 2 3 0
+ 3 3 4
+ 4 0 4
+ 5 6 8
+DEMAND_SECTION
+ 1 0
+ 2 4
+ 3 5
+ 4 6
+ 5 3
+DEPOT_SECTION
+ 1
+ -1
+EOF
+"""
+
+SOLOMON_TEXT = """TINY1
+
+VEHICLE
+NUMBER     CAPACITY
+   3         50
+
+CUSTOMER
+CUST NO.  XCOORD.   YCOORD.    DEMAND   READY TIME   DUE DATE   SERVICE TIME
+    0      10         10          0          0       500          0
+    1      15         10         10         50       150         10
+    2      10         20         20          0       100         10
+    3       5          5         15        100       300         10
+"""
+
+
+class TestCVRPLIB:
+    def test_parse_fields(self):
+        inst, meta = parse_cvrplib(CVRP_TEXT)
+        assert meta["name"] == "TINY-n5-k2"
+        assert inst.n_nodes == 5
+        assert inst.n_vehicles == 2  # from -k2 suffix
+        assert float(inst.capacities[0]) == 10.0
+        np.testing.assert_allclose(np.asarray(inst.demands), [0, 4, 5, 6, 3])
+        # nint(euclid): node1->node2 = 3, node2->node3 = 4, node1->node5 = 10
+        d = np.asarray(inst.durations[0])
+        assert d[0, 1] == 3 and d[1, 2] == 4 and d[0, 4] == 10
+
+    def test_unrounded(self):
+        inst, _ = parse_cvrplib(CVRP_TEXT, round_nint=False)
+        d = np.asarray(inst.durations[0])
+        np.testing.assert_allclose(d[0, 2], 5.0)
+
+    def test_explicit_matrix(self):
+        text = """NAME : EXP3
+TYPE : CVRP
+DIMENSION : 3
+EDGE_WEIGHT_TYPE : EXPLICIT
+EDGE_WEIGHT_FORMAT : FULL_MATRIX
+CAPACITY : 5
+EDGE_WEIGHT_SECTION
+0 2 9
+2 0 4
+9 4 0
+DEMAND_SECTION
+1 0
+2 1
+3 2
+EOF
+"""
+        inst, _ = parse_cvrplib(text)
+        d = np.asarray(inst.durations[0])
+        assert d[0, 2] == 9 and d[2, 1] == 4
+
+    def test_solvable(self):
+        inst, _ = parse_cvrplib(CVRP_TEXT)
+        res = solve_sa(inst, key=0, params=SAParams(n_chains=32, n_iters=800))
+        assert float(res.breakdown.cap_excess) == 0.0
+
+
+class TestSolomon:
+    def test_parse(self):
+        inst, meta = parse_solomon(SOLOMON_TEXT)
+        assert inst.n_nodes == 4
+        assert inst.n_vehicles == 3
+        assert float(inst.capacities[0]) == 50.0
+        assert inst.has_tw
+        np.testing.assert_allclose(np.asarray(inst.ready), [0, 50, 0, 100])
+        np.testing.assert_allclose(np.asarray(inst.due), [500, 150, 100, 300])
+        # service[0] forced to 0 at the depot
+        np.testing.assert_allclose(np.asarray(inst.service), [0, 10, 10, 10])
+        # truncated to 1dp: dist(0,1) = 5.0, dist(0,2) = 10.0
+        d = np.asarray(inst.durations[0])
+        assert d[0, 1] == 5.0 and d[0, 2] == 10.0
+
+    def test_solvable_feasible(self):
+        inst, _ = parse_solomon(SOLOMON_TEXT)
+        res = solve_sa(inst, key=0, params=SAParams(n_chains=64, n_iters=2000))
+        assert float(res.breakdown.tw_lateness) == 0.0
+        assert float(res.breakdown.cap_excess) == 0.0
+
+
+class TestSynth:
+    def test_deterministic(self):
+        a = synth_cvrp(30, 4, seed=7)
+        b = synth_cvrp(30, 4, seed=7)
+        np.testing.assert_array_equal(np.asarray(a.durations), np.asarray(b.durations))
+        assert a.n_vehicles == 4
+
+    def test_vrptw_has_tw(self):
+        inst = synth_vrptw(20, 4, seed=1)
+        assert inst.has_tw
+        assert float(inst.due[0]) == 1000.0
+
+    def test_tsp(self):
+        inst = synth_tsp(16, seed=2)
+        assert inst.n_vehicles == 1 and inst.n_customers == 15
+
+    def test_gap(self):
+        assert gap_percent(102.0, 100.0) == pytest.approx(2.0)
+        with pytest.raises(ValueError):
+            gap_percent(1.0, 0.0)

@@ -1,0 +1,349 @@
+"""API-contract tests against the in-memory store (SURVEY.md §4 item 4).
+
+Replays the reference's request/response shapes end-to-end over real
+HTTP: camelCase keys, error accumulation, the fail/success envelopes,
+result asymmetry (VRP vehicles/durationMax/durationSum vs TSP
+vehicle/duration), VRP-only location filtering on save, CORS preflight
+on VRP GA only.
+"""
+
+import json
+import threading
+import urllib.request
+import urllib.error
+
+import numpy as np
+import pytest
+
+import store.memory as mem
+from service.app import serve
+
+
+@pytest.fixture(scope="module")
+def server():
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def seeded():
+    mem.reset()
+    rng = np.random.default_rng(11)
+    pts = rng.uniform(0, 100, size=(7, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    locations = [
+        {"id": i, "name": f"loc{i}", "demand": 2 if i else 0} for i in range(7)
+    ]
+    mem.seed_locations("locs1", locations)
+    mem.seed_durations("durs1", d.tolist())
+    mem.register_token("tok-alice", "alice@example.com")
+    yield
+
+
+def post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path) as resp:
+        return resp.status, resp.read().decode()
+
+
+def vrp_body(**over):
+    body = {
+        "solutionName": "s1",
+        "solutionDescription": "test",
+        "locationsKey": "locs1",
+        "durationsKey": "durs1",
+        "capacities": [6, 6, 6],
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": 1,
+        "iterationCount": 500,
+    }
+    body.update(over)
+    return body
+
+
+def tsp_body(**over):
+    body = {
+        "solutionName": "t1",
+        "solutionDescription": "test",
+        "locationsKey": "locs1",
+        "durationsKey": "durs1",
+        "customers": [1, 2, 3, 4, 5, 6],
+        "startNode": 0,
+        "startTime": 0,
+        "seed": 1,
+        "iterationCount": 500,
+    }
+    body.update(over)
+    return body
+
+
+ALL_ROUTES = [
+    "/api/vrp/ga",
+    "/api/vrp/sa",
+    "/api/vrp/aco",
+    "/api/vrp/bf",
+    "/api/tsp/ga",
+    "/api/tsp/sa",
+    "/api/tsp/aco",
+    "/api/tsp/bf",
+]
+
+
+class TestBanners:
+    def test_health(self, server):
+        status, text = get(server, "/api")
+        assert status == 200 and text == "Hello!"
+
+    def test_solver_banners(self, server):
+        for route in ALL_ROUTES:
+            status, text = get(server, route)
+            assert status == 200
+            assert text.startswith("Hi, this is the")
+
+    def test_unknown_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, "/api/nope")
+        assert e.value.code == 404
+
+
+class TestErrorEnvelope:
+    def test_missing_params_accumulate(self, server):
+        status, resp = post(server, "/api/vrp/sa", {})
+        assert status == 400
+        assert resp["success"] is False
+        missing = {e["reason"] for e in resp["errors"]}
+        assert "'solutionName' was not provided" in missing
+        assert "'capacities' was not provided" in missing
+        assert all(e["what"] == "Missing parameter" for e in resp["errors"])
+
+    def test_vrp_ga_requires_algo_params(self, server):
+        body = vrp_body()
+        del body["iterationCount"]
+        status, resp = post(server, "/api/vrp/ga", body)
+        assert status == 400
+        reasons = {e["reason"] for e in resp["errors"]}
+        assert "'multiThreaded' was not provided" in reasons
+        assert "'randomPermutationCount' was not provided" in reasons
+        assert "'iterationCount' was not provided" in reasons
+
+    def test_bad_locations_key(self, server):
+        status, resp = post(server, "/api/vrp/sa", vrp_body(locationsKey="nope"))
+        assert status == 400
+        assert resp["errors"][0]["what"] == "Database read error"
+        assert "No location set found" in resp["errors"][0]["reason"]
+
+    def test_bf_too_large_is_solver_error(self, server):
+        rng = np.random.default_rng(0)
+        n = 13
+        d = rng.uniform(1, 10, size=(n, n))
+        mem.seed_locations("big", [{"id": i} for i in range(n)])
+        mem.seed_durations("bigd", d.tolist())
+        status, resp = post(
+            server,
+            "/api/vrp/bf",
+            vrp_body(locationsKey="big", durationsKey="bigd", capacities=[99] * 3),
+        )
+        assert status == 400
+        assert resp["errors"][0]["what"] == "Solver error"
+
+    def test_matrix_shape_mismatch(self, server):
+        mem.seed_durations("badshape", [[0, 1], [1, 0]])
+        status, resp = post(server, "/api/vrp/sa", vrp_body(durationsKey="badshape"))
+        assert status == 400
+        assert resp["errors"][0]["what"] == "Data error"
+
+    def test_non_numeric_fields_get_envelope_not_crash(self, server):
+        # Conversion failures must produce the 400 envelope, never a
+        # dropped connection.
+        status, resp = post(server, "/api/vrp/sa", vrp_body(capacities=["abc", 6]))
+        assert status == 400
+        assert resp["errors"][0]["what"] == "Data error"
+        status, resp = post(server, "/api/vrp/sa", vrp_body(seed="xyz"))
+        assert status == 400
+        assert resp["errors"][0]["what"] == "Data error"
+        status, resp = post(server, "/api/tsp/sa", tsp_body(startTime="9am"))
+        assert status == 400
+        assert resp["errors"][0]["what"] == "Data error"
+
+    def test_tsp_duplicate_customers_deduped(self, server):
+        status, resp = post(server, "/api/tsp/sa", tsp_body(customers=[3, 3, 5, 5]))
+        assert status == 200
+        assert sorted(resp["message"]["vehicle"][1:-1]) == [3, 5]
+
+
+class TestVRPSolve:
+    @pytest.mark.parametrize("route", ["/api/vrp/sa", "/api/vrp/bf", "/api/vrp/aco"])
+    def test_solves_and_covers_all_customers(self, server, route):
+        status, resp = post(server, route, vrp_body())
+        assert status == 200, resp
+        assert resp["success"] is True
+        msg = resp["message"]
+        assert set(msg) == {"durationMax", "durationSum", "vehicles"}
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+        for v in msg["vehicles"]:
+            assert v["tour"][0] == 0 and v["tour"][-1] == 0
+            assert v["load"] <= v["capacity"] + 1e-6
+        assert msg["durationMax"] <= msg["durationSum"] + 1e-6
+
+    def test_ga_honors_reference_params(self, server):
+        status, resp = post(
+            server,
+            "/api/vrp/ga",
+            vrp_body(multiThreaded=True, randomPermutationCount=64, iterationCount=100),
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+
+    def test_ignored_customers_excluded(self, server):
+        status, resp = post(
+            server, "/api/vrp/sa", vrp_body(ignoredCustomers=[3], completedCustomers=[5])
+        )
+        assert status == 200
+        visited = [c for v in resp["message"]["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 4, 6]
+
+    def test_sa_matches_bf_on_seeded_instance(self, server):
+        _, sa = post(server, "/api/vrp/sa", vrp_body(iterationCount=4000))
+        _, bf = post(server, "/api/vrp/bf", vrp_body())
+        assert sa["message"]["durationSum"] <= bf["message"]["durationSum"] * 1.05
+
+
+class TestTSPSolve:
+    @pytest.mark.parametrize("route", ["/api/tsp/sa", "/api/tsp/bf", "/api/tsp/ga", "/api/tsp/aco"])
+    def test_solves(self, server, route):
+        status, resp = post(server, route, tsp_body())
+        assert status == 200, resp
+        msg = resp["message"]
+        assert set(msg) == {"duration", "vehicle"}
+        assert msg["vehicle"][0] == 0 and msg["vehicle"][-1] == 0
+        assert sorted(msg["vehicle"][1:-1]) == [1, 2, 3, 4, 5, 6]
+        assert msg["duration"] > 0
+
+    def test_subset_customers(self, server):
+        status, resp = post(server, "/api/tsp/sa", tsp_body(customers=[2, 4, 6]))
+        assert status == 200
+        assert sorted(resp["message"]["vehicle"][1:-1]) == [2, 4, 6]
+
+    def test_start_node_nonzero(self, server):
+        status, resp = post(
+            server, "/api/tsp/sa", tsp_body(startNode=3, customers=[1, 2, 4])
+        )
+        assert status == 200
+        v = resp["message"]["vehicle"]
+        assert v[0] == 3 and v[-1] == 3
+        assert sorted(v[1:-1]) == [1, 2, 4]
+
+
+class TestPersistence:
+    def test_unauthenticated_not_saved(self, server):
+        status, _ = post(server, "/api/vrp/sa", vrp_body())
+        assert status == 200
+        assert mem.saved_solutions() == []
+
+    def test_bad_token_rejected(self, server):
+        status, resp = post(server, "/api/vrp/sa", vrp_body(auth="bogus"))
+        assert status == 400
+        assert resp["errors"][0]["what"] == "Not permitted"
+
+    def test_vrp_save_filters_locations(self, server):
+        status, resp = post(
+            server, "/api/vrp/sa", vrp_body(auth="tok-alice", ignoredCustomers=[2])
+        )
+        assert status == 200, resp
+        (saved,) = mem.saved_solutions()
+        assert saved["owner"] == "alice@example.com"
+        assert saved["name"] == "s1"
+        assert {"durationMax", "durationSum", "locations", "vehicles"} <= set(saved)
+        saved_ids = [loc["id"] for loc in saved["locations"]]
+        assert 2 not in saved_ids and 0 in saved_ids
+
+    def test_tsp_save_keeps_all_locations(self, server):
+        status, _ = post(server, "/api/tsp/sa", tsp_body(auth="tok-alice"))
+        assert status == 200
+        (saved,) = mem.saved_solutions()
+        assert {"duration", "vehicle", "locations"} <= set(saved)
+        assert len(saved["locations"]) == 7
+
+
+class TestTimedPaths:
+    def test_time_windows_via_service(self, server):
+        rng = np.random.default_rng(3)
+        pts = rng.uniform(0, 50, size=(6, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        locs = [{"id": 0}] + [
+            {
+                "id": i,
+                "demand": 1,
+                "serviceTime": 2,
+                "timeWindow": [0, 500],
+            }
+            for i in range(1, 6)
+        ]
+        mem.seed_locations("twl", locs)
+        mem.seed_durations("twd", d.tolist())
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(locationsKey="twl", durationsKey="twd", capacities=[10, 10],
+                     startTimes=[0, 0]),
+        )
+        assert status == 200, resp
+        visited = [c for v in resp["message"]["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5]
+
+    def test_time_sliced_matrix_via_service(self, server):
+        rng = np.random.default_rng(4)
+        base = rng.uniform(1, 20, size=(5, 5))
+        np.fill_diagonal(base, 0)
+        # matrix[i][j] == [slice0, slice1] nesting
+        m3 = np.stack([base, 2 * base], axis=-1)
+        mem.seed_locations("tdl", [{"id": i} for i in range(5)])
+        mem.seed_durations("tdd", m3.tolist())
+        status, resp = post(
+            server,
+            "/api/tsp/sa",
+            tsp_body(locationsKey="tdl", durationsKey="tdd", customers=[1, 2, 3, 4],
+                     timeSliceDuration=30),
+        )
+        assert status == 200, resp
+        assert sorted(resp["message"]["vehicle"][1:-1]) == [1, 2, 3, 4]
+        assert resp["message"]["duration"] > 0
+
+
+class TestCORS:
+    def test_vrp_ga_preflight(self, server):
+        req = urllib.request.Request(server + "/api/vrp/ga", method="OPTIONS")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            assert resp.headers["Access-Control-Allow-Origin"] == "*"
+
+    def test_other_routes_no_preflight(self, server):
+        req = urllib.request.Request(server + "/api/vrp/sa", method="OPTIONS")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 501

@@ -1,0 +1,173 @@
+"""Instance loaders: CVRPLIB (.vrp) and Solomon VRPTW formats.
+
+The benchmark ladder in BASELINE.md names CVRPLIB instances (A-n32-k5,
+X-n200-k36) and Solomon R101; these parsers turn the standard text
+formats into core.Instance bundles. Supported CVRPLIB fields:
+EDGE_WEIGHT_TYPE EUC_2D (with the library's nint rounding convention,
+selectable) and EXPLICIT/FULL_MATRIX.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+
+from vrpms_tpu.core.instance import Instance, make_instance
+
+
+def _euc2d(coords: np.ndarray, round_nint: bool) -> np.ndarray:
+    d = np.linalg.norm(coords[:, None] - coords[None, :], axis=-1)
+    if round_nint:
+        d = np.floor(d + 0.5)  # TSPLIB nint()
+    return d
+
+
+def parse_cvrplib(text: str, round_nint: bool = True, n_vehicles: int | None = None):
+    """Parse CVRPLIB .vrp text -> (Instance, meta dict).
+
+    The vehicle count comes from (in priority order): the n_vehicles
+    argument, the `-kV` suffix of the NAME field, or
+    ceil(total demand / capacity) + 1 slack vehicle.
+    """
+    fields: dict[str, str] = {}
+    sections: dict[str, list[list[float]]] = {}
+    cur = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line == "EOF":
+            continue
+        m = re.match(r"^([A-Z_0-9]+)\s*:\s*(.*)$", line)
+        if m:
+            fields[m.group(1)] = m.group(2).strip()
+            cur = None
+            continue
+        if re.match(r"^[A-Z_]+$", line):
+            cur = line
+            sections[cur] = []
+            continue
+        if cur:
+            sections[cur].append([float(x) for x in line.split()])
+
+    dim = int(fields["DIMENSION"])
+    capacity = float(fields.get("CAPACITY", 0) or 0)
+    ew_type = fields.get("EDGE_WEIGHT_TYPE", "EUC_2D")
+
+    # Node ids in the file are 1-based with the depot conventionally first
+    # (DEPOT_SECTION confirms); we re-sort by id and index from 0.
+    if ew_type == "EUC_2D":
+        rows = sorted(sections["NODE_COORD_SECTION"], key=lambda r: r[0])
+        coords = np.asarray([[r[1], r[2]] for r in rows])
+        d = _euc2d(coords, round_nint)
+    elif ew_type == "EXPLICIT":
+        fmt = fields.get("EDGE_WEIGHT_FORMAT", "FULL_MATRIX")
+        flat = [x for row in sections["EDGE_WEIGHT_SECTION"] for x in row]
+        if fmt != "FULL_MATRIX":
+            raise ValueError(f"unsupported EDGE_WEIGHT_FORMAT {fmt}")
+        d = np.asarray(flat).reshape(dim, dim)
+        coords = None
+    else:
+        raise ValueError(f"unsupported EDGE_WEIGHT_TYPE {ew_type}")
+
+    demands = np.zeros(dim)
+    for r in sections.get("DEMAND_SECTION", []):
+        demands[int(r[0]) - 1] = r[1]
+
+    depot = 0
+    dep_rows = [int(r[0]) for r in sections.get("DEPOT_SECTION", []) if r[0] > 0]
+    if dep_rows:
+        depot = dep_rows[0] - 1
+    if depot != 0:
+        order = [depot] + [i for i in range(dim) if i != depot]
+        d = d[np.ix_(order, order)]
+        demands = demands[order]
+        if coords is not None:
+            coords = coords[order]
+
+    name = fields.get("NAME", "")
+    if n_vehicles is None:
+        m = re.search(r"-k(\d+)", name)
+        if m:
+            n_vehicles = int(m.group(1))
+        elif capacity > 0:
+            n_vehicles = int(math.ceil(demands.sum() / capacity)) + 1
+        else:
+            n_vehicles = 1
+
+    cap = capacity if capacity > 0 else 1e9
+    inst = make_instance(
+        d, demands=demands, capacities=[cap] * n_vehicles
+    )
+    meta = {"name": name, "dimension": dim, "capacity": capacity, "coords": coords}
+    return inst, meta
+
+
+def load_cvrplib(path: str, **kw):
+    with open(path) as f:
+        return parse_cvrplib(f.read(), **kw)
+
+
+def parse_solomon(
+    text: str,
+    n_vehicles: int | None = None,
+    truncate_1dp: bool = True,
+):
+    """Parse Solomon VRPTW text -> (Instance, meta dict).
+
+    Distances are euclidean; the Solomon literature convention truncates
+    them to one decimal (selectable). Depot time window becomes
+    ready/due of node 0; vehicle NUMBER/CAPACITY come from the VEHICLE
+    block unless overridden.
+    """
+    lines = [ln.rstrip() for ln in text.splitlines()]
+    name = next((ln.strip() for ln in lines if ln.strip()), "solomon")
+    num = cap = None
+    rows = []
+    mode = None
+    for ln in lines:
+        s = ln.strip()
+        if not s:
+            continue
+        up = s.upper()
+        if up.startswith("VEHICLE"):
+            mode = "vehicle"
+            continue
+        if up.startswith("CUSTOMER"):
+            mode = "customer"
+            continue
+        if up.startswith("NUMBER") or up.startswith("CUST"):
+            continue
+        parts = s.split()
+        if mode == "vehicle" and len(parts) == 2:
+            num, cap = int(parts[0]), float(parts[1])
+        elif mode == "customer" and len(parts) >= 7:
+            rows.append([float(x) for x in parts[:7]])
+
+    rows.sort(key=lambda r: r[0])
+    coords = np.asarray([[r[1], r[2]] for r in rows])
+    demands = np.asarray([r[3] for r in rows])
+    ready = np.asarray([r[4] for r in rows])
+    due = np.asarray([r[5] for r in rows])
+    service = np.asarray([r[6] for r in rows])
+
+    d = np.linalg.norm(coords[:, None] - coords[None, :], axis=-1)
+    if truncate_1dp:
+        d = np.floor(d * 10.0) / 10.0
+
+    v = n_vehicles or num or 1
+    inst = make_instance(
+        d,
+        demands=demands,
+        capacities=[cap or 1e9] * v,
+        ready=ready,
+        due=due,
+        service=service,
+    )
+    meta = {"name": name, "n_vehicles": v, "capacity": cap, "coords": coords}
+    return inst, meta
+
+
+def load_solomon(path: str, **kw):
+    with open(path) as f:
+        return parse_solomon(f.read(), **kw)

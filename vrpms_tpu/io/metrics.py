@@ -1,0 +1,10 @@
+"""Benchmark metrics (SURVEY.md §4 item 6): gap-to-best-known-solution."""
+
+from __future__ import annotations
+
+
+def gap_percent(cost: float, best_known: float) -> float:
+    """Percent gap above the best known solution (0 == matched BKS)."""
+    if best_known <= 0:
+        raise ValueError("best_known must be positive")
+    return 100.0 * (float(cost) - best_known) / best_known

@@ -1,0 +1,70 @@
+"""Deterministic synthetic instance generators.
+
+The container has no network egress, so CVRPLIB files can't be fetched;
+these generators produce instances with the same statistical shape as
+the benchmark families (uniform customer placement like the X set,
+Solomon-style time windows) from a seed, for benches and tests. Sizes/
+naming mirror the BASELINE.md ladder (e.g. synth_cvrp(200, 36) stands in
+for X-n200-k36).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vrpms_tpu.core.instance import Instance, make_instance
+
+
+def _euclid(coords: np.ndarray) -> np.ndarray:
+    return np.linalg.norm(coords[:, None] - coords[None, :], axis=-1)
+
+
+def synth_tsp(n_nodes: int, seed: int = 0) -> Instance:
+    """Uniform random points on [0, 1000]^2; node 0 is the start."""
+    rng = np.random.default_rng(seed)
+    coords = rng.uniform(0, 1000, size=(n_nodes, 2))
+    return make_instance(_euclid(coords), n_vehicles=1)
+
+
+def synth_cvrp(n_nodes: int, n_vehicles: int, seed: int = 0) -> Instance:
+    """X-style CVRP: uniform points, unit-ish demands, capacity chosen so
+    the expected route count matches n_vehicles with ~8% slack."""
+    rng = np.random.default_rng(seed)
+    coords = rng.uniform(0, 1000, size=(n_nodes, 2))
+    demands = np.concatenate([[0], rng.integers(1, 10, size=n_nodes - 1)])
+    capacity = float(np.ceil(demands.sum() * 1.08 / n_vehicles))
+    return make_instance(
+        _euclid(coords),
+        demands=demands,
+        capacities=[capacity] * n_vehicles,
+    )
+
+
+def synth_vrptw(
+    n_nodes: int,
+    n_vehicles: int,
+    seed: int = 0,
+    horizon: float = 1000.0,
+    window: float = 120.0,
+) -> Instance:
+    """Solomon-R-style VRPTW: uniform points, random time windows of the
+    given width inside the horizon, constant service time, depot open the
+    whole horizon."""
+    rng = np.random.default_rng(seed)
+    coords = rng.uniform(0, 100, size=(n_nodes, 2))
+    d = _euclid(coords)
+    demands = np.concatenate([[0], rng.integers(1, 10, size=n_nodes - 1)])
+    capacity = float(np.ceil(demands.sum() * 1.2 / n_vehicles))
+    centers = rng.uniform(window, horizon - window, size=n_nodes)
+    ready = np.maximum(centers - window / 2, 0.0)
+    due = np.minimum(centers + window / 2, horizon)
+    ready[0], due[0] = 0.0, horizon
+    service = np.full(n_nodes, 10.0)
+    return make_instance(
+        d,
+        demands=demands,
+        capacities=[capacity] * n_vehicles,
+        ready=ready,
+        due=due,
+        service=service,
+    )
