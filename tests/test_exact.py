@@ -1,0 +1,54 @@
+"""Held-Karp exact TSP vs the brute-force oracle."""
+
+import numpy as np
+import pytest
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core.encoding import is_valid_giant
+from vrpms_tpu.solvers import solve_tsp_bf, solve_tsp_exact
+from vrpms_tpu.solvers.exact import MAX_EXACT_CUSTOMERS
+from tests.test_core_cost import random_instance
+
+
+class TestHeldKarp:
+    @pytest.mark.parametrize("n", [3, 5, 8])
+    def test_matches_bf(self, rng, n):
+        d = rng.uniform(1, 50, size=(n + 1, n + 1))
+        np.fill_diagonal(d, 0)
+        inst = make_instance(d, n_vehicles=1)
+        want = float(solve_tsp_bf(inst).cost)
+        res = solve_tsp_exact(inst)
+        assert np.isclose(float(res.cost), want, rtol=1e-5)
+        assert is_valid_giant(res.giant, n, 1)
+
+    def test_asymmetric_matches_bf(self, rng):
+        n = 6
+        d = rng.uniform(1, 50, size=(n + 1, n + 1))  # asymmetric on purpose
+        np.fill_diagonal(d, 0)
+        inst = make_instance(d, n_vehicles=1)
+        assert np.isclose(
+            float(solve_tsp_exact(inst).cost), float(solve_tsp_bf(inst).cost), rtol=1e-5
+        )
+
+    def test_beyond_bf_bound(self, rng):
+        # 12 customers: infeasible for itertools-scale checks, fine for HK.
+        n = 12
+        d = rng.uniform(1, 50, size=(n + 1, n + 1))
+        d = (d + d.T) / 2
+        np.fill_diagonal(d, 0)
+        inst = make_instance(d, n_vehicles=1)
+        res = solve_tsp_exact(inst)
+        assert is_valid_giant(res.giant, n, 1)
+        # sanity: exact must be no worse than nearest-neighbor + 2-opt
+        from vrpms_tpu.solvers import solve_nn_2opt
+
+        assert float(res.cost) <= float(solve_nn_2opt(inst).cost) + 1e-3
+
+    def test_rejects_large_and_timed(self, rng):
+        # random_instance's n is the node count; customers = n - 1
+        inst = random_instance(rng, n=MAX_EXACT_CUSTOMERS + 2, v=1)
+        with pytest.raises(ValueError, match="exceeds"):
+            solve_tsp_exact(inst)
+        timed = random_instance(rng, n=5, v=1, tw=True)
+        with pytest.raises(ValueError, match="time"):
+            solve_tsp_exact(timed)

@@ -5,6 +5,7 @@ from vrpms_tpu.solvers.local_search import (
     local_search,
     solve_nn_2opt,
 )
+from vrpms_tpu.solvers.exact import solve_tsp_exact
 from vrpms_tpu.solvers.sa import SAParams, solve_sa
 from vrpms_tpu.solvers.ga import GAParams, solve_ga
 from vrpms_tpu.solvers.aco import ACOParams, solve_aco

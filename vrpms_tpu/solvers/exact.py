@@ -1,0 +1,102 @@
+"""Held-Karp exact TSP: dynamic programming over customer subsets.
+
+The reference pins `gurobipy==10.0.3` in requirements.txt:2 without ever
+importing it — the one signal of an intended exact/MILP solver path beyond
+brute force. This module supplies that path TPU-natively: the Held-Karp
+O(2^n n^2) subset DP runs as a single `lax.scan` over subset masks (each
+mask only depends on strictly smaller masks, so ascending order is a valid
+schedule), with the per-mask transition a dense (n, n) min-plus product on
+the VPU. That lifts the exact-TSP bound from brute force's 10 customers
+(10! ~ 3.6M orders) to 16 (2^16 x 16 DP states).
+
+Asymmetric duration matrices are handled naturally (the DP walks directed
+legs). Time windows / time-dependence are not — callers with timed
+instances use brute force (solvers.bf) below its bound.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
+from vrpms_tpu.core.encoding import giant_from_routes
+from vrpms_tpu.core.instance import BIG, Instance
+from vrpms_tpu.solvers.common import SolveResult
+
+MAX_EXACT_CUSTOMERS = 16
+
+
+def _check(inst: Instance) -> int:
+    n = inst.n_customers
+    if n > MAX_EXACT_CUSTOMERS:
+        raise ValueError(
+            f"Held-Karp is exact subset DP; {n} customers exceeds the "
+            f"{MAX_EXACT_CUSTOMERS}-customer bound (2^{n} x {n} states)"
+        )
+    if inst.has_tw or inst.time_dependent:
+        raise ValueError(
+            "Held-Karp does not support time windows or time-dependent "
+            "durations; use brute force below its bound"
+        )
+    return n
+
+
+def _held_karp_table(d: jax.Array, n: int) -> jax.Array:
+    """dp[mask, j] = min cost of depot -> (visit exactly the customers in
+    mask) -> customer j, for j in mask. Returns the full [2^n, n] table."""
+    bit = jnp.int32(1) << jnp.arange(n, dtype=jnp.int32)  # [n]
+    d_c = d[1:, 1:]  # customer->customer legs, [n, n]
+    d_0 = d[0, 1:]  # depot->customer legs, [n]
+
+    def step(dp, mask):
+        in_mask = (mask & bit) != 0  # [n] j in mask?
+        single = (mask & (mask - 1)) == 0  # popcount == 1
+        prev_mask = mask & ~bit  # [n] mask \ {j}
+        prev_rows = dp[prev_mask]  # [n, n]: dp[mask\{j}, k]
+        # k must be in mask\{j}: invalid entries are BIG already, but the
+        # row for prev_mask == 0 is the (unused) all-BIG row 0.
+        cand = prev_rows + d_c.T  # [n(j), n(k)]: dp[...,k] + d[k, j]
+        best = jnp.min(cand, axis=1)  # [n] over k
+        val = jnp.where(single, d_0, best)
+        val = jnp.where(in_mask, val, BIG)
+        dp = dp.at[mask].set(val)
+        return dp, None
+
+    dp0 = jnp.full((1 << n, n), BIG, dtype=jnp.float32)
+    masks = jnp.arange(1, 1 << n, dtype=jnp.int32)
+    dp, _ = jax.lax.scan(step, dp0, masks)
+    return dp
+
+
+_hk_table_jit = jax.jit(_held_karp_table, static_argnums=1)
+
+
+def solve_tsp_exact(inst: Instance, weights: CostWeights | None = None) -> SolveResult:
+    """Exact TSP via Held-Karp; fills the reference's BF/exact hole for
+    11..16 customers where enumeration (solvers.bf) is infeasible."""
+    n = _check(inst)
+    w = weights or CostWeights.make()
+    d = inst.durations[0]
+
+    dp = _hk_table_jit(d, n)
+
+    # Host-side backtrack (tiny: n steps over a 4 MB table at n == 16).
+    dp_h = np.asarray(dp)
+    d_h = np.asarray(d)
+    full = (1 << n) - 1
+    closing = dp_h[full] + d_h[1:, 0]
+    j = int(np.argmin(closing))
+    order = [j]
+    mask = full
+    for _ in range(n - 1):
+        pm = mask & ~(1 << j)
+        k = int(np.argmin(dp_h[pm] + d_h[1:, 1 + j]))
+        order.append(k)
+        mask, j = pm, k
+    order.reverse()  # depot -> order[0] -> ... -> order[-1] -> depot
+
+    giant = giant_from_routes([[c + 1 for c in order]], n, inst.n_vehicles)
+    bd = evaluate_giant(giant, inst)
+    return SolveResult(giant, total_cost(bd, w), bd, jnp.int32((1 << n) * n))
