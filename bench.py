@@ -38,19 +38,22 @@ def _pick_device():
 
 def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
     """routes/sec of the compiled SA sweep on `device` (compile excluded)."""
-    from vrpms_tpu.core.cost import CostWeights, objective_batch
+    from vrpms_tpu.core.cost import CostWeights, objective_batch_mode
     from vrpms_tpu.core.encoding import random_giant_batch
     from vrpms_tpu.solvers.sa import _auto_temps, sa_chain_step, SAParams
 
     w = CostWeights.make()
     t0, t1 = _auto_temps(inst, SAParams())
     inst = jax.device_put(inst, device)
+    # MXU one-hot path on any accelerator, flat-gather on CPU
+    # (core.cost.resolve_eval_mode rationale; 'axon' aliases tpu here)
+    mode = "gather" if device.platform == "cpu" else "onehot"
 
     def chunk(giants, costs, key, start):
         def body(state, i):
             giants, costs = state
             return sa_chain_step(
-                giants, costs, key, start + i, t0, t1, n_iters, inst, w
+                giants, costs, key, start + i, t0, t1, n_iters, inst, w, mode
             ), None
 
         (giants, costs), _ = jax.lax.scan(
@@ -63,7 +66,7 @@ def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
     giants = jax.device_put(
         random_giant_batch(key, n_chains, inst.n_customers, inst.n_vehicles), device
     )
-    costs = objective_batch(giants, inst, w)
+    costs = objective_batch_mode(giants, inst, w, mode)
 
     # Warmup/compile
     g, c = run(giants, costs, key, jnp.int32(0))

@@ -1,0 +1,123 @@
+"""One-hot (MXU) hot-path equivalence vs the gather formulation.
+
+The one-hot path exists because TPU lowers elementwise data-dependent
+gathers to a scalar loop (see core/cost.py rationale); these tests force
+mode='onehot' on CPU to pin its semantics: move application is bit-exact,
+the objective matches the gather path to bf16 rounding of the durations
+matrix, and the dtype auto-widens to f32 past the 256-integer bf16 bound.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core.cost import (
+    CostWeights,
+    objective_batch,
+    objective_hot_batch,
+    onehot_dtype,
+    resolve_eval_mode,
+)
+from vrpms_tpu.core.encoding import is_valid_giant, random_giant_batch
+from vrpms_tpu.moves import apply_src_map, random_move_batch, random_src_map
+from vrpms_tpu.solvers import SAParams, solve_sa
+from tests.test_core_cost import random_instance
+
+
+@pytest.fixture
+def batch(rng):
+    inst = random_instance(rng, n=20, v=4)
+    giants = random_giant_batch(jax.random.key(0), 32, 19, 4)
+    return inst, giants
+
+
+class TestApplySrcMap:
+    def test_onehot_matches_gather_exactly(self, batch):
+        _, giants = batch
+        src = random_src_map(jax.random.key(1), giants.shape[0], giants.shape[1])
+        got = apply_src_map(giants, src, mode="onehot")
+        want = apply_src_map(giants, src, mode="gather")
+        assert got.dtype == giants.dtype
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    def test_gather_matches_per_row_indexing(self, batch):
+        _, giants = batch
+        src = random_src_map(jax.random.key(2), giants.shape[0], giants.shape[1])
+        want = np.take_along_axis(np.asarray(giants), np.asarray(src), axis=1)
+        got = apply_src_map(giants, src, mode="gather")
+        assert np.array_equal(np.asarray(got), want)
+
+    def test_batched_moves_stay_valid(self, batch):
+        _, giants = batch
+        for mode in ("gather", "onehot"):
+            out = random_move_batch(jax.random.key(3), giants, mode=mode)
+            for row in np.asarray(out):
+                assert is_valid_giant(row, 19, 4)
+
+
+class TestObjectiveHot:
+    def test_matches_gather_to_bf16_rounding(self, batch):
+        inst, giants = batch
+        w = CostWeights.make()
+        ref = np.asarray(objective_batch(giants, inst, w))
+        got = np.asarray(objective_hot_batch(giants, inst, w))
+        np.testing.assert_allclose(got, ref, rtol=2e-2)
+
+    def test_capacity_excess_term_included(self, rng):
+        # one overloaded vehicle: penalty must dominate the difference
+        d = np.ones((4, 4)) - np.eye(4)
+        inst = make_instance(
+            d, demands=[0, 5, 5, 5], capacities=[6.0, 100.0]
+        )
+        w = CostWeights.make()
+        # all three customers on vehicle 0 (cap 6, load 15 -> excess 9)
+        g = jnp.asarray([[0, 1, 2, 3, 0, 0]], dtype=jnp.int32)
+        ref = float(objective_batch(g, inst, w)[0])
+        got = float(objective_hot_batch(g, inst, w)[0])
+        assert abs(got - ref) / ref < 1e-3
+        assert got > 9 * float(w.cap)  # the exact penalty survived bf16
+
+    def test_timed_instances_fall_back(self, rng):
+        inst = random_instance(rng, n=8, v=2, tw=True)
+        giants = random_giant_batch(jax.random.key(4), 8, 7, 2)
+        w = CostWeights.make()
+        ref = np.asarray(objective_batch(giants, inst, w))
+        got = np.asarray(objective_hot_batch(giants, inst, w))
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_wide_instance_uses_f32(self, rng):
+        assert onehot_dtype(256) == jnp.bfloat16
+        assert onehot_dtype(300) == jnp.float32
+        n = 300  # L = 300 + v > 256 -> f32 one-hots, near-exact objective
+        d = rng.uniform(1, 50, size=(n, n))
+        inst = make_instance(
+            d, demands=rng.uniform(1, 5, n), capacities=[400.0, 400.0]
+        )
+        giants = random_giant_batch(jax.random.key(5), 4, n - 1, 2)
+        w = CostWeights.make()
+        ref = np.asarray(objective_batch(giants, inst, w))
+        got = np.asarray(objective_hot_batch(giants, inst, w))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+class TestSAOnehotMode:
+    def test_solve_sa_onehot_beats_random_and_is_valid(self, rng):
+        inst = random_instance(rng, n=15, v=3)
+        res = solve_sa(
+            inst, key=0, params=SAParams(n_chains=32, n_iters=800), mode="onehot"
+        )
+        assert is_valid_giant(res.giant, 14, 3)
+        w = CostWeights.make()
+        rand_costs = objective_batch(
+            random_giant_batch(jax.random.key(9), 32, 14, 3), inst, w
+        )
+        assert float(res.cost) < float(jnp.min(rand_costs))
+
+    def test_resolve_mode(self):
+        assert resolve_eval_mode("gather") == "gather"
+        assert resolve_eval_mode("onehot") == "onehot"
+        assert resolve_eval_mode("auto") in ("gather", "onehot")
+        with pytest.raises(ValueError):
+            resolve_eval_mode("bogus")

@@ -188,6 +188,96 @@ def _td_eval(giant, inst: Instance) -> CostBreakdown:
     )
 
 
+# --- One-hot (MXU) evaluation path -----------------------------------------
+#
+# TPU profiling (see bench.py history) shows XLA lowers elementwise gathers
+# with ~1M data-dependent indices — `d[prev, next]`, `demands[giant]`, and
+# batched `giant[src]` — to a scalar loop at ~140M elem/s, making the
+# gather-based sweep ~25 ms at B=4096 while every other op is microseconds.
+# The one-hot path reformulates those gathers as one-hot contractions that
+# run on the MXU: selecting via `onehot(idx) @ table` is exact (each output
+# sums exactly one table element), so the only approximation is that the
+# durations matrix itself is rounded to bfloat16 (~1e-3 relative). Penalty
+# terms stay exact: route-membership counts are integers <= L (exact in
+# bf16 when L <= 256; larger instances auto-switch to f32 one-hots).
+
+
+def onehot_dtype(bound: int):
+    """Widest-exact one-hot dtype: integers <= 256 are exact in bf16."""
+    return jnp.bfloat16 if bound <= 256 else jnp.float32
+
+
+def _onehot(x: jax.Array, n: int, dtype) -> jax.Array:
+    return (x[..., None] == jnp.arange(n, dtype=x.dtype)).astype(dtype)
+
+
+def resolve_eval_mode(mode: str = "auto") -> str:
+    """'onehot' on TPU backends, 'gather' elsewhere; explicit modes pass
+    through. The split exists because the two hot-path formulations are
+    each catastrophic on the other platform (scalar-loop gathers on TPU;
+    dense 80-GFLOP one-hot contractions on CPU)."""
+    if mode == "auto":
+        # the TPU plugin in some environments registers under an alias
+        # (e.g. 'axon'); only plain CPU wants the gather formulation
+        return "gather" if jax.default_backend() == "cpu" else "onehot"
+    if mode not in ("onehot", "gather"):
+        raise ValueError(f"eval mode must be auto/onehot/gather, got {mode!r}")
+    return mode
+
+
+def objective_hot_batch(
+    giants: jax.Array, inst: Instance, w: CostWeights
+) -> jax.Array:
+    """Gather-free batched objective for the untimed fast path.
+
+    distance: bf16-rounded durations (exact one-hot selection of a
+    rounded table); capacity excess: exact. Timed instances fall back to
+    the gather formulation — their sequential propagation dominates and
+    the one-hot reformulation doesn't apply as directly.
+    """
+    if inst.has_tw or inst.time_dependent:
+        return objective_batch(giants, inst, w)
+    b, length = giants.shape
+    n = inst.n_nodes
+    v = inst.n_vehicles
+    dt = onehot_dtype(max(length, n))
+    prev_oh = _onehot(giants[:, :-1], n, dt)  # (B, K, N), K = L-1
+    next_oh = _onehot(giants[:, 1:], n, dt)
+
+    d = inst.durations[0].astype(dt)
+    # X[b,k,m] = durations[prev[b,k], m] — exact row selection of the
+    # dt-rounded matrix; dist contracts it against the next-node one-hot.
+    x = jnp.einsum("bkn,nm->bkm", prev_oh, d, preferred_element_type=dt)
+    dist = jnp.einsum(
+        "bkm,bkm->b", x, next_oh, preferred_element_type=jnp.float32
+    )
+
+    # Loads without scatter: counts[b,v,n] = how many legs of routes
+    # 0..v depart node n (an integer <= K, exact in dt); contracting with
+    # the f32 demand vector gives cumulative-demand-through-route-v.
+    rid = jnp.cumsum((giants == 0).astype(jnp.int32), axis=1) - 1
+    le = (rid[:, :-1, None] <= jnp.arange(v)[None, None, :]).astype(dt)
+    counts = jnp.einsum("bkv,bkn->bvn", le, prev_oh, preferred_element_type=dt)
+    cum = jnp.einsum(
+        "bvn,n->bv",
+        counts.astype(jnp.float32),
+        inst.demands,
+        preferred_element_type=jnp.float32,
+    )
+    load = jnp.diff(cum, axis=1, prepend=jnp.zeros((b, 1), cum.dtype))
+    cap_excess = jnp.maximum(load - inst.capacities, 0.0).sum(-1)
+    return dist + w.cap * cap_excess
+
+
+def objective_batch_mode(
+    giants: jax.Array, inst: Instance, w: CostWeights, mode: str = "auto"
+) -> jax.Array:
+    """Batched objective in the given eval mode ('auto'/'onehot'/'gather')."""
+    if resolve_eval_mode(mode) == "onehot":
+        return objective_hot_batch(giants, inst, w)
+    return objective_batch(giants, inst, w)
+
+
 def evaluate_giant(giant: jax.Array, inst: Instance) -> CostBreakdown:
     """Evaluate one giant tour; dispatches on static instance metadata."""
     if inst.time_dependent:

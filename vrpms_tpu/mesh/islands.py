@@ -36,7 +36,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from vrpms_tpu.core.cost import CostWeights, evaluate_giant, objective_batch, total_cost
+from vrpms_tpu.core.cost import (
+    CostWeights,
+    evaluate_giant,
+    objective_batch_mode,
+    resolve_eval_mode,
+    total_cost,
+)
 from vrpms_tpu.core.encoding import random_giant_batch
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
@@ -99,9 +105,11 @@ def solve_sa_islands(
     params: SAParams = SAParams(),
     island_params: IslandParams = IslandParams(),
     weights: CostWeights | None = None,
+    mode: str = "auto",
 ) -> SolveResult:
     """SA with per-device chain batches + ring elite migration."""
     w = weights or CostWeights.make()
+    mode = resolve_eval_mode(mode)
     if isinstance(key, int):
         key = jax.random.key(key)
     mesh = mesh or make_mesh()
@@ -132,12 +140,12 @@ def solve_sa_islands(
     def run(giants):
         isl = jax.lax.axis_index("islands")
         k_isl = jax.random.fold_in(k_run, isl)
-        costs = objective_batch(giants, inst, w)
+        costs = objective_batch_mode(giants, inst, w, mode)
 
         def inner(st, it):
             giants, costs, best_g, best_c = st
             giants, costs = sa_chain_step(
-                giants, costs, k_isl, it, t0, t1, n_iters, inst, w
+                giants, costs, k_isl, it, t0, t1, n_iters, inst, w, mode
             )
             better = costs < best_c
             best_g = jnp.where(better[:, None], giants, best_g)

@@ -3,5 +3,8 @@ from vrpms_tpu.moves.moves import (
     rotate_segment,
     swap_positions,
     random_move,
+    random_src_map,
+    apply_src_map,
+    random_move_batch,
     N_MOVE_TYPES,
 )

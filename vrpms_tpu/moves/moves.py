@@ -49,6 +49,63 @@ def swap_positions(giant: jax.Array, i: jax.Array, j: jax.Array) -> jax.Array:
     return giant[src]
 
 
+def random_src_map(key: jax.Array, batch: int, length: int) -> jax.Array:
+    """Batched proposal: one (B, L) source-index map encoding a random
+    reverse/rotate/swap per chain, built entirely from `jnp.where`
+    arithmetic (no integer modulo — TPUs have no hardware integer divide,
+    so `% span` with a runtime divisor expands into a long scalar
+    sequence; the rotate wrap is a compare-subtract instead)."""
+    k_pos, k_type, k_rot = jax.random.split(key, 3)
+    ij = jax.random.randint(k_pos, (batch, 2), 1, length - 1)
+    i = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
+    j = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
+    m = jax.random.randint(k_rot, (batch, 1), 1, 4)
+    mt = jax.random.randint(k_type, (batch, 1), 0, N_MOVE_TYPES)
+    k = jnp.arange(length, dtype=jnp.int32)[None, :]
+    inside = (k >= i) & (k <= j)
+    span = j - i + 1
+    mm = jnp.minimum(m, span - 1)  # left-rotate by mm < span
+    shifted = k + mm
+    wrapped = jnp.where(shifted > j, shifted - span, shifted)
+    src_rev = jnp.where(inside, i + j - k, k)
+    src_rot = jnp.where(inside, wrapped, k)
+    src_swp = jnp.where(k == i, j, jnp.where(k == j, i, k))
+    return jnp.where(mt == 0, src_rev, jnp.where(mt == 1, src_rot, src_swp))
+
+
+def apply_src_map(giants: jax.Array, src: jax.Array, mode: str = "gather") -> jax.Array:
+    """out[b, k] = giants[b, src[b, k]] for a (B, L) batch.
+
+    mode 'gather': one flat gather — fast on CPU, scalar-loop slow on TPU.
+    mode 'onehot': exact one-hot matmul on the MXU (node ids and integer
+    one-hot sums stay exact in bf16 up to 256, f32 above).
+    """
+    b, length = giants.shape
+    if mode == "onehot":
+        from vrpms_tpu.core.cost import _onehot, onehot_dtype
+
+        # node ids < L and src < L, so L bounds every integer involved
+        dt = onehot_dtype(length)
+        oh = _onehot(src, length, dt)
+        out = jnp.einsum(
+            "bkl,bl->bk",
+            oh,
+            giants.astype(dt),
+            preferred_element_type=jnp.float32,
+        )
+        return jnp.round(out).astype(giants.dtype)
+    idx = jnp.arange(b, dtype=jnp.int32)[:, None] * length + src
+    return giants.reshape(-1)[idx]
+
+
+def random_move_batch(
+    key: jax.Array, giants: jax.Array, mode: str = "gather"
+) -> jax.Array:
+    """Sample and apply one random move per chain; the SA batch proposal."""
+    src = random_src_map(key, giants.shape[0], giants.shape[1])
+    return apply_src_map(giants, src, mode=mode)
+
+
 def random_move(key: jax.Array, giant: jax.Array) -> jax.Array:
     """Sample and apply one uniformly-chosen move; used as the SA proposal.
 
