@@ -76,11 +76,13 @@ class SolveHandler(BaseHTTPRequestHandler):
         # Run algorithm (the reference's TODO hole, realised)
         if self.problem == "vrp":
             result = run_vrp(
-                self.algorithm, params, opts, algo_params, locations, durations, errors
+                self.algorithm, params, opts, algo_params, locations, durations,
+                errors, database=database,
             )
         else:
             result = run_tsp(
-                self.algorithm, params, opts, algo_params, locations, durations, errors
+                self.algorithm, params, opts, algo_params, locations, durations,
+                errors, database=database,
             )
         if result is None or len(errors) > 0:
             fail(self, errors)

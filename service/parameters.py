@@ -78,6 +78,10 @@ def parse_solver_options(content: dict, errors):
                         require it; optional everywhere else)
     populationSize:     SA chains / GA population / ACO ants
     timeSliceDuration:  minutes per time-of-day slice of a 3-D matrix
+    warmStart:          seed the search from the solution previously
+                        checkpointed under this solutionName
+    includeStats:       attach solver statistics to the result message
+    profile:            capture a jax.profiler trace of the solve
     """
     return {
         "backend": get_parameter("backend", content, errors, optional=True),
@@ -87,4 +91,7 @@ def parse_solver_options(content: dict, errors):
         "time_slice_duration": get_parameter(
             "timeSliceDuration", content, errors, optional=True
         ),
+        "warm_start": get_parameter("warmStart", content, errors, optional=True),
+        "include_stats": get_parameter("includeStats", content, errors, optional=True),
+        "profile": get_parameter("profile", content, errors, optional=True),
     }

@@ -28,13 +28,16 @@ from __future__ import annotations
 import contextlib
 import functools
 import sys
+import time
 import traceback
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from vrpms_tpu.core import make_instance
-from vrpms_tpu.core.encoding import routes_from_giant
+from vrpms_tpu.core.encoding import random_giant_batch, routes_from_giant
+from vrpms_tpu.core.split import greedy_split_giant
 from vrpms_tpu.solvers import (
     ACOParams,
     GAParams,
@@ -45,6 +48,7 @@ from vrpms_tpu.solvers import (
     solve_tsp_bf,
     solve_vrp_bf,
 )
+from vrpms_tpu.solvers.ga import _random_perms
 
 DEFAULT_SLICE_MINUTES = 60.0
 
@@ -70,9 +74,9 @@ def _enveloped(fn):
     400 JSON body (reference api/helpers.py:16-21)."""
 
     @functools.wraps(fn)
-    def wrapper(algorithm, params, opts, ga_params, locations, matrix, errors):
+    def wrapper(algorithm, params, opts, ga_params, locations, matrix, errors, **kw):
         try:
-            return fn(algorithm, params, opts, ga_params, locations, matrix, errors)
+            return fn(algorithm, params, opts, ga_params, locations, matrix, errors, **kw)
         except Exception as e:
             traceback.print_exc(file=sys.stderr)
             errors += [
@@ -119,7 +123,34 @@ def _build_arrays(locations, matrix, active_pos, errors, slice_minutes):
     }
 
 
-def _solve_instance(inst, algorithm, opts, ga_params, errors, problem):
+def _warm_perm(state, active_ids: list, problem: str):
+    """Previous checkpoint -> customer permutation in active indexing.
+
+    The checkpoint stores routes of ORIGINAL location ids; re-solves may
+    exclude some (the reference's ignored/completed dynamic inputs) or
+    introduce new customers. Order is preserved for surviving ids and new
+    customers are appended, so the seed stays a valid permutation of the
+    CURRENT active set — the coarse resume-from-world-state semantics of
+    SURVEY.md §5 made warm.
+    """
+    if not state or state.get("problem") != problem:
+        return None
+    index_of = {cid: i for i, cid in enumerate(active_ids)}
+    seen = set()
+    order = []
+    for route in state.get("routes", []):
+        for cid in route:
+            pos = index_of.get(cid)
+            if pos is not None and pos > 0 and pos not in seen:
+                order.append(pos)
+                seen.add(pos)
+    order += [i for i in range(1, len(active_ids)) if i not in seen]
+    if not order:
+        return None
+    return jnp.asarray(order, dtype=jnp.int32)
+
+
+def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None):
     """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
     seed = int(opts.get("seed") or 0)
     iters = opts.get("iteration_count")
@@ -134,7 +165,16 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem):
                 n_chains=int(pop or 128),
                 n_iters=int(iters or 5000),
             )
-            return solve_sa(inst, key=seed, params=p)
+            init = None
+            if warm is not None:
+                init = random_giant_batch(
+                    jax.random.key(seed + 1),
+                    p.n_chains,
+                    inst.n_customers,
+                    inst.n_vehicles,
+                )
+                init = init.at[0].set(greedy_split_giant(warm, inst))
+            return solve_sa(inst, key=seed, params=p, init_giants=init)
         if algorithm == "aco":
             p = ACOParams(n_ants=int(pop or 64), n_iters=int(iters or 200))
             return solve_aco(inst, key=seed, params=p)
@@ -146,15 +186,58 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem):
                 generations=max(generations, 1),
                 elites=max(2, min(16, population // 8)),
             )
-            return solve_ga(inst, key=seed, params=p)
+            init = None
+            if warm is not None:
+                init = _random_perms(
+                    jax.random.key(seed + 1), p.population, inst.n_customers
+                )
+                init = init.at[0].set(warm)
+            return solve_ga(inst, key=seed, params=p, init_perms=init)
         raise ValueError(f"unknown algorithm {algorithm!r}")
     except ValueError as e:
         errors += [{"what": "Solver error", "reason": str(e)}]
         return None
 
 
+def _profiled(opts):
+    """jax.profiler trace context when the request asks for one."""
+    if opts.get("profile"):
+        trace_dir = (
+            opts["profile"]
+            if isinstance(opts["profile"], str)
+            else "/tmp/vrpms_profile"
+        )
+        try:
+            return jax.profiler.trace(trace_dir), trace_dir
+        except Exception:
+            pass
+    return contextlib.nullcontext(), None
+
+
+def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
+    """Timed + optionally profiled dispatch; returns (res, stats|None)."""
+    ctx, trace_dir = _profiled(opts)
+    t0 = time.perf_counter()
+    with ctx:
+        res = _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm)
+        if res is not None:
+            jax.block_until_ready(res.cost)
+    if res is None or not opts.get("include_stats"):
+        return res, None
+    stats = {
+        "algorithm": algorithm,
+        "evals": int(res.evals),
+        "wallMs": round((time.perf_counter() - t0) * 1e3, 1),
+        "backend": jax.default_backend(),
+        "warmStart": warm is not None,
+    }
+    if trace_dir:
+        stats["profileDir"] = trace_dir
+    return res, stats
+
+
 @_enveloped
-def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors):
+def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, database=None):
     """Solve a VRP request; returns the contract result dict or None."""
     capacities = params["capacities"]
     start_times = params["start_times"]
@@ -200,8 +283,14 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors):
         slice_minutes=slice_minutes,
         slice_axis=arrays["slice_axis"],
     )
+    orig_ids_for_warm = [locations[i]["id"] for i in active_pos]
+    warm = None
+    if opts.get("warm_start") and database is not None:
+        warm = _warm_perm(
+            database.get_warmstart(params["name"]), orig_ids_for_warm, "vrp"
+        )
     with _device_ctx(opts.get("backend")):
-        res = _solve_instance(inst, algorithm, opts, ga_params, errors, "vrp")
+        res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "vrp", warm)
     if res is None:
         return None
 
@@ -223,15 +312,27 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors):
                 "load": float(sum(demands[c] for c in route)),
             }
         )
-    return {
+    result = {
         "durationMax": _as_float(bd.duration_max),
         "durationSum": _as_float(bd.duration_sum),
         "vehicles": vehicles,
     }
+    if stats is not None:
+        result["stats"] = stats
+    if database is not None:
+        database.save_warmstart(
+            params["name"],
+            {
+                "problem": "vrp",
+                "routes": [v["tour"][1:-1] for v in vehicles],
+                "cost": result["durationSum"],
+            },
+        )
+    return result
 
 
 @_enveloped
-def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors):
+def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors, database=None):
     """Solve a TSP request; returns the contract result dict or None."""
     customers = params["customers"]
     start_node = params["start_node"]
@@ -276,15 +377,26 @@ def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors):
         slice_minutes=slice_minutes,
         slice_axis=arrays["slice_axis"],
     )
+    orig_ids = [locations[i]["id"] for i in active_pos]
+    warm = None
+    if opts.get("warm_start") and database is not None:
+        warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "tsp")
     with _device_ctx(opts.get("backend")):
-        res = _solve_instance(inst, algorithm, opts, ga_params, errors, "tsp")
+        res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "tsp", warm)
     if res is None:
         return None
 
-    orig_ids = [locations[i]["id"] for i in active_pos]
     routes = routes_from_giant(res.giant)
     tour = [start_node] + [orig_ids[c] for c in routes[0]] + [start_node]
-    return {
+    result = {
         "duration": _as_float(res.breakdown.duration_sum),
         "vehicle": tour,
     }
+    if stats is not None:
+        result["stats"] = stats
+    if database is not None:
+        database.save_warmstart(
+            params["name"],
+            {"problem": "tsp", "routes": [tour[1:-1]], "cost": result["duration"]},
+        )
+    return result

@@ -37,6 +37,33 @@ class Database:
     def _owner_email(self) -> str | None:
         raise NotImplementedError
 
+    def _fetch_warmstart(self, name):
+        raise NotImplementedError
+
+    def _upsert_warmstart(self, name, state: dict):
+        raise NotImplementedError
+
+    # -- warm-start checkpoints (framework extension) -----------------------
+    # The reference has no computation checkpointing; its closest analog is
+    # the ignored/completed dynamic re-solve inputs (SURVEY.md §5
+    # "checkpoint/resume"). This seam persists the best-so-far solution
+    # keyed by solutionName so a re-solve can seed its population from the
+    # previous result. Best-effort by design: a miss or store failure must
+    # never fail a solve.
+    def get_warmstart(self, name) -> dict | None:
+        try:
+            row = self._fetch_warmstart(name)
+            return None if row is None else row.get("state")
+        except Exception:
+            return None
+
+    def save_warmstart(self, name, state: dict) -> bool:
+        try:
+            self._upsert_warmstart(name, state)
+            return True
+        except Exception:
+            return False
+
     # -- reference-shaped API ----------------------------------------------
     def get_locations_by_id(self, id, errors):
         try:

@@ -23,7 +23,12 @@ import threading
 from store.base import Database, DatabaseTSP, DatabaseVRP
 
 _lock = threading.Lock()
-_tables: dict = {"locations": {}, "durations": {}, "solutions": []}
+_tables: dict = {
+    "locations": {},
+    "durations": {},
+    "solutions": [],
+    "warmstarts": {},
+}
 _tokens: dict = {}
 _fixtures_loaded = False
 
@@ -33,6 +38,7 @@ def reset():
         _tables["locations"].clear()
         _tables["durations"].clear()
         _tables["solutions"].clear()
+        _tables["warmstarts"].clear()
         _tokens.clear()
         global _fixtures_loaded
         _fixtures_loaded = False
@@ -93,6 +99,13 @@ class _InMemoryMixin(Database):
     def _owner_email(self):
         _ensure_fixtures()
         return _tokens.get(self.auth) if self.auth else None
+
+    def _fetch_warmstart(self, name):
+        return _tables["warmstarts"].get(str(name))
+
+    def _upsert_warmstart(self, name, state: dict):
+        with _lock:
+            _tables["warmstarts"][str(name)] = {"name": name, "state": state}
 
 
 class InMemoryDatabaseVRP(_InMemoryMixin, DatabaseVRP):

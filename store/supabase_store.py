@@ -55,6 +55,21 @@ class _SupabaseMixin(Database):
             return None
         return user.model_dump()["user"]["email"]
 
+    def _fetch_warmstart(self, name):
+        result = (
+            self.client.table("warmstarts").select("*").eq("name", name).execute()
+        )
+        if not len(result.data):
+            return None
+        return result.data[0]
+
+    def _upsert_warmstart(self, name, state: dict):
+        return (
+            self.client.table("warmstarts")
+            .upsert({"name": name, "state": state}, on_conflict="name")
+            .execute()
+        )
+
 
 class SupabaseDatabaseVRP(_SupabaseMixin, DatabaseVRP):
     pass

@@ -1,0 +1,127 @@
+"""Warm-start checkpointing + stats/profile options (framework extensions).
+
+The reference has no computation checkpointing (SURVEY.md §5); this
+covers the solutionName-keyed warm-start seam end-to-end over HTTP, the
+id-remapping under dynamic re-solve (ignored/completed), and the
+includeStats attachment.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import store.memory as mem
+from service.solve import _warm_perm
+from tests.test_service import post, server, seeded  # noqa: F401  (fixtures)
+
+
+def vrp_body(**over):
+    body = {
+        "solutionName": "ws-sol",
+        "solutionDescription": "d",
+        "locationsKey": "locs1",
+        "durationsKey": "durs1",
+        "capacities": [6, 6, 6],
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "iterationCount": 300,
+        "populationSize": 16,
+        "includeStats": True,
+    }
+    body.update(over)
+    return body
+
+
+class TestWarmPerm:
+    def test_preserves_order_and_appends_new(self):
+        state = {"problem": "vrp", "routes": [[5, 3], [9]]}
+        # active ids: depot 0, then customers 3, 5, 7 (9 was completed)
+        got = _warm_perm(state, [0, 3, 5, 7], "vrp")
+        assert got is not None
+        # 5 -> pos 2, 3 -> pos 1, 9 dropped, new customer 7 appended
+        assert np.asarray(got).tolist() == [2, 1, 3]
+
+    def test_rejects_cross_problem_and_empty(self):
+        assert _warm_perm({"problem": "tsp", "routes": [[1]]}, [0, 1], "vrp") is None
+        assert _warm_perm(None, [0, 1], "vrp") is None
+        assert _warm_perm({"problem": "vrp", "routes": []}, [0], "vrp") is None
+
+
+class TestWarmStartHTTP:
+    def test_checkpoint_saved_and_reused(self, server):
+        status, first = post(server, "/api/vrp/sa", vrp_body())
+        assert status == 200 and first["success"]
+        assert first["message"]["stats"]["warmStart"] is False
+        ws = mem._tables["warmstarts"].get("ws-sol")
+        assert ws is not None and ws["state"]["problem"] == "vrp"
+        saved_routes = ws["state"]["routes"]
+        assert sorted(c for r in saved_routes for c in r) == [1, 2, 3, 4, 5, 6]
+
+        status, second = post(server, "/api/vrp/sa", vrp_body(warmStart=True))
+        assert status == 200 and second["success"]
+        assert second["message"]["stats"]["warmStart"] is True
+        # warm-started solve must not be worse than the checkpointed cost
+        assert (
+            second["message"]["durationSum"]
+            <= ws["state"]["cost"] + 1e-6
+        )
+
+    def test_warm_start_survives_dynamic_resolve(self, server):
+        status, _ = post(server, "/api/vrp/sa", vrp_body())
+        assert status == 200
+        status, second = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(warmStart=True, completedCustomers=[2, 5]),
+        )
+        assert status == 200 and second["success"]
+        served = [
+            c for v in second["message"]["vehicles"] for c in v["tour"][1:-1]
+        ]
+        assert sorted(served) == [1, 3, 4, 6]
+        assert second["message"]["stats"]["warmStart"] is True
+
+    def test_tsp_checkpoint_roundtrip(self, server):
+        body = {
+            "solutionName": "ws-tsp",
+            "solutionDescription": "d",
+            "locationsKey": "locs1",
+            "durationsKey": "durs1",
+            "customers": [1, 2, 3, 4],
+            "startNode": 0,
+            "startTime": 0,
+            "includeStats": True,
+            "iterationCount": 300,
+            "populationSize": 16,
+        }
+        status, first = post(server, "/api/tsp/sa", body)
+        assert status == 200 and first["success"]
+        assert mem._tables["warmstarts"]["ws-tsp"]["state"]["problem"] == "tsp"
+        status, second = post(server, "/api/tsp/sa", dict(body, warmStart=True))
+        assert status == 200
+        assert second["message"]["stats"]["warmStart"] is True
+        assert second["message"]["duration"] <= first["message"]["duration"] + 1e-6
+
+    def test_stats_absent_by_default(self, server):
+        body = vrp_body()
+        body.pop("includeStats")
+        status, resp = post(server, "/api/vrp/sa", body)
+        assert status == 200
+        assert "stats" not in resp["message"]
+
+    def test_ga_warm_start(self, server):
+        status, _ = post(server, "/api/vrp/sa", vrp_body())
+        assert status == 200
+        status, resp = post(
+            server,
+            "/api/vrp/ga",
+            vrp_body(
+                warmStart=True,
+                multiThreaded=False,
+                randomPermutationCount=16,
+                iterationCount=50,
+            ),
+        )
+        assert status == 200 and resp["success"]
+        assert resp["message"]["stats"]["warmStart"] is True

@@ -1,0 +1,4 @@
+from vrpms_tpu.kernels.sa_eval import (
+    pallas_objective_batch,
+    pallas_available,
+)

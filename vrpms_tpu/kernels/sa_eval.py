@@ -1,0 +1,164 @@
+"""Pallas TPU kernel: fused giant-tour objective (distance + capacity).
+
+The XLA one-hot path (core.cost.objective_hot_batch) is HBM-bound: the
+(B, L, N) one-hot and X = P @ D intermediates round-trip ~0.8 GB per
+sweep at B=4096. This kernel keeps the whole evaluation in VMEM per
+batch-tile: build the position one-hot, run the leg-selection matmul on
+the MXU, contract against the next-position one-hot, and reduce per-route
+loads — nothing but the (B, L) tours and the (B,) costs touch HBM.
+
+Semantics match objective_hot_batch's fast path exactly (same bf16
+selection argument: one-hot contractions select single elements, so the
+only rounding is the durations matrix itself in bf16). Untimed instances
+only; callers fall back to the XLA paths otherwise (see
+core.cost.resolve_eval_mode).
+
+Layout: tours are processed TRANSPOSED — work arrays are (L̂, TILE_B)
+with chains on the 128-lane minor axis — and padded: L̂/N̂ round L/N up
+to the MXU-friendly 128 multiple. Padding is semantically free: pad
+positions hold depot zeros (D[0,0] == 0, demands[0] == 0) and pad nodes
+are never selected by a one-hot.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core.instance import Instance
+from vrpms_tpu.core.cost import CostWeights
+
+try:  # pallas imports fail on some CPU-only builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+
+def pallas_available() -> bool:
+    return _PALLAS_OK
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _eval_kernel(gt_ref, d_ref, dem_ref, cap_ref, wcap_ref, cost_ref, *, n_vehicles):
+    """One batch-tile: gt (L̂, TILE_B) transposed tours -> cost (1, TILE_B)."""
+    lhat = gt_ref.shape[0]
+    tile_b = gt_ref.shape[1]
+    nhat = d_ref.shape[0]
+    gt = gt_ref[:]  # (L̂, TILE_B) int32
+
+    # One-hot over nodes in flat (l, b) ordering: row p = l * TILE_B + b.
+    flat = gt.reshape(lhat * tile_b, 1)
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (lhat * tile_b, nhat), 1)
+    p_all = (flat == node_iota).astype(jnp.bfloat16)  # (L̂*T, N̂)
+
+    # X[p, m] = D[node(p), m] — exact bf16 row selection on the MXU.
+    x_all = jnp.dot(p_all, d_ref[:], preferred_element_type=jnp.bfloat16)
+
+    # legs[p] = D[node(p), node(p + one position)] ; +1 position == +TILE_B
+    # rows in (l, b) ordering. Pad legs are depot self-loops (cost 0).
+    prod = x_all[: (lhat - 1) * tile_b] * p_all[tile_b:]
+    legs = jnp.sum(prod.astype(jnp.float32), axis=1)  # ((L̂-1)*T,)
+    dist = jnp.sum(legs.reshape(lhat - 1, tile_b), axis=0)  # (TILE_B,)
+
+    # Per-position demand: nd[p] = demands[node(p)] (f32 matvec).
+    nd = jnp.dot(
+        p_all.astype(jnp.float32), dem_ref[:].reshape(nhat, 1),
+        preferred_element_type=jnp.float32,
+    ).reshape(lhat, tile_b)
+
+    # rid[l] = (# zeros at positions <= l) - 1 via a triangular MXU matmul
+    # (counts are small integers — exact in bf16 up to 256).
+    is_zero = (gt == 0).astype(jnp.bfloat16)  # (L̂, T)
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
+    col_i = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
+    tri = (col_i <= row_i).astype(jnp.bfloat16)
+    rid = (
+        jnp.dot(tri, is_zero, preferred_element_type=jnp.float32)
+        .astype(jnp.int32)
+        - 1
+    )  # (L̂, T); pad rows exceed V-1 and drop out of every load below
+
+    # Loads: route v's demand, excess past its capacity.
+    def body(v, excess):
+        mask = rid == v
+        load = jnp.sum(jnp.where(mask, nd, 0.0), axis=0)  # (TILE_B,)
+        return excess + jnp.maximum(load - cap_ref[0, v], 0.0)
+
+    excess = jax.lax.fori_loop(
+        0, n_vehicles, body, jnp.zeros((tile_b,), jnp.float32)
+    )
+    cost_ref[0, :] = dist + wcap_ref[0, 0] * excess
+
+
+def _pad_static(inst: Instance):
+    n = inst.n_nodes
+    nhat = _round_up(n, 128)
+    d = jnp.zeros((nhat, nhat), jnp.bfloat16).at[:n, :n].set(
+        inst.durations[0].astype(jnp.bfloat16)
+    )
+    dem = jnp.zeros((nhat,), jnp.float32).at[:n].set(inst.demands)
+    vhat = _round_up(inst.n_vehicles, 8)
+    cap = jnp.full((1, vhat), 1e18, jnp.float32).at[0, : inst.n_vehicles].set(
+        inst.capacities
+    )
+    return d, dem, cap
+
+
+@functools.partial(jax.jit, static_argnames=("tile_b", "n_vehicles", "interpret"))
+def _run(giants_t, d, dem, cap, wcap, *, tile_b, n_vehicles, interpret=False):
+    lhat, b = giants_t.shape
+    grid = b // tile_b
+    cost = pl.pallas_call(
+        functools.partial(_eval_kernel, n_vehicles=n_vehicles),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((lhat, tile_b), lambda i: (0, i)),
+            pl.BlockSpec(d.shape, lambda i: (0, 0)),
+            pl.BlockSpec(dem.shape, lambda i: (0,)),
+            pl.BlockSpec(cap.shape, lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile_b), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, b), jnp.float32),
+        interpret=interpret,
+    )(giants_t, d, dem, cap, wcap)
+    return cost[0]
+
+
+def pallas_objective_batch(
+    giants: jax.Array,
+    inst: Instance,
+    w: CostWeights,
+    tile_b: int = 32,
+    transposed: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused-TPU batched objective; drop-in for objective_hot_batch.
+
+    giants: (B, L) int32 — or (L, B) with transposed=True to skip the
+    relayout when the caller keeps SA state in kernel layout. B must be
+    a multiple of tile_b (solvers size their chain batches accordingly).
+    """
+    if not _PALLAS_OK:
+        raise RuntimeError("pallas unavailable in this environment")
+    if inst.has_tw or inst.time_dependent:
+        raise ValueError("pallas objective covers the untimed fast path only")
+    gt = giants if transposed else giants.T
+    lhat = _round_up(gt.shape[0], 8)
+    if gt.shape[1] % tile_b:
+        raise ValueError(f"batch {gt.shape[1]} not a multiple of tile_b {tile_b}")
+    gt = jnp.pad(gt, ((0, lhat - gt.shape[0]), (0, 0)))
+    d, dem, cap = _pad_static(inst)
+    wcap = jnp.asarray(w.cap, jnp.float32).reshape(1, 1)
+    return _run(
+        gt, d, dem, cap, wcap,
+        tile_b=tile_b, n_vehicles=inst.n_vehicles, interpret=interpret,
+    )
