@@ -45,9 +45,9 @@ def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
     w = CostWeights.make()
     t0, t1 = _auto_temps(inst, SAParams())
     inst = jax.device_put(inst, device)
-    # MXU one-hot path on any accelerator, flat-gather on CPU
+    # fused pallas kernel on any accelerator, flat-gather on CPU
     # (core.cost.resolve_eval_mode rationale; 'axon' aliases tpu here)
-    mode = "gather" if device.platform == "cpu" else "onehot"
+    mode = "gather" if device.platform == "cpu" else "pallas"
 
     def chunk(giants, costs, key, start):
         def body(state, i):

@@ -150,6 +150,30 @@ def _warm_perm(state, active_ids: list, problem: str):
     return jnp.asarray(order, dtype=jnp.int32)
 
 
+def _better_checkpoint(prev, problem, routes, cost) -> bool:
+    """Should this result replace the stored warm-start checkpoint?
+
+    Passed to store.base.Database.save_warmstart as its keep-best guard
+    (re-evaluated against the freshly fetched state at write time). Keep
+    the stored checkpoint only when it solves the SAME customer set at
+    an equal-or-lower cost; a dynamic re-solve (ignored/completed changed
+    the active set) always refreshes, because costs across different
+    customer sets are not comparable. `cost` is the PENALIZED solver
+    objective (distance + capacity/TW penalties), so an infeasible
+    short-distance result never displaces a feasible checkpoint.
+    """
+    if not prev or prev.get("problem") != problem:
+        return True
+    prev_ids = {c for r in prev.get("routes", []) for c in r}
+    new_ids = {c for r in routes for c in r}
+    if prev_ids != new_ids:
+        return True
+    try:
+        return float(cost) < float(prev.get("cost"))
+    except (TypeError, ValueError):
+        return True
+
+
 def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None):
     """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
     seed = int(opts.get("seed") or 0)
@@ -199,26 +223,41 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
         return None
 
 
+PROFILE_DIR = "/tmp/vrpms_profile"
+
+
+@contextlib.contextmanager
 def _profiled(opts):
-    """jax.profiler trace context when the request asks for one."""
-    if opts.get("profile"):
-        trace_dir = (
-            opts["profile"]
-            if isinstance(opts["profile"], str)
-            else "/tmp/vrpms_profile"
-        )
+    """jax.profiler trace context when the request asks for one.
+
+    The trace always lands under the fixed PROFILE_DIR — the request
+    flag is treated as a boolean, never as a path (a request-supplied
+    path would let callers write anywhere the server can). Best-effort:
+    a failure to start tracing (e.g. a trace already active from a
+    concurrent request) must not fail the solve.
+    """
+    if not opts.get("profile"):
+        yield None
+        return
+    try:
+        ctx = jax.profiler.trace(PROFILE_DIR)
+        ctx.__enter__()
+    except Exception:
+        yield None
+        return
+    try:
+        yield PROFILE_DIR
+    finally:
         try:
-            return jax.profiler.trace(trace_dir), trace_dir
+            ctx.__exit__(None, None, None)
         except Exception:
             pass
-    return contextlib.nullcontext(), None
 
 
 def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
     """Timed + optionally profiled dispatch; returns (res, stats|None)."""
-    ctx, trace_dir = _profiled(opts)
     t0 = time.perf_counter()
-    with ctx:
+    with _profiled(opts) as trace_dir:
         res = _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm)
         if res is not None:
             jax.block_until_ready(res.cost)
@@ -283,12 +322,12 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
         slice_minutes=slice_minutes,
         slice_axis=arrays["slice_axis"],
     )
-    orig_ids_for_warm = [locations[i]["id"] for i in active_pos]
+    orig_ids = [locations[i]["id"] for i in active_pos]
     warm = None
-    if opts.get("warm_start") and database is not None:
-        warm = _warm_perm(
-            database.get_warmstart(params["name"]), orig_ids_for_warm, "vrp"
-        )
+    # Only SA and GA consume a warm seed (see _solve_instance); skipping
+    # the lookup for bf/aco also keeps stats['warmStart'] truthful.
+    if opts.get("warm_start") and database is not None and algorithm in ("sa", "ga"):
+        warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "vrp")
     with _device_ctx(opts.get("backend")):
         res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "vrp", warm)
     if res is None:
@@ -297,7 +336,6 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     bd = res.breakdown
     route_durs = np.asarray(bd.route_durations)
     demands = np.asarray(inst.demands)
-    orig_ids = [locations[i]["id"] for i in active_pos]
     depot_id = locations[depot_pos]["id"]
     vehicles = []
     for r, route in enumerate(routes_from_giant(res.giant)):
@@ -320,13 +358,12 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     if stats is not None:
         result["stats"] = stats
     if database is not None:
+        routes = [v["tour"][1:-1] for v in vehicles]
+        chk_cost = _as_float(res.cost)  # penalized objective, not raw duration
         database.save_warmstart(
             params["name"],
-            {
-                "problem": "vrp",
-                "routes": [v["tour"][1:-1] for v in vehicles],
-                "cost": result["durationSum"],
-            },
+            {"problem": "vrp", "routes": routes, "cost": chk_cost},
+            better_than=lambda prev: _better_checkpoint(prev, "vrp", routes, chk_cost),
         )
     return result
 
@@ -379,7 +416,7 @@ def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     )
     orig_ids = [locations[i]["id"] for i in active_pos]
     warm = None
-    if opts.get("warm_start") and database is not None:
+    if opts.get("warm_start") and database is not None and algorithm in ("sa", "ga"):
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "tsp")
     with _device_ctx(opts.get("backend")):
         res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "tsp", warm)
@@ -395,8 +432,11 @@ def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     if stats is not None:
         result["stats"] = stats
     if database is not None:
+        routes = [tour[1:-1]]
+        chk_cost = _as_float(res.cost)  # penalized objective, not raw duration
         database.save_warmstart(
             params["name"],
-            {"problem": "tsp", "routes": [tour[1:-1]], "cost": result["duration"]},
+            {"problem": "tsp", "routes": routes, "cost": chk_cost},
+            better_than=lambda prev: _better_checkpoint(prev, "tsp", routes, chk_cost),
         )
     return result

@@ -37,32 +37,67 @@ class Database:
     def _owner_email(self) -> str | None:
         raise NotImplementedError
 
-    def _fetch_warmstart(self, name):
+    def _fetch_warmstart(self, owner: str, name):
         raise NotImplementedError
 
-    def _upsert_warmstart(self, name, state: dict):
+    def _upsert_warmstart(self, owner: str, name, state: dict):
         raise NotImplementedError
 
     # -- warm-start checkpoints (framework extension) -----------------------
     # The reference has no computation checkpointing; its closest analog is
     # the ignored/completed dynamic re-solve inputs (SURVEY.md §5
     # "checkpoint/resume"). This seam persists the best-so-far solution
-    # keyed by solutionName so a re-solve can seed its population from the
-    # previous result. Best-effort by design: a miss or store failure must
-    # never fail a solve.
+    # keyed by (owner, solutionName) so a re-solve can seed its population
+    # from the previous result. Owner scoping mirrors save_solution's auth
+    # rule: without an authenticated owner nothing is stored or returned —
+    # otherwise tenants could read or clobber each other's checkpoints
+    # through a shared solutionName. Best-effort by design: a miss or store
+    # failure must never fail a solve.
+    def _warmstart_owner(self) -> str | None:
+        # Database instances are per-request; cache the owner so a
+        # warm-started solve resolves it once, not once per get + save
+        # (on Supabase each resolution is an auth network round-trip).
+        if not hasattr(self, "_warmstart_owner_cache"):
+            try:
+                self._warmstart_owner_cache = self._owner_email()
+            except Exception:
+                self._warmstart_owner_cache = None
+        return self._warmstart_owner_cache
+
     def get_warmstart(self, name) -> dict | None:
+        owner = self._warmstart_owner()
+        if not owner:
+            return None
         try:
-            row = self._fetch_warmstart(name)
+            row = self._fetch_warmstart(owner, name)
             return None if row is None else row.get("state")
         except Exception:
             return None
 
-    def save_warmstart(self, name, state: dict) -> bool:
+    def save_warmstart(self, name, state: dict, better_than=None) -> bool:
+        """Persist a checkpoint; with `better_than`, only if it improves.
+
+        `better_than(prev_state) -> bool` is evaluated against the
+        freshly re-fetched stored state immediately before the upsert
+        (the in-memory store runs the whole sequence under its table
+        lock; remote stores narrow the race window to one round-trip).
+        """
+        owner = self._warmstart_owner()
+        if not owner:
+            return False
         try:
-            self._upsert_warmstart(name, state)
-            return True
+            return self._upsert_warmstart_guarded(owner, name, state, better_than)
         except Exception:
             return False
+
+    def _upsert_warmstart_guarded(self, owner, name, state, better_than) -> bool:
+        if better_than is not None:
+            row = self._fetch_warmstart(owner, name)
+            prev = None if row is None else row.get("state")
+            if prev is not None and not better_than(prev):
+                return False
+        self._upsert_warmstart(owner, name, state)
+        return True
 
     # -- reference-shaped API ----------------------------------------------
     def get_locations_by_id(self, id, errors):

@@ -100,12 +100,32 @@ class _InMemoryMixin(Database):
         _ensure_fixtures()
         return _tokens.get(self.auth) if self.auth else None
 
-    def _fetch_warmstart(self, name):
-        return _tables["warmstarts"].get(str(name))
+    def _fetch_warmstart(self, owner, name):
+        return _tables["warmstarts"].get((owner, str(name)))
 
-    def _upsert_warmstart(self, name, state: dict):
+    def _upsert_warmstart(self, owner, name, state: dict):
         with _lock:
-            _tables["warmstarts"][str(name)] = {"name": name, "state": state}
+            _tables["warmstarts"][(owner, str(name))] = {
+                "owner": owner,
+                "name": name,
+                "state": state,
+            }
+
+    def _upsert_warmstart_guarded(self, owner, name, state, better_than):
+        # Atomic keep-best: fetch, compare and write under the table
+        # lock so concurrent solves can't regress the stored best.
+        with _lock:
+            if better_than is not None:
+                row = _tables["warmstarts"].get((owner, str(name)))
+                prev = None if row is None else row.get("state")
+                if prev is not None and not better_than(prev):
+                    return False
+            _tables["warmstarts"][(owner, str(name))] = {
+                "owner": owner,
+                "name": name,
+                "state": state,
+            }
+        return True
 
 
 class InMemoryDatabaseVRP(_InMemoryMixin, DatabaseVRP):

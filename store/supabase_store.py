@@ -55,18 +55,25 @@ class _SupabaseMixin(Database):
             return None
         return user.model_dump()["user"]["email"]
 
-    def _fetch_warmstart(self, name):
+    def _fetch_warmstart(self, owner, name):
         result = (
-            self.client.table("warmstarts").select("*").eq("name", name).execute()
+            self.client.table("warmstarts")
+            .select("*")
+            .eq("owner", owner)
+            .eq("name", name)
+            .execute()
         )
         if not len(result.data):
             return None
         return result.data[0]
 
-    def _upsert_warmstart(self, name, state: dict):
+    def _upsert_warmstart(self, owner, name, state: dict):
         return (
             self.client.table("warmstarts")
-            .upsert({"name": name, "state": state}, on_conflict="name")
+            .upsert(
+                {"owner": owner, "name": name, "state": state},
+                on_conflict="owner,name",
+            )
             .execute()
         )
 

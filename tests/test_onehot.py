@@ -118,6 +118,8 @@ class TestSAOnehotMode:
     def test_resolve_mode(self):
         assert resolve_eval_mode("gather") == "gather"
         assert resolve_eval_mode("onehot") == "onehot"
-        assert resolve_eval_mode("auto") in ("gather", "onehot")
+        assert resolve_eval_mode("pallas") == "pallas"
+        # cpu -> gather; tpu -> pallas; other accelerators (gpu) -> onehot
+        assert resolve_eval_mode("auto") in ("gather", "pallas", "onehot")
         with pytest.raises(ValueError):
             resolve_eval_mode("bogus")

@@ -1,0 +1,106 @@
+"""Pallas objective kernel: interpret-mode equivalence vs the XLA paths.
+
+The fused kernel (vrpms_tpu/kernels/sa_eval.py) is the TPU hot path of
+every SA/GA island sweep; these tests pin its semantics on CPU via
+pallas interpret mode (SURVEY.md §4 mesh-without-hardware strategy):
+identical selection as the XLA one-hot path — the only rounding is the
+bf16 durations matrix (and bf16 demands in the packed column) — for both
+the homogeneous-capacity fast path and the general per-vehicle kernel.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core.cost import CostWeights, objective_batch
+from vrpms_tpu.core.encoding import random_giant_batch
+from vrpms_tpu.kernels.sa_eval import (
+    _homogeneous_capacity,
+    pallas_available,
+    pallas_objective_batch,
+)
+
+pytestmark = pytest.mark.skipif(
+    not pallas_available(), reason="pallas not importable"
+)
+
+W = CostWeights.make()
+
+
+def _synth(rng, n, caps, demand_lo=1.0, demand_hi=9.0):
+    d = rng.uniform(1.0, 100.0, size=(n, n))
+    np.fill_diagonal(d, 0.0)
+    demands = rng.uniform(demand_lo, demand_hi, size=n)
+    return make_instance(d, demands=demands, capacities=caps)
+
+
+def _check(inst, batch=128, seed=0, rtol=2e-2):
+    giants = random_giant_batch(
+        jax.random.key(seed), batch, inst.n_customers, inst.n_vehicles
+    )
+    ref = np.asarray(objective_batch(giants, inst, W))
+    got = np.asarray(pallas_objective_batch(giants, inst, W, interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=rtol)
+    return got
+
+
+class TestPallasObjective:
+    def test_homogeneous_matches_gather(self, rng):
+        inst = _synth(rng, 30, [40.0] * 5)
+        assert _homogeneous_capacity(inst) == 40.0
+        _check(inst)
+
+    def test_heterogeneous_uses_general_kernel(self, rng):
+        inst = _synth(rng, 30, [30.0, 50.0, 80.0])
+        assert _homogeneous_capacity(inst) is None
+        _check(inst)
+
+    def test_negative_demand_uses_general_kernel(self, rng):
+        inst = _synth(rng, 12, [40.0, 40.0], demand_lo=-3.0)
+        assert _homogeneous_capacity(inst) is None
+        _check(inst)
+
+    def test_tsp_uncapacitated(self, rng):
+        inst = _synth(rng, 20, None)
+        inst = make_instance(np.asarray(inst.durations[0]), n_vehicles=1)
+        _check(inst)
+
+    def test_capacity_excess_exact(self):
+        # one overloaded route: excess must survive bf16 selection exactly
+        d = np.ones((4, 4)) - np.eye(4)
+        inst = make_instance(d, demands=[0, 5, 5, 5], capacities=[6.0, 6.0])
+        g = jnp.asarray([[0, 1, 2, 3, 0, 0]] * 128, dtype=jnp.int32)
+        ref = float(objective_batch(g, inst, W)[0])
+        got = float(pallas_objective_batch(g, inst, W, interpret=True)[0])
+        assert abs(got - ref) / ref < 1e-3
+
+    def test_transposed_input(self, rng):
+        inst = _synth(rng, 16, [35.0] * 3)
+        giants = random_giant_batch(jax.random.key(3), 128, 15, 3)
+        a = pallas_objective_batch(giants, inst, W, interpret=True)
+        b = pallas_objective_batch(
+            giants.T, inst, W, transposed=True, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_timed_instances_rejected(self, rng):
+        d = rng.uniform(1, 50, size=(8, 8))
+        inst = make_instance(
+            d, capacities=[99.0], ready=np.zeros(8), due=np.full(8, 1e9)
+        )
+        giants = random_giant_batch(jax.random.key(4), 128, 7, 1)
+        with pytest.raises(ValueError):
+            pallas_objective_batch(giants, inst, W, interpret=True)
+
+    def test_batch_must_be_tile_multiple(self, rng):
+        inst = _synth(rng, 10, [40.0, 40.0])
+        giants = random_giant_batch(jax.random.key(5), 64, 9, 2)
+        with pytest.raises(ValueError):
+            pallas_objective_batch(giants, inst, W, interpret=True)
+
+    def test_node_count_on_lane_boundary(self, rng):
+        # N == 128 forces the padded demand column into a bumped tile
+        inst = _synth(rng, 128, [300.0] * 4)
+        _check(inst, rtol=2e-2)

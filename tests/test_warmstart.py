@@ -15,8 +15,12 @@ from service.solve import _warm_perm
 from tests.test_service import post, server, seeded  # noqa: F401  (fixtures)
 
 
+ALICE = "alice@example.com"  # registered for "tok-alice" by the seeded fixture
+
+
 def vrp_body(**over):
     body = {
+        "auth": "tok-alice",  # checkpoints are owner-scoped like saves
         "solutionName": "ws-sol",
         "solutionDescription": "d",
         "locationsKey": "locs1",
@@ -53,7 +57,7 @@ class TestWarmStartHTTP:
         status, first = post(server, "/api/vrp/sa", vrp_body())
         assert status == 200 and first["success"]
         assert first["message"]["stats"]["warmStart"] is False
-        ws = mem._tables["warmstarts"].get("ws-sol")
+        ws = mem._tables["warmstarts"].get((ALICE, "ws-sol"))
         assert ws is not None and ws["state"]["problem"] == "vrp"
         saved_routes = ws["state"]["routes"]
         assert sorted(c for r in saved_routes for c in r) == [1, 2, 3, 4, 5, 6]
@@ -84,6 +88,7 @@ class TestWarmStartHTTP:
 
     def test_tsp_checkpoint_roundtrip(self, server):
         body = {
+            "auth": "tok-alice",
             "solutionName": "ws-tsp",
             "solutionDescription": "d",
             "locationsKey": "locs1",
@@ -97,7 +102,8 @@ class TestWarmStartHTTP:
         }
         status, first = post(server, "/api/tsp/sa", body)
         assert status == 200 and first["success"]
-        assert mem._tables["warmstarts"]["ws-tsp"]["state"]["problem"] == "tsp"
+        ws = mem._tables["warmstarts"][(ALICE, "ws-tsp")]
+        assert ws["state"]["problem"] == "tsp"
         status, second = post(server, "/api/tsp/sa", dict(body, warmStart=True))
         assert status == 200
         assert second["message"]["stats"]["warmStart"] is True
@@ -109,6 +115,58 @@ class TestWarmStartHTTP:
         status, resp = post(server, "/api/vrp/sa", body)
         assert status == 200
         assert "stats" not in resp["message"]
+
+    def test_checkpoint_keeps_best_so_far(self, server):
+        status, first = post(server, "/api/vrp/sa", vrp_body())
+        assert status == 200 and first["success"]
+        good = mem._tables["warmstarts"][(ALICE, "ws-sol")]["state"]
+        # A deliberately bad follow-up solve over the SAME customer set
+        # (1 iteration, adversarial seed) must not clobber the checkpoint.
+        status, second = post(
+            server, "/api/vrp/sa", vrp_body(iterationCount=1, seed=99)
+        )
+        assert status == 200 and second["success"]
+        kept = mem._tables["warmstarts"][(ALICE, "ws-sol")]["state"]
+        assert kept["cost"] <= good["cost"] + 1e-9
+        # A dynamic re-solve (different active set) always refreshes.
+        status, third = post(
+            server, "/api/vrp/sa", vrp_body(completedCustomers=[2])
+        )
+        assert status == 200 and third["success"]
+        refreshed = mem._tables["warmstarts"][(ALICE, "ws-sol")]["state"]
+        assert sorted(c for r in refreshed["routes"] for c in r) == [1, 3, 4, 5, 6]
+
+    def test_anonymous_requests_do_not_checkpoint(self, server):
+        body = vrp_body()
+        del body["auth"]
+        status, resp = post(server, "/api/vrp/sa", body)
+        assert status == 200 and resp["success"]
+        assert mem._tables["warmstarts"] == {}
+        assert resp["message"]["stats"]["warmStart"] is False
+
+    def test_checkpoints_are_tenant_isolated(self, server):
+        mem.register_token("tok-bob", "bob@example.com")
+        status, _ = post(server, "/api/vrp/sa", vrp_body())
+        assert status == 200
+        assert (ALICE, "ws-sol") in mem._tables["warmstarts"]
+        # Bob reuses the same solutionName: he must neither read Alice's
+        # checkpoint nor overwrite it.
+        status, resp = post(
+            server, "/api/vrp/sa", vrp_body(auth="tok-bob", seed=7, warmStart=True)
+        )
+        assert status == 200 and resp["success"]
+        assert ("bob@example.com", "ws-sol") in mem._tables["warmstarts"]
+        alice_ws = mem._tables["warmstarts"][(ALICE, "ws-sol")]
+        assert alice_ws["owner"] == ALICE
+
+    def test_warm_stat_false_for_algorithms_without_seed(self, server):
+        status, _ = post(server, "/api/vrp/sa", vrp_body())
+        assert status == 200
+        status, resp = post(
+            server, "/api/vrp/aco", vrp_body(warmStart=True, iterationCount=30)
+        )
+        assert status == 200 and resp["success"]
+        assert resp["message"]["stats"]["warmStart"] is False
 
     def test_ga_warm_start(self, server):
         status, _ = post(server, "/api/vrp/sa", vrp_body())

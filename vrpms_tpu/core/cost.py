@@ -211,17 +211,35 @@ def _onehot(x: jax.Array, n: int, dtype) -> jax.Array:
     return (x[..., None] == jnp.arange(n, dtype=x.dtype)).astype(dtype)
 
 
+def _tpu_backend() -> bool:
+    """True when the default backend's devices are TPU chips (covers
+    plugin aliases like 'axon' whose device platform is still 'tpu')."""
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError:
+        return False
+    return jax.default_backend() == "tpu" or dev.platform == "tpu"
+
+
 def resolve_eval_mode(mode: str = "auto") -> str:
-    """'onehot' on TPU backends, 'gather' elsewhere; explicit modes pass
-    through. The split exists because the two hot-path formulations are
-    each catastrophic on the other platform (scalar-loop gathers on TPU;
-    dense 80-GFLOP one-hot contractions on CPU)."""
+    """'pallas' (fused kernel) on TPU backends, 'gather' elsewhere;
+    explicit modes pass through. The split exists because each hot-path
+    formulation is catastrophic off its platform (scalar-loop gathers on
+    TPU; dense 80-GFLOP one-hot contractions on CPU). 'pallas' degrades
+    to 'onehot' per call when the kernel doesn't apply (timed instances,
+    batch not a lane-tile multiple, pallas unavailable)."""
     if mode == "auto":
-        # the TPU plugin in some environments registers under an alias
-        # (e.g. 'axon'); only plain CPU wants the gather formulation
-        return "gather" if jax.default_backend() == "cpu" else "onehot"
-    if mode not in ("onehot", "gather"):
-        raise ValueError(f"eval mode must be auto/onehot/gather, got {mode!r}")
+        backend = jax.default_backend()
+        if backend == "cpu":
+            return "gather"
+        # The fused kernel is Mosaic/TPU-only; the TPU plugin registers
+        # under an alias in some environments (e.g. 'axon'). Other
+        # accelerators (GPU) get the XLA one-hot formulation.
+        return "pallas" if _tpu_backend() else "onehot"
+    if mode not in ("pallas", "onehot", "gather"):
+        raise ValueError(
+            f"eval mode must be auto/pallas/onehot/gather, got {mode!r}"
+        )
     return mode
 
 
@@ -272,8 +290,25 @@ def objective_hot_batch(
 def objective_batch_mode(
     giants: jax.Array, inst: Instance, w: CostWeights, mode: str = "auto"
 ) -> jax.Array:
-    """Batched objective in the given eval mode ('auto'/'onehot'/'gather')."""
-    if resolve_eval_mode(mode) == "onehot":
+    """Batched objective in the given eval mode.
+
+    'pallas' requires an untimed instance and a lane-tile-multiple batch;
+    anything else quietly uses the XLA one-hot path so solvers can pass
+    one mode for every instance shape.
+    """
+    mode = resolve_eval_mode(mode)
+    if mode == "pallas":
+        from vrpms_tpu.kernels.sa_eval import pallas_available, pallas_objective_batch
+
+        if (
+            pallas_available()
+            and _tpu_backend()  # Mosaic lowers on TPU only
+            and not (inst.has_tw or inst.time_dependent)
+            and giants.shape[0] % 128 == 0
+        ):
+            return pallas_objective_batch(giants, inst, w)
+        mode = "onehot"
+    if mode == "onehot":
         return objective_hot_batch(giants, inst, w)
     return objective_batch(giants, inst, w)
 

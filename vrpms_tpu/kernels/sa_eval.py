@@ -1,23 +1,40 @@
 """Pallas TPU kernel: fused giant-tour objective (distance + capacity).
 
 The XLA one-hot path (core.cost.objective_hot_batch) is HBM-bound: the
-(B, L, N) one-hot and X = P @ D intermediates round-trip ~0.8 GB per
-sweep at B=4096. This kernel keeps the whole evaluation in VMEM per
-batch-tile: build the position one-hot, run the leg-selection matmul on
-the MXU, contract against the next-position one-hot, and reduce per-route
-loads — nothing but the (B, L) tours and the (B,) costs touch HBM.
+(B, L, N) one-hot and X = P @ D intermediates round-trip ~0.5 GB per
+sweep at B=4096 because XLA never fuses through a dot. This kernel keeps
+the whole evaluation in VMEM per batch-tile: it walks the tour in
+position *chunks*, building only a (CHUNK*TILE_B, N̂) one-hot at a time,
+runs the leg-selection matmul on the MXU, contracts against the
+next-position one-hot, and reduces per-route loads — nothing but the
+(L, B) tours and the (B,) costs touch HBM.
 
-Semantics match objective_hot_batch's fast path exactly (same bf16
-selection argument: one-hot contractions select single elements, so the
-only rounding is the durations matrix itself in bf16). Untimed instances
+Semantics match objective_hot_batch's fast path (same bf16 selection
+argument: one-hot contractions select single elements, so the only
+rounding is the durations matrix itself in bf16). Untimed instances
 only; callers fall back to the XLA paths otherwise (see
 core.cost.resolve_eval_mode).
 
+Mosaic constraints that shaped the code (probed on v5e, jax 0.9):
+  * cross-layout reshapes — (C, T) -> (C*T, 1) flattens and their
+    inverses — do not lower; 2-D transposes DO. One-hots are therefore
+    built per position from a transposed chunk column and stacked with
+    `jnp.concatenate` along sublanes, never reshaped.
+  * matmul accumulators must be 32-bit (bf16 inputs are fine).
+  * `jnp.take_along_axis(tab, idx, axis=0)` advertises a
+    `tpu.dynamic_gather` lowering when tab/idx/out share one 2-D shape,
+    but this environment's Mosaic backend crashes compiling it — so no
+    in-kernel table lookups (demands ride in a column of D instead) and
+    the SA move-apply stays an XLA one-hot einsum outside the kernel.
+
 Layout: tours are processed TRANSPOSED — work arrays are (L̂, TILE_B)
-with chains on the 128-lane minor axis — and padded: L̂/N̂ round L/N up
-to the MXU-friendly 128 multiple. Padding is semantically free: pad
-positions hold depot zeros (D[0,0] == 0, demands[0] == 0) and pad nodes
-are never selected by a one-hot.
+with chains on the 128-lane minor axis — and padded: L̂ rounds L up to a
+chunk multiple plus one trailing all-depot chunk (so the "next node" read
+never overflows), N̂ rounds N up to the MXU-friendly 128 multiple.
+Padding is semantically free: pad positions hold depot zeros (D[0,0] ==
+0, demands[0] == 0, and pad rows accumulate route ids past V-1 so the
+capacity loop never sees them) and pad nodes are never selected by a
+one-hot.
 """
 
 from __future__ import annotations
@@ -47,35 +64,165 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _eval_kernel(gt_ref, d_ref, dem_ref, cap_ref, wcap_ref, cost_ref, *, n_vehicles):
-    """One batch-tile: gt (L̂, TILE_B) transposed tours -> cost (1, TILE_B)."""
+def _position_onehots(gt_ref, start, count, nhat):
+    """Per-position one-hots for tour positions [start, start+count).
+
+    Returns `count` blocks of (TILE_B, N̂) bf16, chains on sublanes.
+    Built transpose-then-compare because flatten reshapes don't lower;
+    callers stack with jnp.concatenate when they need a matmul lhs.
+    """
+    tile_b = gt_ref.shape[1]
+    rows = gt_ref[pl.ds(start, count), :]  # (count, T) int32
+    cols = rows.T  # (T, count) — supported 2-D transpose
+    iota = jax.lax.broadcasted_iota(jnp.int32, (tile_b, nhat), 1)
+    return [
+        (cols[:, i : i + 1] == iota).astype(jnp.bfloat16) for i in range(count)
+    ]
+
+
+_NEG_BIG = -1e18
+
+
+def _shift_down(a, k, fill):
+    """Rows shifted down by k along axis 0, top filled with `fill`.
+
+    Sublane shifts only — lane-axis shifts lower to cross-lane permutes
+    and measured ~1 ms/sweep slower for the load scan below.
+    """
+    rows = a.shape[0]
+    pad = jnp.full((k, a.shape[1]), fill, a.dtype)
+    return jnp.concatenate([pad, a[: rows - k]], axis=0)
+
+
+def eval_tours_homog(gt_ref, d_ref, cap0, wcap, *, chunk):
+    """Homogeneous-capacity objective: (L̂, TILE_B) block -> (1, TILE_B).
+
+    Fast path for uniform-capacity fleets (the CVRP benchmark norm) and
+    TSP. Per chunk of `chunk` positions it runs one small MXU matmul per
+    position (concatenating one-hots into a bigger lhs measurably loses
+    to the copies it costs) and handles route loads with a *parallel*
+    segmented scan — profiled 2.2 ms/sweep cheaper than the naive
+    per-position register recurrence, whose serial dependency chain
+    stalls the VPU:
+
+      * demands ride in a spare padded column of D, so the per-position
+        demand is a free byproduct column of the leg matmul;
+      * within a chunk, cumulative demand C is a 3-level shift tree and
+        "C at the most recent route-closing depot zero" is a max-scan of
+        where(z, C, -BIG) — valid because demands are nonnegative, so C
+        is nondecreasing;
+      * a depot zero at position i contributes relu(C_i - C_lastclose -
+        Q) to the excess; only two (1, T) carries cross chunks.
+
+    Trailing pad rows are depot zeros and only ever close empty routes.
+    """
     lhat = gt_ref.shape[0]
     tile_b = gt_ref.shape[1]
     nhat = d_ref.shape[0]
-    gt = gt_ref[:]  # (L̂, TILE_B) int32
+    n_chunks = lhat // chunk
+    d = d_ref[:]
 
-    # One-hot over nodes in flat (l, b) ordering: row p = l * TILE_B + b.
-    flat = gt.reshape(lhat * tile_b, 1)
-    node_iota = jax.lax.broadcasted_iota(jnp.int32, (lhat * tile_b, nhat), 1)
-    p_all = (flat == node_iota).astype(jnp.bfloat16)  # (L̂*T, N̂)
+    def body(c, carry):
+        dist, excess, cum, lc = carry
+        start = c * chunk
+        rows = gt_ref[pl.ds(start, chunk + 1), :]  # (C+1, T) int32
+        # One compare per position; position i is prev for leg i and
+        # next for leg i-1 — each one-hot is used twice.
+        ohs = _position_onehots(gt_ref, start, chunk + 1, nhat)
+        nd_rows = []
+        for i in range(chunk):
+            # X[b, m] = D[node_i(b), m] — exact row selection on the MXU
+            # (bf16 inputs, f32 accumulator as Mosaic requires).
+            x = jnp.dot(ohs[i], d, preferred_element_type=jnp.float32)
+            dist = dist + jnp.sum(
+                x * ohs[i + 1].astype(jnp.float32), axis=1, keepdims=True
+            )
+            nd_rows.append(x[:, nhat - 1 : nhat].T)  # demand column
+        nd = jnp.concatenate(nd_rows, axis=0)  # (C, T) f32
+        z = rows[:chunk] == 0  # (C, T) route-closing depot zeros
 
-    # X[p, m] = D[node(p), m] — exact bf16 row selection on the MXU.
-    x_all = jnp.dot(p_all, d_ref[:], preferred_element_type=jnp.bfloat16)
+        # Inclusive prefix demand within the chunk (log-depth shifts).
+        p = nd
+        k = 1
+        while k < chunk:
+            p = p + _shift_down(p, k, 0.0)
+            k *= 2
+        cdem = cum + p  # running cumulative demand C
+        # Max-scan of C at closes == C at the most recent close <= i.
+        m = jnp.where(z, cdem, _NEG_BIG)
+        k = 1
+        while k < chunk:
+            m = jnp.maximum(m, _shift_down(m, k, _NEG_BIG))
+            k *= 2
+        lc_exc = jnp.maximum(_shift_down(m, 1, _NEG_BIG), lc)
+        contrib = jnp.where(
+            z, jnp.maximum(cdem - lc_exc - cap0, 0.0), 0.0
+        )
+        excess = excess + jnp.sum(contrib, axis=0, keepdims=True)
+        cum = cdem[chunk - 1 : chunk]
+        lc = jnp.maximum(lc, m[chunk - 1 : chunk])
+        return dist, excess, cum, lc
 
-    # legs[p] = D[node(p), node(p + one position)] ; +1 position == +TILE_B
-    # rows in (l, b) ordering. Pad legs are depot self-loops (cost 0).
-    prod = x_all[: (lhat - 1) * tile_b] * p_all[tile_b:]
-    legs = jnp.sum(prod.astype(jnp.float32), axis=1)  # ((L̂-1)*T,)
-    dist = jnp.sum(legs.reshape(lhat - 1, tile_b), axis=0)  # (TILE_B,)
+    zero_col = jnp.zeros((tile_b, 1), jnp.float32)
+    zero_row = jnp.zeros((1, tile_b), jnp.float32)
+    dist, excess, cum, lc = jax.lax.fori_loop(
+        0, n_chunks - 1, body, (zero_col, zero_row, zero_row, zero_row)
+    )
+    # The loop stops short of the trailing all-depot pad chunk; close any
+    # still-open route here.
+    excess = excess + jnp.maximum(cum - lc - cap0, 0.0)
+    return dist.T + wcap * excess
 
-    # Per-position demand: nd[p] = demands[node(p)] (f32 matvec).
-    nd = jnp.dot(
-        p_all.astype(jnp.float32), dem_ref[:].reshape(nhat, 1),
-        preferred_element_type=jnp.float32,
-    ).reshape(lhat, tile_b)
+
+def eval_tours(gt_ref, d_ref, dem_ref, cap_ref, wcap, nd_ref, *, n_vehicles, chunk):
+    """Objective of every tour in a (L̂, TILE_B) block -> (1, TILE_B) f32.
+
+    General path: per-vehicle capacities via a route-id triangular matmul
+    over an (L̂, TILE_B) per-position demand scratch (nd_ref). The
+    uniform-capacity fast path above avoids the scratch entirely.
+    """
+    lhat = gt_ref.shape[0]
+    tile_b = gt_ref.shape[1]
+    nhat = d_ref.shape[0]
+    n_chunks = lhat // chunk
+    d = d_ref[:]
+    dem_col = dem_ref[:].reshape(nhat, 1)
+
+    def body(c, dist):
+        start = c * chunk
+        # chunk+1 one-hots; position i serves as prev for leg i and next
+        # for leg i-1, so each is built once and used twice. The final
+        # chunk's successors live in the trailing all-depot pad chunk, so
+        # start+chunk stays in bounds and those legs cost D[0,0]=0.
+        ohs = _position_onehots(gt_ref, start, chunk + 1, nhat)
+        p_oh = jnp.concatenate(ohs[:-1], axis=0)  # (C*T, N̂)
+        n_oh = jnp.concatenate(ohs[1:], axis=0)
+        # X[p, m] = D[node(p), m] — exact row selection on the MXU
+        # (bf16 inputs, f32 accumulator as Mosaic requires).
+        x = jnp.dot(p_oh, d, preferred_element_type=jnp.float32)
+        legs = jnp.sum(x * n_oh.astype(jnp.float32), axis=1, keepdims=True)
+        # Per-position demand of the chunk, stored for the load pass.
+        nd = jnp.dot(
+            p_oh.astype(jnp.float32), dem_col, preferred_element_type=jnp.float32
+        )  # (C*T, 1)
+        for i in range(chunk):
+            blk = slice(i * tile_b, (i + 1) * tile_b)
+            dist = dist + legs[blk]
+            nd_ref[pl.ds(start + i, 1), :] = nd[blk].T
+        return dist
+
+    dist = jax.lax.fori_loop(
+        0, n_chunks - 1, body, jnp.zeros((tile_b, 1), jnp.float32)
+    )
+    # Demands of the trailing pad chunk are all depot zeros; the load pass
+    # below masks by rid < V anyway, but keep the scratch fully defined.
+    nd_ref[pl.ds(lhat - chunk, chunk), :] = jnp.zeros(
+        (chunk, tile_b), jnp.float32
+    )
 
     # rid[l] = (# zeros at positions <= l) - 1 via a triangular MXU matmul
     # (counts are small integers — exact in bf16 up to 256).
+    gt = gt_ref[:]
     is_zero = (gt == 0).astype(jnp.bfloat16)  # (L̂, T)
     row_i = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
     col_i = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
@@ -87,24 +234,51 @@ def _eval_kernel(gt_ref, d_ref, dem_ref, cap_ref, wcap_ref, cost_ref, *, n_vehic
     )  # (L̂, T); pad rows exceed V-1 and drop out of every load below
 
     # Loads: route v's demand, excess past its capacity.
-    def body(v, excess):
+    nd_all = nd_ref[:]
+
+    def cap_body(v, excess):
         mask = rid == v
-        load = jnp.sum(jnp.where(mask, nd, 0.0), axis=0)  # (TILE_B,)
+        load = jnp.sum(jnp.where(mask, nd_all, 0.0), axis=0, keepdims=True)
         return excess + jnp.maximum(load - cap_ref[0, v], 0.0)
 
     excess = jax.lax.fori_loop(
-        0, n_vehicles, body, jnp.zeros((tile_b,), jnp.float32)
+        0, n_vehicles, cap_body, jnp.zeros((1, tile_b), jnp.float32)
     )
-    cost_ref[0, :] = dist + wcap_ref[0, 0] * excess
+    return dist.T + wcap * excess
 
 
-def _pad_static(inst: Instance):
+def _eval_kernel(gt_ref, d_ref, dem_ref, cap_ref, wcap_ref, cost_ref, nd_ref,
+                 *, n_vehicles, chunk):
+    cost_ref[0:1, :] = eval_tours(
+        gt_ref, d_ref, dem_ref, cap_ref, wcap_ref[0, 0], nd_ref,
+        n_vehicles=n_vehicles, chunk=chunk,
+    )
+
+
+def _eval_kernel_homog(gt_ref, d_ref, scal_ref, cost_ref, *, chunk):
+    # No dem input: on this path demands ride in D's packed last column.
+    cost_ref[0:1, :] = eval_tours_homog(
+        gt_ref, d_ref, scal_ref[0, 0], scal_ref[0, 1], chunk=chunk
+    )
+
+
+def pad_static(inst: Instance):
+    """Durations/demands/capacities padded to kernel shapes (N̂, V̂).
+
+    The last padded column of D carries the demand vector (bf16), so row
+    selection yields each node's demand for free alongside its leg row;
+    legs never read that column because no tour contains node N̂-1 (N̂ is
+    bumped a full lane-tile when N is already a 128 multiple).
+    """
     n = inst.n_nodes
     nhat = _round_up(n, 128)
+    if nhat == n:
+        nhat += 128
     d = jnp.zeros((nhat, nhat), jnp.bfloat16).at[:n, :n].set(
         inst.durations[0].astype(jnp.bfloat16)
     )
     dem = jnp.zeros((nhat,), jnp.float32).at[:n].set(inst.demands)
+    d = d.at[:, nhat - 1].set(dem.astype(jnp.bfloat16))
     vhat = _round_up(inst.n_vehicles, 8)
     cap = jnp.full((1, vhat), 1e18, jnp.float32).at[0, : inst.n_vehicles].set(
         inst.capacities
@@ -112,12 +286,19 @@ def _pad_static(inst: Instance):
     return d, dem, cap
 
 
-@functools.partial(jax.jit, static_argnames=("tile_b", "n_vehicles", "interpret"))
-def _run(giants_t, d, dem, cap, wcap, *, tile_b, n_vehicles, interpret=False):
+def padded_length(length: int, chunk: int) -> int:
+    """Position-axis pad: chunk multiple + one all-depot successor chunk."""
+    return _round_up(length, chunk) + chunk
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_b", "n_vehicles", "chunk", "interpret")
+)
+def _run(giants_t, d, dem, cap, wcap, *, tile_b, n_vehicles, chunk, interpret=False):
     lhat, b = giants_t.shape
     grid = b // tile_b
     cost = pl.pallas_call(
-        functools.partial(_eval_kernel, n_vehicles=n_vehicles),
+        functools.partial(_eval_kernel, n_vehicles=n_vehicles, chunk=chunk),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((lhat, tile_b), lambda i: (0, i)),
@@ -128,16 +309,58 @@ def _run(giants_t, d, dem, cap, wcap, *, tile_b, n_vehicles, interpret=False):
         ],
         out_specs=pl.BlockSpec((1, tile_b), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((1, b), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((lhat, tile_b), jnp.float32)],
         interpret=interpret,
     )(giants_t, d, dem, cap, wcap)
     return cost[0]
+
+
+@functools.partial(jax.jit, static_argnames=("tile_b", "chunk", "interpret"))
+def _run_homog(giants_t, d, scal, *, tile_b, chunk, interpret=False):
+    lhat, b = giants_t.shape
+    grid = b // tile_b
+    cost = pl.pallas_call(
+        functools.partial(_eval_kernel_homog, chunk=chunk),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((lhat, tile_b), lambda i: (0, i)),
+            pl.BlockSpec(d.shape, lambda i: (0, 0)),
+            pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile_b), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, b), jnp.float32),
+        interpret=interpret,
+    )(giants_t, d, scal)
+    return cost[0]
+
+
+def _homogeneous_capacity(inst: Instance):
+    """Concrete scalar capacity when the fleet is uniform, else None.
+
+    Solvers call this with concrete (non-traced) instances — the pallas
+    dispatch happens at trace time, so data-dependent inspection is safe
+    there; traced capacities fall back to the general kernel.
+    """
+    caps = inst.capacities
+    if isinstance(caps, jax.core.Tracer) or isinstance(
+        inst.demands, jax.core.Tracer
+    ):
+        return None
+    import numpy as np
+
+    c = np.asarray(caps)
+    uniform = bool(np.all(c == c[0]))
+    # The max-scan load trick needs nondecreasing cumulative demand.
+    nonneg = bool(np.all(np.asarray(inst.demands) >= 0))
+    return float(c[0]) if (uniform and nonneg) else None
 
 
 def pallas_objective_batch(
     giants: jax.Array,
     inst: Instance,
     w: CostWeights,
-    tile_b: int = 32,
+    tile_b: int = 128,
+    chunk: int = 16,  # 16 measured ~15% faster than 8 on v5e; 32 is equal
     transposed: bool = False,
     interpret: bool = False,
 ) -> jax.Array:
@@ -145,20 +368,31 @@ def pallas_objective_batch(
 
     giants: (B, L) int32 — or (L, B) with transposed=True to skip the
     relayout when the caller keeps SA state in kernel layout. B must be
-    a multiple of tile_b (solvers size their chain batches accordingly).
+    a multiple of tile_b (solvers size their chain batches accordingly);
+    tile_b must be a multiple of 128 (the TPU lane width — Mosaic
+    requires minor block dims of 128).
     """
     if not _PALLAS_OK:
         raise RuntimeError("pallas unavailable in this environment")
     if inst.has_tw or inst.time_dependent:
         raise ValueError("pallas objective covers the untimed fast path only")
     gt = giants if transposed else giants.T
-    lhat = _round_up(gt.shape[0], 8)
+    lhat = padded_length(gt.shape[0], chunk)
     if gt.shape[1] % tile_b:
         raise ValueError(f"batch {gt.shape[1]} not a multiple of tile_b {tile_b}")
     gt = jnp.pad(gt, ((0, lhat - gt.shape[0]), (0, 0)))
-    d, dem, cap = _pad_static(inst)
+    d, dem, cap = pad_static(inst)
+    cap0 = _homogeneous_capacity(inst)
+    if cap0 is not None:
+        scal = jnp.stack(
+            [jnp.float32(cap0), jnp.asarray(w.cap, jnp.float32)]
+        ).reshape(1, 2)
+        return _run_homog(
+            gt, d, scal, tile_b=tile_b, chunk=chunk, interpret=interpret
+        )
     wcap = jnp.asarray(w.cap, jnp.float32).reshape(1, 1)
     return _run(
         gt, d, dem, cap, wcap,
-        tile_b=tile_b, n_vehicles=inst.n_vehicles, interpret=interpret,
+        tile_b=tile_b, n_vehicles=inst.n_vehicles, chunk=chunk,
+        interpret=interpret,
     )

@@ -81,6 +81,8 @@ def apply_src_map(giants: jax.Array, src: jax.Array, mode: str = "gather") -> ja
     one-hot sums stay exact in bf16 up to 256, f32 above).
     """
     b, length = giants.shape
+    if mode == "pallas":  # pallas covers the objective; apply stays XLA
+        mode = "onehot"
     if mode == "onehot":
         from vrpms_tpu.core.cost import _onehot, onehot_dtype
 
