@@ -1,0 +1,71 @@
+"""Local smoke driver — the reference's manual test path, made real.
+
+The reference blesses `python main.py` as the local-testing procedure
+(reference README.md:47-51; reference main.py:1-13 calls its duration
+stub, its random solve stub, and the date helper, then prints). This
+driver exercises the same three capabilities against the actual
+framework: a point-to-point duration query with time-of-day slicing, a
+real VRP solve on a synthetic instance, and the dated solve summary.
+
+    python main.py [--customers N] [--vehicles V] [--algorithm sa|ga|aco|bf]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--customers", type=int, default=12)
+    ap.add_argument("--vehicles", type=int, default=3)
+    ap.add_argument("--algorithm", default="sa", choices=["sa", "ga", "aco", "bf"])
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from vrpms_tpu.core import travel_duration
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.solvers import (
+        ACOParams,
+        GAParams,
+        SAParams,
+        solve_aco,
+        solve_ga,
+        solve_sa,
+        solve_vrp_bf,
+        solve_info,
+    )
+    from vrpms_tpu.utils import current_date
+
+    # synth_cvrp counts nodes (depot included); +1 turns customers into nodes
+    inst = synth_cvrp(args.customers + 1, args.vehicles, seed=args.seed)
+    print(f"instance: {inst.n_customers} customers, {inst.n_vehicles} vehicles")
+    print(
+        "duration 1 -> 2 departing t=0:   ",
+        float(travel_duration(inst, 1, 2, 0.0)),
+    )
+    print(
+        "duration 1 -> 2 departing t=90:  ",
+        float(travel_duration(inst, 1, 2, 90.0)),
+    )
+
+    if args.algorithm == "sa":
+        res = solve_sa(inst, key=args.seed, params=SAParams(n_chains=128, n_iters=2000))
+    elif args.algorithm == "ga":
+        res = solve_ga(inst, key=args.seed, params=GAParams(population=64, generations=200))
+    elif args.algorithm == "aco":
+        res = solve_aco(inst, key=args.seed, params=ACOParams(n_ants=32, n_iters=100))
+    else:
+        res = solve_vrp_bf(inst)
+
+    info = solve_info(res)
+    print(f"{args.algorithm} solve: cost={float(res.cost):.1f}")
+    print("tour:        ", info["tour"])
+    print("total_time:  ", round(info["total_time"], 1))
+    print("unvisited:   ", info["unvisited"])
+    print("date:        ", info["date"])
+    print("current date:", current_date())
+
+
+if __name__ == "__main__":
+    main()

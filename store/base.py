@@ -64,6 +64,19 @@ class Database:
                 self._warmstart_owner_cache = None
         return self._warmstart_owner_cache
 
+    def _warmstart_warn(self, op: str, exc: Exception) -> None:
+        # Best-effort must not mean silent: a store/schema problem (e.g.
+        # a warmstarts table missing the owner column — see
+        # store/schema.sql) would otherwise disable checkpoints with no
+        # trace at all.
+        import sys
+
+        print(
+            f"[store] warm-start {op} failed ({type(exc).__name__}: {exc}); "
+            "continuing without checkpoint — check store/schema.sql",
+            file=sys.stderr,
+        )
+
     def get_warmstart(self, name) -> dict | None:
         owner = self._warmstart_owner()
         if not owner:
@@ -71,7 +84,8 @@ class Database:
         try:
             row = self._fetch_warmstart(owner, name)
             return None if row is None else row.get("state")
-        except Exception:
+        except Exception as exc:
+            self._warmstart_warn("read", exc)
             return None
 
     def save_warmstart(self, name, state: dict, better_than=None) -> bool:
@@ -87,7 +101,8 @@ class Database:
             return False
         try:
             return self._upsert_warmstart_guarded(owner, name, state, better_than)
-        except Exception:
+        except Exception as exc:
+            self._warmstart_warn("write", exc)
             return False
 
     def _upsert_warmstart_guarded(self, owner, name, state, better_than) -> bool:

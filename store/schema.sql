@@ -1,0 +1,42 @@
+-- Supabase/Postgres schema for the hosted store (store/supabase_store.py).
+--
+-- Tables `locations`, `durations`, and `solutions` mirror the reference's
+-- row shapes exactly (reference api/database.py:28,40,80; see
+-- store/base.py for the column contracts). `warmstarts` is this
+-- framework's extension: best-so-far solve checkpoints keyed by
+-- (owner, name) — owner scoping is REQUIRED, it is what prevents
+-- tenants from reading or clobbering each other's checkpoints through a
+-- shared solutionName. Pair with row-level-security policies matching
+-- the reference's ownership model (reference api/database.py:57-59).
+
+create table if not exists locations (
+  id text primary key,
+  locations jsonb not null
+);
+
+create table if not exists durations (
+  id text primary key,
+  matrix jsonb not null
+);
+
+create table if not exists solutions (
+  id bigint generated always as identity primary key,
+  name text not null,
+  description text,
+  owner text not null,
+  "durationMax" double precision,   -- VRP results
+  "durationSum" double precision,   -- VRP results
+  duration double precision,        -- TSP results
+  locations jsonb,
+  vehicles jsonb,                   -- VRP results
+  vehicle jsonb,                    -- TSP results
+  created_at timestamptz not null default now()
+);
+
+create table if not exists warmstarts (
+  owner text not null,
+  name text not null,
+  state jsonb not null,
+  updated_at timestamptz not null default now(),
+  primary key (owner, name)         -- upsert target: on_conflict="owner,name"
+);

@@ -1,0 +1,62 @@
+"""Duration query, solve summary, and date helper (reference parity).
+
+Covers the three capabilities the reference's local-test path touches
+(reference main.py:1-13): `calculate_duration`-equivalent point queries
+with time-of-day slicing (reference src/solver.py:7), the
+{tour, total_time, unvisited, date} solve summary (reference
+src/solver.py:18-27), and the date stamp format (reference
+src/utilities/helper.py:4-6).
+"""
+
+import re
+
+import numpy as np
+import jax
+
+from vrpms_tpu.core import make_instance, travel_duration
+from vrpms_tpu.solvers import SAParams, solve_sa, solve_info
+from vrpms_tpu.utils import current_date
+
+
+class TestTravelDuration:
+    def test_time_independent_lookup(self, rng):
+        d = rng.uniform(1, 50, size=(6, 6))
+        inst = make_instance(d, n_vehicles=2)
+        assert float(travel_duration(inst, 1, 4)) == np.float32(d[1, 4])
+        # any departure time maps to the single slice
+        assert float(travel_duration(inst, 1, 4, 1e4)) == np.float32(d[1, 4])
+
+    def test_time_of_day_slicing(self, rng):
+        slices = rng.uniform(1, 50, size=(3, 5, 5))
+        inst = make_instance(slices, n_vehicles=1, slice_minutes=60.0)
+        # departing inside slice k uses slice k, cyclically
+        assert float(travel_duration(inst, 2, 3, 0.0)) == np.float32(slices[0, 2, 3])
+        assert float(travel_duration(inst, 2, 3, 61.0)) == np.float32(slices[1, 2, 3])
+        assert float(travel_duration(inst, 2, 3, 2 * 60.0)) == np.float32(slices[2, 2, 3])
+        assert float(travel_duration(inst, 2, 3, 3 * 60.0)) == np.float32(slices[0, 2, 3])
+
+    def test_jittable_with_traced_args(self, rng):
+        d = rng.uniform(1, 50, size=(4, 4))
+        inst = make_instance(d, n_vehicles=1)
+        f = jax.jit(lambda s, t: travel_duration(inst, s, t))
+        assert float(f(1, 2)) == np.float32(d[1, 2])
+
+
+class TestSolveInfo:
+    def test_reference_shape(self, rng):
+        d = rng.uniform(1, 50, size=(7, 7))
+        inst = make_instance(d, demands=rng.uniform(1, 3, 7), capacities=[20.0, 20.0])
+        res = solve_sa(inst, key=0, params=SAParams(n_chains=16, n_iters=200))
+        info = solve_info(res, unvisited=[9, 11])
+        assert set(info) == {"tour", "total_time", "unvisited", "date"}
+        # depot-wrapped flat tour visiting every customer exactly once
+        assert info["tour"][0] == 0 and info["tour"][-1] == 0
+        visited = [n for n in info["tour"] if n != 0]
+        assert sorted(visited) == list(range(1, 7))
+        assert info["unvisited"] == [9, 11]
+        assert info["total_time"] > 0
+        assert re.fullmatch(r"\d{2}-\d{2}-\d{4}", info["date"])
+
+
+def test_current_date_format():
+    assert re.fullmatch(r"\d{2}-\d{2}-\d{4}", current_date())

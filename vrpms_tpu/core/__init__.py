@@ -1,4 +1,4 @@
-from vrpms_tpu.core.instance import Instance, make_instance
+from vrpms_tpu.core.instance import Instance, make_instance, travel_duration
 from vrpms_tpu.core.encoding import (
     giant_length,
     random_giant,

@@ -298,14 +298,12 @@ def objective_batch_mode(
     """
     mode = resolve_eval_mode(mode)
     if mode == "pallas":
-        from vrpms_tpu.kernels.sa_eval import pallas_available, pallas_objective_batch
+        from vrpms_tpu.kernels.sa_eval import pallas_objective_batch, pallas_supported
 
-        if (
-            pallas_available()
-            and _tpu_backend()  # Mosaic lowers on TPU only
-            and not (inst.has_tw or inst.time_dependent)
-            and giants.shape[0] % 128 == 0
-        ):
+        # pallas_supported mirrors every kernel precondition including
+        # the VMEM fit, so oversized instances degrade instead of
+        # failing at Mosaic compile time.
+        if _tpu_backend() and pallas_supported(inst, giants.shape[0]):
             return pallas_objective_batch(giants, inst, w)
         mode = "onehot"
     if mode == "onehot":

@@ -88,6 +88,26 @@ class Instance:
         return self.n_slices > 1
 
 
+def travel_duration(
+    inst: Instance, source, target, depart_time: float = 0.0
+) -> jax.Array:
+    """Point-to-point travel duration, time-of-day slicing honored.
+
+    The real implementation of the reference's duration-query stub
+    (reference src/solver.py:7-15, `calculate_duration(source, target,
+    time_of_day=0)` returning a random 3-320 placeholder): the slice is
+    chosen cyclically from the departure time exactly as the
+    time-dependent cost path does (core.cost._td_eval), so a query and a
+    solve can never disagree. Jittable; indices may be traced.
+    """
+    s = jnp.asarray(source, jnp.int32)
+    t = jnp.asarray(target, jnp.int32)
+    slice_idx = (
+        jnp.asarray(depart_time, jnp.float32) // inst.slice_minutes
+    ).astype(jnp.int32) % inst.n_slices
+    return inst.durations[slice_idx, s, t]
+
+
 def make_instance(
     durations,
     demands=None,

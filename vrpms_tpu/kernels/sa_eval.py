@@ -123,7 +123,7 @@ def eval_tours_homog(gt_ref, d_ref, cap0, wcap, *, chunk):
     d = d_ref[:]
 
     def body(c, carry):
-        dist, excess, cum, lc = carry
+        acc, excess, cum, lc = carry
         start = c * chunk
         rows = gt_ref[pl.ds(start, chunk + 1), :]  # (C+1, T) int32
         # One compare per position; position i is prev for leg i and
@@ -134,9 +134,10 @@ def eval_tours_homog(gt_ref, d_ref, cap0, wcap, *, chunk):
             # X[b, m] = D[node_i(b), m] — exact row selection on the MXU
             # (bf16 inputs, f32 accumulator as Mosaic requires).
             x = jnp.dot(ohs[i], d, preferred_element_type=jnp.float32)
-            dist = dist + jnp.sum(
-                x * ohs[i + 1].astype(jnp.float32), axis=1, keepdims=True
-            )
+            # Leg costs accumulate as one FMA into a wide (T, N̂) buffer;
+            # the lane reduction happens ONCE after the loop instead of
+            # per position (hundreds of VPU reductions saved per tile).
+            acc = acc + x * ohs[i + 1].astype(jnp.float32)
             nd_rows.append(x[:, nhat - 1 : nhat].T)  # demand column
         nd = jnp.concatenate(nd_rows, axis=0)  # (C, T) f32
         z = rows[:chunk] == 0  # (C, T) route-closing depot zeros
@@ -161,13 +162,14 @@ def eval_tours_homog(gt_ref, d_ref, cap0, wcap, *, chunk):
         excess = excess + jnp.sum(contrib, axis=0, keepdims=True)
         cum = cdem[chunk - 1 : chunk]
         lc = jnp.maximum(lc, m[chunk - 1 : chunk])
-        return dist, excess, cum, lc
+        return acc, excess, cum, lc
 
-    zero_col = jnp.zeros((tile_b, 1), jnp.float32)
+    zero_acc = jnp.zeros((tile_b, nhat), jnp.float32)
     zero_row = jnp.zeros((1, tile_b), jnp.float32)
-    dist, excess, cum, lc = jax.lax.fori_loop(
-        0, n_chunks - 1, body, (zero_col, zero_row, zero_row, zero_row)
+    acc, excess, cum, lc = jax.lax.fori_loop(
+        0, n_chunks - 1, body, (zero_acc, zero_row, zero_row, zero_row)
     )
+    dist = jnp.sum(acc, axis=1, keepdims=True)  # the one deferred reduction
     # The loop stops short of the trailing all-depot pad chunk; close any
     # still-open route here.
     excess = excess + jnp.maximum(cum - lc - cap0, 0.0)
@@ -271,9 +273,7 @@ def pad_static(inst: Instance):
     bumped a full lane-tile when N is already a 128 multiple).
     """
     n = inst.n_nodes
-    nhat = _round_up(n, 128)
-    if nhat == n:
-        nhat += 128
+    nhat = _padded_n(n)
     d = jnp.zeros((nhat, nhat), jnp.bfloat16).at[:n, :n].set(
         inst.durations[0].astype(jnp.bfloat16)
     )
@@ -355,12 +355,70 @@ def _homogeneous_capacity(inst: Instance):
     return float(c[0]) if (uniform and nonneg) else None
 
 
+_VMEM_BUDGET = 9 * 2**20  # conservative share of the ~16 MB v5e VMEM
+
+
+def _vmem_estimate(tb, ch, nhat, lhat, het) -> int:
+    """Rough peak VMEM of one kernel tile, in bytes.
+
+    Calibrated against what actually compiles on v5e at N̂=256: 1024/8
+    (~8 MB) fits, 1024/16 and 2048/8 (~12+ MB) crash the compiler.
+    """
+    est = (
+        (ch + 1) * tb * nhat * 2  # bf16 one-hot blocks live across a chunk
+        + 2 * tb * nhat * 4       # x + deferred-reduction acc (f32)
+        + lhat * tb * 4           # the tours block
+        + nhat * nhat * 2         # durations (bf16)
+    )
+    if het:  # general kernel extras: nd scratch, tri matmul, rid
+        est += lhat * tb * 4 + lhat * lhat * 2 + lhat * tb * 4
+    return est
+
+
+def _auto_tile(batch: int, nhat: int, lhat: int, het: bool):
+    """Fastest-measured (tile_b, chunk) that divides the batch AND fits
+    the VMEM model, or None when nothing does (huge-N instances —
+    callers then fall back to the XLA one-hot path).
+
+    Preference order per v5e measurements: 1024/8 > 512/16 > 256/16 >
+    128/16, with /8 variants as smaller-footprint fallbacks.
+    """
+    for tb, ch in (
+        (1024, 8), (512, 16), (512, 8), (256, 16), (256, 8), (128, 16), (128, 8)
+    ):
+        if batch % tb == 0 and _vmem_estimate(tb, ch, nhat, lhat, het) <= _VMEM_BUDGET:
+            return tb, ch
+    return None
+
+
+def _padded_n(n: int) -> int:
+    nhat = _round_up(n, 128)
+    return nhat + 128 if nhat == n else nhat
+
+
+def pallas_supported(inst: Instance, batch: int) -> bool:
+    """Can pallas_objective_batch handle this instance/batch? Mirrors
+    every precondition the kernel raises on, including the VMEM fit, so
+    dispatchers can fall back to XLA instead of failing at compile."""
+    if not _PALLAS_OK or inst.has_tw or inst.time_dependent:
+        return False
+    if batch % 128:
+        return False
+    length = inst.n_customers + inst.n_vehicles + 1
+    het = _homogeneous_capacity(inst) is None
+    # lhat depends on the chunk chosen; bound it by the largest pad
+    return (
+        _auto_tile(batch, _padded_n(inst.n_nodes), length + 2 * 16, het)
+        is not None
+    )
+
+
 def pallas_objective_batch(
     giants: jax.Array,
     inst: Instance,
     w: CostWeights,
-    tile_b: int = 128,
-    chunk: int = 16,  # 16 measured ~15% faster than 8 on v5e; 32 is equal
+    tile_b: int | None = None,
+    chunk: int | None = None,
     transposed: bool = False,
     interpret: bool = False,
 ) -> jax.Array:
@@ -368,15 +426,27 @@ def pallas_objective_batch(
 
     giants: (B, L) int32 — or (L, B) with transposed=True to skip the
     relayout when the caller keeps SA state in kernel layout. B must be
-    a multiple of tile_b (solvers size their chain batches accordingly);
-    tile_b must be a multiple of 128 (the TPU lane width — Mosaic
-    requires minor block dims of 128).
+    a multiple of 128 (the TPU lane width — Mosaic requires minor block
+    dims of 128); tile_b/chunk default to the measured-best choice for
+    the batch size.
     """
     if not _PALLAS_OK:
         raise RuntimeError("pallas unavailable in this environment")
     if inst.has_tw or inst.time_dependent:
         raise ValueError("pallas objective covers the untimed fast path only")
     gt = giants if transposed else giants.T
+    if tile_b is None or chunk is None:
+        cap0_known = _homogeneous_capacity(inst) is not None
+        auto = _auto_tile(
+            gt.shape[1], _padded_n(inst.n_nodes), gt.shape[0] + 2 * 16,
+            het=not cap0_known,
+        )
+        if auto is None:
+            raise ValueError(
+                f"no pallas tile fits VMEM for batch {gt.shape[1]}, "
+                f"{inst.n_nodes} nodes (use the XLA one-hot path)"
+            )
+        tile_b, chunk = tile_b or auto[0], chunk or auto[1]
     lhat = padded_length(gt.shape[0], chunk)
     if gt.shape[1] % tile_b:
         raise ValueError(f"batch {gt.shape[1]} not a multiple of tile_b {tile_b}")

@@ -1,4 +1,4 @@
-from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
+from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn, solve_info
 from vrpms_tpu.solvers.bf import solve_tsp_bf, solve_vrp_bf
 from vrpms_tpu.solvers.local_search import (
     nearest_neighbor_perm,

@@ -24,6 +24,32 @@ class SolveResult(NamedTuple):
     evals: jax.Array          # candidate evaluations performed (throughput metric)
 
 
+def solve_info(res: SolveResult, unvisited: list | None = None) -> dict:
+    """Reference-shaped solve summary: {tour, total_time, unvisited, date}.
+
+    The reference's solver entry returns exactly these keys with
+    placeholder values (reference src/solver.py:18-27: a random depot-
+    wrapped shuffle, constant total_time, empty unvisited, dated via
+    src/utilities/helper.py). Here they are real: the winning giant tour
+    flattened to one depot-wrapped node list, the summed route durations,
+    and the customers excluded from this solve (the dynamic re-solve
+    inputs — SURVEY.md §5 checkpoint/resume).
+    """
+    from vrpms_tpu.core.encoding import routes_from_giant
+    from vrpms_tpu.utils import current_date
+
+    tour = [0]
+    for route in routes_from_giant(res.giant):
+        tour.extend(route)
+        tour.append(0)
+    return {
+        "tour": tour,
+        "total_time": float(jnp.asarray(res.breakdown.duration_sum)),
+        "unvisited": list(unvisited or []),
+        "date": current_date(),
+    }
+
+
 def perm_fitness_fn(inst: Instance, w: CostWeights, fleet_penalty: float = 1_000.0):
     """Batched fitness for permutation genomes (GA population, ACO ants).
 

@@ -1,0 +1,16 @@
+"""Small host-side utilities.
+
+The reference keeps a date helper as its only utility (reference
+src/utilities/helper.py:4-6, `get_current_date()` -> '%d-%m-%Y'); the
+same stamp is attached to solve summaries here (see
+vrpms_tpu.solvers.common.solve_info).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+
+
+def current_date() -> str:
+    """Today as 'DD-MM-YYYY' (reference src/utilities/helper.py:4-6)."""
+    return datetime.now().strftime("%d-%m-%Y")
