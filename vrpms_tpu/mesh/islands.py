@@ -29,7 +29,7 @@ devices makes ppermute ride DCN across slice boundaries transparently.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -98,46 +98,32 @@ def _blocked_schedule(total: int, block: int):
     return total // block, total % block
 
 
-def solve_sa_islands(
-    inst: Instance,
-    key: jax.Array | int = 0,
-    mesh: Mesh | None = None,
-    params: SAParams = SAParams(),
-    island_params: IslandParams = IslandParams(),
-    weights: CostWeights | None = None,
-    mode: str = "auto",
-) -> SolveResult:
-    """SA with per-device chain batches + ring elite migration."""
-    w = weights or CostWeights.make()
-    mode = resolve_eval_mode(mode)
-    if isinstance(key, int):
-        key = jax.random.key(key)
-    mesh = mesh or make_mesh()
+@lru_cache(maxsize=64)
+def _sa_islands_fn(mesh: Mesh, n_iters: int, island_params: IslandParams, mode: str):
+    """Build (and cache) the jitted sharded SA run for one configuration.
+
+    Cached on the hashable statics — Mesh, n_iters, migration schedule,
+    eval mode — so repeated solves reuse the compile; instance data,
+    temperatures, and keys stay dynamic arguments (keying on the full
+    SAParams would recompile whenever t_initial/t_final change, which
+    the trace never sees). A per-call jit(shard_map(...)) closure would
+    recompile every request.
+    """
     n_isl = mesh.shape["islands"]
-    chains_local = max(
-        -(-params.n_chains // n_isl), island_params.n_migrants + 1
-    )
-    t0, t1 = _auto_temps(inst, params)
-    n_iters = params.n_iters
     block_len = island_params.migrate_every
     n_blocks, tail = _blocked_schedule(n_iters, block_len)
     k_mig = island_params.n_migrants
 
-    k_init, k_run = jax.random.split(key)
-    giants0 = random_giant_batch(
-        k_init, n_isl * chains_local, inst.n_customers, inst.n_vehicles
-    )
-
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P("islands"),),
+        in_specs=(P("islands"), P(), P(), P(), P(), P()),
         out_specs=(P("islands"), P("islands")),
         # Library scans (split/cost kernels) carry unvarying literals;
         # skip the VMA replication checker rather than pvary them all.
         check_vma=False,
     )
-    def run(giants):
+    def run(giants, k_run, inst, w, t0, t1):
         isl = jax.lax.axis_index("islands")
         k_isl = jax.random.fold_in(k_run, isl)
         costs = objective_batch_mode(giants, inst, w, mode)
@@ -170,7 +156,40 @@ def solve_sa_islands(
         champ = jnp.argmin(best_c)
         return best_g[champ][None], best_c[champ][None]
 
-    g_all, c_all = jax.jit(run)(giants0)
+    return jax.jit(run)
+
+
+def solve_sa_islands(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    mesh: Mesh | None = None,
+    params: SAParams = SAParams(),
+    island_params: IslandParams = IslandParams(),
+    weights: CostWeights | None = None,
+    mode: str = "auto",
+) -> SolveResult:
+    """SA with per-device chain batches + ring elite migration."""
+    w = weights or CostWeights.make()
+    mode = resolve_eval_mode(mode)
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    mesh = mesh or make_mesh()
+    n_isl = mesh.shape["islands"]
+    chains_local = max(
+        -(-params.n_chains // n_isl), island_params.n_migrants + 1
+    )
+    t0, t1 = _auto_temps(inst, params)
+    n_iters = params.n_iters
+
+    k_init, k_run = jax.random.split(key)
+    giants0 = random_giant_batch(
+        k_init, n_isl * chains_local, inst.n_customers, inst.n_vehicles
+    )
+
+    run = _sa_islands_fn(mesh, n_iters, island_params, mode)
+    g_all, c_all = run(
+        giants0, k_run, inst, w, jnp.float32(t0), jnp.float32(t1)
+    )
     g, c = _pick_champion(g_all, c_all)
     bd = evaluate_giant(g, inst)
     return SolveResult(
@@ -181,42 +200,26 @@ def solve_sa_islands(
     )
 
 
-def solve_ga_islands(
-    inst: Instance,
-    key: jax.Array | int = 0,
-    mesh: Mesh | None = None,
-    params: GAParams = GAParams(),
-    island_params: IslandParams = IslandParams(),
-    weights: CostWeights | None = None,
-) -> SolveResult:
-    """GA with per-device sub-populations + ring elite migration."""
-    w = weights or CostWeights.make()
-    if isinstance(key, int):
-        key = jax.random.key(key)
-    mesh = mesh or make_mesh()
+@lru_cache(maxsize=64)
+def _ga_islands_fn(
+    mesh: Mesh, local_params: GAParams, island_params: IslandParams
+):
+    """Build (and cache) the jitted sharded GA run (see _sa_islands_fn)."""
     n_isl = mesh.shape["islands"]
-    pop_local = max(
-        -(-params.population // n_isl),
-        max(params.elites, island_params.n_migrants) + 1,
-    )
-    local_params = dataclasses.replace(params, population=pop_local)
-    generations = params.generations
+    generations = local_params.generations
     block_len = island_params.migrate_every
     n_blocks, tail = _blocked_schedule(generations, block_len)
     k_mig = island_params.n_migrants
-    fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
-
-    k_init, k_run = jax.random.split(key)
-    perms0 = _random_perms(k_init, n_isl * pop_local, inst.n_customers)
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P("islands"),),
+        in_specs=(P("islands"), P(), P(), P()),
         out_specs=(P("islands"), P("islands")),
         check_vma=False,
     )
-    def run(perms):
+    def run(perms, k_run, inst, w):
+        fitness = perm_fitness_fn(inst, w, local_params.fleet_penalty)
         isl = jax.lax.axis_index("islands")
         k_isl = jax.random.fold_in(k_run, isl)
         fits = fitness(perms)
@@ -250,7 +253,35 @@ def solve_ga_islands(
         _, _, best_p, best_f = state
         return best_p[None], best_f[None]
 
-    p_all, f_all = jax.jit(run)(perms0)
+    return jax.jit(run)
+
+
+def solve_ga_islands(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    mesh: Mesh | None = None,
+    params: GAParams = GAParams(),
+    island_params: IslandParams = IslandParams(),
+    weights: CostWeights | None = None,
+) -> SolveResult:
+    """GA with per-device sub-populations + ring elite migration."""
+    w = weights or CostWeights.make()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    mesh = mesh or make_mesh()
+    n_isl = mesh.shape["islands"]
+    pop_local = max(
+        -(-params.population // n_isl),
+        max(params.elites, island_params.n_migrants) + 1,
+    )
+    local_params = dataclasses.replace(params, population=pop_local)
+    generations = params.generations
+
+    k_init, k_run = jax.random.split(key)
+    perms0 = _random_perms(k_init, n_isl * pop_local, inst.n_customers)
+
+    run = _ga_islands_fn(mesh, local_params, island_params)
+    p_all, f_all = run(perms0, k_run, inst, w)
     best_perm, _ = _pick_champion(p_all, f_all)
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)

@@ -16,6 +16,7 @@ ant's split route edges (depot hops included), with tau clipping to
 from __future__ import annotations
 
 import dataclasses
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -66,15 +67,20 @@ def _deposit_edges(giant):
     return giant[:-1], giant[1:]
 
 
-def solve_aco(
-    inst: Instance,
-    key: jax.Array | int = 0,
-    params: ACOParams = ACOParams(),
-    weights: CostWeights | None = None,
-) -> SolveResult:
-    w = weights or CostWeights.make()
-    if isinstance(key, int):
-        key = jax.random.key(key)
+@lru_cache(maxsize=32)
+def _aco_run_fn(params: ACOParams):
+    """Build (and cache) the jitted colony loop for one parameter set
+    (see _sa_run_fn's rationale: cross-request compile reuse with
+    bounded retention of request-controlled configurations)."""
+
+    @jax.jit
+    def run(key, inst, w):
+        return _aco_body(key, inst, w, params)
+
+    return run
+
+
+def _aco_body(key, inst, w, params: ACOParams):
     n_nodes = inst.n_nodes
     n = inst.n_customers
     fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
@@ -89,41 +95,50 @@ def solve_aco(
     alpha = params.alpha
     rho = params.rho
 
-    @jax.jit
-    def run(key):
-        tau = jnp.full((n_nodes, n_nodes), tau0)
-        best_perm = jnp.arange(1, n + 1, dtype=jnp.int32)
-        best_fit = fitness(best_perm[None])[0]
+    tau = jnp.full((n_nodes, n_nodes), tau0)
+    best_perm = jnp.arange(1, n + 1, dtype=jnp.int32)
+    best_fit = fitness(best_perm[None])[0]
 
-        def iteration(state, it):
-            tau, best_perm, best_fit = state
-            k_it = jax.random.fold_in(key, it)
-            orders = _construct_orders(k_it, tau ** alpha, eta, params.n_ants)
-            fits = fitness(orders)
-            champ = jnp.argmin(fits)
-            it_best_perm, it_best_fit = orders[champ], fits[champ]
-            better = it_best_fit < best_fit
-            best_perm = jnp.where(better, it_best_perm, best_perm)
-            best_fit = jnp.where(better, it_best_fit, best_fit)
-            # Evaporate, then deposit along the iteration-best ant's actual
-            # split route (depot hops included) scaled by solution quality.
-            giant = greedy_split_giant(it_best_perm, inst)
-            src, dst = _deposit_edges(giant)
-            amount = 1.0 / jnp.maximum(it_best_fit, 1e-6)
-            tau = (1.0 - rho) * tau
-            tau = tau.at[src, dst].add(amount)
-            # MMAS-style trail limits keep exploration alive.
-            tau_max = 1.0 / (rho * jnp.maximum(best_fit, 1e-6))
-            tau_min = tau_max / (2.0 * n_nodes)
-            tau = jnp.clip(tau, tau_min, tau_max)
-            return (tau, best_perm, best_fit), None
+    def iteration(state, it):
+        tau, best_perm, best_fit = state
+        k_it = jax.random.fold_in(key, it)
+        orders = _construct_orders(k_it, tau ** alpha, eta, params.n_ants)
+        fits = fitness(orders)
+        champ = jnp.argmin(fits)
+        it_best_perm, it_best_fit = orders[champ], fits[champ]
+        better = it_best_fit < best_fit
+        best_perm = jnp.where(better, it_best_perm, best_perm)
+        best_fit = jnp.where(better, it_best_fit, best_fit)
+        # Evaporate, then deposit along the iteration-best ant's actual
+        # split route (depot hops included) scaled by solution quality.
+        giant = greedy_split_giant(it_best_perm, inst)
+        src, dst = _deposit_edges(giant)
+        amount = 1.0 / jnp.maximum(it_best_fit, 1e-6)
+        tau = (1.0 - rho) * tau
+        tau = tau.at[src, dst].add(amount)
+        # MMAS-style trail limits keep exploration alive.
+        tau_max = 1.0 / (rho * jnp.maximum(best_fit, 1e-6))
+        tau_min = tau_max / (2.0 * n_nodes)
+        tau = jnp.clip(tau, tau_min, tau_max)
+        return (tau, best_perm, best_fit), None
 
-        (tau, best_perm, best_fit), _ = jax.lax.scan(
-            iteration, (tau, best_perm, best_fit), jnp.arange(params.n_iters)
-        )
-        return best_perm, best_fit
+    (tau, best_perm, best_fit), _ = jax.lax.scan(
+        iteration, (tau, best_perm, best_fit), jnp.arange(params.n_iters)
+    )
+    return best_perm, best_fit
 
-    best_perm, _ = run(key)
+
+def solve_aco(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    params: ACOParams = ACOParams(),
+    weights: CostWeights | None = None,
+) -> SolveResult:
+    w = weights or CostWeights.make()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+
+    best_perm, _ = _aco_run_fn(params)(key, inst, w)
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)
     return SolveResult(

@@ -15,6 +15,7 @@ VRP: all n! orders, each priced by the bounded-fleet optimal split
 from __future__ import annotations
 
 import math
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -86,28 +87,68 @@ def _check_size(inst: Instance):
     return n
 
 
+def _giant_of(idx, inst: Instance, n: int):
+    perm = _perm_from_index(idx, n) + 1
+    zeros = jnp.zeros(inst.n_vehicles, dtype=jnp.int32)
+    return jnp.concatenate([jnp.zeros(1, jnp.int32), perm, zeros])
+
+
+@lru_cache(maxsize=MAX_BF_CUSTOMERS + 1)
+def _tsp_bf_run_fn(n: int):
+    """Build (and cache) the jitted enumeration; the compile caches
+    across solves (a per-call jit(lambda) would recompile per request).
+    n is bounded by MAX_BF_CUSTOMERS, so the cache covers every size."""
+
+    @jax.jit
+    def run(inst, w):
+        def score(idx_batch):
+            giants = jax.vmap(lambda i: _giant_of(i, inst, n))(idx_batch)
+            return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
+
+        return _enumerate_min(math.factorial(n), score, n)
+
+    return run
+
+
 def solve_tsp_bf(inst: Instance, weights: CostWeights | None = None) -> SolveResult:
     """Exact TSP by full enumeration (single vehicle assumed)."""
     n = _check_size(inst)
     w = weights or CostWeights.make()
     n_perms = math.factorial(n)
-    v = inst.n_vehicles
-    length = giant_length(n, v)
+    length = giant_length(n, inst.n_vehicles)
 
-    def giant_of(idx):
-        perm = _perm_from_index(idx, n) + 1
-        zeros = jnp.zeros(v, dtype=jnp.int32)
-        return jnp.concatenate([jnp.zeros(1, jnp.int32), perm, zeros])
-
-    def score(idx_batch):
-        giants = jax.vmap(giant_of)(idx_batch)
-        return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
-
-    best_idx, _ = jax.jit(lambda: _enumerate_min(n_perms, score, n))()
-    giant = giant_of(best_idx)
+    best_idx, _ = _tsp_bf_run_fn(n)(inst, w)
+    giant = _giant_of(best_idx, inst, n)
     assert giant.shape == (length,)
     bd = evaluate_giant(giant, inst)
     return SolveResult(giant, total_cost(bd, w), bd, jnp.int32(n_perms))
+
+
+@lru_cache(maxsize=MAX_BF_CUSTOMERS + 1)
+def _vrp_bf_run_fn(n: int):
+    """Build (and cache) the jitted enumeration (see _tsp_bf_run_fn).
+    The timed-vs-plain dispatch keys off static Instance metadata, so
+    each variant compiles once."""
+
+    @jax.jit
+    def run(inst, w):
+        timed = inst.has_tw or inst.time_dependent
+
+        def perm_of(idx):
+            return _perm_from_index(idx, n) + 1
+
+        if timed:
+            def score(idx_batch):
+                giants = jax.vmap(lambda i: greedy_split_giant(perm_of(i), inst))(idx_batch)
+                return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
+        else:
+            def score(idx_batch):
+                perms = jax.vmap(perm_of)(idx_batch)
+                return jax.vmap(lambda p: optimal_split_cost(p, inst))(perms)
+
+        return _enumerate_min(math.factorial(n), score, n)
+
+    return run
 
 
 def solve_vrp_bf(inst: Instance, weights: CostWeights | None = None) -> SolveResult:
@@ -122,20 +163,8 @@ def solve_vrp_bf(inst: Instance, weights: CostWeights | None = None) -> SolveRes
     n_perms = math.factorial(n)
     timed = inst.has_tw or inst.time_dependent
 
-    def perm_of(idx):
-        return _perm_from_index(idx, n) + 1
-
-    if timed:
-        def score(idx_batch):
-            giants = jax.vmap(lambda i: greedy_split_giant(perm_of(i), inst))(idx_batch)
-            return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
-    else:
-        def score(idx_batch):
-            perms = jax.vmap(perm_of)(idx_batch)
-            return jax.vmap(lambda p: optimal_split_cost(p, inst))(perms)
-
-    best_idx, _ = jax.jit(lambda: _enumerate_min(n_perms, score, n))()
-    perm = perm_of(best_idx)
+    best_idx, _ = _vrp_bf_run_fn(n)(inst, w)
+    perm = _perm_from_index(best_idx, n) + 1
     if timed:
         giant = greedy_split_giant(perm, inst)
     else:

@@ -26,6 +26,7 @@ on by vrpms_tpu.mesh (ring elite migration), not inside this module.
 from __future__ import annotations
 
 import dataclasses
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -123,24 +124,19 @@ def ga_generation(perms, fits, key, gen, fitness, params: GAParams):
     return children, new_fits
 
 
-def solve_ga(
-    inst: Instance,
-    key: jax.Array | int = 0,
-    params: GAParams = GAParams(),
-    weights: CostWeights | None = None,
-    init_perms: jax.Array | None = None,
-) -> SolveResult:
-    w = weights or CostWeights.make()
-    if isinstance(key, int):
-        key = jax.random.key(key)
-    n = inst.n_customers
-    pop = params.population
-    fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
-    k_init, k_run = jax.random.split(key)
-    perms0 = _random_perms(k_init, pop, n) if init_perms is None else init_perms
+@lru_cache(maxsize=32)
+def _ga_run_fn(params: GAParams):
+    """Build (and cache) the jitted GA loop for one parameter set.
+
+    Hoisted to module level so the compile caches across solves (an
+    inner @jax.jit closure would recompile on every service request);
+    bounded lru_cache so request-controlled GAParams can't pin compiled
+    executables without limit. GAParams is frozen, hence hashable.
+    """
 
     @jax.jit
-    def run(perms, key):
+    def run(perms, key, inst, w):
+        fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
         fits = fitness(perms)
 
         def step(state, gen):
@@ -159,7 +155,25 @@ def solve_ga(
         )
         return best_p, best_f
 
-    best_perm, _ = run(perms0, k_run)
+    return run
+
+
+def solve_ga(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    params: GAParams = GAParams(),
+    weights: CostWeights | None = None,
+    init_perms: jax.Array | None = None,
+) -> SolveResult:
+    w = weights or CostWeights.make()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    n = inst.n_customers
+    pop = params.population
+    k_init, k_run = jax.random.split(key)
+    perms0 = _random_perms(k_init, pop, n) if init_perms is None else init_perms
+
+    best_perm, _ = _ga_run_fn(params)(perms0, k_run, inst, w)
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)
     return SolveResult(

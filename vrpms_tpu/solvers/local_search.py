@@ -14,6 +14,8 @@ and as a VRP improver (moves across separators reassign vehicles).
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 
@@ -76,14 +78,19 @@ def _apply_move(giant, move):
     )
 
 
-def local_search(
-    giant: jax.Array,
-    inst: Instance,
-    weights: CostWeights | None = None,
-    max_sweeps: int = 256,
-) -> SolveResult:
-    """Steepest-descent to a local optimum of the full move neighborhood."""
-    w = weights or CostWeights.make()
+@lru_cache(maxsize=32)
+def _ls_run_fn(max_sweeps: int):
+    """Build (and cache) the jitted steepest descent; compile caches
+    across calls with bounded retention (see sa._sa_run_fn rationale)."""
+
+    @jax.jit
+    def run(giant, inst, w):
+        return _ls_body(giant, inst, w, max_sweeps)
+
+    return run
+
+
+def _ls_body(giant, inst, w, max_sweeps):
     length = giant.shape[0]
     cands, valid = _candidate_moves(length)
     n_cands = cands.shape[0]
@@ -106,14 +113,21 @@ def local_search(
         cur_cost = jnp.where(better, costs[k], cur_cost)
         return g, cur_cost, better, sweeps + 1, evals + n_cands
 
-    @jax.jit
-    def run(g0):
-        c0 = total_cost(evaluate_giant(g0, inst), w)
-        state = (g0, c0, jnp.bool_(True), jnp.int32(0), jnp.int32(0))
-        g, c, _, _, evals = jax.lax.while_loop(cond, body, state)
-        return g, c, evals
+    c0 = total_cost(evaluate_giant(giant, inst), w)
+    state = (giant, c0, jnp.bool_(True), jnp.int32(0), jnp.int32(0))
+    g, c, _, _, evals = jax.lax.while_loop(cond, body, state)
+    return g, c, evals
 
-    g, c, evals = run(giant)
+
+def local_search(
+    giant: jax.Array,
+    inst: Instance,
+    weights: CostWeights | None = None,
+    max_sweeps: int = 256,
+) -> SolveResult:
+    """Steepest-descent to a local optimum of the full move neighborhood."""
+    w = weights or CostWeights.make()
+    g, c, evals = _ls_run_fn(max_sweeps)(giant, inst, w)
     bd = evaluate_giant(g, inst)
     return SolveResult(g, c, bd, evals)
 

@@ -17,6 +17,7 @@ analog for a functional runtime).
 from __future__ import annotations
 
 import dataclasses
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -76,6 +77,46 @@ def sa_chain_step(giants, costs, key, it, t0, t1, n_iters, inst, w, mode="auto")
     return giants, costs
 
 
+@lru_cache(maxsize=32)
+def _sa_run_fn(n_iters: int, mode: str):
+    """Build (and cache) the jitted anneal for one static configuration.
+
+    Hoisted to module level so the compile caches across solves — a
+    `@jax.jit` defined inside solve_sa would be a fresh function object
+    per call, recompiling on every service request (tens of seconds of
+    latency for a cached-size problem). The bounded lru_cache (rather
+    than a bare jitted function with static_argnames) matters in a
+    long-running service: request bodies control n_iters, and jit's own
+    cache is unbounded, so eviction here is what frees stale compiled
+    executables. Temperatures arrive as dynamic scalars so retuning them
+    never recompiles; only shapes, n_iters, and mode specialize a trace.
+    """
+
+    @jax.jit
+    def run(giants, key, inst, w, t0, t1):
+        costs = objective_batch_mode(giants, inst, w, mode)
+        best_g, best_c = giants, costs
+
+        def step(state, it):
+            giants, costs, best_g, best_c = state
+            giants, costs = sa_chain_step(
+                giants, costs, key, it, t0, t1, n_iters, inst, w, mode
+            )
+            better = costs < best_c
+            best_g = jnp.where(better[:, None], giants, best_g)
+            best_c = jnp.where(better, costs, best_c)
+            return (giants, costs, best_g, best_c), None
+
+        state, _ = jax.lax.scan(
+            step, (giants, costs, best_g, best_c), jnp.arange(n_iters)
+        )
+        _, _, best_g, best_c = state
+        champ = jnp.argmin(best_c)
+        return best_g[champ], best_c[champ]
+
+    return run
+
+
 def solve_sa(
     inst: Instance,
     key: jax.Array | int = 0,
@@ -99,29 +140,9 @@ def solve_sa(
         giants = init_giants
     n_iters = params.n_iters
 
-    @jax.jit
-    def run(giants, key):
-        costs = objective_batch_mode(giants, inst, w, mode)
-        best_g, best_c = giants, costs
-
-        def step(state, it):
-            giants, costs, best_g, best_c = state
-            giants, costs = sa_chain_step(
-                giants, costs, key, it, t0, t1, n_iters, inst, w, mode
-            )
-            better = costs < best_c
-            best_g = jnp.where(better[:, None], giants, best_g)
-            best_c = jnp.where(better, costs, best_c)
-            return (giants, costs, best_g, best_c), None
-
-        state, _ = jax.lax.scan(
-            step, (giants, costs, best_g, best_c), jnp.arange(n_iters)
-        )
-        _, _, best_g, best_c = state
-        champ = jnp.argmin(best_c)
-        return best_g[champ], best_c[champ]
-
-    g, c = run(giants, k_run)
+    g, c = _sa_run_fn(n_iters, mode)(
+        giants, k_run, inst, w, jnp.float32(t0), jnp.float32(t1)
+    )
     bd = evaluate_giant(g, inst)
     # evals from the actual batch (init_giants may differ from n_chains)
     return SolveResult(g, total_cost(bd, w), bd, jnp.int32(giants.shape[0] * n_iters))
