@@ -40,11 +40,14 @@ def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
     """routes/sec of the compiled SA sweep on `device` (compile excluded)."""
     from vrpms_tpu.core.cost import CostWeights, objective_batch_mode
     from vrpms_tpu.core.encoding import random_giant_batch
+    from vrpms_tpu.moves import knn_table
     from vrpms_tpu.solvers.sa import _auto_temps, sa_chain_step, SAParams
 
     w = CostWeights.make()
     t0, t1 = _auto_temps(inst, SAParams())
+    knn = knn_table(inst.durations[0], SAParams().knn_k)
     inst = jax.device_put(inst, device)
+    knn = jax.device_put(knn, device)
     # fused pallas kernel on any accelerator, flat-gather on CPU
     # (core.cost.resolve_eval_mode rationale; 'axon' aliases tpu here)
     mode = "gather" if device.platform == "cpu" else "pallas"
@@ -53,7 +56,7 @@ def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
         def body(state, i):
             giants, costs = state
             return sa_chain_step(
-                giants, costs, key, start + i, t0, t1, n_iters, inst, w, mode
+                giants, costs, key, start + i, t0, t1, n_iters, inst, w, mode, knn
             ), None
 
         (giants, costs), _ = jax.lax.scan(

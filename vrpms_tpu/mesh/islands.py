@@ -46,6 +46,7 @@ from vrpms_tpu.core.cost import (
 from vrpms_tpu.core.encoding import random_giant_batch
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
+from vrpms_tpu.moves import knn_table
 from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
 from vrpms_tpu.solvers.ga import GAParams, ga_generation, _random_perms
 from vrpms_tpu.solvers.sa import SAParams, _auto_temps, sa_chain_step
@@ -117,13 +118,13 @@ def _sa_islands_fn(mesh: Mesh, n_iters: int, island_params: IslandParams, mode: 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P("islands"), P(), P(), P(), P(), P()),
+        in_specs=(P("islands"), P(), P(), P(), P(), P(), P()),
         out_specs=(P("islands"), P("islands")),
         # Library scans (split/cost kernels) carry unvarying literals;
         # skip the VMA replication checker rather than pvary them all.
         check_vma=False,
     )
-    def run(giants, k_run, inst, w, t0, t1):
+    def run(giants, k_run, inst, w, t0, t1, knn):
         isl = jax.lax.axis_index("islands")
         k_isl = jax.random.fold_in(k_run, isl)
         costs = objective_batch_mode(giants, inst, w, mode)
@@ -131,7 +132,7 @@ def _sa_islands_fn(mesh: Mesh, n_iters: int, island_params: IslandParams, mode: 
         def inner(st, it):
             giants, costs, best_g, best_c = st
             giants, costs = sa_chain_step(
-                giants, costs, k_isl, it, t0, t1, n_iters, inst, w, mode
+                giants, costs, k_isl, it, t0, t1, n_iters, inst, w, mode, knn
             )
             better = costs < best_c
             best_g = jnp.where(better[:, None], giants, best_g)
@@ -186,9 +187,10 @@ def solve_sa_islands(
         k_init, n_isl * chains_local, inst.n_customers, inst.n_vehicles
     )
 
+    knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
     run = _sa_islands_fn(mesh, n_iters, island_params, mode)
     g_all, c_all = run(
-        giants0, k_run, inst, w, jnp.float32(t0), jnp.float32(t1)
+        giants0, k_run, inst, w, jnp.float32(t0), jnp.float32(t1), knn
     )
     g, c = _pick_champion(g_all, c_all)
     bd = evaluate_giant(g, inst)

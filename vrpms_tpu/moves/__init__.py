@@ -6,5 +6,8 @@ from vrpms_tpu.moves.moves import (
     random_src_map,
     apply_src_map,
     random_move_batch,
+    knn_table,
+    knn_src_map,
+    knn_move_batch,
     N_MOVE_TYPES,
 )

@@ -31,7 +31,7 @@ from vrpms_tpu.core.cost import (
 )
 from vrpms_tpu.core.encoding import random_giant_batch
 from vrpms_tpu.core.instance import Instance
-from vrpms_tpu.moves import random_move_batch
+from vrpms_tpu.moves import knn_move_batch, knn_table, random_move_batch
 from vrpms_tpu.solvers.common import SolveResult
 
 
@@ -41,6 +41,7 @@ class SAParams:
     n_iters: int = 20_000
     t_initial: float | None = None  # None: scaled from mean duration
     t_final: float | None = None
+    knn_k: int = 16  # candidate-list width for proposals; 0 = uniform
 
 
 def _auto_temps(inst: Instance, params: SAParams) -> tuple[float, float]:
@@ -50,15 +51,20 @@ def _auto_temps(inst: Instance, params: SAParams) -> tuple[float, float]:
     return float(t0), float(t1)
 
 
-def sa_chain_step(giants, costs, key, it, t0, t1, n_iters, inst, w, mode="auto"):
+def sa_chain_step(
+    giants, costs, key, it, t0, t1, n_iters, inst, w, mode="auto", knn=None
+):
     """One Metropolis sweep of every chain; the flagship compiled step.
 
     Exposed standalone (not just inside solve_sa's scan) so the graft
     entry point and the island-model driver can reuse the exact same
     step function. `mode` picks the hot-path formulation (see
-    core.cost.resolve_eval_mode): 'onehot' keeps the proposal-apply and
-    objective on the MXU (no elementwise gathers — the TPU profile shows
-    those lower to a ~140M elem/s scalar loop), 'gather' is the CPU path.
+    core.cost.resolve_eval_mode): 'onehot'/'pallas' keep the
+    proposal-apply and objective on the MXU (no elementwise gathers —
+    the TPU profile shows those lower to a ~140M elem/s scalar loop),
+    'gather' is the CPU path. With a `knn` candidate table, the second
+    move endpoint is sampled from the current node's nearest neighbors
+    instead of uniformly (moves.knn_table rationale).
     """
     mode = resolve_eval_mode(mode)
     b = giants.shape[0]
@@ -66,7 +72,10 @@ def sa_chain_step(giants, costs, key, it, t0, t1, n_iters, inst, w, mode="auto")
     temp = t0 * (t1 / t0) ** frac
     k_it = jax.random.fold_in(key, it)
     k_moves, k_accept = jax.random.split(k_it)
-    cands = random_move_batch(k_moves, giants, mode=mode)
+    if knn is not None:
+        cands = knn_move_batch(k_moves, giants, knn, mode=mode)
+    else:
+        cands = random_move_batch(k_moves, giants, mode=mode)
     cand_costs = objective_batch_mode(cands, inst, w, mode)
     u = jax.random.uniform(k_accept, (b,))
     accept = (cand_costs < costs) | (
@@ -93,14 +102,14 @@ def _sa_run_fn(n_iters: int, mode: str):
     """
 
     @jax.jit
-    def run(giants, key, inst, w, t0, t1):
+    def run(giants, key, inst, w, t0, t1, knn):
         costs = objective_batch_mode(giants, inst, w, mode)
         best_g, best_c = giants, costs
 
         def step(state, it):
             giants, costs, best_g, best_c = state
             giants, costs = sa_chain_step(
-                giants, costs, key, it, t0, t1, n_iters, inst, w, mode
+                giants, costs, key, it, t0, t1, n_iters, inst, w, mode, knn
             )
             better = costs < best_c
             best_g = jnp.where(better[:, None], giants, best_g)
@@ -140,8 +149,11 @@ def solve_sa(
         giants = init_giants
     n_iters = params.n_iters
 
+    # solve_sa requires a concrete instance (_auto_temps above already
+    # forced durations to a value), so the table can always be built.
+    knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
     g, c = _sa_run_fn(n_iters, mode)(
-        giants, k_run, inst, w, jnp.float32(t0), jnp.float32(t1)
+        giants, k_run, inst, w, jnp.float32(t0), jnp.float32(t1), knn
     )
     bd = evaluate_giant(g, inst)
     # evals from the actual batch (init_giants may differ from n_chains)
