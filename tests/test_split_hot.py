@@ -1,0 +1,104 @@
+"""CPU parity for the accelerator (one-hot) GA/ACO fitness paths.
+
+The TPU/GPU default fitness is greedy_split_cost_hot_batch — one-hot leg
+selection plus pointer-doubling route boundaries — and the hot ACO
+construction scores via one-hot matmuls. CI runs on CPU where 'auto'
+resolves to 'gather', so these tests force the hot formulations and pin
+them against the scan/gather versions (the same strategy
+tests/test_onehot.py uses for the giant-tour paths).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core.cost import CostWeights
+from vrpms_tpu.core.split import (
+    greedy_split_cost_batch,
+    greedy_split_cost_hot_batch,
+)
+from vrpms_tpu.solvers.aco import _construct_orders
+from vrpms_tpu.solvers.common import perm_fitness_fn
+
+
+def _rand_instance(rng, n, v, q):
+    d = rng.uniform(1, 60, size=(n + 1, n + 1))
+    np.fill_diagonal(d, 0)
+    demands = np.concatenate([[0], rng.integers(1, 9, n)])
+    return make_instance(d, demands=demands, capacities=[float(q)] * v)
+
+
+def _rand_perms(rng, b, n):
+    return jnp.asarray(
+        np.stack([rng.permutation(np.arange(1, n + 1)) for _ in range(b)]),
+        dtype=jnp.int32,
+    )
+
+
+class TestGreedySplitHot:
+    @pytest.mark.parametrize("n,v,q", [(6, 2, 9), (19, 3, 14), (33, 5, 21)])
+    def test_matches_scan_split(self, rng, n, v, q):
+        inst = _rand_instance(rng, n, v, q)
+        perms = _rand_perms(rng, 16, n)
+        c_ref, r_ref = greedy_split_cost_batch(perms, inst)
+        c_hot, r_hot = greedy_split_cost_hot_batch(perms, inst)
+        # identical route structure; costs to bf16 rounding of D
+        np.testing.assert_array_equal(
+            np.asarray(r_ref), np.asarray(r_hot).astype(np.int32)
+        )
+        np.testing.assert_allclose(np.asarray(c_hot), np.asarray(c_ref), rtol=2e-2)
+
+    def test_oversize_customer_rides_alone(self, rng):
+        # a single customer above capacity must still occupy one route,
+        # exactly like the scan rule (progress clamp in the jump fn)
+        d = np.ones((4, 4)) - np.eye(4)
+        inst = make_instance(d, demands=[0, 9, 1, 1], capacities=[5.0, 5.0, 5.0])
+        perms = jnp.asarray([[1, 2, 3], [2, 1, 3], [3, 2, 1]], dtype=jnp.int32)
+        c_ref, r_ref = greedy_split_cost_batch(perms, inst)
+        c_hot, r_hot = greedy_split_cost_hot_batch(perms, inst)
+        np.testing.assert_array_equal(
+            np.asarray(r_ref), np.asarray(r_hot).astype(np.int32)
+        )
+        np.testing.assert_allclose(np.asarray(c_hot), np.asarray(c_ref), rtol=1e-6)
+
+    def test_fitness_fn_hot_matches_gather(self, rng):
+        inst = _rand_instance(rng, 15, 2, 12)
+        w = CostWeights.make()
+        perms = _rand_perms(rng, 8, 15)
+        ref = np.asarray(perm_fitness_fn(inst, w, mode="gather")(perms))
+        hot = np.asarray(perm_fitness_fn(inst, w, mode="onehot")(perms))
+        # fleet-overflow penalties are exact; distances bf16-rounded
+        np.testing.assert_allclose(hot, ref, rtol=2e-2)
+
+
+class TestAcoConstructionHot:
+    def test_orders_are_permutations_and_biased(self, rng):
+        n_nodes = 12
+        d = rng.uniform(1, 50, size=(n_nodes, n_nodes))
+        tau = jnp.ones((n_nodes, n_nodes))
+        eta = jnp.asarray(1.0 / np.maximum(d, 1e-6)) ** 2.5
+        for mode in ("gather", "onehot"):
+            orders = _construct_orders(jax.random.key(0), tau, eta, 16, mode=mode)
+            assert orders.shape == (16, n_nodes - 1)
+            for row in np.asarray(orders):
+                assert sorted(row) == list(range(1, n_nodes))
+
+    def test_hot_and_gather_sample_same_distribution(self, rng):
+        # identical keys and uniform pheromone: choices differ only via
+        # bf16 log-score rounding, so the aggregate next-hop frequency
+        # from the depot must match closely across modes
+        n_nodes = 8
+        d = rng.uniform(1, 50, size=(n_nodes, n_nodes))
+        tau = jnp.ones((n_nodes, n_nodes))
+        eta = jnp.asarray(1.0 / np.maximum(d, 1e-6)) ** 2.5
+        a = np.asarray(
+            _construct_orders(jax.random.key(1), tau, eta, 512, mode="gather")
+        )
+        b = np.asarray(
+            _construct_orders(jax.random.key(1), tau, eta, 512, mode="onehot")
+        )
+        freq_a = np.bincount(a[:, 0], minlength=n_nodes) / 512
+        freq_b = np.bincount(b[:, 0], minlength=n_nodes) / 512
+        assert np.abs(freq_a - freq_b).max() < 0.1

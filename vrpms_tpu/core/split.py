@@ -69,6 +69,102 @@ def greedy_split_cost_batch(perms: jax.Array, inst: Instance):
     return jax.vmap(greedy_split_cost, in_axes=(0, None))(perms, inst)
 
 
+def greedy_split_cost_hot_batch(perms: jax.Array, inst: Instance):
+    """Gather-free batched greedy-split cost (the TPU GA/ACO fitness).
+
+    Same semantics as greedy_split_cost (to bf16 rounding of the
+    durations matrix), reformulated for hardware where data-dependent
+    gathers lower to a scalar loop:
+
+      * per-leg demands / direct legs / depot detours are one-hot
+        contractions (exact selections of a bf16-rounded table);
+      * the greedy route boundaries are the orbit of 0 under the jump
+        function f(s) = first position j > s whose cumulative demand
+        exceeds capacity from a route starting at s — computable without
+        a sequential position walk because cumulative demand is
+        nondecreasing, so each route is a contiguous prefix run;
+      * the orbit is found by pointer doubling: encode f as a one-hot
+        transition matrix (plus an absorbing end state) and square it
+        log2(n) times, unioning reach sets — all small bf16 MXU matmuls
+        with 0/1 entries (clamped after each product), no gathers.
+
+    Requires nonnegative demands and a homogeneous fleet (capacities[0])
+    like the scan version it mirrors. Returns (cost, n_routes).
+    """
+    d = inst.durations[0].astype(jnp.bfloat16)
+    q = inst.capacities[0]
+    b, n = perms.shape
+    n_nodes = inst.n_nodes
+    from vrpms_tpu.core.cost import _onehot, onehot_dtype
+
+    dt = onehot_dtype(max(n_nodes, n + 1))
+    oh = _onehot(perms, n_nodes, dt)  # (B, n, N)
+    dem = jnp.einsum(
+        "bkn,n->bk", oh, inst.demands, preferred_element_type=jnp.float32
+    )
+    # direct[k] = d[p_k, p_k+1]; depot detour legs from the 0-row/column.
+    x = jnp.einsum(
+        "bkn,nm->bkm", oh[:, :-1], d, preferred_element_type=dt
+    )
+    direct = jnp.einsum(
+        "bkm,bkm->bk", x, oh[:, 1:], preferred_element_type=jnp.float32
+    )
+    to_depot = jnp.einsum(
+        "bkn,n->bk", oh[:, :-1], d[:, 0].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    from_depot = jnp.einsum(
+        "bkn,n->bk", oh[:, 1:], d[0].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    first_leg = jnp.einsum(
+        "bn,n->b", oh[:, 0], d[0].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    last_leg = jnp.einsum(
+        "bn,n->b", oh[:, -1], d[:, 0].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+    # Jump function on route-start positions 0..n-1 plus absorbing n:
+    # a route from s spans the longest prefix with cumdem <= cumdem[s-1]
+    # + Q, but always at least one customer.
+    cum = jnp.cumsum(dem, axis=1)  # (B, n), inclusive
+    cum_excl = jnp.concatenate([jnp.zeros((b, 1)), cum[:, :-1]], axis=1)
+    limit = cum_excl + q  # (B, n) per start s
+    jpos = jnp.arange(n)
+    fits = (jpos[None, None, :] >= jnp.arange(n)[None, :, None]) & (
+        cum[:, None, :] <= limit[:, :, None]
+    )  # (B, s, j): j continues the route started at s
+    f = jnp.arange(n)[None, :] + fits.sum(-1)  # first position NOT fitting
+    f = jnp.clip(jnp.maximum(f, jnp.arange(n)[None, :] + 1), 0, n)
+
+    # Orbit of 0 under f via reach-set doubling on one-hot matrices.
+    m = _onehot(f, n + 1, dt)  # (B, n, n+1) rows for states 0..n-1
+    absorb = jnp.zeros((b, 1, n + 1), dt).at[:, 0, n].set(1)
+    m = jnp.concatenate([m, absorb], axis=1)  # (B, n+1, n+1)
+    reach = jnp.zeros((b, 1, n + 1), dt).at[:, 0, 0].set(1)
+    steps = max(1, (n).bit_length())
+    for s in range(steps):
+        reach = jnp.minimum(
+            reach
+            + jnp.einsum("bij,bjk->bik", reach, m, preferred_element_type=dt),
+            1,
+        )
+        if s < steps - 1:  # the final squaring's result is never read
+            m = jnp.minimum(
+                jnp.einsum("bij,bjk->bik", m, m, preferred_element_type=dt), 1
+            )
+    starts = reach[:, 0, :n].astype(jnp.float32)  # route-start indicator
+
+    # Legs k (p_k -> p_k+1) become depot detours when k+1 starts a route.
+    fresh = starts[:, 1:]
+    legs = direct + fresh * (to_depot + from_depot - direct)
+    cost = first_leg + legs.sum(axis=1) + last_leg
+    n_routes = 1.0 + fresh.sum(axis=1)
+    return cost, n_routes
+
+
 def _route_cost_matrix(perm: jax.Array, inst: Instance) -> jax.Array:
     """C[i, j] = cost of serving perm[i..j-1] (0-based) as one route,
     BIG when empty/backward/capacity-infeasible. Shape [n+1, n+1] over

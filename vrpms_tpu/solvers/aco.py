@@ -37,29 +37,53 @@ class ACOParams:
     fleet_penalty: float = 1_000.0
 
 
-def _construct_orders(key, tau, eta, n_ants: int):
+def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto"):
     """All ants build customer orders in lockstep.
 
     Step k: score[a, c] = alpha*log tau[cur_a, c] + beta*log eta[cur_a, c]
     over unvisited customers, plus Gumbel noise -> argmax is a sample from
-    the ACO construction distribution.
+    the ACO construction distribution. The per-step row lookup and the
+    visited-set update run as one-hot matmul / mask ops on accelerators
+    (gathers and scatters lower to scalar loops on TPU); the one-hot of
+    the current node is reused from the previous step's argmax.
     """
-    n_nodes = tau.shape[0]
-    log_tau = jnp.log(jnp.maximum(tau, 1e-30))
-    log_eta = jnp.log(jnp.maximum(eta, 1e-30))
+    from vrpms_tpu.core.cost import resolve_eval_mode
 
-    def step(carry, k):
-        cur, visited = carry
-        scores = log_tau[cur] + log_eta[cur]  # already exponent-weighted
+    n_nodes = tau.shape[0]
+    log_score = jnp.log(jnp.maximum(tau, 1e-30)) + jnp.log(
+        jnp.maximum(eta, 1e-30)
+    )
+    hot = resolve_eval_mode(mode) != "gather"
+
+    def pick(scores, visited, k):
         gumbel = jax.random.gumbel(jax.random.fold_in(key, k), (n_ants, n_nodes))
         scores = jnp.where(visited, -jnp.inf, scores + gumbel)
-        nxt = jnp.argmax(scores, axis=1).astype(jnp.int32)
-        visited = visited.at[jnp.arange(n_ants), nxt].set(True)
-        return (nxt, visited), nxt
+        return jnp.argmax(scores, axis=1).astype(jnp.int32)
 
     visited0 = jnp.zeros((n_ants, n_nodes), dtype=bool).at[:, 0].set(True)
-    cur0 = jnp.zeros(n_ants, dtype=jnp.int32)
-    _, orders = jax.lax.scan(step, (cur0, visited0), jnp.arange(n_nodes - 1))
+    if hot:
+        def step(carry, k):
+            cur_oh, visited = carry
+            scores = jnp.einsum(
+                "an,nm->am",
+                cur_oh.astype(jnp.bfloat16),
+                log_score.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            nxt = pick(scores, visited, k)
+            nxt_oh = nxt[:, None] == jnp.arange(n_nodes)[None, :]
+            return (nxt_oh.astype(jnp.float32), visited | nxt_oh), nxt
+
+        init = (jnp.zeros((n_ants, n_nodes)).at[:, 0].set(1.0), visited0)
+    else:
+        def step(carry, k):
+            cur, visited = carry
+            nxt = pick(log_score[cur], visited, k)
+            visited = visited.at[jnp.arange(n_ants), nxt].set(True)
+            return (nxt, visited), nxt
+
+        init = (jnp.zeros(n_ants, dtype=jnp.int32), visited0)
+    _, orders = jax.lax.scan(step, init, jnp.arange(n_nodes - 1))
     return orders.T  # [A, n]
 
 

@@ -12,9 +12,19 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from vrpms_tpu.core.cost import CostBreakdown, CostWeights, evaluate_giant, total_cost
+from vrpms_tpu.core.cost import (
+    CostBreakdown,
+    CostWeights,
+    evaluate_giant,
+    resolve_eval_mode,
+    total_cost,
+)
 from vrpms_tpu.core.instance import Instance
-from vrpms_tpu.core.split import greedy_split_cost, greedy_split_giant
+from vrpms_tpu.core.split import (
+    greedy_split_cost,
+    greedy_split_cost_hot_batch,
+    greedy_split_giant,
+)
 
 
 class SolveResult(NamedTuple):
@@ -50,22 +60,41 @@ def solve_info(res: SolveResult, unvisited: list | None = None) -> dict:
     }
 
 
-def perm_fitness_fn(inst: Instance, w: CostWeights, fleet_penalty: float = 1_000.0):
+def perm_fitness_fn(
+    inst: Instance,
+    w: CostWeights,
+    fleet_penalty: float = 1_000.0,
+    mode: str = "auto",
+):
     """Batched fitness for permutation genomes (GA population, ACO ants).
 
     Plain CVRP: greedy split distance + penalty per route over the fleet
-    bound. Timed instances (TW or time-dependent durations): full
-    giant-tour evaluation so waiting/lateness are priced.
+    bound — via the gather-free one-hot/pointer-doubling formulation on
+    accelerators (core.split.greedy_split_cost_hot_batch), the scan
+    formulation on CPU. Timed instances (TW or time-dependent
+    durations): full giant-tour evaluation so waiting/lateness are
+    priced.
     """
     timed = inst.has_tw or inst.time_dependent
     v = inst.n_vehicles
+    hot = resolve_eval_mode(mode) != "gather" and not timed
 
-    def fit(perm):
-        if timed:
-            giant = greedy_split_giant(perm, inst)
-            return total_cost(evaluate_giant(giant, inst), w)
+    def fit_timed(perm):
+        giant = greedy_split_giant(perm, inst)
+        return total_cost(evaluate_giant(giant, inst), w)
+
+    def fit_plain(perm):
         cost, n_routes = greedy_split_cost(perm, inst)
         overflow = jnp.maximum(n_routes - v, 0).astype(jnp.float32)
         return cost + fleet_penalty * overflow
 
-    return jax.vmap(fit)
+    if timed:
+        return jax.vmap(fit_timed)
+    if hot:
+        def batch(perms):
+            cost, n_routes = greedy_split_cost_hot_batch(perms, inst)
+            overflow = jnp.maximum(n_routes - v, 0.0)
+            return cost + fleet_penalty * overflow
+
+        return batch
+    return jax.vmap(fit_plain)
