@@ -73,6 +73,50 @@ class TestGreedySplitHot:
         np.testing.assert_allclose(hot, ref, rtol=2e-2)
 
 
+class TestGaOperatorsHot:
+    def test_hot_ox_structure(self, rng):
+        from vrpms_tpu.solvers.ga import order_crossover_hot
+
+        n, pop = 22, 12
+        p1 = _rand_perms(rng, pop, n)
+        p2 = _rand_perms(rng, pop, n)
+        key = jax.random.key(5)
+        children = np.asarray(order_crossover_hot(p1, p2, key))
+        ij = np.asarray(jax.random.randint(key, (pop, 2), 0, n))
+        for p in range(pop):
+            child = children[p]
+            assert sorted(child) == list(range(1, n + 1))
+            i, j = min(ij[p]), max(ij[p])
+            # OX contract: p1's cut segment kept in place...
+            assert np.array_equal(child[i : j + 1], np.asarray(p1)[p, i : j + 1])
+            # ...and the rest follows p2's relative order
+            seg = set(child[i : j + 1].tolist())
+            rest = [v for v in child if v not in seg]
+            assert rest == [v for v in np.asarray(p2)[p] if v not in seg]
+
+    def test_hot_generation_evolves_and_stays_valid(self, rng):
+        from vrpms_tpu.solvers.ga import GAParams, ga_generation
+
+        inst = _rand_instance(rng, 14, 3, 12)
+        w = CostWeights.make()
+        fitness = perm_fitness_fn(inst, w, mode="onehot")
+        perms = _rand_perms(rng, 32, 14)
+        fits = fitness(perms)
+        best0 = float(jnp.min(fits))
+        params = GAParams(population=32, elites=4)
+        for gen in range(5):
+            prev_best = float(jnp.min(fits))
+            perms, fits = ga_generation(
+                perms, fits, jax.random.key(9), gen, fitness, params, "onehot"
+            )
+            # elitism carries the best individuals forward, so the
+            # population minimum can never regress between generations
+            assert float(jnp.min(fits)) <= prev_best + 1e-3
+        for row in np.asarray(perms):
+            assert sorted(row) == list(range(1, 15))
+        assert float(jnp.min(fits)) <= best0 + 1e-3
+
+
 class TestAcoConstructionHot:
     def test_orders_are_permutations_and_biased(self, rng):
         n_nodes = 12

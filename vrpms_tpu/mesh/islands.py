@@ -204,7 +204,7 @@ def solve_sa_islands(
 
 @lru_cache(maxsize=64)
 def _ga_islands_fn(
-    mesh: Mesh, local_params: GAParams, island_params: IslandParams
+    mesh: Mesh, local_params: GAParams, island_params: IslandParams, mode: str
 ):
     """Build (and cache) the jitted sharded GA run (see _sa_islands_fn)."""
     n_isl = mesh.shape["islands"]
@@ -221,7 +221,7 @@ def _ga_islands_fn(
         check_vma=False,
     )
     def run(perms, k_run, inst, w):
-        fitness = perm_fitness_fn(inst, w, local_params.fleet_penalty)
+        fitness = perm_fitness_fn(inst, w, local_params.fleet_penalty, mode=mode)
         isl = jax.lax.axis_index("islands")
         k_isl = jax.random.fold_in(k_run, isl)
         fits = fitness(perms)
@@ -230,7 +230,7 @@ def _ga_islands_fn(
         def inner(st, gen):
             perms, fits, best_p, best_f = st
             perms, fits = ga_generation(
-                perms, fits, k_isl, gen, fitness, local_params
+                perms, fits, k_isl, gen, fitness, local_params, mode
             )
             champ = jnp.argmin(fits)
             better = fits[champ] < best_f
@@ -265,6 +265,7 @@ def solve_ga_islands(
     params: GAParams = GAParams(),
     island_params: IslandParams = IslandParams(),
     weights: CostWeights | None = None,
+    mode: str = "auto",
 ) -> SolveResult:
     """GA with per-device sub-populations + ring elite migration."""
     w = weights or CostWeights.make()
@@ -282,7 +283,9 @@ def solve_ga_islands(
     k_init, k_run = jax.random.split(key)
     perms0 = _random_perms(k_init, n_isl * pop_local, inst.n_customers)
 
-    run = _ga_islands_fn(mesh, local_params, island_params)
+    run = _ga_islands_fn(
+        mesh, local_params, island_params, resolve_eval_mode(mode)
+    )
     p_all, f_all = run(perms0, k_run, inst, w)
     best_perm, _ = _pick_champion(p_all, f_all)
     giant = greedy_split_giant(best_perm, inst)

@@ -31,10 +31,22 @@ from functools import lru_cache
 import jax
 import jax.numpy as jnp
 
-from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
+from vrpms_tpu.core.cost import (
+    CostWeights,
+    _onehot,
+    evaluate_giant,
+    onehot_dtype,
+    resolve_eval_mode,
+    total_cost,
+)
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
-from vrpms_tpu.moves.moves import reverse_segment, rotate_segment
+from vrpms_tpu.moves.moves import (
+    _segment_src_map,
+    apply_src_map,
+    reverse_segment,
+    rotate_segment,
+)
 from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
 
 
@@ -78,6 +90,50 @@ def order_crossover(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Array:
     return jnp.where(in_seg, p1, compact[rank]).astype(jnp.int32)
 
 
+def order_crossover_hot(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Array:
+    """Batched gather-free OX for (P, n) parents (the accelerator path).
+
+    Same semantics as order_crossover, reformulated so nothing gathers,
+    scatters, or sorts (all three lower poorly on TPU): segment
+    membership, the p2-order compaction of the remaining genes, and the
+    final fill are one-hot einsums; ranks come from cumsums. Genome
+    values are <= n and one-hot count sums are <= n, so onehot_dtype
+    keeps every contraction exact.
+    """
+    pop, n = p1.shape
+    dt = onehot_dtype(n + 1)
+    ij = jax.random.randint(key, (pop, 2), 0, n)
+    i = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
+    j = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
+    pos = jnp.arange(n)[None, :]
+    in_seg = (pos >= i) & (pos <= j)  # (P, n)
+
+    oh1 = _onehot(p1, n + 1, dt)  # (P, n, n+1) over gene values
+    oh2 = _onehot(p2, n + 1, dt)
+    # member[p, v] = 1 iff value v sits inside p1's kept segment
+    member = jnp.einsum(
+        "pk,pkv->pv", in_seg.astype(dt), oh1, preferred_element_type=dt
+    )
+    keep = 1.0 - jnp.einsum(
+        "pkv,pv->pk", oh2, member, preferred_element_type=jnp.float32
+    )  # (P, n): p2 genes not already in the segment
+    # Compact kept p2 genes, preserving order: rank by prefix count.
+    rank = jnp.cumsum(keep, axis=1) - keep  # exclusive prefix, f32 ints
+    rank_idx = jnp.where(keep > 0.5, rank, n).astype(jnp.int32)
+    oh_rank = _onehot(rank_idx, n + 1, dt)
+    compact = jnp.einsum(
+        "pkr,pk->pr", oh_rank, (p2 * keep).astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )[:, :n]  # (P, n) values; slot n dumped
+    # Fill positions outside the segment with compact[...] in order.
+    fill_rank = (jnp.cumsum(~in_seg, axis=1) - 1).astype(jnp.int32)
+    oh_fill = _onehot(jnp.clip(fill_rank, 0, n - 1), n, dt)
+    fill = jnp.einsum(
+        "pkr,pr->pk", oh_fill, compact, preferred_element_type=jnp.float32
+    )
+    return jnp.where(in_seg, p1, jnp.round(fill).astype(p1.dtype))
+
+
 def mutate(perm: jax.Array, key: jax.Array, rate: float) -> jax.Array:
     n = perm.shape[0]
     k_do, k_pos, k_type = jax.random.split(key, 3)
@@ -95,28 +151,81 @@ def mutate(perm: jax.Array, key: jax.Array, rate: float) -> jax.Array:
     return jnp.where(do, mutated, perm)
 
 
-def ga_generation(perms, fits, key, gen, fitness, params: GAParams):
+def mutate_batch(perms, key, rate: float, mode: str) -> jax.Array:
+    """Batched segment mutation: one reverse/rotate per genome, applied
+    through the mode-aware src-map machinery (one-hot apply on TPU)."""
+    pop, n = perms.shape
+    k_do, k_pos, k_type = jax.random.split(key, 3)
+    ij = jax.random.randint(k_pos, (pop, 2), 0, n)
+    lo = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
+    hi = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
+    mt = jax.random.randint(k_type, (pop, 1), 0, 2)  # reverse / rotate-1
+    src = _segment_src_map(lo, hi, mt, jnp.ones_like(mt), n)
+    mutated = apply_src_map(perms, src, mode=mode)
+    do = jax.random.uniform(k_do, (pop, 1)) < rate
+    return jnp.where(do, mutated, perms)
+
+
+def ga_generation(perms, fits, key, gen, fitness, params: GAParams, mode="gather"):
     """One generation: selection -> OX -> mutation -> elitism.
 
     Standalone so the island driver (vrpms_tpu.mesh) can wrap it with
-    migration while reusing the identical update rule.
+    migration while reusing the identical update rule. `mode` picks the
+    gather (CPU) or one-hot (accelerator) formulation of selection,
+    crossover, and mutation — both implement the same operators.
     """
     pop = perms.shape[0]
+    hot = mode in ("onehot", "pallas")
     k_gen = jax.random.fold_in(key, gen)
     k_t1, k_t2, k_cx, k_cxdo, k_mut = jax.random.split(k_gen, 5)
 
-    def tournament(k):
-        draws = jax.random.randint(k, (pop, params.tournament), 0, pop)
-        return draws[jnp.arange(pop), jnp.argmin(fits[draws], axis=1)]
+    if hot:
+        # Exactness never needs pop in the bound: the draw/winner
+        # one-hots only ever accumulate 0/1 values, and fits/perms
+        # contractions accumulate in f32 — so gene values (<= n) set
+        # the dtype and populations > 256 keep bf16 MXU throughput.
+        dt = onehot_dtype(perms.shape[1] + 1)
 
-    pa = perms[tournament(k_t1)]
-    pb = perms[tournament(k_t2)]
-    children = jax.vmap(order_crossover)(pa, pb, jax.random.split(k_cx, pop))
+        def tournament(k):
+            draws = jax.random.randint(k, (pop, params.tournament), 0, pop)
+            oh_d = _onehot(draws, pop, dt)  # (P, T, P)
+            drawn_fits = jnp.einsum(
+                "ptq,q->pt", oh_d, fits, preferred_element_type=jnp.float32
+            )
+            pick = jnp.argmin(drawn_fits, axis=1)
+            oh_pick = _onehot(pick, params.tournament, dt)
+            winner_oh = jnp.einsum(
+                "pt,ptq->pq", oh_pick, oh_d, preferred_element_type=dt
+            )
+            rows = jnp.einsum(
+                "pq,qk->pk",
+                winner_oh,
+                perms.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return jnp.round(rows).astype(perms.dtype)
+
+        pa = tournament(k_t1)
+        pb = tournament(k_t2)
+        children = order_crossover_hot(pa, pb, k_cx)
+    else:
+        def tournament(k):
+            draws = jax.random.randint(k, (pop, params.tournament), 0, pop)
+            return draws[jnp.arange(pop), jnp.argmin(fits[draws], axis=1)]
+
+        pa = perms[tournament(k_t1)]
+        pb = perms[tournament(k_t2)]
+        children = jax.vmap(order_crossover)(
+            pa, pb, jax.random.split(k_cx, pop)
+        )
     do_cx = jax.random.uniform(k_cxdo, (pop,)) < params.crossover_rate
     children = jnp.where(do_cx[:, None], children, pa)
-    children = jax.vmap(mutate, in_axes=(0, 0, None))(
-        children, jax.random.split(k_mut, pop), params.mutation_rate
-    )
+    if hot:
+        children = mutate_batch(children, k_mut, params.mutation_rate, mode)
+    else:
+        children = jax.vmap(mutate, in_axes=(0, 0, None))(
+            children, jax.random.split(k_mut, pop), params.mutation_rate
+        )
     # Elitism: overwrite the first E children with the current best E.
     elite_idx = jnp.argsort(fits)[: params.elites]
     children = children.at[: params.elites].set(perms[elite_idx])
@@ -125,23 +234,27 @@ def ga_generation(perms, fits, key, gen, fitness, params: GAParams):
 
 
 @lru_cache(maxsize=32)
-def _ga_run_fn(params: GAParams):
+def _ga_run_fn(params: GAParams, mode: str):
     """Build (and cache) the jitted GA loop for one parameter set.
 
     Hoisted to module level so the compile caches across solves (an
     inner @jax.jit closure would recompile on every service request);
     bounded lru_cache so request-controlled GAParams can't pin compiled
     executables without limit. GAParams is frozen, hence hashable.
+    `mode` is the resolved eval mode (gather on CPU, one-hot family on
+    accelerators) applied to both operators and fitness.
     """
 
     @jax.jit
     def run(perms, key, inst, w):
-        fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
+        fitness = perm_fitness_fn(inst, w, params.fleet_penalty, mode=mode)
         fits = fitness(perms)
 
         def step(state, gen):
             perms, fits, best_p, best_f = state
-            perms, fits = ga_generation(perms, fits, key, gen, fitness, params)
+            perms, fits = ga_generation(
+                perms, fits, key, gen, fitness, params, mode
+            )
             champ = jnp.argmin(fits)
             better = fits[champ] < best_f
             best_p = jnp.where(better, perms[champ], best_p)
@@ -164,6 +277,7 @@ def solve_ga(
     params: GAParams = GAParams(),
     weights: CostWeights | None = None,
     init_perms: jax.Array | None = None,
+    mode: str = "auto",
 ) -> SolveResult:
     w = weights or CostWeights.make()
     if isinstance(key, int):
@@ -173,7 +287,9 @@ def solve_ga(
     k_init, k_run = jax.random.split(key)
     perms0 = _random_perms(k_init, pop, n) if init_perms is None else init_perms
 
-    best_perm, _ = _ga_run_fn(params)(perms0, k_run, inst, w)
+    best_perm, _ = _ga_run_fn(params, resolve_eval_mode(mode))(
+        perms0, k_run, inst, w
+    )
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)
     return SolveResult(
