@@ -82,6 +82,8 @@ def parse_solver_options(content: dict, errors):
                         checkpointed under this solutionName
     includeStats:       attach solver statistics to the result message
     profile:            capture a jax.profiler trace of the solve
+    timeLimit:          wall-clock budget in seconds; SA stops at the
+                        deadline and returns its best-so-far
     """
     return {
         "backend": get_parameter("backend", content, errors, optional=True),
@@ -94,4 +96,5 @@ def parse_solver_options(content: dict, errors):
         "warm_start": get_parameter("warmStart", content, errors, optional=True),
         "include_stats": get_parameter("includeStats", content, errors, optional=True),
         "profile": get_parameter("profile", content, errors, optional=True),
+        "time_limit": get_parameter("timeLimit", content, errors, optional=True),
     }

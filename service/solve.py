@@ -198,7 +198,15 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     inst.n_vehicles,
                 )
                 init = init.at[0].set(greedy_split_giant(warm, inst))
-            return solve_sa(inst, key=seed, params=p, init_giants=init)
+            deadline = opts.get("time_limit")
+            return solve_sa(
+                inst,
+                key=seed,
+                params=p,
+                init_giants=init,
+                # explicit 0 means "stop as soon as possible", not "no limit"
+                deadline_s=float(deadline) if deadline is not None else None,
+            )
         if algorithm == "aco":
             p = ACOParams(n_ants=int(pop or 64), n_iters=int(iters or 200))
             return solve_aco(inst, key=seed, params=p)

@@ -60,3 +60,26 @@ class TestSA:
         inst = random_instance(rng, n=9, v=2, tw=True)
         res = solve_sa(inst, key=3, params=SAParams(n_chains=32, n_iters=1500))
         assert is_valid_giant(res.giant, 8, 2)
+
+    def test_deadline_truncates_but_returns_valid_best(self, rng):
+        inst = euclidean_cvrp(rng, n=15, v=3, q=12)
+        # an absurd iteration budget with a ~0 deadline: the solve must
+        # stop after its first block and still return a valid solution
+        res = solve_sa(
+            inst,
+            key=4,
+            params=SAParams(n_chains=32, n_iters=200_000),
+            deadline_s=1e-6,
+        )
+        assert is_valid_giant(res.giant, 14, 3)
+        assert int(res.evals) < 32 * 200_000  # truncated
+        assert int(res.evals) >= 32 * 1  # but at least one block ran
+
+    def test_deadline_full_budget_matches_unbounded(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=15)
+        p = SAParams(n_chains=32, n_iters=700)
+        free = solve_sa(inst, key=6, params=p)
+        timed = solve_sa(inst, key=6, params=p, deadline_s=3600.0)
+        # same schedule, same key, deadline never hit: identical result
+        assert float(free.cost) == float(timed.cost)
+        assert np.array_equal(np.asarray(free.giant), np.asarray(timed.giant))

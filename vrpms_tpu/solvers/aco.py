@@ -94,7 +94,7 @@ def _deposit_edges(giant):
 @lru_cache(maxsize=32)
 def _aco_run_fn(params: ACOParams):
     """Build (and cache) the jitted colony loop for one parameter set
-    (see _sa_run_fn's rationale: cross-request compile reuse with
+    (see _sa_block_fn's rationale: cross-request compile reuse with
     bounded retention of request-controlled configurations)."""
 
     @jax.jit

@@ -81,7 +81,7 @@ def _apply_move(giant, move):
 @lru_cache(maxsize=32)
 def _ls_run_fn(max_sweeps: int):
     """Build (and cache) the jitted steepest descent; compile caches
-    across calls with bounded retention (see sa._sa_run_fn rationale)."""
+    across calls with bounded retention (see sa._sa_block_fn rationale)."""
 
     @jax.jit
     def run(giant, inst, w):

@@ -68,7 +68,11 @@ def sa_chain_step(
     """
     mode = resolve_eval_mode(mode)
     b = giants.shape[0]
-    frac = it.astype(jnp.float32) / max(n_iters - 1, 1)
+    # n_iters may be a dynamic scalar (deadline-chunked solves pass the
+    # schedule horizon as a traced value)
+    frac = it.astype(jnp.float32) / jnp.maximum(
+        jnp.asarray(n_iters, jnp.float32) - 1.0, 1.0
+    )
     temp = t0 * (t1 / t0) ** frac
     k_it = jax.random.fold_in(key, it)
     k_moves, k_accept = jax.random.split(k_it)
@@ -87,29 +91,35 @@ def sa_chain_step(
 
 
 @lru_cache(maxsize=32)
-def _sa_run_fn(n_iters: int, mode: str):
-    """Build (and cache) the jitted anneal for one static configuration.
+def _sa_block_fn(n_block: int, mode: str):
+    """Build (and cache) one jitted anneal block of n_block sweeps.
 
     Hoisted to module level so the compile caches across solves — a
     `@jax.jit` defined inside solve_sa would be a fresh function object
     per call, recompiling on every service request (tens of seconds of
     latency for a cached-size problem). The bounded lru_cache (rather
     than a bare jitted function with static_argnames) matters in a
-    long-running service: request bodies control n_iters, and jit's own
-    cache is unbounded, so eviction here is what frees stale compiled
-    executables. Temperatures arrive as dynamic scalars so retuning them
-    never recompiles; only shapes, n_iters, and mode specialize a trace.
+    long-running service: request bodies control iteration counts, and
+    jit's own cache is unbounded, so eviction here is what frees stale
+    compiled executables. Temperatures, the global iteration offset, and
+    the schedule horizon arrive as dynamic scalars so deadline-driven
+    chunking and retuning never recompile; only shapes, n_block, and
+    mode specialize a trace.
+
+    Blocks compose: solve_sa runs the whole anneal as one block, or — to
+    honor a wall-clock deadline — as several, checking the clock on the
+    host between device-side blocks (SURVEY.md §5 failure-detection:
+    a solve must be stoppable at a request deadline).
     """
 
     @jax.jit
-    def run(giants, key, inst, w, t0, t1, knn):
-        costs = objective_batch_mode(giants, inst, w, mode)
-        best_g, best_c = giants, costs
+    def run(state, key, inst, w, t0, t1, knn, start_it, horizon):
+        giants, costs, best_g, best_c = state
 
         def step(state, it):
             giants, costs, best_g, best_c = state
             giants, costs = sa_chain_step(
-                giants, costs, key, it, t0, t1, n_iters, inst, w, mode, knn
+                giants, costs, key, it, t0, t1, horizon, inst, w, mode, knn
             )
             better = costs < best_c
             best_g = jnp.where(better[:, None], giants, best_g)
@@ -117,13 +127,24 @@ def _sa_run_fn(n_iters: int, mode: str):
             return (giants, costs, best_g, best_c), None
 
         state, _ = jax.lax.scan(
-            step, (giants, costs, best_g, best_c), jnp.arange(n_iters)
+            step,
+            (giants, costs, best_g, best_c),
+            start_it + jnp.arange(n_block),
         )
-        _, _, best_g, best_c = state
-        champ = jnp.argmin(best_c)
-        return best_g[champ], best_c[champ]
+        return state
 
     return run
+
+
+@lru_cache(maxsize=8)
+def _sa_init_fn(mode: str):
+    """Jitted initial chain evaluation (kept compiled like the blocks)."""
+
+    @jax.jit
+    def init(giants, inst, w):
+        return objective_batch_mode(giants, inst, w, mode)
+
+    return init
 
 
 def solve_sa(
@@ -133,8 +154,19 @@ def solve_sa(
     weights: CostWeights | None = None,
     init_giants: jax.Array | None = None,
     mode: str = "auto",
+    deadline_s: float | None = None,
 ) -> SolveResult:
-    """Batched-chain SA; returns the best solution over all chains."""
+    """Batched-chain SA; returns the best solution over all chains.
+
+    With `deadline_s`, the anneal runs in fixed 512-sweep device-side
+    blocks and the host checks the wall clock between them, stopping
+    early once the budget is spent (the cooling schedule still targets
+    the full n_iters, so a truncated run behaves like an interrupted
+    anneal, not a faster one). Granularity is one block: a deadline
+    shorter than a single block overshoots by that block's runtime.
+    """
+    import time
+
     w = weights or CostWeights.make()
     mode = resolve_eval_mode(mode)
     if isinstance(key, int):
@@ -152,9 +184,35 @@ def solve_sa(
     # solve_sa requires a concrete instance (_auto_temps above already
     # forced durations to a value), so the table can always be built.
     knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
-    g, c = _sa_run_fn(n_iters, mode)(
-        giants, k_run, inst, w, jnp.float32(t0), jnp.float32(t1), knn
-    )
+    t0j, t1j = jnp.float32(t0), jnp.float32(t1)
+    horizon = jnp.float32(n_iters)
+    costs = _sa_init_fn(mode)(giants, inst, w)
+    state = (giants, costs, giants, costs)
+
+    if deadline_s is None:
+        state = _sa_block_fn(n_iters, mode)(
+            state, k_run, inst, w, t0j, t1j, knn, jnp.int32(0), horizon
+        )
+        done = n_iters
+    else:
+        # Full blocks of one size plus at most one remainder block (two
+        # compiles per n_iters); small enough for ~10+ deadline checks.
+        block = max(1, min(n_iters, 512))
+        done = 0
+        t_start = time.monotonic()
+        while done < n_iters:
+            nb = min(block, n_iters - done)
+            state = _sa_block_fn(nb, mode)(
+                state, k_run, inst, w, t0j, t1j, knn, jnp.int32(done), horizon
+            )
+            jax.block_until_ready(state[3])
+            done += nb
+            if time.monotonic() - t_start >= deadline_s:
+                break
+
+    _, _, best_g, best_c = state
+    champ = jnp.argmin(best_c)
+    g, c = best_g[champ], best_c[champ]
     bd = evaluate_giant(g, inst)
     # evals from the actual batch (init_giants may differ from n_chains)
-    return SolveResult(g, total_cost(bd, w), bd, jnp.int32(giants.shape[0] * n_iters))
+    return SolveResult(g, total_cost(bd, w), bd, jnp.int32(giants.shape[0] * done))
