@@ -1,6 +1,41 @@
-"""Benchmark metrics (SURVEY.md §4 item 6): gap-to-best-known-solution."""
+"""Benchmark metrics (SURVEY.md §4 item 6): gap-to-best-known-solution.
+
+BEST_KNOWN carries published optima / best-known values for the classic
+instances the BASELINE.md ladder names, so loading a real CVRPLIB or
+Solomon file (vrpms_tpu.io.cvrplib) reports a true gap; synthetic
+stand-ins have no BKS and report cost only. Values are the widely
+published literature numbers: A-set and Solomon optima, X-set BKS as of
+the CVRPLIB 2024 tables.
+"""
 
 from __future__ import annotations
+
+# instance name (as in the file's NAME field, lowercased) -> BKS distance
+BEST_KNOWN: dict[str, float] = {
+    "a-n32-k5": 784.0,
+    "a-n33-k5": 661.0,
+    "a-n36-k5": 799.0,
+    "a-n45-k6": 944.0,
+    "a-n55-k9": 1073.0,
+    "a-n60-k9": 1354.0,
+    "x-n101-k25": 27591.0,
+    "x-n110-k13": 14971.0,
+    "x-n200-k36": 58578.0,
+    "x-n303-k21": 21736.0,
+    "x-n502-k39": 69226.0,
+    # Solomon VRPTW distances (vehicle-count-then-distance objective's
+    # distance component, 100-customer sets)
+    "r101": 1650.8,
+    "r201": 1252.4,
+    "c101": 828.94,
+    "c201": 591.56,
+    "rc101": 1696.95,
+}
+
+
+def best_known(name: str) -> float | None:
+    """BKS lookup by instance name (case-insensitive), None if unknown."""
+    return BEST_KNOWN.get(name.strip().lower())
 
 
 def gap_percent(cost: float, best_known: float) -> float:

@@ -381,10 +381,12 @@ def _auto_tile(batch: int, nhat: int, lhat: int, het: bool):
     callers then fall back to the XLA one-hot path).
 
     Preference order per v5e measurements: 1024/8 > 512/16 > 256/16 >
-    128/16, with /8 variants as smaller-footprint fallbacks.
+    128/16, with 128/8 as the smallest-footprint last resort (every
+    entry verified to actually compile on v5e — several nearby configs,
+    e.g. 1024/4, 256/8 and 2048/*, are unverified or crash Mosaic).
     """
     for tb, ch in (
-        (1024, 8), (512, 16), (512, 8), (256, 16), (256, 8), (128, 16), (128, 8)
+        (1024, 8), (512, 16), (512, 8), (256, 16), (128, 16), (128, 8)
     ):
         if batch % tb == 0 and _vmem_estimate(tb, ch, nhat, lhat, het) <= _VMEM_BUDGET:
             return tb, ch
