@@ -18,6 +18,8 @@ Errors are accumulated into the caller's mutable list as
 
 from __future__ import annotations
 
+import sys
+
 
 class Database:
     """Abstract store. Subclasses implement _fetch_row / _insert_solution
@@ -69,8 +71,6 @@ class Database:
         # a warmstarts table missing the owner column — see
         # store/schema.sql) would otherwise disable checkpoints with no
         # trace at all.
-        import sys
-
         print(
             f"[store] warm-start {op} failed ({type(exc).__name__}: {exc}); "
             "continuing without checkpoint — check store/schema.sql",

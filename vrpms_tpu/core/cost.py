@@ -267,6 +267,26 @@ def _cap_excess_hot(giants, prev_oh, rid, inst: Instance, dt) -> jax.Array:
     return jnp.maximum(load - inst.capacities, 0.0).sum(-1)
 
 
+def _legs_hot(giants: jax.Array, inst: Instance):
+    """One-hot leg selection shared by the hot paths: returns (prev_oh,
+    next_oh, legs, dt) with legs[b, k] = durations[0][g_k, g_k+1]
+    selected exactly from the dt-rounded matrix; dt is the widest-exact
+    one-hot dtype for this instance, owned here so both hot paths stay
+    in precision lockstep."""
+    n = inst.n_nodes
+    dt = onehot_dtype(max(giants.shape[1], n))
+    prev_oh = _onehot(giants[:, :-1], n, dt)  # (B, K, N), K = L-1
+    next_oh = _onehot(giants[:, 1:], n, dt)
+    d = inst.durations[0].astype(dt)
+    # X[b,k,m] = durations[prev[b,k], m] — exact row selection of the
+    # dt-rounded matrix; legs contract it against the next-node one-hot.
+    x = jnp.einsum("bkn,nm->bkm", prev_oh, d, preferred_element_type=dt)
+    legs = jnp.einsum(
+        "bkm,bkm->bk", x, next_oh, preferred_element_type=jnp.float32
+    )
+    return prev_oh, next_oh, legs, dt
+
+
 def _tw_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Array:
     """Gather-free batched objective for time-windowed instances.
 
@@ -277,18 +297,8 @@ def _tw_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
     whole evaluation vectorizes on TPU (gathers there lower to a scalar
     loop ~50x slower). The scan itself runs batched over axis 1.
     """
-    n = inst.n_nodes
     v = inst.n_vehicles
-    length = giants.shape[1]
-    dt = onehot_dtype(max(length, n))
-    prev_oh = _onehot(giants[:, :-1], n, dt)  # (B, K, N), K = L-1
-    next_oh = _onehot(giants[:, 1:], n, dt)
-
-    d = inst.durations[0].astype(dt)
-    x = jnp.einsum("bkn,nm->bkm", prev_oh, d, preferred_element_type=dt)
-    legs = jnp.einsum(
-        "bkm,bkm->bk", x, next_oh, preferred_element_type=jnp.float32
-    )
+    prev_oh, next_oh, legs, dt = _legs_hot(giants, inst)
     dist = legs.sum(axis=1)
 
     service_prev = jnp.einsum(
@@ -342,20 +352,8 @@ def objective_hot_batch(
         return objective_batch(giants, inst, w)
     if inst.has_tw:
         return _tw_hot_batch(giants, inst, w)
-    b, length = giants.shape
-    n = inst.n_nodes
-    dt = onehot_dtype(max(length, n))
-    prev_oh = _onehot(giants[:, :-1], n, dt)  # (B, K, N), K = L-1
-    next_oh = _onehot(giants[:, 1:], n, dt)
-
-    d = inst.durations[0].astype(dt)
-    # X[b,k,m] = durations[prev[b,k], m] — exact row selection of the
-    # dt-rounded matrix; dist contracts it against the next-node one-hot.
-    x = jnp.einsum("bkn,nm->bkm", prev_oh, d, preferred_element_type=dt)
-    dist = jnp.einsum(
-        "bkm,bkm->b", x, next_oh, preferred_element_type=jnp.float32
-    )
-
+    prev_oh, _, legs, dt = _legs_hot(giants, inst)
+    dist = legs.sum(axis=1)
     cap_excess = _cap_excess_hot(giants, prev_oh, _rid_batch(giants), inst, dt)
     return dist + w.cap * cap_excess
 

@@ -148,7 +148,7 @@ def knn_src_map(key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str):
     k_i, k_r, k_type, k_rot = jax.random.split(key, 4)
     i = jax.random.randint(k_i, (b, 1), 1, length - 1)
     r = jax.random.randint(k_r, (b,), 0, k_width)
-    if mode in ("onehot", "pallas"):
+    if mode != "gather":  # onehot/pallas: no elementwise gathers on TPU
         from vrpms_tpu.core.cost import _onehot, onehot_dtype
 
         dt_l = onehot_dtype(length)
