@@ -84,6 +84,9 @@ def parse_solver_options(content: dict, errors):
     profile:            capture a jax.profiler trace of the solve
     timeLimit:          wall-clock budget in seconds; SA stops at the
                         deadline and returns its best-so-far
+    makespanWeight:     price the longest route's elapsed time (the
+                        durationMax the result reports) into the
+                        objective; 0/absent optimizes total distance
     """
     return {
         "backend": get_parameter("backend", content, errors, optional=True),
@@ -97,4 +100,7 @@ def parse_solver_options(content: dict, errors):
         "include_stats": get_parameter("includeStats", content, errors, optional=True),
         "profile": get_parameter("profile", content, errors, optional=True),
         "time_limit": get_parameter("timeLimit", content, errors, optional=True),
+        "makespan_weight": get_parameter(
+            "makespanWeight", content, errors, optional=True
+        ),
     }

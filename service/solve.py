@@ -176,14 +176,17 @@ def _better_checkpoint(prev, problem, routes, cost) -> bool:
 
 def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None):
     """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
+    from vrpms_tpu.core.cost import CostWeights
+
     seed = int(opts.get("seed") or 0)
     iters = opts.get("iteration_count")
     pop = opts.get("population_size")
+    w = CostWeights.make(makespan=float(opts.get("makespan_weight") or 0.0))
     try:
         if algorithm == "bf":
             if problem == "tsp":
-                return solve_tsp_bf(inst)
-            return solve_vrp_bf(inst)
+                return solve_tsp_bf(inst, weights=w)
+            return solve_vrp_bf(inst, weights=w)
         if algorithm == "sa":
             p = SAParams(
                 n_chains=int(pop or 128),
@@ -203,13 +206,14 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 inst,
                 key=seed,
                 params=p,
+                weights=w,
                 init_giants=init,
                 # explicit 0 means "stop as soon as possible", not "no limit"
                 deadline_s=float(deadline) if deadline is not None else None,
             )
         if algorithm == "aco":
             p = ACOParams(n_ants=int(pop or 64), n_iters=int(iters or 200))
-            return solve_aco(inst, key=seed, params=p)
+            return solve_aco(inst, key=seed, params=p, weights=w)
         if algorithm == "ga":
             population = int(pop or (ga_params or {}).get("random_permutationCount") or 128)
             generations = int(iters or (ga_params or {}).get("iteration_count") or 300)
@@ -224,7 +228,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     jax.random.key(seed + 1), p.population, inst.n_customers
                 )
                 init = init.at[0].set(warm)
-            return solve_ga(inst, key=seed, params=p, init_perms=init)
+            return solve_ga(inst, key=seed, params=p, weights=w, init_perms=init)
         raise ValueError(f"unknown algorithm {algorithm!r}")
     except ValueError as e:
         errors += [{"what": "Solver error", "reason": str(e)}]

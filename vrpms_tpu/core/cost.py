@@ -55,23 +55,42 @@ class CostBreakdown(NamedTuple):
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["cap", "tw"],
-    meta_fields=[],
+    data_fields=["cap", "tw", "makespan"],
+    meta_fields=["use_makespan"],
 )
 @dataclasses.dataclass(frozen=True)
 class CostWeights:
-    """Penalty weights combining CostBreakdown into one scalar objective."""
+    """Penalty weights combining CostBreakdown into one scalar objective.
+
+    `makespan` prices the LONGEST route's elapsed time into the
+    objective — the durationMax the contract reports (and the reference
+    parses but never optimizes). `use_makespan` is static metadata so
+    the cheaper no-makespan traces (which skip per-route duration
+    bookkeeping entirely) stay specialized.
+    """
 
     cap: jax.Array
     tw: jax.Array
+    makespan: jax.Array
+    use_makespan: bool
 
     @staticmethod
-    def make(cap: float = 1_000.0, tw: float = 100.0) -> "CostWeights":
-        return CostWeights(jnp.float32(cap), jnp.float32(tw))
+    def make(
+        cap: float = 1_000.0, tw: float = 100.0, makespan: float = 0.0
+    ) -> "CostWeights":
+        return CostWeights(
+            jnp.float32(cap),
+            jnp.float32(tw),
+            jnp.float32(makespan),
+            bool(makespan != 0.0),
+        )
 
 
 def total_cost(c: CostBreakdown, w: CostWeights) -> jax.Array:
-    return c.distance + w.cap * c.cap_excess + w.tw * c.tw_lateness
+    cost = c.distance + w.cap * c.cap_excess + w.tw * c.tw_lateness
+    if w.use_makespan:
+        cost = cost + w.makespan * c.duration_max
+    return cost
 
 
 def _cap_excess(giant, rid, inst: Instance) -> jax.Array:
@@ -248,22 +267,26 @@ def _rid_batch(giants) -> jax.Array:
     return jnp.cumsum((giants == 0).astype(jnp.int32), axis=1) - 1
 
 
-def _cap_excess_hot(giants, prev_oh, rid, inst: Instance, dt) -> jax.Array:
-    """Batched capacity excess without scatter: counts[b,v,n] = how many
-    legs of routes 0..v depart node n (an integer <= K, exact in dt);
-    contracting with the f32 demand vector gives cumulative-demand-
-    through-route-v, and a diff recovers per-route loads."""
-    b = giants.shape[0]
-    v = inst.n_vehicles
-    le = (rid[:, :-1, None] <= jnp.arange(v)[None, None, :]).astype(dt)
-    counts = jnp.einsum("bkv,bkn->bvn", le, prev_oh, preferred_element_type=dt)
-    cum = jnp.einsum(
-        "bvn,n->bv",
-        counts.astype(jnp.float32),
-        inst.demands,
-        preferred_element_type=jnp.float32,
+def _per_route_sums(vals: jax.Array, rid: jax.Array, v: int) -> jax.Array:
+    """Scatter-free per-route totals: vals[b, k] summed into the route
+    owning leg k. cum-through-route-v is one einsum against the
+    rid <= v mask; a diff recovers the per-route values. (For valid
+    giant tours rid of every leg position is already in [0, v-1].)"""
+    b = vals.shape[0]
+    le = (rid[:, :-1, None] <= jnp.arange(v)[None, None, :]).astype(
+        jnp.float32
     )
-    load = jnp.diff(cum, axis=1, prepend=jnp.zeros((b, 1), cum.dtype))
+    cum = jnp.einsum("bkv,bk->bv", le, vals, preferred_element_type=jnp.float32)
+    return jnp.diff(cum, axis=1, prepend=jnp.zeros((b, 1), cum.dtype))
+
+
+def _cap_excess_hot(prev_oh, rid, inst: Instance) -> jax.Array:
+    """Batched capacity excess without scatter: per-route loads from the
+    one-hot-selected per-leg demands."""
+    dem_prev = jnp.einsum(
+        "bkn,n->bk", prev_oh, inst.demands, preferred_element_type=jnp.float32
+    )
+    load = _per_route_sums(dem_prev, rid, inst.n_vehicles)
     return jnp.maximum(load - inst.capacities, 0.0).sum(-1)
 
 
@@ -333,8 +356,16 @@ def _tw_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
     _, arrive = jax.lax.associative_scan(combine, (t, r), axis=1)
     lateness = jnp.maximum(arrive - due_cur, 0.0).sum(axis=1)
 
-    cap_excess = _cap_excess_hot(giants, prev_oh, rid, inst, dt)
-    return dist + w.cap * cap_excess + w.tw * lateness
+    cap_excess = _cap_excess_hot(prev_oh, rid, inst)
+    cost = dist + w.cap * cap_excess + w.tw * lateness
+    if w.use_makespan:
+        # Route elapsed time = arrival at its closing depot zero minus
+        # its shift start (the batched twin of _tw_eval's route_dur).
+        closes = giants[:, 1:] == 0
+        route_end = _per_route_sums(jnp.where(closes, arrive, 0.0), rid, v)
+        route_dur = jnp.maximum(route_end - inst.start_times[None, :], 0.0)
+        cost = cost + w.makespan * route_dur.max(axis=-1)
+    return cost
 
 
 def objective_hot_batch(
@@ -354,8 +385,17 @@ def objective_hot_batch(
         return _tw_hot_batch(giants, inst, w)
     prev_oh, _, legs, dt = _legs_hot(giants, inst)
     dist = legs.sum(axis=1)
-    cap_excess = _cap_excess_hot(giants, prev_oh, _rid_batch(giants), inst, dt)
-    return dist + w.cap * cap_excess
+    rid = _rid_batch(giants)
+    cap_excess = _cap_excess_hot(prev_oh, rid, inst)
+    cost = dist + w.cap * cap_excess
+    if w.use_makespan:
+        service_prev = jnp.einsum(
+            "bkn,n->bk", prev_oh, inst.service,
+            preferred_element_type=jnp.float32,
+        )
+        route_dur = _per_route_sums(legs + service_prev, rid, inst.n_vehicles)
+        cost = cost + w.makespan * route_dur.max(axis=-1)
+    return cost
 
 
 def objective_batch_mode(
@@ -373,8 +413,13 @@ def objective_batch_mode(
 
         # pallas_supported mirrors every kernel precondition including
         # the VMEM fit, so oversized instances degrade instead of
-        # failing at Mosaic compile time.
-        if _tpu_backend() and pallas_supported(inst, giants.shape[0]):
+        # failing at Mosaic compile time. The kernel computes distance +
+        # capacity only, so makespan-priced objectives use the XLA path.
+        if (
+            _tpu_backend()
+            and not w.use_makespan
+            and pallas_supported(inst, giants.shape[0])
+        ):
             return pallas_objective_batch(giants, inst, w)
         mode = "onehot"
     if mode == "onehot":

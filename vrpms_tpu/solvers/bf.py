@@ -132,12 +132,16 @@ def _vrp_bf_run_fn(n: int):
 
     @jax.jit
     def run(inst, w):
-        timed = inst.has_tw or inst.time_dependent
+        # Orders score by pure optimal-split distance only when that IS
+        # the objective; time windows or a makespan weight need the full
+        # giant evaluation (w.use_makespan is static metadata, so each
+        # variant still compiles once).
+        full = inst.has_tw or inst.time_dependent or w.use_makespan
 
         def perm_of(idx):
             return _perm_from_index(idx, n) + 1
 
-        if timed:
+        if full:
             def score(idx_batch):
                 giants = jax.vmap(lambda i: greedy_split_giant(perm_of(i), inst))(idx_batch)
                 return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
@@ -155,17 +159,18 @@ def solve_vrp_bf(inst: Instance, weights: CostWeights | None = None) -> SolveRes
     """Exact CVRP: every customer order priced by its optimal split.
 
     Assumes a homogeneous fleet (split uses capacities[0], like the GA/
-    ACO fitness path). Time windows fall back to enumerating orders and
-    evaluating the greedy-split giant exactly.
+    ACO fitness path). Time windows and makespan-priced objectives fall
+    back to enumerating orders and evaluating the greedy-split giant —
+    exact over that split space, matching the solver fitness paths.
     """
     n = _check_size(inst)
     w = weights or CostWeights.make()
     n_perms = math.factorial(n)
-    timed = inst.has_tw or inst.time_dependent
+    full = inst.has_tw or inst.time_dependent or w.use_makespan
 
     best_idx, _ = _vrp_bf_run_fn(n)(inst, w)
     perm = _perm_from_index(best_idx, n) + 1
-    if timed:
+    if full:
         giant = greedy_split_giant(perm, inst)
     else:
         routes = optimal_split_routes(perm, inst)

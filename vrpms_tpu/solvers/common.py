@@ -16,6 +16,7 @@ from vrpms_tpu.core.cost import (
     CostBreakdown,
     CostWeights,
     evaluate_giant,
+    objective_batch_mode,
     resolve_eval_mode,
     total_cost,
 )
@@ -75,9 +76,11 @@ def perm_fitness_fn(
     durations): full giant-tour evaluation so waiting/lateness are
     priced.
     """
-    timed = inst.has_tw or inst.time_dependent
+    # Timed instances and makespan-priced objectives need the full
+    # giant-tour evaluation (split-distance shortcuts price neither).
+    full_eval = inst.has_tw or inst.time_dependent or w.use_makespan
     v = inst.n_vehicles
-    hot = resolve_eval_mode(mode) != "gather" and not timed
+    hot = resolve_eval_mode(mode) != "gather"
 
     def fit_timed(perm):
         giant = greedy_split_giant(perm, inst)
@@ -88,7 +91,16 @@ def perm_fitness_fn(
         overflow = jnp.maximum(n_routes - v, 0).astype(jnp.float32)
         return cost + fleet_penalty * overflow
 
-    if timed:
+    if full_eval:
+        if hot:
+            # Split each genome, then evaluate the giants through the
+            # gather-free batched objective (which prices TW + makespan)
+            # instead of per-genome gather evaluation.
+            def batch_full(perms):
+                giants = jax.vmap(lambda p: greedy_split_giant(p, inst))(perms)
+                return objective_batch_mode(giants, inst, w, mode)
+
+            return batch_full
         return jax.vmap(fit_timed)
     if hot:
         def batch(perms):
