@@ -39,9 +39,13 @@ def _pick_device():
 def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
     """routes/sec of the compiled SA sweep on `device` (compile excluded)."""
     from vrpms_tpu.core.cost import CostWeights, objective_batch_mode
-    from vrpms_tpu.core.encoding import random_giant_batch
     from vrpms_tpu.moves import knn_table
-    from vrpms_tpu.solvers.sa import _auto_temps, sa_chain_step, SAParams
+    from vrpms_tpu.solvers.sa import (
+        _auto_temps,
+        initial_giants,
+        sa_chain_step,
+        SAParams,
+    )
 
     w = CostWeights.make()
     t0, t1 = _auto_temps(inst, SAParams())
@@ -66,8 +70,9 @@ def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
 
     run = jax.jit(chunk, device=device)
     key = jax.random.key(seed)
+    # production init: perturbed nearest-neighbor seeds (SAParams.init)
     giants = jax.device_put(
-        random_giant_batch(key, n_chains, inst.n_customers, inst.n_vehicles), device
+        initial_giants(key, n_chains, inst, SAParams(), mode), device
     )
     costs = objective_batch_mode(giants, inst, w, mode)
 

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from vrpms_tpu.core import make_instance
-from vrpms_tpu.core.encoding import random_giant_batch, routes_from_giant
+from vrpms_tpu.core.encoding import routes_from_giant
 from vrpms_tpu.core.split import greedy_split_giant
 from vrpms_tpu.solvers import (
     ACOParams,
@@ -194,13 +194,19 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
             )
             init = None
             if warm is not None:
-                init = random_giant_batch(
+                # Every chain starts from the checkpointed solution,
+                # decorrelated by a few moves — paired with solve_sa's
+                # cool seeded schedule it refines the warm basin instead
+                # of drowning one good chain among random ones.
+                from vrpms_tpu.core.cost import resolve_eval_mode
+                from vrpms_tpu.solvers.sa import perturbed_clones
+
+                init = perturbed_clones(
                     jax.random.key(seed + 1),
                     p.n_chains,
-                    inst.n_customers,
-                    inst.n_vehicles,
+                    greedy_split_giant(warm, inst),
+                    resolve_eval_mode("auto"),
                 )
-                init = init.at[0].set(greedy_split_giant(warm, inst))
             deadline = opts.get("time_limit")
             return solve_sa(
                 inst,

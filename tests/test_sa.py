@@ -2,6 +2,7 @@
 
 import numpy as np
 import jax
+import pytest
 
 from vrpms_tpu.core import make_instance
 from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
@@ -83,3 +84,30 @@ class TestSA:
         # same schedule, same key, deadline never hit: identical result
         assert float(free.cost) == float(timed.cost)
         assert np.array_equal(np.asarray(free.giant), np.asarray(timed.giant))
+
+    def test_nn_init_not_worse_than_random(self, rng):
+        inst = euclidean_cvrp(rng, n=25, v=4, q=10)
+        budget = SAParams(n_chains=64, n_iters=1000)
+        nn = solve_sa(inst, key=1, params=budget)  # init="nn" default
+        rnd = solve_sa(
+            inst, key=1, params=SAParams(n_chains=64, n_iters=1000, init="random")
+        )
+        assert is_valid_giant(nn.giant, 24, 4)
+        # same budget/seed: constructive seeding should never lose badly
+        assert float(nn.cost) <= float(rnd.cost) * 1.02
+
+    def test_initial_giants_shapes_and_validity(self, rng):
+        from vrpms_tpu.solvers.sa import initial_giants
+
+        inst = euclidean_cvrp(rng, n=12, v=3, q=10)  # 12 nodes = 11 customers
+        for init in ("nn", "random"):
+            g = initial_giants(
+                jax.random.key(0), 16, inst, SAParams(init=init), "gather"
+            )
+            assert g.shape == (16, 11 + 3 + 1)
+            for row in np.asarray(g):
+                assert is_valid_giant(row, 11, 3)
+        with pytest.raises(ValueError):
+            initial_giants(
+                jax.random.key(0), 4, inst, SAParams(init="bogus"), "gather"
+            )

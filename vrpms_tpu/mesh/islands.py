@@ -43,13 +43,17 @@ from vrpms_tpu.core.cost import (
     resolve_eval_mode,
     total_cost,
 )
-from vrpms_tpu.core.encoding import random_giant_batch
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
 from vrpms_tpu.moves import knn_table
 from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
 from vrpms_tpu.solvers.ga import GAParams, ga_generation, _random_perms
-from vrpms_tpu.solvers.sa import SAParams, _auto_temps, sa_chain_step
+from vrpms_tpu.solvers.sa import (
+    SAParams,
+    _auto_temps,
+    initial_giants,
+    sa_chain_step,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,9 +187,7 @@ def solve_sa_islands(
     n_iters = params.n_iters
 
     k_init, k_run = jax.random.split(key)
-    giants0 = random_giant_batch(
-        k_init, n_isl * chains_local, inst.n_customers, inst.n_vehicles
-    )
+    giants0 = initial_giants(k_init, n_isl * chains_local, inst, params, mode)
 
     knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
     run = _sa_islands_fn(mesh, n_iters, island_params, mode)
