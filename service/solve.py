@@ -48,7 +48,6 @@ from vrpms_tpu.solvers import (
     solve_tsp_bf,
     solve_vrp_bf,
 )
-from vrpms_tpu.solvers.ga import _random_perms
 
 DEFAULT_SLICE_MINUTES = 60.0
 
@@ -230,10 +229,17 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
             )
             init = None
             if warm is not None:
-                init = _random_perms(
-                    jax.random.key(seed + 1), p.population, inst.n_customers
+                # Whole population seeded from the checkpointed order
+                # (see the SA warm branch above for the rationale).
+                from vrpms_tpu.core.cost import resolve_eval_mode
+                from vrpms_tpu.solvers.ga import perturbed_perm_clones
+
+                init = perturbed_perm_clones(
+                    jax.random.key(seed + 1),
+                    p.population,
+                    warm,
+                    resolve_eval_mode("auto"),
                 )
-                init = init.at[0].set(warm)
             return solve_ga(inst, key=seed, params=p, weights=w, init_perms=init)
         raise ValueError(f"unknown algorithm {algorithm!r}")
     except ValueError as e:

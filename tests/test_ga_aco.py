@@ -88,3 +88,36 @@ class TestACO:
         res = solve_aco(inst, key=1, params=ACOParams(n_ants=64, n_iters=150))
         assert float(res.cost) <= opt * 1.10 + 1e-3
         assert float(res.breakdown.cap_excess) == 0.0
+
+
+class TestGaInit:
+    def test_nn_population_not_worse_than_random(self):
+        import numpy as np
+        from vrpms_tpu.io.synth import synth_cvrp
+        from vrpms_tpu.solvers import GAParams, solve_ga
+
+        inst = synth_cvrp(26, 4, seed=5)
+        budget = dict(population=64, generations=40)
+        nn = solve_ga(inst, key=2, params=GAParams(**budget))
+        rnd = solve_ga(inst, key=2, params=GAParams(**budget, init="random"))
+        assert float(nn.cost) <= float(rnd.cost) * 1.02
+
+    def test_initial_perms_valid_and_validated(self):
+        import numpy as np
+        import jax
+        import pytest
+        from vrpms_tpu.io.synth import synth_cvrp
+        from vrpms_tpu.solvers.ga import GAParams, initial_perms
+
+        inst = synth_cvrp(13, 2, seed=1)
+        for init in ("nn", "random"):
+            perms = initial_perms(
+                jax.random.key(0), 8, inst, GAParams(init=init), "gather"
+            )
+            assert perms.shape == (8, 12)
+            for row in np.asarray(perms):
+                assert sorted(row) == list(range(1, 13))
+        with pytest.raises(ValueError):
+            initial_perms(
+                jax.random.key(0), 8, inst, GAParams(init="x"), "gather"
+            )

@@ -183,3 +183,15 @@ class TestWarmStartHTTP:
         )
         assert status == 200 and resp["success"]
         assert resp["message"]["stats"]["warmStart"] is True
+
+    def test_warm_resolve_never_regresses_below_checkpoint(self, server):
+        status, first = post(server, "/api/vrp/sa", vrp_body())
+        assert status == 200
+        chk = mem._tables["warmstarts"][(ALICE, "ws-sol")]["state"]["cost"]
+        # a tiny-budget warm re-solve must still return >= checkpoint
+        # quality (the exact checkpoint rides along as clone 0)
+        status, small = post(
+            server, "/api/vrp/sa", vrp_body(warmStart=True, iterationCount=2)
+        )
+        assert status == 200 and small["success"]
+        assert small["message"]["durationSum"] <= chk + 1e-6

@@ -47,7 +47,7 @@ from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
 from vrpms_tpu.moves import knn_table
 from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
-from vrpms_tpu.solvers.ga import GAParams, ga_generation, _random_perms
+from vrpms_tpu.solvers.ga import GAParams, ga_generation, initial_perms
 from vrpms_tpu.solvers.sa import (
     SAParams,
     _auto_temps,
@@ -283,7 +283,9 @@ def solve_ga_islands(
     generations = params.generations
 
     k_init, k_run = jax.random.split(key)
-    perms0 = _random_perms(k_init, n_isl * pop_local, inst.n_customers)
+    perms0 = initial_perms(
+        k_init, n_isl * pop_local, inst, params, resolve_eval_mode(mode)
+    )
 
     run = _ga_islands_fn(
         mesh, local_params, island_params, resolve_eval_mode(mode)

@@ -89,13 +89,17 @@ def perturbed_clones(
 ) -> jax.Array:
     """One seed tour cloned per chain, decorrelated by a few random
     moves — the chain-start recipe for any constructive or warm seed.
-    Callers pairing this with solve_sa should keep the default (cool)
-    schedule: seeded starts are refined, not unscrambled."""
+    Clone 0 stays EXACTLY the seed, so best-so-far tracking guarantees
+    the solve never returns worse than what it started from (warm
+    re-solves with tiny budgets must not regress below their
+    checkpoint). Callers pairing this with solve_sa should keep the
+    default (cool) schedule: seeded starts are refined, not unscrambled.
+    """
     giants = jnp.tile(giant[None], (batch, 1))
     for _ in range(n_moves):
         key, k = jax.random.split(key)
         giants = random_move_batch(k, giants, mode=mode)
-    return giants
+    return giants.at[0].set(giant)
 
 
 def sa_chain_step(
