@@ -6,6 +6,11 @@ from vrpms_tpu.solvers.local_search import (
     solve_nn_2opt,
 )
 from vrpms_tpu.solvers.exact import solve_tsp_exact
+from vrpms_tpu.solvers.delta_ls import (
+    delta_polish,
+    delta_polish_batch,
+    move_delta_tables,
+)
 from vrpms_tpu.solvers.sa import SAParams, solve_sa
 from vrpms_tpu.solvers.ga import GAParams, solve_ga
 from vrpms_tpu.solvers.aco import ACOParams, solve_aco

@@ -1,0 +1,468 @@
+"""Delta-evaluated steepest-descent local search — the fast polish.
+
+The full-evaluation steepest descent (solvers.local_search) re-costs every
+candidate tour, O(L) each, so one sweep of the O(L^2) neighborhood is
+O(L^3) — fine for the 50-node ladder slice, hopeless as a polish step on
+X-n200-scale champions. This module evaluates the SAME neighborhood
+(2-opt reversals, or-opt segment relocations of length 1-3, swaps — the
+move set SURVEY.md §2.2 requires) in O(L^2) per sweep via classic delta
+formulas, reshaped for the MXU:
+
+  * the permuted duration matrix P[a, b] = d[g_a, g_b] is two one-hot
+    matmuls (onehot(g) @ d @ onehot(g)^T) — no gathers on TPU;
+  * every move's DISTANCE delta is elementwise arithmetic over shifted
+    views of P and cumulative leg sums — exact even for asymmetric
+    matrices (a reversed segment re-costs its interior legs from the
+    transpose diagonal's cumsum);
+  * CAPACITY deltas ride along (cap_delta_tables): exact for every
+    load-shifting move family with a closed form — inter-route segment
+    relocations, separator relocations (route merge/split/boundary
+    shift), customer swaps, and separator-spanning reversals — and a
+    can't-win penalty for the rest. Distance-only ranking dies on
+    tight instances: every top slot is a capacity-busting merge;
+  * time-window / makespan / time-of-day effects stay unmodeled, so the
+    top-K predicted moves per tour are re-evaluated with the exact
+    penalized objective and only true improvements are accepted.
+    Correctness never depends on the delta being complete — it is a
+    proposal ranking; acceptance is exact.
+
+Batched over tours (polish a whole champion set at once) and jittable:
+sweeps run under `lax.while_loop` with an early exit once no tour
+improves. This is the reference's missing local-search core (its stub
+shuffles randomly, reference src/solver.py:18-27) built as dense linear
+algebra instead of nested loops.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core.cost import (
+    CostWeights,
+    evaluate_giant,
+    objective_batch_mode,
+    onehot_dtype,
+    resolve_eval_mode,
+    total_cost,
+    _onehot,
+    _rid_batch,
+)
+from vrpms_tpu.core.instance import Instance
+from vrpms_tpu.moves.moves import _segment_src_map, apply_src_map
+from vrpms_tpu.solvers.common import SolveResult
+
+# Table order (axis 1 of move_delta_tables): the t in a flat move index.
+#   0: 2-opt reverse [i, j]
+#   1: swap i, j (non-adjacent; adjacent swaps ARE reversals)
+#   2/3/4: or-opt relocate segment [i, i+s-1], s = 1/2/3, to after j
+N_TABLES = 5
+_INF = jnp.float32(jnp.inf)
+BIGF = 1e18  # sentinel for "no separator to the right" scans
+
+
+def _permuted_matrix(giants: jax.Array, inst: Instance, mode: str) -> jax.Array:
+    """P[b, a, c] = durations[0][g_a, g_c] for each tour in the batch.
+
+    'gather' indexes directly (CPU); otherwise two one-hot contractions
+    keep the build on the MXU with the hot paths' precision (bf16-rounded
+    matrix for instances with <= 256 nodes, exactly like core.cost).
+    """
+    d = inst.durations[0]
+    if mode == "gather":
+        return d[giants[:, :, None], giants[:, None, :]]
+    n = inst.n_nodes
+    dt = onehot_dtype(max(giants.shape[1], n))
+    oh = _onehot(giants, n, dt)  # (B, L, N)
+    rows = jnp.einsum("bln,nm->blm", oh, d.astype(dt), preferred_element_type=dt)
+    return jnp.einsum("blm,bkm->blk", rows, oh, preferred_element_type=jnp.float32)
+
+
+def _shift(a: jax.Array, di: int, dj: int) -> jax.Array:
+    """out[b, i, j] = a[b, i + di, j + dj]; wrapped entries are masked by
+    every consumer's validity mask, so plain rolls suffice."""
+    return jnp.roll(a, shift=(-di, -dj), axis=(1, 2))
+
+
+def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> jax.Array:
+    """[B, 5, L, L] distance deltas; +inf marks invalid (i, j) slots.
+
+    Entry [b, t, i, j] is the EXACT change in total leg distance (of the
+    mode's rounded matrix, slice 0) when move (t, i, j) is applied to
+    tour b — see decode_move for the move each slot denotes.
+    """
+    mode = resolve_eval_mode(mode)
+    b, length = giants.shape
+    p = _permuted_matrix(giants, inst, mode)
+
+    # Leg vectors over positions, padded to length L (out-of-range = 0).
+    fwd = jnp.diagonal(p, offset=1, axis1=1, axis2=2)   # P[k, k+1]
+    bwd = jnp.diagonal(p, offset=-1, axis1=1, axis2=2)  # P[k+1, k]
+    zcol = jnp.zeros((b, 1), jnp.float32)
+    fwd_at = jnp.concatenate([fwd, zcol], axis=1)       # [B, L]
+    # Prefix sums: F[k] = sum of fwd legs 0..k-1, so ranges are diffs.
+    cum_f = jnp.concatenate([zcol, jnp.cumsum(fwd, axis=1)], axis=1)
+    cum_b = jnp.concatenate([zcol, jnp.cumsum(bwd, axis=1)], axis=1)
+
+    def row(vec):  # value varies along i
+        return vec[:, :, None]
+
+    def col(vec):  # value varies along j
+        return vec[:, None, :]
+
+    def rshift(vec, k):  # out[i] = vec[i + k]
+        return jnp.roll(vec, -k, axis=1)
+
+    i_idx = jnp.arange(length)[None, :, None]
+    j_idx = jnp.arange(length)[None, None, :]
+    interior_i = (i_idx >= 1) & (i_idx <= length - 2)
+    interior_j = (j_idx >= 1) & (j_idx <= length - 2)
+
+    fwd_im1 = row(rshift(fwd_at, -1))
+    fwd_i = row(fwd_at)
+    fwd_jm1 = col(rshift(fwd_at, -1))
+    fwd_j = col(fwd_at)
+
+    # --- 2-opt reverse [i, j] ------------------------------------------
+    # new legs (i-1 -> j), reversed interior, (i -> j+1)
+    interior_flip = (col(cum_b) - row(cum_b)) - (col(cum_f) - row(cum_f))
+    rev = (
+        _shift(p, -1, 0)            # P[i-1, j]
+        + _shift(p, 0, 1)           # P[i, j+1]
+        - fwd_im1
+        - fwd_j
+        + interior_flip
+    )
+    rev = jnp.where(interior_i & interior_j & (i_idx < j_idx), rev, _INF)
+
+    # --- swap i, j (j >= i + 2) ----------------------------------------
+    pt = jnp.swapaxes(p, 1, 2)  # pt[i, j] = P[j, i]
+    swp = (
+        _shift(p, -1, 0)            # P[i-1, j]
+        + _shift(pt, 1, 0)          # P[j, i+1]
+        + _shift(pt, 0, -1)         # P[j-1, i]
+        + _shift(p, 0, 1)           # P[i, j+1]
+        - fwd_im1 - fwd_i - fwd_jm1 - fwd_j
+    )
+    swp = jnp.where(interior_i & interior_j & (j_idx >= i_idx + 2), swp, _INF)
+
+    # --- or-opt relocate [i, i+s-1] to after j -------------------------
+    tables = [rev, swp]
+    for s in (1, 2, 3):
+        # closing leg P[i-1, i+s] = the (s+1)-offset diagonal at i-1
+        dg = jnp.diagonal(p, offset=s + 1, axis1=1, axis2=2)
+        dg = jnp.concatenate(
+            [dg, jnp.zeros((b, length - dg.shape[1]), jnp.float32)], axis=1
+        )
+        removal = fwd_im1 + row(rshift(fwd_at, s - 1)) - row(rshift(dg, -1))
+        insertion = (
+            pt                        # P[j, i]
+            + _shift(p, s - 1, 1)     # P[i+s-1, j+1]
+            - fwd_j
+        )
+        seg_ok = interior_i & (i_idx + s - 1 <= length - 2)
+        # j outside [i-1, i+s-1]; j = 0 (insert right after the start
+        # depot) is valid, j = L-1 is not (no leg leaves the last depot).
+        j_ok = (j_idx <= length - 2) & ((j_idx <= i_idx - 2) | (j_idx >= i_idx + s))
+        rel = jnp.where(seg_ok & j_ok, insertion - removal, _INF)
+        tables.append(rel)
+
+    return jnp.stack(tables, axis=1)
+
+
+def _select_by_pos(pos_oh: jax.Array, vec: jax.Array, mode: str, idx=None):
+    """vec[rid[b, k]] per position, as one-hot contraction off-CPU."""
+    if mode == "gather":
+        return vec[idx] if vec.ndim == 1 else jnp.take_along_axis(vec, idx, axis=1)
+    if vec.ndim == 1:
+        return jnp.einsum("blv,v->bl", pos_oh, vec, preferred_element_type=jnp.float32)
+    return jnp.einsum("blv,bv->bl", pos_oh, vec, preferred_element_type=jnp.float32)
+
+
+def cap_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> jax.Array:
+    """[B, 5, L, L] capacity-excess deltas for the same move slots.
+
+    Without this term, distance-only ranking collapses on tight-capacity
+    instances: the best distance deltas are all capacity-busting
+    inter-route moves, and every true improvement drowns below the top-K
+    horizon (measured on synth CVRP: polish accepted zero moves from an
+    NN seed). Coverage, per move family:
+
+      * intra-route moves: exactly 0 — no load shifts;
+      * relocation of a separator-free segment between routes: exact;
+      * relocation of a lone separator: exact — merges its two routes
+        and splits (or boundary-shifts) the receiving route;
+      * swap of two customers in different routes: exact;
+      * 2-opt reversal spanning separators: exact for homogeneous
+        capacities — interior sub-routes keep their load MULTISET (their
+        excess sum is invariant), so only the two edge routes change:
+        the window-head chunk [i, z1-1] and window-tail chunk [z2+1, j]
+        trade places (z1/z2 = first/last separator in the window);
+      * the rest (multi-node segments containing separators; swaps
+        involving a separator) have no tractable closed form — they get
+        a penalty exceeding any real excess change, so they only surface
+        when capacity is unpriced (w.cap = 0 keeps them distance-ranked,
+        since the caller scales this table by w.cap).
+
+    Separator moves renumber the routes in between, so a HETEROGENEOUS
+    fleet makes those entries heuristic (the exact recheck still guards
+    acceptance); per-route capacities stay exact for customer-only moves.
+    """
+    mode = resolve_eval_mode(mode)
+    b, length = giants.shape
+    v = inst.n_vehicles
+    is_zero = giants == 0
+    rid = _rid_batch(giants)
+    rid_c = jnp.clip(rid, 0, v - 1)
+    rid_oh = _onehot(rid_c, v, jnp.float32)
+    if mode == "gather":
+        dem_at = inst.demands[giants]
+    else:
+        dt = onehot_dtype(inst.n_nodes)
+        dem_at = jnp.einsum(
+            "bln,n->bl",
+            _onehot(giants, inst.n_nodes, dt),
+            inst.demands,
+            preferred_element_type=jnp.float32,
+        )
+    load = jnp.einsum("blv,bl->bv", rid_oh, dem_at, preferred_element_type=jnp.float32)
+    load_at = _select_by_pos(rid_oh, load, mode, rid_c)
+    cap_at = _select_by_pos(rid_oh, inst.capacities, mode, rid_c)
+    exc_at = jnp.maximum(load_at - cap_at, 0.0)
+
+    zcol = jnp.zeros((b, 1), jnp.float32)
+    cum_dem = jnp.concatenate([zcol, jnp.cumsum(dem_at, axis=1)], axis=1)
+    cum_zero = jnp.concatenate(
+        [zcol, jnp.cumsum(is_zero.astype(jnp.float32), axis=1)], axis=1
+    )
+
+    def row(vec):
+        return vec[:, :, None]
+
+    def col(vec):
+        return vec[:, None, :]
+
+    diff_route = row(rid) != col(rid)
+    # unmodeled slots cost more than any real excess change can gain
+    unmodeled = jnp.sum(inst.demands) * 2.0 + 1.0
+
+    d_inc = cum_dem[:, 1:]  # demand of positions 0..k, inclusive
+    open_d = jax.lax.cummax(jnp.where(is_zero, d_inc, -1.0), axis=1)
+    prefix = d_inc - open_d  # in-route load up to each position
+    # demand from each position to its route's closing separator
+    close_d = jnp.flip(
+        jax.lax.cummin(
+            jnp.flip(jnp.where(is_zero, d_inc, jnp.float32(BIGF)), axis=1), axis=1
+        ),
+        axis=1,
+    )
+    suffix = close_d - cum_dem[:, :length]
+
+    # --- 2-opt reversal: edge chunks trade routes ----------------------
+    # Start-edge route = rid[i-1] (owner of the leg entering the window),
+    # end-edge route = rid[j]; exact whenever the window holds >= 1
+    # separator (otherwise intra-route: exactly 0).
+    load_in = jnp.roll(load_at, 1, axis=1)
+    cap_in = jnp.roll(cap_at, 1, axis=1)
+    exc_in = jnp.roll(exc_at, 1, axis=1)
+    qa, qb = row(suffix), col(prefix)  # head chunk out, tail chunk in
+    has_zero = (col(cum_zero[:, 1:]) - row(cum_zero[:, :length])) >= 1.0
+    rev = (
+        jnp.maximum(row(load_in) - qa + qb - row(cap_in), 0.0) - row(exc_in)
+        + jnp.maximum(col(load_at) - qb + qa - col(cap_at), 0.0) - col(exc_at)
+    )
+    rev = jnp.where(has_zero, rev, 0.0)
+
+    # --- swap of two customers between different routes ----------------
+    qi, qj = row(dem_at), col(dem_at)
+    swp = (
+        jnp.maximum(row(load_at) - qi + qj - row(cap_at), 0.0) - row(exc_at)
+        + jnp.maximum(col(load_at) - qj + qi - col(cap_at), 0.0) - col(exc_at)
+    )
+    swp = jnp.where(diff_route, swp, 0.0)
+    swp = jnp.where(row(is_zero) | col(is_zero), unmodeled, swp)
+
+    tables = [rev, swp]
+
+    # Relocating a lone SEPARATOR (s = 1, g[i] = 0) merges the two routes
+    # around it and splits (or boundary-shifts) the route receiving it —
+    # the fleet-rebalancing move.
+    rid_prev = jnp.clip(rid - 1, 0, v - 1)
+    prev_oh = _onehot(rid_prev, v, jnp.float32)
+    load_prev = _select_by_pos(prev_oh, load, mode, rid_prev)
+    cap_prev = _select_by_pos(prev_oh, inst.capacities, mode, rid_prev)
+    exc_prev = jnp.maximum(load_prev - cap_prev, 0.0)
+    load_m = load_prev + load_at  # merged load of routes r-1 and r
+    merge_term = jnp.maximum(load_m - cap_prev, 0.0) - exc_prev - exc_at
+    split_term = (
+        jnp.maximum(prefix - cap_at, 0.0)
+        + jnp.maximum(load_at - prefix - cap_at, 0.0)
+        - exc_at
+    )
+    # Insertion back into the merged pair (q = r-1: before the removed
+    # zero; q = r: after it) is a boundary SHIFT: the merged route
+    # re-splits at j, with the in-merged-route prefix extended by route
+    # r-1's full load when j lies in route r.
+    into_r = col(rid) == row(rid)
+    boundary = into_r | (col(rid) == row(rid) - 1)
+    p_m = col(prefix) + jnp.where(into_r, row(load_prev), 0.0)
+    shift_delta = (
+        jnp.maximum(p_m - row(cap_prev), 0.0)
+        + jnp.maximum(row(load_m) - p_m - row(cap_at), 0.0)
+        - row(exc_prev)
+        - row(exc_at)
+    )
+    sep1 = jnp.where(
+        row(is_zero),
+        jnp.where(boundary, shift_delta, row(merge_term) + col(split_term)),
+        0.0,
+    )
+
+    # relocation of a separator-free segment [i, i+s-1] to after j
+    for s in (1, 2, 3):
+        q_seg = jnp.roll(cum_dem, -s, axis=1)[:, :length] - cum_dem[:, :length]
+        pure = (
+            jnp.roll(cum_zero, -s, axis=1)[:, :length] - cum_zero[:, :length]
+        ) == 0.0
+        src_term = (
+            jnp.maximum(row(load_at) - row(q_seg) - row(cap_at), 0.0)
+            - row(exc_at)
+        )
+        dst_term = (
+            jnp.maximum(col(load_at) + row(q_seg) - col(cap_at), 0.0)
+            - col(exc_at)
+        )
+        rel = jnp.where(diff_route & row(pure), src_term + dst_term, 0.0)
+        if s == 1:
+            rel = rel + sep1  # disjoint: `pure` excludes zero segments
+        else:
+            rel = jnp.where(row(pure), rel, unmodeled)
+        tables.append(rel)
+
+    return jnp.stack(tables, axis=1)
+
+
+def decode_move(t: jax.Array, i: jax.Array, j: jax.Array):
+    """Flat table slot -> (move_type, lo, hi, m) for moves._segment_src_map.
+
+    Reverse and swap map directly; a relocation is a rotation of the
+    window between the segment and its insertion point (forward: rotate
+    [i, j] left by s; backward: rotate [j+1, i+s-1] left by i-j-1).
+    """
+    s = t - 1  # segment length for relocation tables
+    forward = j >= i + s
+    mt = jnp.where(t == 0, 0, jnp.where(t == 1, 2, 1))
+    lo = jnp.where(t <= 1, i, jnp.where(forward, i, j + 1))
+    hi = jnp.where(t <= 1, j, jnp.where(forward, j, i + s - 1))
+    m = jnp.where(t <= 1, 1, jnp.where(forward, s, i - j - 1))
+    return mt, lo, hi, m
+
+
+def _sweep(giants, costs, inst, w, mode, top_k):
+    """One steepest-descent sweep: rank all moves by delta, exactly
+    re-evaluate each tour's top-K, accept each tour's best improvement."""
+    b, length = giants.shape
+    deltas = move_delta_tables(giants, inst, mode)
+    if inst.n_vehicles > 1:  # single-route (TSP) moves never shift load
+        deltas = deltas + w.cap * cap_delta_tables(giants, inst, mode)
+    deltas = deltas.reshape(b, -1)
+    scores, idx = jax.lax.top_k(-deltas, top_k)  # best = most negative delta
+    valid = jnp.isfinite(scores)
+
+    t = idx // (length * length)
+    rem = idx % (length * length)
+    i, j = rem // length, rem % length
+    mt, lo, hi, m = decode_move(t, i, j)
+    # invalid slots (masked +inf deltas) become identity swaps
+    one = jnp.ones((), jnp.int32)
+    mt = jnp.where(valid, mt, 2)
+    lo = jnp.where(valid, lo, one)
+    hi = jnp.where(valid, hi, one)
+    m = jnp.where(valid, m, one)
+
+    flat = lambda a: a.reshape(b * top_k, 1).astype(jnp.int32)
+    src = _segment_src_map(flat(lo), flat(hi), flat(mt), flat(m), length)
+    cands = apply_src_map(
+        jnp.repeat(giants, top_k, axis=0), src, mode=mode
+    ).reshape(b, top_k, length)
+    cand_costs = objective_batch_mode(
+        cands.reshape(b * top_k, length), inst, w, mode
+    ).reshape(b, top_k)
+    cand_costs = jnp.where(valid, cand_costs, _INF)
+
+    k_best = jnp.argmin(cand_costs, axis=1)
+    best_cost = jnp.take_along_axis(cand_costs, k_best[:, None], axis=1)[:, 0]
+    best_tour = jnp.take_along_axis(
+        cands, k_best[:, None, None], axis=1
+    )[:, 0, :]
+    better = best_cost < costs - 1e-6
+    giants = jnp.where(better[:, None], best_tour, giants)
+    costs = jnp.where(better, best_cost, costs)
+    return giants, costs, better.any()
+
+
+@lru_cache(maxsize=32)
+def _polish_fn(max_sweeps: int, top_k: int, mode: str):
+    """Build (and cache) the jitted polish loop; compile reuse across
+    requests with bounded retention (see sa._sa_block_fn's rationale)."""
+
+    @jax.jit
+    def run(giants, inst, w):
+        costs = objective_batch_mode(giants, inst, w, mode)
+
+        def cond(state):
+            _, _, improved, sweeps = state
+            return improved & (sweeps < max_sweeps)
+
+        def body(state):
+            giants, costs, _, sweeps = state
+            giants, costs, improved = _sweep(giants, costs, inst, w, mode, top_k)
+            return giants, costs, improved, sweeps + 1
+
+        giants, costs, _, sweeps = jax.lax.while_loop(
+            cond, body, (giants, costs, jnp.bool_(True), jnp.int32(0))
+        )
+        return giants, costs, sweeps
+
+    return run
+
+
+def delta_polish_batch(
+    giants: jax.Array,
+    inst: Instance,
+    weights: CostWeights | None = None,
+    mode: str = "auto",
+    max_sweeps: int = 128,
+    top_k: int = 8,
+):
+    """Polish a [B, L] batch of tours to delta-neighborhood local optima.
+
+    Returns (giants, costs, evals): improved tours, their penalized
+    objectives (in `mode` precision), and the number of exact candidate
+    evaluations spent.
+    """
+    w = weights or CostWeights.make()
+    mode = resolve_eval_mode(mode)
+    giants, costs, sweeps = _polish_fn(max_sweeps, top_k, mode)(giants, inst, w)
+    evals = sweeps * giants.shape[0] * top_k  # counts the final no-improve sweep
+    return giants, costs, evals
+
+
+def delta_polish(
+    giant: jax.Array,
+    inst: Instance,
+    weights: CostWeights | None = None,
+    mode: str = "auto",
+    max_sweeps: int = 128,
+    top_k: int = 8,
+) -> SolveResult:
+    """Polish one tour; the post-solver champion improver."""
+    w = weights or CostWeights.make()
+    giants, _, evals = delta_polish_batch(
+        giant[None], inst, w, mode=mode, max_sweeps=max_sweeps, top_k=top_k
+    )
+    g = giants[0]
+    bd = evaluate_giant(g, inst)
+    return SolveResult(g, total_cost(bd, w), bd, jnp.int32(evals))
