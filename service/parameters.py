@@ -87,6 +87,10 @@ def parse_solver_options(content: dict, errors):
     makespanWeight:     price the longest route's elapsed time (the
                         durationMax the result reports) into the
                         objective; 0/absent optimizes total distance
+    localSearch:        polish the returned solution with the delta-
+                        evaluated steepest descent (solvers.delta_ls);
+                        true = default sweep budget, an integer caps
+                        the number of sweeps
     """
     return {
         "backend": get_parameter("backend", content, errors, optional=True),
@@ -103,4 +107,5 @@ def parse_solver_options(content: dict, errors):
         "makespan_weight": get_parameter(
             "makespanWeight", content, errors, optional=True
         ),
+        "local_search": get_parameter("localSearch", content, errors, optional=True),
     }

@@ -173,14 +173,21 @@ def _better_checkpoint(prev, problem, routes, cost) -> bool:
         return True
 
 
-def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None):
-    """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
+def _request_weights(opts):
+    """The ONE place request options become CostWeights — the solver
+    dispatch and the polish acceptance guard must price the same
+    objective, or 'never returns worse' silently breaks."""
     from vrpms_tpu.core.cost import CostWeights
 
+    return CostWeights.make(makespan=float(opts.get("makespan_weight") or 0.0))
+
+
+def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None, w=None):
+    """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
     seed = int(opts.get("seed") or 0)
     iters = opts.get("iteration_count")
     pop = opts.get("population_size")
-    w = CostWeights.make(makespan=float(opts.get("makespan_weight") or 0.0))
+    w = w if w is not None else _request_weights(opts)
     try:
         if algorithm == "bf":
             if problem == "tsp":
@@ -278,11 +285,54 @@ def _profiled(opts):
             pass
 
 
+POLISH_BLOCK_SWEEPS = 16
+
+
+def _polish(res, inst, opts, w, t_start):
+    """Optional localSearch pass over the champion (delta_ls descent).
+
+    `localSearch: true` uses the full default sweep budget; an integer
+    caps the sweeps. Runs in fixed-size sweep blocks with a host clock
+    check between them so a request's `timeLimit` bounds the polish too
+    (same granularity contract as solve_sa's deadline blocks). Never
+    returns a worse result: acceptance inside delta_ls is exact and
+    monotone in the same penalized objective `w`, and polish evals are
+    accounted even when no sweep improved.
+    """
+    spec = opts.get("local_search")
+    if not spec or res is None:
+        return res, False
+    from vrpms_tpu.solvers import delta_polish
+
+    budget = 128 if spec is True else max(1, int(spec))
+    deadline = opts.get("time_limit")
+    deadline = float(deadline) if deadline is not None else None
+    best, extra_evals = res, 0
+    while budget > 0:
+        block = min(POLISH_BLOCK_SWEEPS, budget)
+        pol = delta_polish(best.giant, inst, w, max_sweeps=block)
+        extra_evals += int(pol.evals)
+        improved = float(pol.cost) < float(best.cost)
+        if improved:
+            best = pol
+        budget -= block
+        if not improved or (
+            deadline is not None
+            and time.perf_counter() - t_start >= deadline
+        ):
+            break
+    return best._replace(evals=res.evals + extra_evals), True
+
+
 def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
     """Timed + optionally profiled dispatch; returns (res, stats|None)."""
     t0 = time.perf_counter()
+    w = _request_weights(opts)
     with _profiled(opts) as trace_dir:
-        res = _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm)
+        res = _solve_instance(
+            inst, algorithm, opts, ga_params, errors, problem, warm, w
+        )
+        res, polished = _polish(res, inst, opts, w, t0)
         if res is not None:
             jax.block_until_ready(res.cost)
     if res is None or not opts.get("include_stats"):
@@ -293,6 +343,7 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
         "wallMs": round((time.perf_counter() - t0) * 1e3, 1),
         "backend": jax.default_backend(),
         "warmStart": warm is not None,
+        "localSearch": polished,
     }
     if trace_dir:
         stats["profileDir"] = trace_dir

@@ -232,6 +232,36 @@ class TestVRPSolve:
         _, bf = post(server, "/api/vrp/bf", vrp_body())
         assert sa["message"]["durationSum"] <= bf["message"]["durationSum"] * 1.05
 
+    def test_local_search_polishes_and_never_worsens(self, server):
+        plain_body = vrp_body(iterationCount=50, populationSize=8)
+        _, plain = post(server, "/api/vrp/sa", plain_body)
+        status, pol = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(
+                iterationCount=50,
+                populationSize=8,
+                localSearch=True,
+                includeStats=True,
+            ),
+        )
+        assert status == 200, pol
+        assert pol["message"]["stats"]["localSearch"] is True
+        assert (
+            pol["message"]["durationSum"]
+            <= plain["message"]["durationSum"] + 1e-6
+        )
+        visited = [c for v in pol["message"]["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+
+    def test_local_search_on_tsp(self, server):
+        status, resp = post(
+            server, "/api/tsp/sa", tsp_body(localSearch=32, includeStats=True)
+        )
+        assert status == 200, resp
+        assert resp["message"]["stats"]["localSearch"] is True
+        assert sorted(resp["message"]["vehicle"][1:-1]) == [1, 2, 3, 4, 5, 6]
+
 
 class TestTSPSolve:
     @pytest.mark.parametrize("route", ["/api/tsp/sa", "/api/tsp/bf", "/api/tsp/ga", "/api/tsp/aco"])
