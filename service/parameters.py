@@ -82,8 +82,10 @@ def parse_solver_options(content: dict, errors):
                         checkpointed under this solutionName
     includeStats:       attach solver statistics to the result message
     profile:            capture a jax.profiler trace of the solve
-    timeLimit:          wall-clock budget in seconds; SA stops at the
-                        deadline and returns its best-so-far
+    timeLimit:          wall-clock budget in seconds; the iterative
+                        solvers (SA, GA, ACO) and the localSearch
+                        polish stop at the deadline and return their
+                        best-so-far
     makespanWeight:     price the longest route's elapsed time (the
                         durationMax the result reports) into the
                         objective; 0/absent optimizes total distance

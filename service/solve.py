@@ -225,7 +225,14 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
             )
         if algorithm == "aco":
             p = ACOParams(n_ants=int(pop or 64), n_iters=int(iters or 200))
-            return solve_aco(inst, key=seed, params=p, weights=w)
+            deadline = opts.get("time_limit")
+            return solve_aco(
+                inst,
+                key=seed,
+                params=p,
+                weights=w,
+                deadline_s=float(deadline) if deadline is not None else None,
+            )
         if algorithm == "ga":
             population = int(pop or (ga_params or {}).get("random_permutationCount") or 128)
             generations = int(iters or (ga_params or {}).get("iteration_count") or 300)
@@ -247,7 +254,15 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     warm,
                     resolve_eval_mode("auto"),
                 )
-            return solve_ga(inst, key=seed, params=p, weights=w, init_perms=init)
+            deadline = opts.get("time_limit")
+            return solve_ga(
+                inst,
+                key=seed,
+                params=p,
+                weights=w,
+                init_perms=init,
+                deadline_s=float(deadline) if deadline is not None else None,
+            )
         raise ValueError(f"unknown algorithm {algorithm!r}")
     except ValueError as e:
         errors += [{"what": "Solver error", "reason": str(e)}]
@@ -309,6 +324,11 @@ def _polish(res, inst, opts, w, t_start):
     deadline = float(deadline) if deadline is not None else None
     best, extra_evals = res, 0
     while budget > 0:
+        # clock check BEFORE each block: a solver that consumed the whole
+        # timeLimit leaves nothing for polish, and the response must not
+        # overshoot the declared budget by a polish block
+        if deadline is not None and time.perf_counter() - t_start >= deadline:
+            break
         block = min(POLISH_BLOCK_SWEEPS, budget)
         pol = delta_polish(best.giant, inst, w, max_sweeps=block)
         extra_evals += int(pol.evals)
@@ -316,10 +336,7 @@ def _polish(res, inst, opts, w, t_start):
         if improved:
             best = pol
         budget -= block
-        if not improved or (
-            deadline is not None
-            and time.perf_counter() - t_start >= deadline
-        ):
+        if not improved:
             break
     return best._replace(evals=res.evals + extra_evals), True
 

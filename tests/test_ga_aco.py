@@ -68,6 +68,26 @@ class TestGA:
         res = solve_ga(inst, key=2, params=GAParams(population=64, generations=100))
         assert is_valid_giant(res.giant, 7, 2)
 
+    def test_deadline_truncates_but_returns_valid_best(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        res = solve_ga(
+            inst,
+            key=3,
+            params=GAParams(population=32, generations=100_000),
+            deadline_s=1e-6,
+        )
+        assert is_valid_giant(res.giant, 9, 2)
+        assert 32 * 1 <= int(res.evals) < 32 * 100_000  # truncated early
+
+    def test_deadline_full_budget_matches_unbounded(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        p = GAParams(population=32, generations=60)
+        free = solve_ga(inst, key=4, params=p)
+        timed = solve_ga(inst, key=4, params=p, deadline_s=3600.0)
+        # deadline never hit: block-composed run matches the single block
+        assert float(free.cost) == float(timed.cost)
+        assert np.array_equal(np.asarray(free.giant), np.asarray(timed.giant))
+
 
 class TestACO:
     def test_near_optimal_tsp(self, rng):
@@ -88,6 +108,25 @@ class TestACO:
         res = solve_aco(inst, key=1, params=ACOParams(n_ants=64, n_iters=150))
         assert float(res.cost) <= opt * 1.10 + 1e-3
         assert float(res.breakdown.cap_excess) == 0.0
+
+    def test_deadline_truncates_but_returns_valid_best(self, rng):
+        inst = euclidean_cvrp(rng, n=8, v=2, q=12)
+        res = solve_aco(
+            inst,
+            key=2,
+            params=ACOParams(n_ants=16, n_iters=100_000),
+            deadline_s=1e-6,
+        )
+        assert is_valid_giant(res.giant, 7, 2)
+        assert 16 * 1 <= int(res.evals) < 16 * 100_000  # truncated early
+
+    def test_deadline_full_budget_matches_unbounded(self, rng):
+        inst = euclidean_cvrp(rng, n=8, v=2, q=12)
+        p = ACOParams(n_ants=16, n_iters=40)
+        free = solve_aco(inst, key=3, params=p)
+        timed = solve_aco(inst, key=3, params=p, deadline_s=3600.0)
+        assert float(free.cost) == float(timed.cost)
+        assert np.array_equal(np.asarray(free.giant), np.asarray(timed.giant))
 
 
 class TestGaInit:

@@ -92,64 +92,71 @@ def _deposit_edges(giant):
 
 
 @lru_cache(maxsize=32)
-def _aco_run_fn(params: ACOParams):
-    """Build (and cache) the jitted colony loop for one parameter set
-    (see _sa_block_fn's rationale: cross-request compile reuse with
-    bounded retention of request-controlled configurations)."""
+def _aco_block_fn(params: ACOParams, n_block: int):
+    """Build (and cache) one jitted block of n_block colony iterations
+    (see sa._sa_block_fn's rationale: cross-request compile reuse with
+    bounded retention; blocks compose so a deadline-driven solve can
+    check the host clock between device-side blocks). Callers pass
+    params with `n_iters` normalized to 0 — the block never reads it —
+    so requests differing only in iteration budget share one compile."""
 
     @jax.jit
-    def run(key, inst, w):
-        return _aco_body(key, inst, w, params)
+    def run(state, key, inst, w, start_it):
+        n_nodes = inst.n_nodes
+        fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
+        d = inst.durations[0]
+        eta = (1.0 / jnp.maximum(d, 1e-6)) ** params.beta
+        alpha = params.alpha
+        rho = params.rho
+
+        def iteration(state, it):
+            tau, best_perm, best_fit = state
+            k_it = jax.random.fold_in(key, it)
+            orders = _construct_orders(k_it, tau ** alpha, eta, params.n_ants)
+            fits = fitness(orders)
+            champ = jnp.argmin(fits)
+            it_best_perm, it_best_fit = orders[champ], fits[champ]
+            better = it_best_fit < best_fit
+            best_perm = jnp.where(better, it_best_perm, best_perm)
+            best_fit = jnp.where(better, it_best_fit, best_fit)
+            # Evaporate, then deposit along the iteration-best ant's
+            # actual split route (depot hops included) scaled by quality.
+            giant = greedy_split_giant(it_best_perm, inst)
+            src, dst = _deposit_edges(giant)
+            amount = 1.0 / jnp.maximum(it_best_fit, 1e-6)
+            tau = (1.0 - rho) * tau
+            tau = tau.at[src, dst].add(amount)
+            # MMAS-style trail limits keep exploration alive.
+            tau_max = 1.0 / (rho * jnp.maximum(best_fit, 1e-6))
+            tau_min = tau_max / (2.0 * n_nodes)
+            tau = jnp.clip(tau, tau_min, tau_max)
+            return (tau, best_perm, best_fit), None
+
+        state, _ = jax.lax.scan(
+            iteration, state, start_it + jnp.arange(n_block)
+        )
+        return state
 
     return run
 
 
-def _aco_body(key, inst, w, params: ACOParams):
-    n_nodes = inst.n_nodes
-    n = inst.n_customers
-    fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
+@lru_cache(maxsize=8)
+def _aco_init_fn(params: ACOParams):
+    """Jitted colony-state init (tau0 scale + incumbent evaluation)."""
 
-    d = inst.durations[0]
-    eta_base = 1.0 / jnp.maximum(d, 1e-6)
-    # Rough NN-scale init: tau0 = 1 / (n * mean-duration); exact value is
-    # irrelevant once MMAS clipping engages.
-    scale = jnp.maximum(jnp.mean(d), 1e-6)
-    tau0 = 1.0 / (n * scale)
-    eta = eta_base ** params.beta
-    alpha = params.alpha
-    rho = params.rho
+    @jax.jit
+    def init(inst, w):
+        n = inst.n_customers
+        fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
+        d = inst.durations[0]
+        # Rough NN-scale init: tau0 = 1 / (n * mean-duration); exact
+        # value is irrelevant once MMAS clipping engages.
+        tau0 = 1.0 / (n * jnp.maximum(jnp.mean(d), 1e-6))
+        tau = jnp.full((inst.n_nodes, inst.n_nodes), tau0)
+        best_perm = jnp.arange(1, n + 1, dtype=jnp.int32)
+        return tau, best_perm, fitness(best_perm[None])[0]
 
-    tau = jnp.full((n_nodes, n_nodes), tau0)
-    best_perm = jnp.arange(1, n + 1, dtype=jnp.int32)
-    best_fit = fitness(best_perm[None])[0]
-
-    def iteration(state, it):
-        tau, best_perm, best_fit = state
-        k_it = jax.random.fold_in(key, it)
-        orders = _construct_orders(k_it, tau ** alpha, eta, params.n_ants)
-        fits = fitness(orders)
-        champ = jnp.argmin(fits)
-        it_best_perm, it_best_fit = orders[champ], fits[champ]
-        better = it_best_fit < best_fit
-        best_perm = jnp.where(better, it_best_perm, best_perm)
-        best_fit = jnp.where(better, it_best_fit, best_fit)
-        # Evaporate, then deposit along the iteration-best ant's actual
-        # split route (depot hops included) scaled by solution quality.
-        giant = greedy_split_giant(it_best_perm, inst)
-        src, dst = _deposit_edges(giant)
-        amount = 1.0 / jnp.maximum(it_best_fit, 1e-6)
-        tau = (1.0 - rho) * tau
-        tau = tau.at[src, dst].add(amount)
-        # MMAS-style trail limits keep exploration alive.
-        tau_max = 1.0 / (rho * jnp.maximum(best_fit, 1e-6))
-        tau_min = tau_max / (2.0 * n_nodes)
-        tau = jnp.clip(tau, tau_min, tau_max)
-        return (tau, best_perm, best_fit), None
-
-    (tau, best_perm, best_fit), _ = jax.lax.scan(
-        iteration, (tau, best_perm, best_fit), jnp.arange(params.n_iters)
-    )
-    return best_perm, best_fit
+    return init
 
 
 def solve_aco(
@@ -157,17 +164,33 @@ def solve_aco(
     key: jax.Array | int = 0,
     params: ACOParams = ACOParams(),
     weights: CostWeights | None = None,
+    deadline_s: float | None = None,
 ) -> SolveResult:
+    """MMAS colony search; with `deadline_s` the colony runs in fixed
+    16-iteration device blocks under common.run_blocked's granularity
+    contract."""
+    from vrpms_tpu.solvers.common import run_blocked
+
     w = weights or CostWeights.make()
     if isinstance(key, int):
         key = jax.random.key(key)
 
-    best_perm, _ = _aco_run_fn(params)(key, inst, w)
+    block_params = dataclasses.replace(params, n_iters=0)
+    state = _aco_init_fn(block_params)(inst, w)
+
+    def step_block(st, nb, start):
+        return _aco_block_fn(block_params, nb)(st, key, inst, w, jnp.int32(start))
+
+    state, done = run_blocked(
+        step_block, state, params.n_iters, 16, deadline_s, lambda st: st[2]
+    )
+
+    best_perm = state[1]
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)
     return SolveResult(
         giant,
         total_cost(bd, w),
         bd,
-        jnp.int32(params.n_ants * params.n_iters),
+        jnp.int32(params.n_ants * done),
     )

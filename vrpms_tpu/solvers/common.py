@@ -35,6 +35,44 @@ class SolveResult(NamedTuple):
     evals: jax.Array          # candidate evaluations performed (throughput metric)
 
 
+def run_blocked(
+    step_block,
+    state,
+    n_total: int,
+    block_size: int,
+    deadline_s: float | None,
+    sync,
+):
+    """Deadline-aware composition of jitted iteration blocks — the one
+    block-driver loop shared by SA, GA, and ACO (identical granularity
+    contract everywhere: the host clock is checked between device-side
+    blocks, so a deadline shorter than one block overshoots by that
+    block's runtime).
+
+    step_block(state, n_block, start) runs n_block iterations from
+    absolute offset `start` (offsets arrive as dynamic scalars inside,
+    so composed blocks reproduce the unbounded run exactly); sync(state)
+    picks the array to block on for the clock check. Returns
+    (state, iterations_done). deadline_s None runs everything as one
+    block with no host sync.
+    """
+    import time
+
+    if deadline_s is None:
+        return step_block(state, n_total, 0), n_total
+    block = max(1, min(n_total, block_size))
+    done = 0
+    t_start = time.monotonic()
+    while done < n_total:
+        nb = min(block, n_total - done)
+        state = step_block(state, nb, done)
+        jax.block_until_ready(sync(state))
+        done += nb
+        if time.monotonic() - t_start >= deadline_s:
+            break
+    return state, done
+
+
 def solve_info(res: SolveResult, unvisited: list | None = None) -> dict:
     """Reference-shaped solve summary: {tour, total_time, unvisited, date}.
 

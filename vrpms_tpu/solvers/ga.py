@@ -275,8 +275,8 @@ def ga_generation(perms, fits, key, gen, fitness, params: GAParams, mode="gather
 
 
 @lru_cache(maxsize=32)
-def _ga_run_fn(params: GAParams, mode: str):
-    """Build (and cache) the jitted GA loop for one parameter set.
+def _ga_block_fn(params: GAParams, n_block: int, mode: str):
+    """Build (and cache) one jitted block of n_block generations.
 
     Hoisted to module level so the compile caches across solves (an
     inner @jax.jit closure would recompile on every service request);
@@ -284,12 +284,18 @@ def _ga_run_fn(params: GAParams, mode: str):
     executables without limit. GAParams is frozen, hence hashable.
     `mode` is the resolved eval mode (gather on CPU, one-hot family on
     accelerators) applied to both operators and fitness.
+
+    Blocks compose exactly like sa._sa_block_fn's: the generation index
+    offset arrives as a dynamic scalar, so a deadline-driven solve runs
+    several blocks with host clock checks in between while an unbounded
+    solve runs the whole budget as one block. Callers pass params with
+    `generations` normalized to 0 (the block body never reads it), so
+    requests differing only in iteration budget share one compile.
     """
 
     @jax.jit
-    def run(perms, key, inst, w):
+    def run(state, key, inst, w, start_gen):
         fitness = perm_fitness_fn(inst, w, params.fleet_penalty, mode=mode)
-        fits = fitness(perms)
 
         def step(state, gen):
             perms, fits, best_p, best_f = state
@@ -302,14 +308,21 @@ def _ga_run_fn(params: GAParams, mode: str):
             best_f = jnp.where(better, fits[champ], best_f)
             return (perms, fits, best_p, best_f), None
 
-        champ0 = jnp.argmin(fits)
-        state = (perms, fits, perms[champ0], fits[champ0])
-        (perms, fits, best_p, best_f), _ = jax.lax.scan(
-            step, state, jnp.arange(params.generations)
-        )
-        return best_p, best_f
+        state, _ = jax.lax.scan(step, state, start_gen + jnp.arange(n_block))
+        return state
 
     return run
+
+
+@lru_cache(maxsize=32)
+def _ga_init_fn(params: GAParams, mode: str):
+    """Jitted initial population evaluation (kept compiled like blocks)."""
+
+    @jax.jit
+    def init(perms, inst, w):
+        return perm_fitness_fn(inst, w, params.fleet_penalty, mode=mode)(perms)
+
+    return init
 
 
 def solve_ga(
@@ -319,7 +332,15 @@ def solve_ga(
     weights: CostWeights | None = None,
     init_perms: jax.Array | None = None,
     mode: str = "auto",
+    deadline_s: float | None = None,
 ) -> SolveResult:
+    """Vectorised GA; returns the best genome's split route plan.
+
+    With `deadline_s`, generations run in fixed 32-generation device
+    blocks under common.run_blocked's granularity contract.
+    """
+    from vrpms_tpu.solvers.common import run_blocked
+
     w = weights or CostWeights.make()
     if isinstance(key, int):
         key = jax.random.key(key)
@@ -331,7 +352,23 @@ def solve_ga(
     else:
         perms0 = init_perms
 
-    best_perm, _ = _ga_run_fn(params, mode)(perms0, k_run, inst, w)
+    # The iteration budget lives outside the compile key: blocks never
+    # read it, so requests differing only in generations share compiles.
+    block_params = dataclasses.replace(params, generations=0)
+    fits0 = _ga_init_fn(block_params, mode)(perms0, inst, w)
+    champ0 = jnp.argmin(fits0)
+    state = (perms0, fits0, perms0[champ0], fits0[champ0])
+
+    def step_block(st, nb, start):
+        return _ga_block_fn(block_params, nb, mode)(
+            st, k_run, inst, w, jnp.int32(start)
+        )
+
+    state, done = run_blocked(
+        step_block, state, params.generations, 32, deadline_s, lambda st: st[3]
+    )
+
+    best_perm = state[2]
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)
     return SolveResult(
@@ -339,5 +376,5 @@ def solve_ga(
         total_cost(bd, w),
         bd,
         # evals from the actual population (init_perms may differ)
-        jnp.int32(perms0.shape[0] * params.generations),
+        jnp.int32(perms0.shape[0] * done),
     )

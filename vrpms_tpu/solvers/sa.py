@@ -210,13 +210,11 @@ def solve_sa(
     """Batched-chain SA; returns the best solution over all chains.
 
     With `deadline_s`, the anneal runs in fixed 512-sweep device-side
-    blocks and the host checks the wall clock between them, stopping
-    early once the budget is spent (the cooling schedule still targets
-    the full n_iters, so a truncated run behaves like an interrupted
-    anneal, not a faster one). Granularity is one block: a deadline
-    shorter than a single block overshoots by that block's runtime.
+    blocks under common.run_blocked's granularity contract (the cooling
+    schedule still targets the full n_iters, so a truncated run behaves
+    like an interrupted anneal, not a faster one).
     """
-    import time
+    from vrpms_tpu.solvers.common import run_blocked
 
     w = weights or CostWeights.make()
     mode = resolve_eval_mode(mode)
@@ -238,26 +236,14 @@ def solve_sa(
     costs = _sa_init_fn(mode)(giants, inst, w)
     state = (giants, costs, giants, costs)
 
-    if deadline_s is None:
-        state = _sa_block_fn(n_iters, mode)(
-            state, k_run, inst, w, t0j, t1j, knn, jnp.int32(0), horizon
+    def step_block(st, nb, start):
+        return _sa_block_fn(nb, mode)(
+            st, k_run, inst, w, t0j, t1j, knn, jnp.int32(start), horizon
         )
-        done = n_iters
-    else:
-        # Full blocks of one size plus at most one remainder block (two
-        # compiles per n_iters); small enough for ~10+ deadline checks.
-        block = max(1, min(n_iters, 512))
-        done = 0
-        t_start = time.monotonic()
-        while done < n_iters:
-            nb = min(block, n_iters - done)
-            state = _sa_block_fn(nb, mode)(
-                state, k_run, inst, w, t0j, t1j, knn, jnp.int32(done), horizon
-            )
-            jax.block_until_ready(state[3])
-            done += nb
-            if time.monotonic() - t_start >= deadline_s:
-                break
+
+    state, done = run_blocked(
+        step_block, state, n_iters, 512, deadline_s, lambda st: st[3]
+    )
 
     _, _, best_g, best_c = state
     champ = jnp.argmin(best_c)
