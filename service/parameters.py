@@ -93,6 +93,14 @@ def parse_solver_options(content: dict, errors):
                         evaluated steepest descent (solvers.delta_ls);
                         true = default sweep budget, an integer caps
                         the number of sweeps
+    islands:            run SA/GA as an island model over this many
+                        devices of the mesh (vrpms_tpu.mesh): per-device
+                        populations with ring elite migration. Clamped
+                        to the devices actually attached; ignored by
+                        bf/aco. Island runs are single-shot compiled
+                        programs: timeLimit and warmStart do not apply
+    migrateEvery:       steps between ring migrations (default 100)
+    migrants:           elites sent to the ring neighbor (default 4)
     """
     return {
         "backend": get_parameter("backend", content, errors, optional=True),
@@ -110,4 +118,7 @@ def parse_solver_options(content: dict, errors):
             "makespanWeight", content, errors, optional=True
         ),
         "local_search": get_parameter("localSearch", content, errors, optional=True),
+        "islands": get_parameter("islands", content, errors, optional=True),
+        "migrate_every": get_parameter("migrateEvery", content, errors, optional=True),
+        "migrants": get_parameter("migrants", content, errors, optional=True),
     }

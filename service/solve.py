@@ -182,11 +182,40 @@ def _request_weights(opts):
     return CostWeights.make(makespan=float(opts.get("makespan_weight") or 0.0))
 
 
+def _island_devices(opts):
+    """(island_count, devices) for an `islands` request: the backend
+    option picks the device pool (like _device_ctx does for non-island
+    solves) and the count clamps to what is actually attached (a
+    single-chip deployment quietly runs one island, which is exactly
+    the non-island solver semantics). The ONE clamp — stats must report
+    the same count the mesh was built from."""
+    backend = opts.get("backend")
+    try:
+        devices = jax.devices(backend) if backend in ("cpu", "tpu") else jax.devices()
+    except RuntimeError:
+        devices = jax.devices()
+    return max(1, min(int(opts["islands"]), len(devices))), devices
+
+
+def _island_setup(opts):
+    """(mesh, IslandParams) for an `islands` request."""
+    from vrpms_tpu.mesh import IslandParams, make_mesh
+
+    n, devices = _island_devices(opts)
+    mesh = make_mesh(devices=devices[:n])
+    ip = IslandParams(
+        migrate_every=int(opts.get("migrate_every") or 100),
+        n_migrants=int(opts.get("migrants") or 4),
+    )
+    return mesh, ip
+
+
 def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None, w=None):
     """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
     seed = int(opts.get("seed") or 0)
     iters = opts.get("iteration_count")
     pop = opts.get("population_size")
+    islands = opts.get("islands")
     w = w if w is not None else _request_weights(opts)
     try:
         if algorithm == "bf":
@@ -198,6 +227,13 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 n_chains=int(pop or 128),
                 n_iters=int(iters or 5000),
             )
+            if islands:
+                from vrpms_tpu.mesh import solve_sa_islands
+
+                mesh, ip = _island_setup(opts)
+                return solve_sa_islands(
+                    inst, key=seed, mesh=mesh, params=p, island_params=ip, weights=w
+                )
             init = None
             if warm is not None:
                 # Every chain starts from the checkpointed solution,
@@ -241,6 +277,13 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 generations=max(generations, 1),
                 elites=max(2, min(16, population // 8)),
             )
+            if islands:
+                from vrpms_tpu.mesh import solve_ga_islands
+
+                mesh, ip = _island_setup(opts)
+                return solve_ga_islands(
+                    inst, key=seed, mesh=mesh, params=p, island_params=ip, weights=w
+                )
             init = None
             if warm is not None:
                 # Whole population seeded from the checkpointed order
@@ -323,6 +366,7 @@ def _polish(res, inst, opts, w, t_start):
     deadline = opts.get("time_limit")
     deadline = float(deadline) if deadline is not None else None
     best, extra_evals = res, 0
+    ran = False
     while budget > 0:
         # clock check BEFORE each block: a solver that consumed the whole
         # timeLimit leaves nothing for polish, and the response must not
@@ -331,6 +375,7 @@ def _polish(res, inst, opts, w, t_start):
             break
         block = min(POLISH_BLOCK_SWEEPS, budget)
         pol = delta_polish(best.giant, inst, w, max_sweeps=block)
+        ran = True
         extra_evals += int(pol.evals)
         improved = float(pol.cost) < float(best.cost)
         if improved:
@@ -338,7 +383,9 @@ def _polish(res, inst, opts, w, t_start):
         budget -= block
         if not improved:
             break
-    return best._replace(evals=res.evals + extra_evals), True
+    # `ran` (not the request flag) feeds stats.localSearch: a deadline
+    # consumed entirely by the solver means zero polish sweeps ran
+    return best._replace(evals=res.evals + extra_evals), ran
 
 
 def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
@@ -362,6 +409,9 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
         "warmStart": warm is not None,
         "localSearch": polished,
     }
+    # only SA/GA actually island-shard (bf/aco ignore the option)
+    if opts.get("islands") and algorithm in ("sa", "ga"):
+        stats["islands"] = _island_devices(opts)[0]
     if trace_dir:
         stats["profileDir"] = trace_dir
     return res, stats
@@ -416,9 +466,14 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     )
     orig_ids = [locations[i]["id"] for i in active_pos]
     warm = None
-    # Only SA and GA consume a warm seed (see _solve_instance); skipping
-    # the lookup for bf/aco also keeps stats['warmStart'] truthful.
-    if opts.get("warm_start") and database is not None and algorithm in ("sa", "ga"):
+    # Only non-island SA and GA consume a warm seed (see _solve_instance);
+    # skipping the lookup otherwise also keeps stats['warmStart'] truthful.
+    if (
+        opts.get("warm_start")
+        and database is not None
+        and algorithm in ("sa", "ga")
+        and not opts.get("islands")
+    ):
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "vrp")
     with _device_ctx(opts.get("backend")):
         res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "vrp", warm)
@@ -508,7 +563,12 @@ def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     )
     orig_ids = [locations[i]["id"] for i in active_pos]
     warm = None
-    if opts.get("warm_start") and database is not None and algorithm in ("sa", "ga"):
+    if (
+        opts.get("warm_start")
+        and database is not None
+        and algorithm in ("sa", "ga")
+        and not opts.get("islands")
+    ):
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "tsp")
     with _device_ctx(opts.get("backend")):
         res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "tsp", warm)

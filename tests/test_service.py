@@ -254,6 +254,45 @@ class TestVRPSolve:
         visited = [c for v in pol["message"]["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    def test_islands_sa_solves_over_virtual_mesh(self, server):
+        """islands rides the conftest's 8 virtual CPU devices: the
+        sharded ring-migration program must serve the same contract."""
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(
+                islands=4,
+                iterationCount=300,
+                populationSize=16,
+                migrateEvery=50,
+                migrants=2,
+                includeStats=True,
+            ),
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        assert msg["stats"]["islands"] == 4
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+
+    def test_islands_ga_solves_and_clamps(self, server):
+        status, resp = post(
+            server,
+            "/api/vrp/ga",
+            vrp_body(
+                multiThreaded=True,
+                randomPermutationCount=24,
+                iterationCount=60,
+                islands=999,  # more than attached devices: clamped
+                includeStats=True,
+            ),
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        assert 1 <= msg["stats"]["islands"] <= 8
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+
     def test_local_search_on_tsp(self, server):
         status, resp = post(
             server, "/api/tsp/sa", tsp_body(localSearch=32, includeStats=True)
