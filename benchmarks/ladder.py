@@ -85,10 +85,17 @@ def config1_tsp50(quick=False):
 
 def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
     from vrpms_tpu.io.metrics import gap_percent
+    from vrpms_tpu.solvers.delta_ls import delta_polish
     from vrpms_tpu.solvers.sa import SAParams, solve_sa
 
     t0 = time.perf_counter()
     res = solve_sa(inst, key=seed, params=SAParams(n_chains=n_chains, n_iters=n_iters))
+    sa_cost = float(res.breakdown.distance)
+    sa_evals = int(res.evals)
+    sa_elapsed = time.perf_counter() - t0  # throughput excludes polish
+    # the production pipeline: delta-descent polish on the champion
+    # (the service's localSearch option; ~0.3 s steady-state at n200)
+    res = delta_polish(res.giant, inst)
     elapsed = time.perf_counter() - t0
     extra = {}
     if bks:
@@ -109,10 +116,11 @@ def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
         config,
         name,
         cost=round(float(res.breakdown.distance), 1),
+        sa_cost=round(sa_cost, 1),
         cap_excess=float(res.breakdown.cap_excess),
         tw_lateness=round(float(res.breakdown.tw_lateness), 2),
         seconds=round(elapsed, 2),
-        routes_per_sec=round(int(res.evals) / elapsed, 1),
+        routes_per_sec=round(sa_evals / sa_elapsed, 1),
         **extra,
     )
 
@@ -161,14 +169,21 @@ def config4_ga_islands(quick=False):
         params=GAParams(population=256, generations=100 if quick else 1000, elites=4),
         island_params=IslandParams(migrate_every=25, n_migrants=2),
     )
+    ga_cost = float(res.breakdown.distance)
+    ga_evals = int(res.evals)
+    ga_elapsed = time.perf_counter() - t0  # throughput excludes polish
+    from vrpms_tpu.solvers.delta_ls import delta_polish
+
+    res = delta_polish(res.giant, inst)
     elapsed = time.perf_counter() - t0
     return _result(
         4,
         "cvrp-n100-ga-islands",
         cost=round(float(res.breakdown.distance), 1),
+        ga_cost=round(ga_cost, 1),
         cap_excess=float(res.breakdown.cap_excess),
         seconds=round(elapsed, 2),
-        evals_per_sec=round(int(res.evals) / elapsed, 1),
+        evals_per_sec=round(ga_evals / ga_elapsed, 1),
     )
 
 

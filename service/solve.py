@@ -182,6 +182,21 @@ def _request_weights(opts):
     return CostWeights.make(makespan=float(opts.get("makespan_weight") or 0.0))
 
 
+def _positive_int(opts, key, default, name):
+    """Validated positive-integer option: absent -> default, anything
+    not a positive integer -> ValueError (the Solver-error envelope).
+    The sharded solvers silently degenerate on nonsense (a negative
+    migrateEvery makes every scan empty, 'solving' with zero
+    iterations), so rejection must happen at the service boundary."""
+    val = opts.get(key)
+    if val is None:
+        return default
+    iv = int(val)
+    if iv < 1:
+        raise ValueError(f"'{name}' must be a positive integer, got {val!r}")
+    return iv
+
+
 def _island_devices(opts):
     """(island_count, devices) for an `islands` request: the backend
     option picks the device pool (like _device_ctx does for non-island
@@ -194,7 +209,8 @@ def _island_devices(opts):
         devices = jax.devices(backend) if backend in ("cpu", "tpu") else jax.devices()
     except RuntimeError:
         devices = jax.devices()
-    return max(1, min(int(opts["islands"]), len(devices))), devices
+    n = _positive_int(opts, "islands", 1, "islands")
+    return min(n, len(devices)), devices
 
 
 def _island_setup(opts):
@@ -204,8 +220,8 @@ def _island_setup(opts):
     n, devices = _island_devices(opts)
     mesh = make_mesh(devices=devices[:n])
     ip = IslandParams(
-        migrate_every=int(opts.get("migrate_every") or 100),
-        n_migrants=int(opts.get("migrants") or 4),
+        migrate_every=_positive_int(opts, "migrate_every", 100, "migrateEvery"),
+        n_migrants=_positive_int(opts, "migrants", 4, "migrants"),
     )
     return mesh, ip
 

@@ -293,6 +293,19 @@ class TestVRPSolve:
         visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    def test_islands_rejects_nonsense_migration_options(self, server):
+        """Negative migrateEvery would silently run ZERO iterations in
+        the sharded solvers; the boundary must reject it instead."""
+        for bad in (
+            {"islands": 2, "migrateEvery": -7},
+            {"islands": 2, "migrants": -2},
+            {"islands": -3},
+        ):
+            status, resp = post(server, "/api/vrp/sa", vrp_body(**bad))
+            assert status == 400, (bad, resp)
+            assert resp["success"] is False
+            assert any("positive integer" in e["reason"] for e in resp["errors"])
+
     def test_local_search_on_tsp(self, server):
         status, resp = post(
             server, "/api/tsp/sa", tsp_body(localSearch=32, includeStats=True)
