@@ -84,18 +84,27 @@ def config1_tsp50(quick=False):
 
 
 def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
+    import jax.numpy as jnp
+
+    from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
     from vrpms_tpu.io.metrics import gap_percent
-    from vrpms_tpu.solvers.delta_ls import delta_polish
+    from vrpms_tpu.solvers.delta_ls import delta_polish_batch
     from vrpms_tpu.solvers.sa import SAParams, solve_sa
+    from vrpms_tpu.solvers.common import SolveResult
 
     t0 = time.perf_counter()
-    res = solve_sa(inst, key=seed, params=SAParams(n_chains=n_chains, n_iters=n_iters))
+    res = solve_sa(
+        inst, key=seed, params=SAParams(n_chains=n_chains, n_iters=n_iters), pool=8
+    )
     sa_cost = float(res.breakdown.distance)
     sa_evals = int(res.evals)
     sa_elapsed = time.perf_counter() - t0  # throughput excludes polish
-    # the production pipeline: delta-descent polish on the champion
-    # (the service's localSearch option; ~0.3 s steady-state at n200)
-    res = delta_polish(res.giant, inst)
+    # the production pipeline: delta-descent polish over the elite pool
+    # (the service's localSearch/localSearchPool options)
+    giants, costs, _ = delta_polish_batch(res.pool, inst)
+    champ = giants[int(jnp.argmin(costs))]
+    bd = evaluate_giant(champ, inst)
+    res = SolveResult(champ, total_cost(bd, CostWeights.make()), bd, res.evals)
     elapsed = time.perf_counter() - t0
     extra = {}
     if bks:

@@ -93,6 +93,9 @@ def parse_solver_options(content: dict, errors):
                         evaluated steepest descent (solvers.delta_ls);
                         true = default sweep budget, an integer caps
                         the number of sweeps
+    localSearchPool:    polish this many of the solver's elite solutions
+                        at once (SA chain bests / GA final population)
+                        and return the winner; default 1 (champion only)
     islands:            run SA/GA as an island model over this many
                         devices of the mesh (vrpms_tpu.mesh): per-device
                         populations with ring elite migration. Clamped
@@ -118,6 +121,9 @@ def parse_solver_options(content: dict, errors):
             "makespanWeight", content, errors, optional=True
         ),
         "local_search": get_parameter("localSearch", content, errors, optional=True),
+        "local_search_pool": get_parameter(
+            "localSearchPool", content, errors, optional=True
+        ),
         "islands": get_parameter("islands", content, errors, optional=True),
         "migrate_every": get_parameter("migrateEvery", content, errors, optional=True),
         "migrants": get_parameter("migrants", content, errors, optional=True),

@@ -68,6 +68,16 @@ class TestGA:
         res = solve_ga(inst, key=2, params=GAParams(population=64, generations=100))
         assert is_valid_giant(res.giant, 7, 2)
 
+    def test_pool_returns_champion_first(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        res = solve_ga(
+            inst, key=5, params=GAParams(population=32, generations=40), pool=5
+        )
+        assert res.pool is not None and res.pool.shape[0] == 5
+        assert np.array_equal(np.asarray(res.pool[0]), np.asarray(res.giant))
+        for g in np.asarray(res.pool):
+            assert is_valid_giant(g, 9, 2)
+
     def test_deadline_truncates_but_returns_valid_best(self, rng):
         inst = euclidean_cvrp(rng, n=10, v=2, q=20)
         res = solve_ga(

@@ -85,6 +85,23 @@ class TestSA:
         assert float(free.cost) == float(timed.cost)
         assert np.array_equal(np.asarray(free.giant), np.asarray(timed.giant))
 
+    def test_pool_returns_sorted_valid_elites(self, rng):
+        from vrpms_tpu.core.cost import CostWeights, objective_batch
+
+        inst = euclidean_cvrp(rng, n=12, v=3, q=10)
+        res = solve_sa(
+            inst, key=7, params=SAParams(n_chains=16, n_iters=500), pool=4
+        )
+        assert res.pool is not None and res.pool.shape[0] == 4
+        assert np.array_equal(np.asarray(res.pool[0]), np.asarray(res.giant))
+        costs = np.asarray(objective_batch(res.pool, inst, CostWeights.make()))
+        assert (np.diff(costs) >= -1e-4).all()  # best first
+        for g in np.asarray(res.pool):
+            assert is_valid_giant(g, 11, 3)
+        # default: no pool materialised
+        res2 = solve_sa(inst, key=7, params=SAParams(n_chains=16, n_iters=500))
+        assert res2.pool is None
+
     def test_nn_init_not_worse_than_random(self, rng):
         inst = euclidean_cvrp(rng, n=25, v=4, q=10)
         budget = SAParams(n_chains=64, n_iters=1000)

@@ -314,6 +314,47 @@ class TestVRPSolve:
         assert resp["message"]["stats"]["localSearch"] is True
         assert sorted(resp["message"]["vehicle"][1:-1]) == [1, 2, 3, 4, 5, 6]
 
+    def test_local_search_pool_polish(self, server):
+        status, resp = post(
+            server,
+            "/api/vrp/ga",
+            vrp_body(
+                multiThreaded=False,
+                randomPermutationCount=24,
+                iterationCount=40,
+                localSearch=True,
+                localSearchPool=6,
+                includeStats=True,
+            ),
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        assert msg["stats"]["localSearch"] is True
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+
+    def test_local_search_pool_rejects_nonsense(self, server):
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(localSearch=True, localSearchPool=-4),
+        )
+        assert status == 400
+        assert any("positive integer" in e["reason"] for e in resp["errors"])
+        # validated even without localSearch (boundary policy)
+        status, resp = post(
+            server, "/api/vrp/sa", vrp_body(localSearchPool=0)
+        )
+        assert status == 400
+        # pools need the solver champion set; islands return only one
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(localSearch=True, localSearchPool=4, islands=2),
+        )
+        assert status == 400
+        assert any("islands" in e["reason"] for e in resp["errors"])
+
 
 class TestTSPSolve:
     @pytest.mark.parametrize("route", ["/api/tsp/sa", "/api/tsp/bf", "/api/tsp/ga", "/api/tsp/aco"])

@@ -33,6 +33,8 @@ class SolveResult(NamedTuple):
     cost: jax.Array           # scalar weighted objective of `giant`
     breakdown: CostBreakdown  # its cost components (distance, penalties, ...)
     evals: jax.Array          # candidate evaluations performed (throughput metric)
+    pool: jax.Array | None = None  # optional [K, L] elite tours (best first,
+                                   # pool[0] == giant) for multi-start polish
 
 
 def run_blocked(

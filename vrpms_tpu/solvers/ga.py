@@ -333,11 +333,15 @@ def solve_ga(
     init_perms: jax.Array | None = None,
     mode: str = "auto",
     deadline_s: float | None = None,
+    pool: int = 0,
 ) -> SolveResult:
     """Vectorised GA; returns the best genome's split route plan.
 
     With `deadline_s`, generations run in fixed 32-generation device
-    blocks under common.run_blocked's granularity contract.
+    blocks under common.run_blocked's granularity contract. `pool` > 0
+    additionally returns the champion plus the final population's top
+    genomes as split giants (SolveResult.pool, best first) for
+    multi-start polish.
     """
     from vrpms_tpu.solvers.common import run_blocked
 
@@ -368,13 +372,33 @@ def solve_ga(
         step_block, state, params.generations, 32, deadline_s, lambda st: st[3]
     )
 
-    best_perm = state[2]
+    perms, fits, best_perm, _ = state
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)
+    elite = None
+    if pool > 0:
+        # Elitism keeps the champion genome in the final population, so
+        # naively prepending it would duplicate pool[0] and waste a
+        # multi-start slot; skip the population's copy when present.
+        import numpy as np
+
+        order = jnp.argsort(fits)
+        if perms.shape[0] and np.array_equal(
+            np.asarray(perms[order[0]]), np.asarray(best_perm)
+        ):
+            order = order[1:]
+        order = order[: min(pool - 1, order.shape[0])]
+        elite = jnp.concatenate(
+            [
+                giant[None],
+                jax.vmap(lambda p: greedy_split_giant(p, inst))(perms[order]),
+            ]
+        )
     return SolveResult(
         giant,
         total_cost(bd, w),
         bd,
         # evals from the actual population (init_perms may differ)
         jnp.int32(perms0.shape[0] * done),
+        elite,
     )

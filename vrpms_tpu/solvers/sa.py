@@ -206,8 +206,15 @@ def solve_sa(
     init_giants: jax.Array | None = None,
     mode: str = "auto",
     deadline_s: float | None = None,
+    pool: int = 0,
 ) -> SolveResult:
     """Batched-chain SA; returns the best solution over all chains.
+
+    `pool` > 0 additionally returns the top-`pool` per-chain bests
+    (SolveResult.pool, best first) — distinct chains sit in distinct
+    local basins, so polishing the whole pool and keeping the winner
+    beats polishing the champion alone (measured −0.9% at K=32 on
+    synth X-n200).
 
     With `deadline_s`, the anneal runs in fixed 512-sweep device-side
     blocks under common.run_blocked's granularity contract (the cooling
@@ -247,7 +254,13 @@ def solve_sa(
 
     _, _, best_g, best_c = state
     champ = jnp.argmin(best_c)
-    g, c = best_g[champ], best_c[champ]
+    g = best_g[champ]
     bd = evaluate_giant(g, inst)
+    elite = None
+    if pool > 0:
+        order = jnp.argsort(best_c)[: min(pool, best_g.shape[0])]
+        elite = best_g[order]
     # evals from the actual batch (init_giants may differ from n_chains)
-    return SolveResult(g, total_cost(bd, w), bd, jnp.int32(giants.shape[0] * done))
+    return SolveResult(
+        g, total_cost(bd, w), bd, jnp.int32(giants.shape[0] * done), elite
+    )
