@@ -84,27 +84,23 @@ def config1_tsp50(quick=False):
 
 
 def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
-    import jax.numpy as jnp
-
-    from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
     from vrpms_tpu.io.metrics import gap_percent
-    from vrpms_tpu.solvers.delta_ls import delta_polish_batch
-    from vrpms_tpu.solvers.sa import SAParams, solve_sa
-    from vrpms_tpu.solvers.common import SolveResult
+    from vrpms_tpu.solvers.ils import ILSParams, solve_ils
+    from vrpms_tpu.solvers.sa import SAParams
 
+    # The production top-quality pipeline (the service's ilsRounds
+    # option): iterated rounds of anneal -> elite-pool delta polish ->
+    # reseed, splitting the sweep budget across rounds. Measured on
+    # synth X-n200: 36.8k vs 37.3k for one long anneal + polish, in a
+    # third of the wall time (BASELINE.md).
     t0 = time.perf_counter()
-    res = solve_sa(
-        inst, key=seed, params=SAParams(n_chains=n_chains, n_iters=n_iters), pool=8
+    res = solve_ils(
+        inst,
+        key=seed,
+        params=ILSParams.from_budget(
+            4, SAParams(n_chains=n_chains, n_iters=0), n_iters, pool=32
+        ),
     )
-    sa_cost = float(res.breakdown.distance)
-    sa_evals = int(res.evals)
-    sa_elapsed = time.perf_counter() - t0  # throughput excludes polish
-    # the production pipeline: delta-descent polish over the elite pool
-    # (the service's localSearch/localSearchPool options)
-    giants, costs, _ = delta_polish_batch(res.pool, inst)
-    champ = giants[int(jnp.argmin(costs))]
-    bd = evaluate_giant(champ, inst)
-    res = SolveResult(champ, total_cost(bd, CostWeights.make()), bd, res.evals)
     elapsed = time.perf_counter() - t0
     extra = {}
     if bks:
@@ -125,11 +121,10 @@ def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
         config,
         name,
         cost=round(float(res.breakdown.distance), 1),
-        sa_cost=round(sa_cost, 1),
         cap_excess=float(res.breakdown.cap_excess),
         tw_lateness=round(float(res.breakdown.tw_lateness), 2),
         seconds=round(elapsed, 2),
-        routes_per_sec=round(sa_evals / sa_elapsed, 1),
+        evals_per_sec=round(int(res.evals) / elapsed, 1),
         **extra,
     )
 

@@ -96,6 +96,11 @@ def parse_solver_options(content: dict, errors):
     localSearchPool:    polish this many of the solver's elite solutions
                         at once (SA chain bests / GA final population)
                         and return the winner; default 1 (champion only)
+    ilsRounds:          SA only: run iterated local search — this many
+                        rounds of (anneal -> elite-pool delta polish ->
+                        reseed chains from the champion). iterationCount
+                        is the TOTAL sweep budget across rounds. The
+                        strongest quality setting (solvers.ils)
     islands:            run SA/GA as an island model over this many
                         devices of the mesh (vrpms_tpu.mesh): per-device
                         populations with ring elite migration. Clamped
@@ -124,6 +129,7 @@ def parse_solver_options(content: dict, errors):
         "local_search_pool": get_parameter(
             "localSearchPool", content, errors, optional=True
         ),
+        "ils_rounds": get_parameter("ilsRounds", content, errors, optional=True),
         "islands": get_parameter("islands", content, errors, optional=True),
         "migrate_every": get_parameter("migrateEvery", content, errors, optional=True),
         "migrants": get_parameter("migrants", content, errors, optional=True),

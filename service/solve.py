@@ -253,6 +253,11 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 n_chains=int(pop or 128),
                 n_iters=int(iters or 5000),
             )
+            ils_rounds = _positive_int(opts, "ils_rounds", 0, "ilsRounds")
+            if ils_rounds and islands:
+                raise ValueError(
+                    "'ilsRounds' is not supported with 'islands'"
+                )
             if islands:
                 from vrpms_tpu.mesh import solve_sa_islands
 
@@ -276,14 +281,28 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     resolve_eval_mode("auto"),
                 )
             deadline = opts.get("time_limit")
+            # explicit 0 means "stop as soon as possible", not "no limit"
+            deadline = float(deadline) if deadline is not None else None
+            if ils_rounds:
+                from vrpms_tpu.solvers import ILSParams, solve_ils
+
+                return solve_ils(
+                    inst,
+                    key=seed,
+                    params=ILSParams.from_budget(
+                        ils_rounds, p, p.n_iters, pool=max(pool, 16)
+                    ),
+                    weights=w,
+                    init_giants=init,
+                    deadline_s=deadline,
+                )
             return solve_sa(
                 inst,
                 key=seed,
                 params=p,
                 weights=w,
                 init_giants=init,
-                # explicit 0 means "stop as soon as possible", not "no limit"
-                deadline_s=float(deadline) if deadline is not None else None,
+                deadline_s=deadline,
                 pool=pool,
             )
         if algorithm == "aco":
@@ -459,6 +478,8 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
     # only SA/GA actually island-shard (bf/aco ignore the option)
     if opts.get("islands") and algorithm in ("sa", "ga"):
         stats["islands"] = _island_devices(opts)[0]
+    if opts.get("ils_rounds") and algorithm == "sa":
+        stats["ilsRounds"] = int(opts["ils_rounds"])
     if trace_dir:
         stats["profileDir"] = trace_dir
     return res, stats

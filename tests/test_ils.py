@@ -1,0 +1,63 @@
+"""Iterated local search: quality, validity, deadline, service wiring."""
+
+import numpy as np
+import pytest
+
+from vrpms_tpu.core.encoding import is_valid_giant
+from vrpms_tpu.solvers import ILSParams, SAParams, solve_ils, solve_sa
+from tests.test_sa import euclidean_cvrp
+
+
+class TestILS:
+    def test_valid_and_not_worse_than_plain_sa(self, rng):
+        inst = euclidean_cvrp(rng, n=20, v=4, q=10)
+        budget = 2000
+        plain = solve_sa(
+            inst, key=3, params=SAParams(n_chains=64, n_iters=budget)
+        )
+        ils = solve_ils(
+            inst,
+            key=3,
+            params=ILSParams(
+                rounds=4,
+                sa=SAParams(n_chains=64, n_iters=budget // 4),
+                pool=8,
+            ),
+        )
+        assert is_valid_giant(ils.giant, 19, 4)
+        # polish alone guarantees parity; reseeding usually wins outright
+        assert float(ils.cost) <= float(plain.cost) * 1.01 + 1e-3
+        assert int(ils.evals) > 0
+
+    def test_deadline_truncates_but_returns_valid(self, rng):
+        inst = euclidean_cvrp(rng, n=12, v=3, q=10)
+        res = solve_ils(
+            inst,
+            key=5,
+            params=ILSParams(
+                rounds=50, sa=SAParams(n_chains=16, n_iters=100_000), pool=4
+            ),
+            deadline_s=1e-6,
+        )
+        assert is_valid_giant(res.giant, 11, 3)
+        # round 0 always runs (truncated), later rounds are skipped
+        assert int(res.evals) < 50 * 16 * 100_000
+
+    def test_deterministic(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=15)
+        p = ILSParams(rounds=2, sa=SAParams(n_chains=16, n_iters=300), pool=4)
+        a = solve_ils(inst, key=9, params=p)
+        b = solve_ils(inst, key=9, params=p)
+        assert float(a.cost) == float(b.cost)
+        assert np.array_equal(np.asarray(a.giant), np.asarray(b.giant))
+
+    def test_tw_instance(self, rng):
+        from tests.test_core_cost import random_instance
+
+        inst = random_instance(rng, n=9, v=2, tw=True)
+        res = solve_ils(
+            inst,
+            key=1,
+            params=ILSParams(rounds=2, sa=SAParams(n_chains=16, n_iters=400), pool=4),
+        )
+        assert is_valid_giant(res.giant, 8, 2)

@@ -333,6 +333,26 @@ class TestVRPSolve:
         visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    def test_ils_rounds_solves_and_reports(self, server):
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=400, populationSize=16, ilsRounds=2,
+                     includeStats=True),
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        assert msg["stats"]["ilsRounds"] == 2
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+
+    def test_ils_rejects_islands_combo(self, server):
+        status, resp = post(
+            server, "/api/vrp/sa", vrp_body(ilsRounds=2, islands=2)
+        )
+        assert status == 400
+        assert any("islands" in e["reason"] for e in resp["errors"])
+
     def test_local_search_pool_rejects_nonsense(self, server):
         status, resp = post(
             server,

@@ -12,5 +12,6 @@ from vrpms_tpu.solvers.delta_ls import (
     move_delta_tables,
 )
 from vrpms_tpu.solvers.sa import SAParams, solve_sa
+from vrpms_tpu.solvers.ils import ILSParams, solve_ils
 from vrpms_tpu.solvers.ga import GAParams, solve_ga
 from vrpms_tpu.solvers.aco import ACOParams, solve_aco
