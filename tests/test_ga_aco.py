@@ -119,6 +119,16 @@ class TestACO:
         assert float(res.cost) <= opt * 1.10 + 1e-3
         assert float(res.breakdown.cap_excess) == 0.0
 
+    def test_candidate_list_competitive_with_full_sampling(self, rng):
+        """KNN-restricted construction (default) must not lose to full
+        sampling at equal budget (measured better at n>=100: BASELINE)."""
+        inst = euclidean_cvrp(rng, n=24, v=4, q=10)
+        budget = dict(n_ants=32, n_iters=80)
+        knn = solve_aco(inst, key=4, params=ACOParams(**budget, knn_k=8))
+        full = solve_aco(inst, key=4, params=ACOParams(**budget, knn_k=0))
+        assert is_valid_giant(knn.giant, 23, 4)
+        assert float(knn.cost) <= float(full.cost) * 1.10
+
     def test_deadline_truncates_but_returns_valid_best(self, rng):
         inst = euclidean_cvrp(rng, n=8, v=2, q=12)
         res = solve_aco(

@@ -35,9 +35,11 @@ class ACOParams:
     beta: float = 2.5         # heuristic (1/duration) exponent
     rho: float = 0.1          # evaporation rate
     fleet_penalty: float = 1_000.0
+    knn_k: int = 16           # candidate-list width for construction;
+                              # 0 = sample over all unvisited nodes
 
 
-def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto"):
+def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto", knn_mask=None):
     """All ants build customer orders in lockstep.
 
     Step k: score[a, c] = alpha*log tau[cur_a, c] + beta*log eta[cur_a, c]
@@ -46,6 +48,12 @@ def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto"):
     visited-set update run as one-hot matmul / mask ops on accelerators
     (gathers and scatters lower to scalar loops on TPU); the one-hot of
     the current node is reused from the previous step's argmax.
+
+    With `knn_mask` ([N, N] 0/1, knn_mask[u, v] = 1 iff v is one of u's
+    K nearest), sampling restricts to the current node's candidate list
+    — the classic construction speed/quality lever (most good next hops
+    are geometric neighbors) — falling back to all unvisited nodes for
+    ants whose whole candidate list is already visited.
     """
     from vrpms_tpu.core.cost import resolve_eval_mode
 
@@ -55,10 +63,20 @@ def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto"):
     )
     hot = resolve_eval_mode(mode) != "gather"
 
-    def pick(scores, visited, k):
+    def pick(scores, allowed, visited, k):
         gumbel = jax.random.gumbel(jax.random.fold_in(key, k), (n_ants, n_nodes))
-        scores = jnp.where(visited, -jnp.inf, scores + gumbel)
-        return jnp.argmax(scores, axis=1).astype(jnp.int32)
+        noisy = scores + gumbel
+        open_ = ~visited
+        if allowed is not None:
+            cand = allowed & open_
+            # fall back to the full unvisited set when the list is spent
+            has = cand.any(axis=1, keepdims=True)
+            cand = jnp.where(has, cand, open_)
+        else:
+            cand = open_
+        return jnp.argmax(jnp.where(cand, noisy, -jnp.inf), axis=1).astype(
+            jnp.int32
+        )
 
     visited0 = jnp.zeros((n_ants, n_nodes), dtype=bool).at[:, 0].set(True)
     if hot:
@@ -70,7 +88,18 @@ def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto"):
                 log_score.astype(jnp.bfloat16),
                 preferred_element_type=jnp.float32,
             )
-            nxt = pick(scores, visited, k)
+            allowed = None
+            if knn_mask is not None:
+                allowed = (
+                    jnp.einsum(
+                        "an,nm->am",
+                        cur_oh.astype(jnp.bfloat16),
+                        knn_mask.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32,
+                    )
+                    > 0.5
+                )
+            nxt = pick(scores, allowed, visited, k)
             nxt_oh = nxt[:, None] == jnp.arange(n_nodes)[None, :]
             return (nxt_oh.astype(jnp.float32), visited | nxt_oh), nxt
 
@@ -78,7 +107,8 @@ def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto"):
     else:
         def step(carry, k):
             cur, visited = carry
-            nxt = pick(log_score[cur], visited, k)
+            allowed = knn_mask[cur] > 0.5 if knn_mask is not None else None
+            nxt = pick(log_score[cur], allowed, visited, k)
             visited = visited.at[jnp.arange(n_ants), nxt].set(True)
             return (nxt, visited), nxt
 
@@ -101,7 +131,7 @@ def _aco_block_fn(params: ACOParams, n_block: int):
     so requests differing only in iteration budget share one compile."""
 
     @jax.jit
-    def run(state, key, inst, w, start_it):
+    def run(state, key, inst, w, start_it, knn_mask):
         n_nodes = inst.n_nodes
         fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
         d = inst.durations[0]
@@ -112,7 +142,9 @@ def _aco_block_fn(params: ACOParams, n_block: int):
         def iteration(state, it):
             tau, best_perm, best_fit = state
             k_it = jax.random.fold_in(key, it)
-            orders = _construct_orders(k_it, tau ** alpha, eta, params.n_ants)
+            orders = _construct_orders(
+                k_it, tau ** alpha, eta, params.n_ants, knn_mask=knn_mask
+            )
             fits = fitness(orders)
             champ = jnp.argmin(fits)
             it_best_perm, it_best_fit = orders[champ], fits[champ]
@@ -175,11 +207,25 @@ def solve_aco(
     if isinstance(key, int):
         key = jax.random.key(key)
 
-    block_params = dataclasses.replace(params, n_iters=0)
+    # normalize everything the traced block never reads out of the
+    # compile key (knn_k only shapes the dynamic knn_mask argument)
+    block_params = dataclasses.replace(params, n_iters=0, knn_k=0)
     state = _aco_init_fn(block_params)(inst, w)
+    knn_mask = None
+    if params.knn_k > 0:
+        import numpy as np
+
+        from vrpms_tpu.moves import knn_table
+
+        tbl = np.asarray(knn_table(inst.durations[0], params.knn_k))
+        mask = np.zeros((inst.n_nodes, inst.n_nodes), dtype=bool)
+        mask[np.arange(inst.n_nodes)[:, None], tbl] = True
+        knn_mask = jnp.asarray(mask)
 
     def step_block(st, nb, start):
-        return _aco_block_fn(block_params, nb)(st, key, inst, w, jnp.int32(start))
+        return _aco_block_fn(block_params, nb)(
+            st, key, inst, w, jnp.int32(start), knn_mask
+        )
 
     state, done = run_blocked(
         step_block, state, params.n_iters, 16, deadline_s, lambda st: st[2]
