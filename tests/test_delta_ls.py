@@ -17,14 +17,23 @@ from vrpms_tpu.core.cost import CostWeights, evaluate_giant
 from vrpms_tpu.core.encoding import is_valid_giant, random_giant_batch
 from vrpms_tpu.core.instance import make_instance
 from vrpms_tpu.io.synth import synth_cvrp
-from vrpms_tpu.moves.moves import _segment_src_map, apply_src_map
+from vrpms_tpu.moves.moves import apply_src_map
 from vrpms_tpu.solvers import local_search
 from vrpms_tpu.solvers.delta_ls import (
-    decode_move,
     delta_polish,
     delta_polish_batch,
     move_delta_tables,
+    move_src_map,
 )
+
+
+def _apply_move(giants_b, t, i, j):
+    """Apply one table slot via the production src-map path."""
+    length = giants_b.shape[1]
+    src = move_src_map(
+        jnp.int32([t]), jnp.int32([i]), jnp.int32([j]), length
+    )
+    return apply_src_map(giants_b, src)[0]
 
 
 def _asym_instance(n_customers, n_vehicles, rng, seed=0):
@@ -59,17 +68,7 @@ def test_deltas_match_full_eval_asymmetric(rng, n_vehicles):
                     delta = tables[b, t, i, j]
                     if not np.isfinite(delta):
                         continue
-                    mt, lo, hi, m = decode_move(
-                        jnp.int32(t), jnp.int32(i), jnp.int32(j)
-                    )
-                    src = _segment_src_map(
-                        jnp.reshape(lo, (1, 1)),
-                        jnp.reshape(hi, (1, 1)),
-                        jnp.reshape(mt, (1, 1)),
-                        jnp.reshape(m, (1, 1)),
-                        length,
-                    )
-                    moved = apply_src_map(giants[b][None], src)[0]
+                    moved = _apply_move(giants[b][None], t, i, j)
                     assert is_valid_giant(moved, 9, n_vehicles)
                     true_delta = _distance(moved, inst) - base
                     assert delta == pytest.approx(true_delta, abs=1e-3), (
@@ -106,17 +105,7 @@ def test_cap_deltas_exact_or_penalized(rng):
                     if pred == pytest.approx(penalty):
                         n_pen += 1
                         continue
-                    mt, lo, hi, m = decode_move(
-                        jnp.int32(t), jnp.int32(i), jnp.int32(j)
-                    )
-                    src = _segment_src_map(
-                        jnp.reshape(lo, (1, 1)),
-                        jnp.reshape(hi, (1, 1)),
-                        jnp.reshape(mt, (1, 1)),
-                        jnp.reshape(m, (1, 1)),
-                        length,
-                    )
-                    moved = apply_src_map(giants[b][None], src)[0]
+                    moved = _apply_move(giants[b][None], t, i, j)
                     true = float(evaluate_giant(moved, inst).cap_excess) - base
                     assert pred == pytest.approx(true, abs=1e-3), (
                         f"table {t} move ({i},{j}): predicted cap delta "

@@ -58,7 +58,9 @@ from vrpms_tpu.solvers.common import SolveResult
 #   0: 2-opt reverse [i, j]
 #   1: swap i, j (non-adjacent; adjacent swaps ARE reversals)
 #   2/3/4: or-opt relocate segment [i, i+s-1], s = 1/2/3, to after j
-N_TABLES = 5
+#   5/6:   or-opt relocate REVERSED segment, s = 2/3 (s = 1 flips to
+#          itself); the classic second or-opt orientation
+N_TABLES = 7
 _INF = jnp.float32(jnp.inf)
 BIGF = 1e18  # sentinel for "no separator to the right" scans
 
@@ -87,7 +89,7 @@ def _shift(a: jax.Array, di: int, dj: int) -> jax.Array:
 
 
 def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> jax.Array:
-    """[B, 5, L, L] distance deltas; +inf marks invalid (i, j) slots.
+    """[B, N_TABLES, L, L] distance deltas; +inf marks invalid slots.
 
     Entry [b, t, i, j] is the EXACT change in total leg distance (of the
     mode's rounded matrix, slice 0) when move (t, i, j) is applied to
@@ -148,8 +150,10 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
     )
     swp = jnp.where(interior_i & interior_j & (j_idx >= i_idx + 2), swp, _INF)
 
-    # --- or-opt relocate [i, i+s-1] to after j -------------------------
+    # --- or-opt relocate [i, i+s-1] to after j, both orientations ------
     tables = [rev, swp]
+    flip_tables = []
+    cf, cb = cum_f[:, :length], cum_b[:, :length]
     for s in (1, 2, 3):
         # closing leg P[i-1, i+s] = the (s+1)-offset diagonal at i-1
         dg = jnp.diagonal(p, offset=s + 1, axis1=1, axis2=2)
@@ -168,8 +172,23 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
         j_ok = (j_idx <= length - 2) & ((j_idx <= i_idx - 2) | (j_idx >= i_idx + s))
         rel = jnp.where(seg_ok & j_ok, insertion - removal, _INF)
         tables.append(rel)
+        if s >= 2:
+            # Reversed insertion: (j -> i+s-1), flipped interior legs,
+            # (i -> j+1). The segment's interior travels backwards, so
+            # its fwd legs are re-costed from the bwd cumsum (exact on
+            # asymmetric matrices, like the 2-opt interior term).
+            interior = row((rshift(cb, s - 1) - cb) - (rshift(cf, s - 1) - cf))
+            ins_flip = (
+                _shift(pt, s - 1, 0)  # P[j, i+s-1]
+                + _shift(p, 0, 1)     # P[i, j+1]
+                - fwd_j
+                + interior
+            )
+            flip_tables.append(
+                jnp.where(seg_ok & j_ok, ins_flip - removal, _INF)
+            )
 
-    return jnp.stack(tables, axis=1)
+    return jnp.stack(tables + flip_tables, axis=1)
 
 
 def _select_by_pos(pos_oh: jax.Array, vec: jax.Array, mode: str, idx=None):
@@ -182,7 +201,7 @@ def _select_by_pos(pos_oh: jax.Array, vec: jax.Array, mode: str, idx=None):
 
 
 def cap_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> jax.Array:
-    """[B, 5, L, L] capacity-excess deltas for the same move slots.
+    """[B, N_TABLES, L, L] capacity-excess deltas, same move slots.
 
     Without this term, distance-only ranking collapses on tight-capacity
     instances: the best distance deltas are all capacity-busting
@@ -320,7 +339,10 @@ def cap_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> j
         0.0,
     )
 
-    # relocation of a separator-free segment [i, i+s-1] to after j
+    # relocation of a separator-free segment [i, i+s-1] to after j;
+    # load shifts are orientation-blind, so the reversed-relocation
+    # tables (s = 2, 3) reuse the same entries
+    flip_tables = []
     for s in (1, 2, 3):
         q_seg = jnp.roll(cum_dem, -s, axis=1)[:, :length] - cum_dem[:, :length]
         pure = (
@@ -339,17 +361,21 @@ def cap_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> j
             rel = rel + sep1  # disjoint: `pure` excludes zero segments
         else:
             rel = jnp.where(row(pure), rel, unmodeled)
+            flip_tables.append(rel)
         tables.append(rel)
 
-    return jnp.stack(tables, axis=1)
+    return jnp.stack(tables + flip_tables, axis=1)
 
 
 def decode_move(t: jax.Array, i: jax.Array, j: jax.Array):
-    """Flat table slot -> (move_type, lo, hi, m) for moves._segment_src_map.
+    """Table slot (t <= 4) -> (move_type, lo, hi, m) for
+    moves._segment_src_map.
 
     Reverse and swap map directly; a relocation is a rotation of the
     window between the segment and its insertion point (forward: rotate
     [i, j] left by s; backward: rotate [j+1, i+s-1] left by i-j-1).
+    Reversed relocations (t >= 5) are not rotations — move_src_map
+    builds their permutation directly.
     """
     s = t - 1  # segment length for relocation tables
     forward = j >= i + s
@@ -358,6 +384,38 @@ def decode_move(t: jax.Array, i: jax.Array, j: jax.Array):
     hi = jnp.where(t <= 1, j, jnp.where(forward, j, i + s - 1))
     m = jnp.where(t <= 1, 1, jnp.where(forward, s, i - j - 1))
     return mt, lo, hi, m
+
+
+def move_src_map(t, i, j, length: int) -> jax.Array:
+    """(M,) table slots -> (M, L) gather maps applying each move.
+
+    The single apply path for every table (the sweep and the tests use
+    exactly this, so the formulas and the application can never drift):
+    t <= 4 routes through moves._segment_src_map; t >= 5 (reversed
+    relocation) writes its permutation directly — relocate [i, i+s-1]
+    after j with the segment flipped end-to-end.
+    """
+    shape = lambda a: jnp.asarray(a, jnp.int32).reshape(-1, 1)
+    t, i, j = shape(t), shape(i), shape(j)
+    mt, lo, hi, m = decode_move(t, i, j)
+    base = _segment_src_map(lo, hi, mt, m, length)
+
+    s = t - 3  # segment length for the reversed-relocation tables
+    k = jnp.arange(length, dtype=jnp.int32)[None, :]
+    # forward (j >= i+s): window [i, j] = shifted tail, then flipped seg
+    src_f = jnp.where(
+        (k >= i) & (k <= j - s),
+        k + s,
+        jnp.where((k > j - s) & (k <= j), i + (j - k), k),
+    )
+    # backward (j <= i-2): window [j+1, i+s-1] = flipped seg, then shift
+    src_b = jnp.where(
+        (k >= j + 1) & (k <= j + s),
+        i + (j + s - k),
+        jnp.where((k > j + s) & (k <= i + s - 1), k - s, k),
+    )
+    src_flip = jnp.where(j >= i + s, src_f, src_b)
+    return jnp.where(t >= 5, src_flip, base)
 
 
 def _sweep(giants, costs, inst, w, mode, top_k):
@@ -374,16 +432,12 @@ def _sweep(giants, costs, inst, w, mode, top_k):
     t = idx // (length * length)
     rem = idx % (length * length)
     i, j = rem // length, rem % length
-    mt, lo, hi, m = decode_move(t, i, j)
     # invalid slots (masked +inf deltas) become identity swaps
     one = jnp.ones((), jnp.int32)
-    mt = jnp.where(valid, mt, 2)
-    lo = jnp.where(valid, lo, one)
-    hi = jnp.where(valid, hi, one)
-    m = jnp.where(valid, m, one)
-
-    flat = lambda a: a.reshape(b * top_k, 1).astype(jnp.int32)
-    src = _segment_src_map(flat(lo), flat(hi), flat(mt), flat(m), length)
+    t = jnp.where(valid, t, 1)  # table 1 = swap; lo == hi is identity
+    i = jnp.where(valid, i, one)
+    j = jnp.where(valid, j, one)
+    src = move_src_map(t, i, j, length)
     cands = apply_src_map(
         jnp.repeat(giants, top_k, axis=0), src, mode=mode
     ).reshape(b, top_k, length)
