@@ -105,8 +105,9 @@ def parse_solver_options(content: dict, errors):
                         devices of the mesh (vrpms_tpu.mesh): per-device
                         populations with ring elite migration. Clamped
                         to the devices actually attached; ignored by
-                        bf/aco. Island runs are single-shot compiled
-                        programs: timeLimit and warmStart do not apply
+                        bf/aco. timeLimit applies (migration blocks run
+                        in clock-checked chunks); warmStart does not,
+                        and ilsRounds/localSearchPool>1 are rejected
     migrateEvery:       steps between ring migrations (default 100)
     migrants:           elites sent to the ring neighbor (default 4)
     """

@@ -262,8 +262,15 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 from vrpms_tpu.mesh import solve_sa_islands
 
                 mesh, ip = _island_setup(opts)
+                deadline = opts.get("time_limit")
                 return solve_sa_islands(
-                    inst, key=seed, mesh=mesh, params=p, island_params=ip, weights=w
+                    inst,
+                    key=seed,
+                    mesh=mesh,
+                    params=p,
+                    island_params=ip,
+                    weights=w,
+                    deadline_s=float(deadline) if deadline is not None else None,
                 )
             init = None
             if warm is not None:
@@ -327,8 +334,15 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 from vrpms_tpu.mesh import solve_ga_islands
 
                 mesh, ip = _island_setup(opts)
+                deadline = opts.get("time_limit")
                 return solve_ga_islands(
-                    inst, key=seed, mesh=mesh, params=p, island_params=ip, weights=w
+                    inst,
+                    key=seed,
+                    mesh=mesh,
+                    params=p,
+                    island_params=ip,
+                    weights=w,
+                    deadline_s=float(deadline) if deadline is not None else None,
                 )
             init = None
             if warm is not None:

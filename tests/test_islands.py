@@ -52,6 +52,59 @@ class TestIslandMesh:
         )
         assert is_valid_giant(res.giant, 7, 2)
 
+    def test_sa_islands_deadline_matches_unbounded_when_never_hit(self, rng):
+        """The chunked deadline program must reproduce the single-shot
+        one exactly (same fold-in indices, same migration points)."""
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        kw = dict(
+            key=3,
+            params=SAParams(n_chains=32, n_iters=450),
+            island_params=IslandParams(migrate_every=100, n_migrants=2),
+        )
+        free = solve_sa_islands(inst, **kw)
+        timed = solve_sa_islands(inst, deadline_s=3600.0, **kw)
+        assert float(free.cost) == float(timed.cost)
+        assert np.array_equal(np.asarray(free.giant), np.asarray(timed.giant))
+        assert int(free.evals) == int(timed.evals)
+
+    def test_ga_islands_deadline_matches_unbounded_when_never_hit(self, rng):
+        inst = euclidean_cvrp(rng, n=9, v=2, q=15)
+        kw = dict(
+            key=4,
+            params=GAParams(population=32, generations=110, elites=2),
+            island_params=IslandParams(migrate_every=50, n_migrants=2),
+        )
+        free = solve_ga_islands(inst, **kw)
+        timed = solve_ga_islands(inst, deadline_s=3600.0, **kw)
+        assert float(free.cost) == float(timed.cost)
+        assert np.array_equal(np.asarray(free.giant), np.asarray(timed.giant))
+
+    def test_islands_deadline_truncates(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        res = solve_sa_islands(
+            inst,
+            key=5,
+            params=SAParams(n_chains=32, n_iters=100_000),
+            island_params=IslandParams(migrate_every=100, n_migrants=2),
+            deadline_s=1e-6,
+        )
+        assert is_valid_giant(res.giant, 9, 2)
+        assert 0 < int(res.evals) < 32 * 100_000
+
+    def test_islands_deadline_bounds_migrationless_tail(self, rng):
+        """migrateEvery > n_iters leaves everything in the tail; the
+        deadline must still truncate it (chunked, not one shot)."""
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        res = solve_sa_islands(
+            inst,
+            key=6,
+            params=SAParams(n_chains=32, n_iters=100_000),
+            island_params=IslandParams(migrate_every=10_000_000, n_migrants=2),
+            deadline_s=1e-6,
+        )
+        assert is_valid_giant(res.giant, 9, 2)
+        assert 0 < int(res.evals) < 32 * 100_000
+
     def test_migration_spreads_elites(self, rng):
         # With migration every step and a tiny per-island batch, all
         # islands should converge on comparable costs; mainly this

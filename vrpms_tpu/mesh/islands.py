@@ -164,6 +164,114 @@ def _sa_islands_fn(mesh: Mesh, n_iters: int, island_params: IslandParams, mode: 
     return jax.jit(run)
 
 
+@lru_cache(maxsize=64)
+def _sa_islands_chunk_fn(
+    mesh: Mesh, n_blocks: int, block_len: int, k_mig: int, mode: str
+):
+    """One jitted CHUNK of n_blocks migration blocks over the mesh.
+
+    The deadline-aware twin of _sa_islands_fn: full sharded state in and
+    out, with the absolute iteration offset and the schedule horizon as
+    dynamic scalars — chunks compose to exactly the single-shot program
+    (same fold-in indices, same migration points), so the host can check
+    the wall clock between chunks (_deadline_driver's contract).
+    `block_len` == 0 marks a migration-free tail chunk of n_blocks
+    single iterations.
+    """
+    n_isl = mesh.shape["islands"]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("islands"), P(), P(), P(), P(), P(), P(), P(), P()),
+        out_specs=P("islands"),
+        check_vma=False,
+    )
+    def run(state, k_run, inst, w, t0, t1, knn, start_it, horizon):
+        isl = jax.lax.axis_index("islands")
+        k_isl = jax.random.fold_in(k_run, isl)
+
+        def inner(st, it):
+            giants, costs, best_g, best_c = st
+            giants, costs = sa_chain_step(
+                giants, costs, k_isl, it, t0, t1, horizon, inst, w, mode, knn
+            )
+            better = costs < best_c
+            best_g = jnp.where(better[:, None], giants, best_g)
+            best_c = jnp.where(better, costs, best_c)
+            return (giants, costs, best_g, best_c), None
+
+        if block_len == 0:  # tail: plain iterations, no migration
+            state, _ = jax.lax.scan(
+                inner, state, start_it + jnp.arange(n_blocks)
+            )
+            return state
+
+        def block(st, b):
+            st, _ = jax.lax.scan(
+                inner, st, start_it + b * block_len + jnp.arange(block_len)
+            )
+            giants, costs, best_g, best_c = st
+            giants, costs = _migrate(giants, costs, k_mig, "islands", n_isl)
+            return (giants, costs, best_g, best_c), None
+
+        state, _ = jax.lax.scan(block, state, jnp.arange(n_blocks))
+        return state
+
+    return jax.jit(run)
+
+
+# the chunked paths reduce full sharded best-pools with the same rule
+_champion = jax.jit(_pick_champion)
+
+
+def _deadline_driver(
+    call, state, total: int, block_len: int, sync_iters: int, deadline_s: float
+):
+    """Host-clock-checked execution of `total` island iterations: full
+    migration blocks in chunks of ~sync_iters iterations, then the
+    migration-free tail in chunks of the same budget — ONE driver for SA
+    and GA so deadline semantics cannot diverge. call(state, n, bl,
+    start) runs n blocks of bl iterations (bl == 0: n single iterations)
+    from absolute iteration offset `start`. At least one chunk always
+    runs; afterwards the clock is checked before and after every chunk.
+    Returns (state, done)."""
+    import time
+
+    n_blocks, tail = _blocked_schedule(total, block_len)
+    chunk = max(1, sync_iters // max(block_len, 1))
+    t_start = time.monotonic()
+
+    def spent():
+        return time.monotonic() - t_start >= deadline_s
+
+    def sync(st):
+        jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+
+    done = 0
+    b = 0
+    while b < n_blocks:
+        nb = min(chunk, n_blocks - b)
+        state = call(state, nb, block_len, b * block_len)
+        sync(state)
+        b += nb
+        done = b * block_len
+        if spent():
+            return state, done
+    t = 0
+    while t < tail:
+        if done > 0 and spent():
+            break
+        nt = min(sync_iters, tail - t)
+        state = call(state, nt, 0, n_blocks * block_len + t)
+        sync(state)
+        t += nt
+        done += nt
+        if spent():
+            break
+    return state, done
+
+
 def solve_sa_islands(
     inst: Instance,
     key: jax.Array | int = 0,
@@ -172,8 +280,14 @@ def solve_sa_islands(
     island_params: IslandParams = IslandParams(),
     weights: CostWeights | None = None,
     mode: str = "auto",
+    deadline_s: float | None = None,
 ) -> SolveResult:
-    """SA with per-device chain batches + ring elite migration."""
+    """SA with per-device chain batches + ring elite migration.
+
+    With `deadline_s`, migration blocks (and the migration-free tail)
+    run in host-clock-checked chunks; the chunked program reproduces the
+    single-shot one exactly when the deadline is never hit.
+    """
     w = weights or CostWeights.make()
     mode = resolve_eval_mode(mode)
     if isinstance(key, int):
@@ -190,17 +304,38 @@ def solve_sa_islands(
     giants0 = initial_giants(k_init, n_isl * chains_local, inst, params, mode)
 
     knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
-    run = _sa_islands_fn(mesh, n_iters, island_params, mode)
-    g_all, c_all = run(
-        giants0, k_run, inst, w, jnp.float32(t0), jnp.float32(t1), knn
-    )
-    g, c = _pick_champion(g_all, c_all)
+    t0j, t1j = jnp.float32(t0), jnp.float32(t1)
+    if deadline_s is None:
+        run = _sa_islands_fn(mesh, n_iters, island_params, mode)
+        g_all, c_all = run(giants0, k_run, inst, w, t0j, t1j, knn)
+        g, c = _pick_champion(g_all, c_all)
+        done = n_iters
+    else:
+        from vrpms_tpu.solvers.sa import _sa_init_fn
+
+        block_len = island_params.migrate_every
+        k_mig = island_params.n_migrants
+        horizon = jnp.float32(n_iters)
+        costs0 = _sa_init_fn(mode)(giants0, inst, w)
+        state = (giants0, costs0, giants0, costs0)
+
+        def call(st, n, bl, start):
+            return _sa_islands_chunk_fn(mesh, n, bl, k_mig, mode)(
+                st, k_run, inst, w, t0j, t1j, knn, jnp.int32(start), horizon
+            )
+
+        # ~512 iterations per host sync
+        state, done = _deadline_driver(
+            call, state, n_iters, block_len, 512, deadline_s
+        )
+        _, _, best_g, best_c = state
+        g, c = _champion(best_g, best_c)
     bd = evaluate_giant(g, inst)
     return SolveResult(
         g,
         total_cost(bd, w),
         bd,
-        jnp.int32(n_isl * chains_local * n_iters),
+        jnp.int32(n_isl * chains_local * done),
     )
 
 
@@ -260,6 +395,85 @@ def _ga_islands_fn(
     return jax.jit(run)
 
 
+@lru_cache(maxsize=64)
+def _ga_islands_chunk_fn(
+    mesh: Mesh,
+    n_blocks: int,
+    block_len: int,
+    local_params: GAParams,
+    k_mig: int,
+    mode: str,
+):
+    """One jitted chunk of n_blocks GA migration blocks (the deadline-
+    aware twin of _ga_islands_fn — see _sa_islands_chunk_fn's contract).
+    Per-island bests travel as [1, n]/[1] rows so the sharded state
+    round-trips between chunks. Callers normalize `generations` to 0 in
+    local_params (the chunk never reads it). block_len == 0 marks a
+    migration-free tail of n_blocks single generations."""
+    n_isl = mesh.shape["islands"]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("islands"), P(), P(), P(), P()),
+        out_specs=P("islands"),
+        check_vma=False,
+    )
+    def run(state, k_run, inst, w, start_gen):
+        fitness = perm_fitness_fn(inst, w, local_params.fleet_penalty, mode=mode)
+        isl = jax.lax.axis_index("islands")
+        k_isl = jax.random.fold_in(k_run, isl)
+        perms, fits, best_p1, best_f1 = state
+        st = (perms, fits, best_p1[0], best_f1[0])
+
+        def inner(st, gen):
+            perms, fits, best_p, best_f = st
+            perms, fits = ga_generation(
+                perms, fits, k_isl, gen, fitness, local_params, mode
+            )
+            champ = jnp.argmin(fits)
+            better = fits[champ] < best_f
+            best_p = jnp.where(better, perms[champ], best_p)
+            best_f = jnp.where(better, fits[champ], best_f)
+            return (perms, fits, best_p, best_f), None
+
+        if block_len == 0:
+            st, _ = jax.lax.scan(inner, st, start_gen + jnp.arange(n_blocks))
+        else:
+            def block(st, b):
+                st, _ = jax.lax.scan(
+                    inner, st, start_gen + b * block_len + jnp.arange(block_len)
+                )
+                perms, fits, best_p, best_f = st
+                perms, fits = _migrate(perms, fits, k_mig, "islands", n_isl)
+                return (perms, fits, best_p, best_f), None
+
+            st, _ = jax.lax.scan(block, st, jnp.arange(n_blocks))
+        perms, fits, best_p, best_f = st
+        return (perms, fits, best_p[None], best_f[None])
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=8)
+def _ga_islands_init_fn(fleet_penalty: float, n_isl: int, mode: str):
+    """Jitted initial fitness + per-island incumbent extraction."""
+
+    @jax.jit
+    def init(perms0, inst, w):
+        fitness = perm_fitness_fn(inst, w, fleet_penalty, mode=mode)
+        fits0 = fitness(perms0)
+        pop_local = perms0.shape[0] // n_isl
+        fr = fits0.reshape(n_isl, pop_local)
+        idx = jnp.argmin(fr, axis=1)
+        rows = jnp.arange(n_isl)
+        best_p = perms0.reshape(n_isl, pop_local, -1)[rows, idx]
+        best_f = fr[rows, idx]
+        return fits0, best_p, best_f
+
+    return init
+
+
 def solve_ga_islands(
     inst: Instance,
     key: jax.Array | int = 0,
@@ -268,8 +482,13 @@ def solve_ga_islands(
     island_params: IslandParams = IslandParams(),
     weights: CostWeights | None = None,
     mode: str = "auto",
+    deadline_s: float | None = None,
 ) -> SolveResult:
-    """GA with per-device sub-populations + ring elite migration."""
+    """GA with per-device sub-populations + ring elite migration.
+
+    With `deadline_s`, migration blocks run in host-clock-checked chunks
+    (see solve_sa_islands).
+    """
     w = weights or CostWeights.make()
     if isinstance(key, int):
         key = jax.random.key(key)
@@ -281,22 +500,41 @@ def solve_ga_islands(
     )
     local_params = dataclasses.replace(params, population=pop_local)
     generations = params.generations
+    mode = resolve_eval_mode(mode)
 
     k_init, k_run = jax.random.split(key)
-    perms0 = initial_perms(
-        k_init, n_isl * pop_local, inst, params, resolve_eval_mode(mode)
-    )
+    perms0 = initial_perms(k_init, n_isl * pop_local, inst, params, mode)
 
-    run = _ga_islands_fn(
-        mesh, local_params, island_params, resolve_eval_mode(mode)
-    )
-    p_all, f_all = run(perms0, k_run, inst, w)
-    best_perm, _ = _pick_champion(p_all, f_all)
+    if deadline_s is None:
+        run = _ga_islands_fn(mesh, local_params, island_params, mode)
+        p_all, f_all = run(perms0, k_run, inst, w)
+        best_perm, _ = _pick_champion(p_all, f_all)
+        done = generations
+    else:
+        block_len = island_params.migrate_every
+        k_mig = island_params.n_migrants
+        chunk_params = dataclasses.replace(local_params, generations=0)
+        fits0, best_p0, best_f0 = _ga_islands_init_fn(
+            params.fleet_penalty, n_isl, mode
+        )(perms0, inst, w)
+        state = (perms0, fits0, best_p0, best_f0)
+
+        def call(st, n, bl, start):
+            return _ga_islands_chunk_fn(
+                mesh, n, bl, chunk_params, k_mig, mode
+            )(st, k_run, inst, w, jnp.int32(start))
+
+        # ~128 generations per host sync (a generation costs more)
+        state, done = _deadline_driver(
+            call, state, generations, block_len, 128, deadline_s
+        )
+        _, _, best_p, best_f = state
+        best_perm, _ = _champion(best_p, best_f)
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)
     return SolveResult(
         giant,
         total_cost(bd, w),
         bd,
-        jnp.int32(n_isl * pop_local * generations),
+        jnp.int32(n_isl * pop_local * done),
     )
