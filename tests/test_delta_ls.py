@@ -31,7 +31,7 @@ def _apply_move(giants_b, t, i, j):
     """Apply one table slot via the production src-map path."""
     length = giants_b.shape[1]
     src = move_src_map(
-        jnp.int32([t]), jnp.int32([i]), jnp.int32([j]), length
+        jnp.int32([t]), jnp.int32([i]), jnp.int32([j]), length, giants=giants_b
     )
     return apply_src_map(giants_b, src)[0]
 

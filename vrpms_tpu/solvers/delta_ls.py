@@ -60,7 +60,10 @@ from vrpms_tpu.solvers.common import SolveResult
 #   2/3/4: or-opt relocate segment [i, i+s-1], s = 1/2/3, to after j
 #   5/6:   or-opt relocate REVERSED segment, s = 2/3 (s = 1 flips to
 #          itself); the classic second or-opt orientation
-N_TABLES = 7
+#   7:     2-opt* suffix exchange — route of i and route of j (a later
+#          route) trade their suffixes after i resp. j, orientation
+#          preserved; the classic inter-route tail move
+N_TABLES = 8
 _INF = jnp.float32(jnp.inf)
 BIGF = 1e18  # sentinel for "no separator to the right" scans
 
@@ -188,7 +191,73 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
                 jnp.where(seg_ok & j_ok, ins_flip - removal, _INF)
             )
 
-    return jnp.stack(tables + flip_tables, axis=1)
+    # --- 2-opt*: routes of i and j (a later route) trade suffixes ------
+    # Suffix of position k = everything after k up to k's route-closing
+    # separator. New legs: (i -> j+1), (B-tail -> i's old close),
+    # (j -> i+1), (A-tail -> j's old close); an empty donor suffix
+    # degenerates to a direct close. Orientation is preserved, so no
+    # interior re-costing — this is the inter-route tail move the
+    # window-based families above cannot express.
+    rid = _rid_batch(giants)
+    nz_after, at_idx, suf_len = _suffix_structure(giants)
+    nz_clip = jnp.clip(nz_after, 0, length - 1)
+    if mode == "gather":
+        # direct O(L^2) indexing on CPU; the one-hot matmuls below would
+        # be O(L^3) dense contractions — catastrophic off the MXU
+        fwd_tail = jnp.take_along_axis(fwd_at, at_idx, axis=1)
+        p_close = jnp.take_along_axis(p, nz_clip[:, :, None], axis=2)[:, :, 0]
+        pr = jnp.take_along_axis(
+            p, jnp.broadcast_to(at_idx[:, :, None], p.shape), axis=1
+        )
+        y = jnp.take_along_axis(
+            pr, jnp.broadcast_to(nz_clip[:, None, :], p.shape), axis=2
+        )
+    else:
+        at_oh = _onehot(at_idx, length, jnp.float32)
+        nz_oh = _onehot(nz_clip, length, jnp.float32)
+        fwd_tail = _select_by_pos(at_oh, fwd_at, mode)
+        # P[k, nz_after[k]]: the direct-close leg from k
+        p_close = jnp.einsum(
+            "bkm,bkm->bk", p, nz_oh, preferred_element_type=jnp.float32
+        )
+        # Y[b, x, y] = P[at_idx[x], nz_after[y]]: both tail->close legs
+        pr = jnp.einsum("bxr,brc->bxc", at_oh, p, preferred_element_type=jnp.float32)
+        y = jnp.einsum("bxc,byc->bxy", pr, nz_oh, preferred_element_type=jnp.float32)
+
+    a_empty = row(suf_len == 0)
+    b_empty = col(suf_len == 0)
+    added_a = jnp.where(
+        b_empty, row(p_close), _shift(p, 0, 1) + jnp.swapaxes(y, 1, 2)
+    )
+    added_b = jnp.where(a_empty, col(p_close), _shift(pt, 1, 0) + y)
+    removed_a = fwd_i + jnp.where(a_empty, 0.0, row(fwd_tail))
+    removed_b = fwd_j + jnp.where(b_empty, 0.0, col(fwd_tail))
+    star_ok = (
+        (col(rid) > row(rid))
+        & (i_idx <= length - 2)
+        & (j_idx <= length - 2)
+        & ~(a_empty & b_empty)
+    )
+    star = jnp.where(star_ok, added_a + added_b - removed_a - removed_b, _INF)
+
+    return jnp.stack(tables + flip_tables + [star], axis=1)
+
+
+def _suffix_structure(giants: jax.Array):
+    """(nz_after, at_idx, suf_len): per position, the index of the next
+    separator strictly after it, the index of its route-suffix tail, and
+    that suffix's length (0 when the next position is a separator).
+    Entries at L-1 are wrapped garbage; consumers mask them."""
+    b, length = giants.shape
+    idx = jnp.arange(length, dtype=jnp.int32)[None, :]
+    masked = jnp.where(giants == 0, idx, length)
+    nz_geq = jnp.flip(
+        jax.lax.cummin(jnp.flip(masked, axis=1), axis=1), axis=1
+    )
+    nz_after = jnp.roll(nz_geq, -1, axis=1)
+    at_idx = jnp.clip(nz_after - 1, 0, length - 1)
+    suf_len = nz_after - idx - 1
+    return nz_after, at_idx, jnp.broadcast_to(suf_len, (b, length))
 
 
 def _select_by_pos(pos_oh: jax.Array, vec: jax.Array, mode: str, idx=None):
@@ -364,7 +433,27 @@ def cap_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> j
             flip_tables.append(rel)
         tables.append(rel)
 
-    return jnp.stack(tables + flip_tables, axis=1)
+    # 2-opt* suffix exchange: each route keeps its vehicle slot (the
+    # separator ORDER is preserved), so the load swap is exact even for
+    # heterogeneous fleets. suffix[k] counts demand from k to its route
+    # close, so rolling by one gives the demand strictly AFTER k (a
+    # separator's "after" is the whole route it opens).
+    suf_after = jnp.roll(suffix, -1, axis=1)
+    star_a = (
+        jnp.maximum(
+            row(load_at) - row(suf_after) + col(suf_after) - row(cap_at), 0.0
+        )
+        - row(exc_at)
+    )
+    star_b = (
+        jnp.maximum(
+            col(load_at) - col(suf_after) + row(suf_after) - col(cap_at), 0.0
+        )
+        - col(exc_at)
+    )
+    star = jnp.where(col(rid) > row(rid), star_a + star_b, 0.0)
+
+    return jnp.stack(tables + flip_tables + [star], axis=1)
 
 
 def decode_move(t: jax.Array, i: jax.Array, j: jax.Array):
@@ -386,14 +475,16 @@ def decode_move(t: jax.Array, i: jax.Array, j: jax.Array):
     return mt, lo, hi, m
 
 
-def move_src_map(t, i, j, length: int) -> jax.Array:
+def move_src_map(t, i, j, length: int, giants: jax.Array | None = None) -> jax.Array:
     """(M,) table slots -> (M, L) gather maps applying each move.
 
     The single apply path for every table (the sweep and the tests use
     exactly this, so the formulas and the application can never drift):
-    t <= 4 routes through moves._segment_src_map; t >= 5 (reversed
-    relocation) writes its permutation directly — relocate [i, i+s-1]
-    after j with the segment flipped end-to-end.
+    t <= 4 routes through moves._segment_src_map; t = 5/6 (reversed
+    relocation) and t = 7 (2-opt* suffix exchange) write their
+    permutations directly. t = 7 depends on where each tour's
+    separators sit, so `giants` ([M, L], row-aligned with the slots) is
+    required when any slot uses it.
     """
     shape = lambda a: jnp.asarray(a, jnp.int32).reshape(-1, 1)
     t, i, j = shape(t), shape(i), shape(j)
@@ -415,7 +506,43 @@ def move_src_map(t, i, j, length: int) -> jax.Array:
         jnp.where((k > j + s) & (k <= i + s - 1), k - s, k),
     )
     src_flip = jnp.where(j >= i + s, src_f, src_b)
-    return jnp.where(t >= 5, src_flip, base)
+    out = jnp.where(t >= 5, src_flip, base)
+    if giants is None:
+        # t == 7 NEEDS the tours (separator positions); without them the
+        # t >= 5 branch above would silently apply a wrong-but-valid
+        # permutation that does not match the scored delta. Concrete
+        # misuse fails loudly; traced values can't be inspected.
+        try:
+            has_star = bool((t == 7).any())
+        except jax.errors.ConcretizationTypeError:
+            has_star = False
+        if has_star:
+            raise ValueError("move_src_map: t == 7 (2-opt*) requires giants=")
+        return out
+
+    # 2-opt* suffix exchange: [0..i] ++ Bsuf ++ [zA..j] ++ Asuf ++ rest,
+    # where Asuf/Bsuf are the (possibly empty) suffixes of i's and j's
+    # routes and zA closes i's route. The middle block (zA..j) shifts by
+    # the suffix-length difference; both suffixes keep orientation.
+    nz_after, _, _ = _suffix_structure(giants)
+    za = jnp.take_along_axis(nz_after, jnp.clip(i, 0, length - 1), axis=1)
+    zb = jnp.take_along_axis(nz_after, jnp.clip(j, 0, length - 1), axis=1)
+    la = za - i - 1
+    lb = zb - j - 1
+    src_star = jnp.where(
+        (k > i) & (k <= i + lb),
+        k + (j - i),
+        jnp.where(
+            (k > i + lb) & (k <= j + lb - la),
+            k + (la - lb),
+            jnp.where(
+                (k > j + lb - la) & (k <= j + lb),
+                k + (i - j + la - lb),
+                k,
+            ),
+        ),
+    )
+    return jnp.where(t == 7, src_star, out)
 
 
 def _sweep(giants, costs, inst, w, mode, top_k):
@@ -437,10 +564,9 @@ def _sweep(giants, costs, inst, w, mode, top_k):
     t = jnp.where(valid, t, 1)  # table 1 = swap; lo == hi is identity
     i = jnp.where(valid, i, one)
     j = jnp.where(valid, j, one)
-    src = move_src_map(t, i, j, length)
-    cands = apply_src_map(
-        jnp.repeat(giants, top_k, axis=0), src, mode=mode
-    ).reshape(b, top_k, length)
+    rep = jnp.repeat(giants, top_k, axis=0)
+    src = move_src_map(t, i, j, length, giants=rep)
+    cands = apply_src_map(rep, src, mode=mode).reshape(b, top_k, length)
     cand_costs = objective_batch_mode(
         cands.reshape(b * top_k, length), inst, w, mode
     ).reshape(b, top_k)
