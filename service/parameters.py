@@ -106,8 +106,9 @@ def parse_solver_options(content: dict, errors):
                         populations with ring elite migration. Clamped
                         to the devices actually attached; ignored by
                         bf/aco. timeLimit applies (migration blocks run
-                        in clock-checked chunks); warmStart does not,
-                        and ilsRounds/localSearchPool>1 are rejected
+                        in clock-checked chunks) and ilsRounds composes
+                        (sharded anneal rounds, champion polish between);
+                        warmStart does not, localSearchPool>1 is rejected
     migrateEvery:       steps between ring migrations (default 100)
     migrants:           elites sent to the ring neighbor (default 4)
     """

@@ -254,15 +254,24 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 n_iters=int(iters or 5000),
             )
             ils_rounds = _positive_int(opts, "ils_rounds", 0, "ilsRounds")
-            if ils_rounds and islands:
-                raise ValueError(
-                    "'ilsRounds' is not supported with 'islands'"
-                )
             if islands:
-                from vrpms_tpu.mesh import solve_sa_islands
+                from vrpms_tpu.mesh import solve_ils_islands, solve_sa_islands
 
                 mesh, ip = _island_setup(opts)
                 deadline = opts.get("time_limit")
+                deadline = float(deadline) if deadline is not None else None
+                if ils_rounds:
+                    from vrpms_tpu.solvers import ILSParams
+
+                    return solve_ils_islands(
+                        inst,
+                        key=seed,
+                        mesh=mesh,
+                        params=ILSParams.from_budget(ils_rounds, p, p.n_iters),
+                        island_params=ip,
+                        weights=w,
+                        deadline_s=deadline,
+                    )
                 return solve_sa_islands(
                     inst,
                     key=seed,
@@ -270,7 +279,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     params=p,
                     island_params=ip,
                     weights=w,
-                    deadline_s=float(deadline) if deadline is not None else None,
+                    deadline_s=deadline,
                 )
             init = None
             if warm is not None:

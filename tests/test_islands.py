@@ -105,6 +105,46 @@ class TestIslandMesh:
         assert is_valid_giant(res.giant, 9, 2)
         assert 0 < int(res.evals) < 32 * 100_000
 
+    def test_ils_islands_valid_and_competitive(self, rng):
+        from vrpms_tpu.mesh import solve_ils_islands
+        from vrpms_tpu.solvers import ILSParams
+
+        inst = euclidean_cvrp(rng, n=16, v=3, q=10)
+        plain = solve_sa_islands(
+            inst,
+            key=2,
+            params=SAParams(n_chains=32, n_iters=1200),
+            island_params=IslandParams(migrate_every=100, n_migrants=2),
+        )
+        ils = solve_ils_islands(
+            inst,
+            key=2,
+            params=ILSParams.from_budget(
+                3, SAParams(n_chains=32, n_iters=0), 1200, pool=4
+            ),
+            island_params=IslandParams(migrate_every=100, n_migrants=2),
+        )
+        assert is_valid_giant(ils.giant, 15, 3)
+        # champion polish alone guarantees near-parity
+        assert float(ils.cost) <= float(plain.cost) * 1.02 + 1e-3
+
+    def test_ils_islands_deadline_truncates(self, rng):
+        from vrpms_tpu.mesh import solve_ils_islands
+        from vrpms_tpu.solvers import ILSParams
+
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        res = solve_ils_islands(
+            inst,
+            key=7,
+            params=ILSParams.from_budget(
+                50, SAParams(n_chains=16, n_iters=0), 1_000_000, pool=4
+            ),
+            island_params=IslandParams(migrate_every=100, n_migrants=1),
+            deadline_s=1e-6,
+        )
+        assert is_valid_giant(res.giant, 9, 2)
+        assert 0 < int(res.evals) < 16 * 1_000_000
+
     def test_migration_spreads_elites(self, rng):
         # With migration every step and a tiny per-island batch, all
         # islands should converge on comparable costs; mainly this

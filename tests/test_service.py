@@ -346,12 +346,25 @@ class TestVRPSolve:
         visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
-    def test_ils_rejects_islands_combo(self, server):
+    def test_ils_composes_with_islands(self, server):
         status, resp = post(
-            server, "/api/vrp/sa", vrp_body(ilsRounds=2, islands=2)
+            server,
+            "/api/vrp/sa",
+            vrp_body(
+                iterationCount=400,
+                populationSize=16,
+                ilsRounds=2,
+                islands=4,
+                migrateEvery=100,
+                includeStats=True,
+            ),
         )
-        assert status == 400
-        assert any("islands" in e["reason"] for e in resp["errors"])
+        assert status == 200, resp
+        msg = resp["message"]
+        assert msg["stats"]["ilsRounds"] == 2
+        assert msg["stats"]["islands"] == 4
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
     def test_local_search_pool_rejects_nonsense(self, server):
         status, resp = post(

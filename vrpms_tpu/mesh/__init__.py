@@ -2,5 +2,6 @@ from vrpms_tpu.mesh.islands import (
     make_mesh,
     solve_sa_islands,
     solve_ga_islands,
+    solve_ils_islands,
     IslandParams,
 )

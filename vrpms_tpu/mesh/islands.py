@@ -281,12 +281,19 @@ def solve_sa_islands(
     weights: CostWeights | None = None,
     mode: str = "auto",
     deadline_s: float | None = None,
+    init_giants: jax.Array | None = None,
+    pool: int = 0,
 ) -> SolveResult:
     """SA with per-device chain batches + ring elite migration.
 
     With `deadline_s`, migration blocks (and the migration-free tail)
     run in host-clock-checked chunks; the chunked program reproduces the
     single-shot one exactly when the deadline is never hit.
+    `init_giants` ([B, L], B a multiple of the island count) overrides
+    the constructive seeds — the warm-start/ILS-reseed hook. `pool` > 0
+    returns an elite pool (SolveResult.pool, best first): the per-island
+    champions (single-shot path; at most one per island) or the global
+    top chains (deadline path).
     """
     w = weights or CostWeights.make()
     mode = resolve_eval_mode(mode)
@@ -294,21 +301,38 @@ def solve_sa_islands(
         key = jax.random.key(key)
     mesh = mesh or make_mesh()
     n_isl = mesh.shape["islands"]
-    chains_local = max(
-        -(-params.n_chains // n_isl), island_params.n_migrants + 1
-    )
     t0, t1 = _auto_temps(inst, params)
     n_iters = params.n_iters
 
     k_init, k_run = jax.random.split(key)
-    giants0 = initial_giants(k_init, n_isl * chains_local, inst, params, mode)
+    if init_giants is None:
+        chains_local = max(
+            -(-params.n_chains // n_isl), island_params.n_migrants + 1
+        )
+        giants0 = initial_giants(k_init, n_isl * chains_local, inst, params, mode)
+    else:
+        if init_giants.shape[0] % n_isl:
+            raise ValueError(
+                f"init_giants batch {init_giants.shape[0]} must divide "
+                f"across {n_isl} islands"
+            )
+        chains_local = init_giants.shape[0] // n_isl
+        if chains_local <= island_params.n_migrants:
+            raise ValueError(
+                "per-island chains must exceed n_migrants"
+            )
+        giants0 = init_giants
 
     knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
+    elite = None
     if deadline_s is None:
         run = _sa_islands_fn(mesh, n_iters, island_params, mode)
         g_all, c_all = run(giants0, k_run, inst, w, t0j, t1j, knn)
         g, c = _pick_champion(g_all, c_all)
+        if pool > 0:
+            order = jnp.argsort(c_all)[: min(pool, g_all.shape[0])]
+            elite = g_all[order]
         done = n_iters
     else:
         from vrpms_tpu.solvers.sa import _sa_init_fn
@@ -330,12 +354,16 @@ def solve_sa_islands(
         )
         _, _, best_g, best_c = state
         g, c = _champion(best_g, best_c)
+        if pool > 0:
+            order = jnp.argsort(best_c)[: min(pool, best_g.shape[0])]
+            elite = best_g[order]
     bd = evaluate_giant(g, inst)
     return SolveResult(
         g,
         total_cost(bd, w),
         bd,
         jnp.int32(n_isl * chains_local * done),
+        elite,
     )
 
 
@@ -537,4 +565,66 @@ def solve_ga_islands(
         total_cost(bd, w),
         bd,
         jnp.int32(n_isl * pop_local * done),
+    )
+
+
+def solve_ils_islands(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    mesh: Mesh | None = None,
+    params=None,  # solvers.ils.ILSParams
+    island_params: IslandParams = IslandParams(),
+    weights: CostWeights | None = None,
+    mode: str = "auto",
+    deadline_s: float | None = None,
+) -> SolveResult:
+    """Iterated local search with the anneal phase sharded over islands.
+
+    Each round runs the ring-migration island SA (per-device chain
+    batches, ppermute elite exchange), polishes the returned elite pool
+    (the per-island champions; global top chains under a deadline) with
+    the delta descent, and reseeds EVERY island's chains from the
+    best-so-far (sa.perturbed_clones). Only the pool and the reseed
+    clones cross the host boundary between rounds — the communicate-
+    small-things rule (module docstring) carried up to the ILS level.
+    Round/polish/reseed/deadline semantics are solvers.ils.ils_loop's,
+    shared verbatim with the single-device solve_ils.
+    """
+    from vrpms_tpu.solvers.ils import ILSParams, ils_loop
+
+    params = params or ILSParams()
+    w = weights or CostWeights.make()
+    mode = resolve_eval_mode(mode)
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    mesh = mesh or make_mesh()
+    n_isl = mesh.shape["islands"]
+    chains_local = max(
+        -(-params.sa.n_chains // n_isl), island_params.n_migrants + 1
+    )
+
+    def anneal(k_round, init, budget):
+        return solve_sa_islands(
+            inst,
+            key=k_round,
+            mesh=mesh,
+            params=params.sa,
+            island_params=island_params,
+            weights=w,
+            mode=mode,
+            deadline_s=budget,
+            init_giants=init,
+            pool=params.pool,
+        )
+
+    return ils_loop(
+        anneal,
+        n_isl * chains_local,
+        inst,
+        key,
+        params,
+        w,
+        mode,
+        deadline_s,
+        None,
     )

@@ -82,6 +82,52 @@ def solve_ils(
     if isinstance(key, int):
         key = jax.random.key(key)
 
+    def anneal(k_round, init, budget):
+        return solve_sa(
+            inst,
+            key=k_round,
+            params=params.sa,
+            weights=w,
+            init_giants=init,
+            mode=mode,
+            deadline_s=budget,
+            pool=params.pool,
+        )
+
+    return ils_loop(
+        anneal,
+        params.sa.n_chains,
+        inst,
+        key,
+        params,
+        w,
+        mode,
+        deadline_s,
+        init_giants,
+    )
+
+
+def ils_loop(
+    anneal,
+    reseed_batch: int,
+    inst: Instance,
+    key: jax.Array,
+    params: ILSParams,
+    w: CostWeights,
+    mode: str,
+    deadline_s: float | None,
+    init_giants: jax.Array | None,
+) -> SolveResult:
+    """The ONE round/polish/reseed/deadline loop behind every ILS
+    variant (single-device solve_ils, mesh.solve_ils_islands) — the
+    anneal is the only thing that varies, so deadline semantics, the
+    polish convergence heuristic, and the reseed keying cannot diverge.
+
+    anneal(key, init_giants, budget) -> SolveResult; a returned elite
+    pool is polished whole, otherwise the champion alone.
+    """
+    if params.rounds < 1:
+        raise ValueError(f"ILSParams.rounds must be >= 1, got {params.rounds}")
     t_start = time.monotonic()
 
     def remaining():
@@ -97,22 +143,13 @@ def solve_ils(
         budget = remaining()
         if budget is not None and budget <= 0 and best_g is not None:
             break
-        k_round = jax.random.fold_in(key, r)
-        res = solve_sa(
-            inst,
-            key=k_round,
-            params=params.sa,
-            weights=w,
-            init_giants=init,
-            mode=mode,
-            deadline_s=budget,
-            pool=params.pool,
-        )
+        res = anneal(jax.random.fold_in(key, r), init, budget)
         evals += int(res.evals)
         # Polish in deadline-checked blocks (the same never-overshoot-
         # by-more-than-a-block contract as the service's _polish); an
-        # exhausted budget falls back to the pool's unpolished best.
-        giants, costs = res.pool, None
+        # exhausted budget falls back to the unpolished best.
+        giants = res.pool if res.pool is not None else res.giant[None]
+        costs = None
         sweeps_left = params.polish_sweeps
         top_k = 8  # delta_polish_batch default; fixed for the eval test
         while sweeps_left > 0:
@@ -128,9 +165,9 @@ def solve_ils(
             if int(p_evals) < block * giants.shape[0] * top_k:
                 break  # converged mid-block
         champ = int(jnp.argmin(costs)) if costs is not None else 0
-        # mode-precision pool costs rank the pool (pool[0] is the SA
-        # best when unpolished); the champion is re-evaluated exactly
-        # before it may displace the incumbent
+        # mode-precision pool costs rank the pool (pool[0] is the
+        # anneal's best when unpolished); the champion is re-evaluated
+        # exactly before it may displace the incumbent
         cand = giants[champ]
         cand_cost = float(total_cost(evaluate_giant(cand, inst), w))
         if cand_cost < best_c:
@@ -139,7 +176,7 @@ def solve_ils(
             # reseed every chain from the incumbent, decorrelated; the
             # next round's nn-init would discard what was just learned
             init = perturbed_clones(
-                jax.random.fold_in(key, 1000 + r), params.sa.n_chains, best_g, mode
+                jax.random.fold_in(key, 1000 + r), reseed_batch, best_g, mode
             )
 
     bd = evaluate_giant(best_g, inst)
