@@ -101,6 +101,20 @@ def _build_arrays(locations, matrix, active_pos, errors, slice_minutes):
         ]
         return None
     sub = arr[np.ix_(active_pos, active_pos)]
+    if not np.isfinite(sub).all() or (sub < 0).any():
+        # NaN/inf would propagate through every cost into the response
+        # (and NaN is not even valid JSON); negative durations break the
+        # solvers' shortest-leg assumptions — both are data errors.
+        # Checked on the ACTIVE submatrix only: bad entries confined to
+        # ignored/completed/unselected locations never reach a solver
+        # (inf rows are a legitimate "unreachable node" convention).
+        errors += [
+            {
+                "what": "Data error",
+                "reason": "durations matrix entries must be finite and non-negative",
+            }
+        ]
+        return None
     locs = [locations[i] for i in active_pos]
     demands = [0.0] + [float(loc.get("demand", 1)) for loc in locs[1:]]
     service = [float(loc.get("serviceTime", 0)) for loc in locs]

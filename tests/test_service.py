@@ -168,6 +168,33 @@ class TestErrorEnvelope:
         assert status == 400
         assert resp["errors"][0]["what"] == "Solver error"
 
+    def test_non_finite_or_negative_matrix_rejected(self, server):
+        n = 7
+        bad = [[0.0] * n for _ in range(n)]
+        bad[1][2] = float("nan")
+        mem.seed_durations("durs-nan", bad)
+        status, resp = post(
+            server, "/api/vrp/sa", vrp_body(durationsKey="durs-nan")
+        )
+        assert status == 400
+        assert any("finite" in e["reason"] for e in resp["errors"])
+        neg = [[0.0] * n for _ in range(n)]
+        neg[2][3] = -5.0
+        mem.seed_durations("durs-neg", neg)
+        status, resp = post(
+            server, "/api/tsp/sa", tsp_body(durationsKey="durs-neg")
+        )
+        assert status == 400
+        assert any("non-negative" in e["reason"] for e in resp["errors"])
+        # bad entries confined to EXCLUDED locations must not reject:
+        # inf rows are a legitimate unreachable-node convention
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(durationsKey="durs-nan", ignoredCustomers=[1]),
+        )
+        assert status == 200, resp
+
     def test_matrix_shape_mismatch(self, server):
         mem.seed_durations("badshape", [[0, 1], [1, 0]])
         status, resp = post(server, "/api/vrp/sa", vrp_body(durationsKey="badshape"))
