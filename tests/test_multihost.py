@@ -48,6 +48,20 @@ WORKER = textwrap.dedent(
     g = np.asarray(res.giant)
     assert is_valid_giant(g, inst.n_customers, inst.n_vehicles)
     print(f"MULTIHOST_OK {float(res.cost):.3f}", flush=True)
+
+    # the flagship sharded ILS pipeline crosses the process boundary too
+    from vrpms_tpu.mesh import solve_ils_islands
+    from vrpms_tpu.solvers import ILSParams
+
+    res = solve_ils_islands(
+        inst,
+        key=0,
+        mesh=mesh,
+        params=ILSParams.from_budget(2, SAParams(n_chains=8), 40, pool=4),
+        island_params=IslandParams(migrate_every=10, n_migrants=1),
+    )
+    assert is_valid_giant(np.asarray(res.giant), inst.n_customers, inst.n_vehicles)
+    print(f"MULTIHOST_ILS_OK {float(res.cost):.3f}", flush=True)
     """
 )
 
@@ -92,10 +106,13 @@ def test_island_solve_spans_two_processes(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    costs = []
-    for out in outs:
-        lines = [l for l in out.splitlines() if l.startswith("MULTIHOST_OK")]
-        assert lines, out[-2000:]
-        costs.append(float(lines[0].split()[1]))
-    # both controllers must agree on the global champion
-    assert costs[0] == costs[1]
+    for marker in ("MULTIHOST_OK", "MULTIHOST_ILS_OK"):
+        costs = []
+        for out in outs:
+            lines = [
+                l for l in out.splitlines() if l.split()[0:1] == [marker]
+            ]
+            assert lines, (marker, out[-2000:])
+            costs.append(float(lines[0].split()[1]))
+        # both controllers must agree on the global champion
+        assert costs[0] == costs[1], marker
