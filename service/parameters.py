@@ -106,9 +106,10 @@ def parse_solver_options(content: dict, errors):
                         populations with ring elite migration. Clamped
                         to the devices actually attached; ignored by
                         bf/aco. timeLimit applies (migration blocks run
-                        in clock-checked chunks) and ilsRounds composes
-                        (sharded anneal rounds, champion polish between);
-                        warmStart does not, localSearchPool>1 is rejected
+                        in clock-checked chunks), ilsRounds composes
+                        (sharded anneal rounds, pool polish between),
+                        and localSearchPool polishes the per-island
+                        champions; warmStart does not apply
     migrateEvery:       steps between ring migrations (default 100)
     migrants:           elites sent to the ring neighbor (default 4)
     """

@@ -249,15 +249,14 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
     w = w if w is not None else _request_weights(opts)
     try:
         # validated whenever provided; elite pools only feed the
-        # multi-start polish, so they are materialised only with it
+        # multi-start polish, so they are materialised only with it.
+        # ILS polishes internally every round: an EXPLICIT
+        # localSearchPool is honored exactly, otherwise ILSParams'
+        # default pool applies.
         pool = _positive_int(opts, "local_search_pool", 1, "localSearchPool")
+        ils_pool = pool if opts.get("local_search_pool") is not None else 32
         if not opts.get("local_search"):
             pool = 0
-        elif pool > 1 and islands:
-            raise ValueError(
-                "'localSearchPool' > 1 is not supported with 'islands' "
-                "(island solvers return only their champion)"
-            )
         if algorithm == "bf":
             if problem == "tsp":
                 return solve_tsp_bf(inst, weights=w)
@@ -281,7 +280,9 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                         inst,
                         key=seed,
                         mesh=mesh,
-                        params=ILSParams.from_budget(ils_rounds, p, p.n_iters),
+                        params=ILSParams.from_budget(
+                            ils_rounds, p, p.n_iters, pool=ils_pool
+                        ),
                         island_params=ip,
                         weights=w,
                         deadline_s=deadline,
@@ -294,6 +295,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     island_params=ip,
                     weights=w,
                     deadline_s=deadline,
+                    pool=pool,
                 )
             init = None
             if warm is not None:
@@ -320,7 +322,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     inst,
                     key=seed,
                     params=ILSParams.from_budget(
-                        ils_rounds, p, p.n_iters, pool=max(pool, 16)
+                        ils_rounds, p, p.n_iters, pool=ils_pool
                     ),
                     weights=w,
                     init_giants=init,
@@ -366,6 +368,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     island_params=ip,
                     weights=w,
                     deadline_s=float(deadline) if deadline is not None else None,
+                    pool=pool,
                 )
             init = None
             if warm is not None:

@@ -105,6 +105,20 @@ class TestIslandMesh:
         assert is_valid_giant(res.giant, 9, 2)
         assert 0 < int(res.evals) < 32 * 100_000
 
+    def test_ga_islands_pool_returns_champion_first(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=2, q=20)
+        kw = dict(
+            key=8,
+            params=GAParams(population=32, generations=40, elites=2),
+            island_params=IslandParams(migrate_every=20, n_migrants=2),
+        )
+        for deadline in (None, 3600.0):
+            res = solve_ga_islands(inst, deadline_s=deadline, pool=3, **kw)
+            assert res.pool is not None and res.pool.shape[0] == 3
+            assert np.array_equal(np.asarray(res.pool[0]), np.asarray(res.giant))
+            for g in np.asarray(res.pool):
+                assert is_valid_giant(g, 9, 2)
+
     def test_ils_islands_valid_and_competitive(self, rng):
         from vrpms_tpu.mesh import solve_ils_islands
         from vrpms_tpu.solvers import ILSParams

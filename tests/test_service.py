@@ -406,14 +406,28 @@ class TestVRPSolve:
             server, "/api/vrp/sa", vrp_body(localSearchPool=0)
         )
         assert status == 400
-        # pools need the solver champion set; islands return only one
+
+    def test_local_search_pool_composes_with_islands(self, server):
+        """Island solvers return their per-island champions as the
+        elite pool, so pool polish composes with islands."""
         status, resp = post(
             server,
             "/api/vrp/sa",
-            vrp_body(localSearch=True, localSearchPool=4, islands=2),
+            vrp_body(
+                iterationCount=300,
+                populationSize=16,
+                islands=4,
+                localSearch=True,
+                localSearchPool=4,
+                includeStats=True,
+            ),
         )
-        assert status == 400
-        assert any("islands" in e["reason"] for e in resp["errors"])
+        assert status == 200, resp
+        msg = resp["message"]
+        assert msg["stats"]["localSearch"] is True
+        assert msg["stats"]["islands"] == 4
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
 
 class TestTSPSolve:

@@ -511,11 +511,14 @@ def solve_ga_islands(
     weights: CostWeights | None = None,
     mode: str = "auto",
     deadline_s: float | None = None,
+    pool: int = 0,
 ) -> SolveResult:
     """GA with per-device sub-populations + ring elite migration.
 
     With `deadline_s`, migration blocks run in host-clock-checked chunks
-    (see solve_sa_islands).
+    (see solve_sa_islands). `pool` > 0 returns the per-island champion
+    genomes as split giants (SolveResult.pool, best first; at most one
+    per island).
     """
     w = weights or CostWeights.make()
     if isinstance(key, int):
@@ -537,6 +540,7 @@ def solve_ga_islands(
         run = _ga_islands_fn(mesh, local_params, island_params, mode)
         p_all, f_all = run(perms0, k_run, inst, w)
         best_perm, _ = _pick_champion(p_all, f_all)
+        pool_perms, pool_fits = p_all, f_all
         done = generations
     else:
         block_len = island_params.migrate_every
@@ -558,13 +562,21 @@ def solve_ga_islands(
         )
         _, _, best_p, best_f = state
         best_perm, _ = _champion(best_p, best_f)
+        pool_perms, pool_fits = best_p, best_f
     giant = greedy_split_giant(best_perm, inst)
     bd = evaluate_giant(giant, inst)
+    elite = None
+    if pool > 0:
+        order = jnp.argsort(pool_fits)[: min(pool, pool_perms.shape[0])]
+        elite = jax.vmap(lambda p: greedy_split_giant(p, inst))(
+            pool_perms[order]
+        )
     return SolveResult(
         giant,
         total_cost(bd, w),
         bd,
         jnp.int32(n_isl * pop_local * done),
+        elite,
     )
 
 
