@@ -12,4 +12,12 @@ Envelope parity (reference api/helpers.py:16-29):
 Where the reference's handlers end in `# TODO: Run algorithm`
 (e.g. reference api/vrp/ga/index.py:48), these dispatch across the
 api->solver boundary into vrpms_tpu's compiled search.
+
+Importing the package loads `.env` (the reference's src/__init__.py:1-2
+runs load_dotenv at import time so SUPABASE_URL/SUPABASE_KEY reach the
+store, reference README.md:53-66); same bootstrap here, dependency-free.
 """
+
+from vrpms_tpu.utils import load_dotenv
+
+load_dotenv()

@@ -15,7 +15,12 @@ import json
 from http.server import BaseHTTPRequestHandler
 
 import store
-from service.helpers import fail, remove_unused_locations, success
+from service.helpers import (
+    fail,
+    remove_unused_locations,
+    send_static_headers,
+    success,
+)
 from service.parameters import parse_solver_options
 from service.solve import run_tsp, run_vrp
 
@@ -37,6 +42,7 @@ class SolveHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         self.send_response(200)
         self.send_header("Content-type", "text/plain")
+        send_static_headers(self)
         self.end_headers()
         self.wfile.write(self.banner.encode("utf-8"))
 
@@ -124,7 +130,22 @@ class SolveHandler(BaseHTTPRequestHandler):
 
 class CORSPreflightMixin:
     """The reference exposes OPTIONS preflight only on VRP GA
-    (api/vrp/ga/index.py:16-22, vercel.json:4-11)."""
+    (api/vrp/ga/index.py:16-22), and its edge config additionally pins
+    CORS headers onto every GET/POST response for that route
+    (vercel.json:4-11) — reproduced via `static_headers`, which every
+    response writer emits (a browser's actual POST would otherwise be
+    CORS-blocked even though its preflight succeeded)."""
+
+    static_headers = (
+        ("Access-Control-Allow-Credentials", "true"),
+        ("Access-Control-Allow-Origin", "*"),
+        ("Access-Control-Allow-Methods", "GET,OPTIONS,PATCH,DELETE,POST,PUT"),
+        (
+            "Access-Control-Allow-Headers",
+            "X-CSRF-Token, X-Requested-With, Accept, Accept-Version, "
+            "Content-Length, Content-MD5, Content-Type, Date, X-Api-Version",
+        ),
+    )
 
     def do_OPTIONS(self):
         self.send_response(200, "ok")

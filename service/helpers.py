@@ -24,9 +24,19 @@ def remove_unused_locations(locations, ignored_customers, completed_customers):
     return [loc for loc in locations if loc["id"] not in disregard]
 
 
+def send_static_headers(handler: BaseHTTPRequestHandler):
+    """Route-attached response headers (the reference's edge config pins
+    CORS headers to every /api/vrp/ga RESPONSE, not just the OPTIONS
+    preflight — reference vercel.json:4-11). Handlers opt in via a
+    `static_headers` class attribute; emitted by every response writer."""
+    for key, value in getattr(handler, "static_headers", ()):
+        handler.send_header(key, value)
+
+
 def fail(handler: BaseHTTPRequestHandler, errors):
     handler.send_response(400)
     handler.send_header("Content-type", "application/json")
+    send_static_headers(handler)
     handler.end_headers()
     response = {"success": False, "errors": errors}
     handler.wfile.write(json.dumps(response).encode("utf-8"))
@@ -35,6 +45,7 @@ def fail(handler: BaseHTTPRequestHandler, errors):
 def success(handler: BaseHTTPRequestHandler, result: dict):
     handler.send_response(200)
     handler.send_header("Content-type", "application/json")
+    send_static_headers(handler)
     handler.end_headers()
     response = {"success": True, "message": result}
     handler.wfile.write(json.dumps(response).encode("utf-8"))

@@ -82,10 +82,12 @@ def parse_solver_options(content: dict, errors):
                         checkpointed under this solutionName
     includeStats:       attach solver statistics to the result message
     profile:            capture a jax.profiler trace of the solve
-    timeLimit:          wall-clock budget in seconds; the iterative
-                        solvers (SA, GA, ACO) and the localSearch
-                        polish stop at the deadline and return their
-                        best-so-far
+    timeLimit:          wall-clock budget in seconds; every solver
+                        (SA, GA, ACO, and BF's chunked enumeration)
+                        and the localSearch polish stop at the
+                        deadline and return their best-so-far (a
+                        deadline-cut BF is then no longer exact; its
+                        stats report the orders actually scored)
     makespanWeight:     price the longest route's elapsed time (the
                         durationMax the result reports) into the
                         objective; 0/absent optimizes total distance
@@ -95,12 +97,17 @@ def parse_solver_options(content: dict, errors):
                         the number of sweeps
     localSearchPool:    polish this many of the solver's elite solutions
                         at once (SA chain bests / GA final population)
-                        and return the winner; default 1 (champion only)
+                        and return the winner; default 1 (champion
+                        only). A bare localSearchPool > 1 (without
+                        localSearch) enables the polish with its
+                        default budget; an explicit localSearch: false
+                        disables it regardless
     ilsRounds:          SA only: run iterated local search — this many
                         rounds of (anneal -> elite-pool delta polish ->
                         reseed chains from the champion). iterationCount
                         is the TOTAL sweep budget across rounds. The
-                        strongest quality setting (solvers.ils)
+                        strongest quality setting (solvers.ils).
+                        Explicit 0 = ILS off (plain SA)
     islands:            run SA/GA as an island model over this many
                         devices of the mesh (vrpms_tpu.mesh): per-device
                         populations with ring elite migration. Clamped

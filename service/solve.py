@@ -196,18 +196,22 @@ def _request_weights(opts):
     return CostWeights.make(makespan=float(opts.get("makespan_weight") or 0.0))
 
 
-def _positive_int(opts, key, default, name):
+def _positive_int(opts, key, default, name, zero_ok=False):
     """Validated positive-integer option: absent -> default, anything
     not a positive integer -> ValueError (the Solver-error envelope).
     The sharded solvers silently degenerate on nonsense (a negative
     migrateEvery makes every scan empty, 'solving' with zero
-    iterations), so rejection must happen at the service boundary."""
+    iterations), so rejection must happen at the service boundary.
+    `zero_ok` admits an explicit 0 for features where it plainly means
+    "off" (ilsRounds, islands) — consistent with timeLimit's explicit-0
+    handling — while negatives/non-integers still reject."""
     val = opts.get(key)
     if val is None:
         return default
     iv = int(val)
-    if iv < 1:
-        raise ValueError(f"'{name}' must be a positive integer, got {val!r}")
+    if iv < (0 if zero_ok else 1):
+        kind = "non-negative" if zero_ok else "positive"
+        raise ValueError(f"'{name}' must be a {kind} integer, got {val!r}")
     return iv
 
 
@@ -255,18 +259,21 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
         # default pool applies.
         pool = _positive_int(opts, "local_search_pool", 1, "localSearchPool")
         ils_pool = pool if opts.get("local_search_pool") is not None else 32
-        if not opts.get("local_search"):
+        if not _polish_enabled(opts):
             pool = 0
         if algorithm == "bf":
+            deadline = opts.get("time_limit")
+            deadline = float(deadline) if deadline is not None else None
             if problem == "tsp":
-                return solve_tsp_bf(inst, weights=w)
-            return solve_vrp_bf(inst, weights=w)
+                return solve_tsp_bf(inst, weights=w, deadline_s=deadline)
+            return solve_vrp_bf(inst, weights=w, deadline_s=deadline)
         if algorithm == "sa":
             p = SAParams(
                 n_chains=int(pop or 128),
                 n_iters=int(iters or 5000),
             )
-            ils_rounds = _positive_int(opts, "ils_rounds", 0, "ilsRounds")
+            # explicit 0 means "ILS off" (plain SA), like timeLimit's 0
+            ils_rounds = _positive_int(opts, "ils_rounds", 0, "ilsRounds", zero_ok=True)
             if islands:
                 from vrpms_tpu.mesh import solve_ils_islands, solve_sa_islands
 
@@ -435,6 +442,26 @@ POLISH_TOP_K = 8  # delta_ls candidates per sweep; fixed so the eval
                   # count identifies mid-block convergence exactly
 
 
+def _polish_enabled(opts):
+    """Whether the delta-descent polish runs: `localSearch` truthy, or —
+    when `localSearch` is simply absent — an explicit `localSearchPool`
+    > 1 (asking to polish a pool clearly intends the polish; an explicit
+    `localSearch: false` still wins and disables it)."""
+    spec = opts.get("local_search")
+    if spec is not None:
+        return bool(spec)
+    try:
+        return int(opts.get("local_search_pool") or 0) > 1
+    except (TypeError, ValueError):
+        return False
+
+
+def _polish_spec(opts):
+    """The sweep budget the polish runs with (see _polish_enabled)."""
+    spec = opts.get("local_search")
+    return spec if spec is not None else _polish_enabled(opts)
+
+
 def _polish(res, inst, opts, w, t_start):
     """Optional localSearch pass over the champion — or, when the solver
     returned an elite pool (localSearchPool > 1), over the whole pool at
@@ -449,7 +476,7 @@ def _polish(res, inst, opts, w, t_start):
     objectives (pool costs are mode-precision), and polish evals are
     accounted even when no sweep improved.
     """
-    spec = opts.get("local_search")
+    spec = _polish_spec(opts)
     if not spec or res is None:
         return res, False
     from vrpms_tpu.core.cost import evaluate_giant, total_cost
@@ -484,14 +511,17 @@ def _polish(res, inst, opts, w, t_start):
         ):
             break
         best_seen = new_best
+    # saturate like ils_loop does: extreme pool*sweep budgets must not
+    # wrap the int32 stats counter
+    evals = jnp.int32(min(int(res.evals) + extra_evals, 2**31 - 1))
     if not ran:
-        return res._replace(evals=res.evals + extra_evals), ran
+        return res._replace(evals=evals), ran
     champ = giants[int(jnp.argmin(costs))]
     bd = evaluate_giant(champ, inst)
     cost = total_cost(bd, w)
     if float(cost) >= float(res.cost):
-        return res._replace(evals=res.evals + extra_evals), ran
-    return SolveResult(champ, cost, bd, res.evals + extra_evals), ran
+        return res._replace(evals=evals), ran
+    return SolveResult(champ, cost, bd, evals), ran
 
 
 def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):

@@ -19,6 +19,14 @@ from __future__ import annotations
 
 import os
 
+from vrpms_tpu.utils import load_dotenv
+
+# The reference loads `.env` at package import (src/__init__.py:1-2) so
+# SUPABASE_URL/SUPABASE_KEY are present by the time a client is built;
+# the store is that consumer here, so it bootstraps too (idempotent, and
+# real environment variables always win).
+load_dotenv()
+
 
 def get_database(problem: str, auth=None):
     """Factory: problem is 'vrp' or 'tsp'; returns the configured store."""

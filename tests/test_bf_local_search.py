@@ -75,6 +75,39 @@ class TestBruteForce:
         assert is_valid_giant(res.giant, n - 1, 3)
         assert float(res.breakdown.cap_excess) == 0.0
 
+    def test_deadline_none_and_generous_agree(self, rng):
+        # the chunked deadline path composes to exactly the single-shot
+        # reduction when the deadline is never hit
+        n = 8
+        d = rng.uniform(1, 50, size=(n, n))
+        np.fill_diagonal(d, 0)
+        demands = [0] + [1] * (n - 1)
+        inst = make_instance(d, demands=demands, capacities=[4, 4, 4])
+        exact = solve_vrp_bf(inst)
+        timed = solve_vrp_bf(inst, deadline_s=60.0)
+        assert np.isclose(float(timed.cost), float(exact.cost), rtol=1e-6)
+        assert int(timed.evals) == int(exact.evals) == 5040
+        t_exact = solve_tsp_bf(make_instance(d, n_vehicles=1))
+        t_timed = solve_tsp_bf(make_instance(d, n_vehicles=1), deadline_s=60.0)
+        assert np.isclose(float(t_timed.cost), float(t_exact.cost), rtol=1e-6)
+
+    def test_deadline_zero_truncates_but_returns_valid(self, rng):
+        # timeLimit 0 = "stop as soon as possible": exactly one ~262k-
+        # order chunk of the 10-customer space (3.6M orders) is scored,
+        # and the best-so-far is still a valid, finitely-priced solution
+        n = 11
+        d = rng.uniform(1, 50, size=(n, n))
+        np.fill_diagonal(d, 0)
+        demands = [0] + [1] * (n - 1)
+        inst = make_instance(d, demands=demands, capacities=[5, 5, 5])
+        res = solve_vrp_bf(inst, deadline_s=0.0)
+        import math
+
+        assert int(res.evals) < math.factorial(10)
+        assert int(res.evals) >= (1 << 13) * 32  # at least one chunk ran
+        assert np.isfinite(float(res.cost))
+        assert is_valid_giant(res.giant, n - 1, inst.n_vehicles)
+
     def test_rejects_large(self, rng):
         inst = random_instance(rng, n=MAX_BF_CUSTOMERS + 2, v=1)
         with pytest.raises(ValueError, match="exceeds"):

@@ -62,6 +62,34 @@ WORKER = textwrap.dedent(
     )
     assert is_valid_giant(np.asarray(res.giant), inst.n_customers, inst.n_vehicles)
     print(f"MULTIHOST_ILS_OK {float(res.cost):.3f}", flush=True)
+
+    # Deadline-bounded chunked drivers must take IDENTICAL stop
+    # decisions on every controller (mesh.sync.controller_value
+    # broadcasts process 0's clock); a per-process local-clock decision
+    # here risks one controller issuing ppermute chunks the other never
+    # joins — a distributed hang. The tight deadlines make mid-run
+    # truncation (the dangerous branch) likely on every CI machine.
+    res = solve_sa_islands(
+        inst,
+        key=0,
+        mesh=mesh,
+        params=SAParams(n_chains=8, n_iters=400),
+        island_params=IslandParams(migrate_every=20, n_migrants=1),
+        deadline_s=0.2,
+    )
+    assert is_valid_giant(np.asarray(res.giant), inst.n_customers, inst.n_vehicles)
+    print(f"MULTIHOST_DEADLINE_OK {float(res.cost):.3f}", flush=True)
+
+    res = solve_ils_islands(
+        inst,
+        key=0,
+        mesh=mesh,
+        params=ILSParams.from_budget(3, SAParams(n_chains=8), 600, pool=4),
+        island_params=IslandParams(migrate_every=10, n_migrants=1),
+        deadline_s=0.5,
+    )
+    assert is_valid_giant(np.asarray(res.giant), inst.n_customers, inst.n_vehicles)
+    print(f"MULTIHOST_ILS_DEADLINE_OK {float(res.cost):.3f}", flush=True)
     """
 )
 
@@ -106,7 +134,12 @@ def test_island_solve_spans_two_processes(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for marker in ("MULTIHOST_OK", "MULTIHOST_ILS_OK"):
+    for marker in (
+        "MULTIHOST_OK",
+        "MULTIHOST_ILS_OK",
+        "MULTIHOST_DEADLINE_OK",
+        "MULTIHOST_ILS_DEADLINE_OK",
+    ):
         costs = []
         for out in outs:
             lines = [

@@ -48,6 +48,12 @@ def seeded():
 
 
 def post(base, path, body):
+    status, parsed, _ = post_h(base, path, body)
+    return status, parsed
+
+
+def post_h(base, path, body):
+    """POST returning (status, parsed_body, headers) for header checks."""
     req = urllib.request.Request(
         base + path,
         data=json.dumps(body).encode(),
@@ -56,9 +62,9 @@ def post(base, path, body):
     )
     try:
         with urllib.request.urlopen(req) as resp:
-            return resp.status, json.loads(resp.read())
+            return resp.status, json.loads(resp.read()), resp.headers
     except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+        return e.code, json.loads(e.read()), e.headers
 
 
 def get(base, path):
@@ -393,6 +399,55 @@ class TestVRPSolve:
         visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    def test_ils_rounds_zero_means_off(self, server):
+        # explicit 0 disables ILS (plain SA), like timeLimit's 0 —
+        # not a Solver-error envelope
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=200, populationSize=16, ilsRounds=0,
+                     includeStats=True),
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        assert "ilsRounds" not in msg["stats"]
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+
+    def test_bare_local_search_pool_enables_polish(self, server):
+        # an explicit localSearchPool > 1 without localSearch clearly
+        # intends the polish: it runs with the default budget
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=200, populationSize=16,
+                     localSearchPool=4, includeStats=True),
+        )
+        assert status == 200, resp
+        assert resp["message"]["stats"]["localSearch"] is True
+        # ... but an explicit localSearch: false still wins
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=200, populationSize=16,
+                     localSearch=False, localSearchPool=4,
+                     includeStats=True),
+        )
+        assert status == 200, resp
+        assert resp["message"]["stats"]["localSearch"] is False
+
+    def test_bf_honors_time_limit(self, server):
+        # BF accepts timeLimit like every other solver (chunked
+        # enumeration); a tiny instance finishes inside the first chunk
+        # so the result stays exact and complete
+        status, resp = post(
+            server, "/api/vrp/bf", vrp_body(timeLimit=30, includeStats=True)
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
+        assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+
     def test_local_search_pool_rejects_nonsense(self, server):
         status, resp = post(
             server,
@@ -544,3 +599,33 @@ class TestCORS:
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(req)
         assert e.value.code == 501
+
+    def test_vrp_ga_responses_carry_static_cors_headers(self, server):
+        # The reference's edge config attaches CORS headers to every
+        # /api/vrp/ga RESPONSE (vercel.json:4-11) — a browser's actual
+        # POST (not just its preflight) must see them.
+        status, resp, headers = post_h(
+            server, "/api/vrp/ga", vrp_body(
+                multiThreaded=False, randomPermutationCount=32,
+                iterationCount=20, populationSize=16,
+            )
+        )
+        assert status == 200, resp
+        assert headers["Access-Control-Allow-Origin"] == "*"
+        assert headers["Access-Control-Allow-Credentials"] == "true"
+        assert "POST" in headers["Access-Control-Allow-Methods"]
+        assert "Content-Type" in headers["Access-Control-Allow-Headers"]
+        # error envelopes are responses too
+        status, _, headers = post_h(server, "/api/vrp/ga", {})
+        assert status == 400
+        assert headers["Access-Control-Allow-Origin"] == "*"
+        # and the GET banner
+        req = urllib.request.Request(server + "/api/vrp/ga")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers["Access-Control-Allow-Origin"] == "*"
+
+    def test_other_routes_no_static_cors_headers(self, server):
+        # reference parity: only /api/vrp/ga has the edge headers
+        status, _, headers = post_h(server, "/api/vrp/sa", {})
+        assert status == 400
+        assert headers.get("Access-Control-Allow-Origin") is None

@@ -60,3 +60,57 @@ class TestSolveInfo:
 
 def test_current_date_format():
     assert re.fullmatch(r"\d{2}-\d{2}-\d{4}", current_date())
+
+
+class TestLoadDotenv:
+    """The reference's .env bootstrap (src/__init__.py:1-2, README.md:
+    53-66) — same semantics without the python-dotenv dependency."""
+
+    def test_parses_and_never_overrides(self, tmp_path, monkeypatch):
+        from vrpms_tpu.utils import load_dotenv
+
+        env = tmp_path / ".env"
+        env.write_text(
+            "# comment\n"
+            "\n"
+            "SUPABASE_URL=https://example.supabase.co\n"
+            "export SUPABASE_KEY='an on-key'\n"
+            'VRPMS_QUOTED="spaced value"\n'
+            "VRPMS_PRESET=from-file\n"
+            "VRPMS_INLINE=bare-value # inline comment\n"
+            "not a kv line\n"
+        )
+        monkeypatch.delenv("SUPABASE_URL", raising=False)
+        monkeypatch.delenv("SUPABASE_KEY", raising=False)
+        monkeypatch.delenv("VRPMS_QUOTED", raising=False)
+        monkeypatch.delenv("VRPMS_INLINE", raising=False)
+        monkeypatch.setenv("VRPMS_PRESET", "from-env")
+        assert load_dotenv(str(env)) is True
+        import os
+
+        assert os.environ["SUPABASE_URL"] == "https://example.supabase.co"
+        assert os.environ["SUPABASE_KEY"] == "an on-key"
+        assert os.environ["VRPMS_QUOTED"] == "spaced value"
+        # inline comments are stripped from unquoted values
+        assert os.environ["VRPMS_INLINE"] == "bare-value"
+        # real environment always beats the file (python-dotenv default)
+        assert os.environ["VRPMS_PRESET"] == "from-env"
+        monkeypatch.delenv("SUPABASE_URL")
+        monkeypatch.delenv("SUPABASE_KEY")
+        monkeypatch.delenv("VRPMS_QUOTED")
+        monkeypatch.delenv("VRPMS_INLINE")
+
+    def test_missing_file_is_fine(self, tmp_path):
+        from vrpms_tpu.utils import load_dotenv
+
+        assert load_dotenv(str(tmp_path / "nope.env")) is False
+
+    def test_service_package_bootstraps_dotenv(self):
+        # importing the service package runs the loader (reference
+        # src/__init__.py:1-2 pattern); it is idempotent, so importing
+        # again here simply must not raise
+        import importlib
+
+        import service
+
+        importlib.reload(service)

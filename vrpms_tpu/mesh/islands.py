@@ -226,7 +226,13 @@ _champion = jax.jit(_pick_champion)
 
 
 def _deadline_driver(
-    call, state, total: int, block_len: int, sync_iters: int, deadline_s: float
+    call,
+    state,
+    total: int,
+    block_len: int,
+    sync_iters: int,
+    deadline_s: float,
+    multi_controller: bool = False,
 ):
     """Host-clock-checked execution of `total` island iterations: full
     migration blocks in chunks of ~sync_iters iterations, then the
@@ -235,15 +241,24 @@ def _deadline_driver(
     start) runs n blocks of bl iterations (bl == 0: n single iterations)
     from absolute iteration offset `start`. At least one chunk always
     runs; afterwards the clock is checked before and after every chunk.
+    With `multi_controller` (the solve's mesh spans processes), every
+    stop decision comes from process 0's clock (mesh.sync.
+    controller_value) so all hosts issue identical chunk sequences —
+    local clocks diverging would strand the ppermute collectives of the
+    extra chunks. Process-local solves must NOT set it: the broadcast
+    is itself a collective the other processes would never join.
     Returns (state, done)."""
     import time
+
+    from vrpms_tpu.mesh.sync import controller_value
 
     n_blocks, tail = _blocked_schedule(total, block_len)
     chunk = max(1, sync_iters // max(block_len, 1))
     t_start = time.monotonic()
 
     def spent():
-        return time.monotonic() - t_start >= deadline_s
+        over = time.monotonic() - t_start >= deadline_s
+        return controller_value(over) if multi_controller else over
 
     def sync(st):
         jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
@@ -348,9 +363,12 @@ def solve_sa_islands(
                 st, k_run, inst, w, t0j, t1j, knn, jnp.int32(start), horizon
             )
 
+        from vrpms_tpu.mesh.sync import mesh_spans_processes
+
         # ~512 iterations per host sync
         state, done = _deadline_driver(
-            call, state, n_iters, block_len, 512, deadline_s
+            call, state, n_iters, block_len, 512, deadline_s,
+            multi_controller=mesh_spans_processes(mesh),
         )
         _, _, best_g, best_c = state
         g, c = _champion(best_g, best_c)
@@ -556,9 +574,12 @@ def solve_ga_islands(
                 mesh, n, bl, chunk_params, k_mig, mode
             )(st, k_run, inst, w, jnp.int32(start))
 
+        from vrpms_tpu.mesh.sync import mesh_spans_processes
+
         # ~128 generations per host sync (a generation costs more)
         state, done = _deadline_driver(
-            call, state, generations, block_len, 128, deadline_s
+            call, state, generations, block_len, 128, deadline_s,
+            multi_controller=mesh_spans_processes(mesh),
         )
         _, _, best_p, best_f = state
         best_perm, _ = _champion(best_p, best_f)
@@ -629,6 +650,8 @@ def solve_ils_islands(
             pool=params.pool,
         )
 
+    from vrpms_tpu.mesh.sync import mesh_spans_processes
+
     return ils_loop(
         anneal,
         n_isl * chains_local,
@@ -639,4 +662,5 @@ def solve_ils_islands(
         mode,
         deadline_s,
         None,
+        multi_controller=mesh_spans_processes(mesh),
     )

@@ -1,0 +1,50 @@
+"""Single-source host decisions for multi-controller (jax.distributed) runs.
+
+The blocked/chunked deadline drivers (mesh.islands._deadline_driver,
+solvers.ils.ils_loop) gate further shard_map chunks on the host wall
+clock. Under a multi-host mesh every controller runs that host loop, and
+two controllers observing different elapsed times would issue different
+chunk counts — collectives (ppermute, and the broadcast here) that one
+process never joins, i.e. a distributed hang. The fix is the standard
+SPMD rule: any data-dependent *control flow* decision must come from ONE
+source. `controller_value` broadcasts process 0's measurement to every
+process (identity in the common single-controller case), so all hosts
+take identical branch sequences.
+
+Discipline for callers: call sites must themselves be reached
+identically on every process (the broadcast is a collective). That is
+true ONLY for solves whose mesh spans every process — gate on
+`mesh_spans_processes` before broadcasting; a process-local solve (e.g.
+plain solve_ils without islands) must never call the collective, or it
+blocks forever waiting for processes that never entered the solve.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def mesh_spans_processes(mesh) -> bool:
+    """True iff this Mesh's devices live on more than one JAX process —
+    the precise condition under which host-side control decisions must
+    be broadcast (and under which broadcasting is safe: every process
+    owning mesh devices runs the same host driver)."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def controller_value(value):
+    """Process 0's `value` (a host float/bool scalar) on every process.
+
+    Single-process: returns `value` unchanged, no collective, no device
+    work — the fast path for every non-distributed deployment.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.broadcast_one_to_all(
+        np.asarray(value, dtype=np.float64)
+    )
+    return type(value)(np.asarray(out))

@@ -15,6 +15,7 @@ VRP: all n! orders, each priced by the bounded-fleet optimal split
 from __future__ import annotations
 
 import math
+import time
 from functools import lru_cache
 
 import jax
@@ -29,6 +30,7 @@ from vrpms_tpu.solvers.common import SolveResult
 
 MAX_BF_CUSTOMERS = 10
 _BATCH = 1 << 13
+_CHUNK_BATCHES = 32  # ~262k orders between host deadline checks
 
 
 def _perm_from_index(idx: jax.Array, n: int) -> jax.Array:
@@ -52,12 +54,11 @@ def _perm_from_index(idx: jax.Array, n: int) -> jax.Array:
     return jnp.stack(out).astype(jnp.int32)
 
 
-def _enumerate_min(n_perms: int, score_fn, n: int):
-    """Scan over fixed-size index batches; returns (best_idx, best_score).
-
-    score_fn: i32[B] perm-indices -> f32[B] scores (BIG for padding).
-    """
-    n_batches = (n_perms + _BATCH - 1) // _BATCH
+def _min_step(score_fn, n_perms: int):
+    """One fixed-size enumeration batch folded into the running best —
+    the ONE reduction step behind both the single-shot scan and the
+    deadline-chunked driver (indices past n_perms score inf, so partial
+    final batches and overshooting chunks are both harmless)."""
 
     def step(carry, b):
         best_idx, best_val = carry
@@ -71,8 +72,19 @@ def _enumerate_min(n_perms: int, score_fn, n: int):
             jnp.where(better, scores[j], best_val),
         ), None
 
+    return step
+
+
+def _enumerate_min(n_perms: int, score_fn, n: int):
+    """Scan over fixed-size index batches; returns (best_idx, best_score).
+
+    score_fn: i32[B] perm-indices -> f32[B] scores (BIG for padding).
+    """
+    n_batches = (n_perms + _BATCH - 1) // _BATCH
     (best_idx, best_val), _ = jax.lax.scan(
-        step, (jnp.int32(0), jnp.float32(jnp.inf)), jnp.arange(n_batches)
+        _min_step(score_fn, n_perms),
+        (jnp.int32(0), jnp.float32(jnp.inf)),
+        jnp.arange(n_batches),
     )
     return best_idx, best_val
 
@@ -93,6 +105,37 @@ def _giant_of(idx, inst: Instance, n: int):
     return jnp.concatenate([jnp.zeros(1, jnp.int32), perm, zeros])
 
 
+def _score_fn(kind: str, n: int, inst: Instance, w: CostWeights):
+    """idx-batch scorer for one problem kind — the ONE place enumeration
+    pricing is defined, shared by the single-shot jits and the deadline
+    chunks so the two paths cannot diverge. 'vrp' picks full-evaluation
+    vs optimal-split pricing off static Instance/weights metadata."""
+    if kind == "tsp":
+        def score(idx_batch):
+            giants = jax.vmap(lambda i: _giant_of(i, inst, n))(idx_batch)
+            return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
+
+        return score
+    # Orders score by pure optimal-split distance only when that IS the
+    # objective; time windows or a makespan weight need the full giant
+    # evaluation (static metadata, so each variant compiles once).
+    full = inst.has_tw or inst.time_dependent or w.use_makespan
+
+    def perm_of(idx):
+        return _perm_from_index(idx, n) + 1
+
+    if full:
+        def score(idx_batch):
+            giants = jax.vmap(lambda i: greedy_split_giant(perm_of(i), inst))(idx_batch)
+            return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
+    else:
+        def score(idx_batch):
+            perms = jax.vmap(perm_of)(idx_batch)
+            return jax.vmap(lambda p: optimal_split_cost(p, inst))(perms)
+
+    return score
+
+
 @lru_cache(maxsize=MAX_BF_CUSTOMERS + 1)
 def _tsp_bf_run_fn(n: int):
     """Build (and cache) the jitted enumeration; the compile caches
@@ -101,27 +144,78 @@ def _tsp_bf_run_fn(n: int):
 
     @jax.jit
     def run(inst, w):
-        def score(idx_batch):
-            giants = jax.vmap(lambda i: _giant_of(i, inst, n))(idx_batch)
-            return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
-
-        return _enumerate_min(math.factorial(n), score, n)
+        return _enumerate_min(math.factorial(n), _score_fn("tsp", n, inst, w), n)
 
     return run
 
 
-def solve_tsp_bf(inst: Instance, weights: CostWeights | None = None) -> SolveResult:
-    """Exact TSP by full enumeration (single vehicle assumed)."""
+@lru_cache(maxsize=2 * (MAX_BF_CUSTOMERS + 1))
+def _bf_chunk_fn(n: int, kind: str):
+    """One jitted chunk of _CHUNK_BATCHES enumeration batches from a
+    dynamic batch offset — the deadline-aware twin of the single-shot
+    run fns. Chunks compose to exactly the single-shot reduction
+    (indices past n! score inf), so the host can check the wall clock
+    between chunks like every other solver's blocked driver."""
+
+    @jax.jit
+    def run(carry, start_b, inst, w):
+        step = _min_step(_score_fn(kind, n, inst, w), math.factorial(n))
+        carry, _ = jax.lax.scan(
+            step, carry, start_b + jnp.arange(_CHUNK_BATCHES)
+        )
+        return carry
+
+    return run
+
+
+def _enumerate_deadline(n: int, kind: str, inst: Instance, w, deadline_s: float):
+    """Host-clock-checked enumeration: returns (best_idx, orders_scored,
+    exhausted). At least one chunk always runs, so the result is the
+    best over >= ~262k orders (or the whole space when smaller); when
+    the deadline cuts enumeration short the result is best-so-far, NOT
+    exact — the caller reports the scored count via SolveResult.evals."""
+    n_perms = math.factorial(n)
+    n_batches = (n_perms + _BATCH - 1) // _BATCH
+    carry = (jnp.int32(0), jnp.float32(jnp.inf))
+    run = _bf_chunk_fn(n, kind)
+    t0 = time.monotonic()
+    b = 0
+    while b < n_batches:
+        carry = run(carry, jnp.int32(b), inst, w)
+        jax.block_until_ready(carry[1])
+        b += _CHUNK_BATCHES
+        if time.monotonic() - t0 >= deadline_s:
+            break
+    scored = min(b * _BATCH, n_perms)
+    return carry[0], scored, scored >= n_perms
+
+
+def solve_tsp_bf(
+    inst: Instance,
+    weights: CostWeights | None = None,
+    deadline_s: float | None = None,
+) -> SolveResult:
+    """Exact TSP by full enumeration (single vehicle assumed).
+
+    With `deadline_s` the enumeration runs in host-clock-checked chunks
+    and may stop early with the best order seen so far (SolveResult.evals
+    reports how many orders were actually scored) — the same best-effort
+    deadline contract as every iterative solver.
+    """
     n = _check_size(inst)
     w = weights or CostWeights.make()
     n_perms = math.factorial(n)
     length = giant_length(n, inst.n_vehicles)
 
-    best_idx, _ = _tsp_bf_run_fn(n)(inst, w)
+    if deadline_s is None:
+        best_idx, _ = _tsp_bf_run_fn(n)(inst, w)
+        scored = n_perms
+    else:
+        best_idx, scored, _ = _enumerate_deadline(n, "tsp", inst, w, deadline_s)
     giant = _giant_of(best_idx, inst, n)
     assert giant.shape == (length,)
     bd = evaluate_giant(giant, inst)
-    return SolveResult(giant, total_cost(bd, w), bd, jnp.int32(n_perms))
+    return SolveResult(giant, total_cost(bd, w), bd, jnp.int32(scored))
 
 
 @lru_cache(maxsize=MAX_BF_CUSTOMERS + 1)
@@ -132,43 +226,37 @@ def _vrp_bf_run_fn(n: int):
 
     @jax.jit
     def run(inst, w):
-        # Orders score by pure optimal-split distance only when that IS
-        # the objective; time windows or a makespan weight need the full
-        # giant evaluation (w.use_makespan is static metadata, so each
-        # variant still compiles once).
-        full = inst.has_tw or inst.time_dependent or w.use_makespan
-
-        def perm_of(idx):
-            return _perm_from_index(idx, n) + 1
-
-        if full:
-            def score(idx_batch):
-                giants = jax.vmap(lambda i: greedy_split_giant(perm_of(i), inst))(idx_batch)
-                return jax.vmap(lambda g: total_cost(evaluate_giant(g, inst), w))(giants)
-        else:
-            def score(idx_batch):
-                perms = jax.vmap(perm_of)(idx_batch)
-                return jax.vmap(lambda p: optimal_split_cost(p, inst))(perms)
-
-        return _enumerate_min(math.factorial(n), score, n)
+        return _enumerate_min(math.factorial(n), _score_fn("vrp", n, inst, w), n)
 
     return run
 
 
-def solve_vrp_bf(inst: Instance, weights: CostWeights | None = None) -> SolveResult:
+def solve_vrp_bf(
+    inst: Instance,
+    weights: CostWeights | None = None,
+    deadline_s: float | None = None,
+) -> SolveResult:
     """Exact CVRP: every customer order priced by its optimal split.
 
     Assumes a homogeneous fleet (split uses capacities[0], like the GA/
     ACO fitness path). Time windows and makespan-priced objectives fall
     back to enumerating orders and evaluating the greedy-split giant —
     exact over that split space, matching the solver fitness paths.
+
+    With `deadline_s` the enumeration runs in host-clock-checked chunks
+    and may stop early with the best order seen so far (then NOT exact;
+    SolveResult.evals reports the orders actually scored).
     """
     n = _check_size(inst)
     w = weights or CostWeights.make()
     n_perms = math.factorial(n)
     full = inst.has_tw or inst.time_dependent or w.use_makespan
 
-    best_idx, _ = _vrp_bf_run_fn(n)(inst, w)
+    if deadline_s is None:
+        best_idx, _ = _vrp_bf_run_fn(n)(inst, w)
+        scored = n_perms
+    else:
+        best_idx, scored, _ = _enumerate_deadline(n, "vrp", inst, w, deadline_s)
     perm = _perm_from_index(best_idx, n) + 1
     if full:
         giant = greedy_split_giant(perm, inst)
@@ -176,4 +264,4 @@ def solve_vrp_bf(inst: Instance, weights: CostWeights | None = None) -> SolveRes
         routes = optimal_split_routes(perm, inst)
         giant = giant_from_routes(routes, n, inst.n_vehicles)
     bd = evaluate_giant(giant, inst)
-    return SolveResult(giant, total_cost(bd, w), bd, jnp.int32(n_perms))
+    return SolveResult(giant, total_cost(bd, w), bd, jnp.int32(scored))

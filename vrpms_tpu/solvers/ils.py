@@ -117,6 +117,7 @@ def ils_loop(
     mode: str,
     deadline_s: float | None,
     init_giants: jax.Array | None,
+    multi_controller: bool = False,
 ) -> SolveResult:
     """The ONE round/polish/reseed/deadline loop behind every ILS
     variant (single-device solve_ils, mesh.solve_ils_islands) — the
@@ -133,7 +134,17 @@ def ils_loop(
     def remaining():
         if deadline_s is None:
             return None
-        return deadline_s - (time.monotonic() - t_start)
+        elapsed = time.monotonic() - t_start
+        if multi_controller:
+            # A mesh-spanning solve (solve_ils_islands over a multi-
+            # process mesh) must take the same round/polish branches on
+            # every controller, so the budget is process 0's clock
+            # everywhere. Process-local solves must NOT broadcast: the
+            # other processes never enter this loop (see mesh.sync).
+            from vrpms_tpu.mesh.sync import controller_value
+
+            elapsed = controller_value(elapsed)
+        return deadline_s - elapsed
 
     best_g = None
     best_c = float("inf")
@@ -150,6 +161,7 @@ def ils_loop(
         # exhausted budget falls back to the unpolished best.
         giants = res.pool if res.pool is not None else res.giant[None]
         costs = None
+        best_block = None
         sweeps_left = params.polish_sweeps
         top_k = 8  # delta_polish_batch default; fixed for the eval test
         while sweeps_left > 0:
@@ -164,6 +176,14 @@ def ils_loop(
             sweeps_left -= block
             if int(p_evals) < block * giants.shape[0] * top_k:
                 break  # converged mid-block
+            # a descent that converges exactly ON the block boundary
+            # reports a full eval count; catch it by the pool best not
+            # moving, saving the redundant (and, for a partial final
+            # block, separately-compiled) extra call
+            new_best = float(jnp.min(costs))
+            if best_block is not None and new_best >= best_block - 1e-6:
+                break
+            best_block = new_best
         champ = int(jnp.argmin(costs)) if costs is not None else 0
         # mode-precision pool costs rank the pool (pool[0] is the
         # anneal's best when unpolished); the champion is re-evaluated

@@ -3,14 +3,51 @@
 The reference keeps a date helper as its only utility (reference
 src/utilities/helper.py:4-6, `get_current_date()` -> '%d-%m-%Y'); the
 same stamp is attached to solve summaries here (see
-vrpms_tpu.solvers.common.solve_info).
+vrpms_tpu.solvers.common.solve_info). The reference's other L4 duty —
+loading `.env` secrets at package import (reference src/__init__.py:1-2,
+README.md:53-66) — is `load_dotenv` below, dependency-free.
 """
 
 from __future__ import annotations
 
+import os
 from datetime import datetime
 
 
 def current_date() -> str:
     """Today as 'DD-MM-YYYY' (reference src/utilities/helper.py:4-6)."""
     return datetime.now().strftime("%d-%m-%Y")
+
+
+def load_dotenv(path: str = ".env") -> bool:
+    """Minimal python-dotenv equivalent (the reference pins the package
+    only for this one call, reference requirements.txt + src/__init__.py:1-2).
+
+    KEY=VALUE lines; blank lines and `#` comments ignored; an optional
+    `export ` prefix and matching single/double quotes are stripped.
+    Existing environment variables are NEVER overridden (python-dotenv's
+    default), so deployment-provided secrets beat the checked-out file.
+    Returns True iff a file was read.
+    """
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return False
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        if line.startswith("export "):
+            line = line[len("export "):].lstrip()
+        key, _, val = line.partition("=")
+        key = key.strip()
+        val = val.strip()
+        if len(val) >= 2 and val[0] == val[-1] and val[0] in "\"'":
+            val = val[1:-1]
+        elif " #" in val:
+            # python-dotenv strips inline comments from unquoted values
+            val = val.split(" #", 1)[0].rstrip()
+        if key and key not in os.environ:
+            os.environ[key] = val
+    return True
