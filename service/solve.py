@@ -196,6 +196,15 @@ def _request_weights(opts):
     return CostWeights.make(makespan=float(opts.get("makespan_weight") or 0.0))
 
 
+def _deadline(opts):
+    """The request's timeLimit as a float deadline (None = unbounded) —
+    the ONE place the option becomes solver deadline_s, for every
+    algorithm. Explicit 0 means "stop as soon as possible", not "no
+    limit"."""
+    val = opts.get("time_limit")
+    return float(val) if val is not None else None
+
+
 def _positive_int(opts, key, default, name, zero_ok=False):
     """Validated positive-integer option: absent -> default, anything
     not a positive integer -> ValueError (the Solver-error envelope).
@@ -262,8 +271,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
         if not _polish_enabled(opts):
             pool = 0
         if algorithm == "bf":
-            deadline = opts.get("time_limit")
-            deadline = float(deadline) if deadline is not None else None
+            deadline = _deadline(opts)
             if problem == "tsp":
                 return solve_tsp_bf(inst, weights=w, deadline_s=deadline)
             return solve_vrp_bf(inst, weights=w, deadline_s=deadline)
@@ -278,8 +286,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 from vrpms_tpu.mesh import solve_ils_islands, solve_sa_islands
 
                 mesh, ip = _island_setup(opts)
-                deadline = opts.get("time_limit")
-                deadline = float(deadline) if deadline is not None else None
+                deadline = _deadline(opts)
                 if ils_rounds:
                     from vrpms_tpu.solvers import ILSParams
 
@@ -319,9 +326,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     greedy_split_giant(warm, inst),
                     resolve_eval_mode("auto"),
                 )
-            deadline = opts.get("time_limit")
-            # explicit 0 means "stop as soon as possible", not "no limit"
-            deadline = float(deadline) if deadline is not None else None
+            deadline = _deadline(opts)
             if ils_rounds:
                 from vrpms_tpu.solvers import ILSParams, solve_ils
 
@@ -346,13 +351,12 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
             )
         if algorithm == "aco":
             p = ACOParams(n_ants=int(pop or 64), n_iters=int(iters or 200))
-            deadline = opts.get("time_limit")
             return solve_aco(
                 inst,
                 key=seed,
                 params=p,
                 weights=w,
-                deadline_s=float(deadline) if deadline is not None else None,
+                deadline_s=_deadline(opts),
             )
         if algorithm == "ga":
             population = int(pop or (ga_params or {}).get("random_permutationCount") or 128)
@@ -366,7 +370,6 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 from vrpms_tpu.mesh import solve_ga_islands
 
                 mesh, ip = _island_setup(opts)
-                deadline = opts.get("time_limit")
                 return solve_ga_islands(
                     inst,
                     key=seed,
@@ -374,7 +377,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     params=p,
                     island_params=ip,
                     weights=w,
-                    deadline_s=float(deadline) if deadline is not None else None,
+                    deadline_s=_deadline(opts),
                     pool=pool,
                 )
             init = None
@@ -390,14 +393,13 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     warm,
                     resolve_eval_mode("auto"),
                 )
-            deadline = opts.get("time_limit")
             return solve_ga(
                 inst,
                 key=seed,
                 params=p,
                 weights=w,
                 init_perms=init,
-                deadline_s=float(deadline) if deadline is not None else None,
+                deadline_s=_deadline(opts),
                 pool=pool,
             )
         raise ValueError(f"unknown algorithm {algorithm!r}")
@@ -483,8 +485,7 @@ def _polish(res, inst, opts, w, t_start):
     from vrpms_tpu.solvers import SolveResult, delta_polish_batch
 
     budget = 128 if spec is True else max(1, int(spec))
-    deadline = opts.get("time_limit")
-    deadline = float(deadline) if deadline is not None else None
+    deadline = _deadline(opts)
     giants = res.pool if res.pool is not None else res.giant[None]
     best_seen = None
     extra_evals = 0

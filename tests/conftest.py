@@ -30,3 +30,62 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# quick/slow split: `-m quick` is the sub-2-minute iteration gate (exactness,
+# contract, parsing, kernel-equivalence tests); the full suite (~12 min, incl.
+# the quality/convergence/end-to-end solves below) remains the round gate.
+# Node-id patterns keep the policy in one place at file/class granularity so
+# individual test renames don't silently change buckets.
+# ---------------------------------------------------------------------------
+
+_SLOW_PATTERNS = (
+    # quality/convergence-heavy solver suites
+    "test_delta_ls.py",
+    "test_islands.py",
+    "test_ils.py",
+    "test_multihost.py",
+    "test_sa.py::TestSA",
+    "test_ga_aco.py",
+    "test_knn_moves.py::TestKnnQuality",
+    "test_pallas_eval.py",
+    # multi-second solves inside otherwise-quick suites; for the
+    # parametrized equivalence families one representative stays quick
+    "test_core_cost.py::TestPropertyVsOracle::test_matches_naive_eval[3",
+    "test_core_cost.py::TestPropertyVsOracle::test_matches_naive_eval[1-True]",
+    "test_split_hot.py::TestGreedySplitHot::test_matches_scan_split[33-5-21]",
+    "test_split_hot.py::TestGreedySplitHot::test_matches_scan_split[19-3-14]",
+    "test_split_hot.py::TestGreedySplitHot::test_fitness_fn_hot_matches_gather",
+    "test_split_hot.py::TestGreedySplitHot::test_oversize_customer_rides_alone",
+    "test_moves_split.py::TestSplit::test_optimal_not_worse_than_greedy",
+    "test_moves_split.py::TestSplit::test_greedy_giant_consistent",
+    "test_moves_split.py::TestMoves::test_random_move_preserves_validity",
+    "test_split_hot.py::TestGaOperatorsHot::test_hot_generation_evolves_and_stays_valid",
+    "test_makespan.py::TestMakespanObjective::test_solve_sa_reduces_makespan",
+    "test_onehot.py::TestSAOnehotMode",
+    "test_io.py::TestSolomon::test_solvable_feasible",
+    "test_io.py::TestCVRPLIB::test_solvable",
+    "test_bf_local_search.py::TestBruteForce::test_vrp_matches_itertools",
+    "test_bf_local_search.py::TestBruteForce::test_vrp_tw_runs_and_beats_random",
+    "test_bf_local_search.py::TestBruteForce::test_deadline_none_and_generous_agree",
+    "test_bf_local_search.py::TestBruteForce::test_deadline_zero_truncates_but_returns_valid",
+    "test_bf_local_search.py::TestLocalSearch",
+    # end-to-end HTTP solves (the envelope/contract tests stay quick)
+    "test_service.py::TestVRPSolve",
+    "test_service.py::TestTSPSolve",
+    "test_service.py::TestTimedPaths",
+    "test_service.py::TestErrorEnvelope::test_non_finite_or_negative_matrix_rejected",
+    "test_service.py::TestErrorEnvelope::test_tsp_duplicate_customers_deduped",
+    "test_makespan.py::TestServiceMakespan",
+    "test_warmstart.py::TestWarmStartHTTP",
+    "test_utils_info.py::TestSolveInfo",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if any(p in item.nodeid for p in _SLOW_PATTERNS):
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.quick)

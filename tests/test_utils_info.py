@@ -78,12 +78,16 @@ class TestLoadDotenv:
             'VRPMS_QUOTED="spaced value"\n'
             "VRPMS_PRESET=from-file\n"
             "VRPMS_INLINE=bare-value # inline comment\n"
+            'VRPMS_QUOTED_INLINE="a b" # comment after quotes\n'
+            'VRPMS_HASH_IN_QUOTES="a # b"\n'
             "not a kv line\n"
         )
         monkeypatch.delenv("SUPABASE_URL", raising=False)
         monkeypatch.delenv("SUPABASE_KEY", raising=False)
         monkeypatch.delenv("VRPMS_QUOTED", raising=False)
         monkeypatch.delenv("VRPMS_INLINE", raising=False)
+        monkeypatch.delenv("VRPMS_QUOTED_INLINE", raising=False)
+        monkeypatch.delenv("VRPMS_HASH_IN_QUOTES", raising=False)
         monkeypatch.setenv("VRPMS_PRESET", "from-env")
         assert load_dotenv(str(env)) is True
         import os
@@ -93,12 +97,18 @@ class TestLoadDotenv:
         assert os.environ["VRPMS_QUOTED"] == "spaced value"
         # inline comments are stripped from unquoted values
         assert os.environ["VRPMS_INLINE"] == "bare-value"
+        # ... and from after a quoted value, which still unquotes
+        assert os.environ["VRPMS_QUOTED_INLINE"] == "a b"
+        # ... but a '#' INSIDE quotes is data
+        assert os.environ["VRPMS_HASH_IN_QUOTES"] == "a # b"
         # real environment always beats the file (python-dotenv default)
         assert os.environ["VRPMS_PRESET"] == "from-env"
         monkeypatch.delenv("SUPABASE_URL")
         monkeypatch.delenv("SUPABASE_KEY")
         monkeypatch.delenv("VRPMS_QUOTED")
         monkeypatch.delenv("VRPMS_INLINE")
+        monkeypatch.delenv("VRPMS_QUOTED_INLINE")
+        monkeypatch.delenv("VRPMS_HASH_IN_QUOTES")
 
     def test_missing_file_is_fine(self, tmp_path):
         from vrpms_tpu.utils import load_dotenv

@@ -43,11 +43,14 @@ def load_dotenv(path: str = ".env") -> bool:
         key, _, val = line.partition("=")
         key = key.strip()
         val = val.strip()
+        # python-dotenv semantics: strip an inline comment first (so a
+        # quoted value followed by ` # ...` still unquotes), then strip
+        # matching quotes
+        if not (val[:1] in "\"'" and val[:1] == val[-1:] and len(val) >= 2):
+            if " #" in val:
+                val = val.split(" #", 1)[0].rstrip()
         if len(val) >= 2 and val[0] == val[-1] and val[0] in "\"'":
             val = val[1:-1]
-        elif " #" in val:
-            # python-dotenv strips inline comments from unquoted values
-            val = val.split(" #", 1)[0].rstrip()
         if key and key not in os.environ:
             os.environ[key] = val
     return True
