@@ -1,0 +1,33 @@
+# Deploy recipe: the self-hosted equivalent of the reference's Vercel
+# plane (reference vercel.json + README.md:69-72). Serves the 9-endpoint
+# contract via service.app on :8080.
+#
+#   docker build -t vrpms-tpu .
+#   docker run -p 8080:8080 -e VRPMS_STORE=memory vrpms-tpu
+#
+# For TPU hosts, base on a TPU-enabled JAX image instead and install
+# jax[tpu]; the service code is identical (backend selection is
+# runtime). SUPABASE_URL/SUPABASE_KEY (or a mounted .env) switch
+# persistence to the hosted store; VRPMS_WARMUP pre-traces expected
+# instance shapes at startup so first requests answer at steady-state
+# latency; the XLA compile cache persists under /cache across restarts
+# when mounted.
+
+FROM python:3.12-slim
+
+WORKDIR /app
+COPY requirements.txt .
+RUN pip install --no-cache-dir -r requirements.txt
+
+COPY vrpms_tpu/ vrpms_tpu/
+COPY service/ service/
+COPY store/ store/
+COPY benchmarks/ benchmarks/
+COPY pyproject.toml .
+
+ENV PYTHONPATH=/app \
+    VRPMS_COMPILE_CACHE=/cache/xla
+VOLUME ["/cache"]
+
+EXPOSE 8080
+CMD ["python", "-m", "service.app", "--port", "8080"]

@@ -1,10 +1,10 @@
 """Benchmark entry: one JSON line on stdout for the round driver.
 
-Measures the framework's primary throughput metric (BASELINE.json):
-candidate route evaluations per second per chip, on the X-n200-k36-
-shaped synthetic CVRP (200 nodes, 36 vehicles — CVRPLIB files can't be
-fetched in this zero-egress container; vrpms_tpu.io.synth generates the
-same statistical shape deterministically).
+Headline metric (BASELINE.json): candidate route evaluations per second
+per chip, on the X-n200-k36-shaped synthetic CVRP (200 nodes, 36
+vehicles — CVRPLIB files can't be fetched in this zero-egress
+container; vrpms_tpu.io.synth generates the same statistical shape
+deterministically).
 
 vs_baseline = accelerator throughput / single-host CPU throughput of the
 identical compiled search. The reference publishes no solver numbers at
@@ -12,7 +12,11 @@ all (BASELINE.md: every endpoint is a stub), so the honest baseline is
 the same workload on the host CPU — the hardware class the reference's
 pure-Python/serverless design targets.
 
-Diagnostics go to stderr; stdout carries exactly one JSON line.
+The single JSON line additionally carries a `families` map — one entry
+per solver family (ga / aco / vrptw one-hot / delta-polish / time-
+dependent sweep) — so BENCH_r*.json catches regressions in anything,
+not just the SA sweep. Diagnostics go to stderr; stdout carries exactly
+one JSON line.
 """
 
 from __future__ import annotations
@@ -36,8 +40,15 @@ def _pick_device():
         return dev, dev.platform
 
 
-def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
-    """routes/sec of the compiled SA sweep on `device` (compile excluded)."""
+def _throughput(
+    inst, device, n_chains: int, n_iters: int, seed: int = 0, mode: str | None = None
+):
+    """routes/sec of the compiled SA sweep on `device` (compile excluded).
+
+    `mode` None picks the production default for the device platform
+    (fused pallas kernel on accelerators — degrading per-call to the XLA
+    one-hot path where the kernel doesn't apply, e.g. timed instances —
+    flat-gather on CPU; core.cost.resolve_eval_mode rationale)."""
     from vrpms_tpu.core.cost import CostWeights, objective_batch_mode
     from vrpms_tpu.moves import knn_table
     from vrpms_tpu.solvers.sa import (
@@ -52,9 +63,8 @@ def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
     knn = knn_table(inst.durations[0], SAParams().knn_k)
     inst = jax.device_put(inst, device)
     knn = jax.device_put(knn, device)
-    # fused pallas kernel on any accelerator, flat-gather on CPU
-    # (core.cost.resolve_eval_mode rationale; 'axon' aliases tpu here)
-    mode = "gather" if device.platform == "cpu" else "pallas"
+    if mode is None:
+        mode = "gather" if device.platform == "cpu" else "pallas"
 
     def chunk(giants, costs, key, start):
         def body(state, i):
@@ -87,7 +97,121 @@ def _throughput(inst, device, n_chains: int, n_iters: int, seed: int = 0):
     return routes_per_sec, elapsed, float(jnp.min(c))
 
 
+def _timed(fn, *args):
+    """(result, steady-state seconds): run once for compile, once timed."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+def _family_ga(device):
+    """GA: pop 512, 50 generations, n=100 (BASELINE.md measured row)."""
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.solvers import GAParams, solve_ga
+
+    inst = jax.device_put(synth_cvrp(100, 12, seed=12), device)
+    p = GAParams(population=512, generations=50, elites=8)
+
+    res, warm_s = _timed(lambda: solve_ga(inst, key=0, params=p))
+    return {
+        "seconds": round(warm_s, 3),
+        "cost": round(float(res.breakdown.distance), 1),
+        "evals_per_sec": round(int(res.evals) / warm_s, 1),
+    }
+
+
+def _family_aco(device):
+    """ACO with KNN candidate lists: 128 ants x 50 iterations, n=100."""
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.solvers import ACOParams, solve_aco
+
+    inst = jax.device_put(synth_cvrp(100, 12, seed=12), device)
+    p = ACOParams(n_ants=128, n_iters=50)
+
+    res, warm_s = _timed(lambda: solve_aco(inst, key=0, params=p))
+    return {
+        "seconds": round(warm_s, 3),
+        "cost": round(float(res.breakdown.distance), 1),
+        "tours_per_sec": round(int(res.evals) / warm_s, 1),
+    }
+
+
+def _family_vrptw(device):
+    """VRPTW sweep (one-hot max-plus-scan TW path), Solomon-R101 shape."""
+    from vrpms_tpu.io.synth import synth_vrptw
+
+    inst = synth_vrptw(101, 19, seed=13)
+    rps, elapsed, best = _throughput(inst, device, n_chains=4096, n_iters=300)
+    return {
+        "routes_per_sec": round(rps, 1),
+        "seconds": round(elapsed, 3),
+        "best_cost": round(best, 1),
+    }
+
+
+def _family_td(device):
+    """Time-dependent sweep (lean-scan hot path), T=24 slices, n=200."""
+    import numpy as np
+
+    from vrpms_tpu.core import make_instance
+    from vrpms_tpu.io.synth import synth_cvrp
+
+    base = synth_cvrp(200, 36, seed=0)
+    d = np.asarray(base.durations[0])
+    t_slices = 24
+    # rush-hour profile: +-30% per slice over the day
+    factors = 1.0 + 0.3 * np.sin(np.linspace(0, 2 * np.pi, t_slices, endpoint=False))
+    slices = d[None, :, :] * factors[:, None, None]
+    inst = make_instance(
+        slices,
+        demands=np.asarray(base.demands),
+        capacities=np.asarray(base.capacities).tolist(),
+        slice_axis="first",
+        slice_minutes=60.0,
+    )
+    rps, elapsed, best = _throughput(inst, device, n_chains=2048, n_iters=100)
+    return {
+        "routes_per_sec": round(rps, 1),
+        "seconds": round(elapsed, 3),
+        "best_cost": round(best, 1),
+        "n_slices": t_slices,
+    }
+
+
+def _family_polish(device):
+    """Delta-descent polish: cost drop + wall on 32 NN-seeded tours."""
+    from vrpms_tpu.core.cost import CostWeights, resolve_eval_mode
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.solvers.delta_ls import delta_polish_batch
+    from vrpms_tpu.solvers.sa import SAParams, initial_giants
+
+    inst = jax.device_put(synth_cvrp(200, 36, seed=0), device)
+    w = CostWeights.make()
+    mode = resolve_eval_mode("auto")
+    giants = initial_giants(jax.random.key(3), 32, inst, SAParams(), mode)
+    from vrpms_tpu.core.cost import objective_batch_mode
+
+    before = float(jnp.min(objective_batch_mode(giants, inst, w, mode)))
+
+    def run():
+        g, c, e = delta_polish_batch(giants, inst, w, max_sweeps=16)
+        return c
+
+    (costs, warm_s) = _timed(lambda: run())
+    return {
+        "seconds": round(warm_s, 3),
+        "cost_before": round(before, 1),
+        "cost_after": round(float(jnp.min(costs)), 1),
+    }
+
+
 def main():
+    from vrpms_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
     dev, platform = _pick_device()
     print(f"[bench] device: {dev} ({platform})", file=sys.stderr)
 
@@ -114,6 +238,27 @@ def main():
             cpu_rps = value
             cpu_baseline = "unavailable"
 
+    families = {}
+    fam_fns = {
+        "ga": _family_ga,
+        "aco": _family_aco,
+        "vrptw_onehot": _family_vrptw,
+        "delta_polish": _family_polish,
+        "time_dependent": _family_td,
+    }
+    for fam, fn in fam_fns.items():
+        try:
+            t0 = time.perf_counter()
+            families[fam] = fn(dev)
+            print(
+                f"[bench] {fam}: {families[fam]} "
+                f"({time.perf_counter() - t0:.1f}s incl. compile)",
+                file=sys.stderr,
+            )
+        except Exception as e:  # one family must not sink the headline
+            print(f"[bench] {fam} FAILED: {e}", file=sys.stderr)
+            families[fam] = {"error": f"{type(e).__name__}: {e}"}
+
     result = {
         "metric": "candidate_routes_per_sec_per_chip",
         "value": round(value, 1),
@@ -125,6 +270,7 @@ def main():
         "measure_seconds": round(elapsed, 3),
         "cpu_routes_per_sec": round(cpu_rps, 1),
         "cpu_baseline": cpu_baseline,
+        "families": families,
     }
     print(json.dumps(result))
 

@@ -129,6 +129,110 @@ def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
     )
 
 
+def config3_budget(seconds, vrp_path=None, seed=0, chains=4096, rounds=None,
+                   per_round=1536):
+    """Cost-at-budget on the config-3 instance: ONE deadline-bounded ILS
+    solve (the service's ilsRounds pipeline) with `timeLimit=seconds`.
+
+    The north-star claim (BASELINE.json: <=2% gap in <10 s on one chip)
+    is about a FRESH process answering inside the budget, so run this
+    under --budget-series, which spawns a new interpreter per point:
+    each pays its own jax/device init and persistent-cache loads
+    (enable_compile_cache amortizes actual XLA compiles across
+    processes). `seconds` bounds the solve only; the parent records the
+    whole process wall clock next to it.
+    """
+    if vrp_path:
+        inst, name, bks = _load_vrp(vrp_path)
+    else:
+        from vrpms_tpu.io.synth import synth_cvrp
+
+        inst, name, bks = synth_cvrp(200, 36, seed=0), "cvrp-n200-k36-budget", None
+    from vrpms_tpu.io.metrics import gap_percent
+    from vrpms_tpu.solvers.ils import ILSParams, solve_ils
+    from vrpms_tpu.solvers.sa import SAParams
+
+    # Tuned on one v5e chip (2026-07, synth X-n200): B=4096 chains,
+    # 1536-sweep rounds (a 512 multiple, so only ONE anneal-block
+    # program shape ever loads; ~1.3 s each) + pool-32 polish reached 37.2k in
+    # 8 s steady-state vs 36.8k for the 123 s record — smaller rounds
+    # convert a tight budget into more polish/reseed cycles. The round
+    # count scales with the budget (the deadline cuts the tail anyway).
+    if rounds is None:
+        rounds = max(4, int(float(seconds) / 1.2) + 1)
+    p = ILSParams.from_budget(
+        rounds, SAParams(n_chains=chains, n_iters=0), rounds * per_round,
+        pool=32,
+    )
+
+    def one(k):
+        t0 = time.perf_counter()
+        res = solve_ils(inst, key=k, params=p, deadline_s=float(seconds))
+        return res, time.perf_counter() - t0
+
+    # cold: first solve of the process (pays per-program load/dispatch
+    # round trips even with a warm disk compile cache — the restarted-
+    # service number); steady: the long-running-service number.
+    res, elapsed = one(seed)
+    res2, elapsed2 = one(seed + 1)
+    extra = {}
+    if bks and float(res.breakdown.cap_excess) == 0.0:
+        extra["gap_percent"] = round(
+            gap_percent(float(res.breakdown.distance), bks), 2
+        )
+    if bks and float(res2.breakdown.cap_excess) == 0.0:
+        extra["steady_gap_percent"] = round(
+            gap_percent(float(res2.breakdown.distance), bks), 2
+        )
+    return _result(
+        3,
+        name,
+        budget_s=float(seconds),
+        cost=round(float(res.breakdown.distance), 1),
+        cap_excess=float(res.breakdown.cap_excess),
+        solve_seconds=round(elapsed, 2),
+        evals=int(res.evals),
+        steady_cost=round(float(res2.breakdown.distance), 1),
+        steady_solve_seconds=round(elapsed2, 2),
+        steady_evals=int(res2.evals),
+        **extra,
+    )
+
+
+def budget_series(seconds_list, vrp_path=None, cpu=False):
+    """Fresh interpreter per budget point — the honest cold-ish-process
+    measurement (in-process jit caches empty; disk compile cache warm
+    after the first ever run on a machine)."""
+    import subprocess
+    import sys
+
+    points = []
+    for s in seconds_list:
+        cmd = [sys.executable, "-m", "benchmarks.ladder", "--configs", "3",
+               "--budget", str(s)]
+        if vrp_path:
+            cmd += ["--vrp", vrp_path]
+        if cpu:
+            cmd += ["--cpu"]
+        t0 = time.perf_counter()
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        wall = time.perf_counter() - t0
+        line = None
+        for out_line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                line = json.loads(out_line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if proc.returncode != 0 or line is None:
+            print(proc.stderr[-2000:], flush=True)
+            raise RuntimeError(f"budget point {s}s failed")
+        line["process_seconds"] = round(wall, 2)
+        points.append(line)
+    print(json.dumps({"config": 3, "name": "budget-series", "points": points}))
+    return points
+
+
 def _load_vrp(path):
     """CVRPLIB file -> (instance, display name, BKS-if-known)."""
     from vrpms_tpu.io import load_cvrplib
@@ -216,11 +320,34 @@ def main():
     ap.add_argument("--solomon", help="path to a Solomon instance for config 5")
     ap.add_argument("--vrp", help="path to a CVRPLIB .vrp for config 3")
     ap.add_argument("--vrp-small", help="path to a CVRPLIB .vrp for config 2")
+    ap.add_argument(
+        "--budget", type=float,
+        help="config 3 as ONE deadline-bounded ILS solve with this "
+        "timeLimit (seconds); prints cost-at-budget",
+    )
+    ap.add_argument(
+        "--budget-series",
+        help="comma-separated seconds (e.g. 1,5,10,30); fresh process "
+        "per point for honest cold-process cost-at-budget",
+    )
     args = ap.parse_args()
     if args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from vrpms_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    if args.budget_series:
+        budget_series(
+            [float(s) for s in args.budget_series.split(",")],
+            vrp_path=args.vrp,
+            cpu=args.cpu,
+        )
+        return
+    if args.budget is not None:
+        config3_budget(args.budget, vrp_path=args.vrp)
+        return
     wanted = {int(c) for c in args.configs.split(",")}
     if 1 in wanted:
         config1_tsp50(args.quick)

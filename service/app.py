@@ -87,14 +87,41 @@ def main():
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--fixtures", help="JSON fixture file for the memory store")
     parser.add_argument("--store", choices=["memory", "supabase"])
+    parser.add_argument(
+        "--warmup",
+        default=os.environ.get("VRPMS_WARMUP", ""),
+        help="pre-trace solver programs for these instance shapes before "
+        "serving, e.g. '200x36,100x12x1024' (locations x vehicles "
+        "[x population]; locations = durations-matrix size incl. depot); "
+        "also via $VRPMS_WARMUP. See service.warmup.",
+    )
     args = parser.parse_args()
     if args.store:
         os.environ["VRPMS_STORE"] = args.store
     if args.fixtures:
         os.environ["VRPMS_FIXTURES"] = args.fixtures
         os.environ.setdefault("VRPMS_STORE", "memory")
+    # persistent XLA compile cache: restarted services skip the ~30s/shape
+    # TPU compiles (the north-star 10s budget assumes this is on)
+    from vrpms_tpu.utils import enable_compile_cache
+
+    cache_dir = enable_compile_cache()
+    if args.warmup:
+        # best-effort like the compile cache: a bad shape spec or a
+        # transient backend error must not crash-loop the service before
+        # the port ever binds
+        try:
+            from service.warmup import warmup
+
+            warmup(args.warmup)
+        except Exception as e:
+            print(f"[warmup] skipped: {type(e).__name__}: {e}")
     server = serve(args.port)
-    print(f"vrpms_tpu service on :{args.port} (store={os.environ.get('VRPMS_STORE', 'auto')})")
+    print(
+        f"vrpms_tpu service on :{args.port} "
+        f"(store={os.environ.get('VRPMS_STORE', 'auto')}, "
+        f"compile_cache={cache_dir or 'off'})"
+    )
     server.serve_forever()
 
 

@@ -79,7 +79,9 @@ def parse_solver_options(content: dict, errors):
     populationSize:     SA chains / GA population / ACO ants
     timeSliceDuration:  minutes per time-of-day slice of a 3-D matrix
     warmStart:          seed the search from the solution previously
-                        checkpointed under this solutionName
+                        checkpointed under this solutionName (SA/GA
+                        chain/population seeding; ACO: colony incumbent
+                        + pheromone head start)
     includeStats:       attach solver statistics to the result message
     profile:            capture a jax.profiler trace of the solve
     timeLimit:          wall-clock budget in seconds; every solver
@@ -108,17 +110,22 @@ def parse_solver_options(content: dict, errors):
                         is the TOTAL sweep budget across rounds. The
                         strongest quality setting (solvers.ils).
                         Explicit 0 = ILS off (plain SA)
-    islands:            run SA/GA as an island model over this many
+    islands:            run SA/GA/ACO as an island model over this many
                         devices of the mesh (vrpms_tpu.mesh): per-device
-                        populations with ring elite migration. Clamped
+                        populations/colonies with ring elite migration
+                        (ACO exchanges incumbent genomes only — each
+                        island keeps its own pheromone matrix). Clamped
                         to the devices actually attached; ignored by
-                        bf/aco. timeLimit applies (migration blocks run
+                        bf. timeLimit applies (migration blocks run
                         in clock-checked chunks), ilsRounds composes
                         (sharded anneal rounds, pool polish between),
                         and localSearchPool polishes the per-island
-                        champions; warmStart does not apply
+                        champions; warmStart applies to ACO only (it
+                        seeds every island's colony incumbent)
     migrateEvery:       steps between ring migrations (default 100)
-    migrants:           elites sent to the ring neighbor (default 4)
+    migrants:           elites sent to the ring neighbor (default 4;
+                        SA/GA only — ACO islands always exchange
+                        exactly their one incumbent genome)
     """
     return {
         "backend": get_parameter("backend", content, errors, optional=True),

@@ -351,12 +351,29 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
             )
         if algorithm == "aco":
             p = ACOParams(n_ants=int(pop or 64), n_iters=int(iters or 200))
+            if islands:
+                from vrpms_tpu.mesh import solve_aco_islands
+
+                mesh, ip = _island_setup(opts)
+                return solve_aco_islands(
+                    inst,
+                    key=seed,
+                    mesh=mesh,
+                    params=p,
+                    island_params=ip,
+                    weights=w,
+                    deadline_s=_deadline(opts),
+                    init_perm=warm,
+                    pool=pool,
+                )
             return solve_aco(
                 inst,
                 key=seed,
                 params=p,
                 weights=w,
                 deadline_s=_deadline(opts),
+                init_perm=warm,
+                pool=pool,
             )
         if algorithm == "ga":
             population = int(pop or (ga_params or {}).get("random_permutationCount") or 128)
@@ -481,7 +498,7 @@ def _polish(res, inst, opts, w, t_start):
     spec = _polish_spec(opts)
     if not spec or res is None:
         return res, False
-    from vrpms_tpu.core.cost import evaluate_giant, total_cost
+    from vrpms_tpu.core.cost import exact_cost
     from vrpms_tpu.solvers import SolveResult, delta_polish_batch
 
     budget = 128 if spec is True else max(1, int(spec))
@@ -490,11 +507,17 @@ def _polish(res, inst, opts, w, t_start):
     best_seen = None
     extra_evals = 0
     ran = False
+    # at least ONE block always runs for an EXPLICIT localSearch request
+    # (the ils_loop rule): the user asked for the polish, so the solver
+    # consuming the whole timeLimit must not silently skip it (overshoot
+    # bounded by one block). Implicit pool polish keeps strict deadlines.
+    force_first = bool(opts.get("local_search"))
     while budget > 0:
-        # clock check BEFORE each block: a solver that consumed the whole
-        # timeLimit leaves nothing for polish, and the response must not
-        # overshoot the declared budget by a polish block
-        if deadline is not None and time.perf_counter() - t_start >= deadline:
+        if (
+            (ran or not force_first)
+            and deadline is not None
+            and time.perf_counter() - t_start >= deadline
+        ):
             break
         block = min(POLISH_BLOCK_SWEEPS, budget)
         giants, costs, evals = delta_polish_batch(
@@ -518,8 +541,7 @@ def _polish(res, inst, opts, w, t_start):
     if not ran:
         return res._replace(evals=evals), ran
     champ = giants[int(jnp.argmin(costs))]
-    bd = evaluate_giant(champ, inst)
-    cost = total_cost(bd, w)
+    bd, cost = exact_cost(champ, inst, w)
     if float(cost) >= float(res.cost):
         return res._replace(evals=evals), ran
     return SolveResult(champ, cost, bd, evals), ran
@@ -546,8 +568,8 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
         "warmStart": warm is not None,
         "localSearch": polished,
     }
-    # only SA/GA actually island-shard (bf/aco ignore the option)
-    if opts.get("islands") and algorithm in ("sa", "ga"):
+    # SA/GA/ACO island-shard (bf ignores the option)
+    if opts.get("islands") and algorithm in ("sa", "ga", "aco"):
         stats["islands"] = _island_devices(opts)[0]
     if opts.get("ils_rounds") and algorithm == "sa":
         stats["ilsRounds"] = int(opts["ils_rounds"])
@@ -607,11 +629,15 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     warm = None
     # Only non-island SA and GA consume a warm seed (see _solve_instance);
     # skipping the lookup otherwise also keeps stats['warmStart'] truthful.
+    # SA/GA consume a warm seed only without islands; ACO warms its
+    # colony incumbent either way (solve_aco/solve_aco_islands init_perm).
     if (
         opts.get("warm_start")
         and database is not None
-        and algorithm in ("sa", "ga")
-        and not opts.get("islands")
+        and (
+            algorithm == "aco"
+            or (algorithm in ("sa", "ga") and not opts.get("islands"))
+        )
     ):
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "vrp")
     with _device_ctx(opts.get("backend")):
@@ -702,11 +728,15 @@ def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     )
     orig_ids = [locations[i]["id"] for i in active_pos]
     warm = None
+    # SA/GA consume a warm seed only without islands; ACO warms its
+    # colony incumbent either way (solve_aco/solve_aco_islands init_perm).
     if (
         opts.get("warm_start")
         and database is not None
-        and algorithm in ("sa", "ga")
-        and not opts.get("islands")
+        and (
+            algorithm == "aco"
+            or (algorithm in ("sa", "ga") and not opts.get("islands"))
+        )
     ):
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "tsp")
     with _device_ctx(opts.get("backend")):

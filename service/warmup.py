@@ -1,0 +1,98 @@
+"""Startup warmup: pre-trace the hot solver programs for expected shapes.
+
+XLA compiles are keyed on array shapes and static config: chain batch B,
+giant-tour length L (1 + customers + vehicles), eval mode, and block
+length. A fresh process pays ~30 s per shape on TPU for the first solve
+— far outside the north-star response budget (BASELINE.md config 3:
+<10 s). With the persistent compile cache (vrpms_tpu.utils.
+enable_compile_cache) plus this warmup, a restarted service answers its
+first real request at steady-state latency: the warmup replays the
+EXACT service dispatch (service.solve._solve_instance) on synthetic
+instances of the declared shapes, so every program a matching request
+needs is already in the in-process jit caches (and on disk for the next
+restart).
+
+Shape spec grammar (service.app --warmup / $VRPMS_WARMUP):
+
+    "200x36,100x12x1024"   ->   (locations x vehicles [x population])
+
+N is the LOCATION count — the durations-matrix size, depot included
+(exactly what a request's matrix row count is) — NOT the customer
+count; programs are keyed on L = 1 + (N-1) customers + V vehicles, so
+an off-by-one here silently warms the wrong shape. Population defaults
+to the service's own default for each algorithm.
+Warmed programs per shape: the deadline-blocked SA anneal (512-sweep
+blocks — every timeLimit request reuses these), constructive init, the
+delta-descent polish for pool sizes 1 and 32 (localSearch /
+localSearchPool / ilsRounds paths), and the exact final evaluation. A
+request with no timeLimit and a novel iterationCount still compiles its
+own single-block anneal once.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def parse_shapes(spec: str) -> list[tuple[int, int, int | None]]:
+    """'200x36,100x12x1024' -> [(200, 36, None), (100, 12, 1024)]."""
+    shapes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dims = [int(x) for x in part.split("x")]
+        if len(dims) == 2:
+            shapes.append((dims[0], dims[1], None))
+        elif len(dims) == 3:
+            shapes.append((dims[0], dims[1], dims[2]))
+        else:
+            raise ValueError(
+                f"warmup shape {part!r} is not NxV or NxVxPOP"
+            )
+    return shapes
+
+
+def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True) -> float:
+    """Run the warmup for every shape in `spec`; returns seconds spent."""
+    from service.solve import _run_solver
+    from vrpms_tpu.io.synth import synth_cvrp
+
+    t_start = time.perf_counter()
+    for n, v, pop in parse_shapes(spec):
+        inst = synth_cvrp(n, v, seed=0)
+        for algo in algorithms:
+            errors: list = []
+            # timeLimit 0 -> one 512-sweep deadline block (the program
+            # every timeLimit request runs); localSearchPool 32 compiles
+            # the pool polish; iterationCount 512 keeps the block full-
+            # size. _run_solver is the service's own timed dispatch, so
+            # the polish and final-eval programs warm too.
+            opts = {
+                "seed": 0,
+                "population_size": pop,
+                "iteration_count": 512,
+                "time_limit": 0.0,
+                "local_search": True,
+                "local_search_pool": 32,
+            }
+            res, _ = _run_solver(inst, algo, opts, {}, errors, "vrp", None)
+            # champion-only polish (localSearch without a pool) is a
+            # distinct batch-1 program
+            opts2 = {
+                "seed": 0,
+                "population_size": pop,
+                "iteration_count": 512,
+                "time_limit": 0.0,
+                "local_search": True,
+            }
+            res2, _ = _run_solver(inst, algo, opts2, {}, errors, "vrp", None)
+            if errors and log:
+                print(f"[warmup] {n}x{v} {algo}: {errors}", file=sys.stderr)
+            del res, res2
+    elapsed = time.perf_counter() - t_start
+    if log:
+        print(f"[warmup] {spec} ({','.join(algorithms)}): {elapsed:.1f}s",
+              file=sys.stderr)
+    return elapsed

@@ -149,6 +149,93 @@ class TestACO:
         assert float(free.cost) == float(timed.cost)
         assert np.array_equal(np.asarray(free.giant), np.asarray(timed.giant))
 
+    def test_onehot_deposit_matches_scatter(self, rng):
+        # the MXU outer-product deposit must add exactly the scatter's
+        # multiset of edges, repeated (0,0) hops of unused vehicles
+        # included
+        from vrpms_tpu.solvers.aco import deposit
+
+        n = 9
+        tau = jnp.asarray(rng.uniform(0.1, 1.0, size=(n, n)), jnp.float32)
+        # giant with trailing empty routes -> repeated (0, 0) edges
+        giant = jnp.asarray([0, 3, 1, 0, 5, 2, 4, 0, 6, 7, 8, 0, 0, 0], jnp.int32)
+        amount = jnp.float32(0.37)
+        got = deposit(tau, giant, amount, hot=True)
+        want = deposit(tau, giant, amount, hot=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+    def test_warm_start_never_worse_than_seed(self, rng):
+        from vrpms_tpu.core.cost import CostWeights, exact_cost
+        from vrpms_tpu.core.split import greedy_split_giant
+        from vrpms_tpu.solvers.local_search import nearest_neighbor_perm
+
+        inst = euclidean_cvrp(rng, n=10, v=3, q=8)
+        w = CostWeights.make()
+        # a deliberately good seed: the NN-constructed order
+        seed_perm = nearest_neighbor_perm(inst)
+        seed_cost = float(exact_cost(greedy_split_giant(seed_perm, inst), inst, w)[1])
+        res = solve_aco(
+            inst, key=5, params=ACOParams(n_ants=8, n_iters=3),
+            init_perm=seed_perm,
+        )
+        # 3 iterations of a tiny colony rarely improve on NN; the warm
+        # incumbent guarantees the solve never returns worse either way
+        assert float(res.cost) <= seed_cost + 1e-3
+
+    def test_elite_pool_sorted_valid(self, rng):
+        inst = euclidean_cvrp(rng, n=10, v=3, q=8)
+        res = solve_aco(
+            inst, key=6, params=ACOParams(n_ants=16, n_iters=30), pool=4
+        )
+        assert res.pool is not None and res.pool.shape[0] == 4
+        from vrpms_tpu.core.cost import CostWeights, exact_cost
+
+        w = CostWeights.make()
+        costs = [float(exact_cost(g, inst, w)[1]) for g in res.pool]
+        for g in res.pool:
+            assert is_valid_giant(np.asarray(g), 9, 3)
+        # the pool is exact-re-ranked at the solver boundary: best
+        # first, and the champion never exact-prices worse than pool[0]
+        assert costs[0] == min(costs)
+        assert float(res.cost) <= costs[0] + 1e-3
+
+
+class TestACOIslands:
+    def test_islands_solve_valid_and_competitive(self, rng):
+        from vrpms_tpu.mesh import IslandParams, make_mesh, solve_aco_islands
+
+        inst = euclidean_cvrp(rng, n=10, v=3, q=8)
+        mesh = make_mesh(n_devices=4)
+        res = solve_aco_islands(
+            inst,
+            key=0,
+            mesh=mesh,
+            params=ACOParams(n_ants=16, n_iters=40),
+            island_params=IslandParams(migrate_every=10, n_migrants=1),
+            pool=4,
+        )
+        assert is_valid_giant(np.asarray(res.giant), 9, 3)
+        assert res.pool is not None and res.pool.shape[0] == 4
+        # islands at 4x the colony count must not lose badly to one colony
+        single = solve_aco(inst, key=0, params=ACOParams(n_ants=16, n_iters=40))
+        assert float(res.cost) <= float(single.cost) * 1.10 + 1e-3
+
+    def test_islands_deadline_truncates(self, rng):
+        from vrpms_tpu.mesh import IslandParams, make_mesh, solve_aco_islands
+
+        inst = euclidean_cvrp(rng, n=8, v=2, q=12)
+        mesh = make_mesh(n_devices=2)
+        res = solve_aco_islands(
+            inst,
+            key=1,
+            mesh=mesh,
+            params=ACOParams(n_ants=8, n_iters=100_000),
+            island_params=IslandParams(migrate_every=10, n_migrants=1),
+            deadline_s=1e-6,
+        )
+        assert is_valid_giant(np.asarray(res.giant), 7, 2)
+        assert int(res.evals) < 2 * 8 * 100_000
+
 
 class TestGaInit:
     def test_nn_population_not_worse_than_random(self):

@@ -111,7 +111,10 @@ class TestObjectiveHot:
         # late at 2 plus 11 late at 1 — the hot path must rank them so
         assert got[0] < got[1]
 
-    def test_time_dependent_instances_fall_back(self, rng):
+    def test_time_dependent_lean_scan_matches_gather(self, rng):
+        # the TD hot path (one-hot precompute + lean scan with one flat
+        # travel gather per leg) must price exactly like the per-leg
+        # gather walk _td_eval
         slices = rng.uniform(1, 50, size=(2, 6, 6))
         inst = make_instance(slices, n_vehicles=2, slice_axis="first")
         giants = random_giant_batch(jax.random.key(6), 8, 5, 2)
@@ -119,6 +122,32 @@ class TestObjectiveHot:
         ref = np.asarray(objective_batch(giants, inst, w))
         got = np.asarray(objective_hot_batch(giants, inst, w))
         np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_time_dependent_with_tw_and_makespan_matches_gather(self, rng):
+        # TD + time windows + service + per-vehicle shift starts +
+        # makespan pricing: every term of the lean-scan path against the
+        # reference walk, across many slices
+        t, n, v = 5, 9, 3
+        slices = rng.uniform(5, 60, size=(t, n, n))
+        ready = np.concatenate([[0.0], rng.uniform(0, 120, n - 1)])
+        due = ready + rng.uniform(30, 120, n)
+        service = rng.integers(0, 10, n).astype(float)
+        inst = make_instance(
+            slices,
+            demands=[0] + [1] * (n - 1),
+            capacities=[4.0, 4.0, 4.0],
+            ready=ready.tolist(),
+            due=due.tolist(),
+            service=service.tolist(),
+            start_times=[0.0, 30.0, 60.0],
+            slice_axis="first",
+            slice_minutes=45.0,
+        )
+        giants = random_giant_batch(jax.random.key(7), 16, n - 1, v)
+        w = CostWeights.make(makespan=2.5)
+        ref = np.asarray(objective_batch(giants, inst, w))
+        got = np.asarray(objective_hot_batch(giants, inst, w))
+        np.testing.assert_allclose(got, ref, rtol=2e-5)
 
     def test_wide_instance_uses_f32(self, rng):
         assert onehot_dtype(256) == jnp.bfloat16

@@ -160,13 +160,21 @@ class TestWarmStartHTTP:
         assert alice_ws["owner"] == ALICE
 
     def test_warm_stat_false_for_algorithms_without_seed(self, server):
+        # BF is the one remaining algorithm with no warm-start seam
+        # (SA/GA seed chains/populations; ACO seeds its colony incumbent)
         status, _ = post(server, "/api/vrp/sa", vrp_body())
         assert status == 200
+        status, resp = post(
+            server, "/api/vrp/bf", vrp_body(warmStart=True)
+        )
+        assert status == 200 and resp["success"]
+        assert resp["message"]["stats"]["warmStart"] is False
+        # ... while ACO now consumes the checkpoint
         status, resp = post(
             server, "/api/vrp/aco", vrp_body(warmStart=True, iterationCount=30)
         )
         assert status == 200 and resp["success"]
-        assert resp["message"]["stats"]["warmStart"] is False
+        assert resp["message"]["stats"]["warmStart"] is True
 
     def test_ga_warm_start(self, server):
         status, _ = post(server, "/api/vrp/sa", vrp_body())

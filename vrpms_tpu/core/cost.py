@@ -24,6 +24,7 @@ All three return the same CostBreakdown so solvers are path-agnostic.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import NamedTuple
 
@@ -368,6 +369,82 @@ def _tw_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
     return cost
 
 
+def _td_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Array:
+    """Batched objective for time-DEPENDENT durations — the lean-scan
+    hot path.
+
+    The duration slice of each leg is chosen by its departure time
+    (reference src/solver.py:7 `time_of_day`), a true sequential
+    dependency with no associative reformulation — so a scan over the
+    leg positions is irreducible. What IS reducible is everything
+    around it: _td_eval (the single-tour path) gathers service/ready/
+    due/start per scan step, which TPU lowers to scalar loops; here all
+    per-leg aux quantities precompute over the whole (B, K) leg grid as
+    one-hot contractions (MXU) before the scan, and the scan body is
+    elementwise VPU math plus exactly ONE flat f32 gather of B travel
+    times per step. Semantics match _td_eval leg for leg (same clock
+    propagation, same `% n_slices` cyclic slicing); travel times are
+    f32-exact (no bf16 table rounding — the gather reads the original
+    matrix), aux selections share the TW hot path's one-hot precision.
+    """
+    v = inst.n_vehicles
+    t_slices = inst.n_slices
+    n = inst.n_nodes
+    b = giants.shape[0]
+    dt = onehot_dtype(max(giants.shape[1], n))
+    prev, cur = giants[:, :-1], giants[:, 1:]
+    prev_oh = _onehot(prev, n, dt)
+    next_oh = _onehot(cur, n, dt)
+    service_prev = jnp.einsum(
+        "bkn,n->bk", prev_oh, inst.service, preferred_element_type=jnp.float32
+    )
+    ready_cur = jnp.einsum(
+        "bkn,n->bk", next_oh, inst.ready, preferred_element_type=jnp.float32
+    )
+    due_cur = jnp.einsum(
+        "bkn,n->bk", next_oh, inst.due, preferred_element_type=jnp.float32
+    )
+    rid = _rid_batch(giants)
+    route_of_leg = jnp.minimum(rid[:, :-1], v - 1)
+    start_oh = (route_of_leg[..., None] == jnp.arange(v)).astype(jnp.float32)
+    start = jnp.einsum(
+        "bkv,v->bk", start_oh, inst.start_times,
+        preferred_element_type=jnp.float32,
+    )
+    from_depot = prev == 0
+
+    # flat travel lookup: index = slice*N*N + prev*N + cur; the (prev,
+    # cur) part is departure-independent, precomputed once per leg
+    nn = n * n
+    pn = prev.astype(jnp.int32) * n + cur.astype(jnp.int32)
+    d_flat = inst.durations.reshape(t_slices * nn)
+
+    def step(clock, x):
+        pn_k, reset_k, start_k, svc_k, rdy_k = x
+        depart = jnp.where(reset_k, start_k, clock + svc_k)
+        sidx = (depart // inst.slice_minutes).astype(jnp.int32) % t_slices
+        travel = d_flat[sidx * nn + pn_k]
+        arrive = jnp.maximum(depart + travel, rdy_k)
+        return arrive, (travel, arrive)
+
+    _, (legs, arrive) = jax.lax.scan(
+        step,
+        jnp.zeros((b,), jnp.float32),
+        (pn.T, from_depot.T, start.T, service_prev.T, ready_cur.T),
+    )
+    legs, arrive = legs.T, arrive.T  # back to (B, K)
+    dist = legs.sum(axis=1)
+    lateness = jnp.maximum(arrive - due_cur, 0.0).sum(axis=1)
+    cap_excess = _cap_excess_hot(prev_oh, rid, inst)
+    cost = dist + w.cap * cap_excess + w.tw * lateness
+    if w.use_makespan:
+        closes = cur == 0
+        route_end = _per_route_sums(jnp.where(closes, arrive, 0.0), rid, v)
+        route_dur = jnp.maximum(route_end - inst.start_times[None, :], 0.0)
+        cost = cost + w.makespan * route_dur.max(axis=-1)
+    return cost
+
+
 def objective_hot_batch(
     giants: jax.Array, inst: Instance, w: CostWeights
 ) -> jax.Array:
@@ -375,12 +452,12 @@ def objective_hot_batch(
 
     distance: bf16-rounded durations (exact one-hot selection of a
     rounded table); capacity excess: exact. Time-windowed instances take
-    the one-hot max-plus-scan path above; only time-DEPENDENT durations
-    (slice chosen by departure time) fall back to the gather formulation
-    — their sequential per-leg slice selection has no one-hot form.
+    the one-hot max-plus-scan path above; time-DEPENDENT durations take
+    the lean-scan path (_td_hot_batch): one-hot precomputation around an
+    irreducible departure-time scan.
     """
     if inst.time_dependent:
-        return objective_batch(giants, inst, w)
+        return _td_hot_batch(giants, inst, w)
     if inst.has_tw:
         return _tw_hot_batch(giants, inst, w)
     prev_oh, _, legs, dt = _legs_hot(giants, inst)
@@ -434,6 +511,47 @@ def evaluate_giant(giant: jax.Array, inst: Instance) -> CostBreakdown:
     if inst.has_tw:
         return _tw_eval(giant, inst)
     return _fast_eval(giant, inst)
+
+
+@functools.lru_cache(maxsize=4)
+def _exact_eval_fn():
+    """Jitted (breakdown, total_cost) of one tour — the ONE compiled
+    exact-evaluation program every solver's final/championship check
+    uses. Eagerly, evaluate_giant + total_cost issue ~10 small device
+    programs; through a tunneled TPU that is seconds of dispatch latency
+    per call, paid once per solve and once per ILS round — as one jitted
+    (and persistently cached) program it is one dispatch."""
+
+    @jax.jit
+    def fn(giant, inst, w):
+        bd = evaluate_giant(giant, inst)
+        return bd, total_cost(bd, w)
+
+    return fn
+
+
+def exact_cost(giant: jax.Array, inst: Instance, w: CostWeights):
+    """(CostBreakdown, penalized cost) via the shared jitted program."""
+    return _exact_eval_fn()(giant, inst, w)
+
+
+@functools.lru_cache(maxsize=4)
+def _exact_eval_batch_fn():
+    """Jitted exact penalized costs of a [B, L] giant batch (the
+    batched twin of _exact_eval_fn; used to re-rank small elite pools
+    by the TRUE objective before results cross the solver boundary)."""
+
+    @jax.jit
+    def fn(giants, inst, w):
+        bd = jax.vmap(evaluate_giant, in_axes=(0, None))(giants, inst)
+        return total_cost(bd, w)
+
+    return fn
+
+
+def exact_cost_batch(giants: jax.Array, inst: Instance, w: CostWeights):
+    """f32[B] exact penalized costs via the shared jitted program."""
+    return _exact_eval_batch_fn()(giants, inst, w)
 
 
 def evaluate_batch(giants: jax.Array, inst: Instance) -> CostBreakdown:

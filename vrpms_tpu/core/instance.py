@@ -132,18 +132,27 @@ def make_instance(
     ([T,N,N]), "last" ([N,N,T]), or "auto" to infer from the square pair
     of axes. "auto" is ambiguous when T == N, so explicit callers (the
     service layer knows its JSON nesting) should pass "last"/"first".
+
+    All normalization runs in HOST numpy; device arrays are created only
+    by the final per-field transfers. The previous eager-jnp version
+    issued ~10 tiny device programs per build, each costing a compile/
+    load round trip through a tunneled TPU — seconds of latency before
+    a solve could even start.
     """
-    d = jnp.asarray(durations, dtype=dtype)
+    import numpy as np
+
+    np_dtype = np.dtype(dtype)
+    d = np.array(durations, dtype=np_dtype)
     if d.ndim == 2:
         d = d[None]
     elif d.ndim == 3:
         if slice_axis == "last":
-            d = jnp.moveaxis(d, -1, 0)
+            d = np.moveaxis(d, -1, 0)
         elif slice_axis == "auto":
             # [N, N, T] (per-pair list of slice durations, the natural
             # JSON nesting for matrix[i][j] == [t0, t1, ...]) -> T first.
             if d.shape[0] == d.shape[1] and d.shape[1] != d.shape[2]:
-                d = jnp.moveaxis(d, -1, 0)
+                d = np.moveaxis(d, -1, 0)
             elif d.shape[0] == d.shape[1] == d.shape[2]:
                 raise ValueError(
                     "ambiguous cubic durations (T == N); pass "
@@ -158,29 +167,37 @@ def make_instance(
         raise ValueError(f"durations must be square, got {d.shape}")
     # Depot self-loop must be free: adjacent separator zeros in the giant
     # tour encode an unused vehicle, whose legs are (0, 0).
-    d = d.at[:, 0, 0].set(0.0)
+    d[:, 0, 0] = 0.0
 
     demands = (
-        jnp.zeros(n, dtype) if demands is None else jnp.asarray(demands, dtype)
+        np.zeros(n, np_dtype)
+        if demands is None
+        else np.array(demands, dtype=np_dtype)
     )
-    demands = demands.at[0].set(0.0)
+    if demands.shape == (n,):
+        demands[0] = 0.0
     if capacities is None:
         v = n_vehicles or 1
-        capacities = jnp.full((v,), BIG, dtype)
+        capacities = np.full((v,), BIG, np_dtype)
     else:
-        capacities = jnp.asarray(capacities, dtype).reshape(-1)
+        capacities = np.asarray(capacities, dtype=np_dtype).reshape(-1)
     v = capacities.shape[0]
 
     # Ready times alone also require the timed path (arrival waiting).
     has_tw = due is not None or ready is not None
-    ready = jnp.zeros(n, dtype) if ready is None else jnp.asarray(ready, dtype)
-    due = jnp.full(n, BIG, dtype) if due is None else jnp.asarray(due, dtype)
-    service = jnp.zeros(n, dtype) if service is None else jnp.asarray(service, dtype)
-    service = service.at[0].set(0.0)  # no service at the depot
+    ready = np.zeros(n, np_dtype) if ready is None else np.asarray(ready, np_dtype)
+    due = np.full(n, BIG, np_dtype) if due is None else np.asarray(due, np_dtype)
+    service = (
+        np.zeros(n, np_dtype)
+        if service is None
+        else np.array(service, dtype=np_dtype)
+    )
+    if service.shape == (n,):
+        service[0] = 0.0  # no service at the depot
     start_times = (
-        jnp.zeros(v, dtype)
+        np.zeros(v, np_dtype)
         if start_times is None
-        else jnp.asarray(start_times, dtype).reshape(-1)
+        else np.asarray(start_times, np_dtype).reshape(-1)
     )
     if start_times.shape[0] != v:
         raise ValueError(
@@ -198,13 +215,13 @@ def make_instance(
             raise ValueError(f"{name} must have shape ({n},), got {arr.shape}")
 
     return Instance(
-        durations=d,
-        demands=demands,
-        capacities=capacities,
-        ready=ready,
-        due=due,
-        service=service,
-        start_times=start_times,
+        durations=jnp.asarray(d),
+        demands=jnp.asarray(demands),
+        capacities=jnp.asarray(capacities),
+        ready=jnp.asarray(ready),
+        due=jnp.asarray(due),
+        service=jnp.asarray(service),
+        start_times=jnp.asarray(start_times),
         has_tw=bool(has_tw),
         slice_minutes=float(slice_minutes),
     )

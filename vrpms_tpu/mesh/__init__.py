@@ -1,5 +1,6 @@
 from vrpms_tpu.mesh.islands import (
     make_mesh,
+    solve_aco_islands,
     solve_sa_islands,
     solve_ga_islands,
     solve_ils_islands,

@@ -38,10 +38,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from vrpms_tpu.core.cost import (
     CostWeights,
-    evaluate_giant,
+    exact_cost,
     objective_batch_mode,
     resolve_eval_mode,
-    total_cost,
 )
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
@@ -375,10 +374,10 @@ def solve_sa_islands(
         if pool > 0:
             order = jnp.argsort(best_c)[: min(pool, best_g.shape[0])]
             elite = best_g[order]
-    bd = evaluate_giant(g, inst)
+    bd, cost = exact_cost(g, inst, w)
     return SolveResult(
         g,
-        total_cost(bd, w),
+        cost,
         bd,
         jnp.int32(n_isl * chains_local * done),
         elite,
@@ -585,7 +584,7 @@ def solve_ga_islands(
         best_perm, _ = _champion(best_p, best_f)
         pool_perms, pool_fits = best_p, best_f
     giant = greedy_split_giant(best_perm, inst)
-    bd = evaluate_giant(giant, inst)
+    bd, cost = exact_cost(giant, inst, w)
     elite = None
     if pool > 0:
         order = jnp.argsort(pool_fits)[: min(pool, pool_perms.shape[0])]
@@ -594,9 +593,176 @@ def solve_ga_islands(
         )
     return SolveResult(
         giant,
-        total_cost(bd, w),
+        cost,
         bd,
         jnp.int32(n_isl * pop_local * done),
+        elite,
+    )
+
+
+@lru_cache(maxsize=32)
+def _aco_islands_chunk_fn(mesh: Mesh, n_blocks: int, block_len: int, aco_params):
+    """One jitted chunk of n_blocks ACO migration blocks over the mesh.
+
+    Per-island colonies with PHEROMONE-FREE elite exchange: each island
+    evolves its own dense tau matrix; at migration only the incumbent
+    genome + fitness cross the ring (a few hundred bytes — the
+    communicate-small-things rule; shipping tau would be N^2 floats per
+    hop). A received better elite replaces the local incumbent AND is
+    deposited into the local tau, so the information actually steers
+    construction. block_len == 0 marks a migration-free tail of
+    n_blocks single iterations. Chunks compose exactly (absolute
+    iteration offsets), so _deadline_driver can clock-check between
+    them like SA/GA.
+    """
+    from vrpms_tpu.core.cost import resolve_eval_mode
+    from vrpms_tpu.solvers.aco import aco_iteration, deposit
+
+    n_isl = mesh.shape["islands"]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("islands"), P(), P(), P(), P(), P()),
+        out_specs=P("islands"),
+        check_vma=False,
+    )
+    def run(state, k_run, inst, w, knn_mask, start_it):
+        hot = resolve_eval_mode("auto") != "gather"
+        isl = jax.lax.axis_index("islands")
+        k_isl = jax.random.fold_in(k_run, isl)
+        tau1, bp1, bf1 = state
+        st = (
+            tau1[0], bp1[0], bf1[0],
+            jnp.zeros((0, bp1.shape[-1]), bp1.dtype), jnp.zeros((0,)),
+        )
+
+        def iteration(st, it):
+            return aco_iteration(
+                st, it, k_isl, inst, w, aco_params, knn_mask, hot
+            ), None
+
+        def migrate(st):
+            tau, bp, bf, pp, pf = st
+            rbp = jax.lax.ppermute(bp, "islands", _ring(n_isl))
+            rbf = jax.lax.ppermute(bf, "islands", _ring(n_isl))
+            better = rbf < bf
+            bp = jnp.where(better, rbp, bp)
+            bf = jnp.where(better, rbf, bf)
+            # deposit the adopted elite so construction feels it; a
+            # zero amount makes the rejected case a no-op
+            amount = jnp.where(better, 1.0 / jnp.maximum(rbf, 1e-6), 0.0)
+            tau = deposit(tau, greedy_split_giant(rbp, inst), amount, hot)
+            return tau, bp, bf, pp, pf
+
+        if block_len == 0:
+            def tail(st, it):
+                return iteration(st, it)
+
+            st, _ = jax.lax.scan(tail, st, start_it + jnp.arange(n_blocks))
+        else:
+            def block(st, b):
+                st, _ = jax.lax.scan(
+                    iteration, st, start_it + b * block_len + jnp.arange(block_len)
+                )
+                return migrate(st), None
+
+            st, _ = jax.lax.scan(block, st, jnp.arange(n_blocks))
+        tau, bp, bf, _, _ = st
+        return tau[None], bp[None], bf[None]
+
+    return jax.jit(run)
+
+
+def solve_aco_islands(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    mesh: Mesh | None = None,
+    params=None,  # solvers.aco.ACOParams
+    island_params: IslandParams = IslandParams(),
+    weights: CostWeights | None = None,
+    deadline_s: float | None = None,
+    init_perm: jax.Array | None = None,
+    pool: int = 0,
+) -> SolveResult:
+    """ACO with per-device colonies + ring elite migration.
+
+    Every island runs an independent MMAS colony (own pheromone
+    matrix, decorrelated keys); every `migrate_every` iterations the
+    incumbents circulate the ring and better arrivals are adopted and
+    deposited (see _aco_islands_chunk_fn). With `deadline_s` the blocks
+    run under the host-clock-checked _deadline_driver. `init_perm`
+    warm-starts EVERY island's incumbent; `pool` > 0 returns the
+    per-island champions as split giants (best first, at most one per
+    island) — the multi-start polish hook.
+    """
+    import dataclasses as _dc
+
+    from vrpms_tpu.solvers.aco import ACOParams, _aco_init_fn, aco_knn_mask
+
+    params = params or ACOParams()
+    w = weights or CostWeights.make()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    mesh = mesh or make_mesh()
+    n_isl = mesh.shape["islands"]
+    block_params = _dc.replace(params, n_iters=0, knn_k=0)
+
+    warm = init_perm is not None
+    if init_perm is None:
+        init_perm = jnp.arange(1, inst.n_customers + 1, dtype=jnp.int32)
+    tau0, bp0, bf0, _, _ = _aco_init_fn(block_params, 0, warm)(inst, w, init_perm)
+    state = (
+        jnp.tile(tau0[None], (n_isl, 1, 1)),
+        jnp.tile(bp0[None], (n_isl, 1)),
+        jnp.tile(bf0[None], (n_isl,)),
+    )
+    knn_mask = aco_knn_mask(inst, params.knn_k)
+    block_len = island_params.migrate_every
+
+    def call(st, n, bl, start):
+        return _aco_islands_chunk_fn(mesh, n, bl, block_params)(
+            st, key, inst, w, knn_mask, jnp.int32(start)
+        )
+
+    if deadline_s is None:
+        n_blocks, tail = _blocked_schedule(params.n_iters, block_len)
+        if n_blocks:
+            state = call(state, n_blocks, block_len, 0)
+        if tail:
+            state = call(state, tail, 0, n_blocks * block_len)
+        done = params.n_iters
+    else:
+        from vrpms_tpu.mesh.sync import mesh_spans_processes
+
+        # ~64 colony iterations per host sync (an iteration is heavy)
+        state, done = _deadline_driver(
+            call, state, params.n_iters, block_len, 64, deadline_s,
+            multi_controller=mesh_spans_processes(mesh),
+        )
+    _, best_p, best_f = state
+    best_perm, _ = _champion(best_p, best_f)
+    giant = greedy_split_giant(best_perm, inst)
+    bd, cost = exact_cost(giant, inst, w)
+    elite = None
+    if pool > 0:
+        from vrpms_tpu.core.cost import exact_cost_batch
+
+        order = jnp.argsort(best_f)[: min(pool, best_p.shape[0])]
+        elite = jax.vmap(lambda p: greedy_split_giant(p, inst))(best_p[order])
+        # exact re-rank + champion upgrade (see solve_aco: colony
+        # fitness can disagree with the bounded-fleet objective)
+        ecosts = exact_cost_batch(elite, inst, w)
+        order2 = jnp.argsort(ecosts)
+        elite = elite[order2]
+        if float(ecosts[order2[0]]) < float(cost):
+            giant = elite[0]
+            bd, cost = exact_cost(giant, inst, w)
+    return SolveResult(
+        giant,
+        cost,
+        bd,
+        jnp.int32(n_isl * params.n_ants * done),
         elite,
     )
 

@@ -21,7 +21,7 @@ from functools import lru_cache
 import jax
 import jax.numpy as jnp
 
-from vrpms_tpu.core.cost import CostWeights, evaluate_giant, total_cost
+from vrpms_tpu.core.cost import CostWeights, exact_cost
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
 from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
@@ -121,6 +121,80 @@ def _deposit_edges(giant):
     return giant[:-1], giant[1:]
 
 
+def deposit(tau, giant, amount, hot: bool):
+    """tau + amount along the giant tour's edges (multiplicity counted).
+
+    Hot path: the scatter `tau.at[src, dst].add` lowers to a serial
+    scalar loop on TPU; the same update is the rank-L outer-product
+    accumulation  tau += amount * src_ohT @ dst_oh  — one MXU einsum.
+    One-hot counts are integers <= L (exact in bf16 for L <= 256;
+    onehot_dtype widens beyond), so both paths add exactly the same
+    multiset of edges, including repeated (0, 0) hops of unused
+    vehicles.
+    """
+    src, dst = _deposit_edges(giant)
+    if not hot:
+        return tau.at[src, dst].add(amount)
+    from vrpms_tpu.core.cost import _onehot, onehot_dtype
+
+    n = tau.shape[0]
+    dt = onehot_dtype(max(n, giant.shape[0]))
+    src_oh = _onehot(src, n, dt)
+    dst_oh = _onehot(dst, n, dt)
+    counts = jnp.einsum(
+        "kn,km->nm", src_oh, dst_oh, preferred_element_type=jnp.float32
+    )
+    return tau + amount * counts
+
+
+def _merge_pool(pool_perms, pool_fits, orders, fits):
+    """Fold an iteration's ant orders into the running top-K elite pool
+    (best first, deduplicated by fitness equality is NOT attempted —
+    distinct basins matter more than distinct costs)."""
+    all_perms = jnp.concatenate([pool_perms, orders])
+    all_fits = jnp.concatenate([pool_fits, fits])
+    order = jnp.argsort(all_fits)[: pool_fits.shape[0]]
+    return all_perms[order], all_fits[order]
+
+
+def aco_iteration(state, it, key, inst, w, params: ACOParams, knn_mask, hot: bool):
+    """One colony iteration — construct, evaluate, deposit, clip.
+
+    Exposed standalone (like sa.sa_chain_step) so the single-device
+    block fn and the island-model driver run the exact same step.
+    State: (tau, best_perm, best_fit, pool_perms, pool_fits); the pool
+    arrays may be zero-length (K=0) when no elite pool is requested.
+    """
+    fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
+    n_nodes = inst.n_nodes
+    d = inst.durations[0]
+    eta = (1.0 / jnp.maximum(d, 1e-6)) ** params.beta
+
+    tau, best_perm, best_fit, pool_perms, pool_fits = state
+    k_it = jax.random.fold_in(key, it)
+    orders = _construct_orders(
+        k_it, tau ** params.alpha, eta, params.n_ants, knn_mask=knn_mask
+    )
+    fits = fitness(orders)
+    champ = jnp.argmin(fits)
+    it_best_perm, it_best_fit = orders[champ], fits[champ]
+    better = it_best_fit < best_fit
+    best_perm = jnp.where(better, it_best_perm, best_perm)
+    best_fit = jnp.where(better, it_best_fit, best_fit)
+    if pool_perms.shape[0]:
+        pool_perms, pool_fits = _merge_pool(pool_perms, pool_fits, orders, fits)
+    # Evaporate, then deposit along the iteration-best ant's actual
+    # split route (depot hops included) scaled by quality.
+    giant = greedy_split_giant(it_best_perm, inst)
+    amount = 1.0 / jnp.maximum(it_best_fit, 1e-6)
+    tau = deposit((1.0 - params.rho) * tau, giant, amount, hot)
+    # MMAS-style trail limits keep exploration alive.
+    tau_max = 1.0 / (params.rho * jnp.maximum(best_fit, 1e-6))
+    tau_min = tau_max / (2.0 * n_nodes)
+    tau = jnp.clip(tau, tau_min, tau_max)
+    return (tau, best_perm, best_fit, pool_perms, pool_fits)
+
+
 @lru_cache(maxsize=32)
 def _aco_block_fn(params: ACOParams, n_block: int):
     """Build (and cache) one jitted block of n_block colony iterations
@@ -129,40 +203,14 @@ def _aco_block_fn(params: ACOParams, n_block: int):
     check the host clock between device-side blocks). Callers pass
     params with `n_iters` normalized to 0 — the block never reads it —
     so requests differing only in iteration budget share one compile."""
+    from vrpms_tpu.core.cost import resolve_eval_mode
 
     @jax.jit
     def run(state, key, inst, w, start_it, knn_mask):
-        n_nodes = inst.n_nodes
-        fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
-        d = inst.durations[0]
-        eta = (1.0 / jnp.maximum(d, 1e-6)) ** params.beta
-        alpha = params.alpha
-        rho = params.rho
+        hot = resolve_eval_mode("auto") != "gather"
 
-        def iteration(state, it):
-            tau, best_perm, best_fit = state
-            k_it = jax.random.fold_in(key, it)
-            orders = _construct_orders(
-                k_it, tau ** alpha, eta, params.n_ants, knn_mask=knn_mask
-            )
-            fits = fitness(orders)
-            champ = jnp.argmin(fits)
-            it_best_perm, it_best_fit = orders[champ], fits[champ]
-            better = it_best_fit < best_fit
-            best_perm = jnp.where(better, it_best_perm, best_perm)
-            best_fit = jnp.where(better, it_best_fit, best_fit)
-            # Evaporate, then deposit along the iteration-best ant's
-            # actual split route (depot hops included) scaled by quality.
-            giant = greedy_split_giant(it_best_perm, inst)
-            src, dst = _deposit_edges(giant)
-            amount = 1.0 / jnp.maximum(it_best_fit, 1e-6)
-            tau = (1.0 - rho) * tau
-            tau = tau.at[src, dst].add(amount)
-            # MMAS-style trail limits keep exploration alive.
-            tau_max = 1.0 / (rho * jnp.maximum(best_fit, 1e-6))
-            tau_min = tau_max / (2.0 * n_nodes)
-            tau = jnp.clip(tau, tau_min, tau_max)
-            return (tau, best_perm, best_fit), None
+        def iteration(st, it):
+            return aco_iteration(st, it, key, inst, w, params, knn_mask, hot), None
 
         state, _ = jax.lax.scan(
             iteration, state, start_it + jnp.arange(n_block)
@@ -172,21 +220,41 @@ def _aco_block_fn(params: ACOParams, n_block: int):
     return run
 
 
-@lru_cache(maxsize=8)
-def _aco_init_fn(params: ACOParams):
-    """Jitted colony-state init (tau0 scale + incumbent evaluation)."""
+@lru_cache(maxsize=16)
+def _aco_init_fn(params: ACOParams, pool: int, warm: bool = False):
+    """Jitted colony-state init (tau0 scale + incumbent evaluation).
+
+    `init_perm` is the starting incumbent — identity order by default,
+    or (warm=True) a warm-start seed: it is evaluated as best-so-far
+    (so the solve can never return worse than the checkpoint), and a
+    WARM seed's split route additionally receives a 2x-tau0 pheromone
+    head start, biasing early construction toward the known-good edges
+    without freezing exploration (MMAS clipping re-engages
+    immediately). Cold solves keep the classic uniform pheromone init —
+    the identity incumbent is arbitrary and must not steer
+    construction. `pool` > 0 allocates the top-K elite pool (seeded
+    with the incumbent; empty slots at +inf).
+    """
+    from vrpms_tpu.core.cost import resolve_eval_mode
 
     @jax.jit
-    def init(inst, w):
+    def init(inst, w, init_perm):
         n = inst.n_customers
         fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
         d = inst.durations[0]
+        hot = resolve_eval_mode("auto") != "gather"
         # Rough NN-scale init: tau0 = 1 / (n * mean-duration); exact
         # value is irrelevant once MMAS clipping engages.
         tau0 = 1.0 / (n * jnp.maximum(jnp.mean(d), 1e-6))
         tau = jnp.full((inst.n_nodes, inst.n_nodes), tau0)
-        best_perm = jnp.arange(1, n + 1, dtype=jnp.int32)
-        return tau, best_perm, fitness(best_perm[None])[0]
+        if warm:
+            tau = deposit(
+                tau, greedy_split_giant(init_perm, inst), 2.0 * tau0, hot
+            )
+        fit0 = fitness(init_perm[None])[0]
+        pool_perms = jnp.tile(init_perm[None], (pool, 1))
+        pool_fits = jnp.full((pool,), jnp.inf).at[:1].set(fit0)
+        return tau, init_perm, fit0, pool_perms, pool_fits
 
     return init
 
@@ -197,10 +265,19 @@ def solve_aco(
     params: ACOParams = ACOParams(),
     weights: CostWeights | None = None,
     deadline_s: float | None = None,
+    init_perm: jax.Array | None = None,
+    pool: int = 0,
 ) -> SolveResult:
     """MMAS colony search; with `deadline_s` the colony runs in fixed
     16-iteration device blocks under common.run_blocked's granularity
-    contract."""
+    contract.
+
+    `init_perm` warm-starts the colony (incumbent + pheromone head
+    start, see _aco_init_fn) — the solve never returns worse than the
+    seed. `pool` > 0 additionally returns the top-`pool` ant orders
+    seen across all iterations as split giants (SolveResult.pool, best
+    first) — the multi-start polish hook every other solver exposes.
+    """
     from vrpms_tpu.solvers.common import run_blocked
 
     w = weights or CostWeights.make()
@@ -210,17 +287,11 @@ def solve_aco(
     # normalize everything the traced block never reads out of the
     # compile key (knn_k only shapes the dynamic knn_mask argument)
     block_params = dataclasses.replace(params, n_iters=0, knn_k=0)
-    state = _aco_init_fn(block_params)(inst, w)
-    knn_mask = None
-    if params.knn_k > 0:
-        import numpy as np
-
-        from vrpms_tpu.moves import knn_table
-
-        tbl = np.asarray(knn_table(inst.durations[0], params.knn_k))
-        mask = np.zeros((inst.n_nodes, inst.n_nodes), dtype=bool)
-        mask[np.arange(inst.n_nodes)[:, None], tbl] = True
-        knn_mask = jnp.asarray(mask)
+    warm = init_perm is not None
+    if init_perm is None:
+        init_perm = jnp.arange(1, inst.n_customers + 1, dtype=jnp.int32)
+    state = _aco_init_fn(block_params, pool, warm)(inst, w, init_perm)
+    knn_mask = aco_knn_mask(inst, params.knn_k)
 
     def step_block(st, nb, start):
         return _aco_block_fn(block_params, nb)(
@@ -231,12 +302,43 @@ def solve_aco(
         step_block, state, params.n_iters, 16, deadline_s, lambda st: st[2]
     )
 
-    best_perm = state[1]
+    _, best_perm, _, pool_perms, pool_fits = state
     giant = greedy_split_giant(best_perm, inst)
-    bd = evaluate_giant(giant, inst)
+    bd, cost = exact_cost(giant, inst, w)
+    elite = None
+    if pool > 0:
+        from vrpms_tpu.core.cost import exact_cost_batch
+
+        elite = jax.vmap(lambda p: greedy_split_giant(p, inst))(pool_perms)
+        # The colony ranks by its fitness (unbounded split + per-route
+        # fleet penalty), which can disagree with the true bounded-fleet
+        # objective; re-rank the small pool EXACTLY and let an exactly-
+        # better elite displace the fitness champion — the caller must
+        # never see a champion that exact-prices worse than its pool.
+        ecosts = exact_cost_batch(elite, inst, w)
+        order = jnp.argsort(ecosts)
+        elite = elite[order]
+        if float(ecosts[order[0]]) < float(cost):
+            giant = elite[0]
+            bd, cost = exact_cost(giant, inst, w)
     return SolveResult(
         giant,
-        total_cost(bd, w),
+        cost,
         bd,
         jnp.int32(params.n_ants * done),
+        elite,
     )
+
+
+def aco_knn_mask(inst: Instance, knn_k: int):
+    """[N, N] candidate-list mask for construction (None when off)."""
+    if knn_k <= 0:
+        return None
+    import numpy as np
+
+    from vrpms_tpu.moves import knn_table
+
+    tbl = np.asarray(knn_table(inst.durations[0], knn_k))
+    mask = np.zeros((inst.n_nodes, inst.n_nodes), dtype=bool)
+    mask[np.arange(inst.n_nodes)[:, None], tbl] = True
+    return jnp.asarray(mask)

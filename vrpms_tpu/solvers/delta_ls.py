@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from vrpms_tpu.core.cost import (
     CostWeights,
     evaluate_giant,
+    exact_cost,
     objective_batch_mode,
     onehot_dtype,
     resolve_eval_mode,
@@ -644,5 +645,5 @@ def delta_polish(
         giant[None], inst, w, mode=mode, max_sweeps=max_sweeps, top_k=top_k
     )
     g = giants[0]
-    bd = evaluate_giant(g, inst)
-    return SolveResult(g, total_cost(bd, w), bd, jnp.int32(evals))
+    bd, cost = exact_cost(g, inst, w)
+    return SolveResult(g, cost, bd, jnp.int32(evals))

@@ -34,10 +34,9 @@ import jax.numpy as jnp
 from vrpms_tpu.core.cost import (
     CostWeights,
     _onehot,
-    evaluate_giant,
+    exact_cost,
     onehot_dtype,
     resolve_eval_mode,
-    total_cost,
 )
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
@@ -84,9 +83,41 @@ def initial_perms(
         return _random_perms(key, pop, inst.n_customers)
     if params.init != "nn":
         raise ValueError(f"GAParams.init must be 'nn' or 'random', got {params.init!r}")
+
+    return perturbed_perm_clones(key, pop, _nn_perm_fn()(inst), mode)
+
+
+@lru_cache(maxsize=8)
+def _nn_perm_fn():
+    """Jitted NN construction (one device program — see sa._nn_seed_fn;
+    eager dispatch latency through a tunneled TPU is the cold-solve
+    bottleneck, not compute)."""
     from vrpms_tpu.solvers.local_search import nearest_neighbor_perm
 
-    return perturbed_perm_clones(key, pop, nearest_neighbor_perm(inst), mode)
+    return jax.jit(nearest_neighbor_perm)
+
+
+@lru_cache(maxsize=32)
+def _perturb_perms_fn(pop: int, mode: str, n_moves: int):
+    """Jitted clone-and-decorrelate for permutations (the GA twin of
+    sa._perturb_fn, cached per shape/mode for the same dispatch-latency
+    reason)."""
+
+    @jax.jit
+    def fn(key, perm):
+        n = perm.shape[0]
+        perms = jnp.tile(perm[None], (pop, 1))
+        for _ in range(n_moves):
+            key, k_pos, k_type = jax.random.split(key, 3)
+            ij = jax.random.randint(k_pos, (pop, 2), 0, n)
+            lo = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
+            hi = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
+            mt = jax.random.randint(k_type, (pop, 1), 0, 2)
+            src = _segment_src_map(lo, hi, mt, jnp.ones_like(mt), n)
+            perms = apply_src_map(perms, src, mode=mode)
+        return perms.at[0].set(perm)
+
+    return fn
 
 
 def perturbed_perm_clones(
@@ -96,17 +127,7 @@ def perturbed_perm_clones(
     segment moves — the population recipe for any constructive or warm
     seed (the GA twin of sa.perturbed_clones). Slot 0 stays EXACTLY the
     seed so best tracking can never return worse than the seed."""
-    n = perm.shape[0]
-    perms = jnp.tile(perm[None], (pop, 1))
-    for _ in range(n_moves):
-        key, k_pos, k_type = jax.random.split(key, 3)
-        ij = jax.random.randint(k_pos, (pop, 2), 0, n)
-        lo = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
-        hi = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
-        mt = jax.random.randint(k_type, (pop, 1), 0, 2)
-        src = _segment_src_map(lo, hi, mt, jnp.ones_like(mt), n)
-        perms = apply_src_map(perms, src, mode=mode)
-    return perms.at[0].set(perm)
+    return _perturb_perms_fn(pop, mode, n_moves)(key, perm)
 
 
 def order_crossover(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Array:
@@ -374,7 +395,7 @@ def solve_ga(
 
     perms, fits, best_perm, _ = state
     giant = greedy_split_giant(best_perm, inst)
-    bd = evaluate_giant(giant, inst)
+    bd, cost = exact_cost(giant, inst, w)
     elite = None
     if pool > 0:
         # Elitism keeps the champion genome in the final population, so
@@ -396,7 +417,7 @@ def solve_ga(
         )
     return SolveResult(
         giant,
-        total_cost(bd, w),
+        cost,
         bd,
         # evals from the actual population (init_perms may differ)
         jnp.int32(perms0.shape[0] * done),

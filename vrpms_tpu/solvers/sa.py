@@ -24,10 +24,9 @@ import jax.numpy as jnp
 
 from vrpms_tpu.core.cost import (
     CostWeights,
-    evaluate_giant,
+    exact_cost,
     objective_batch_mode,
     resolve_eval_mode,
-    total_cost,
 )
 from vrpms_tpu.core.encoding import random_giant_batch
 from vrpms_tpu.core.instance import Instance
@@ -45,8 +44,8 @@ class SAParams:
     init: str = "nn"  # "nn": perturbed nearest-neighbor seeds; "random"
 
 
-def _auto_temps(inst: Instance, params: SAParams) -> tuple[float, float]:
-    """Geometric schedule endpoints scaled from the mean duration.
+def _temps_from_scale(scale: float, params: SAParams) -> tuple[float, float]:
+    """Geometric schedule endpoints from the mean-duration scale.
 
     The start temperature depends on the initialization: random starts
     need a hot anneal (0.8x scale) to unscramble, but good constructive
@@ -55,11 +54,38 @@ def _auto_temps(inst: Instance, params: SAParams) -> tuple[float, float]:
     reaches 15.7% lower cost than random 0.8x, while nn-seeded at the
     hot temperature loses most of the seed's head start.
     """
-    scale = float(jnp.mean(inst.durations[0]))
     hot = 0.8 if params.init == "random" else 0.05
     t0 = params.t_initial if params.t_initial is not None else hot * scale
     t1 = params.t_final if params.t_final is not None else max(1e-3, 0.002 * scale)
     return float(t0), float(t1)
+
+
+def _auto_temps(inst: Instance, params: SAParams) -> tuple[float, float]:
+    """Schedule endpoints from the instance (one jitted mean dispatch)."""
+    return _temps_from_scale(float(_mean_fn()(inst.durations[0])), params)
+
+
+@lru_cache(maxsize=1)
+def _mean_fn():
+    """Jitted matrix mean (one cacheable dispatch; the eager reduction
+    costs a multi-second compile round trip per process on a tunneled
+    TPU — see _perturb_fn)."""
+    return jax.jit(jnp.mean)
+
+
+@lru_cache(maxsize=8)
+def _nn_seed_fn():
+    """Jitted NN-construct + greedy split (ONE device program — the
+    eager composition was ~50 dispatches, which through a tunneled TPU
+    dominated cold-solve latency; see perturbed_clones)."""
+    from vrpms_tpu.core.split import greedy_split_giant
+    from vrpms_tpu.solvers.local_search import nearest_neighbor_perm
+
+    @jax.jit
+    def fn(inst):
+        return greedy_split_giant(nearest_neighbor_perm(inst), inst)
+
+    return fn
 
 
 def initial_giants(
@@ -77,11 +103,28 @@ def initial_giants(
         return random_giant_batch(key, batch, inst.n_customers, inst.n_vehicles)
     if params.init != "nn":
         raise ValueError(f"SAParams.init must be 'nn' or 'random', got {params.init!r}")
-    from vrpms_tpu.core.split import greedy_split_giant
-    from vrpms_tpu.solvers.local_search import nearest_neighbor_perm
-
-    seed = greedy_split_giant(nearest_neighbor_perm(inst), inst)
+    seed = _nn_seed_fn()(inst)
     return perturbed_clones(key, batch, seed, mode)
+
+
+@lru_cache(maxsize=32)
+def _perturb_fn(batch: int, mode: str, n_moves: int):
+    """Jitted clone-and-decorrelate (cached per shape/mode like the
+    anneal blocks). Eagerly, the n_moves sequential random_move_batch
+    calls issue dozens of small device programs; on a tunneled TPU that
+    cost ~45 s of pure dispatch latency per cold solve (measured on the
+    X-n200 shape) — as ONE jitted program it is milliseconds warm and
+    one persistent-cacheable compile cold."""
+
+    @jax.jit
+    def fn(key, giant):
+        giants = jnp.tile(giant[None], (batch, 1))
+        for _ in range(n_moves):
+            key, k = jax.random.split(key)
+            giants = random_move_batch(k, giants, mode=mode)
+        return giants.at[0].set(giant)
+
+    return fn
 
 
 def perturbed_clones(
@@ -95,11 +138,7 @@ def perturbed_clones(
     checkpoint). Callers pairing this with solve_sa should keep the
     default (cool) schedule: seeded starts are refined, not unscrambled.
     """
-    giants = jnp.tile(giant[None], (batch, 1))
-    for _ in range(n_moves):
-        key, k = jax.random.split(key)
-        giants = random_move_batch(k, giants, mode=mode)
-    return giants.at[0].set(giant)
+    return _perturb_fn(batch, mode, n_moves)(key, giant)
 
 
 def sa_chain_step(
@@ -198,6 +237,37 @@ def _sa_init_fn(mode: str):
     return init
 
 
+@lru_cache(maxsize=32)
+def _sa_prep_fn(batch: int, mode: str, n_moves: int = 8):
+    """Fused cold-start prep: NN seed + clone/decorrelate + initial
+    evaluation + the temperature scale, as ONE jitted program.
+
+    A fresh process otherwise pays a separate program load + dispatch
+    round trip for each of those four steps (~0.5 s apiece through a
+    tunneled TPU) before the first anneal block can launch; fusing them
+    puts the whole cold path one dispatch from the anneal — the
+    north-star response budget is wall-clock INCLUDING this.
+    """
+
+    @jax.jit
+    def prep(key, inst, w):
+        # inline (not via the cached single-purpose fns) so everything
+        # traces into one program
+        from vrpms_tpu.core.split import greedy_split_giant
+        from vrpms_tpu.solvers.local_search import nearest_neighbor_perm
+
+        seed = greedy_split_giant(nearest_neighbor_perm(inst), inst)
+        giants = jnp.tile(seed[None], (batch, 1))
+        for _ in range(n_moves):
+            key, k = jax.random.split(key)
+            giants = random_move_batch(k, giants, mode=mode)
+        giants = giants.at[0].set(seed)
+        costs = objective_batch_mode(giants, inst, w, mode)
+        return giants, costs, jnp.mean(inst.durations[0])
+
+    return prep
+
+
 def solve_sa(
     inst: Instance,
     key: jax.Array | int = 0,
@@ -207,8 +277,13 @@ def solve_sa(
     mode: str = "auto",
     deadline_s: float | None = None,
     pool: int = 0,
+    knn: jax.Array | None = None,
 ) -> SolveResult:
     """Batched-chain SA; returns the best solution over all chains.
+
+    `knn` optionally passes a precomputed candidate table (knn_table) —
+    repeat callers (the ILS round loop) avoid re-transferring the
+    durations matrix to host every round.
 
     `pool` > 0 additionally returns the top-`pool` per-chain bests
     (SolveResult.pool, best first) — distinct chains sit in distinct
@@ -227,20 +302,27 @@ def solve_sa(
     mode = resolve_eval_mode(mode)
     if isinstance(key, int):
         key = jax.random.key(key)
-    t0, t1 = _auto_temps(inst, params)
     k_init, k_run = jax.random.split(key)
-    if init_giants is None:
-        giants = initial_giants(k_init, params.n_chains, inst, params, mode)
+    if init_giants is None and params.init == "nn":
+        # fused cold path: seed + clones + eval + temp scale in ONE
+        # dispatch (see _sa_prep_fn)
+        giants, costs, mean = _sa_prep_fn(params.n_chains, mode)(k_init, inst, w)
+        t0, t1 = _temps_from_scale(float(mean), params)
     else:
-        giants = init_giants
+        t0, t1 = _auto_temps(inst, params)
+        if init_giants is None:
+            giants = initial_giants(k_init, params.n_chains, inst, params, mode)
+        else:
+            giants = init_giants
+        costs = _sa_init_fn(mode)(giants, inst, w)
     n_iters = params.n_iters
 
-    # solve_sa requires a concrete instance (_auto_temps above already
-    # forced durations to a value), so the table can always be built.
-    knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
+    # solve_sa requires a concrete instance (the temp scale above
+    # already forced durations to a value), so the table can be built.
+    if knn is None:
+        knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
     horizon = jnp.float32(n_iters)
-    costs = _sa_init_fn(mode)(giants, inst, w)
     state = (giants, costs, giants, costs)
 
     def step_block(st, nb, start):
@@ -255,12 +337,12 @@ def solve_sa(
     _, _, best_g, best_c = state
     champ = jnp.argmin(best_c)
     g = best_g[champ]
-    bd = evaluate_giant(g, inst)
+    bd, cost = exact_cost(g, inst, w)
     elite = None
     if pool > 0:
         order = jnp.argsort(best_c)[: min(pool, best_g.shape[0])]
         elite = best_g[order]
     # evals from the actual batch (init_giants may differ from n_chains)
     return SolveResult(
-        g, total_cost(bd, w), bd, jnp.int32(giants.shape[0] * done), elite
+        g, cost, bd, jnp.int32(giants.shape[0] * done), elite
     )

@@ -19,6 +19,48 @@ def current_date() -> str:
     return datetime.now().strftime("%d-%m-%Y")
 
 
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Turn on JAX's persistent (disk) compilation cache, best-effort.
+
+    The north-star budget is quality-per-wall-clock INCLUDING what a
+    fresh process pays before its first sweep (BASELINE.md config 3:
+    <10 s on one chip). XLA compiles of the solver blocks cost ~30 s per
+    shape on TPU; with this cache a restarted service/benchmark loads
+    them from disk in well under a second each, so the 10 s budget goes
+    to search, not recompilation.
+
+    Path: explicit arg > $VRPMS_COMPILE_CACHE > ~/.cache/vrpms_tpu/xla.
+    Set VRPMS_COMPILE_CACHE=off to disable. Returns the directory in
+    effect, or None when disabled/unavailable. Safe to call repeatedly
+    and before/after other jax.config updates; never raises (a broken
+    cache dir must not take down a solve — caching is an optimization).
+    """
+    if path is None:
+        path = os.environ.get("VRPMS_COMPILE_CACHE")
+        if path is not None and str(path).lower() in ("off", "0", "none", ""):
+            return None  # explicitly disabled (incl. VRPMS_COMPILE_CACHE=)
+        path = path or os.path.join(
+            os.path.expanduser("~"), ".cache", "vrpms_tpu", "xla"
+        )
+    elif str(path).lower() in ("off", "0", "none", ""):
+        return None
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        # Cache EVERYTHING, even sub-second entries: through the
+        # tunneled TPU plugin each tiny eager op (convert_element_type,
+        # scatter, ...) costs ~0.6 s to compile, and a cold solve issues
+        # dozens of them — measured ~25-35 s of a fresh process's wall
+        # clock. The 1 s default threshold would skip exactly those.
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return str(path)
+    except Exception:
+        return None
+
+
 def load_dotenv(path: str = ".env") -> bool:
     """Minimal python-dotenv equivalent (the reference pins the package
     only for this one call, reference requirements.txt + src/__init__.py:1-2).
