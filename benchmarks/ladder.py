@@ -103,11 +103,11 @@ def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
     )
     elapsed = time.perf_counter() - t0
     extra = {}
+    feasible = (
+        float(res.breakdown.cap_excess) == 0.0
+        and float(res.breakdown.tw_lateness) == 0.0
+    )
     if bks:
-        feasible = (
-            float(res.breakdown.cap_excess) == 0.0
-            and float(res.breakdown.tw_lateness) == 0.0
-        )
         if feasible:
             # Caveat: BKS distances assume the literature vehicle count;
             # loaders may provision a larger fleet, so treat small gaps
@@ -117,6 +117,10 @@ def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
             )
         else:
             extra["gap_percent"] = None  # infeasible: not comparable to BKS
+    if feasible:
+        extra["certified_gap_ub_percent"] = _certified_gap(
+            float(res.breakdown.distance), inst
+        )
     return _result(
         config,
         name,
@@ -127,6 +131,17 @@ def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
         evals_per_sec=round(int(res.evals) / elapsed, 1),
         **extra,
     )
+
+
+def _certified_gap(distance: float, inst):
+    """BKS-free optimality certificate: true gap <= this (polynomial
+    lower bounds, vrpms_tpu.io.bounds; validated against BF oracles).
+    For time-windowed instances the certificate covers the DISTANCE
+    component only."""
+    from vrpms_tpu.io.bounds import certified_gap_percent
+
+    gap = certified_gap_percent(distance, inst)
+    return round(gap, 2) if gap is not None else None
 
 
 def config3_budget(seconds, vrp_path=None, seed=0, chains=4096, rounds=None,
@@ -183,6 +198,10 @@ def config3_budget(seconds, vrp_path=None, seed=0, chains=4096, rounds=None,
     if bks and float(res2.breakdown.cap_excess) == 0.0:
         extra["steady_gap_percent"] = round(
             gap_percent(float(res2.breakdown.distance), bks), 2
+        )
+    if float(res2.breakdown.cap_excess) == 0.0:
+        extra["certified_gap_ub_percent"] = _certified_gap(
+            float(res2.breakdown.distance), inst
         )
     return _result(
         3,

@@ -72,6 +72,7 @@ _SLOW_PATTERNS = (
     "test_bf_local_search.py::TestBruteForce::test_deadline_zero_truncates_but_returns_valid",
     "test_bf_local_search.py::TestLocalSearch",
     # end-to-end HTTP solves (the envelope/contract tests stay quick)
+    "test_concurrency.py",
     "test_service.py::TestVRPSolve",
     "test_service.py::TestTSPSolve",
     "test_service.py::TestTimedPaths",

@@ -66,14 +66,21 @@ def naive_eval(giant, inst):
 
 
 def naive_greedy_split(perm, inst):
-    """Greedy capacity split of a customer order; returns (cost, n_routes)."""
+    """Greedy capacity split of a customer order; returns (cost, n_routes).
+
+    Per-vehicle capacities in vehicle-index order (routes past the
+    fleet bound reuse the last vehicle's) — the oracle twin of
+    core.split._greedy_fresh.
+    """
     d = np.asarray(inst.durations)[0]
     demands = np.asarray(inst.demands)
-    q = float(np.asarray(inst.capacities)[0])
+    caps = np.asarray(inst.capacities, dtype=float)
+    v = len(caps)
     routes = [[]]
     load = 0.0
     for c in np.asarray(perm):
         c = int(c)
+        q = caps[min(len(routes) - 1, v - 1)]
         if load + demands[c] > q and routes[-1]:
             routes.append([])
             load = 0.0

@@ -1,0 +1,107 @@
+"""Lower-bound certificates validated against the exact oracles.
+
+The whole point of vrpms_tpu.io.bounds is trust: LB <= OPT must hold
+ALWAYS (else 'certified' gaps are lies). These tests pin every bound
+against brute force on small instances — symmetric, asymmetric,
+heterogeneous-fleet, TSP — and sanity-check usefulness (non-vacuous,
+1-tree near-tight on Euclidean TSP).
+"""
+
+import numpy as np
+import pytest
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.io.bounds import (
+    assignment_lb,
+    certified_gap_percent,
+    cmt_qroute_lb,
+    cvrp_forest_lb,
+    held_karp_1tree_lb,
+    lower_bound,
+    mst_lb,
+    qroute_lb,
+    route_count_lb,
+)
+from vrpms_tpu.solvers import solve_tsp_bf, solve_vrp_bf
+
+
+def euclid(rng, n):
+    pts = rng.uniform(0, 100, size=(n, 2))
+    return np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+
+
+class TestValidity:
+    def test_lb_never_exceeds_cvrp_optimum(self, rng):
+        for seed in range(4):
+            r = np.random.default_rng(seed)
+            n = 7
+            d = euclid(r, n)
+            demands = [0] + [int(x) for x in r.integers(1, 4, n - 1)]
+            inst = make_instance(d, demands=demands, capacities=[9.0, 7.0, 5.0])
+            opt = float(solve_vrp_bf(inst).cost)
+            lb = lower_bound(inst)
+            tol = opt * (1 + 1e-5) + 1e-4  # f32 kernel vs f64 bound
+            assert 0 < lb <= tol, (seed, lb, opt)
+            assert assignment_lb(inst) <= tol
+            assert mst_lb(inst) <= tol
+            assert cvrp_forest_lb(inst) <= tol
+            assert qroute_lb(inst) <= tol
+            assert cmt_qroute_lb(inst, iters=5) <= tol
+            # the Lagrangian forest bound is the workhorse: near-tight
+            # on small Euclidean CVRPs
+            assert cvrp_forest_lb(inst) >= 0.75 * opt
+
+    def test_lb_valid_on_asymmetric(self, rng):
+        for seed in range(3):
+            r = np.random.default_rng(10 + seed)
+            n = 7
+            d = r.uniform(5, 60, size=(n, n))
+            np.fill_diagonal(d, 0)
+            demands = [0] + [1] * (n - 1)
+            inst = make_instance(d, demands=demands, capacities=[3.0, 3.0, 3.0])
+            opt = float(solve_vrp_bf(inst).cost)
+            lb = lower_bound(inst)
+            assert 0 < lb <= opt * (1 + 1e-5) + 1e-4
+            # symmetric-only bounds must return vacuous, not wrong
+            assert mst_lb(inst) == 0.0
+            assert held_karp_1tree_lb(inst) == 0.0
+
+    def test_one_tree_bounds_tsp_and_is_tight_on_euclidean(self, rng):
+        for seed in range(3):
+            r = np.random.default_rng(20 + seed)
+            n = 8
+            inst = make_instance(euclid(r, n), n_vehicles=1)
+            opt = float(solve_tsp_bf(inst).cost)
+            lb = held_karp_1tree_lb(inst)
+            # f32 cost kernel vs f64 bound: allow kernel-rounding slack
+            assert lb <= opt * (1 + 1e-5) + 1e-4
+            # Held-Karp is known-strong on Euclidean instances
+            assert lb >= 0.85 * opt, (seed, lb, opt)
+            assert lower_bound(inst) <= opt * (1 + 1e-5) + 1e-4
+
+    def test_certified_gap_is_conservative(self, rng):
+        r = np.random.default_rng(30)
+        n = 7
+        d = euclid(r, n)
+        demands = [0] + [1] * (n - 1)
+        inst = make_instance(d, demands=demands, capacities=[3.0, 3.0, 3.0])
+        res = solve_vrp_bf(inst)
+        gap = certified_gap_percent(float(res.cost), inst)
+        # the optimum's true gap is 0; the certificate may only
+        # overestimate (up to f32 kernel rounding), never go negative
+        assert gap is not None and gap >= -1e-3
+
+
+class TestRouteCount:
+    def test_binpacking_lb(self):
+        d = np.ones((5, 5))
+        np.fill_diagonal(d, 0)
+        inst = make_instance(
+            d, demands=[0, 3, 3, 3, 3], capacities=[5.0, 5.0, 5.0, 5.0]
+        )
+        # 12 demand over caps 5+5+5: needs at least 3 vehicles
+        assert route_count_lb(inst) == 3
+        inst2 = make_instance(
+            d, demands=[0, 3, 3, 3, 3], capacities=[12.0, 5.0, 1.0, 1.0]
+        )
+        assert route_count_lb(inst2) == 1

@@ -1,0 +1,168 @@
+"""Heterogeneous-fleet correctness across GA/ACO/BF (VERDICT round-1 #4).
+
+The reference parses per-vehicle `capacities` (reference
+api/parameters.py:11); SA's giant-tour path always priced them exactly
+(routes bind to vehicles positionally), but the permutation-genome
+solvers' split shortcuts assumed capacities[0]. These tests pin the
+het-aware behavior: per-vehicle greedy split, per-round optimal-split
+DP, vehicle-aligned route reconstruction, exact-giant fitness dispatch
+(Instance.het_fleet), and the end-to-end service contract.
+"""
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core.cost import CostWeights, exact_cost
+from vrpms_tpu.core.encoding import is_valid_giant, routes_from_giant
+from vrpms_tpu.core.split import (
+    greedy_split_giant,
+    optimal_split_cost,
+    optimal_split_routes,
+)
+from vrpms_tpu.solvers import solve_vrp_bf
+from vrpms_tpu.solvers.aco import ACOParams, solve_aco
+from vrpms_tpu.solvers.ga import GAParams, solve_ga
+from vrpms_tpu.solvers.sa import SAParams, solve_sa
+
+
+def het_instance(rng, n=8, caps=(9.0, 5.0, 3.0)):
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    demands = [0.0] + [float(x) for x in rng.integers(1, 4, n - 1)]
+    return make_instance(d, demands=demands, capacities=list(caps))
+
+
+def python_het_split_optimum(perm, d, demands, caps):
+    """Exact DP oracle: serve perm's prefix with vehicles 0..r in order
+    (any vehicle may stay empty), per-vehicle capacity bounds."""
+    n = len(perm)
+
+    def route_cost(i, j):  # serve perm[i:j] as one route
+        path = [0] + list(perm[i:j]) + [0]
+        return sum(d[a, b] for a, b in zip(path[:-1], path[1:]))
+
+    INF = float("inf")
+    vals = [0.0] + [INF] * n  # vals[j]: best cost serving perm[:j]
+    for cap in caps:
+        nxt = list(vals)
+        for j in range(1, n + 1):
+            for i in range(j):
+                load = sum(demands[c] for c in perm[i:j])
+                if load <= cap and vals[i] + route_cost(i, j) < nxt[j]:
+                    nxt[j] = vals[i] + route_cost(i, j)
+        vals = nxt
+    return vals[n]
+
+
+class TestHetSplit:
+    def test_greedy_split_uses_per_vehicle_capacities(self, rng):
+        inst = het_instance(rng, n=9, caps=(8.0, 4.0, 2.0, 2.0))
+        caps = np.asarray(inst.capacities)
+        demands = np.asarray(inst.demands)
+        for seed in range(5):
+            perm = jnp.asarray(
+                np.random.default_rng(seed).permutation(np.arange(1, 9)),
+                jnp.int32,
+            )
+            giant = greedy_split_giant(perm, inst)
+            assert is_valid_giant(np.asarray(giant), 8, 4)
+            # python twin of the per-vehicle greedy rule
+            loads = [0.0] * len(caps)
+            r = 0
+            expected_routes = [[] for _ in caps]
+            for k, c in enumerate(np.asarray(perm)):
+                dk = float(demands[c])
+                q = caps[min(r, len(caps) - 1)]
+                if k > 0 and loads[min(r, len(caps) - 1)] + dk > q:
+                    r = min(r + 1, len(caps) - 1)
+                    loads[r] = dk
+                else:
+                    loads[min(r, len(caps) - 1)] += dk
+                expected_routes[min(r, len(caps) - 1)].append(int(c))
+            assert routes_from_giant(giant) == expected_routes
+
+    def test_optimal_split_matches_python_dp(self, rng):
+        inst = het_instance(rng, n=8, caps=(7.0, 5.0, 3.0))
+        d = np.asarray(inst.durations[0])
+        demands = np.asarray(inst.demands)
+        for seed in range(6):
+            perm = np.random.default_rng(100 + seed).permutation(
+                np.arange(1, 8)
+            )
+            want = python_het_split_optimum(
+                list(perm), d, demands, np.asarray(inst.capacities)
+            )
+            got = float(optimal_split_cost(jnp.asarray(perm, jnp.int32), inst))
+            if want == float("inf"):
+                assert got >= 1e8
+                continue
+            np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_reconstruction_vehicle_aligned(self, rng):
+        # spans must land on the vehicle whose capacity bound the DP
+        # applied — positional giant pricing must see zero excess
+        inst = het_instance(rng, n=8, caps=(7.0, 5.0, 3.0))
+        demands = np.asarray(inst.demands)
+        caps = np.asarray(inst.capacities)
+        for seed in range(6):
+            perm = np.random.default_rng(200 + seed).permutation(
+                np.arange(1, 8)
+            )
+            cost = float(optimal_split_cost(jnp.asarray(perm, jnp.int32), inst))
+            if cost >= 1e8:
+                continue
+            routes = optimal_split_routes(jnp.asarray(perm, jnp.int32), inst)
+            assert len(routes) == len(caps)  # vehicle-aligned, empties kept
+            for r, route in enumerate(routes):
+                assert sum(demands[c] for c in route) <= caps[r] + 1e-6
+
+
+class TestHetBF:
+    def test_bf_matches_itertools_het(self, rng):
+        # caps comfortably cover the worst-case total demand (6 x 3)
+        inst = het_instance(rng, n=7, caps=(9.0, 7.0, 5.0))
+        d = np.asarray(inst.durations[0])
+        demands = np.asarray(inst.demands)
+        caps = np.asarray(inst.capacities)
+        best = float("inf")
+        for perm in itertools.permutations(range(1, 7)):
+            best = min(
+                best, python_het_split_optimum(list(perm), d, demands, caps)
+            )
+        res = solve_vrp_bf(inst)
+        np.testing.assert_allclose(float(res.cost), best, rtol=1e-5)
+        assert float(res.breakdown.cap_excess) == 0.0
+        # the decoded giant's positional loads respect each vehicle
+        for r, route in enumerate(routes_from_giant(res.giant)):
+            assert sum(demands[c] for c in route) <= caps[r] + 1e-6
+
+
+class TestHetMetaheuristics:
+    @pytest.mark.parametrize("solver", ["ga", "aco", "sa"])
+    def test_feasible_per_vehicle_and_never_mispriced(self, rng, solver):
+        inst = het_instance(rng, n=9, caps=(10.0, 6.0, 4.0))
+        assert inst.het_fleet
+        w = CostWeights.make()
+        if solver == "ga":
+            res = solve_ga(inst, key=0, params=GAParams(population=64, generations=60))
+        elif solver == "aco":
+            res = solve_aco(inst, key=0, params=ACOParams(n_ants=32, n_iters=60))
+        else:
+            res = solve_sa(inst, key=0, params=SAParams(n_chains=64, n_iters=2000))
+        # the reported cost is the EXACT positional pricing of the giant
+        np.testing.assert_allclose(
+            float(res.cost), float(exact_cost(res.giant, inst, w)[1]), rtol=1e-6
+        )
+        # an easy instance (total demand 8..24 vs fleet 20) must come
+        # back per-vehicle feasible — mispricing against capacities[0]
+        # would show up as hidden excess here
+        assert float(res.breakdown.cap_excess) == 0.0
+        demands = np.asarray(inst.demands)
+        caps = np.asarray(inst.capacities)
+        for r, route in enumerate(routes_from_giant(res.giant)):
+            assert sum(demands[c] for c in route) <= caps[r] + 1e-6

@@ -96,27 +96,34 @@ class TestSplit:
                 assert float(opt) <= float(greedy) + 1e-4
 
     def test_optimal_matches_enumeration(self, rng):
-        # Exhaustively enumerate all split-point subsets on small orders.
+        # Exhaustively enumerate split-point subsets AND order-preserving
+        # assignments of the resulting routes to vehicles (random_instance
+        # fleets are heterogeneous; a route is only feasible on a vehicle
+        # whose own capacity covers it, and vehicles may sit empty).
         import itertools
 
         for trial in range(5):
             n = 7
-            inst = random_instance(rng, n=n, v=3)
+            v = 3
+            inst = random_instance(rng, n=n, v=v)
             perm = list(rng.permutation(np.arange(1, n)))
-            q = float(np.asarray(inst.capacities)[0])
+            caps = np.asarray(inst.capacities, dtype=float)
             demands = np.asarray(inst.demands)
             best = np.inf
-            for n_cuts in range(0, 3):  # up to 3 routes
+            for n_cuts in range(0, v):  # up to v routes
                 for cuts in itertools.combinations(range(1, n - 1), n_cuts):
                     bounds = [0, *cuts, n - 1]
                     routes = [
                         perm[a:b] for a, b in zip(bounds[:-1], bounds[1:])
                     ]
-                    if any(
-                        sum(demands[c] for c in r) > q for r in routes
-                    ):
-                        continue
-                    best = min(best, route_list_cost(routes, inst))
+                    loads = [sum(demands[c] for c in r) for r in routes]
+                    for slots in itertools.combinations(range(v), len(routes)):
+                        if any(
+                            load > caps[s] for load, s in zip(loads, slots)
+                        ):
+                            continue
+                        best = min(best, route_list_cost(routes, inst))
+                        break  # any feasible assignment prices the same
             got = float(
                 optimal_split_cost(jnp.asarray(perm, dtype=jnp.int32), inst)
             )

@@ -38,7 +38,7 @@ BIG = 1e9
         "service",
         "start_times",
     ],
-    meta_fields=["has_tw", "slice_minutes"],
+    meta_fields=["has_tw", "slice_minutes", "het_fleet"],
 )
 @dataclasses.dataclass(frozen=True)
 class Instance:
@@ -55,6 +55,9 @@ class Instance:
     start_times:  f32[V] vehicle shift start times.
     has_tw:       static bool — whether the TW propagation path is traced.
     slice_minutes:static float — wall-minutes per time-of-day slice.
+    het_fleet:    static bool — capacities are non-uniform; split-based
+                  fitness shortcuts (which assume one capacity) must
+                  give way to exact per-vehicle giant-tour pricing.
     """
 
     durations: jax.Array
@@ -66,6 +69,7 @@ class Instance:
     start_times: jax.Array
     has_tw: bool
     slice_minutes: float
+    het_fleet: bool = False
 
     @property
     def n_nodes(self) -> int:
@@ -224,4 +228,5 @@ def make_instance(
         start_times=jnp.asarray(start_times),
         has_tw=bool(has_tw),
         slice_minutes=float(slice_minutes),
+        het_fleet=bool(np.unique(capacities).size > 1),
     )

@@ -13,9 +13,13 @@ step. Two TPU-friendly variants:
     a dense [n+1, n+1] reduction (VPU-friendly, no inner scan), giving
     the bounded-fleet optimum min over r <= V of V_r[n].
 
-Both assume a homogeneous capacity (capacities[0]); heterogeneous fleets
-are handled by the giant-tour representation instead, where routes are
-positionally bound to vehicles (vrpms_tpu.core.cost).
+Heterogeneous fleets: the greedy rule and the optimal-split DP both
+apply PER-VEHICLE capacities in vehicle-index order (routes bind to
+vehicles positionally, exactly like the giant-tour pricing in
+vrpms_tpu.core.cost). Only the gather-free pointer-doubling fitness
+shortcut (greedy_split_cost_hot_batch) requires a homogeneous fleet —
+het-fleet fitness goes through exact giant evaluation instead
+(solvers.common.perm_fitness_fn dispatches on Instance.het_fleet).
 """
 
 from __future__ import annotations
@@ -35,15 +39,30 @@ def _greedy_fresh(perm: jax.Array, inst: Instance) -> jax.Array:
     by cost and reconstruction so they can never disagree. fresh[0] is
     only True when perm[0] alone exceeds capacity (and is not counted as
     an extra route by callers).
+
+    Heterogeneous fleets are priced exactly: route r checks against
+    capacities[r] in vehicle order (routes bind to vehicles positionally
+    in the giant encoding); routes past the fleet bound reuse the last
+    vehicle's capacity, matching greedy_split_giant's cramming rule.
     """
-    q = inst.capacities[0]
+    caps = inst.capacities
+    v = caps.shape[0]
     dem = inst.demands[perm]
+    n = perm.shape[0]
 
-    def step(load, dk):
-        fresh = load + dk > q
-        return jnp.where(fresh, dk, load + dk), fresh
+    def step(carry, x):
+        load, r = carry
+        dk, k = x
+        fresh = load + dk > caps[jnp.minimum(r, v - 1)]
+        # position 0 is route 0 even when oversized (callers don't count
+        # fresh[0] as an extra route)
+        r = r + (fresh & (k > 0)).astype(jnp.int32)
+        load = jnp.where(fresh, dk, load + dk)
+        return (load, r), fresh
 
-    _, fresh = jax.lax.scan(step, jnp.float32(0.0), dem)
+    _, fresh = jax.lax.scan(
+        step, (jnp.float32(0.0), jnp.int32(0)), (dem, jnp.arange(n))
+    )
     return fresh
 
 
@@ -165,10 +184,11 @@ def greedy_split_cost_hot_batch(perms: jax.Array, inst: Instance):
     return cost, n_routes
 
 
-def _route_cost_matrix(perm: jax.Array, inst: Instance) -> jax.Array:
-    """C[i, j] = cost of serving perm[i..j-1] (0-based) as one route,
-    BIG when empty/backward/capacity-infeasible. Shape [n+1, n+1] over
-    split points 0..n."""
+def _route_cost_load(perm: jax.Array, inst: Instance):
+    """(cost[i, j], load[i, j]) of serving perm[i..j-1] (0-based) as one
+    route; cost is BIG for empty/backward spans, load is the span's
+    total demand. Shape [n+1, n+1] over split points 0..n. Capacity is
+    NOT applied here — the DP rounds apply each vehicle's own bound."""
     d = inst.durations[0]
     n = perm.shape[0]
     dem = inst.demands[perm]
@@ -187,24 +207,31 @@ def _route_cost_matrix(perm: jax.Array, inst: Instance) -> jax.Array:
         + d[last, 0].reshape(1, -1)
     )
     load = cum_dem[j] - cum_dem[i]
-    valid = (i < j) & (load <= inst.capacities[0])
-    return jnp.where(valid, cost, BIG)
+    return jnp.where(i < j, cost, BIG), load
 
 
 def optimal_split_cost(perm: jax.Array, inst: Instance) -> jax.Array:
-    """Bounded-fleet optimal split distance via V min-plus matvec rounds."""
+    """Bounded-fleet optimal split distance via V min-plus matvec rounds.
+
+    Heterogeneous fleets are exact: round r masks route spans against
+    capacities[r], i.e. routes are assigned to vehicles in index order —
+    the same positional binding the giant encoding uses. (Order-dependent
+    fleet assignment is inherent to that binding; the DP finds the best
+    split GIVEN it.) The "stay" transition lets any vehicle go unused.
+    """
     n = perm.shape[0]
     v = inst.n_vehicles
-    c = _route_cost_matrix(perm, inst)
+    cost, load = _route_cost_load(perm, inst)
     init = jnp.full(n + 1, BIG).at[0].set(0.0)
 
-    def round_(vals, _):
+    def round_(vals, cap_r):
+        c = jnp.where(load <= cap_r, cost, BIG)
         nxt = jnp.min(vals[:, None] + c, axis=0)
         # Allowing "stay" keeps vals[n] monotone in rounds: min over r<=V.
         nxt = jnp.minimum(nxt, vals)
         return nxt, None
 
-    vals, _ = jax.lax.scan(round_, init, None, length=v)
+    vals, _ = jax.lax.scan(round_, init, inst.capacities)
     return vals[n]
 
 
@@ -232,16 +259,24 @@ def optimal_split_routes(perm, inst: Instance) -> list[list[int]]:
     """Host-side optimal split with route reconstruction (numpy).
 
     Used for final-answer reporting; `optimal_split_cost` is the jitted
-    fitness twin. Tested to agree with it exactly.
+    fitness twin. Tested to agree with it exactly. Returns ONE list per
+    vehicle, vehicle-aligned (unused vehicles get []) — a heterogeneous
+    fleet's spans must land on the vehicle whose capacity bound the DP
+    actually applied, or positional giant pricing would disagree.
     """
     p = np.asarray(perm)
     n = p.shape[0]
     v = int(inst.n_vehicles)
-    c = np.asarray(_route_cost_matrix(jnp.asarray(p), inst))
+    cost, load = _route_cost_load(jnp.asarray(p), inst)
+    cost, load = np.asarray(cost), np.asarray(load)
+    caps = np.asarray(inst.capacities)
     vals = np.full(n + 1, np.inf)
     vals[0] = 0.0
     pred = np.zeros((v, n + 1), dtype=np.int64)
     for r in range(v):
+        # vehicle r's own capacity bound (het-fleet exactness; mirrors
+        # optimal_split_cost's per-round mask)
+        c = np.where(load <= caps[r], cost, BIG)
         cand = vals[:, None] + c
         nxt = cand.min(axis=0)
         pred[r] = cand.argmin(axis=0)
@@ -253,14 +288,13 @@ def optimal_split_routes(perm, inst: Instance) -> list[list[int]]:
         raise ValueError(
             "no capacity-feasible split of this order within the fleet bound"
         )
-    routes: list[list[int]] = []
+    routes: list[list[int]] = [[] for _ in range(v)]
     j, r = n, v - 1
     while j > 0 and r >= 0:
         if pred[r, j] == -1:
             r -= 1
             continue
         i = int(pred[r, j])
-        routes.append([int(x) for x in p[i:j]])
+        routes[r] = [int(x) for x in p[i:j]]
         j, r = i, r - 1
-    routes.reverse()
     return routes

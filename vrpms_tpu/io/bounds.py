@@ -1,0 +1,536 @@
+"""Lower-bound certificates: make gaps measurable without network access.
+
+The primary quality metric is gap-to-best-known (BASELINE.json), but in
+a zero-egress container every benchmark instance is a synthetic stand-in
+with no published optimum — a reported cost of 36.8k could be 2% or 25%
+off and nobody could tell (VERDICT round-1 missing item #2). These
+bounds turn any reported cost into a CERTIFIED statement:
+
+    cost <= (1 + gap_ub) * OPT        because        LB <= OPT <= cost
+
+All bounds run host-side in numpy/scipy (milliseconds at n=200; these
+certify results, they are not on any hot path) and are classic
+polynomial relaxations:
+
+  * route_count_lb — bin-packing bound on the vehicles actually needed
+    (fewest vehicles whose capacities cover total demand);
+  * assignment_lb  — the assignment-problem relaxation of the VRP
+    digraph: every customer needs one out-arc and one in-arc, the depot
+    is duplicated once per vehicle (zero-cost depot->depot arcs model
+    empty routes), subtour/capacity constraints dropped; exact AP via
+    scipy's Hungarian;
+  * mst_lb         — spanning-tree bound: a VRP solution is a connected
+    spanning subgraph (every route touches the depot), so the symmetric
+    MST weight is a lower bound; only valid for symmetric matrices;
+  * held_karp_1tree_lb — for TSP (V == 1): minimum 1-tree with
+    Lagrangian ascent on node potentials (Held & Karp 1970), typically
+    within ~1% of the optimum on Euclidean instances; symmetric only.
+
+`lower_bound` returns the best applicable max of these. Validity is
+pinned by tests against the exact BF/Held-Karp oracles on small
+instances (tests/test_bounds.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vrpms_tpu.core.instance import BIG, Instance
+
+
+def _host(inst: Instance):
+    d = np.asarray(inst.durations[0], dtype=np.float64)
+    demands = np.asarray(inst.demands, dtype=np.float64)
+    caps = np.asarray(inst.capacities, dtype=np.float64)
+    return d, demands, caps
+
+
+def _certifiable(inst: Instance) -> bool:
+    """Bounds here read durations slice 0 only; a TIME-DEPENDENT
+    instance may travel on cheaper slices, so slice-0 bounds are NOT
+    lower bounds for it. Every public bound gates on this and returns
+    the vacuous 0.0 rather than a wrong certificate. (A valid TD bound
+    would use the elementwise min over slices — future work.)"""
+    return not inst.time_dependent
+
+
+def _symmetric(d: np.ndarray) -> bool:
+    return bool(np.allclose(d, d.T, rtol=1e-6, atol=1e-9))
+
+
+def route_count_lb(inst: Instance) -> int:
+    """Fewest vehicles whose combined capacity covers total demand (a
+    bin-packing relaxation: item splitting allowed, so it never
+    overestimates). At least 1."""
+    _, demands, caps = _host(inst)
+    total = float(demands.sum())
+    caps_desc = np.sort(caps)[::-1]
+    covered = np.cumsum(caps_desc)
+    idx = np.searchsorted(covered, total - 1e-9)
+    return int(min(idx + 1, len(caps))) if total > 0 else 1
+
+
+def assignment_lb(inst: Instance) -> float:
+    """Assignment-problem relaxation of the VRP digraph (see module
+    docstring). Valid for asymmetric matrices and any fleet; capacity
+    and connectivity are relaxed, so the bound is safe but not tight."""
+    if not _certifiable(inst):
+        return 0.0
+    d, _, caps = _host(inst)
+    n = d.shape[0]
+    v = len(caps)
+    m = n - 1 + v  # customers 1..n-1 plus v depot copies
+    c = np.zeros((m, m), dtype=np.float64)
+    # block layout: indices 0..n-2 are customers 1..n-1; n-1..m-1 depot
+    cust = np.arange(1, n)
+    c[: n - 1, : n - 1] = d[np.ix_(cust, cust)]
+    np.fill_diagonal(c[: n - 1, : n - 1], BIG)  # no self-arcs
+    c[: n - 1, n - 1 :] = d[cust, 0][:, None]  # customer -> depot
+    c[n - 1 :, : n - 1] = d[0, cust][None, :]  # depot -> customer
+    c[n - 1 :, n - 1 :] = 0.0  # empty routes are free
+    try:
+        from scipy.optimize import linear_sum_assignment
+
+        rows, cols = linear_sum_assignment(c)
+        return float(c[rows, cols].sum())
+    except ImportError:  # pragma: no cover - scipy is present in CI
+        # degenerate fallback: cheapest out-arc per customer (the AP
+        # without the one-in-arc constraint) — still a valid LB
+        out = np.where(np.eye(n, dtype=bool), np.inf, d)[1:, :].min(axis=1)
+        return float(out.sum())
+
+
+def mst_lb(inst: Instance) -> float:
+    """Symmetric MST bound (0.0 — vacuous — for asymmetric matrices)."""
+    if not _certifiable(inst):
+        return 0.0
+    d, _, _ = _host(inst)
+    if not _symmetric(d):
+        return 0.0
+    return float(_mst_weight(np.maximum(d, d.T)))
+
+
+def _mst_weight(d: np.ndarray, nodes: np.ndarray | None = None) -> float:
+    """Prim's MST weight over the given node subset (dense O(k^2))."""
+    if nodes is not None:
+        d = d[np.ix_(nodes, nodes)]
+    k = d.shape[0]
+    if k <= 1:
+        return 0.0
+    in_tree = np.zeros(k, dtype=bool)
+    in_tree[0] = True
+    best = d[0].copy()
+    best[0] = np.inf
+    total = 0.0
+    for _ in range(k - 1):
+        j = int(np.argmin(np.where(in_tree, np.inf, best)))
+        total += best[j]
+        in_tree[j] = True
+        best = np.minimum(best, d[j])
+        best[in_tree] = np.inf
+    return total
+
+
+def held_karp_1tree_lb(
+    inst: Instance, iters: int = 100, seed_step: float = 2.0
+) -> float:
+    """Held-Karp 1-tree bound for the TSP (V == 1), symmetric only.
+
+    1-tree = MST over nodes 1..n-1 plus the depot's two cheapest edges;
+    every tour is a 1-tree, so its weight bounds the tour. Lagrangian
+    ascent on node potentials pi (reduced costs d + pi_i + pi_j, bound
+    w(1-tree) - 2*sum(pi)) sharpens it; the step follows the classic
+    degree-subgradient schedule with halving on stall.
+    """
+    if not _certifiable(inst):
+        return 0.0
+    d, _, _ = _host(inst)
+    if not _symmetric(d):
+        return 0.0
+    d = np.maximum(d, d.T)
+    n = d.shape[0]
+    if n < 3:
+        return float(d[0, 1] + d[1, 0]) if n == 2 else 0.0
+    pi = np.zeros(n)
+    best = 0.0
+    step = seed_step * float(np.mean(d[d > 0])) / max(n, 1)
+    for _ in range(iters):
+        dr = d + pi[:, None] + pi[None, :]
+        np.fill_diagonal(dr, np.inf)
+        # MST over customers + parent tracking for degrees
+        k = n - 1
+        sub = dr[1:, 1:]
+        in_tree = np.zeros(k, dtype=bool)
+        in_tree[0] = True
+        best_w = sub[0].copy()
+        best_from = np.zeros(k, dtype=int)
+        best_w[0] = np.inf
+        deg = np.zeros(n)
+        w_total = 0.0
+        for _ in range(k - 1):
+            j = int(np.argmin(np.where(in_tree, np.inf, best_w)))
+            w_total += best_w[j]
+            deg[j + 1] += 1
+            deg[best_from[j] + 1] += 1
+            in_tree[j] = True
+            closer = sub[j] < best_w
+            best_from = np.where(closer & ~in_tree, j, best_from)
+            best_w = np.where(closer, sub[j], best_w)
+            best_w[in_tree] = np.inf
+        # depot's two cheapest reduced edges
+        two = np.sort(dr[0, 1:])[:2]
+        w_total += float(two.sum())
+        deg[0] = 2.0
+        ends = np.argsort(dr[0, 1:])[:2] + 1
+        deg[ends] += 1
+        bound = w_total - 2.0 * float(pi.sum())
+        if bound > best:
+            best = bound
+        else:
+            step *= 0.9
+        g = deg - 2.0
+        if not g.any():
+            break  # the 1-tree IS a tour: bound is the optimum
+        pi = pi + step * g
+    return float(best)
+
+
+def _mst_edges(d: np.ndarray):
+    """Prim over the full matrix: (total weight, list of (w, i, j))."""
+    k = d.shape[0]
+    in_tree = np.zeros(k, dtype=bool)
+    in_tree[0] = True
+    best = d[0].copy()
+    frm = np.zeros(k, dtype=int)
+    best[0] = np.inf
+    edges = []
+    for _ in range(k - 1):
+        j = int(np.argmin(np.where(in_tree, np.inf, best)))
+        edges.append((float(best[j]), int(frm[j]), j))
+        in_tree[j] = True
+        closer = d[j] < best
+        frm = np.where(closer & ~in_tree, j, frm)
+        best = np.where(closer, d[j], best)
+        best[in_tree] = np.inf
+    return sum(w for w, _, _ in edges), edges
+
+
+def cvrp_forest_lb(inst: Instance, iters: int = 80) -> float:
+    """Lagrangian r-route forest bound for symmetric CVRP — the
+    multi-vehicle analog of the Held-Karp 1-tree.
+
+    Decomposition of any r-route solution: remove the depot and each
+    route becomes a customer path, so the customer-customer edges form
+    a spanning forest with r components (weight >= MST(customers) minus
+    its r-1 heaviest edges); the depot contributes r out-arcs to
+    DISTINCT customers and r in-arcs from distinct customers (>= the r
+    smallest depot-edge values each way). r itself is unknown, so the
+    bound takes the min over r in [route_count_lb, V]. Lagrangian
+    ascent on customer potentials (every customer has degree exactly 2)
+    sharpens it; every iterate is a valid bound, so the max is kept.
+    """
+    if not _certifiable(inst):
+        return 0.0
+    d, _, caps = _host(inst)
+    if not _symmetric(d):
+        return 0.0
+    d = np.maximum(d, d.T)
+    n = d.shape[0]
+    if n <= 2:
+        return 0.0
+    v = len(caps)
+    # r counts NON-empty routes (empty routes ride free 0-cost (0,0)
+    # arcs): at most one per customer, at most the fleet size
+    r_hi = min(v, n - 1)
+    r_lo = min(route_count_lb(inst), r_hi)
+    pi = np.zeros(n)  # pi[0] stays 0 (depot degree is not constrained)
+    best_bound = 0.0
+    step = 2.0 * float(np.mean(d[d > 0])) / max(n, 1)
+    for _ in range(iters):
+        dr = d + pi[:, None] + pi[None, :]
+        np.fill_diagonal(dr, np.inf)
+        mst_w, edges = _mst_edges(dr[1:, 1:])
+        by_weight = sorted(edges, reverse=True)
+        depot = dr[0, 1:]
+        order = np.argsort(depot)
+        cum_depot = np.concatenate([[0.0], np.cumsum(depot[order])])
+        best_r, best_val = r_lo, np.inf
+        for r in range(r_lo, r_hi + 1):
+            drop = sum(w for w, _, _ in by_weight[: r - 1])
+            val = (mst_w - drop) + 2.0 * cum_depot[min(r, n - 1)]
+            if val < best_val:
+                best_val, best_r = val, r
+        bound = best_val - 2.0 * float(pi[1:].sum())
+        if bound > best_bound:
+            best_bound = bound
+        else:
+            step *= 0.9
+        # subgradient from the minimizing structure's customer degrees
+        deg = np.zeros(n)
+        for w, i, j in by_weight[best_r - 1 :]:
+            deg[i + 1] += 1
+            deg[j + 1] += 1
+        ends = order[: min(best_r, n - 1)] + 1
+        deg[ends] += 2.0  # one out-arc + one in-arc per chosen customer
+        g = deg[1:] - 2.0
+        if not g.any():
+            break
+        pi[1:] = pi[1:] + step * g
+    return float(best_bound)
+
+
+def qroute_lb(inst: Instance, max_units: int = 4096) -> float:
+    """Capacity-aware q-route lower bound (Christofides-Mingozzi-Toth).
+
+    A q-route is a depot-to-depot walk accumulating exactly q demand
+    units, with elementarity relaxed except for 2-cycles (i -> j -> i
+    immediately is forbidden via the classic best/second-best
+    predecessor DP). Every real route serving q units IS such a walk,
+    so cost(route) >= qroute(q) >= q * min_q' qroute(q')/q', and
+    summing over routes gives  LB = total_units * best cost-per-unit.
+
+    Valid for asymmetric matrices and heterogeneous fleets (Q = the
+    LARGEST capacity bounds every route's load). Requires strictly
+    positive integer demands (returns 0.0 — vacuous — otherwise:
+    zero-demand customers would break the per-unit argument, and
+    fractional demands the DP indexing).
+    """
+    if not _certifiable(inst):
+        return 0.0
+    d, demands, caps = _host(inst)
+    n = d.shape[0]
+    if n <= 2:
+        return 0.0
+    dem = demands[1:]
+    if not np.allclose(dem, np.round(dem)):
+        return 0.0
+    dem_i = np.round(dem).astype(int)
+    if (dem_i < 1).any():
+        return 0.0
+    q_max = int(np.floor(caps.max()))
+    if q_max < int(dem_i.max()) or q_max > max_units:
+        return 0.0
+    k = n - 1  # customers
+    cust = np.arange(1, n)
+    dc = d[np.ix_(cust, cust)]  # customer-customer arcs
+    INF = np.inf
+    # A[q, j]: best cost arriving at customer j with q units served
+    # (j's demand included); P: its predecessor (-1 = depot);
+    # B: best cost over predecessors DIFFERENT from P (2-cycle guard).
+    A = np.full((q_max + 1, k), INF)
+    P = np.full((q_max + 1, k), -2, dtype=int)
+    B = np.full((q_max + 1, k), INF)
+    for j in range(k):
+        if dem_i[j] <= q_max:
+            A[dem_i[j], j] = d[0, j + 1]
+            P[dem_i[j], j] = -1
+    for q in range(1, q_max + 1):
+        for dv in np.unique(dem_i):
+            qp = q - int(dv)
+            if qp < 1:
+                continue
+            ks = np.where(dem_i == dv)[0]
+            if not len(ks):
+                continue
+            # arrival value from each predecessor j to target k: use the
+            # second-best at (qp, j) when its best path came FROM k
+            vals = np.where(
+                P[qp][:, None] == ks[None, :], B[qp][:, None], A[qp][:, None]
+            ) + dc[:, ks]
+            vals[ks[None, :] == np.arange(k)[:, None]] = INF  # no self-arc
+            order = np.argsort(vals, axis=0)
+            b1, b2 = order[0], order[1]
+            v1 = vals[b1, np.arange(len(ks))]
+            v2 = vals[b2, np.arange(len(ks))]
+            better = v1 < A[q, ks]
+            # second-best bookkeeping before overwriting the best
+            B[q, ks] = np.where(
+                better, np.minimum(A[q, ks], v2), np.minimum(B[q, ks], v1)
+            )
+            P[q, ks] = np.where(better, b1, P[q, ks])
+            A[q, ks] = np.where(better, v1, A[q, ks])
+    back = d[cust, 0]
+    closed = A + back[None, :]
+    route_q = closed.min(axis=1)  # best closed q-route per q
+    qs = np.arange(q_max + 1, dtype=np.float64)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        ratios = route_q[1:] / qs[1:]
+    finite = np.isfinite(ratios)
+    if not finite.any():
+        return 0.0
+    return float(ratios[finite].min() * dem_i.sum())
+
+
+def _qroute_table(d, dem_i, q_max, lam):
+    """(route_q, visits): best closed q-route cost per load q under
+    in-arc penalties `lam`, and each route's customer-visit counts
+    (reconstructed through the best-predecessor chain; the 2-cycle
+    second-best branch is approximated by its best-path visits — only
+    the subgradient uses visits, never the bound itself)."""
+    n = d.shape[0]
+    k = n - 1
+    cust = np.arange(1, n)
+    dc = d[np.ix_(cust, cust)] + lam[None, :]
+    INF = np.inf
+    A = np.full((q_max + 1, k), INF)
+    P = np.full((q_max + 1, k), -2, dtype=int)
+    B = np.full((q_max + 1, k), INF)
+    for j in range(k):
+        if dem_i[j] <= q_max:
+            A[dem_i[j], j] = d[0, j + 1] + lam[j]
+            P[dem_i[j], j] = -1
+    for q in range(1, q_max + 1):
+        for dv in np.unique(dem_i):
+            qp = q - int(dv)
+            if qp < 1:
+                continue
+            ks = np.where(dem_i == dv)[0]
+            if not len(ks):
+                continue
+            vals = np.where(
+                P[qp][:, None] == ks[None, :], B[qp][:, None], A[qp][:, None]
+            ) + dc[:, ks]
+            vals[ks[None, :] == np.arange(k)[:, None]] = INF
+            order = np.argsort(vals, axis=0)
+            b1, b2 = order[0], order[1]
+            v1 = vals[b1, np.arange(len(ks))]
+            v2 = vals[b2, np.arange(len(ks))]
+            better = v1 < A[q, ks]
+            B[q, ks] = np.where(
+                better, np.minimum(A[q, ks], v2), np.minimum(B[q, ks], v1)
+            )
+            P[q, ks] = np.where(better, b1, P[q, ks])
+            A[q, ks] = np.where(better, v1, A[q, ks])
+    back = d[cust, 0]
+    closed = A + back[None, :]
+    route_q = closed.min(axis=1)
+    ends = closed.argmin(axis=1)
+    visits = np.zeros((q_max + 1, k))
+    for q in range(1, q_max + 1):
+        if not np.isfinite(route_q[q]):
+            continue
+        qq, j = q, int(ends[q])
+        while j >= 0 and qq >= 1:
+            visits[q, j] += 1
+            j_next = int(P[qq, j])
+            qq -= int(dem_i[j])
+            j = j_next
+    return route_q, visits
+
+
+def cmt_qroute_lb(inst: Instance, iters: int = 40, max_units: int = 4096) -> float:
+    """Christofides-Mingozzi-Toth q-route bound with route-combination
+    DP and Lagrangian ascent on customer penalties — the strongest
+    capacity-aware bound here.
+
+    For penalties lam (free sign), a real solution costs
+        cost = cost_lam - sum(lam)        (every customer has 1 in-arc)
+    and its routes are closed q-routes under the penalized arcs, loads
+    summing to total demand with the route count in [r_lo, r_hi]; so
+        cost >= min_{k, load combo} sum of k penalized q-route costs
+                - sum(lam)
+    — computed exactly by a (routes x units) min-plus DP over the
+    penalized q-route table. Every iterate is valid; the max is kept.
+    Same applicability gates as qroute_lb (positive integer demands).
+    """
+    if not _certifiable(inst):
+        return 0.0
+    d, demands, caps = _host(inst)
+    n = d.shape[0]
+    if n <= 2:
+        return 0.0
+    dem = demands[1:]
+    if not np.allclose(dem, np.round(dem)):
+        return 0.0
+    dem_i = np.round(dem).astype(int)
+    if (dem_i < 1).any():
+        return 0.0
+    q_max = int(np.floor(caps.max()))
+    if q_max < int(dem_i.max()) or q_max > max_units:
+        return 0.0
+    k = n - 1
+    total = int(dem_i.sum())
+    r_hi = min(len(caps), k)
+    r_lo = min(route_count_lb(inst), r_hi)
+    lam = np.zeros(k)
+    best_bound = 0.0
+    step = 0.5 * float(np.mean(d[d > 0]))
+    for _ in range(iters):
+        route_q, visits = _qroute_table(d, dem_i, q_max, lam)
+        # combo DP: G_r[u] = min cost of EXACTLY r q-routes covering u
+        # units; choices kept per round for one backtrack at the end
+        G = np.full(total + 1, np.inf)
+        G[0] = 0.0
+        finite_q = [
+            q for q in range(1, q_max + 1) if np.isfinite(route_q[q])
+        ]
+        choices = []
+        best_val, best_r = np.inf, -1
+        for r in range(1, r_hi + 1):
+            Gn = np.full(total + 1, np.inf)
+            choice = np.full(total + 1, -1, dtype=int)
+            for q in finite_q:
+                u = np.arange(q, total + 1)
+                cand = G[u - q] + route_q[q]
+                better = cand < Gn[u]
+                Gn[u] = np.where(better, cand, Gn[u])
+                choice[u] = np.where(better, q, choice[u])
+            choices.append(choice)
+            G = Gn
+            if r >= r_lo and np.isfinite(G[total]) and G[total] < best_val:
+                best_val, best_r = float(G[total]), r
+        if not np.isfinite(best_val):
+            break
+        bound = best_val - float(lam.sum())
+        if bound > best_bound:
+            best_bound = bound
+        else:
+            step *= 0.85
+        # backtrack the winning combo once for the visit subgradient
+        total_visits = np.zeros(k)
+        u, ok = total, True
+        for r in range(best_r - 1, -1, -1):
+            q = int(choices[r][u])
+            if q <= 0:
+                ok = False
+                break
+            total_visits += visits[q]
+            u -= q
+        if not ok:
+            break
+        g = 1.0 - total_visits  # every customer should be visited once
+        if not g.any():
+            break
+        lam = lam + step * g
+    return float(best_bound)
+
+
+def lower_bound(inst: Instance) -> float:
+    """Best applicable lower bound on the total-distance objective.
+
+    TSP (single BIG-capacity vehicle): Held-Karp 1-tree (symmetric) or
+    the AP relaxation (asymmetric). VRP: max of the AP relaxation and
+    the symmetric MST bound.
+    """
+    d, _, caps = _host(inst)
+    tsp = len(caps) == 1 and caps[0] >= BIG / 2
+    bounds = [assignment_lb(inst)]
+    if tsp:
+        bounds.append(held_karp_1tree_lb(inst))
+    else:
+        bounds.append(mst_lb(inst))
+        bounds.append(cvrp_forest_lb(inst))
+        # qroute_lb / cmt_qroute_lb are valid too but measured dominated
+        # by the Lagrangian forest bound on every benchmarked shape
+        # (synth X-n200: forest 19.3k vs q-route 10.2k); they stay
+        # available for instances where capacity, not geometry, binds.
+    return float(max(bounds))
+
+
+def certified_gap_percent(cost: float, inst: Instance) -> float | None:
+    """Certified upper bound (percent) on this cost's optimality gap:
+    gap_true <= (cost - LB) / LB. None when the bound is vacuous."""
+    lb = lower_bound(inst)
+    if lb <= 0:
+        return None
+    return 100.0 * (float(cost) - lb) / lb

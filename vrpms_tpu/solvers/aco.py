@@ -305,6 +305,15 @@ def solve_aco(
     _, best_perm, _, pool_perms, pool_fits = state
     giant = greedy_split_giant(best_perm, inst)
     bd, cost = exact_cost(giant, inst, w)
+    if warm:
+        # the warm guarantee is on the EXACT objective, not the colony
+        # fitness (whose fleet-overflow penalty can disagree with the
+        # crammed-giant capacity pricing): never return worse than the
+        # seed as the caller will actually price it
+        seed_giant = greedy_split_giant(init_perm, inst)
+        bd_s, cost_s = exact_cost(seed_giant, inst, w)
+        if float(cost_s) < float(cost):
+            giant, bd, cost = seed_giant, bd_s, cost_s
     elite = None
     if pool > 0:
         from vrpms_tpu.core.cost import exact_cost_batch

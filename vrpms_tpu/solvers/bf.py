@@ -238,10 +238,13 @@ def solve_vrp_bf(
 ) -> SolveResult:
     """Exact CVRP: every customer order priced by its optimal split.
 
-    Assumes a homogeneous fleet (split uses capacities[0], like the GA/
-    ACO fitness path). Time windows and makespan-priced objectives fall
-    back to enumerating orders and evaluating the greedy-split giant —
-    exact over that split space, matching the solver fitness paths.
+    Heterogeneous fleets are exact too: the split DP applies per-vehicle
+    capacities in vehicle order (core.split.optimal_split_cost), and
+    enumerating ALL orders covers every assignment of route spans to
+    vehicles (the DP's "stay" transition lets any vehicle go empty).
+    Time windows and makespan-priced objectives fall back to enumerating
+    orders and evaluating the greedy-split giant — exact over that split
+    space, matching the solver fitness paths.
 
     With `deadline_s` the enumeration runs in host-clock-checked chunks
     and may stop early with the best order seen so far (then NOT exact;

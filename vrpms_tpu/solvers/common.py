@@ -116,9 +116,13 @@ def perm_fitness_fn(
     durations): full giant-tour evaluation so waiting/lateness are
     priced.
     """
-    # Timed instances and makespan-priced objectives need the full
-    # giant-tour evaluation (split-distance shortcuts price neither).
-    full_eval = inst.has_tw or inst.time_dependent or w.use_makespan
+    # Timed instances, makespan-priced objectives, and heterogeneous
+    # fleets need the full giant-tour evaluation (the split-distance
+    # shortcuts price none of those; per-vehicle capacities require the
+    # positional giant pricing)
+    full_eval = (
+        inst.has_tw or inst.time_dependent or w.use_makespan or inst.het_fleet
+    )
     v = inst.n_vehicles
     hot = resolve_eval_mode(mode) != "gather"
 
