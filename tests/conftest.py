@@ -71,6 +71,10 @@ _SLOW_PATTERNS = (
     "test_bf_local_search.py::TestBruteForce::test_deadline_none_and_generous_agree",
     "test_bf_local_search.py::TestBruteForce::test_deadline_zero_truncates_but_returns_valid",
     "test_bf_local_search.py::TestLocalSearch",
+    "test_bounds.py::TestValidity",
+    "test_het_fleet.py::TestHetBF",
+    "test_het_fleet.py::TestHetMetaheuristics",
+    "test_perturb.py::TestRuinRecreate::test_ils_reseed_ruin_mode_runs",
     # end-to-end HTTP solves (the envelope/contract tests stay quick)
     "test_concurrency.py",
     "test_service.py::TestVRPSolve",

@@ -107,28 +107,39 @@ def mst_lb(inst: Instance) -> float:
     d, _, _ = _host(inst)
     if not _symmetric(d):
         return 0.0
-    return float(_mst_weight(np.maximum(d, d.T)))
+    # np.minimum: within the symmetry tolerance the SMALLER direction is
+    # the safe one — maximum could push LB past OPT by the tolerance
+    return float(_mst_weight(np.minimum(d, d.T)))
 
 
-def _mst_weight(d: np.ndarray, nodes: np.ndarray | None = None) -> float:
-    """Prim's MST weight over the given node subset (dense O(k^2))."""
-    if nodes is not None:
-        d = d[np.ix_(nodes, nodes)]
+def _mst_edges(d: np.ndarray):
+    """Prim over the full matrix: (total weight, list of (w, i, j)) —
+    THE one MST implementation every bound derives from."""
     k = d.shape[0]
-    if k <= 1:
-        return 0.0
     in_tree = np.zeros(k, dtype=bool)
     in_tree[0] = True
     best = d[0].copy()
+    frm = np.zeros(k, dtype=int)
     best[0] = np.inf
-    total = 0.0
+    edges = []
     for _ in range(k - 1):
         j = int(np.argmin(np.where(in_tree, np.inf, best)))
-        total += best[j]
+        edges.append((float(best[j]), int(frm[j]), j))
         in_tree[j] = True
-        best = np.minimum(best, d[j])
+        closer = d[j] < best
+        frm = np.where(closer & ~in_tree, j, frm)
+        best = np.where(closer, d[j], best)
         best[in_tree] = np.inf
-    return total
+    return sum(w for w, _, _ in edges), edges
+
+
+def _mst_weight(d: np.ndarray, nodes: np.ndarray | None = None) -> float:
+    """MST weight over the given node subset (via _mst_edges)."""
+    if nodes is not None:
+        d = d[np.ix_(nodes, nodes)]
+    if d.shape[0] <= 1:
+        return 0.0
+    return _mst_edges(d)[0]
 
 
 def held_karp_1tree_lb(
@@ -147,7 +158,7 @@ def held_karp_1tree_lb(
     d, _, _ = _host(inst)
     if not _symmetric(d):
         return 0.0
-    d = np.maximum(d, d.T)
+    d = np.minimum(d, d.T)  # safe direction within the symmetry tolerance
     n = d.shape[0]
     if n < 3:
         return float(d[0, 1] + d[1, 0]) if n == 2 else 0.0
@@ -157,26 +168,12 @@ def held_karp_1tree_lb(
     for _ in range(iters):
         dr = d + pi[:, None] + pi[None, :]
         np.fill_diagonal(dr, np.inf)
-        # MST over customers + parent tracking for degrees
-        k = n - 1
-        sub = dr[1:, 1:]
-        in_tree = np.zeros(k, dtype=bool)
-        in_tree[0] = True
-        best_w = sub[0].copy()
-        best_from = np.zeros(k, dtype=int)
-        best_w[0] = np.inf
+        # MST over customers (via the shared Prim) + degree counts
+        w_total, edges = _mst_edges(dr[1:, 1:])
         deg = np.zeros(n)
-        w_total = 0.0
-        for _ in range(k - 1):
-            j = int(np.argmin(np.where(in_tree, np.inf, best_w)))
-            w_total += best_w[j]
+        for _, i, j in edges:
+            deg[i + 1] += 1
             deg[j + 1] += 1
-            deg[best_from[j] + 1] += 1
-            in_tree[j] = True
-            closer = sub[j] < best_w
-            best_from = np.where(closer & ~in_tree, j, best_from)
-            best_w = np.where(closer, sub[j], best_w)
-            best_w[in_tree] = np.inf
         # depot's two cheapest reduced edges
         two = np.sort(dr[0, 1:])[:2]
         w_total += float(two.sum())
@@ -193,26 +190,6 @@ def held_karp_1tree_lb(
             break  # the 1-tree IS a tour: bound is the optimum
         pi = pi + step * g
     return float(best)
-
-
-def _mst_edges(d: np.ndarray):
-    """Prim over the full matrix: (total weight, list of (w, i, j))."""
-    k = d.shape[0]
-    in_tree = np.zeros(k, dtype=bool)
-    in_tree[0] = True
-    best = d[0].copy()
-    frm = np.zeros(k, dtype=int)
-    best[0] = np.inf
-    edges = []
-    for _ in range(k - 1):
-        j = int(np.argmin(np.where(in_tree, np.inf, best)))
-        edges.append((float(best[j]), int(frm[j]), j))
-        in_tree[j] = True
-        closer = d[j] < best
-        frm = np.where(closer & ~in_tree, j, frm)
-        best = np.where(closer, d[j], best)
-        best[in_tree] = np.inf
-    return sum(w for w, _, _ in edges), edges
 
 
 def cvrp_forest_lb(inst: Instance, iters: int = 80) -> float:
@@ -234,7 +211,7 @@ def cvrp_forest_lb(inst: Instance, iters: int = 80) -> float:
     d, _, caps = _host(inst)
     if not _symmetric(d):
         return 0.0
-    d = np.maximum(d, d.T)
+    d = np.minimum(d, d.T)  # safe direction within the symmetry tolerance
     n = d.shape[0]
     if n <= 2:
         return 0.0
@@ -311,47 +288,7 @@ def qroute_lb(inst: Instance, max_units: int = 4096) -> float:
     if q_max < int(dem_i.max()) or q_max > max_units:
         return 0.0
     k = n - 1  # customers
-    cust = np.arange(1, n)
-    dc = d[np.ix_(cust, cust)]  # customer-customer arcs
-    INF = np.inf
-    # A[q, j]: best cost arriving at customer j with q units served
-    # (j's demand included); P: its predecessor (-1 = depot);
-    # B: best cost over predecessors DIFFERENT from P (2-cycle guard).
-    A = np.full((q_max + 1, k), INF)
-    P = np.full((q_max + 1, k), -2, dtype=int)
-    B = np.full((q_max + 1, k), INF)
-    for j in range(k):
-        if dem_i[j] <= q_max:
-            A[dem_i[j], j] = d[0, j + 1]
-            P[dem_i[j], j] = -1
-    for q in range(1, q_max + 1):
-        for dv in np.unique(dem_i):
-            qp = q - int(dv)
-            if qp < 1:
-                continue
-            ks = np.where(dem_i == dv)[0]
-            if not len(ks):
-                continue
-            # arrival value from each predecessor j to target k: use the
-            # second-best at (qp, j) when its best path came FROM k
-            vals = np.where(
-                P[qp][:, None] == ks[None, :], B[qp][:, None], A[qp][:, None]
-            ) + dc[:, ks]
-            vals[ks[None, :] == np.arange(k)[:, None]] = INF  # no self-arc
-            order = np.argsort(vals, axis=0)
-            b1, b2 = order[0], order[1]
-            v1 = vals[b1, np.arange(len(ks))]
-            v2 = vals[b2, np.arange(len(ks))]
-            better = v1 < A[q, ks]
-            # second-best bookkeeping before overwriting the best
-            B[q, ks] = np.where(
-                better, np.minimum(A[q, ks], v2), np.minimum(B[q, ks], v1)
-            )
-            P[q, ks] = np.where(better, b1, P[q, ks])
-            A[q, ks] = np.where(better, v1, A[q, ks])
-    back = d[cust, 0]
-    closed = A + back[None, :]
-    route_q = closed.min(axis=1)  # best closed q-route per q
+    route_q, _ = _qroute_table(d, dem_i, q_max, np.zeros(k))
     qs = np.arange(q_max + 1, dtype=np.float64)
     with np.errstate(invalid="ignore", divide="ignore"):
         ratios = route_q[1:] / qs[1:]

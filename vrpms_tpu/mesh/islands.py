@@ -745,11 +745,9 @@ def solve_aco_islands(
     giant = greedy_split_giant(best_perm, inst)
     bd, cost = exact_cost(giant, inst, w)
     if warm:
-        # exact-objective warm guarantee (see solve_aco)
-        seed_giant = greedy_split_giant(init_perm, inst)
-        bd_s, cost_s = exact_cost(seed_giant, inst, w)
-        if float(cost_s) < float(cost):
-            giant, bd, cost = seed_giant, bd_s, cost_s
+        from vrpms_tpu.solvers.aco import warm_floor
+
+        giant, bd, cost = warm_floor(giant, bd, cost, init_perm, inst, w)
     elite = None
     if pool > 0:
         from vrpms_tpu.core.cost import exact_cost_batch

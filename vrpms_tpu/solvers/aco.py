@@ -306,14 +306,7 @@ def solve_aco(
     giant = greedy_split_giant(best_perm, inst)
     bd, cost = exact_cost(giant, inst, w)
     if warm:
-        # the warm guarantee is on the EXACT objective, not the colony
-        # fitness (whose fleet-overflow penalty can disagree with the
-        # crammed-giant capacity pricing): never return worse than the
-        # seed as the caller will actually price it
-        seed_giant = greedy_split_giant(init_perm, inst)
-        bd_s, cost_s = exact_cost(seed_giant, inst, w)
-        if float(cost_s) < float(cost):
-            giant, bd, cost = seed_giant, bd_s, cost_s
+        giant, bd, cost = warm_floor(giant, bd, cost, init_perm, inst, w)
     elite = None
     if pool > 0:
         from vrpms_tpu.core.cost import exact_cost_batch
@@ -337,6 +330,18 @@ def solve_aco(
         jnp.int32(params.n_ants * done),
         elite,
     )
+
+
+def warm_floor(giant, bd, cost, init_perm, inst: Instance, w):
+    """Never return worse than a warm seed IN THE EXACT OBJECTIVE — the
+    one keep-best guard shared by solve_aco and solve_aco_islands (the
+    colony fitness's fleet-overflow penalty can disagree with the
+    crammed-giant capacity pricing, so the comparison must be exact)."""
+    seed_giant = greedy_split_giant(init_perm, inst)
+    bd_s, cost_s = exact_cost(seed_giant, inst, w)
+    if float(cost_s) < float(cost):
+        return seed_giant, bd_s, cost_s
+    return giant, bd, cost
 
 
 def aco_knn_mask(inst: Instance, knn_k: int):

@@ -11,8 +11,10 @@ polish is the MXU delta descent of solvers.delta_ls).
 Round structure:
   round 0: SA from the standard perturbed-NN seeds (or caller-provided
            warm seeds), elite pool polished, champion kept;
-  round r: every chain reseeded from the best-so-far champion via a few
-           random moves (sa.perturbed_clones — chain 0 stays exact), a
+  round r: every chain reseeded from the best-so-far champion — by
+           default via spatial ruin-and-recreate (solvers.perturb;
+           chain 0 stays the exact incumbent), optionally via a few
+           random moves (sa.perturbed_clones, ILSParams.reseed) — a
            cool anneal refines, pool polished, champion kept.
 
 This fills the reference's SA endpoint slot (reference
@@ -46,6 +48,19 @@ class ILSParams:
     pool: int = 32           # elite pool polished per round
     polish_sweeps: int = 128
     polish_block: int = 16   # sweeps per deadline-checked polish block
+    min_round_s: float = 1.0  # don't START a round with less than this
+                             # much budget left: a round commits to at
+                             # least one anneal block + one polish block
+                             # + reseed (~1-2 s at production shapes),
+                             # so opening one at remaining ~0 overshoots
+                             # the deadline by that whole tail
+    reseed: str = "ruin"     # "ruin": spatial ruin-and-recreate
+                             # (solvers.perturb) — the default; measured
+                             # on synth X-n200 at equal 30 s budget:
+                             # 36647/36881 vs 36951/37147 for "moves"
+                             # (a few random moves per clone,
+                             # sa.perturbed_clones), and 36647 BEATS the
+                             # old 123 s record 36803
     polish_reserve_s: float = 2.0  # deadline slice withheld from each
                              # round's anneal so the polish actually
                              # runs (measured: the polish converts an
@@ -180,7 +195,11 @@ def ils_loop(
     init = init_giants
     for r in range(params.rounds):
         budget = remaining()
-        if budget is not None and budget <= 0 and best_g is not None:
+        if (
+            budget is not None
+            and budget <= max(0.0, params.min_round_s)
+            and best_g is not None
+        ):
             break
         if budget is not None:
             # withhold the polish reserve from the anneal (the anneal
@@ -234,13 +253,23 @@ def ils_loop(
         tlog(f"round {r}: exact champion {cand_cost:.1f}")
         if cand_cost < best_c:
             best_c, best_g = cand_cost, cand
-        if r + 1 < params.rounds:
-            # reseed every chain from the incumbent, decorrelated; the
-            # next round's nn-init would discard what was just learned
-            init = perturbed_clones(
-                jax.random.fold_in(key, 1000 + r), reseed_batch, best_g, mode
-            )
-            tlog(f"round {r}: reseeded")
+        budget = remaining()
+        if r + 1 < params.rounds and (
+            budget is None or budget > max(0.0, params.min_round_s)
+        ):
+            # reseed every chain from the incumbent, decorrelated (the
+            # next round's nn-init would discard what was just learned)
+            # — skipped when the next round cannot start anyway
+            k_reseed = jax.random.fold_in(key, 1000 + r)
+            if params.reseed == "ruin":
+                from vrpms_tpu.solvers.perturb import ruin_recreate_clones
+
+                init = ruin_recreate_clones(
+                    k_reseed, reseed_batch, jnp.asarray(best_g), inst
+                )
+            else:
+                init = perturbed_clones(k_reseed, reseed_batch, best_g, mode)
+            tlog(f"round {r}: reseeded ({params.reseed})")
 
     bd, cost = exact_cost(best_g, inst, w)
     # saturate rather than overflow: extreme budgets exceed int32

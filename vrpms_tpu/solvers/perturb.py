@@ -1,0 +1,153 @@
+"""Ruin-and-recreate perturbation: the ILS reseed that actually jumps.
+
+The round-1 reseed cloned the incumbent and applied a few random moves
+(sa.perturbed_clones) — local wiggles that mostly land in the same
+basin. Classic ILS results (and our own measurements below) favor
+spatial ruin-and-recreate: remove a geographically coherent cluster of
+customers, then greedily reinsert each at its cheapest position. The
+rebuilt tours are structurally different yet high-quality starts.
+
+TPU shape discipline: everything is fixed-shape and batched over B
+chains —
+
+  * ruin: per chain, pick a random seed customer and remove its
+    `k_remove` nearest customers (top-k over the duration row — a
+    vectorised reduction, no host loop);
+  * compact: keep the survivors in incumbent order via one stable
+    argsort over (removed, position);
+  * recreate: `k_remove` insertion steps; each step scores EVERY gap of
+    every chain at once (three [B, m+1] duration lookups) and splices
+    by index arithmetic (no dynamic shapes — the sequence buffer stays
+    [B, n] with a static valid length per step).
+
+Insertion deltas treat the customer order as a depot-anchored path
+(route boundaries are re-derived by the greedy split afterwards) — the
+standard giant-tour approximation.
+
+Cites: reference api/vrp/sa/index.py:40 (the SA/ILS slot this feeds);
+ruin-and-recreate is the Schrimpf et al. / SISR family of perturbations,
+re-derived here in batched fixed-shape form.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core.instance import Instance
+from vrpms_tpu.core.split import greedy_split_giant
+
+
+def _ruin_recreate_one_batch(key, perm, batch: int, d, k_remove: int):
+    """[batch, n] perturbed customer orders from ONE incumbent perm.
+
+    d is the [N, N] duration matrix (slice 0). Chain 0's ORDER is the
+    incumbent's (callers that need the exact incumbent giant — split
+    included — restore it after splitting, see _rr_giants_fn).
+    """
+    n = perm.shape[0]
+    k_seed, k_order, k_jit = jax.random.split(key, 3)
+
+    # --- ruin: per-chain seed customer + its k nearest customers -----
+    seeds = jax.random.randint(k_seed, (batch,), 0, n)
+    seed_nodes = perm[seeds]  # node ids
+    rows = d[seed_nodes][:, 1:]  # distances to customers 1..n (B, n)
+    # jitter breaks ties so chains ruin different clusters even from
+    # identical seeds
+    rows = rows * (1.0 + 0.1 * jax.random.uniform(k_jit, rows.shape))
+    # the seed itself is distance 0 -> always removed; take k nearest
+    _, rm_idx = jax.lax.top_k(-rows, k_remove)  # customer ids - 1
+    removed_nodes = rm_idx + 1  # (B, k)
+
+    # --- compact survivors in incumbent order ------------------------
+    perm_b = jnp.tile(perm[None], (batch, 1))  # (B, n)
+    is_removed = (
+        perm_b[:, :, None] == removed_nodes[:, None, :]
+    ).any(-1)  # (B, n)
+    # stable sort: survivors (0) before removed (1), original order kept
+    order = jnp.argsort(is_removed.astype(jnp.int32), axis=1, stable=True)
+    seq = jnp.take_along_axis(perm_b, order, axis=1)  # (B, n)
+    # removal order for reinsertion: the removed customers, shuffled
+    # identically cheaply via a per-chain random roll
+    rolls = jax.random.randint(k_order, (batch, 1), 0, k_remove)
+    pos_k = (jnp.arange(k_remove)[None, :] + rolls) % k_remove
+    to_insert = jnp.take_along_axis(removed_nodes, pos_k, axis=1)
+
+    # --- recreate: greedy cheapest-gap insertion, one step per removal
+    m0 = n - k_remove
+    pos = jnp.arange(n)
+
+    def insert_step(seq, t):
+        m = m0 + t  # static per unrolled step
+        c = to_insert[:, t]  # (B,)
+        valid = pos[None, : m + 1]
+        a = jnp.where(
+            valid == 0,
+            0,
+            jnp.take_along_axis(
+                seq, jnp.maximum(valid - 1, 0), axis=1
+            ),
+        )  # predecessor node of gap j (depot for j == 0)
+        b = jnp.where(
+            valid == m, 0, jnp.take_along_axis(seq, jnp.minimum(valid, m - 1), axis=1)
+        )  # successor node of gap j (depot for j == m)
+        delta = d[a, c[:, None]] + d[c[:, None], b] - d[a, b]
+        j = jnp.argmin(delta, axis=1)  # (B,) best gap
+        shift = pos[None, :] > j[:, None]  # positions after j shift right
+        at = pos[None, :] == j[:, None]
+        prev = jnp.concatenate(
+            [jnp.zeros((seq.shape[0], 1), seq.dtype), seq[:, :-1]], axis=1
+        )
+        seq = jnp.where(at, c[:, None], jnp.where(shift, prev, seq))
+        return seq, None
+
+    # python-unrolled over the (small, static) k_remove steps so each
+    # step's valid length m is a static shape
+    for t in range(k_remove):
+        seq, _ = insert_step(seq, t)
+    return seq.at[0].set(perm)
+
+
+def ruin_recreate_clones(
+    key: jax.Array,
+    batch: int,
+    giant: jax.Array,
+    inst: Instance,
+    k_remove: int | None = None,
+) -> jax.Array:
+    """[batch, L] giant tours: the incumbent giant's customer order,
+    ruin-and-recreate perturbed per chain, re-split greedily. Chain 0 is
+    the exact incumbent (keep-best guarantee). One jitted program.
+    """
+    n = inst.n_customers
+    if k_remove is None:
+        k_remove = max(2, min(24, n // 8))
+    k_remove = min(k_remove, n - 1)
+    return _rr_giants_fn(batch, int(k_remove))(key, giant, inst)
+
+
+@lru_cache(maxsize=32)
+def _rr_giants_fn(batch: int, k_remove: int):
+    @jax.jit
+    def fn(key, giant, inst):
+        perm = _perm_of_giant(giant, inst.n_customers)
+        seqs = _ruin_recreate_one_batch(
+            key, perm, batch, inst.durations[0], k_remove
+        )
+        out = jax.vmap(lambda p: greedy_split_giant(p, inst))(seqs)
+        # chain 0 keeps the incumbent GIANT byte-exact — a greedy
+        # re-split of its order could lose an annealed separator
+        # placement (TW/makespan/het instances), breaking keep-best
+        return out.at[0].set(giant)
+
+    return fn
+
+
+def _perm_of_giant(giant: jax.Array, n: int) -> jax.Array:
+    """Customer order of a giant tour (separators stripped), fixed
+    shape [n]: stable-sort positions by is-separator."""
+    is_sep = (giant == 0).astype(jnp.int32)
+    order = jnp.argsort(is_sep, axis=0, stable=True)
+    return giant[order][:n]
