@@ -110,6 +110,9 @@ def parse_solver_options(content: dict, errors):
                         is the TOTAL sweep budget across rounds. The
                         strongest quality setting (solvers.ils).
                         Explicit 0 = ILS off (plain SA)
+    ilsReseed:          'ruin' (default; spatial ruin-and-recreate) or
+                        'moves' (a few random moves per clone) — how
+                        ILS reseeds chains from the champion each round
     islands:            run SA/GA/ACO as an island model over this many
                         devices of the mesh (vrpms_tpu.mesh): per-device
                         populations/colonies with ring elite migration
@@ -147,6 +150,7 @@ def parse_solver_options(content: dict, errors):
             "localSearchPool", content, errors, optional=True
         ),
         "ils_rounds": get_parameter("ilsRounds", content, errors, optional=True),
+        "ils_reseed": get_parameter("ilsReseed", content, errors, optional=True),
         "islands": get_parameter("islands", content, errors, optional=True),
         "migrate_every": get_parameter("migrateEvery", content, errors, optional=True),
         "migrants": get_parameter("migrants", content, errors, optional=True),

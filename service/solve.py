@@ -205,6 +205,16 @@ def _deadline(opts):
     return float(val) if val is not None else None
 
 
+def _ils_reseed(opts):
+    """Validated ilsReseed option ('ruin' default — see ILSParams)."""
+    val = opts.get("ils_reseed")
+    if val is None:
+        return "ruin"
+    if val not in ("ruin", "moves"):
+        raise ValueError(f"'ilsReseed' must be 'ruin' or 'moves', got {val!r}")
+    return val
+
+
 def _positive_int(opts, key, default, name, zero_ok=False):
     """Validated positive-integer option: absent -> default, anything
     not a positive integer -> ValueError (the Solver-error envelope).
@@ -266,6 +276,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
         # ILS polishes internally every round: an EXPLICIT
         # localSearchPool is honored exactly, otherwise ILSParams'
         # default pool applies.
+        _ils_reseed(opts)  # validated whenever provided (like pool)
         pool = _positive_int(opts, "local_search_pool", 1, "localSearchPool")
         ils_pool = pool if opts.get("local_search_pool") is not None else 32
         if not _polish_enabled(opts):
@@ -295,7 +306,8 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                         key=seed,
                         mesh=mesh,
                         params=ILSParams.from_budget(
-                            ils_rounds, p, p.n_iters, pool=ils_pool
+                            ils_rounds, p, p.n_iters, pool=ils_pool,
+                            reseed=_ils_reseed(opts),
                         ),
                         island_params=ip,
                         weights=w,
@@ -334,7 +346,8 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     inst,
                     key=seed,
                     params=ILSParams.from_budget(
-                        ils_rounds, p, p.n_iters, pool=ils_pool
+                        ils_rounds, p, p.n_iters, pool=ils_pool,
+                        reseed=_ils_reseed(opts),
                     ),
                     weights=w,
                     init_giants=init,

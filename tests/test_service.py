@@ -399,6 +399,25 @@ class TestVRPSolve:
         visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    def test_ils_reseed_option(self, server):
+        for mode in ("ruin", "moves"):
+            status, resp = post(
+                server,
+                "/api/vrp/sa",
+                vrp_body(iterationCount=200, populationSize=16, ilsRounds=2,
+                         ilsReseed=mode, includeStats=True),
+            )
+            assert status == 200, resp
+            visited = [c for v in resp["message"]["vehicles"]
+                       for c in v["tour"][1:-1]]
+            assert sorted(visited) == [1, 2, 3, 4, 5, 6]
+        status, resp = post(
+            server, "/api/vrp/sa",
+            vrp_body(ilsRounds=2, ilsReseed="bogus"),
+        )
+        assert status == 400
+        assert any("ilsReseed" in e["reason"] for e in resp["errors"])
+
     def test_ils_rounds_zero_means_off(self, server):
         # explicit 0 disables ILS (plain SA), like timeLimit's 0 —
         # not a Solver-error envelope

@@ -288,7 +288,7 @@ def qroute_lb(inst: Instance, max_units: int = 4096) -> float:
     if q_max < int(dem_i.max()) or q_max > max_units:
         return 0.0
     k = n - 1  # customers
-    route_q, _ = _qroute_table(d, dem_i, q_max, np.zeros(k))
+    route_q, _ = _qroute_table(d, dem_i, q_max, np.zeros(k), want_visits=False)
     qs = np.arange(q_max + 1, dtype=np.float64)
     with np.errstate(invalid="ignore", divide="ignore"):
         ratios = route_q[1:] / qs[1:]
@@ -298,12 +298,13 @@ def qroute_lb(inst: Instance, max_units: int = 4096) -> float:
     return float(ratios[finite].min() * dem_i.sum())
 
 
-def _qroute_table(d, dem_i, q_max, lam):
+def _qroute_table(d, dem_i, q_max, lam, want_visits: bool = True):
     """(route_q, visits): best closed q-route cost per load q under
     in-arc penalties `lam`, and each route's customer-visit counts
     (reconstructed through the best-predecessor chain; the 2-cycle
     second-best branch is approximated by its best-path visits — only
-    the subgradient uses visits, never the bound itself)."""
+    the subgradient uses visits, never the bound itself; pass
+    want_visits=False to skip the reconstruction walk)."""
     n = d.shape[0]
     k = n - 1
     cust = np.arange(1, n)
@@ -343,6 +344,8 @@ def _qroute_table(d, dem_i, q_max, lam):
     route_q = closed.min(axis=1)
     ends = closed.argmin(axis=1)
     visits = np.zeros((q_max + 1, k))
+    if not want_visits:
+        return route_q, visits
     for q in range(1, q_max + 1):
         if not np.isfinite(route_q[q]):
             continue

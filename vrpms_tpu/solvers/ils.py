@@ -160,6 +160,12 @@ def ils_loop(
     """
     if params.rounds < 1:
         raise ValueError(f"ILSParams.rounds must be >= 1, got {params.rounds}")
+    if params.reseed not in ("ruin", "moves"):
+        # silent fallback would hide a quality regression (the modes
+        # measure ~0.7% apart on X-n200)
+        raise ValueError(
+            f"ILSParams.reseed must be 'ruin' or 'moves', got {params.reseed!r}"
+        )
     t_start = time.monotonic()
 
     import os

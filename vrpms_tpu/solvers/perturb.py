@@ -43,9 +43,9 @@ from vrpms_tpu.core.split import greedy_split_giant
 def _ruin_recreate_one_batch(key, perm, batch: int, d, k_remove: int):
     """[batch, n] perturbed customer orders from ONE incumbent perm.
 
-    d is the [N, N] duration matrix (slice 0). Chain 0's ORDER is the
-    incumbent's (callers that need the exact incumbent giant — split
-    included — restore it after splitting, see _rr_giants_fn).
+    d is the [N, N] duration matrix (slice 0). Every row is perturbed;
+    the keep-best guarantee (chain 0 == exact incumbent giant) lives in
+    ONE place, _rr_giants_fn's final overwrite.
     """
     n = perm.shape[0]
     k_seed, k_order, k_jit = jax.random.split(key, 3)
@@ -107,7 +107,7 @@ def _ruin_recreate_one_batch(key, perm, batch: int, d, k_remove: int):
     # step's valid length m is a static shape
     for t in range(k_remove):
         seq, _ = insert_step(seq, t)
-    return seq.at[0].set(perm)
+    return seq
 
 
 def ruin_recreate_clones(
