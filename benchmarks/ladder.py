@@ -295,13 +295,27 @@ def config4_ga_islands(quick=False):
         key=0,
         params=GAParams(population=256, generations=100 if quick else 1000, elites=4),
         island_params=IslandParams(migrate_every=25, n_migrants=2),
+        pool=8,
     )
     ga_cost = float(res.breakdown.distance)
     ga_evals = int(res.evals)
     ga_elapsed = time.perf_counter() - t0  # throughput excludes polish
-    from vrpms_tpu.solvers.delta_ls import delta_polish
+    # polish the elite pool and keep the winner (the service's
+    # localSearchPool pipeline; distinct genomes sit in distinct basins)
+    from vrpms_tpu.core.cost import CostWeights, exact_cost, exact_cost_batch
+    from vrpms_tpu.solvers.delta_ls import delta_polish_batch
 
-    res = delta_polish(res.giant, inst)
+    w = CostWeights.make()
+    giants, _, _ = delta_polish_batch(res.pool, inst, w, max_sweeps=128)
+    import jax.numpy as jnp
+
+    # rank the (small) polished pool EXACTLY — mode-precision costs can
+    # misrank near-ties and drop a genuinely better row
+    ecosts = exact_cost_batch(giants, inst, w)
+    champ = giants[int(jnp.argmin(ecosts))]
+    bd, cost = exact_cost(champ, inst, w)
+    if float(cost) < float(res.cost):
+        res = res._replace(giant=champ, cost=cost, breakdown=bd)
     elapsed = time.perf_counter() - t0
     return _result(
         4,

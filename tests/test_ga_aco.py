@@ -61,12 +61,43 @@ class TestGA:
     def test_respects_population_and_generations(self, rng):
         inst = euclidean_cvrp(rng, n=10, v=2, q=20)
         res = solve_ga(inst, key=1, params=GAParams(population=32, generations=50))
-        assert int(res.evals) == 32 * 50
+        # pop + default immigrants (8, clamped) genomes evaluated per gen
+        assert int(res.evals) == (32 + 8) * 50
+        res0 = solve_ga(
+            inst, key=1,
+            params=GAParams(population=32, generations=50, immigrants=0),
+        )
+        assert int(res0.evals) == 32 * 50
 
     def test_tw_instance(self, rng):
         inst = random_instance(rng, n=8, v=2, tw=True)
         res = solve_ga(inst, key=2, params=GAParams(population=64, generations=100))
         assert is_valid_giant(res.giant, 7, 2)
+
+    def test_immigrant_generation_valid_in_both_modes(self, rng):
+        # the default-on immigrant path: every child (immigrants
+        # included) must stay a valid permutation, and the elite-carried
+        # best must never regress across a generation
+        from vrpms_tpu.solvers.common import perm_fitness_fn
+        from vrpms_tpu.solvers.ga import ga_generation, initial_perms
+        from vrpms_tpu.core.cost import CostWeights
+
+        inst = euclidean_cvrp(rng, n=12, v=3, q=10)
+        w = CostWeights.make()
+        p = GAParams(population=24, elites=4, immigrants=6)
+        for mode in ("gather", "onehot"):
+            fitness = perm_fitness_fn(inst, w, p.fleet_penalty, mode=mode)
+            perms = initial_perms(jax.random.key(0), 24, inst, p, mode)
+            fits = fitness(perms)
+            best0 = float(jnp.min(fits))
+            for gen in range(3):
+                perms, fits = ga_generation(
+                    perms, fits, jax.random.key(1), gen, fitness, p, mode,
+                    d=inst.durations[0],
+                )
+            for row in np.asarray(perms):
+                assert sorted(row) == list(range(1, 12)), mode
+            assert float(jnp.min(fits)) <= best0 + 1e-3, mode
 
     def test_pool_returns_champion_first(self, rng):
         inst = euclidean_cvrp(rng, n=10, v=2, q=20)

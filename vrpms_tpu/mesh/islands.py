@@ -412,7 +412,8 @@ def _ga_islands_fn(
         def inner(st, gen):
             perms, fits, best_p, best_f = st
             perms, fits = ga_generation(
-                perms, fits, k_isl, gen, fitness, local_params, mode
+                perms, fits, k_isl, gen, fitness, local_params, mode,
+                d=inst.durations[0],
             )
             champ = jnp.argmin(fits)
             better = fits[champ] < best_f
@@ -474,7 +475,8 @@ def _ga_islands_chunk_fn(
         def inner(st, gen):
             perms, fits, best_p, best_f = st
             perms, fits = ga_generation(
-                perms, fits, k_isl, gen, fitness, local_params, mode
+                perms, fits, k_isl, gen, fitness, local_params, mode,
+                d=inst.durations[0],
             )
             champ = jnp.argmin(fits)
             better = fits[champ] < best_f
@@ -591,11 +593,14 @@ def solve_ga_islands(
         elite = jax.vmap(lambda p: greedy_split_giant(p, inst))(
             pool_perms[order]
         )
+    per_gen = pop_local + max(
+        0, min(local_params.immigrants, pop_local - local_params.elites - 1)
+    )
     return SolveResult(
         giant,
         cost,
         bd,
-        jnp.int32(n_isl * pop_local * done),
+        jnp.int32(n_isl * per_gen * done),
         elite,
     )
 

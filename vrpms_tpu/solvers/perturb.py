@@ -110,6 +110,21 @@ def _ruin_recreate_one_batch(key, perm, batch: int, d, k_remove: int):
     return seq
 
 
+def default_k_remove(n: int) -> int:
+    """The ONE ruin cluster-size heuristic (n = customer count)."""
+    return min(max(2, min(24, n // 8)), n - 1)
+
+
+def ruin_recreate_perms(
+    key: jax.Array, perm: jax.Array, batch: int, d, k_remove: int | None = None
+) -> jax.Array:
+    """[batch, n] perturbed customer orders from one incumbent perm —
+    the perm-level entry (GA immigrants); every row is perturbed."""
+    if k_remove is None:
+        k_remove = default_k_remove(perm.shape[0])
+    return _ruin_recreate_one_batch(key, perm, batch, d, int(k_remove))
+
+
 def ruin_recreate_clones(
     key: jax.Array,
     batch: int,
@@ -121,10 +136,8 @@ def ruin_recreate_clones(
     ruin-and-recreate perturbed per chain, re-split greedily. Chain 0 is
     the exact incumbent (keep-best guarantee). One jitted program.
     """
-    n = inst.n_customers
     if k_remove is None:
-        k_remove = max(2, min(24, n // 8))
-    k_remove = min(k_remove, n - 1)
+        k_remove = default_k_remove(inst.n_customers)
     return _rr_giants_fn(batch, int(k_remove))(key, giant, inst)
 
 
