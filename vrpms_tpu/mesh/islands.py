@@ -593,9 +593,9 @@ def solve_ga_islands(
         elite = jax.vmap(lambda p: greedy_split_giant(p, inst))(
             pool_perms[order]
         )
-    per_gen = pop_local + max(
-        0, min(local_params.immigrants, pop_local - local_params.elites - 1)
-    )
+    from vrpms_tpu.solvers.ga import immigrants_for
+
+    per_gen = pop_local + immigrants_for(local_params, pop_local, inst.n_customers)
     return SolveResult(
         giant,
         cost,

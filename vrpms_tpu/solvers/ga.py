@@ -69,6 +69,15 @@ class GAParams:
                          # bred child survive; 0 disables
 
 
+def immigrants_for(params: GAParams, pop: int, n: int) -> int:
+    """Immigrants actually injected per generation — THE one clamp
+    (elites + at least one bred child survive; tiny instances skip the
+    ruin entirely), shared by ga_generation and the evals accounting."""
+    if n < 4:
+        return 0
+    return max(0, min(params.immigrants, pop - params.elites - 1))
+
+
 def _random_perms(key, pop: int, n: int) -> jax.Array:
     base = jnp.arange(1, n + 1, dtype=jnp.int32)
     return jax.vmap(lambda k: jax.random.permutation(k, base))(
@@ -304,18 +313,21 @@ def ga_generation(
     elite_idx = jnp.argsort(fits)[: params.elites]
     children = children.at[: params.elites].set(perms[elite_idx])
     new_fits = fitness(children)
-    imm_n = max(0, min(params.immigrants, pop - params.elites - 1))
-    if imm_n > 0 and d is not None and perms.shape[1] >= 4:
+    imm_n = immigrants_for(params, pop, perms.shape[1])
+    if imm_n > 0 and d is not None:
         # replace the worst children with ruin-and-recreate variants of
         # the generation champion — structurally fresh, high-quality
         # blood every generation (the GA analog of the ILS reseed)
         from vrpms_tpu.solvers.perturb import ruin_recreate_perms
 
-        champ = children[jnp.argmin(new_fits)]
-        imm = ruin_recreate_perms(
-            jax.random.fold_in(k_gen, 7), champ, imm_n, d
-        )
-        worst = jnp.argsort(new_fits)[-imm_n:]
+        # base the immigrants on a RANDOM top-8 member, not always the
+        # champion: champion-only immigration crowds the population
+        # into one basin (measured: post-polish quality regressed)
+        k_imm, k_base = jax.random.split(jax.random.fold_in(k_gen, 7))
+        order = jnp.argsort(new_fits)
+        base = children[order[jax.random.randint(k_base, (), 0, min(8, pop))]]
+        imm = ruin_recreate_perms(k_imm, base, imm_n, d)
+        worst = order[-imm_n:]
         children = children.at[worst].set(imm)
         new_fits = new_fits.at[worst].set(fitness(imm))
     return children, new_fits
@@ -449,16 +461,7 @@ def solve_ga(
         # evals from the actual population (init_perms may differ),
         # plus the immigrant evaluations each generation performs
         jnp.int32(
-            (
-                perms0.shape[0]
-                + max(
-                    0,
-                    min(
-                        params.immigrants,
-                        perms0.shape[0] - params.elites - 1,
-                    ),
-                )
-            )
+            (perms0.shape[0] + immigrants_for(params, perms0.shape[0], inst.n_customers))
             * done
         ),
         elite,

@@ -120,9 +120,11 @@ def ruin_recreate_perms(
 ) -> jax.Array:
     """[batch, n] perturbed customer orders from one incumbent perm —
     the perm-level entry (GA immigrants); every row is perturbed."""
+    n = perm.shape[0]
     if k_remove is None:
-        k_remove = default_k_remove(perm.shape[0])
-    return _ruin_recreate_one_batch(key, perm, batch, d, int(k_remove))
+        k_remove = default_k_remove(n)
+    k_remove = max(1, min(int(k_remove), n - 1))  # explicit values clamp too
+    return _ruin_recreate_one_batch(key, perm, batch, d, k_remove)
 
 
 def ruin_recreate_clones(
@@ -136,9 +138,11 @@ def ruin_recreate_clones(
     ruin-and-recreate perturbed per chain, re-split greedily. Chain 0 is
     the exact incumbent (keep-best guarantee). One jitted program.
     """
+    n = inst.n_customers
     if k_remove is None:
-        k_remove = default_k_remove(inst.n_customers)
-    return _rr_giants_fn(batch, int(k_remove))(key, giant, inst)
+        k_remove = default_k_remove(n)
+    k_remove = max(1, min(int(k_remove), n - 1))  # explicit values clamp too
+    return _rr_giants_fn(batch, k_remove)(key, giant, inst)
 
 
 @lru_cache(maxsize=32)
