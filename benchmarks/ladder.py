@@ -137,7 +137,8 @@ def _certified_gap(distance: float, inst):
     """BKS-free optimality certificate: true gap <= this (polynomial
     lower bounds, vrpms_tpu.io.bounds; validated against BF oracles).
     For time-windowed instances the certificate covers the DISTANCE
-    component only."""
+    component only; time-dependent instances certify against the
+    elementwise cheapest slice."""
     from vrpms_tpu.io.bounds import certified_gap_percent
 
     gap = certified_gap_percent(distance, inst)

@@ -79,6 +79,32 @@ class TestValidity:
             assert lb >= 0.85 * opt, (seed, lb, opt)
             assert lower_bound(inst) <= opt * (1 + 1e-5) + 1e-4
 
+    def test_time_dependent_bounds_use_slice_minimum(self, rng):
+        # TD instances certify against the elementwise cheapest slice:
+        # valid (every leg costs at least that) and never above the
+        # time-INDEPENDENT optimum of the min-matrix
+        r = np.random.default_rng(40)
+        n = 7
+        base = euclid(r, n)
+        factors = np.array([1.0, 1.4, 0.8])
+        slices = base[None] * factors[:, None, None]
+        demands = [0] + [1] * (n - 1)
+        inst = make_instance(
+            slices, demands=demands, capacities=[3.0, 3.0, 3.0],
+            slice_axis="first",
+        )
+        lb = lower_bound(inst)
+        assert lb > 0  # no longer vacuous
+        # the min-matrix instance's exact optimum caps the bound
+        inst_min = make_instance(
+            slices.min(axis=0), demands=demands, capacities=[3.0, 3.0, 3.0]
+        )
+        opt_min = float(solve_vrp_bf(inst_min).cost)
+        assert lb <= opt_min * (1 + 1e-5) + 1e-4
+        # and the true TD optimum is >= the min-matrix optimum >= lb
+        opt_td = float(solve_vrp_bf(inst).cost)
+        assert lb <= opt_td * (1 + 1e-5) + 1e-4
+
     def test_certified_gap_is_conservative(self, rng):
         r = np.random.default_rng(30)
         n = 7

@@ -26,9 +26,11 @@ polynomial relaxations:
     Lagrangian ascent on node potentials (Held & Karp 1970), typically
     within ~1% of the optimum on Euclidean instances; symmetric only.
 
-`lower_bound` returns the best applicable max of these. Validity is
-pinned by tests against the exact BF/Held-Karp oracles on small
-instances (tests/test_bounds.py).
+`lower_bound` returns the best applicable max of these. Time-dependent
+instances are certified against the elementwise cheapest slice (every
+leg costs at least that — valid, somewhat looser). Validity is pinned
+by tests against the exact BF/Held-Karp oracles on small instances
+(tests/test_bounds.py).
 """
 
 from __future__ import annotations
@@ -38,20 +40,33 @@ import numpy as np
 from vrpms_tpu.core.instance import BIG, Instance
 
 
+_HOST_CACHE: dict = {}
+
+
 def _host(inst: Instance):
-    d = np.asarray(inst.durations[0], dtype=np.float64)
+    """Host copies of the bound inputs. One certificate calls this from
+    several bounds; a tiny id-keyed cache (last instance only) avoids
+    re-transferring [T,N,N] and re-reducing the slice minimum each time.
+    """
+    key = id(inst.durations)
+    hit = _HOST_CACHE.get(key)
+    # the cached entry holds a reference to the keyed array, so its id
+    # cannot be recycled while cached; the identity check makes a stale
+    # hit impossible even across cache rewrites
+    if hit is not None and hit[0] is inst.durations:
+        return hit[1]
+    if inst.time_dependent:
+        # every leg costs at least its cheapest time slice, so bounds
+        # computed on the elementwise slice-minimum stay valid LBs for
+        # the time-dependent objective (somewhat looser, never wrong)
+        d = np.asarray(inst.durations, dtype=np.float64).min(axis=0)
+    else:
+        d = np.asarray(inst.durations[0], dtype=np.float64)
     demands = np.asarray(inst.demands, dtype=np.float64)
     caps = np.asarray(inst.capacities, dtype=np.float64)
+    _HOST_CACHE.clear()  # keep exactly one entry
+    _HOST_CACHE[key] = (inst.durations, (d, demands, caps))
     return d, demands, caps
-
-
-def _certifiable(inst: Instance) -> bool:
-    """Bounds here read durations slice 0 only; a TIME-DEPENDENT
-    instance may travel on cheaper slices, so slice-0 bounds are NOT
-    lower bounds for it. Every public bound gates on this and returns
-    the vacuous 0.0 rather than a wrong certificate. (A valid TD bound
-    would use the elementwise min over slices — future work.)"""
-    return not inst.time_dependent
 
 
 def _symmetric(d: np.ndarray) -> bool:
@@ -74,8 +89,6 @@ def assignment_lb(inst: Instance) -> float:
     """Assignment-problem relaxation of the VRP digraph (see module
     docstring). Valid for asymmetric matrices and any fleet; capacity
     and connectivity are relaxed, so the bound is safe but not tight."""
-    if not _certifiable(inst):
-        return 0.0
     d, _, caps = _host(inst)
     n = d.shape[0]
     v = len(caps)
@@ -102,8 +115,6 @@ def assignment_lb(inst: Instance) -> float:
 
 def mst_lb(inst: Instance) -> float:
     """Symmetric MST bound (0.0 — vacuous — for asymmetric matrices)."""
-    if not _certifiable(inst):
-        return 0.0
     d, _, _ = _host(inst)
     if not _symmetric(d):
         return 0.0
@@ -153,8 +164,6 @@ def held_karp_1tree_lb(
     w(1-tree) - 2*sum(pi)) sharpens it; the step follows the classic
     degree-subgradient schedule with halving on stall.
     """
-    if not _certifiable(inst):
-        return 0.0
     d, _, _ = _host(inst)
     if not _symmetric(d):
         return 0.0
@@ -206,8 +215,6 @@ def cvrp_forest_lb(inst: Instance, iters: int = 80) -> float:
     ascent on customer potentials (every customer has degree exactly 2)
     sharpens it; every iterate is a valid bound, so the max is kept.
     """
-    if not _certifiable(inst):
-        return 0.0
     d, _, caps = _host(inst)
     if not _symmetric(d):
         return 0.0
@@ -272,8 +279,6 @@ def qroute_lb(inst: Instance, max_units: int = 4096) -> float:
     zero-demand customers would break the per-unit argument, and
     fractional demands the DP indexing).
     """
-    if not _certifiable(inst):
-        return 0.0
     d, demands, caps = _host(inst)
     n = d.shape[0]
     if n <= 2:
@@ -373,8 +378,6 @@ def cmt_qroute_lb(inst: Instance, iters: int = 40, max_units: int = 4096) -> flo
     penalized q-route table. Every iterate is valid; the max is kept.
     Same applicability gates as qroute_lb (positive integer demands).
     """
-    if not _certifiable(inst):
-        return 0.0
     d, demands, caps = _host(inst)
     n = d.shape[0]
     if n <= 2:

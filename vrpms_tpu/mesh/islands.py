@@ -46,7 +46,12 @@ from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
 from vrpms_tpu.moves import knn_table
 from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
-from vrpms_tpu.solvers.ga import GAParams, ga_generation, initial_perms
+from vrpms_tpu.solvers.ga import (
+    GAParams,
+    ga_generation,
+    immigrants_for,
+    initial_perms,
+)
 from vrpms_tpu.solvers.sa import (
     SAParams,
     _auto_temps,
@@ -593,8 +598,6 @@ def solve_ga_islands(
         elite = jax.vmap(lambda p: greedy_split_giant(p, inst))(
             pool_perms[order]
         )
-    from vrpms_tpu.solvers.ga import immigrants_for
-
     per_gen = pop_local + immigrants_for(local_params, pop_local, inst.n_customers)
     return SolveResult(
         giant,
