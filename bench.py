@@ -208,6 +208,42 @@ def _family_polish(device):
     }
 
 
+def _family_quality(device):
+    """Cost-at-10 s on synth X-n200 — the north-star budget metric
+    (BASELINE.json: <=2% of best-known in <10 s on one chip), measured
+    at steady state (one 2 s warm solve loads/compiles the programs,
+    then one clean 10 s-budget ILS solve). Reported relative to the
+    123 s round-1 record (36803)."""
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.solvers.ils import ILSParams, solve_ils
+    from vrpms_tpu.solvers.sa import SAParams
+
+    inst = jax.device_put(synth_cvrp(200, 36, seed=0), device)
+    rounds = 9
+    p = ILSParams.from_budget(
+        rounds, SAParams(n_chains=4096, n_iters=0), rounds * 1536, pool=32
+    )
+    # warm EVERY program the measured run needs (anneal block, polish,
+    # exact eval, ruin reseed): two full small rounds, no deadline (a
+    # deadline-truncated warm run never reaches the reseed)
+    solve_ils(
+        inst,
+        key=99,
+        params=ILSParams.from_budget(
+            2, SAParams(n_chains=4096, n_iters=0), 2 * 512, pool=32
+        ),
+    )
+    t0 = time.perf_counter()
+    res = solve_ils(inst, key=0, params=p, deadline_s=10.0)
+    el = time.perf_counter() - t0
+    cost = float(res.breakdown.distance)
+    return {
+        "cost_at_10s": round(cost, 1),
+        "solve_seconds": round(el, 2),
+        "vs_round1_123s_record_pct": round(100 * (cost / 36803.0 - 1), 2),
+    }
+
+
 def main():
     from vrpms_tpu.utils import enable_compile_cache
 
@@ -246,6 +282,9 @@ def main():
         "delta_polish": _family_polish,
         "time_dependent": _family_td,
     }
+    if platform != "cpu":
+        # the 4096-chain ILS budget solve is minutes per block on CPU
+        fam_fns["quality_at_10s"] = _family_quality
     for fam, fn in fam_fns.items():
         try:
             t0 = time.perf_counter()

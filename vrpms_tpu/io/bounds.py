@@ -50,11 +50,18 @@ def _host(inst: Instance):
     """
     key = id(inst.durations)
     hit = _HOST_CACHE.get(key)
-    # the cached entry holds a reference to the keyed array, so its id
-    # cannot be recycled while cached; the identity check makes a stale
-    # hit impossible even across cache rewrites
-    if hit is not None and hit[0] is inst.durations:
-        return hit[1]
+    # the cached entry holds references to ALL keyed arrays (so their
+    # ids cannot be recycled while cached), and the identity checks
+    # cover every field the cached value derives from — a replace()'d
+    # Instance sharing durations but differing in demands/capacities
+    # must miss, or the certificate could be built from stale inputs
+    if (
+        hit is not None
+        and hit[0] is inst.durations
+        and hit[1] is inst.demands
+        and hit[2] is inst.capacities
+    ):
+        return hit[3]
     if inst.time_dependent:
         # every leg costs at least its cheapest time slice, so bounds
         # computed on the elementwise slice-minimum stay valid LBs for
@@ -65,7 +72,9 @@ def _host(inst: Instance):
     demands = np.asarray(inst.demands, dtype=np.float64)
     caps = np.asarray(inst.capacities, dtype=np.float64)
     _HOST_CACHE.clear()  # keep exactly one entry
-    _HOST_CACHE[key] = (inst.durations, (d, demands, caps))
+    _HOST_CACHE[key] = (
+        inst.durations, inst.demands, inst.capacities, (d, demands, caps)
+    )
     return d, demands, caps
 
 
