@@ -36,21 +36,30 @@ _CHUNK_BATCHES = 32  # ~262k orders between host deadline checks
 def _perm_from_index(idx: jax.Array, n: int) -> jax.Array:
     """Lehmer decode: index in [0, n!) -> permutation of 0..n-1.
 
-    Static n (<= MAX_BF_CUSTOMERS) keeps the selection loop unrolled;
-    each step picks the d-th not-yet-used element via a cumulative count.
+    Static n (<= MAX_BF_CUSTOMERS) keeps the selection loop unrolled.
+    Each step picks the d-th smallest unused element by indexing into a
+    sorted list of available ids, then deletes it with a roll+select
+    shift. An earlier formulation tracked a `used` bool mask and picked
+    via argmax(cumsum(~used) rank == d); XLA:TPU miscompiles that
+    bool-cumsum/argmax/scatter chain at wide vmap batches (measured: 85%
+    of rows decode with repeated elements at batch 8192 on v5e, while
+    CPU is correct at every width) — the gather/roll form avoids the
+    fragile pattern entirely and is equivalence-tested against the host
+    decode on-device (tests/test_bf_local_search.py).
     """
     facts = [math.factorial(k) for k in range(n)]
-    used = jnp.zeros(n, dtype=jnp.bool_)
+    avail = jnp.arange(n, dtype=jnp.int32)  # unused ids, ascending
+    pos = jnp.arange(n, dtype=jnp.int32)
     out = []
     rem = idx
     for i in range(n):
         f = facts[n - 1 - i]
         d = (rem // f).astype(jnp.int32)
         rem = rem % f
-        avail_rank = jnp.cumsum(~used) - 1  # rank among unused, -1 if used
-        choice = jnp.argmax((~used) & (avail_rank == d))
-        out.append(choice)
-        used = used.at[choice].set(True)
+        out.append(avail[d])
+        # delete element d: shift the tail left by one
+        shifted = jnp.roll(avail, -1)
+        avail = jnp.where(pos >= d, shifted, avail)
     return jnp.stack(out).astype(jnp.int32)
 
 
@@ -264,7 +273,16 @@ def solve_vrp_bf(
     if full:
         giant = greedy_split_giant(perm, inst)
     else:
-        routes = optimal_split_routes(perm, inst)
-        giant = giant_from_routes(routes, n, inst.n_vehicles)
+        # A deadline-truncated enumeration can stop before ANY scored
+        # order had a capacity-feasible split (tight het fleets): its
+        # best_idx then carries an inf score and optimal_split_routes
+        # would raise. Fall back to the greedy split of that order — a
+        # penalized best-effort result, matching every other solver's
+        # deadline contract (ADVICE round 2).
+        try:
+            routes = optimal_split_routes(perm, inst)
+            giant = giant_from_routes(routes, n, inst.n_vehicles)
+        except ValueError:
+            giant = greedy_split_giant(perm, inst)
     bd = evaluate_giant(giant, inst)
     return SolveResult(giant, total_cost(bd, w), bd, jnp.int32(scored))
