@@ -85,6 +85,7 @@ _SLOW_PATTERNS = (
     "test_makespan.py::TestServiceMakespan",
     "test_warmstart.py::TestWarmStartHTTP",
     "test_utils_info.py::TestSolveInfo",
+    "test_fixtures.py::TestSolverBand",
 )
 
 

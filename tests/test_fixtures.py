@@ -1,0 +1,92 @@
+"""Three-way cross-checks for the embedded public benchmark fixtures.
+
+The fixtures in vrpms_tpu/io/fixtures/ are hand-embedded transcriptions of
+public benchmark data (zero-egress container), so each one is defended
+against transcription error (VERDICT round-2 item 1):
+
+  (a) self-consistency — demand totals vs capacity arithmetic, the `-kV`
+      fleet matching the bin-packing minimum, symmetric nint() matrices,
+      sane time windows;
+  (b) lower_bound(inst) <= BKS — a violated LB proves bad data;
+  (c) the solver lands INSIDE [BKS, 1.2*BKS] — strictly better than the
+      published optimum proves bad data just as surely as way worse
+      proves a bad solver. (ILS hits E-n22-k4=375, A-n32-k5=784 and
+      C101.25=191.3 exactly; see also test_exact.py's branch-and-bound
+      optimality proofs of the CVRP fixtures.)
+"""
+
+import numpy as np
+import pytest
+
+from vrpms_tpu.io import bounds
+from vrpms_tpu.io.fixtures import FIXTURES, fixture_names, load_fixture
+from vrpms_tpu.solvers import ILSParams, SAParams, solve_ils
+
+
+class TestSelfConsistency:
+    @pytest.mark.parametrize("name", fixture_names())
+    def test_loads_and_shapes(self, name):
+        inst, meta = load_fixture(name)
+        assert meta["bks"] > 0
+        d = np.asarray(inst.durations[0])
+        assert d.shape == (inst.n_nodes, inst.n_nodes)
+        assert np.allclose(np.diag(d), 0.0)
+        assert np.allclose(d, d.T)  # EUC_2D is symmetric
+        assert float(np.asarray(inst.demands)[0]) == 0.0  # depot
+
+    @pytest.mark.parametrize(
+        "name", [n for n in fixture_names() if FIXTURES[n][1] == "cvrp"]
+    )
+    def test_cvrp_fleet_is_binpacking_minimum(self, name):
+        # the registry only admits CVRP instances whose -kV fleet equals
+        # the bin-packing minimum: that is what makes the published
+        # fixed-fleet optimum comparable to this framework's
+        # idle-vehicles-allowed objective (see fixtures.py on P-n16-k8)
+        inst, meta = load_fixture(name)
+        assert inst.n_vehicles == meta["bks_vehicles"]
+        assert bounds.route_count_lb(inst) == inst.n_vehicles
+        caps = np.asarray(inst.capacities)
+        dem = np.asarray(inst.demands)
+        assert dem.sum() <= caps.sum()
+        assert dem.max() <= caps.max()
+
+    @pytest.mark.parametrize(
+        "name", [n for n in fixture_names() if FIXTURES[n][1] == "vrptw"]
+    )
+    def test_solomon_windows_sane(self, name):
+        inst, meta = load_fixture(name)
+        ready = np.asarray(inst.ready)
+        due = np.asarray(inst.due)
+        service = np.asarray(inst.service)
+        assert (ready <= due).all()
+        assert (due[1:] <= due[0]).all()  # depot horizon dominates
+        assert (service[1:] > 0).all() and service[0] == 0
+        # every customer individually reachable within its window from a
+        # depot start at time 0 (else the instance would be infeasible)
+        d = np.asarray(inst.durations[0])
+        assert (d[0, 1:] <= due[1:]).all()
+
+    @pytest.mark.parametrize("name", fixture_names())
+    def test_lower_bound_at_most_bks(self, name):
+        inst, meta = load_fixture(name)
+        lb = bounds.lower_bound(inst)
+        assert 0 < lb <= meta["bks"] + 1e-6
+
+
+class TestSolverBand:
+    """Slow: a short ILS must land in [BKS, 1.2*BKS] on every fixture."""
+
+    @pytest.mark.parametrize("name", fixture_names())
+    def test_ils_band(self, name):
+        inst, meta = load_fixture(name)
+        params = ILSParams(
+            rounds=2,
+            sa=SAParams(n_chains=256, n_iters=2500),
+            pool=16,
+            polish_sweeps=64,
+        )
+        res = solve_ils(inst, key=0, params=params)
+        cost = float(res.cost)
+        bks = meta["bks"]
+        assert cost >= bks - 1e-4, f"{name}: {cost} BEATS published BKS {bks} — bad data"
+        assert cost <= 1.2 * bks, f"{name}: {cost} too far above BKS {bks}"

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 # instance name (as in the file's NAME field, lowercased) -> BKS distance
 BEST_KNOWN: dict[str, float] = {
-    "a-n32-k5": 784.0,
+    "e-n22-k4": 375.0,  # embedded fixture; optimum re-proven by solve_cvrp_bnb
+    "a-n32-k5": 784.0,  # embedded fixture
     "a-n33-k5": 661.0,
     "a-n36-k5": 799.0,
     "a-n45-k6": 944.0,
@@ -30,6 +31,10 @@ BEST_KNOWN: dict[str, float] = {
     "c101": 828.94,
     "c201": 591.56,
     "rc101": 1696.95,
+    # 25-customer Solomon subsets (exact optima, Kohl et al.) — embedded
+    # as fixtures (io/fixtures.py)
+    "r101.25": 617.1,
+    "c101.25": 191.3,
 }
 
 
