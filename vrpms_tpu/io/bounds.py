@@ -41,6 +41,7 @@ from vrpms_tpu.core.instance import BIG, Instance
 
 
 _HOST_CACHE: dict = {}
+_WARNED_NO_SCIPY = False
 
 
 def _host(inst: Instance):
@@ -117,7 +118,20 @@ def assignment_lb(inst: Instance) -> float:
         return float(c[rows, cols].sum())
     except ImportError:  # pragma: no cover - scipy is present in CI
         # degenerate fallback: cheapest out-arc per customer (the AP
-        # without the one-in-arc constraint) — still a valid LB
+        # without the one-in-arc constraint) — still a valid LB, but a
+        # much weaker one, which silently loosens every certified gap;
+        # warn ONCE so the degradation is visible (ADVICE round 2)
+        global _WARNED_NO_SCIPY
+        if not _WARNED_NO_SCIPY:
+            _WARNED_NO_SCIPY = True
+            import sys
+
+            print(
+                "vrpms_tpu.io.bounds: scipy unavailable — assignment_lb "
+                "degrades to the cheapest-out-arc bound; certified gaps "
+                "will be much looser (pip install scipy to fix)",
+                file=sys.stderr,
+            )
         out = np.where(np.eye(n, dtype=bool), np.inf, d)[1:, :].min(axis=1)
         return float(out.sum())
 
@@ -272,6 +286,31 @@ def cvrp_forest_lb(inst: Instance, iters: int = 80) -> float:
     return float(best_bound)
 
 
+def _scaled_demands(demands, caps, max_units: int):
+    """(dem_s, cap_s, total_s) with demands/capacity divided by their gcd,
+    or None when the q-route machinery does not apply (non-integer or
+    non-positive demands, or a scaled capacity beyond max_units).
+
+    The gcd reduction is what makes unit-indexed DP tables practical for
+    instances like E-n22-k4 (demands in hundreds, capacity 6000 -> scaled
+    capacity 60): every route load is a multiple of g, so states are
+    exact, not approximated. A capacity not divisible by g rounds DOWN
+    (floor(cap/g) scaled units is exactly what a route can carry).
+    """
+    dem = demands[1:]
+    if len(dem) == 0 or not np.allclose(dem, np.round(dem)):
+        return None
+    dem_i = np.round(dem).astype(np.int64)
+    if (dem_i < 1).any():
+        return None
+    g = int(np.gcd.reduce(dem_i))
+    cap_s = int(np.floor(caps.max() / g))
+    dem_s = (dem_i // g).astype(int)
+    if cap_s < int(dem_s.max()) or cap_s > max_units:
+        return None
+    return dem_s, cap_s, int(dem_s.sum())
+
+
 def qroute_lb(inst: Instance, max_units: int = 4096) -> float:
     """Capacity-aware q-route lower bound (Christofides-Mingozzi-Toth).
 
@@ -292,24 +331,19 @@ def qroute_lb(inst: Instance, max_units: int = 4096) -> float:
     n = d.shape[0]
     if n <= 2:
         return 0.0
-    dem = demands[1:]
-    if not np.allclose(dem, np.round(dem)):
+    scaled = _scaled_demands(demands, caps, max_units)
+    if scaled is None:
         return 0.0
-    dem_i = np.round(dem).astype(int)
-    if (dem_i < 1).any():
-        return 0.0
-    q_max = int(np.floor(caps.max()))
-    if q_max < int(dem_i.max()) or q_max > max_units:
-        return 0.0
+    dem_s, q_max, total_s = scaled
     k = n - 1  # customers
-    route_q, _ = _qroute_table(d, dem_i, q_max, np.zeros(k), want_visits=False)
+    route_q, _ = _qroute_table(d, dem_s, q_max, np.zeros(k), want_visits=False)
     qs = np.arange(q_max + 1, dtype=np.float64)
     with np.errstate(invalid="ignore", divide="ignore"):
         ratios = route_q[1:] / qs[1:]
     finite = np.isfinite(ratios)
     if not finite.any():
         return 0.0
-    return float(ratios[finite].min() * dem_i.sum())
+    return float(ratios[finite].min() * total_s)
 
 
 def _qroute_table(d, dem_i, q_max, lam, want_visits: bool = True):
@@ -372,10 +406,42 @@ def _qroute_table(d, dem_i, q_max, lam, want_visits: bool = True):
     return route_q, visits
 
 
-def cmt_qroute_lb(inst: Instance, iters: int = 40, max_units: int = 4096) -> float:
+def _combo_bound(route_q, total: int, r_lo: int, r_hi: int):
+    """(best_val, best_r, choices): min total cost of r in [r_lo, r_hi]
+    q-routes whose loads sum to exactly `total`, by min-plus DP over the
+    per-load route costs; `choices` backtracks one optimal combo."""
+    G = np.full(total + 1, np.inf)
+    G[0] = 0.0
+    finite_q = [q for q in range(1, len(route_q)) if np.isfinite(route_q[q])]
+    choices = []
+    best_val, best_r = np.inf, -1
+    for r in range(1, r_hi + 1):
+        Gn = np.full(total + 1, np.inf)
+        choice = np.full(total + 1, -1, dtype=int)
+        for q in finite_q:
+            u = np.arange(q, total + 1)
+            cand = G[u - q] + route_q[q]
+            better = cand < Gn[u]
+            Gn[u] = np.where(better, cand, Gn[u])
+            choice[u] = np.where(better, q, choice[u])
+        choices.append(choice)
+        G = Gn
+        if r >= r_lo and np.isfinite(G[total]) and G[total] < best_val:
+            best_val, best_r = float(G[total]), r
+    return best_val, best_r, choices
+
+
+def cmt_qroute_ascent(
+    inst: Instance,
+    iters: int = 60,
+    max_units: int = 4096,
+    ub: float | None = None,
+):
     """Christofides-Mingozzi-Toth q-route bound with route-combination
     DP and Lagrangian ascent on customer penalties — the strongest
-    capacity-aware bound here.
+    capacity-aware bound here. Returns None when inapplicable, else a
+    dict with the bound AND the artifacts the branch-and-bound pruner
+    reuses (best multipliers, scaled demands).
 
     For penalties lam (free sign), a real solution costs
         cost = cost_lam - sum(lam)        (every customer has 1 in-arc)
@@ -385,59 +451,56 @@ def cmt_qroute_lb(inst: Instance, iters: int = 40, max_units: int = 4096) -> flo
                 - sum(lam)
     — computed exactly by a (routes x units) min-plus DP over the
     penalized q-route table. Every iterate is valid; the max is kept.
-    Same applicability gates as qroute_lb (positive integer demands).
+
+    Step management (VERDICT round-2: the old ascent was flat — it
+    descended, its subgradient had the wrong sign: dL/dlam_j at the
+    minimizing combo is visits_j - 1, so an OVER-visited customer must
+    get MORE expensive): Polyak steps theta*(ub - L)/||g||^2 against an
+    upper bound `ub` (any feasible cost — the incumbent being
+    certified; absent, 1.5x the best bound so far stands in), theta
+    decayed on stall. Multipliers are clamped to
+    lam_j >= -0.95 * min-in-arc(j): a more negative penalty would make
+    some arc profitable to cycle through, visits would explode, and one
+    overshooting step could collapse the iterate permanently (measured:
+    unclamped, one step sent the E-n22-k4 bound from 232 to -22000 with
+    no recovery). Demands/capacity are gcd-scaled (_scaled_demands),
+    which is what makes hundred-unit-demand instances (E-n22-k4,
+    scaled capacity 60) tractable.
     """
     d, demands, caps = _host(inst)
     n = d.shape[0]
     if n <= 2:
-        return 0.0
-    dem = demands[1:]
-    if not np.allclose(dem, np.round(dem)):
-        return 0.0
-    dem_i = np.round(dem).astype(int)
-    if (dem_i < 1).any():
-        return 0.0
-    q_max = int(np.floor(caps.max()))
-    if q_max < int(dem_i.max()) or q_max > max_units:
-        return 0.0
+        return None
+    scaled = _scaled_demands(demands, caps, max_units)
+    if scaled is None:
+        return None
+    dem_s, q_max, total = scaled
     k = n - 1
-    total = int(dem_i.sum())
     r_hi = min(len(caps), k)
     r_lo = min(route_count_lb(inst), r_hi)
+    in_arcs = d[:, 1:]
+    lam_lo = -(np.where(in_arcs > 0, in_arcs, np.inf).min(axis=0)) * 0.95
+    lam_hi = float(d.max()) * 2.0
     lam = np.zeros(k)
-    best_bound = 0.0
-    step = 0.5 * float(np.mean(d[d > 0]))
+    best_bound, best_lam = 0.0, lam.copy()
+    theta = 0.5
+    stall = 0
     for _ in range(iters):
-        route_q, visits = _qroute_table(d, dem_i, q_max, lam)
-        # combo DP: G_r[u] = min cost of EXACTLY r q-routes covering u
-        # units; choices kept per round for one backtrack at the end
-        G = np.full(total + 1, np.inf)
-        G[0] = 0.0
-        finite_q = [
-            q for q in range(1, q_max + 1) if np.isfinite(route_q[q])
-        ]
-        choices = []
-        best_val, best_r = np.inf, -1
-        for r in range(1, r_hi + 1):
-            Gn = np.full(total + 1, np.inf)
-            choice = np.full(total + 1, -1, dtype=int)
-            for q in finite_q:
-                u = np.arange(q, total + 1)
-                cand = G[u - q] + route_q[q]
-                better = cand < Gn[u]
-                Gn[u] = np.where(better, cand, Gn[u])
-                choice[u] = np.where(better, q, choice[u])
-            choices.append(choice)
-            G = Gn
-            if r >= r_lo and np.isfinite(G[total]) and G[total] < best_val:
-                best_val, best_r = float(G[total]), r
+        route_q, visits = _qroute_table(d, dem_s, q_max, lam)
+        best_val, best_r, choices = _combo_bound(route_q, total, r_lo, r_hi)
         if not np.isfinite(best_val):
             break
         bound = best_val - float(lam.sum())
-        if bound > best_bound:
-            best_bound = bound
+        if bound > best_bound + 1e-9:
+            best_bound, best_lam = bound, lam.copy()
+            stall = 0
         else:
-            step *= 0.85
+            stall += 1
+            if stall >= 5:
+                theta *= 0.6
+                stall = 0
+        if theta < 1e-4:
+            break
         # backtrack the winning combo once for the visit subgradient
         total_visits = np.zeros(k)
         u, ok = total, True
@@ -450,19 +513,123 @@ def cmt_qroute_lb(inst: Instance, iters: int = 40, max_units: int = 4096) -> flo
             u -= q
         if not ok:
             break
-        g = 1.0 - total_visits  # every customer should be visited once
-        if not g.any():
+        g = total_visits - 1.0  # dL/dlam: over-visited -> raise the price
+        gnorm2 = float(g @ g)
+        if gnorm2 == 0.0:
             break
-        lam = lam + step * g
-    return float(best_bound)
+        target = (ub if ub is not None else 1.5 * max(best_bound, 1e-6)) - bound
+        lam = np.clip(lam + theta * max(target, 1e-6) / gnorm2 * g, lam_lo, lam_hi)
+    return {
+        "bound": float(best_bound),
+        "lam": best_lam,
+        "dem_s": dem_s,
+        "cap_s": q_max,
+        "total_s": total,
+        "r_lo": r_lo,
+        "r_hi": r_hi,
+    }
 
 
-def lower_bound(inst: Instance) -> float:
+def cmt_qroute_lb(
+    inst: Instance,
+    iters: int = 60,
+    max_units: int = 4096,
+    ub: float | None = None,
+) -> float:
+    """The CMT q-route bound value (see cmt_qroute_ascent); 0.0 when the
+    machinery does not apply."""
+    out = cmt_qroute_ascent(inst, iters=iters, max_units=max_units, ub=ub)
+    return 0.0 if out is None else out["bound"]
+
+
+def qpath_completion_tables(inst: Instance, lam: np.ndarray, max_units: int = 4096):
+    """Per-node pruning tables for the branch-and-bound, from root
+    multipliers `lam` -> (R, Psi) or None when inapplicable.
+
+    R[q, i] (i = customer index 1..n-1, column i-1) is a relaxed min
+    cost of a walk  i -> ... -> depot  that collects q more scaled
+    demand units, each entered customer k contributing lam[k]. Psi[m, u]
+    is the min cost of at most m closed penalized q-routes covering u
+    units. Any true completion of a partial solution (finish the open
+    route from position p with q1 more units, then run <= m fresh
+    routes over the remaining demand) therefore costs at least
+
+        min_{q1} R[q1, p] + Psi[m, dem_left - q1]  -  sum_{j in S} lam_j
+
+    because the completion visits each remaining customer exactly once
+    (collecting its lam) and both walk families are relaxations over
+    ALL customers — restriction to S only raises the true cost. The
+    subtraction term is maintained incrementally by the search.
+    """
+    d, demands, caps = _host(inst)
+    scaled = _scaled_demands(demands, caps, max_units)
+    if scaled is None:
+        return None
+    dem_s, cap_s, total = scaled
+    n = d.shape[0]
+    k = n - 1
+    # R by reverse DP over walks ending at the depot, WITH 2-cycle
+    # elimination (the classic best/second-best trick): the walk chosen
+    # from j must not immediately hop back to i, so each state keeps its
+    # best value A, that walk's first hop F, and the best value B among
+    # walks with a DIFFERENT first hop; extending i -> j reads B when
+    # F[j] == i. Without this, cheap i<->j ping-pongs dominate the table
+    # and the bound loses most of its bite at exactly the depths the
+    # branch-and-bound needs it.
+    A = np.full((cap_s + 1, k), np.inf)  # best walk value
+    F = np.full((cap_s + 1, k), -1, dtype=int)  # its first hop (customer col)
+    B = np.full((cap_s + 1, k), np.inf)  # best with a different first hop
+    A[0] = d[1:, 0]  # straight home (no hop: F = -1 matches no i)
+    B[0] = d[1:, 0]
+    dc = d[1:, 1:] + lam[None, :]  # entering customer j costs lam[j]
+    rows = np.arange(k)
+    cand = np.empty((k, k))
+    for q in range(1, cap_s + 1):
+        cand[:] = np.inf
+        for dv in np.unique(dem_s):
+            qp = q - int(dv)
+            if qp < 0:
+                continue
+            js = np.where(dem_s == dv)[0]
+            # extend: i -> j (j collects dv units), then best walk from j
+            # whose first hop is not i
+            vals = np.where(
+                F[qp, js][None, :] == rows[:, None], B[qp, js][None, :],
+                A[qp, js][None, :],
+            ) + dc[:, js]
+            vals[js, np.arange(len(js))] = np.inf  # no i -> i
+            cand[:, js] = vals
+        best_j = np.argmin(cand, axis=1)
+        A[q] = cand[rows, best_j]
+        F[q] = np.where(np.isfinite(A[q]), best_j, -1)
+        cand[rows, best_j] = np.inf
+        B[q] = cand.min(axis=1)
+    R = A
+    # closed penalized q-routes and their <=m-combo DP
+    route_q, _ = _qroute_table(d, dem_s, cap_s, lam, want_visits=False)
+    r_hi = min(len(caps), k)
+    G = np.full((r_hi + 1, total + 1), np.inf)
+    G[0, 0] = 0.0
+    finite_q = [q for q in range(1, cap_s + 1) if np.isfinite(route_q[q])]
+    for r in range(1, r_hi + 1):
+        G[r] = G[r - 1]
+        for q in finite_q:
+            # slice (not fancy-index) assignment: out= into G[r, u] with an
+            # index array would write a temporary copy, leaving G untouched
+            G[r, q:] = np.minimum(G[r, q:], G[r - 1, : total + 1 - q] + route_q[q])
+    # G[r] is already "at most r routes" (the copy-forward above), i.e. Psi
+    return R, G
+
+
+def lower_bound(inst: Instance, ub: float | None = None) -> float:
     """Best applicable lower bound on the total-distance objective.
 
     TSP (single BIG-capacity vehicle): Held-Karp 1-tree (symmetric) or
-    the AP relaxation (asymmetric). VRP: max of the AP relaxation and
-    the symmetric MST bound.
+    the AP relaxation (asymmetric). VRP: max of the AP relaxation, the
+    symmetric MST bound, the Lagrangian forest bound, and the CMT
+    q-route bound (the only capacity-aware one; its ascent is Polyak-
+    stepped when a feasible cost `ub` is supplied, which is how the
+    certificate path calls it).
     """
     d, _, caps = _host(inst)
     tsp = len(caps) == 1 and caps[0] >= BIG / 2
@@ -472,17 +639,15 @@ def lower_bound(inst: Instance) -> float:
     else:
         bounds.append(mst_lb(inst))
         bounds.append(cvrp_forest_lb(inst))
-        # qroute_lb / cmt_qroute_lb are valid too but measured dominated
-        # by the Lagrangian forest bound on every benchmarked shape
-        # (synth X-n200: forest 19.3k vs q-route 10.2k); they stay
-        # available for instances where capacity, not geometry, binds.
+        bounds.append(cmt_qroute_lb(inst, ub=ub))
     return float(max(bounds))
 
 
 def certified_gap_percent(cost: float, inst: Instance) -> float | None:
     """Certified upper bound (percent) on this cost's optimality gap:
-    gap_true <= (cost - LB) / LB. None when the bound is vacuous."""
-    lb = lower_bound(inst)
+    gap_true <= (cost - LB) / LB. None when the bound is vacuous. The
+    cost being certified doubles as the ascent's Polyak upper bound."""
+    lb = lower_bound(inst, ub=float(cost))
     if lb <= 0:
         return None
     return 100.0 * (float(cost) - lb) / lb
