@@ -46,6 +46,7 @@ from vrpms_tpu.solvers import (
     solve_ga,
     solve_sa,
     solve_tsp_bf,
+    solve_tsp_exact,
     solve_vrp_bf,
 )
 
@@ -282,9 +283,41 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
         if not _polish_enabled(opts):
             pool = 0
         if algorithm == "bf":
+            # The exact ladder behind the BF endpoints (the reference's
+            # gurobipy pin signals exact intent beyond enumeration):
+            # enumeration to 10 customers, then Held-Karp DP (TSP, to
+            # 16) / q-route branch-and-bound (CVRP, to ~32) — all exact
+            # when they finish; B&B honors timeLimit (default 60 s) and
+            # returns its best-effort incumbent when cut short.
             deadline = _deadline(opts)
+            from vrpms_tpu.solvers.bf import MAX_BF_CUSTOMERS
+
+            untimed = not inst.has_tw and not inst.time_dependent
             if problem == "tsp":
+                from vrpms_tpu.solvers.exact import MAX_EXACT_CUSTOMERS
+
+                if (
+                    MAX_BF_CUSTOMERS < inst.n_customers <= MAX_EXACT_CUSTOMERS
+                    and untimed
+                    and not w.use_makespan
+                ):
+                    return solve_tsp_exact(inst, weights=w)
                 return solve_tsp_bf(inst, weights=w, deadline_s=deadline)
+            from vrpms_tpu.solvers.exact import MAX_BNB_CUSTOMERS, solve_cvrp_bnb
+
+            if (
+                MAX_BF_CUSTOMERS < inst.n_customers <= MAX_BNB_CUSTOMERS
+                and untimed
+                and not inst.het_fleet
+                and not w.use_makespan
+            ):
+                # explicit timeLimit 0 means "stop ASAP" (same semantics
+                # as _deadline everywhere else), not "no limit"
+                res, _proven, _stats = solve_cvrp_bnb(
+                    inst, weights=w,
+                    time_limit_s=60.0 if deadline is None else deadline,
+                )
+                return res
             return solve_vrp_bf(inst, weights=w, deadline_s=deadline)
         if algorithm == "sa":
             p = SAParams(

@@ -52,3 +52,101 @@ class TestHeldKarp:
         timed = random_instance(rng, n=5, v=1, tw=True)
         with pytest.raises(ValueError, match="time"):
             solve_tsp_exact(timed)
+
+
+class TestBranchAndBound:
+    """solve_cvrp_bnb vs the BF oracle, plus the fixture optimality
+    proofs that pin the embedded public instances (VERDICT r2 item 3)."""
+
+    def test_matches_bf_random(self, rng):
+        from vrpms_tpu.solvers import solve_vrp_bf
+        from vrpms_tpu.solvers.exact import solve_cvrp_bnb
+
+        for _ in range(4):
+            n = int(rng.integers(5, 9))
+            V = int(rng.integers(2, 4))
+            pts = rng.uniform(0, 100, (n + 1, 2))
+            d = np.linalg.norm(pts[:, None] - pts[None], axis=-1)
+            dem = np.concatenate([[0], rng.integers(1, 10, n)])
+            cap = float(max(dem.max(), int(dem.sum() / V * 1.4)))
+            inst = make_instance(d, demands=dem, capacities=[cap] * V)
+            res, proven, _ = solve_cvrp_bnb(inst)
+            assert proven
+            assert np.isclose(float(res.cost), float(solve_vrp_bf(inst).cost), rtol=1e-5)
+
+    def test_native_matches_python(self, rng):
+        # the C++ DFS and the Python twin walk the same tree definition;
+        # both must land on the identical proven optimum (the Python
+        # engine is the oracle the native one is checked against)
+        from vrpms_tpu.solvers.exact import solve_cvrp_bnb
+
+        for _ in range(3):
+            n = int(rng.integers(8, 13))
+            V = int(rng.integers(2, 4))
+            pts = rng.uniform(0, 100, (n + 1, 2))
+            d = np.linalg.norm(pts[:, None] - pts[None], axis=-1)
+            dem = np.concatenate([[0], rng.integers(1, 10, n)])
+            cap = float(max(dem.max(), int(dem.sum() / V * 1.4)))
+            inst = make_instance(d, demands=dem, capacities=[cap] * V)
+            res_n, proven_n, stats_n = solve_cvrp_bnb(inst)
+            res_p, proven_p, stats_p = solve_cvrp_bnb(inst, use_native=False)
+            assert proven_p and stats_p["engine"] == "python"
+            assert np.isclose(float(res_n.cost), float(res_p.cost), rtol=1e-6)
+            if stats_n["engine"] == "native":  # toolchain present
+                assert proven_n
+
+    def test_cost_only_incumbent_never_claims_proven_fallback(self):
+        # an incumbent COST below anything reachable must not stamp the
+        # NN fallback as a proven optimum (code-review round 3 finding)
+        from vrpms_tpu.io.fixtures import load_fixture
+        from vrpms_tpu.solvers.exact import solve_cvrp_bnb
+
+        inst, _ = load_fixture("E-n22-k4")
+        # 300 < optimum 375: the tree exhausts finding nothing
+        res, proven, stats = solve_cvrp_bnb(
+            inst, time_limit_s=60, incumbent_cost=300.0
+        )
+        assert not proven
+        assert float(res.breakdown.cap_excess) == 0.0  # NN fallback returned
+
+    def test_non_integer_demands_use_ap_path(self, rng):
+        # fractional demands disable the q-route tables; the AP-bound
+        # fallback must still prove small instances
+        from vrpms_tpu.solvers import solve_vrp_bf
+        from vrpms_tpu.solvers.exact import solve_cvrp_bnb
+
+        n, V = 6, 2
+        pts = rng.uniform(0, 100, (n + 1, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None], axis=-1)
+        dem = np.concatenate([[0], rng.uniform(1, 8, n)])
+        cap = float(dem.sum() / V * 1.4)
+        inst = make_instance(d, demands=dem, capacities=[cap] * V)
+        res, proven, stats = solve_cvrp_bnb(inst)
+        assert proven and stats["qroute_bound"] is None
+        assert np.isclose(float(res.cost), float(solve_vrp_bf(inst).cost), rtol=1e-5)
+
+    def test_time_limit_returns_incumbent(self):
+        from vrpms_tpu.io.fixtures import load_fixture
+        from vrpms_tpu.solvers.exact import solve_cvrp_bnb
+
+        inst, _ = load_fixture("A-n32-k5")
+        res, proven, _ = solve_cvrp_bnb(inst, time_limit_s=0.2, incumbent_cost=900.0)
+        # 0.2 s cannot exhaust n=32: must come back unproven with a
+        # capacity-feasible best-effort solution (the NN fallback; the
+        # caller's 900 was a bound, not routes, so it cannot be returned)
+        assert not proven
+        assert float(res.breakdown.cap_excess) == 0.0
+        assert np.isfinite(float(res.breakdown.distance))
+
+    def test_proves_e_n22_k4_optimum(self):
+        # The strongest fixture cross-check there is: the branch-and-bound
+        # proves the embedded E-n22-k4 transcription has optimum exactly
+        # 375 — the published value. A transcription error in coords or
+        # demands would move the proven optimum away from 375.
+        from vrpms_tpu.io.fixtures import load_fixture
+        from vrpms_tpu.solvers.exact import solve_cvrp_bnb
+
+        inst, meta = load_fixture("E-n22-k4")
+        res, proven, stats = solve_cvrp_bnb(inst, time_limit_s=120, incumbent_cost=376.0)
+        assert proven
+        assert float(res.breakdown.distance) == meta["bks"] == 375.0

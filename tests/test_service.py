@@ -467,6 +467,41 @@ class TestVRPSolve:
         visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    def test_bf_dispatches_to_bnb_beyond_enumeration(self, server):
+        # 12 customers is past enumeration's 10-customer bound: the BF
+        # endpoint must dispatch to the exact branch-and-bound and the
+        # served optimum must match a direct proven solve
+        rng = np.random.default_rng(5)
+        pts = rng.uniform(0, 100, size=(13, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        mem.seed_locations(
+            "locs_big",
+            [{"id": i, "name": f"b{i}", "demand": 3 if i else 0} for i in range(13)],
+        )
+        mem.seed_durations("durs_big", d.tolist())
+        status, resp = post(
+            server,
+            "/api/vrp/bf",
+            vrp_body(
+                locationsKey="locs_big",
+                durationsKey="durs_big",
+                capacities=[12, 12, 12, 12],
+                startTimes=[0, 0, 0, 0],
+                timeLimit=60,
+            ),
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        visited = sorted(c for v in msg["vehicles"] for c in v["tour"][1:-1])
+        assert visited == list(range(1, 13))
+        from vrpms_tpu.core import make_instance
+        from vrpms_tpu.solvers.exact import solve_cvrp_bnb
+
+        inst = make_instance(d, demands=[0] + [3] * 12, capacities=[12] * 4)
+        want, proven, _ = solve_cvrp_bnb(inst, time_limit_s=60)
+        assert proven
+        assert abs(msg["durationSum"] - float(want.breakdown.distance)) < 1e-2
+
     def test_aco_islands_and_pool(self, server):
         # ACO honors islands (per-device colonies, elite ring) and
         # localSearchPool (per-island champions polished)
@@ -557,6 +592,35 @@ class TestTSPSolve:
         status, resp = post(server, "/api/tsp/sa", tsp_body(customers=[2, 4, 6]))
         assert status == 200
         assert sorted(resp["message"]["vehicle"][1:-1]) == [2, 4, 6]
+
+    def test_bf_dispatches_to_held_karp_beyond_enumeration(self, server):
+        # 12 customers: enumeration refuses (10!-bound), so the TSP BF
+        # endpoint must route to the Held-Karp subset DP and stay exact
+        rng = np.random.default_rng(6)
+        pts = rng.uniform(0, 100, size=(13, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        mem.seed_locations(
+            "locs_hk", [{"id": i, "name": f"h{i}"} for i in range(13)]
+        )
+        mem.seed_durations("durs_hk", d.tolist())
+        status, resp = post(
+            server,
+            "/api/tsp/bf",
+            tsp_body(
+                locationsKey="locs_hk",
+                durationsKey="durs_hk",
+                customers=list(range(1, 13)),
+            ),
+        )
+        assert status == 200, resp
+        msg = resp["message"]
+        assert sorted(msg["vehicle"][1:-1]) == list(range(1, 13))
+        from vrpms_tpu.core import make_instance
+        from vrpms_tpu.solvers import solve_tsp_exact
+
+        inst = make_instance(d, n_vehicles=1)
+        want = solve_tsp_exact(inst)
+        assert abs(msg["duration"] - float(want.breakdown.distance)) < 1e-2
 
     def test_start_node_nonzero(self, server):
         status, resp = post(

@@ -1,0 +1,122 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The accelerator compute path is JAX/XLA/Pallas; the pieces that are
+irreducibly host-side and irregular — today the exact branch-and-bound's
+tree walk (bnb.cpp) — are C++, compiled on first use into this package
+directory with the image's g++ (no pybind11 in the image; the ABI is a
+flat extern "C" ctypes surface). Everything degrades gracefully: callers
+get None when no toolchain is available and fall back to the Python twin.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "bnb.cpp")
+_LIB = os.path.join(_DIR, "libbnb.so")
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-march=native", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:  # no g++ / hung
+        print(f"vrpms_tpu.native: build unavailable ({e})", file=sys.stderr)
+        return False
+    if proc.returncode != 0:
+        print(
+            f"vrpms_tpu.native: g++ failed:\n{proc.stderr[-2000:]}",
+            file=sys.stderr,
+        )
+        return False
+    return True
+
+
+def load_bnb():
+    """The compiled B&B library, building it if stale; None if impossible."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    fresh = os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+    if not fresh and not _build():
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError as e:  # pragma: no cover - corrupt artifact
+        print(f"vrpms_tpu.native: load failed ({e})", file=sys.stderr)
+        _load_failed = True
+        return None
+    lib.bnb_solve.restype = ctypes.c_int
+    lib.bnb_solve.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_int, ctypes.c_int64,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    _lib = lib
+    return lib
+
+
+def bnb_solve_native(
+    d, dem_s, lam, R, Psi, cap_s, total_s, V,
+    best_cost, time_limit_s, symmetric,
+):
+    """Run the native DFS -> (routes | None, cost, nodes, proven) or None
+    when the library cannot be built/loaded. `routes` is None when the
+    search found nothing better than `best_cost` (the caller keeps its
+    incumbent)."""
+    lib = load_bnb()
+    if lib is None:
+        return None
+    n = len(dem_s)
+    d = np.ascontiguousarray(d, np.float64)
+    dem = np.ascontiguousarray(dem_s, np.int64)
+    lam = np.ascontiguousarray(lam, np.float64)
+    R = np.ascontiguousarray(R, np.float64)
+    Psi = np.ascontiguousarray(Psi, np.float64)
+    out_seq = np.zeros(n + V + 2, np.int32)
+    out_len = ctypes.c_int(0)
+    out_cost = ctypes.c_double(0.0)
+    out_nodes = ctypes.c_int64(0)
+    out_proven = ctypes.c_int(0)
+    rc = lib.bnb_solve(
+        n, V, int(cap_s), d, dem, lam, R, Psi, int(Psi.shape[0]), int(total_s),
+        float(best_cost) if np.isfinite(best_cost) else 1e300,
+        -1.0 if time_limit_s is None else float(time_limit_s),
+        1 if symmetric else 0,
+        out_seq, ctypes.byref(out_len), ctypes.byref(out_cost),
+        ctypes.byref(out_nodes), ctypes.byref(out_proven),
+    )
+    if rc != 0:
+        return None
+    routes = None
+    if out_len.value > 0:
+        routes, cur = [], []
+        for v in out_seq[: out_len.value]:
+            if v == -1:
+                routes.append(cur)
+                cur = []
+            else:
+                cur.append(int(v))
+        routes.append(cur)
+    return routes, float(out_cost.value), int(out_nodes.value), bool(out_proven.value)

@@ -1,0 +1,237 @@
+// Native DFS core for the exact CVRP branch-and-bound.
+//
+// Same search as vrpms_tpu/solvers/exact.py::solve_cvrp_bnb's Python DFS
+// (route-by-route construction, first-customer route ordering, canonical
+// orientation for symmetric matrices, Pareto dominance memo, q-route
+// completion bound) — reimplemented in C++ because the node engine is the
+// whole ballgame: the Python walker sustains ~10-20k nodes/s while n=32
+// proofs need 10^7-10^9 nodes. The Lagrangian tables (R, Psi, lam) are
+// computed once in numpy (io/bounds.py) and passed in read-only; this file
+// owns only the hot tree walk. Built as a shared library and driven via
+// ctypes (no pybind11 in the image).
+//
+// Contract notes mirrored from the Python twin:
+//  * routes open in strictly increasing order of their first customer;
+//  * for symmetric matrices a closed route with >= 2 customers must have
+//    first < last (one orientation per route);
+//  * bound: cost + min_{q1 <= min(slack, dl)} R[q1][p] + Psi[m][dl - q1]
+//           - sum_{j unvisited} lam[j]        (capacity-aware, exact LB);
+//  * dominance: per (unvisited-set, last, open-route-first) a Pareto set
+//    of (cost, slack, vehicles-left) — beaten on all three => prune.
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Ctx {
+  int n;                // customers
+  int V;
+  int64_t cap;          // scaled capacity
+  const double* d;      // (n+1)^2
+  const int64_t* dem;   // n, customer j demand at dem[j-1]
+  const double* lam;    // n
+  const double* R;      // (cap+1) x n
+  const double* Psi;    // (V+1) x (total+1)
+  int64_t total;
+  int psi_rows;         // actual Psi row count = min(V, n)+1 (clamp m)
+  bool symmetric;
+  double best_cost;
+  int64_t nodes;
+  int64_t node_budget;  // deadline check cadence
+  double deadline;      // CLOCK_MONOTONIC seconds; <0 => none
+  bool timed_out;
+  // best solution: customer sequence with route breaks
+  std::vector<int> best_seq;   // route-major customers, -1 between routes
+  std::vector<int> cur_stack;  // same layout while walking
+  struct Dom { double cost; int64_t slack; int m; };
+  std::unordered_map<uint64_t, std::vector<Dom>> memo;
+  size_t memo_cap = 0;  // max stored entries: billion-node searches must
+                        // not eat the host (measured: an uncapped memo on
+                        // a 1.26B-node A-n32-k5 run grew into the GBs and
+                        // took the machine into OOM territory)
+  size_t memo_size = 0;
+};
+
+inline double now_s() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + 1e-9 * double(ts.tv_nsec);
+}
+
+inline double dd(const Ctx& c, int a, int b) {
+  return c.d[a * (c.n + 1) + b];
+}
+
+struct Child { double step; int j; bool opens; };
+
+void dfs(Ctx& c, uint64_t unvis, int p, int first, int64_t slack, int m,
+         double cost, double sum_lam, int64_t dem_left) {
+  if (c.timed_out) return;
+  if (++c.nodes >= c.node_budget) {
+    c.node_budget = c.nodes + 8192;
+    if (c.deadline >= 0 && now_s() > c.deadline) { c.timed_out = true; return; }
+  }
+  if (unvis == 0) {
+    // canonical orientation: first < last for symmetric multi-customer routes
+    if (c.symmetric && p != first && first > p) return;
+    double total_cost = cost + dd(c, p, 0);
+    if (total_cost < c.best_cost - 1e-12) {
+      c.best_cost = total_cost;
+      c.best_seq = c.cur_stack;
+    }
+    return;
+  }
+  if (dem_left > slack + int64_t(m) * c.cap) return;
+  // q-route completion bound
+  {
+    int64_t hi = slack < dem_left ? slack : dem_left;
+    int mrow = m < c.psi_rows - 1 ? m : c.psi_rows - 1;
+    const double* Rp = c.R;             // R[q][p-1]
+    const double* Pm = c.Psi + size_t(mrow) * size_t(c.total + 1);
+    double bound = 1e300;
+    for (int64_t q1 = 0; q1 <= hi; ++q1) {
+      double v = Rp[size_t(q1) * size_t(c.n) + size_t(p - 1)] + Pm[dem_left - q1];
+      if (v < bound) bound = v;
+    }
+    if (cost + bound - sum_lam >= c.best_cost - 1e-9) return;
+  }
+  // dominance memo (bounded: stop inserting past memo_cap — lookups keep
+  // working on what exists, correctness never depends on the memo)
+  {
+    uint64_t key = unvis | (uint64_t(p) << 36) | (uint64_t(first) << 44);
+    auto it = c.memo.find(key);
+    if (it != c.memo.end()) {
+      auto& ent = it->second;
+      for (const auto& e : ent)
+        if (e.cost <= cost + 1e-12 && e.slack >= slack && e.m >= m) return;
+      size_t w = 0;
+      for (size_t i = 0; i < ent.size(); ++i)
+        if (!(cost <= ent[i].cost && slack >= ent[i].slack && m >= ent[i].m))
+          ent[w++] = ent[i];
+      c.memo_size -= ent.size() - w;
+      ent.resize(w);
+      if (ent.size() < 8 && c.memo_size < c.memo_cap) {
+        ent.push_back({cost, slack, m});
+        ++c.memo_size;
+      }
+    } else if (c.memo_size < c.memo_cap) {
+      c.memo[key].push_back({cost, slack, m});
+      ++c.memo_size;
+    }
+  }
+  // children, cheapest first
+  Child kids[80];
+  int nk = 0;
+  uint64_t rest = unvis;
+  while (rest) {
+    int j = __builtin_ctzll(rest) + 1;
+    rest &= rest - 1;
+    if (c.dem[j - 1] <= slack)
+      kids[nk++] = {dd(c, p, j), j, false};
+  }
+  bool can_close =
+      m >= 1 && !(c.symmetric && p != first && first > p);
+  if (can_close) {
+    double close = dd(c, p, 0);
+    rest = unvis;
+    while (rest) {
+      int f = __builtin_ctzll(rest) + 1;
+      rest &= rest - 1;
+      if (f > first && c.dem[f - 1] <= c.cap)
+        kids[nk++] = {close + dd(c, 0, f), f, true};
+    }
+  }
+  // insertion sort by step cost (nk <= ~2n, small)
+  for (int i = 1; i < nk; ++i) {
+    Child x = kids[i];
+    int k = i - 1;
+    while (k >= 0 && kids[k].step > x.step) { kids[k + 1] = kids[k]; --k; }
+    kids[k + 1] = x;
+  }
+  for (int i = 0; i < nk; ++i) {
+    if (c.timed_out) return;
+    double ncost = cost + kids[i].step;
+    if (ncost >= c.best_cost - 1e-9) continue;
+    int j = kids[i].j;
+    uint64_t bit = 1ull << (j - 1);
+    if (kids[i].opens) {
+      c.cur_stack.push_back(-1);
+      c.cur_stack.push_back(j);
+      dfs(c, unvis & ~bit, j, j, c.cap - c.dem[j - 1], m - 1, ncost,
+          sum_lam - c.lam[j - 1], dem_left - c.dem[j - 1]);
+      c.cur_stack.pop_back();
+      c.cur_stack.pop_back();
+    } else {
+      c.cur_stack.push_back(j);
+      dfs(c, unvis & ~bit, j, first, slack - c.dem[j - 1], m, ncost,
+          sum_lam - c.lam[j - 1], dem_left - c.dem[j - 1]);
+      c.cur_stack.pop_back();
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" int bnb_solve(
+    int n, int V, int64_t cap_s,
+    const double* d, const int64_t* dem_s, const double* lam,
+    const double* R, const double* Psi, int psi_rows, int64_t total_s,
+    double best_cost_in, double time_limit_s, int symmetric,
+    // outputs
+    int* out_seq,        // size n + V: customers with -1 route breaks
+    int* out_seq_len,
+    double* out_cost,
+    int64_t* out_nodes,
+    int* out_proven) {
+  if (n < 1 || n > 34) return -1;
+  Ctx c;
+  c.n = n; c.V = V; c.cap = cap_s; c.d = d; c.dem = dem_s; c.lam = lam;
+  c.R = R; c.Psi = Psi; c.total = total_s; c.psi_rows = psi_rows;
+  c.symmetric = symmetric != 0;
+  c.best_cost = best_cost_in;
+  c.nodes = 0; c.node_budget = 8192;
+  c.memo_cap = 30'000'000;  // ~1.5 GB worst case, plenty for the hit rate
+  c.deadline = time_limit_s > 0 ? now_s() + time_limit_s : -1.0;
+  c.timed_out = false;
+  c.cur_stack.reserve(n + V + 2);
+
+  double lam_total = 0;
+  int64_t dem_total = 0;
+  for (int j = 0; j < n; ++j) { lam_total += lam[j]; dem_total += dem_s[j]; }
+
+  // root: every capacity-feasible first customer, nearest first
+  std::vector<std::pair<double, int>> roots;
+  for (int f = 1; f <= n; ++f) {
+    if (dem_s[f - 1] > cap_s) { *out_proven = 0; *out_cost = 1e300;
+      *out_seq_len = 0; *out_nodes = 0; return 1; }  // infeasible customer
+    roots.push_back({dd(c, 0, f), f});
+  }
+  for (size_t i = 1; i < roots.size(); ++i) {  // insertion sort
+    auto x = roots[i]; size_t k = i;
+    while (k > 0 && roots[k - 1].first > x.first) { roots[k] = roots[k - 1]; --k; }
+    roots[k] = x;
+  }
+  uint64_t full = (n == 64) ? ~0ull : ((1ull << n) - 1);
+  for (auto& rf : roots) {
+    if (c.timed_out) break;
+    int f = rf.second;
+    if (rf.first >= c.best_cost) continue;
+    c.cur_stack.clear();
+    c.cur_stack.push_back(f);
+    dfs(c, full & ~(1ull << (f - 1)), f, f, cap_s - dem_s[f - 1], V - 1,
+        rf.first, lam_total - lam[f - 1], dem_total - dem_s[f - 1]);
+  }
+
+  *out_nodes = c.nodes;
+  *out_proven = c.timed_out ? 0 : 1;
+  *out_cost = c.best_cost;
+  int len = int(c.best_seq.size());
+  if (len > n + V) len = n + V;
+  for (int i = 0; i < len; ++i) out_seq[i] = c.best_seq[i];
+  *out_seq_len = len;
+  return 0;
+}
