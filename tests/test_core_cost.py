@@ -187,3 +187,70 @@ class TestEncoding:
         assert giant_length(5, 3) == 9
         g = giant_from_routes([[1, 2, 3, 4, 5]], 5, 1)
         assert g.shape == (7,)
+
+
+class TestTDFactorization:
+    """The time-profile factorization (Instance.td_rank) and the
+    factorized TD hot path it unlocks (core.cost._td_hot_batch)."""
+
+    def _mk(self, rng, slices, n, v=5):
+        dem = np.concatenate([[0], rng.integers(1, 9, n - 1)])
+        return make_instance(
+            slices, demands=dem, capacities=[40.0] * v,
+            slice_axis="first", slice_minutes=45.0,
+        )
+
+    def test_rank_detection(self, rng):
+        n, t = 20, 6
+        base = rng.uniform(5, 60, (n, n))
+        np.fill_diagonal(base, 0)
+        f1 = 1.0 + 0.3 * np.sin(np.linspace(0, 2 * np.pi, t, endpoint=False))
+        inst1 = self._mk(rng, base[None] * f1[:, None, None], n)
+        assert inst1.td_rank == 1
+        base2 = rng.uniform(1, 10, (n, n))
+        np.fill_diagonal(base2, 0)
+        two = np.maximum(
+            base[None] * f1[:, None, None]
+            + base2[None] * (1 + 0.2 * rng.standard_normal(t))[:, None, None],
+            0.0,
+        )
+        two[:, 0, 0] = 0.0
+        inst2 = self._mk(rng, two, n)
+        assert inst2.td_rank == 2
+        full = rng.uniform(5, 60, (t, n, n))
+        assert self._mk(rng, full, n).td_rank == 0  # no exact low-rank form
+
+    def test_factorized_hot_path_matches_td_eval(self, rng):
+        from vrpms_tpu.core.cost import CostWeights, _td_eval, _td_hot_batch, total_cost
+        from vrpms_tpu.core.encoding import random_giant_batch
+
+        n, t = 24, 8
+        base = rng.uniform(5, 60, (n, n))
+        np.fill_diagonal(base, 0)
+        f1 = 1.0 + 0.3 * np.sin(np.linspace(0, 2 * np.pi, t, endpoint=False))
+        inst = self._mk(rng, base[None] * f1[:, None, None], n)
+        assert inst.td_rank == 1
+        w = CostWeights.make()
+        giants = random_giant_batch(jax.random.key(0), 12, n - 1, 5)
+        hot = _td_hot_batch(giants, inst, w)
+        ref = jnp.stack(
+            [total_cost(_td_eval(giants[i], inst), w) for i in range(12)]
+        )
+        # bf16 table rounding is the hot paths' shared precision budget
+        np.testing.assert_allclose(np.asarray(hot), np.asarray(ref), rtol=5e-3)
+
+    def test_factorization_reconstructs_exactly(self, rng):
+        n, t = 16, 5
+        base = rng.uniform(5, 60, (n, n))
+        np.fill_diagonal(base, 0)
+        f1 = 0.5 + rng.uniform(0.1, 1.0, t)
+        inst = self._mk(rng, base[None] * f1[:, None, None], n)
+        assert inst.td_rank >= 1
+        recon = np.einsum(
+            "rt,rnm->tnm",
+            np.asarray(inst.td_factors),
+            np.asarray(inst.td_basis),
+        )
+        np.testing.assert_allclose(
+            recon, np.asarray(inst.durations), rtol=1e-4, atol=1e-3
+        )

@@ -112,16 +112,20 @@ class TestObjectiveHot:
         assert got[0] < got[1]
 
     def test_time_dependent_lean_scan_matches_gather(self, rng):
-        # the TD hot path (one-hot precompute + lean scan with one flat
-        # travel gather per leg) must price exactly like the per-leg
-        # gather walk _td_eval
+        # the TD hot path must price like the per-leg gather walk
+        # _td_eval. T=2 random slices are exactly rank 2, so this
+        # exercises the FACTORIZED path (round 3), whose travel times
+        # carry the same bf16 table rounding as every other one-hot hot
+        # path — hence the bf16-level tolerance. The T=5 test below
+        # (td_rank 0, flat-gather fallback) pins f32-exact pricing.
         slices = rng.uniform(1, 50, size=(2, 6, 6))
         inst = make_instance(slices, n_vehicles=2, slice_axis="first")
+        assert inst.td_rank == 2
         giants = random_giant_batch(jax.random.key(6), 8, 5, 2)
         w = CostWeights.make()
         ref = np.asarray(objective_batch(giants, inst, w))
         got = np.asarray(objective_hot_batch(giants, inst, w))
-        np.testing.assert_allclose(got, ref, rtol=1e-6)
+        np.testing.assert_allclose(got, ref, rtol=5e-3)
 
     def test_time_dependent_with_tw_and_makespan_matches_gather(self, rng):
         # TD + time windows + service + per-vehicle shift starts +

@@ -377,15 +377,17 @@ def _td_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
     (reference src/solver.py:7 `time_of_day`), a true sequential
     dependency with no associative reformulation — so a scan over the
     leg positions is irreducible. What IS reducible is everything
-    around it: _td_eval (the single-tour path) gathers service/ready/
-    due/start per scan step, which TPU lowers to scalar loops; here all
-    per-leg aux quantities precompute over the whole (B, K) leg grid as
-    one-hot contractions (MXU) before the scan, and the scan body is
-    elementwise VPU math plus exactly ONE flat f32 gather of B travel
-    times per step. Semantics match _td_eval leg for leg (same clock
-    propagation, same `% n_slices` cyclic slicing); travel times are
-    f32-exact (no bf16 table rounding — the gather reads the original
-    matrix), aux selections share the TW hot path's one-hot precision.
+    around it: all per-leg aux quantities precompute over the whole
+    (B, K) leg grid as one-hot contractions (MXU) before the scan, and
+    when the instance carries an exact time-profile factorization
+    (Instance.td_rank — the common case for real time-of-day data) the
+    travel times do too: R basis-leg tables replace the per-step
+    gather, and the scan body is pure VPU math. Semantics match
+    _td_eval leg for leg (same clock propagation, same `% n_slices`
+    cyclic slicing); the factorized path's travel times carry the same
+    bf16 table rounding as every other one-hot hot path (the fallback
+    flat-gather path, used when no exact factorization exists, stays
+    f32-exact).
     """
     v = inst.n_vehicles
     t_slices = inst.n_slices
@@ -413,25 +415,68 @@ def _td_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
     )
     from_depot = prev == 0
 
-    # flat travel lookup: index = slice*N*N + prev*N + cur; the (prev,
-    # cur) part is departure-independent, precomputed once per leg
-    nn = n * n
-    pn = prev.astype(jnp.int32) * n + cur.astype(jnp.int32)
-    d_flat = inst.durations.reshape(t_slices * nn)
+    # Factorized fast path (VERDICT round-2 item 5): with the exact
+    # time-profile factorization durations[t] = sum_r factors[r, t] *
+    # basis[r] (Instance.td_rank, detected at build), the per-leg travel
+    # for EVERY slice is available from R basis-leg contractions —
+    # R ~ 1-4 times the cost of an untimed evaluation instead of T = 24
+    # (a naive legs-by-slice einsum is T-times the untimed cost: 1.5
+    # TFLOP per step at B=2048/n=200 — slower than the gather it would
+    # replace). The scan body then reads factors at the departure slice
+    # (a T-wide one-hot over a [R, T] table — VPU elementwise) and dots
+    # them with the basis legs: no gather anywhere.
+    if inst.td_rank > 0:
+        # basis legs, one [B,K,N] intermediate at a time (R of them)
+        rows = jnp.einsum(
+            "bkn,rnm->rbkm",
+            prev_oh,
+            inst.td_basis.astype(dt),
+            preferred_element_type=dt,
+        )
+        basis_legs = jnp.einsum(
+            "rbkm,bkm->rbk", rows, next_oh, preferred_element_type=jnp.float32
+        )  # [R, B, K]
+        slice_ids = jnp.arange(t_slices, dtype=jnp.int32)
+        factors = inst.td_factors  # [R, T]
 
-    def step(clock, x):
-        pn_k, reset_k, start_k, svc_k, rdy_k = x
-        depart = jnp.where(reset_k, start_k, clock + svc_k)
-        sidx = (depart // inst.slice_minutes).astype(jnp.int32) % t_slices
-        travel = d_flat[sidx * nn + pn_k]
-        arrive = jnp.maximum(depart + travel, rdy_k)
-        return arrive, (travel, arrive)
+        def step(clock, x):
+            blegs_k, reset_k, start_k, svc_k, rdy_k = x  # blegs_k: [R, B]
+            depart = jnp.where(reset_k, start_k, clock + svc_k)
+            sidx = (depart // inst.slice_minutes).astype(jnp.int32) % t_slices
+            sel = (slice_ids[None, :] == sidx[:, None]).astype(jnp.float32)
+            fac = sel @ factors.T  # [B, R]: factors at each chain's slice
+            travel = (fac.T * blegs_k).sum(axis=0)
+            arrive = jnp.maximum(depart + travel, rdy_k)
+            return arrive, (travel, arrive)
 
-    _, (legs, arrive) = jax.lax.scan(
-        step,
-        jnp.zeros((b,), jnp.float32),
-        (pn.T, from_depot.T, start.T, service_prev.T, ready_cur.T),
-    )
+        xs = (
+            jnp.moveaxis(basis_legs, 2, 0),  # [K, R, B]
+            from_depot.T,
+            start.T,
+            service_prev.T,
+            ready_cur.T,
+        )
+    else:
+        # flat travel lookup: index = slice*N*N + prev*N + cur; the
+        # (prev, cur) part is departure-independent, precomputed per leg.
+        # int64 when T*N*N would overflow int32 (ADVICE round 2: silent
+        # garbage gathers at extreme shapes otherwise).
+        nn = n * n
+        idt = jnp.int64 if t_slices * nn > 2**31 - 1 else jnp.int32
+        pn = prev.astype(idt) * n + cur.astype(idt)
+        d_flat = inst.durations.reshape(t_slices * nn)
+
+        def step(clock, x):
+            pn_k, reset_k, start_k, svc_k, rdy_k = x
+            depart = jnp.where(reset_k, start_k, clock + svc_k)
+            sidx = (depart // inst.slice_minutes).astype(idt) % t_slices
+            travel = d_flat[sidx * nn + pn_k]
+            arrive = jnp.maximum(depart + travel, rdy_k)
+            return arrive, (travel, arrive)
+
+        xs = (pn.T, from_depot.T, start.T, service_prev.T, ready_cur.T)
+
+    _, (legs, arrive) = jax.lax.scan(step, jnp.zeros((b,), jnp.float32), xs)
     legs, arrive = legs.T, arrive.T  # back to (B, K)
     dist = legs.sum(axis=1)
     lateness = jnp.maximum(arrive - due_cur, 0.0).sum(axis=1)

@@ -37,8 +37,10 @@ BIG = 1e9
         "due",
         "service",
         "start_times",
+        "td_factors",
+        "td_basis",
     ],
-    meta_fields=["has_tw", "slice_minutes", "het_fleet"],
+    meta_fields=["has_tw", "slice_minutes", "het_fleet", "td_rank"],
 )
 @dataclasses.dataclass(frozen=True)
 class Instance:
@@ -58,6 +60,16 @@ class Instance:
     het_fleet:    static bool — capacities are non-uniform; split-based
                   fitness shortcuts (which assume one capacity) must
                   give way to exact per-vehicle giant-tour pricing.
+    td_rank/td_factors/td_basis: the time-profile factorization
+                  durations[t] == sum_r td_factors[r, t] * td_basis[r]
+                  (exact to f32 noise), detected at build time for
+                  time-dependent instances. Real time-of-day matrices
+                  are low-rank in time (a base matrix modulated by a
+                  daily profile), and the factorized form is what lets
+                  the TD hot path pay R ~ 1 leg-contraction instead of
+                  T = 24 (core.cost._td_hot_batch). td_rank == 0 means
+                  no exact low-rank form was found; the hot path then
+                  falls back to the flat-gather scan.
     """
 
     durations: jax.Array
@@ -70,6 +82,9 @@ class Instance:
     has_tw: bool
     slice_minutes: float
     het_fleet: bool = False
+    td_factors: jax.Array | None = None  # [R, T]
+    td_basis: jax.Array | None = None  # [R, N, N]
+    td_rank: int = 0
 
     @property
     def n_nodes(self) -> int:
@@ -218,6 +233,11 @@ def make_instance(
             # array would corrupt costs instead of erroring — reject here.
             raise ValueError(f"{name} must have shape ({n},), got {arr.shape}")
 
+    td_factors = td_basis = None
+    td_rank = 0
+    if d.shape[0] > 1:
+        td_rank, td_factors, td_basis = _td_factorize(d)
+
     return Instance(
         durations=jnp.asarray(d),
         demands=jnp.asarray(demands),
@@ -229,4 +249,38 @@ def make_instance(
         has_tw=bool(has_tw),
         slice_minutes=float(slice_minutes),
         het_fleet=bool(np.unique(capacities).size > 1),
+        td_factors=None if td_factors is None else jnp.asarray(td_factors),
+        td_basis=None if td_basis is None else jnp.asarray(td_basis),
+        td_rank=td_rank,
     )
+
+
+def _td_factorize(d, max_rank: int = 4):
+    """Exact low-rank time-profile factorization of [T, N, N] durations.
+
+    Host-side SVD of the [T, N*N] unfolding; accepted at the smallest
+    rank R <= max_rank whose reconstruction is exact to f32 noise
+    (max abs error <= 1e-5 * scale — below the bf16 table rounding the
+    one-hot hot paths already live with). Typical time-of-day data IS
+    low-rank: a base matrix times a rush-hour profile is rank 1; a few
+    independent zone profiles rank 2-4. Returns (0, None, None) when no
+    exact form exists.
+    """
+    import numpy as np
+
+    t = d.shape[0]
+    flat = d.reshape(t, -1).astype(np.float64)
+    try:
+        u, s, vt = np.linalg.svd(flat, full_matrices=False)
+    except np.linalg.LinAlgError:  # pragma: no cover - degenerate input
+        return 0, None, None
+    scale = float(np.abs(flat).max()) or 1.0
+    for r in range(1, min(max_rank, len(s)) + 1):
+        approx = (u[:, :r] * s[:r]) @ vt[:r]
+        if float(np.abs(approx - flat).max()) <= 1e-5 * scale:
+            factors = np.ascontiguousarray((u[:, :r] * s[:r]).T, dtype=np.float32)
+            basis = np.ascontiguousarray(
+                vt[:r].reshape(r, d.shape[1], d.shape[2]), dtype=np.float32
+            )
+            return r, factors, basis
+    return 0, None, None
