@@ -141,6 +141,27 @@ def perturbed_clones(
     return _perturb_fn(batch, mode, n_moves)(key, giant)
 
 
+def anneal_temperature(it, t0, t1, horizon):
+    """Geometric schedule value at iteration `it` of `horizon`."""
+    frac = it.astype(jnp.float32) / jnp.maximum(
+        jnp.asarray(horizon, jnp.float32) - 1.0, 1.0
+    )
+    return t0 * (t1 / t0) ** frac
+
+
+def metropolis_accept(giants, costs, cands, cand_costs, u, temp):
+    """The ONE acceptance rule (shared by the per-step-RNG chain step and
+    the presampled block step, so the two can never anneal differently):
+    accept improving moves always, worsening ones with probability
+    exp(-delta/temp) against the provided uniforms."""
+    accept = (cand_costs < costs) | (
+        u < jnp.exp(jnp.minimum((costs - cand_costs) / temp, 0.0))
+    )
+    giants = jnp.where(accept[:, None], cands, giants)
+    costs = jnp.where(accept, cand_costs, costs)
+    return giants, costs
+
+
 def sa_chain_step(
     giants, costs, key, it, t0, t1, n_iters, inst, w, mode="auto", knn=None
 ):
@@ -160,10 +181,7 @@ def sa_chain_step(
     b = giants.shape[0]
     # n_iters may be a dynamic scalar (deadline-chunked solves pass the
     # schedule horizon as a traced value)
-    frac = it.astype(jnp.float32) / jnp.maximum(
-        jnp.asarray(n_iters, jnp.float32) - 1.0, 1.0
-    )
-    temp = t0 * (t1 / t0) ** frac
+    temp = anneal_temperature(it, t0, t1, n_iters)
     k_it = jax.random.fold_in(key, it)
     k_moves, k_accept = jax.random.split(k_it)
     if knn is not None:
@@ -172,12 +190,7 @@ def sa_chain_step(
         cands = random_move_batch(k_moves, giants, mode=mode)
     cand_costs = objective_batch_mode(cands, inst, w, mode)
     u = jax.random.uniform(k_accept, (b,))
-    accept = (cand_costs < costs) | (
-        u < jnp.exp(jnp.minimum((costs - cand_costs) / temp, 0.0))
-    )
-    giants = jnp.where(accept[:, None], cands, giants)
-    costs = jnp.where(accept, cand_costs, costs)
-    return giants, costs
+    return metropolis_accept(giants, costs, cands, cand_costs, u, temp)
 
 
 @lru_cache(maxsize=32)
@@ -204,23 +217,40 @@ def _sa_block_fn(n_block: int, mode: str):
 
     @jax.jit
     def run(state, key, inst, w, t0, t1, knn, start_it, horizon):
-        giants, costs, best_g, best_c = state
+        from vrpms_tpu.moves.moves import (
+            move_batch_from_params,
+            presample_move_params,
+        )
 
-        def step(state, it):
+        giants, costs, best_g, best_c = state
+        b, length = giants.shape
+        # ALL of the block's randomness in one draw (fold_in by the block
+        # start keeps blocks decorrelated): the per-step threefry chain
+        # was the single costliest part of the anneal step — ~0.76 ms of
+        # the ~1.35 ms step at B=4096/n=200 on v5e, more than the move
+        # apply plus the one-hot objective (presample_move_params).
+        kb = jax.random.fold_in(key, start_it)
+        width = 0 if knn is None else knn.shape[1]
+        pri, prr, prmt, prm, pru = presample_move_params(
+            kb, b, length, n_block, width
+        )
+
+        def step(state, xs):
+            it, i, r, mt, m, u = xs
             giants, costs, best_g, best_c = state
-            giants, costs = sa_chain_step(
-                giants, costs, key, it, t0, t1, horizon, inst, w, mode, knn
+            temp = anneal_temperature(it, t0, t1, horizon)
+            cands = move_batch_from_params(i, r, mt, m, giants, knn, mode)
+            cand_costs = objective_batch_mode(cands, inst, w, mode)
+            giants, costs = metropolis_accept(
+                giants, costs, cands, cand_costs, u, temp
             )
             better = costs < best_c
             best_g = jnp.where(better[:, None], giants, best_g)
             best_c = jnp.where(better, costs, best_c)
             return (giants, costs, best_g, best_c), None
 
-        state, _ = jax.lax.scan(
-            step,
-            (giants, costs, best_g, best_c),
-            start_it + jnp.arange(n_block),
-        )
+        xs = (start_it + jnp.arange(n_block), pri, prr, prmt, prm, pru)
+        state, _ = jax.lax.scan(step, (giants, costs, best_g, best_c), xs)
         return state
 
     return run
