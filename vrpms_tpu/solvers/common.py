@@ -57,6 +57,14 @@ def run_blocked(
     picks the array to block on for the clock check. Returns
     (state, iterations_done). deadline_s None runs everything as one
     block with no host sync.
+
+    Deadline fidelity (VERDICT round-2 item 6): once at least one block
+    has timed, the next block is SHRUNK to what the measured iteration
+    rate says still fits the clock — in multiples of 128 so the set of
+    compiled block shapes stays tiny (each extra shape is one
+    persistent-cacheable compile, ever) — instead of the old run-whole-
+    or-skip choice whose overshoot was a full block (~1.3 s at
+    production shapes, 13% of a 10 s budget).
     """
     import time
 
@@ -67,6 +75,16 @@ def run_blocked(
     t_start = time.monotonic()
     while done < n_total:
         nb = min(block, n_total - done)
+        elapsed = time.monotonic() - t_start
+        if done:
+            remaining_t = deadline_s - elapsed
+            if remaining_t <= 0:
+                break
+            fit = int(done / elapsed * remaining_t)
+            if fit < nb:
+                nb = (fit // 128) * 128
+                if nb < 128:
+                    break
         state = step_block(state, nb, done)
         jax.block_until_ready(sync(state))
         done += nb
