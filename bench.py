@@ -236,6 +236,19 @@ def _family_quality(device):
             2, SAParams(n_chains=4096, n_iters=0), 2 * 512, pool=32
         ),
     )
+    # ... and the rate-fitted SHRUNK block shapes (run_blocked trims the
+    # final block to 128-multiples): uncompiled, each costs a one-time
+    # tunnel compile that would masquerade as budget overshoot
+    from vrpms_tpu.solvers.sa import solve_sa
+
+    # (the generous deadline changes nothing about the warm run except
+    # recording the measured sweeps/s into the solver's rate cache, so
+    # the measured solve below fits its very first late-round block)
+    for nb in (128, 256, 384):
+        solve_sa(
+            inst, key=97,
+            params=SAParams(n_chains=4096, n_iters=nb), deadline_s=60.0,
+        )
     budget = 10.0
     t0 = time.perf_counter()
     res = solve_ils(inst, key=0, params=p, deadline_s=budget)

@@ -44,6 +44,7 @@ def run_blocked(
     block_size: int,
     deadline_s: float | None,
     sync,
+    rate_hint: float | None = None,
 ):
     """Deadline-aware composition of jitted iteration blocks — the one
     block-driver loop shared by SA, GA, and ACO (identical granularity
@@ -64,7 +65,12 @@ def run_blocked(
     compiled block shapes stays tiny (each extra shape is one
     persistent-cacheable compile, ever) — instead of the old run-whole-
     or-skip choice whose overshoot was a full block (~1.3 s at
-    production shapes, 13% of a 10 s budget).
+    production shapes, 13% of a 10 s budget). `rate_hint` (iterations/s
+    from a previous same-shape run; solvers cache it) lets even the
+    FIRST block fit a short remaining budget — that unshrinkable first
+    block of a late-starting ILS round was the residual overshoot. The
+    hint is derated 20% so a tunnel-throughput wobble errs toward
+    finishing early (the loop self-corrects from measured elapsed).
     """
     import time
 
@@ -76,15 +82,22 @@ def run_blocked(
     while done < n_total:
         nb = min(block, n_total - done)
         elapsed = time.monotonic() - t_start
-        if done:
-            remaining_t = deadline_s - elapsed
-            if remaining_t <= 0:
+        remaining_t = deadline_s - elapsed
+        rate = (
+            done / elapsed
+            if done
+            else (0.8 * rate_hint if rate_hint else None)
+        )
+        if rate is not None:
+            if remaining_t <= 0 and done:
                 break
-            fit = int(done / elapsed * remaining_t)
+            fit = int(rate * max(remaining_t, 0.0))
             if fit < nb:
                 nb = (fit // 128) * 128
                 if nb < 128:
-                    break
+                    if done:
+                        break
+                    nb = min(128, n_total)  # a call always runs SOMETHING
         state = step_block(state, nb, done)
         jax.block_until_ready(sync(state))
         done += nb

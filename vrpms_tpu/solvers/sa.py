@@ -34,6 +34,11 @@ from vrpms_tpu.moves import knn_move_batch, knn_table, random_move_batch
 from vrpms_tpu.solvers.common import SolveResult
 
 
+# (batch, length, mode) -> measured anneal sweeps/s of the last
+# deadline-bounded run; run_blocked's first-block fit hint (see solve_sa)
+_SWEEP_RATE: dict = {}
+
+
 @dataclasses.dataclass(frozen=True)
 class SAParams:
     n_chains: int = 1024
@@ -360,9 +365,20 @@ def solve_sa(
             st, k_run, inst, w, t0j, t1j, knn, jnp.int32(start), horizon
         )
 
+    # measured sweep rate per shape, fed back as run_blocked's first-
+    # block fit hint so late ILS rounds stop overshooting their budget
+    rate_key = (giants.shape[0], giants.shape[1], mode)
+    import time as _time
+
+    t_run = _time.monotonic()
     state, done = run_blocked(
-        step_block, state, n_iters, 512, deadline_s, lambda st: st[3]
+        step_block, state, n_iters, 512, deadline_s, lambda st: st[3],
+        rate_hint=_SWEEP_RATE.get(rate_key),
     )
+    if deadline_s is not None and done:
+        el = _time.monotonic() - t_run
+        if el > 0.05:
+            _SWEEP_RATE[rate_key] = done / el
 
     _, _, best_g, best_c = state
     champ = jnp.argmin(best_c)
