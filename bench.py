@@ -211,6 +211,31 @@ def _family_polish(device):
     }
 
 
+def _family_n500(device):
+    """Scale proof (VERDICT round-2 item 9): the X-n502-k39 shape.
+    Reports which eval path actually ran — the Pallas kernel's VMEM
+    autotiler may refuse N-hat = 512 tiles, degrading to the XLA
+    one-hot formulation; that decision has never been benchmarked."""
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.kernels import sa_eval
+
+    inst = synth_cvrp(502, 39, seed=7)
+    length = inst.n_customers + inst.n_vehicles + 1
+    nhat = sa_eval._padded_n(inst.n_nodes)
+    lhat = sa_eval.padded_length(length, 8)
+    b = 2048
+    tile = sa_eval._auto_tile(b, nhat, lhat, False)
+    path = f"pallas tile_b={tile[0]} chunk={tile[1]}" if tile else "onehot (VMEM refusal)"
+    rps, elapsed, best = _throughput(inst, device, n_chains=b, n_iters=50)
+    return {
+        "routes_per_sec": round(rps, 1),
+        "seconds": round(elapsed, 3),
+        "best_cost": round(best, 1),
+        "n_nodes": inst.n_nodes,
+        "eval_path": path,
+    }
+
+
 def _family_quality(device):
     """Cost-at-10 s on synth X-n200 — the north-star budget metric
     (BASELINE.json: <=2% of best-known in <10 s on one chip), measured
@@ -306,6 +331,7 @@ def main():
         "vrptw_onehot": _family_vrptw,
         "delta_polish": _family_polish,
         "time_dependent": _family_td,
+        "scale_n502": _family_n500,
     }
     if platform != "cpu":
         # the 4096-chain ILS budget solve is minutes per block on CPU
