@@ -350,7 +350,7 @@ def config4_ga_islands(quick=False):
     if float(cost) < float(res.cost):
         res = res._replace(giant=champ, cost=cost, breakdown=bd)
     elapsed = time.perf_counter() - t0
-    return _result(
+    line = _result(
         4,
         "cvrp-n100-ga-islands",
         cost=round(float(res.breakdown.distance), 1),
@@ -359,6 +359,27 @@ def config4_ga_islands(quick=False):
         seconds=round(elapsed, 2),
         evals_per_sec=round(ga_evals / ga_elapsed, 1),
     )
+    # ACO on the SAME instance (VERDICT round-2 item 7: ACO quality was
+    # never tracked against the others in the ladder)
+    from vrpms_tpu.mesh import solve_aco_islands
+    from vrpms_tpu.solvers.aco import ACOParams
+
+    t0 = time.perf_counter()
+    res_aco = solve_aco_islands(
+        inst,
+        key=0,
+        params=ACOParams(n_ants=64, n_iters=100 if quick else 500),
+        island_params=IslandParams(migrate_every=25, n_migrants=2),
+        pool=8,
+    )
+    _result(
+        4,
+        "cvrp-n100-aco-islands",
+        cost=round(float(res_aco.breakdown.distance), 1),
+        cap_excess=float(res_aco.breakdown.cap_excess),
+        seconds=round(time.perf_counter() - t0, 2),
+    )
+    return line
 
 
 def config5_vrptw(quick=False, solomon_path=None):
