@@ -536,29 +536,48 @@ def solve_ga_islands(
     mode: str = "auto",
     deadline_s: float | None = None,
     pool: int = 0,
+    init_perms: jax.Array | None = None,
 ) -> SolveResult:
     """GA with per-device sub-populations + ring elite migration.
 
     With `deadline_s`, migration blocks run in host-clock-checked chunks
     (see solve_sa_islands). `pool` > 0 returns the per-island champion
     genomes as split giants (SolveResult.pool, best first; at most one
-    per island).
+    per island). `init_perms` ([B, n], B a multiple of the island count,
+    per-island shards exceeding max(elites, n_migrants)) overrides the
+    constructive seeds — the warm-start hook (VERDICT round-2 item 8:
+    islands + warmStart silently dropped the checkpoint for GA).
     """
     w = weights or CostWeights.make()
     if isinstance(key, int):
         key = jax.random.key(key)
     mesh = mesh or make_mesh()
     n_isl = mesh.shape["islands"]
-    pop_local = max(
-        -(-params.population // n_isl),
-        max(params.elites, island_params.n_migrants) + 1,
-    )
+    if init_perms is None:
+        pop_local = max(
+            -(-params.population // n_isl),
+            max(params.elites, island_params.n_migrants) + 1,
+        )
+    else:
+        if init_perms.shape[0] % n_isl:
+            raise ValueError(
+                f"init_perms batch {init_perms.shape[0]} must divide "
+                f"across {n_isl} islands"
+            )
+        pop_local = init_perms.shape[0] // n_isl
+        if pop_local <= max(params.elites, island_params.n_migrants):
+            raise ValueError(
+                "per-island population must exceed max(elites, n_migrants)"
+            )
     local_params = dataclasses.replace(params, population=pop_local)
     generations = params.generations
     mode = resolve_eval_mode(mode)
 
     k_init, k_run = jax.random.split(key)
-    perms0 = initial_perms(k_init, n_isl * pop_local, inst, params, mode)
+    if init_perms is None:
+        perms0 = initial_perms(k_init, n_isl * pop_local, inst, params, mode)
+    else:
+        perms0 = init_perms
 
     if deadline_s is None:
         run = _ga_islands_fn(mesh, local_params, island_params, mode)
@@ -788,6 +807,7 @@ def solve_ils_islands(
     weights: CostWeights | None = None,
     mode: str = "auto",
     deadline_s: float | None = None,
+    init_giants: jax.Array | None = None,
 ) -> SolveResult:
     """Iterated local search with the anneal phase sharded over islands.
 
@@ -810,9 +830,20 @@ def solve_ils_islands(
         key = jax.random.key(key)
     mesh = mesh or make_mesh()
     n_isl = mesh.shape["islands"]
-    chains_local = max(
-        -(-params.sa.n_chains // n_isl), island_params.n_migrants + 1
-    )
+    if init_giants is None:
+        chains_local = max(
+            -(-params.sa.n_chains // n_isl), island_params.n_migrants + 1
+        )
+    else:
+        # warm-start hook: the first round's chains come from the caller
+        # (perturbed checkpoint clones); solve_sa_islands validates the
+        # per-island shard size
+        if init_giants.shape[0] % n_isl:
+            raise ValueError(
+                f"init_giants batch {init_giants.shape[0]} must divide "
+                f"across {n_isl} islands"
+            )
+        chains_local = init_giants.shape[0] // n_isl
 
     def anneal(k_round, init, budget):
         return solve_sa_islands(
@@ -839,6 +870,6 @@ def solve_ils_islands(
         w,
         mode,
         deadline_s,
-        None,
+        init_giants,
         multi_controller=mesh_spans_processes(mesh),
     )
