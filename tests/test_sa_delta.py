@@ -1,0 +1,166 @@
+"""Fused delta-step kernel (kernels.sa_delta): interpret-mode equivalence
+against the XLA proposal/apply/eval reference, plus multi-step state
+integrity. The kernel also passed a bit-exact compiled-vs-interpret check
+on a real v5e (see BASELINE.md round 3); these CPU tests pin the math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vrpms_tpu.core.cost import (
+    CostWeights,
+    _cap_excess_hot,
+    _legs_hot,
+    _rid_batch,
+)
+from vrpms_tpu.io.synth import synth_cvrp
+from vrpms_tpu.moves import knn_table
+from vrpms_tpu.moves.moves import (
+    _segment_src_map,
+    apply_src_map,
+    presample_move_params,
+    window_from_params,
+)
+from vrpms_tpu.solvers.sa import SAParams, _pow2_at_least, initial_giants
+
+pytest.importorskip("jax.experimental.pallas")
+
+from vrpms_tpu.kernels import sa_delta as K  # noqa: E402
+
+
+def _setup(n=30, v=5, batch=64, seed=3, knn_k=8):
+    inst = synth_cvrp(n, v, seed=seed)
+    w = CostWeights.make()
+    giants = initial_giants(jax.random.key(0), batch, inst, SAParams(), "onehot")
+    b, length = giants.shape
+    lhat = _pow2_at_least(length)
+    nhat = 128
+    knn = knn_table(inst.durations[0], knn_k)
+    d_np = np.zeros((nhat, nhat), np.float32)
+    d_np[: inst.n_nodes, : inst.n_nodes] = np.asarray(inst.durations[0])
+    kf = np.zeros((nhat, knn_k), np.float32)
+    kf[: inst.n_nodes] = np.asarray(knn, np.float32)
+    prev_oh, _, legs, _ = _legs_hot(giants, inst)
+    dist = legs.sum(axis=1)[None]
+    cape = _cap_excess_hot(prev_oh, _rid_batch(giants), inst)[None]
+    gt_t = jnp.zeros((lhat, b), jnp.int32).at[:length].set(giants.T)
+    dp = np.asarray(inst.demands)[np.asarray(giants)]
+    dp_t = jnp.zeros((lhat, b), jnp.float32).at[:length].set(jnp.asarray(dp).T)
+    return (
+        inst, w, giants, length, lhat, knn,
+        jnp.asarray(d_np, jnp.bfloat16), jnp.asarray(kf),
+        gt_t, dp_t, dist, cape,
+    )
+
+
+class TestDeltaStepKernel:
+    def test_single_step_matches_xla_reference(self, rng):
+        (inst, w, giants, L, lhat, knn, d_bf16, knn_f,
+         gt_t, dp_t, dist, cape) = _setup()
+        b = giants.shape[0]
+        i, r, mt, m, u = (
+            a[0] for a in presample_move_params(jax.random.key(7), b, L, 1, 8)
+        )
+        temp = 5.0
+        cap0 = float(np.asarray(inst.capacities)[0])
+        scal = jnp.asarray([[temp, cap0, float(w.cap)]], jnp.float32)
+        bc = dist + w.cap * cape
+        gt2, dp2, dist2, cape2, bt2, bc2 = K.delta_step(
+            gt_t, dp_t, dist, cape, gt_t, bc,
+            i[None], r[None], mt[None], m[None], u[None],
+            d_bf16, knn_f, scal,
+            length=L, tile_b=b, has_knn=True, interpret=True,
+        )
+        # the XLA reference: identical proposal decode + full evaluation
+        lo, hi, mtc, mc = window_from_params(i, r, mt, m, giants, knn, "gather")
+        src = _segment_src_map(lo, hi, mtc, mc, L)
+        cands = apply_src_map(giants, src, "gather")
+        prev_oh, _, legs, _ = _legs_hot(cands, inst)
+        dist_c = legs.sum(axis=1)
+        cape_c = _cap_excess_hot(prev_oh, _rid_batch(cands), inst)
+        cur = dist[0] + w.cap * cape[0]
+        cnd = dist_c + w.cap * cape_c
+        accept = (cnd < cur) | (u < jnp.exp(jnp.minimum((cur - cnd) / temp, 0.0)))
+        g_ref = jnp.where(accept[:, None], cands, giants)
+        assert (np.asarray(gt2[:L].T) == np.asarray(g_ref)).all()
+        np.testing.assert_allclose(
+            np.asarray(dist2[0]),
+            np.asarray(jnp.where(accept, dist_c, dist[0])),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(cape2[0]),
+            np.asarray(jnp.where(accept, cape_c, cape[0])),
+            rtol=1e-5,
+        )
+
+    def test_many_steps_zero_drift_and_valid_tours(self):
+        # 120 chained kernel steps: the incremental dist/cape state must
+        # match a from-scratch evaluation EXACTLY (no fp drift at this
+        # scale), tours must stay permutations, dp must track demands
+        (inst, w, giants, L, lhat, knn, d_bf16, knn_f,
+         gt_t, dp_t, dist, cape) = _setup()
+        b = giants.shape[0]
+        cap0 = float(np.asarray(inst.capacities)[0])
+        scal = jnp.asarray([[5.0, cap0, float(w.cap)]], jnp.float32)
+        bc = dist + w.cap * cape
+        best_t = gt_t
+        i_s, r_s, mt_s, m_s, u_s = presample_move_params(
+            jax.random.key(9), b, L, 120, 8
+        )
+        for step in range(120):
+            gt_t, dp_t, dist, cape, best_t, bc = K.delta_step(
+                gt_t, dp_t, dist, cape, best_t, bc,
+                i_s[step][None], r_s[step][None], mt_s[step][None],
+                m_s[step][None], u_s[step][None],
+                d_bf16, knn_f, scal,
+                length=L, tile_b=b, has_knn=True, interpret=True,
+            )
+        g = gt_t[:L].T
+        gh = np.asarray(g)
+        for row in gh:
+            assert sorted(x for x in row if x) == list(
+                range(1, inst.n_customers + 1)
+            )
+        prev_oh, _, legs, _ = _legs_hot(g, inst)
+        np.testing.assert_allclose(
+            np.asarray(dist[0]), np.asarray(legs.sum(axis=1)), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(cape[0]),
+            np.asarray(_cap_excess_hot(prev_oh, _rid_batch(g), inst)),
+            rtol=1e-5, atol=1e-5,
+        )
+        dp_ref = np.asarray(inst.demands)[gh]
+        np.testing.assert_allclose(np.asarray(dp_t[:L].T), dp_ref, atol=1e-6)
+        # best-so-far never above the running cost seen at any step end
+        assert (np.asarray(bc[0]) <= np.asarray(dist[0] + w.cap * cape[0]) + 1e-4).all()
+
+    def test_uniform_window_without_knn(self):
+        (inst, w, giants, L, lhat, knn, d_bf16, knn_f,
+         gt_t, dp_t, dist, cape) = _setup()
+        b = giants.shape[0]
+        i, r, mt, m, u = (
+            a[0] for a in presample_move_params(jax.random.key(11), b, L, 1, 0)
+        )
+        cap0 = float(np.asarray(inst.capacities)[0])
+        scal = jnp.asarray([[5.0, cap0, float(w.cap)]], jnp.float32)
+        bc = dist + w.cap * cape
+        gt2, *_ = K.delta_step(
+            gt_t, dp_t, dist, cape, gt_t, bc,
+            i[None], r[None], mt[None], m[None], u[None],
+            d_bf16, knn_f, scal,
+            length=L, tile_b=b, has_knn=False, interpret=True,
+        )
+        lo, hi, mtc, mc = window_from_params(i, r, mt, m, giants, None, "gather")
+        src = _segment_src_map(lo, hi, mtc, mc, L)
+        cands = apply_src_map(giants, src, "gather")
+        prev_oh, _, legs, _ = _legs_hot(cands, inst)
+        dist_c = legs.sum(axis=1)
+        cape_c = _cap_excess_hot(prev_oh, _rid_batch(cands), inst)
+        cur = dist[0] + w.cap * cape[0]
+        cnd = dist_c + w.cap * cape_c
+        accept = (cnd < cur) | (u < jnp.exp(jnp.minimum((cur - cnd) / 5.0, 0.0)))
+        g_ref = jnp.where(accept[:, None], cands, giants)
+        assert (np.asarray(gt2[:L].T) == np.asarray(g_ref)).all()

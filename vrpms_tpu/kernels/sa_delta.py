@@ -1,0 +1,373 @@
+"""Pallas TPU kernel: one FUSED delta-evaluated SA step.
+
+The round-2 anneal step paid three full-size dances per move: an XLA
+one-hot move apply ((B, L, L) bf16 through HBM), a full objective
+evaluation (O(L * N^2) MACs per chain — VERDICT round-2 weak #7: every
+move changes O(1) legs, so full eval wastes 1-2 orders of magnitude),
+and the proposal bookkeeping. This kernel performs the ENTIRE step —
+candidate-list proposal decode, move apply, exact distance delta, exact
+capacity excess of the candidate, Metropolis accept, state commit — in
+VMEM per chain tile. Only the (L-hat, B) tour/demand state and a few
+(1, B) rows cross HBM per step.
+
+The enabling observation: every proposal family here (reverse / rotate /
+swap of a window [lo, hi]) is a PER-LANE SUBLANE ROLL composed with
+elementwise masks. A per-lane roll by rho_b is eight masked STATIC rolls
+(binary decomposition of rho) — pure VPU work, no gather anywhere, which
+matters because Mosaic's dynamic-gather lowering crashes in this
+environment (see sa_eval.py's header) and one-hot matmul apply is
+exactly the HBM dance being deleted. Distance deltas read 12 d[u, v]
+pairs via one-hot matmuls on the MXU (the d table lives in VMEM, bf16 —
+the same table rounding as every hot path).
+
+Exactness contract: the committed `dist` state accumulates closed-form
+deltas of the bf16-rounded table in f32 — identical rounding semantics
+to the one-hot hot paths — and the solver re-syncs it against the fused
+evaluation kernel at block boundaries to kill drift. Capacity excess is
+recomputed exactly for every candidate (a move across separators can
+reshape several routes; the segmented-scan recompute is cheaper than
+casework and never wrong). The reverse-move delta assumes a SYMMETRIC
+duration matrix (interior legs of a reversed segment re-cost only under
+symmetry); callers gate on that (delta_supported).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas imports fail on some CPU-only builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+_NEG_BIG = -1e18
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _roll_up_static(x, s):
+    """out[k] = x[(k + s) mod rows] for STATIC s — two sublane slices."""
+    if s == 0:
+        return x
+    return jnp.concatenate([x[s:], x[:s]], axis=0)
+
+
+def _roll_up_perlane(x, rho_row, lhat):
+    """out[k, b] = x[(k + rho_b) mod lhat, b] — per-LANE dynamic sublane
+    roll as ceil(log2(lhat)) masked static rolls (binary decomposition
+    of rho). rho_row: (1, T) int32 in [0, lhat)."""
+    out = x
+    bit = 1
+    while bit < lhat:
+        take = (rho_row & bit) != 0  # (1, T) broadcast over sublanes
+        out = jnp.where(take, _roll_up_static(out, bit & (lhat - 1)), out)
+        bit <<= 1
+    return out
+
+
+def _value_at(gt, pos_row, iota_l):
+    """(1, T) value of each lane's tour at its own position pos_b —
+    one-hot sublane reduction (no gather)."""
+    sel = iota_l == pos_row
+    return jnp.sum(jnp.where(sel, gt, 0), axis=0, keepdims=True)
+
+
+def _pair_lookup(d, u_rows, v_rows, nhat):
+    """d[u_k, v_k] for K (1, T) node-row pairs -> list of (1, T).
+
+    One (T, N-hat) one-hot matmul per pair selects the row vector on the
+    MXU, then the v one-hot contracts it on the VPU. Pairs are processed
+    one at a time — a stacked (K*T, N-hat) formulation was measured no
+    faster and its concat buffers cost the VMEM that larger chain tiles
+    need."""
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (u_rows[0].shape[1], nhat), 1)
+    vals = []
+    for u, v in zip(u_rows, v_rows):
+        u_oh = (u.T == iota_n).astype(jnp.bfloat16)
+        rows = jnp.dot(u_oh, d, preferred_element_type=jnp.float32)
+        v_oh = (v.T == iota_n).astype(jnp.float32)
+        vals.append(jnp.sum(rows * v_oh, axis=1, keepdims=True).T)
+    return vals
+
+
+def _prefix_sum_sublane(x, lhat):
+    p = x
+    k = 1
+    while k < lhat:
+        pad = jnp.zeros((k, x.shape[1]), x.dtype)
+        p = p + jnp.concatenate([pad, p[: lhat - k]], axis=0)
+        k *= 2
+    return p
+
+
+def _prefix_max_sublane(x, lhat):
+    m = x
+    k = 1
+    while k < lhat:
+        pad = jnp.full((k, x.shape[1]), _NEG_BIG, x.dtype)
+        m = jnp.maximum(m, jnp.concatenate([pad, m[: lhat - k]], axis=0))
+        k *= 2
+    return m
+
+
+def _cap_excess_of(cand, dp_cand, cap0, lhat):
+    """Total capacity excess per lane of the candidate tours — the
+    segmented max-scan trick from sa_eval.eval_tours_homog, single-shot:
+    contributions land at route-closing depot zeros; pad rows are depot
+    zeros closing empty routes, so they contribute nothing."""
+    z = cand == 0
+    cum = _prefix_sum_sublane(dp_cand, lhat)
+    m = jnp.where(z, cum, _NEG_BIG)
+    m = _prefix_max_sublane(m, lhat)
+    last_close = jnp.concatenate(
+        [jnp.full((1, cand.shape[1]), _NEG_BIG, m.dtype), m[: lhat - 1]], axis=0
+    )
+    last_close = jnp.maximum(last_close, 0.0)  # floor: nothing before row 0
+    contrib = jnp.where(z, jnp.maximum(cum - last_close - cap0, 0.0), 0.0)
+    return jnp.sum(contrib, axis=0, keepdims=True)
+
+
+def _delta_step_kernel(
+    gt_ref, dp_ref, dist_ref, cape_ref, best_ref, bestc_ref,
+    i_ref, r_ref, mt_ref, m_ref, u_ref,
+    d_ref, knn_ref, scal_ref,
+    gt_out, dp_out, dist_out, cape_out, best_out, bestc_out,
+    *, length, has_knn,
+):
+    lhat, t = gt_ref.shape
+    nhat = d_ref.shape[0]
+    gt = gt_ref[:]
+    dp = dp_ref[:]
+    d = d_ref[:]
+    temp = scal_ref[0, 0]
+    cap0 = scal_ref[0, 1]
+    wcap = scal_ref[0, 2]
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (lhat, t), 0)
+
+    i_row = i_ref[:]
+    # --- proposal decode: second endpoint -------------------------------
+    if has_knn:
+        a_for_knn = _value_at(gt, i_row, iota_l)  # node at position i
+        iota_n = jax.lax.broadcasted_iota(jnp.int32, (t, nhat), 1)
+        a_oh = (a_for_knn.T == iota_n).astype(jnp.bfloat16)
+        rows = jnp.dot(a_oh, knn_ref[:], preferred_element_type=jnp.float32)
+        kw = knn_ref.shape[1]
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (t, kw), 1)
+        r_oh = (r_ref[:].T == iota_k).astype(jnp.float32)
+        bnode = jnp.sum(rows * r_oh, axis=1, keepdims=True)  # (T, 1) f32
+        bnode = bnode.astype(jnp.int32).T  # (1, T)
+        # first position holding that node (min index over matches)
+        match = gt == bnode
+        j_row = jnp.min(
+            jnp.where(match, iota_l, lhat), axis=0, keepdims=True
+        )
+    else:
+        j_row = r_ref[:]
+    j_row = jnp.clip(j_row, 1, length - 2)
+
+    lo = jnp.minimum(i_row, j_row)
+    hi = jnp.maximum(i_row, j_row)
+    span = hi - lo + 1
+    mm = jnp.minimum(m_ref[:], span - 1)
+    mt = mt_ref[:]
+
+    # --- node values around the window ----------------------------------
+    a_ = _value_at(gt, lo - 1, iota_l)
+    b0 = _value_at(gt, lo, iota_l)
+    x2 = _value_at(gt, lo + 1, iota_l)
+    b1 = _value_at(gt, lo + mm - 1, iota_l)
+    x_ = _value_at(gt, lo + mm, iota_l)
+    y2 = _value_at(gt, hi - 1, iota_l)
+    c_ = _value_at(gt, hi, iota_l)
+    e_ = _value_at(gt, hi + 1, iota_l)
+
+    # --- distance deltas (bf16-table values, f32 math) ------------------
+    (
+        d_ab, d_ce, d_ac, d_be, d_ax, d_cb, d_b1e, d_b1x,
+        d_cx2, d_y2b, d_bx2, d_y2c,
+    ) = _pair_lookup(
+        d,
+        [a_, c_, a_, b0, a_, c_, b1, b1, c_, y2, b0, y2],
+        [b0, e_, c_, e_, x_, b0, e_, x_, x2, b0, x2, c_],
+        nhat,
+    )
+    nontriv = hi > lo
+    drev = jnp.where(nontriv, d_ac + d_be - d_ab - d_ce, 0.0)
+    drot = jnp.where(
+        (span >= 2) & (mm >= 1),
+        d_ax + d_cb + d_b1e - d_ab - d_b1x - d_ce,
+        0.0,
+    )
+    dswap_gen = d_ac + d_cx2 + d_y2b + d_be - d_ab - d_bx2 - d_y2c - d_ce
+    dswap = jnp.where(
+        hi == lo + 1, drev, jnp.where(nontriv, dswap_gen, 0.0)
+    )
+    ddist = jnp.where(mt == 0, drev, jnp.where(mt == 1, drot, dswap))
+
+    # --- build the candidate (per-lane rolls + masks) -------------------
+    in_win = (iota_l >= lo) & (iota_l <= hi)
+
+    mask = lhat - 1  # lhat is a power of two: & mask == mod lhat (and
+    # works for negative int32 operands in two's complement) — TPUs have
+    # no hardware integer divide, so a jnp `%` would expand into a long
+    # scalar sequence (and trips the Mosaic lowering here outright)
+
+    def apply_move(arr, flipped):
+        # reverse: arr[lo + hi - k] == flipped[(k + (lhat-1-(lo+hi))) % lhat]
+        rho_rev = (lhat - 1 - (lo + hi)) & mask
+        rev = jnp.where(in_win, _roll_up_perlane(flipped, rho_rev, lhat), arr)
+        # rotate window left by mm: arr[k + mm] or arr[k + mm - span]
+        fwd = _roll_up_perlane(arr, mm & mask, lhat)
+        wrap = _roll_up_perlane(arr, (mm - span) & mask, lhat)
+        rot = jnp.where(
+            in_win, jnp.where(iota_l + mm <= hi, fwd, wrap), arr
+        )
+        return rev, rot
+
+    # Mosaic has no `rev` lowering — flip via the constant antidiagonal
+    # permutation matrix on the MXU instead (0/1 entries select exactly;
+    # node ids and the f32 demand values pass through an f32 matmul
+    # unchanged). One matmul per array per step, ~LH^2*T MACs — noise.
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
+    antidiag = (iota_r + iota_c == lhat - 1).astype(jnp.float32)
+    gt_flip = jnp.dot(
+        antidiag, gt.astype(jnp.float32), preferred_element_type=jnp.float32
+    ).astype(jnp.int32)
+    dp_flip = jnp.dot(antidiag, dp, preferred_element_type=jnp.float32)
+    gt_rev, gt_rot = apply_move(gt, gt_flip)
+    dp_rev, dp_rot = apply_move(dp, dp_flip)
+    # swap lo <-> hi (values already extracted)
+    dem_b0 = _value_at_f(dp, lo, iota_l)
+    dem_c = _value_at_f(dp, hi, iota_l)
+    gt_swp = jnp.where(iota_l == lo, c_, jnp.where(iota_l == hi, b0, gt))
+    dp_swp = jnp.where(
+        iota_l == lo, dem_c, jnp.where(iota_l == hi, dem_b0, dp)
+    )
+    cand = jnp.where(mt == 0, gt_rev, jnp.where(mt == 1, gt_rot, gt_swp))
+    dp_cand = jnp.where(mt == 0, dp_rev, jnp.where(mt == 1, dp_rot, dp_swp))
+
+    # --- capacity + Metropolis ------------------------------------------
+    cape_cand = _cap_excess_of(cand, dp_cand, cap0, lhat)
+    dist = dist_ref[:]
+    cape = cape_ref[:]
+    new_dist = dist + ddist
+    cur_cost = dist + wcap * cape
+    cand_cost = new_dist + wcap * cape_cand
+    delta = cand_cost - cur_cost
+    accept = (delta < 0.0) | (
+        u_ref[:] < jnp.exp(jnp.minimum(-delta / temp, 0.0))
+    )
+    gt_new = jnp.where(accept, cand, gt)
+    gt_out[:] = gt_new
+    dp_out[:] = jnp.where(accept, dp_cand, dp)
+    dist_out[:] = jnp.where(accept, new_dist, dist)
+    cape_out[:] = jnp.where(accept, cape_cand, cape)
+    # best-so-far tracking in-kernel: the XLA twin of this (a (L-hat, B)
+    # where per step) was ~40% of the step's wall at B=16k
+    committed = jnp.where(accept, cand_cost, cur_cost)
+    better = committed < bestc_ref[:]
+    best_out[:] = jnp.where(better, gt_new, best_ref[:])
+    bestc_out[:] = jnp.where(better, committed, bestc_ref[:])
+
+
+def _value_at_f(arr, pos_row, iota_l):
+    sel = iota_l == pos_row
+    return jnp.sum(jnp.where(sel, arr, 0.0), axis=0, keepdims=True)
+
+
+def _dp_init_kernel(gt_ref, dem_ref, dp_out):
+    """dp[k, b] = demands[gt[k, b]] — per-position one-hot matvecs
+    against the demand vector (VMEM-resident; no gather)."""
+    lhat, t = gt_ref.shape
+    nhat = dem_ref.shape[1]
+    dem_col = dem_ref[:].T  # (N-hat, 1)
+    rows = []
+    for k in range(lhat):
+        oh = (
+            gt_ref[k : k + 1, :].T
+            == jax.lax.broadcasted_iota(jnp.int32, (t, nhat), 1)
+        ).astype(jnp.bfloat16)
+        val = jnp.dot(oh, dem_col.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)  # (T, 1)
+        rows.append(val.T)
+    dp_out[:] = jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_b", "interpret"))
+def dp_init(gt_t, dem_row, *, tile_b, interpret=False):
+    """(L-hat, B) tours -> (L-hat, B) per-position demands, on device.
+
+    Exists because both XLA alternatives are terrible at B=16k: the
+    (B, L, N) one-hot einsum moves ~2 GB of intermediates, and a host
+    fancy-index round-trips the whole state through the TPU tunnel.
+    bf16 is exact here as long as demands are integers <= 256 (callers
+    gate; the delta path's capacity math is f32 from here on).
+    """
+    lhat, b = gt_t.shape
+    return pl.pallas_call(
+        _dp_init_kernel,
+        grid=(b // tile_b,),
+        in_specs=[
+            pl.BlockSpec((lhat, tile_b), lambda g: (0, g)),
+            pl.BlockSpec(dem_row.shape, lambda g: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((lhat, tile_b), lambda g: (0, g)),
+        out_shape=jax.ShapeDtypeStruct((lhat, b), jnp.float32),
+        interpret=interpret,
+    )(gt_t, dem_row)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("length", "tile_b", "has_knn", "interpret")
+)
+def delta_step(
+    gt_t, dp_t, dist, cape, best_t, best_c,
+    i, r, mt, m, u, d_bf16, knn_f32, scal,
+    *, length, tile_b, has_knn, interpret=False,
+):
+    """One fused SA step over all chains, best tracking included.
+
+    gt_t/dp_t/best_t: (L-hat, B) i32/f32/i32 transposed tour, demand and
+    best-so-far state; dist/cape/best_c/i/r/mt/m/u: (1, B); d_bf16:
+    (N-hat, N-hat) bf16; knn_f32: (N-hat, K) f32 (ignored when
+    has_knn=False — pass a dummy); scal: (1, 3) f32 [temp, cap0, wcap].
+    Returns the committed (gt_t, dp_t, dist, cape, best_t, best_c).
+    """
+    lhat, b = gt_t.shape
+    grid = b // tile_b
+    kernel = functools.partial(
+        _delta_step_kernel, length=length, has_knn=has_knn
+    )
+    tall = pl.BlockSpec((lhat, tile_b), lambda g: (0, g))
+    row = pl.BlockSpec((1, tile_b), lambda g: (0, g))
+    out = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            tall, tall, row, row, tall, row,
+            row, row, row, row, row,
+            pl.BlockSpec(d_bf16.shape, lambda g: (0, 0)),
+            pl.BlockSpec(knn_f32.shape, lambda g: (0, 0)),
+            pl.BlockSpec((1, 3), lambda g: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[tall, tall, row, row, tall, row],
+        out_shape=[
+            jax.ShapeDtypeStruct((lhat, b), jnp.int32),
+            jax.ShapeDtypeStruct((lhat, b), jnp.float32),
+            jax.ShapeDtypeStruct((1, b), jnp.float32),
+            jax.ShapeDtypeStruct((1, b), jnp.float32),
+            jax.ShapeDtypeStruct((lhat, b), jnp.int32),
+            jax.ShapeDtypeStruct((1, b), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gt_t, dp_t, dist, cape, best_t, best_c, i, r, mt, m, u, d_bf16, knn_f32, scal)
+    return out

@@ -392,3 +392,246 @@ def solve_sa(
     return SolveResult(
         g, cost, bd, jnp.int32(giants.shape[0] * done), elite
     )
+
+
+# ---------------------------------------------------------------------------
+# Delta-evaluated anneal (fused Pallas step kernel)
+# ---------------------------------------------------------------------------
+
+
+def _delta_supported(inst: Instance, w: CostWeights, mode: str) -> bool:
+    """Host-side gate for the fused delta-step path: untimed symmetric
+    uniform-capacity instances on a TPU backend (the reverse-move delta
+    needs symmetry; TW/TD/makespan change non-local terms; heterogeneous
+    fleets break the uniform-capacity excess recompute)."""
+    import numpy as np
+
+    from vrpms_tpu.kernels.sa_delta import _PALLAS_OK
+
+    if mode != "pallas" or not _PALLAS_OK:
+        return False
+    if inst.has_tw or inst.time_dependent or w.use_makespan or inst.het_fleet:
+        return False
+    if inst.n_nodes > 512:
+        return False
+    d = np.asarray(inst.durations[0])
+    return bool(np.allclose(d, d.T, rtol=1e-6, atol=1e-6))
+
+
+def _pow2_at_least(x: int) -> int:
+    p = 1
+    while p < x:
+        p <<= 1
+    return p
+
+
+def _delta_prep(giants, inst, w, lhat: int, nhat: int, tile_b: int):
+    """giants [B, L] -> transposed padded state + exact dist/cape.
+
+    Everything stays on device: dist/cape via two fused-eval kernel
+    passes (see _delta_resync_fn), per-position demands via the dp_init
+    kernel (the XLA one-hot einsum moved ~2 GB of intermediates at
+    B=16k, and a host fancy-index round-trips the state through the
+    TPU tunnel — both measured slower than the 512 steps they set up)."""
+    import numpy as np
+
+    from vrpms_tpu.kernels.sa_delta import dp_init
+
+    b, length = giants.shape
+    gt_t = jnp.zeros((lhat, b), jnp.int32).at[:length].set(giants.T)
+    dist, cape = _delta_resync_fn(length)(gt_t, inst, w)
+    dem_row = np.zeros((1, nhat), np.float32)
+    dem_row[0, : inst.n_nodes] = np.asarray(inst.demands)
+    dp_t = dp_init(gt_t, jnp.asarray(dem_row), tile_b=tile_b)
+    return gt_t, dp_t, dist, cape
+
+
+@lru_cache(maxsize=16)
+def _delta_resync_fn(length: int):
+    """Exact dist/cape of the transposed state — the block-boundary
+    drift killer (f32 sums of the SAME bf16 table the deltas read).
+    Runs as TWO fused-eval kernel passes (wcap 0 then 1; their
+    difference isolates the capacity excess): the XLA one-hot resync
+    moved ~2 GB of (B, L, N) intermediates at B=16k and cost more than
+    the 512 delta steps it certified."""
+
+    @jax.jit
+    def resync(gt_t, inst, w):
+        import dataclasses as _dc
+
+        from vrpms_tpu.kernels.sa_eval import pallas_objective_batch
+
+        gt = gt_t[:length]
+        w0 = _dc.replace(w, cap=0.0)
+        w1 = _dc.replace(w, cap=1.0)
+        dist = pallas_objective_batch(gt, inst, w0, transposed=True)
+        both = pallas_objective_batch(gt, inst, w1, transposed=True)
+        return dist[None, :], (both - dist)[None, :]
+
+    return resync
+
+
+@lru_cache(maxsize=32)
+def _sa_delta_block_fn(n_block: int, length: int, tile_b: int, has_knn: bool):
+    """One jitted block of n_block fused delta steps + best tracking."""
+    from vrpms_tpu.kernels.sa_delta import delta_step
+    from vrpms_tpu.moves.moves import presample_move_params
+
+    @jax.jit
+    def run(state, key, d_bf16, knn_f, scal2, t0, t1, start_it, horizon):
+        gt_t, dp_t, dist, cape, best_t, best_c = state
+        b = gt_t.shape[1]
+        kb = jax.random.fold_in(key, start_it)
+        kw = knn_f.shape[1] if has_knn else 0
+        pri, prr, prmt, prm, pru = presample_move_params(
+            kb, b, length, n_block, kw
+        )
+
+        def step(st, xs):
+            it, i, r, mt, m, u = xs
+            gt_t, dp_t, dist, cape, best_t, best_c = st
+            temp = anneal_temperature(it, t0, t1, horizon)
+            scal = jnp.concatenate(
+                [temp[None, None].astype(jnp.float32), scal2], axis=1
+            )
+            st = delta_step(
+                gt_t, dp_t, dist, cape, best_t, best_c,
+                i[None, :], r[None, :], mt[None, :], m[None, :], u[None, :],
+                d_bf16, knn_f, scal,
+                length=length, tile_b=tile_b, has_knn=has_knn,
+            )
+            return st, None
+
+        xs = (start_it + jnp.arange(n_block), pri, prr, prmt, prm, pru)
+        state, _ = jax.lax.scan(step, state, xs)
+        return state
+
+    return run
+
+
+def solve_sa_delta(
+    inst: Instance,
+    key: jax.Array | int = 0,
+    params: SAParams = SAParams(),
+    weights: CostWeights | None = None,
+    init_giants: jax.Array | None = None,
+    deadline_s: float | None = None,
+    pool: int = 0,
+    knn: jax.Array | None = None,
+) -> SolveResult:
+    """Batched-chain SA with the FUSED delta step (kernels.sa_delta).
+
+    Same contract as solve_sa (deadline blocks, pool, warm init); the
+    per-move work drops from a full O(L * N^2) evaluation to closed-form
+    deltas + a capacity recompute, all inside one VMEM-resident kernel.
+    Callers must pass instances _delta_supported approves.
+    """
+    import numpy as np
+
+    w = weights or CostWeights.make()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    k_init, k_run = jax.random.split(key)
+    mode = "pallas"
+    if init_giants is None and params.init == "nn":
+        giants, _costs, mean = _sa_prep_fn(params.n_chains, "onehot")(
+            k_init, inst, w
+        )
+        t0, t1 = _temps_from_scale(float(mean), params)
+    else:
+        t0, t1 = _auto_temps(inst, params)
+        giants = (
+            initial_giants(k_init, params.n_chains, inst, params, "onehot")
+            if init_giants is None
+            else init_giants
+        )
+    b, length = giants.shape
+    lhat = _pow2_at_least(length)
+    nhat = -(-inst.n_nodes // 128) * 128
+    # 512-chain tiles measured fastest (fewer per-tile fixed costs);
+    # 1024 blows the VMEM budget at L-hat=256
+    tile_b = next((t for t in (512, 256, 128) if b % t == 0), None)
+    if tile_b is None:
+        raise ValueError(f"delta path needs a 128-multiple batch, got {b}")
+
+    d_np = np.zeros((nhat, nhat), np.float32)
+    d_np[: inst.n_nodes, : inst.n_nodes] = np.asarray(inst.durations[0])
+    d_bf16 = jnp.asarray(d_np, jnp.bfloat16)
+    if knn is None and params.knn_k > 0:
+        knn = knn_table(inst.durations[0], params.knn_k)
+    has_knn = knn is not None
+    if has_knn:
+        kf = np.zeros((nhat, knn.shape[1]), np.float32)
+        kf[: inst.n_nodes] = np.asarray(knn, np.float32)
+        knn_f = jnp.asarray(kf)
+    else:
+        knn_f = jnp.zeros((nhat, 8), jnp.float32)
+    cap0 = float(np.asarray(inst.capacities)[0])
+    scal2 = jnp.asarray([[cap0, float(w.cap)]], jnp.float32)
+
+    gt_t, dp_t, dist, cape = _delta_prep(giants, inst, w, lhat, nhat, tile_b)
+    best_c = dist + float(w.cap) * cape
+    state = (gt_t, dp_t, dist, cape, gt_t, best_c)
+    t0j, t1j = jnp.float32(t0), jnp.float32(t1)
+    horizon = jnp.float32(params.n_iters)
+
+    base_it = 0  # global iteration offset: run_blocked numbers its
+    # blocks from 0 within each call, but the schedule and the
+    # presampled RNG streams must see GLOBAL iterations (a block that
+    # restarts at 0 replays the same proposals at replayed temperatures)
+
+    def step_block(st, nb, start):
+        return _sa_delta_block_fn(nb, length, tile_b, has_knn)(
+            st, k_run, d_bf16, knn_f, scal2, t0j, t1j,
+            jnp.int32(base_it + start), horizon,
+        )
+
+    # block-wise with an exact resync between blocks (drift kill); the
+    # same deadline/rate contract as solve_sa
+    from vrpms_tpu.solvers.common import run_blocked
+
+    resync = _delta_resync_fn(length)
+    rate_key = ("delta", b, length)
+    import time as _time
+
+    t_run = _time.monotonic()
+    done = 0
+    remaining = params.n_iters
+    while remaining > 0:
+        block = min(512, remaining)
+        st, did = run_blocked(
+            step_block, state, block, 512,
+            None if deadline_s is None else max(
+                0.0, deadline_s - (_time.monotonic() - t_run)
+            ),
+            lambda s: s[5],
+            rate_hint=_SWEEP_RATE.get(rate_key),
+        )
+        state = st
+        done += did
+        base_it += did
+        remaining -= block
+        if did:
+            el = _time.monotonic() - t_run
+            if el > 0.05:
+                _SWEEP_RATE[rate_key] = done / el
+        # exact resync of the committed state (fp drift accumulates in
+        # the f32 delta sums; measured well under 1e-3 per 512 steps,
+        # but exactness is the contract)
+        gt_t, dp_t, _, _, best_t, best_c = state
+        dist, cape = resync(gt_t, inst, w)
+        state = (gt_t, dp_t, dist, cape, best_t, best_c)
+        if deadline_s is not None and _time.monotonic() - t_run >= deadline_s:
+            break
+        if did < block:
+            break
+
+    gt_t, dp_t, dist, cape, best_t, best_c = state
+    champ = jnp.argmin(best_c[0])
+    g = best_t[:length, champ].T
+    bd, cost = exact_cost(g, inst, w)
+    elite = None
+    if pool > 0:
+        order = jnp.argsort(best_c[0])[: min(pool, b)]
+        elite = best_t[:length, :].T[order]
+    return SolveResult(g, cost, bd, jnp.int32(b * done), elite)
