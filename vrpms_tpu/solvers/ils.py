@@ -112,7 +112,25 @@ def solve_ils(
         else None
     )
 
+    # the fused delta-step kernel does ~20x the moves/s of the full-eval
+    # step at indistinguishable per-sweep quality (kernels.sa_delta), so
+    # every supported instance anneals with it
+    from vrpms_tpu.solvers.sa import _delta_supported, solve_sa_delta
+
+    use_delta = _delta_supported(inst, w, mode) and params.sa.n_chains % 128 == 0
+
     def anneal(k_round, init, budget):
+        if use_delta:
+            return solve_sa_delta(
+                inst,
+                key=k_round,
+                params=params.sa,
+                weights=w,
+                init_giants=init,
+                deadline_s=budget,
+                pool=params.pool,
+                knn=knn,
+            )
         return solve_sa(
             inst,
             key=k_round,
