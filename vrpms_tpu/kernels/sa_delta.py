@@ -142,44 +142,63 @@ def _delta_step_kernel(
     gt_out, dp_out, dist_out, cape_out, best_out, bestc_out,
     *, length, has_knn,
 ):
+    """Single-step variant (the block kernel is the production path;
+    this one exists for tests and for callers that need per-step host
+    control). Same math via the shared _step_body."""
     lhat, t = gt_ref.shape
     nhat = d_ref.shape[0]
-    gt = gt_ref[:]
-    dp = dp_ref[:]
-    d = d_ref[:]
     temp = scal_ref[0, 0]
     cap0 = scal_ref[0, 1]
     wcap = scal_ref[0, 2]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (lhat, t), 0)
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
+    antidiag = (iota_r + iota_c == lhat - 1).astype(jnp.float32)
+    out = _step_body(
+        gt_ref[:], dp_ref[:], dist_ref[:], cape_ref[:],
+        best_ref[:], bestc_ref[:],
+        i_ref[:], r_ref[:], mt_ref[:], m_ref[:], u_ref[:], temp,
+        d_ref[:], knn_ref[:], cap0, wcap, iota_l, antidiag,
+        length=length, lhat=lhat, t=t, nhat=nhat, has_knn=has_knn,
+    )
+    gt_out[:], dp_out[:], dist_out[:], cape_out[:], best_out[:], bestc_out[:] = out
 
-    i_row = i_ref[:]
+
+def _value_at_f(arr, pos_row, iota_l):
+    sel = iota_l == pos_row
+    return jnp.sum(jnp.where(sel, arr, 0.0), axis=0, keepdims=True)
+
+
+def _step_body(
+    gt, dp, dist, cape, best, bestc,
+    i_row, r_row, mt_row, m_row, u_row, temp,
+    d, knn, cap0, wcap, iota_l, antidiag, *, length, lhat, t, nhat, has_knn,
+):
+    """The delta-step math on VALUE arrays — shared verbatim by the
+    one-step kernel (scan path) and the in-kernel block loop."""
     # --- proposal decode: second endpoint -------------------------------
     if has_knn:
-        a_for_knn = _value_at(gt, i_row, iota_l)  # node at position i
+        a_for_knn = _value_at(gt, i_row, iota_l)
         iota_n = jax.lax.broadcasted_iota(jnp.int32, (t, nhat), 1)
         a_oh = (a_for_knn.T == iota_n).astype(jnp.bfloat16)
-        rows = jnp.dot(a_oh, knn_ref[:], preferred_element_type=jnp.float32)
-        kw = knn_ref.shape[1]
+        rows = jnp.dot(a_oh, knn, preferred_element_type=jnp.float32)
+        kw = knn.shape[1]
         iota_k = jax.lax.broadcasted_iota(jnp.int32, (t, kw), 1)
-        r_oh = (r_ref[:].T == iota_k).astype(jnp.float32)
-        bnode = jnp.sum(rows * r_oh, axis=1, keepdims=True)  # (T, 1) f32
-        bnode = bnode.astype(jnp.int32).T  # (1, T)
-        # first position holding that node (min index over matches)
+        r_oh = (r_row.T == iota_k).astype(jnp.float32)
+        bnode = jnp.sum(rows * r_oh, axis=1, keepdims=True)
+        bnode = bnode.astype(jnp.int32).T
         match = gt == bnode
-        j_row = jnp.min(
-            jnp.where(match, iota_l, lhat), axis=0, keepdims=True
-        )
+        j_row = jnp.min(jnp.where(match, iota_l, lhat), axis=0, keepdims=True)
     else:
-        j_row = r_ref[:]
+        j_row = r_row
     j_row = jnp.clip(j_row, 1, length - 2)
 
     lo = jnp.minimum(i_row, j_row)
     hi = jnp.maximum(i_row, j_row)
     span = hi - lo + 1
-    mm = jnp.minimum(m_ref[:], span - 1)
-    mt = mt_ref[:]
+    mm = jnp.minimum(m_row, span - 1)
+    mt = mt_row
 
-    # --- node values around the window ----------------------------------
     a_ = _value_at(gt, lo - 1, iota_l)
     b0 = _value_at(gt, lo, iota_l)
     x2 = _value_at(gt, lo + 1, iota_l)
@@ -189,7 +208,6 @@ def _delta_step_kernel(
     c_ = _value_at(gt, hi, iota_l)
     e_ = _value_at(gt, hi + 1, iota_l)
 
-    # --- distance deltas (bf16-table values, f32 math) ------------------
     (
         d_ab, d_ce, d_ac, d_be, d_ax, d_cb, d_b1e, d_b1x,
         d_cx2, d_y2b, d_bx2, d_y2c,
@@ -207,81 +225,146 @@ def _delta_step_kernel(
         0.0,
     )
     dswap_gen = d_ac + d_cx2 + d_y2b + d_be - d_ab - d_bx2 - d_y2c - d_ce
-    dswap = jnp.where(
-        hi == lo + 1, drev, jnp.where(nontriv, dswap_gen, 0.0)
-    )
+    dswap = jnp.where(hi == lo + 1, drev, jnp.where(nontriv, dswap_gen, 0.0))
     ddist = jnp.where(mt == 0, drev, jnp.where(mt == 1, drot, dswap))
 
-    # --- build the candidate (per-lane rolls + masks) -------------------
     in_win = (iota_l >= lo) & (iota_l <= hi)
-
-    mask = lhat - 1  # lhat is a power of two: & mask == mod lhat (and
-    # works for negative int32 operands in two's complement) — TPUs have
-    # no hardware integer divide, so a jnp `%` would expand into a long
-    # scalar sequence (and trips the Mosaic lowering here outright)
+    mask = lhat - 1
 
     def apply_move(arr, flipped):
-        # reverse: arr[lo + hi - k] == flipped[(k + (lhat-1-(lo+hi))) % lhat]
         rho_rev = (lhat - 1 - (lo + hi)) & mask
         rev = jnp.where(in_win, _roll_up_perlane(flipped, rho_rev, lhat), arr)
-        # rotate window left by mm: arr[k + mm] or arr[k + mm - span]
         fwd = _roll_up_perlane(arr, mm & mask, lhat)
         wrap = _roll_up_perlane(arr, (mm - span) & mask, lhat)
-        rot = jnp.where(
-            in_win, jnp.where(iota_l + mm <= hi, fwd, wrap), arr
-        )
+        rot = jnp.where(in_win, jnp.where(iota_l + mm <= hi, fwd, wrap), arr)
         return rev, rot
 
-    # Mosaic has no `rev` lowering — flip via the constant antidiagonal
-    # permutation matrix on the MXU instead (0/1 entries select exactly;
-    # node ids and the f32 demand values pass through an f32 matmul
-    # unchanged). One matmul per array per step, ~LH^2*T MACs — noise.
-    iota_r = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
-    iota_c = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
-    antidiag = (iota_r + iota_c == lhat - 1).astype(jnp.float32)
     gt_flip = jnp.dot(
         antidiag, gt.astype(jnp.float32), preferred_element_type=jnp.float32
     ).astype(jnp.int32)
     dp_flip = jnp.dot(antidiag, dp, preferred_element_type=jnp.float32)
     gt_rev, gt_rot = apply_move(gt, gt_flip)
     dp_rev, dp_rot = apply_move(dp, dp_flip)
-    # swap lo <-> hi (values already extracted)
     dem_b0 = _value_at_f(dp, lo, iota_l)
     dem_c = _value_at_f(dp, hi, iota_l)
     gt_swp = jnp.where(iota_l == lo, c_, jnp.where(iota_l == hi, b0, gt))
-    dp_swp = jnp.where(
-        iota_l == lo, dem_c, jnp.where(iota_l == hi, dem_b0, dp)
-    )
+    dp_swp = jnp.where(iota_l == lo, dem_c, jnp.where(iota_l == hi, dem_b0, dp))
     cand = jnp.where(mt == 0, gt_rev, jnp.where(mt == 1, gt_rot, gt_swp))
     dp_cand = jnp.where(mt == 0, dp_rev, jnp.where(mt == 1, dp_rot, dp_swp))
 
-    # --- capacity + Metropolis ------------------------------------------
     cape_cand = _cap_excess_of(cand, dp_cand, cap0, lhat)
-    dist = dist_ref[:]
-    cape = cape_ref[:]
     new_dist = dist + ddist
     cur_cost = dist + wcap * cape
     cand_cost = new_dist + wcap * cape_cand
     delta = cand_cost - cur_cost
-    accept = (delta < 0.0) | (
-        u_ref[:] < jnp.exp(jnp.minimum(-delta / temp, 0.0))
-    )
+    accept = (delta < 0.0) | (u_row < jnp.exp(jnp.minimum(-delta / temp, 0.0)))
     gt_new = jnp.where(accept, cand, gt)
-    gt_out[:] = gt_new
-    dp_out[:] = jnp.where(accept, dp_cand, dp)
-    dist_out[:] = jnp.where(accept, new_dist, dist)
-    cape_out[:] = jnp.where(accept, cape_cand, cape)
-    # best-so-far tracking in-kernel: the XLA twin of this (a (L-hat, B)
-    # where per step) was ~40% of the step's wall at B=16k
+    dp_new = jnp.where(accept, dp_cand, dp)
+    dist_new = jnp.where(accept, new_dist, dist)
+    cape_new = jnp.where(accept, cape_cand, cape)
     committed = jnp.where(accept, cand_cost, cur_cost)
-    better = committed < bestc_ref[:]
-    best_out[:] = jnp.where(better, gt_new, best_ref[:])
-    bestc_out[:] = jnp.where(better, committed, bestc_ref[:])
+    better = committed < bestc
+    best_new = jnp.where(better, gt_new, best)
+    bestc_new = jnp.where(better, committed, bestc)
+    return gt_new, dp_new, dist_new, cape_new, best_new, bestc_new
 
 
-def _value_at_f(arr, pos_row, iota_l):
-    sel = iota_l == pos_row
-    return jnp.sum(jnp.where(sel, arr, 0.0), axis=0, keepdims=True)
+def _delta_block_kernel(
+    gt_ref, dp_ref, dist_ref, cape_ref, best_ref, bestc_ref,
+    i_ref, r_ref, mt_ref, m_ref, u_ref, temps_ref,
+    d_ref, knn_ref, scal_ref,
+    gt_out, dp_out, dist_out, cape_out, best_out, bestc_out,
+    *, length, has_knn, n_steps,
+):
+    """n_steps fused delta steps with ALL state VMEM-resident — one
+    kernel launch per block instead of per move (the per-step pallas
+    dispatch plus HBM state round-trip was ~40% of the step at B=16k)."""
+    lhat, t = gt_ref.shape
+    nhat = d_ref.shape[0]
+    d = d_ref[:]
+    knn = knn_ref[:]
+    cap0 = scal_ref[0, 0]
+    wcap = scal_ref[0, 1]
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (lhat, t), 0)
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
+    antidiag = (iota_r + iota_c == lhat - 1).astype(jnp.float32)
+
+    def body(k, carry):
+        gt, dp, dist, cape, best, bestc = carry
+        i_row = i_ref[pl.ds(k, 1), :]
+        r_row = r_ref[pl.ds(k, 1), :]
+        mt_row = mt_ref[pl.ds(k, 1), :]
+        m_row = m_ref[pl.ds(k, 1), :]
+        u_row = u_ref[pl.ds(k, 1), :]
+        temp = temps_ref[0, k]
+        return _step_body(
+            gt, dp, dist, cape, best, bestc,
+            i_row, r_row, mt_row, m_row, u_row, temp,
+            d, knn, cap0, wcap, iota_l, antidiag,
+            length=length, lhat=lhat, t=t, nhat=nhat, has_knn=has_knn,
+        )
+
+    carry = (
+        gt_ref[:], dp_ref[:], dist_ref[:], cape_ref[:],
+        best_ref[:], bestc_ref[:],
+    )
+    gt, dp, dist, cape, best, bestc = jax.lax.fori_loop(
+        0, n_steps, body, carry
+    )
+    gt_out[:] = gt
+    dp_out[:] = dp
+    dist_out[:] = dist
+    cape_out[:] = cape
+    best_out[:] = best
+    bestc_out[:] = bestc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("length", "tile_b", "has_knn", "interpret")
+)
+def delta_block(
+    gt_t, dp_t, dist, cape, best_t, best_c,
+    i, r, mt, m, u, temps, d_bf16, knn_f32, scal,
+    *, length, tile_b, has_knn, interpret=False,
+):
+    """A whole block of fused delta steps in one kernel launch.
+
+    i/r/mt/m/u: (n_steps, B); temps: (1, n_steps) f32 in SMEM; scal:
+    (1, 2) f32 [cap0, wcap]. Other arguments as delta_step."""
+    lhat, b = gt_t.shape
+    n_steps = i.shape[0]
+    grid = b // tile_b
+    kernel = functools.partial(
+        _delta_block_kernel, length=length, has_knn=has_knn, n_steps=n_steps
+    )
+    tall = pl.BlockSpec((lhat, tile_b), lambda g: (0, g))
+    row = pl.BlockSpec((1, tile_b), lambda g: (0, g))
+    steps = pl.BlockSpec((n_steps, tile_b), lambda g: (0, g))
+    out = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            tall, tall, row, row, tall, row,
+            steps, steps, steps, steps, steps,
+            pl.BlockSpec((1, n_steps), lambda g: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(d_bf16.shape, lambda g: (0, 0)),
+            pl.BlockSpec(knn_f32.shape, lambda g: (0, 0)),
+            pl.BlockSpec((1, 2), lambda g: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[tall, tall, row, row, tall, row],
+        out_shape=[
+            jax.ShapeDtypeStruct((lhat, b), jnp.int32),
+            jax.ShapeDtypeStruct((lhat, b), jnp.float32),
+            jax.ShapeDtypeStruct((1, b), jnp.float32),
+            jax.ShapeDtypeStruct((1, b), jnp.float32),
+            jax.ShapeDtypeStruct((lhat, b), jnp.int32),
+            jax.ShapeDtypeStruct((1, b), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gt_t, dp_t, dist, cape, best_t, best_c, i, r, mt, m, u, temps,
+      d_bf16, knn_f32, scal)
+    return out
 
 
 def _dp_init_kernel(gt_ref, dem_ref, dp_out):

@@ -473,8 +473,14 @@ def _delta_resync_fn(length: int):
 
 @lru_cache(maxsize=32)
 def _sa_delta_block_fn(n_block: int, length: int, tile_b: int, has_knn: bool):
-    """One jitted block of n_block fused delta steps + best tracking."""
-    from vrpms_tpu.kernels.sa_delta import delta_step
+    """One jitted block of n_block fused delta steps + best tracking:
+    presample the block's randomness and temperatures, then ONE
+    delta_block kernel launch with state VMEM-resident for the whole
+    block (measured the same step rate as a scan of per-step kernel
+    calls — the compute, not the dispatch, bounds the step — but the
+    single launch compiles far faster than a 512-call scan program,
+    which matters when each compile is a tunnel round trip)."""
+    from vrpms_tpu.kernels.sa_delta import delta_block
     from vrpms_tpu.moves.moves import presample_move_params
 
     @jax.jit
@@ -486,25 +492,15 @@ def _sa_delta_block_fn(n_block: int, length: int, tile_b: int, has_knn: bool):
         pri, prr, prmt, prm, pru = presample_move_params(
             kb, b, length, n_block, kw
         )
-
-        def step(st, xs):
-            it, i, r, mt, m, u = xs
-            gt_t, dp_t, dist, cape, best_t, best_c = st
-            temp = anneal_temperature(it, t0, t1, horizon)
-            scal = jnp.concatenate(
-                [temp[None, None].astype(jnp.float32), scal2], axis=1
-            )
-            st = delta_step(
-                gt_t, dp_t, dist, cape, best_t, best_c,
-                i[None, :], r[None, :], mt[None, :], m[None, :], u[None, :],
-                d_bf16, knn_f, scal,
-                length=length, tile_b=tile_b, has_knn=has_knn,
-            )
-            return st, None
-
-        xs = (start_it + jnp.arange(n_block), pri, prr, prmt, prm, pru)
-        state, _ = jax.lax.scan(step, state, xs)
-        return state
+        temps = anneal_temperature(
+            start_it + jnp.arange(n_block), t0, t1, horizon
+        )[None, :].astype(jnp.float32)
+        return delta_block(
+            gt_t, dp_t, dist, cape, best_t, best_c,
+            pri, prr, prmt, prm, pru, temps,
+            d_bf16, knn_f, scal2,
+            length=length, tile_b=tile_b, has_knn=has_knn,
+        )
 
     return run
 
@@ -548,9 +544,9 @@ def solve_sa_delta(
     b, length = giants.shape
     lhat = _pow2_at_least(length)
     nhat = -(-inst.n_nodes // 128) * 128
-    # 512-chain tiles measured fastest (fewer per-tile fixed costs);
-    # 1024 blows the VMEM budget at L-hat=256
-    tile_b = next((t for t in (512, 256, 128) if b % t == 0), None)
+    # 256-chain tiles measured fastest for the block kernel (512 blows
+    # the VMEM budget once the per-block param streams move in)
+    tile_b = next((t for t in (256, 128) if b % t == 0), None)
     if tile_b is None:
         raise ValueError(f"delta path needs a 128-multiple batch, got {b}")
 
