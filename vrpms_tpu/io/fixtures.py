@@ -48,10 +48,13 @@ FIXTURES: dict[str, tuple[str, str, float, int]] = {
     "C101.25": ("C101_25.txt", "vrptw", 191.3, 3),
 }
 
-# A-n33-k5.vrp is on disk but OUT of the registry: three independent ILS
-# runs plateau at 690 vs the published optimum 661 on a size where this
-# solver proves A-n32-k5 exactly — the transcription is suspect and stays
-# quarantined until branch-and-bound can adjudicate its true optimum.
+# A-n33-k5.vrp is on disk but OUT of the registry: the branch-and-bound
+# PROVED its transcription's optimum is 690 (8.3B nodes exhausted), not
+# the published 661 — the hand transcription is definitively wrong
+# somewhere, and shipping it as truth would corrupt the gap metric. It
+# stays as a record of the cross-check methodology doing its job (the
+# same proof certifies A-n32-k5's transcription: proven optimum 784 ==
+# published).
 
 
 def fixture_names() -> list[str]:
