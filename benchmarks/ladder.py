@@ -121,7 +121,7 @@ def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
         extra["certified_gap_ub_percent"] = _certified_gap(
             float(res.breakdown.distance), inst
         )
-    return _result(
+    line = _result(
         config,
         name,
         cost=round(float(res.breakdown.distance), 1),
@@ -131,6 +131,7 @@ def _sa_gap(inst, name, config, n_chains, n_iters, seed=0, bks=None):
         evals_per_sec=round(int(res.evals) / elapsed, 1),
         **extra,
     )
+    return line, res
 
 
 def _certified_gap(distance: float, inst):
@@ -277,24 +278,29 @@ def config2_small_cvrp(quick=False, vrp_path=None, exact_s=60.0):
 
         inst, meta = load_fixture("A-n32-k5")
         name, bks = "a-n32-k5-fixture", meta["bks"]
-    line = _sa_gap(inst, name, 2, 128, 2000 if quick else 20000, bks=bks)
+    line, res_h = _sa_gap(inst, name, 2, 128, 2000 if quick else 20000, bks=bks)
     if quick:
         exact_s = min(exact_s, 5.0)  # quick is the smoke pass, not a proof
     if exact_s and not inst.has_tw and not inst.time_dependent:
+        from vrpms_tpu.core.encoding import routes_from_giant
         from vrpms_tpu.solvers.exact import solve_cvrp_bnb
 
-        # +0.11 margin: line["cost"] is rounded to 1 decimal, and an ub
-        # below the true optimum would prune it away (the solve would
-        # then honestly report proven=False, but the proof is the point)
-        ub = line["cost"] + 0.11 if line["cap_excess"] == 0.0 else None
+        # the heuristic champion seeds the search as incumbent ROUTES,
+        # so an exhausted tree proves ITS optimality (a cost-only bound
+        # cannot certify what it returns — see solve_cvrp_bnb)
+        routes = cost = None
+        if line["cap_excess"] == 0.0:
+            routes = [r for r in routes_from_giant(np.asarray(res_h.giant)) if r]
+            cost = float(res_h.breakdown.distance)
         t0 = time.perf_counter()
         res, proven, stats = solve_cvrp_bnb(
-            inst, time_limit_s=float(exact_s), incumbent_cost=ub
+            inst, time_limit_s=float(exact_s),
+            incumbent_routes=routes, incumbent_cost=cost,
         )
         _result(
             2,
             name + "-exact",
-            exact_optimum=round(float(res.breakdown.distance), 1),
+            exact_cost=round(float(res.breakdown.distance), 1),
             exact_proven=bool(proven),
             bnb_nodes=int(stats["nodes"]),
             seconds=round(time.perf_counter() - t0, 2),
@@ -313,7 +319,7 @@ def config3_big_cvrp(quick=False, vrp_path=None):
 
         inst, name, bks = synth_cvrp(200, 36, seed=0), "cvrp-n200-k36-vmap-sa", None
     return _sa_gap(inst, name, 3, 256 if quick else 2048,
-                   2000 if quick else 20000, bks=bks)
+                   2000 if quick else 20000, bks=bks)[0]
 
 
 def config4_ga_islands(quick=False):
@@ -394,7 +400,7 @@ def config5_vrptw(quick=False, solomon_path=None):
         inst, meta = load_solomon(solomon_path)
         name = str(meta.get("name", "vrptw-solomon")).lower()
         bks = best_known(name)
-        return _sa_gap(inst, name, 5, 256, 2000 if quick else 30000, bks=bks)
+        return _sa_gap(inst, name, 5, 256, 2000 if quick else 30000, bks=bks)[0]
     from vrpms_tpu.io.fixtures import load_fixture
     from vrpms_tpu.io.synth import synth_vrptw
 
@@ -404,7 +410,7 @@ def config5_vrptw(quick=False, solomon_path=None):
         2000 if quick else 12000, bks=meta["bks"],
     )
     inst = synth_vrptw(101, 19, seed=13)
-    return _sa_gap(inst, "vrptw-r101-shaped", 5, 256, 2000 if quick else 30000)
+    return _sa_gap(inst, "vrptw-r101-shaped", 5, 256, 2000 if quick else 30000)[0]
 
 
 def main():
