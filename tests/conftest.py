@@ -87,6 +87,7 @@ _SLOW_PATTERNS = (
     "test_utils_info.py::TestSolveInfo",
     "test_fixtures.py::TestSolverBand",
     "test_sa_delta.py::TestDeltaStepKernel::test_many_steps_zero_drift_and_valid_tours",
+    "test_sa_delta.py::TestSolveSaDelta",
 )
 
 

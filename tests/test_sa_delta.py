@@ -164,3 +164,67 @@ class TestDeltaStepKernel:
         accept = (cnd < cur) | (u < jnp.exp(jnp.minimum((cur - cnd) / 5.0, 0.0)))
         g_ref = jnp.where(accept[:, None], cands, giants)
         assert (np.asarray(gt2[:L].T) == np.asarray(g_ref)).all()
+
+
+class TestSolveSaDelta:
+    """The solve-level delta driver under interpret mode (CPU CI): block
+    composition must use GLOBAL iteration offsets — a block that
+    restarts its schedule/RNG at 0 replays identical proposals at
+    replayed temperatures (the exact bug class this pins)."""
+
+    def test_driver_matches_manual_block_composition(self, monkeypatch):
+        import os
+
+        monkeypatch.setenv("VRPMS_DELTA_INTERPRET", "1")
+        from vrpms_tpu.core.cost import CostWeights
+        from vrpms_tpu.solvers.sa import (
+            _delta_prep,
+            _delta_resync_fn,
+            _sa_delta_block_fn,
+            _temps_from_scale,
+            _mean_fn,
+            solve_sa_delta,
+        )
+
+        inst = synth_cvrp(20, 4, seed=2)
+        w = CostWeights.make()
+        params = SAParams(n_chains=128, n_iters=700)  # 2 blocks: 512 + 188
+        res = solve_sa_delta(inst, key=5, params=params)
+        # manual composition with EXPLICIT global offsets
+        key = jax.random.key(5)
+        k_init, k_run = jax.random.split(key)
+        from vrpms_tpu.solvers.sa import _pow2_at_least, _sa_prep_fn
+
+        giants, _c, mean = _sa_prep_fn(128, "onehot")(k_init, inst, w)
+        t0, t1 = _temps_from_scale(float(mean), params)
+        b, length = giants.shape
+        lhat = _pow2_at_least(length)
+        nhat = 128
+        knn = knn_table(inst.durations[0], params.knn_k)
+        d_np = np.zeros((nhat, nhat), np.float32)
+        d_np[: inst.n_nodes, : inst.n_nodes] = np.asarray(inst.durations[0])
+        kf = np.zeros((nhat, knn.shape[1]), np.float32)
+        kf[: inst.n_nodes] = np.asarray(knn, np.float32)
+        cap0 = float(np.asarray(inst.capacities)[0])
+        scal2 = jnp.asarray([[cap0, float(w.cap)]], jnp.float32)
+        gt_t, dp_t, dist, cape = _delta_prep(giants, inst, w, lhat, nhat, 128, True)
+        state = (gt_t, dp_t, dist, cape, gt_t, dist + w.cap * cape)
+        horizon = jnp.float32(700)
+        for start, nb in ((0, 512), (512, 188)):
+            state = _sa_delta_block_fn(nb, length, 128, True, True)(
+                state, k_run, jnp.asarray(d_np, jnp.bfloat16),
+                jnp.asarray(kf), scal2, jnp.float32(t0), jnp.float32(t1),
+                jnp.int32(start), horizon,
+            )
+            # the driver resyncs between blocks; mirror it
+            dist2, cape2 = _delta_resync_fn(length, True)(state[0], inst, w)
+            state = (state[0], state[1], dist2, cape2, state[4], state[5])
+        champ = int(jnp.argmin(state[5][0]))
+        want_giant = np.asarray(state[4][:length, champ])
+        # the driver re-prices its champion exactly (f32) while best_c is
+        # the kernel's bf16-table cost, so compare the TOURS (identical
+        # trajectories) and the costs only to bf16 tolerance
+        assert (np.asarray(res.giant) == want_giant).all()
+        assert np.isclose(
+            float(res.cost), float(state[5][0][champ]), rtol=5e-3
+        )

@@ -425,7 +425,8 @@ def _pow2_at_least(x: int) -> int:
     return p
 
 
-def _delta_prep(giants, inst, w, lhat: int, nhat: int, tile_b: int):
+def _delta_prep(giants, inst, w, lhat: int, nhat: int, tile_b: int,
+                interpret: bool = False):
     """giants [B, L] -> transposed padded state + exact dist/cape.
 
     Everything stays on device: dist/cape via two fused-eval kernel
@@ -439,15 +440,15 @@ def _delta_prep(giants, inst, w, lhat: int, nhat: int, tile_b: int):
 
     b, length = giants.shape
     gt_t = jnp.zeros((lhat, b), jnp.int32).at[:length].set(giants.T)
-    dist, cape = _delta_resync_fn(length)(gt_t, inst, w)
+    dist, cape = _delta_resync_fn(length, interpret)(gt_t, inst, w)
     dem_row = np.zeros((1, nhat), np.float32)
     dem_row[0, : inst.n_nodes] = np.asarray(inst.demands)
-    dp_t = dp_init(gt_t, jnp.asarray(dem_row), tile_b=tile_b)
+    dp_t = dp_init(gt_t, jnp.asarray(dem_row), tile_b=tile_b, interpret=interpret)
     return gt_t, dp_t, dist, cape
 
 
 @lru_cache(maxsize=16)
-def _delta_resync_fn(length: int):
+def _delta_resync_fn(length: int, interpret: bool = False):
     """Exact dist/cape of the transposed state — the block-boundary
     drift killer (f32 sums of the SAME bf16 table the deltas read).
     Runs as TWO fused-eval kernel passes (wcap 0 then 1; their
@@ -464,15 +465,22 @@ def _delta_resync_fn(length: int):
         gt = gt_t[:length]
         w0 = _dc.replace(w, cap=0.0)
         w1 = _dc.replace(w, cap=1.0)
-        dist = pallas_objective_batch(gt, inst, w0, transposed=True)
-        both = pallas_objective_batch(gt, inst, w1, transposed=True)
+        dist = pallas_objective_batch(
+            gt, inst, w0, transposed=True, interpret=interpret
+        )
+        both = pallas_objective_batch(
+            gt, inst, w1, transposed=True, interpret=interpret
+        )
         return dist[None, :], (both - dist)[None, :]
 
     return resync
 
 
 @lru_cache(maxsize=32)
-def _sa_delta_block_fn(n_block: int, length: int, tile_b: int, has_knn: bool):
+def _sa_delta_block_fn(
+    n_block: int, length: int, tile_b: int, has_knn: bool,
+    interpret: bool = False,
+):
     """One jitted block of n_block fused delta steps + best tracking:
     presample the block's randomness and temperatures, then ONE
     delta_block kernel launch with state VMEM-resident for the whole
@@ -500,6 +508,7 @@ def _sa_delta_block_fn(n_block: int, length: int, tile_b: int, has_knn: bool):
             pri, prr, prmt, prm, pru, temps,
             d_bf16, knn_f, scal2,
             length=length, tile_b=tile_b, has_knn=has_knn,
+            interpret=interpret,
         )
 
     return run
@@ -565,7 +574,12 @@ def solve_sa_delta(
     cap0 = float(np.asarray(inst.capacities)[0])
     scal2 = jnp.asarray([[cap0, float(w.cap)]], jnp.float32)
 
-    gt_t, dp_t, dist, cape = _delta_prep(giants, inst, w, lhat, nhat, tile_b)
+    import os as _os
+
+    interpret = bool(_os.environ.get("VRPMS_DELTA_INTERPRET"))
+    gt_t, dp_t, dist, cape = _delta_prep(
+        giants, inst, w, lhat, nhat, tile_b, interpret
+    )
     best_c = dist + float(w.cap) * cape
     state = (gt_t, dp_t, dist, cape, gt_t, best_c)
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
@@ -577,7 +591,7 @@ def solve_sa_delta(
     # restarts at 0 replays the same proposals at replayed temperatures)
 
     def step_block(st, nb, start):
-        return _sa_delta_block_fn(nb, length, tile_b, has_knn)(
+        return _sa_delta_block_fn(nb, length, tile_b, has_knn, interpret)(
             st, k_run, d_bf16, knn_f, scal2, t0j, t1j,
             jnp.int32(base_it + start), horizon,
         )
@@ -586,7 +600,7 @@ def solve_sa_delta(
     # same deadline/rate contract as solve_sa
     from vrpms_tpu.solvers.common import run_blocked
 
-    resync = _delta_resync_fn(length)
+    resync = _delta_resync_fn(length, interpret)
     rate_key = ("delta", b, length)
     import time as _time
 
