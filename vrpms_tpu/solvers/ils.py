@@ -217,11 +217,18 @@ def ils_loop(
     best_c = float("inf")
     evals = 0
     init = init_giants
+    # A round commits to its FIXED tail (>= one polish block + exact
+    # champion eval + reseed) no matter how little clock is left, so the
+    # don't-start gate must know what that tail actually costs HERE —
+    # ~0.3 s locally, 1-2 s through a tunneled TPU. Measure it from the
+    # previous round instead of trusting the static min_round_s floor
+    # (26-round budget solves overshot ~25% on the static floor alone).
+    fixed_tail = 0.0
     for r in range(params.rounds):
         budget = remaining()
         if (
             budget is not None
-            and budget <= max(0.0, params.min_round_s)
+            and budget <= max(0.0, params.min_round_s, fixed_tail)
             and best_g is not None
         ):
             break
@@ -229,7 +236,9 @@ def ils_loop(
             # withhold the polish reserve from the anneal (the anneal
             # still runs at least one block on a non-positive budget)
             budget = budget - params.polish_reserve_s
+        t_round = time.monotonic()
         res = anneal(jax.random.fold_in(key, r), init, budget)
+        t_anneal_done = time.monotonic()
         evals += int(res.evals)
         tlog(f"round {r}: anneal done ({int(res.evals)} evals)")
         # Polish in deadline-checked blocks (the same never-overshoot-
@@ -294,6 +303,8 @@ def ils_loop(
             else:
                 init = perturbed_clones(k_reseed, reseed_batch, best_g, mode)
             tlog(f"round {r}: reseeded ({params.reseed})")
+        # everything after the anneal is this round's fixed tail
+        fixed_tail = time.monotonic() - t_anneal_done
 
     bd, cost = exact_cost(best_g, inst, w)
     # saturate rather than overflow: extreme budgets exceed int32
