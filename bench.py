@@ -290,27 +290,12 @@ def _family_quality(device):
     )
     # ... and the rate-fitted SHRUNK block shapes (run_blocked trims the
     # final block to 128-multiples): uncompiled, each costs a one-time
-    # tunnel compile that would masquerade as budget overshoot. The ILS
-    # anneal takes the delta path on this instance, so warm THOSE block
-    # shapes (and the full-eval ones its fallback would use).
-    from vrpms_tpu.core.cost import CostWeights
-    from vrpms_tpu.solvers.sa import _delta_supported, solve_sa, solve_sa_delta
+    # tunnel compile that would masquerade as budget overshoot. The
+    # shared startup warm (also run by service.warmup and the ladder
+    # budget path) compiles every block shape and persists sweep rates.
+    from vrpms_tpu.solvers.sa import warm_anneal_blocks
 
-    # (the generous deadline changes nothing about the warm run except
-    # recording the measured sweeps/s into the solver's rate cache, so
-    # the measured solve below fits its very first late-round block)
-    delta_ok = _delta_supported(inst, CostWeights.make(), "pallas")
-    for nb in (128, 256, 384):
-        if delta_ok:
-            solve_sa_delta(
-                inst, key=97,
-                params=SAParams(n_chains=4096, n_iters=nb), deadline_s=60.0,
-            )
-        else:
-            solve_sa(
-                inst, key=97,
-                params=SAParams(n_chains=4096, n_iters=nb), deadline_s=60.0,
-            )
+    warm_anneal_blocks(inst, 4096)
     budget = 10.0
     t0 = time.perf_counter()
     res = solve_ils(inst, key=0, params=p, deadline_s=budget)

@@ -187,8 +187,28 @@ def config3_budget(seconds, vrp_path=None, seed=0, chains=4096, rounds=None,
         res = solve_ils(inst, key=k, params=p, deadline_s=float(seconds))
         return res, time.perf_counter() - t0
 
-    # cold: first solve of the process (pays per-program load/dispatch
-    # round trips even with a warm disk compile cache — the restarted-
+    # Startup warmup, exactly what a restarted service runs before
+    # accepting requests (service.warmup): two small untimed ILS rounds
+    # compile/load the pipeline programs (anneal, polish, reseed, exact
+    # eval), then warm_anneal_blocks covers the rate-fitted shrunk block
+    # shapes and persists measured sweep rates. This is counted in the
+    # budget-series' process_seconds, NOT in the solve wall — the
+    # north-star claim is that a SOLVE honors its deadline, and before
+    # this warm existed the first tight-deadline solve absorbed those
+    # compiles (12.0 s at a 1 s budget; VERDICT round 3).
+    from vrpms_tpu.solvers.sa import warm_anneal_blocks
+
+    t_warm = time.perf_counter()
+    solve_ils(
+        inst, key=99,
+        params=ILSParams.from_budget(
+            2, SAParams(n_chains=chains, n_iters=0), 2 * 512, pool=32
+        ),
+    )
+    warm_anneal_blocks(inst, chains)
+    warm_s = time.perf_counter() - t_warm
+
+    # cold: first timed solve after startup warmup (the restarted-
     # service number); steady: the long-running-service number.
     res, elapsed = one(seed)
     res2, elapsed2 = one(seed + 1)
@@ -209,6 +229,7 @@ def config3_budget(seconds, vrp_path=None, seed=0, chains=4096, rounds=None,
         3,
         name,
         budget_s=float(seconds),
+        warmup_seconds=round(warm_s, 2),
         cost=round(float(res.breakdown.distance), 1),
         cap_excess=float(res.breakdown.cap_excess),
         solve_seconds=round(elapsed, 2),

@@ -303,7 +303,11 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 ):
                     return solve_tsp_exact(inst, weights=w)
                 return solve_tsp_bf(inst, weights=w, deadline_s=deadline)
-            from vrpms_tpu.solvers.exact import MAX_BNB_CUSTOMERS, solve_cvrp_bnb
+            from vrpms_tpu.solvers.exact import (
+                MAX_BNB_CUSTOMERS,
+                InfeasibleError,
+                solve_cvrp_bnb,
+            )
 
             if (
                 MAX_BF_CUSTOMERS < inst.n_customers <= MAX_BNB_CUSTOMERS
@@ -313,11 +317,22 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
             ):
                 # explicit timeLimit 0 means "stop ASAP" (same semantics
                 # as _deadline everywhere else), not "no limit"
-                res, _proven, _stats = solve_cvrp_bnb(
-                    inst, weights=w,
-                    time_limit_s=60.0 if deadline is None else deadline,
-                )
-                return res
+                try:
+                    res, _proven, _stats = solve_cvrp_bnb(
+                        inst, weights=w,
+                        time_limit_s=60.0 if deadline is None else deadline,
+                    )
+                    return res
+                except InfeasibleError:
+                    # No capacity-feasible solution exists: the B&B has
+                    # nothing to return, and enumeration is out of range
+                    # at these sizes — answer with the penalized
+                    # best-effort NN + local-search packing instead of a
+                    # Solver error, matching the deadline contract every
+                    # other solver honors (ADVICE round 3).
+                    from vrpms_tpu.solvers.local_search import solve_nn_2opt
+
+                    return solve_nn_2opt(inst, weights=w)
             return solve_vrp_bf(inst, weights=w, deadline_s=deadline)
         if algorithm == "sa":
             p = SAParams(

@@ -91,6 +91,15 @@ def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True) -> float:
             if errors and log:
                 print(f"[warmup] {n}x{v} {algo}: {errors}", file=sys.stderr)
             del res, res2
+            if algo == "sa":
+                # every shrunk deadline-block shape + a persisted
+                # sweeps/s per shape, so the FIRST timeLimit request of
+                # this (and the next) process opens with a fitted block
+                # instead of compiling mid-solve (VERDICT round-3
+                # budget-fidelity item)
+                from vrpms_tpu.solvers.sa import warm_anneal_blocks
+
+                warm_anneal_blocks(inst, pop or 128)
     elapsed = time.perf_counter() - t_start
     if log:
         print(f"[warmup] {spec} ({','.join(algorithms)}): {elapsed:.1f}s",

@@ -104,3 +104,76 @@ class TestPallasObjective:
         # N == 128 forces the padded demand column into a bumped tile
         inst = _synth(rng, 128, [300.0] * 4)
         _check(inst, rtol=2e-2)
+
+
+class TestDemandScale:
+    """bf16-exactness of the packed demand column via gcd scaling
+    (ADVICE round 3: unscaled large demands let the kernel rank slightly
+    infeasible tours as feasible)."""
+
+    def test_scale_values(self):
+        from vrpms_tpu.kernels.sa_eval import demand_scale
+
+        assert demand_scale(np.array([0.0, 3, 7, 250])) == 1.0
+        # E-n22-k4 shape: large integers with gcd 100
+        assert demand_scale(np.array([0.0, 100, 2500, 1200])) == 100.0
+        # irreducible large demands: no exact scaling
+        assert demand_scale(np.array([0.0, 257, 1000, 999])) is None
+        # non-integral demands: no exact scaling
+        assert demand_scale(np.array([0.0, 1.5, 2.25])) is None
+        assert demand_scale(np.array([0.0, -1.0, 5.0])) is None
+
+    def test_large_gcd_demands_exact_on_homog_path(self):
+        # demands 100x a small integer pattern — bf16 would round them
+        # (ulp 16 at 2500); the gcd scaling must keep capacity excess
+        # EXACT so near-boundary feasibility never flips
+        from vrpms_tpu.kernels.sa_eval import _homogeneous_capacity, demand_scale
+
+        rng = np.random.default_rng(7)
+        n = 24
+        d = rng.uniform(1.0, 100.0, size=(n, n))
+        np.fill_diagonal(d, 0.0)
+        demands = np.concatenate([[0], rng.integers(1, 26, size=n - 1)]) * 100.0
+        inst = make_instance(d, demands=demands, capacities=[4000.0] * 5)
+        assert _homogeneous_capacity(inst) == 4000.0
+        assert demand_scale(inst.demands) == 100.0
+        giants = random_giant_batch(jax.random.key(2), 128, n - 1, 5)
+        from vrpms_tpu.core.cost import _cap_excess_hot, _legs_hot, _rid_batch
+
+        prev_oh, _, _, _ = _legs_hot(giants, inst)
+        cape_ref = np.asarray(
+            _cap_excess_hot(prev_oh, _rid_batch(giants), inst)
+        )
+        w0 = CostWeights.make(cap=0.0)
+        w1 = CostWeights.make(cap=1.0)
+        dist = np.asarray(pallas_objective_batch(giants, inst, w0, interpret=True))
+        both = np.asarray(pallas_objective_batch(giants, inst, w1, interpret=True))
+        np.testing.assert_allclose(both - dist, cape_ref, rtol=1e-5, atol=1e-3)
+
+    def test_unscalable_demands_fall_back_exact(self):
+        # demands with no bf16-exact scaling must take the f32 general
+        # kernel and still price excess exactly
+        rng = np.random.default_rng(8)
+        n = 16
+        d = rng.uniform(1.0, 100.0, size=(n, n))
+        np.fill_diagonal(d, 0.0)
+        demands = np.concatenate([[0], rng.integers(300, 999, size=n - 1)]).astype(
+            float
+        )
+        demands[1] = 257.0  # force gcd 1 with max > 256
+        inst = make_instance(d, demands=demands, capacities=[2000.0] * 4)
+        from vrpms_tpu.kernels.sa_eval import demand_scale
+
+        assert demand_scale(inst.demands) is None
+        giants = random_giant_batch(jax.random.key(3), 128, n - 1, 4)
+        from vrpms_tpu.core.cost import _cap_excess_hot, _legs_hot, _rid_batch
+
+        prev_oh, _, _, _ = _legs_hot(giants, inst)
+        cape_ref = np.asarray(
+            _cap_excess_hot(prev_oh, _rid_batch(giants), inst)
+        )
+        w0 = CostWeights.make(cap=0.0)
+        w1 = CostWeights.make(cap=1.0)
+        dist = np.asarray(pallas_objective_batch(giants, inst, w0, interpret=True))
+        both = np.asarray(pallas_objective_batch(giants, inst, w1, interpret=True))
+        np.testing.assert_allclose(both - dist, cape_ref, rtol=1e-5, atol=1e-3)

@@ -207,7 +207,9 @@ class TestSolveSaDelta:
         kf[: inst.n_nodes] = np.asarray(knn, np.float32)
         cap0 = float(np.asarray(inst.capacities)[0])
         scal2 = jnp.asarray([[cap0, float(w.cap)]], jnp.float32)
-        gt_t, dp_t, dist, cape = _delta_prep(giants, inst, w, lhat, nhat, 128, True)
+        gt_t, dp_t, dist, cape = _delta_prep(
+            giants, inst, w, lhat, nhat, 128, 1.0, True
+        )
         state = (gt_t, dp_t, dist, cape, gt_t, dist + w.cap * cape)
         horizon = jnp.float32(700)
         for start, nb in ((0, 512), (512, 188)):
@@ -219,7 +221,11 @@ class TestSolveSaDelta:
             # the driver resyncs between blocks; mirror it
             dist2, cape2 = _delta_resync_fn(length, True)(state[0], inst, w)
             state = (state[0], state[1], dist2, cape2, state[4], state[5])
-        champ = int(jnp.argmin(state[5][0]))
+        # mirror the driver's exact best-pool re-rank (ADVICE r3: the raw
+        # kernel tracker carries drift; selection goes by resynced cost)
+        bd2, bc2 = _delta_resync_fn(length, True)(state[4], inst, w)
+        best_exact = bd2 + w.cap * bc2
+        champ = int(jnp.argmin(best_exact[0]))
         want_giant = np.asarray(state[4][:length, champ])
         # the driver re-prices its champion exactly (f32) while best_c is
         # the kernel's bf16-table cost, so compare the TOURS (identical

@@ -161,8 +161,10 @@ class TestErrorEnvelope:
         assert "No location set found" in resp["errors"][0]["reason"]
 
     def test_bf_too_large_is_solver_error(self, server):
+        # past the branch-and-bound's 34-customer bound (11-34 now
+        # dispatch to the exact B&B instead of erroring)
         rng = np.random.default_rng(0)
-        n = 13
+        n = 41
         d = rng.uniform(1, 10, size=(n, n))
         mem.seed_locations("big", [{"id": i} for i in range(n)])
         mem.seed_durations("bigd", d.tolist())
@@ -501,6 +503,36 @@ class TestVRPSolve:
         want, proven, _ = solve_cvrp_bnb(inst, time_limit_s=60)
         assert proven
         assert abs(msg["durationSum"] - float(want.breakdown.distance)) < 1e-2
+
+    def test_bf_infeasible_instance_returns_best_effort(self, server):
+        # 12 customers whose total demand exceeds the whole fleet: the
+        # branch-and-bound has NO capacity-feasible solution (it raises),
+        # so the endpoint must fall back to enumeration's penalized
+        # best-effort result instead of a Solver error (ADVICE round 3)
+        rng = np.random.default_rng(6)
+        pts = rng.uniform(0, 100, size=(13, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        mem.seed_locations(
+            "locs_over",
+            [{"id": i, "name": f"o{i}", "demand": 9 if i else 0} for i in range(13)],
+        )
+        mem.seed_durations("durs_over", d.tolist())
+        status, resp = post(
+            server,
+            "/api/vrp/bf",
+            vrp_body(
+                locationsKey="locs_over",
+                durationsKey="durs_over",
+                capacities=[10, 10],  # 2 * 10 < 12 * 9 demand
+                startTimes=[0, 0],
+                timeLimit=5,
+            ),
+        )
+        assert status == 200, resp
+        visited = sorted(
+            c for v in resp["message"]["vehicles"] for c in v["tour"][1:-1]
+        )
+        assert visited == list(range(1, 13))
 
     def test_aco_islands_and_pool(self, server):
         # ACO honors islands (per-device colonies, elite ring) and

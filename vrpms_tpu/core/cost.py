@@ -459,10 +459,19 @@ def _td_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
     else:
         # flat travel lookup: index = slice*N*N + prev*N + cur; the
         # (prev, cur) part is departure-independent, precomputed per leg.
-        # int64 when T*N*N would overflow int32 (ADVICE round 2: silent
-        # garbage gathers at extreme shapes otherwise).
+        # T*N*N beyond int32 would gather garbage silently — and the
+        # obvious jnp.int64 fix is a no-op here because x64 is never
+        # enabled (int64 canonicalizes to int32; ADVICE round 3), so the
+        # shape is rejected loudly at trace time instead. A [T, N, N]
+        # table that big (~17 GB f32) exceeds HBM anyway.
         nn = n * n
-        idt = jnp.int64 if t_slices * nn > 2**31 - 1 else jnp.int32
+        if t_slices * nn > 2**31 - 1:
+            raise ValueError(
+                f"full-rank time-dependent durations with T*N*N = "
+                f"{t_slices * nn} exceed int32 flat indexing; reduce the "
+                "slice count or supply factorizable (low-rank) profiles"
+            )
+        idt = jnp.int32
         pn = prev.astype(idt) * n + cur.astype(idt)
         d_flat = inst.durations.reshape(t_slices * nn)
 

@@ -610,7 +610,12 @@ def qpath_completion_tables(inst: Instance, lam: np.ndarray, max_units: int = 40
     r_hi = min(len(caps), k)
     G = np.full((r_hi + 1, total + 1), np.inf)
     G[0, 0] = 0.0
-    finite_q = [q for q in range(1, cap_s + 1) if np.isfinite(route_q[q])]
+    # loads past the total demand can never be used — and q > total + 1
+    # would slice G with a NEGATIVE stop index, silently wrapping (it
+    # raised a broadcast error on capacity > total-demand instances)
+    finite_q = [
+        q for q in range(1, min(cap_s, total) + 1) if np.isfinite(route_q[q])
+    ]
     for r in range(1, r_hi + 1):
         G[r] = G[r - 1]
         for q in finite_q:

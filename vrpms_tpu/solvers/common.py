@@ -98,6 +98,14 @@ def run_blocked(
                     if done:
                         break
                     nb = min(128, n_total)  # a call always runs SOMETHING
+        elif nb > 128:
+            # No rate known at all (fresh shape, empty cache): open with
+            # one small block to MEASURE instead of committing a whole
+            # block blind — a full 512-sweep block against a 1 s budget
+            # was the residual first-solve overshoot (VERDICT round 3).
+            # Costs at most 3 extra host syncs on generous deadlines;
+            # the measured rate fits every later block.
+            nb = 128
         state = step_block(state, nb, done)
         jax.block_until_ready(sync(state))
         done += nb

@@ -117,6 +117,12 @@ def solve_tsp_exact(inst: Instance, weights: CostWeights | None = None) -> Solve
 MAX_BNB_CUSTOMERS = 34
 
 
+class InfeasibleError(ValueError):
+    """No capacity-feasible solution exists for the instance — distinct
+    from precondition ValueErrors so dispatchers can fall back to a
+    penalized best-effort result ONLY for true infeasibility."""
+
+
 def _bnb_check(inst: Instance) -> tuple[int, float]:
     n = inst.n_customers
     if n > MAX_BNB_CUSTOMERS:
@@ -287,7 +293,7 @@ def solve_cvrp_bnb(
                 best_routes, best_cost = routes_n, cost_n
                 certified = True
             if best_routes is None:
-                raise ValueError("no capacity-feasible solution found")
+                raise InfeasibleError("no capacity-feasible solution found")
             stats["proven"] = bool(proven_n and certified)
             giant = giant_from_routes(best_routes, n, V)
             bd = evaluate_giant(giant, inst)
@@ -436,7 +442,7 @@ def solve_cvrp_bnb(
         pass
 
     if best_routes is None:
-        raise ValueError("no capacity-feasible solution found")
+        raise InfeasibleError("no capacity-feasible solution found")
     giant = giant_from_routes(best_routes, n, V)
     bd = evaluate_giant(giant, inst)
     res = SolveResult(giant, total_cost(bd, w), bd, jnp.int32(stats["nodes"]))

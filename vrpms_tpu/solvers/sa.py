@@ -442,6 +442,43 @@ def solve_sa(
     )
 
 
+def warm_anneal_blocks(
+    inst: Instance,
+    n_chains: int,
+    weights: CostWeights | None = None,
+    blocks: tuple = (128, 256, 384, 512),
+) -> None:
+    """Compile/load every deadline-block shape a (B, L) solve can need
+    and seed the persistent sweep-rate cache.
+
+    run_blocked shrinks blocks to 128-multiples, so a deadline-bounded
+    anneal touches at most the four shapes here; a fresh process that
+    meets them for the FIRST time inside a timed solve pays each one's
+    compile-or-load round trip against the user's budget (VERDICT
+    round 3: the 30 s budget point ran 51.5 s cold). Calling this at
+    service/ladder startup moves that cost out of every solve and
+    persists a measured sweeps/s per shape, so even the first
+    tight-deadline solve of the NEXT process opens with a fitted block.
+    Routes through solve_sa_delta/solve_sa exactly as a request would
+    (same prep, block, resync, and final-eval programs).
+    """
+    w = weights or CostWeights.make()
+    mode = resolve_eval_mode("auto")
+    # same guard as solve_ils: the delta kernel needs a 128-multiple batch
+    use_delta = _delta_supported(inst, w, mode) and n_chains % 128 == 0
+    # ascending: the rate-less first call opens with a 128 block anyway
+    # (run_blocked's conservative opener), so going small-to-large
+    # compiles each shape exactly once
+    for nb in sorted(blocks):
+        p = SAParams(n_chains=n_chains, n_iters=nb)
+        # the generous deadline only engages run_blocked's timed path so
+        # the measured rate lands in the persistent cache
+        if use_delta:
+            solve_sa_delta(inst, key=1, params=p, deadline_s=3600.0)
+        else:
+            solve_sa(inst, key=1, params=p, mode=mode, deadline_s=3600.0)
+
+
 # ---------------------------------------------------------------------------
 # Delta-evaluated anneal (fused Pallas step kernel)
 # ---------------------------------------------------------------------------
@@ -451,16 +488,22 @@ def _delta_supported(inst: Instance, w: CostWeights, mode: str) -> bool:
     """Host-side gate for the fused delta-step path: untimed symmetric
     uniform-capacity instances on a TPU backend (the reverse-move delta
     needs symmetry; TW/TD/makespan change non-local terms; heterogeneous
-    fleets break the uniform-capacity excess recompute)."""
+    fleets break the uniform-capacity excess recompute). Demands must
+    admit a bf16-exact gcd scaling (kernels.sa_eval.demand_scale) —
+    dp_init and the resync's packed demand column are bf16, and rounded
+    demands let slightly infeasible tours rank feasible (ADVICE r3)."""
     import numpy as np
 
     from vrpms_tpu.kernels.sa_delta import _PALLAS_OK
+    from vrpms_tpu.kernels.sa_eval import demand_scale
 
     if mode != "pallas" or not _PALLAS_OK:
         return False
     if inst.has_tw or inst.time_dependent or w.use_makespan or inst.het_fleet:
         return False
     if inst.n_nodes > 512:
+        return False
+    if demand_scale(inst.demands) is None:
         return False
     d = np.asarray(inst.durations[0])
     return bool(np.allclose(d, d.T, rtol=1e-6, atol=1e-6))
@@ -474,14 +517,17 @@ def _pow2_at_least(x: int) -> int:
 
 
 def _delta_prep(giants, inst, w, lhat: int, nhat: int, tile_b: int,
-                interpret: bool = False):
+                dem_g: float = 1.0, interpret: bool = False):
     """giants [B, L] -> transposed padded state + exact dist/cape.
 
     Everything stays on device: dist/cape via two fused-eval kernel
     passes (see _delta_resync_fn), per-position demands via the dp_init
     kernel (the XLA one-hot einsum moved ~2 GB of intermediates at
     B=16k, and a host fancy-index round-trips the state through the
-    TPU tunnel — both measured slower than the 512 steps they set up)."""
+    TPU tunnel — both measured slower than the 512 steps they set up).
+    Demands and the returned cape are in demand/dem_g units (the gcd
+    scaling that keeps dp_init's bf16 matvecs exact; the kernel's
+    excess weight carries the g factor back — see solve_sa_delta)."""
     import numpy as np
 
     from vrpms_tpu.kernels.sa_delta import dp_init
@@ -489,8 +535,9 @@ def _delta_prep(giants, inst, w, lhat: int, nhat: int, tile_b: int,
     b, length = giants.shape
     gt_t = jnp.zeros((lhat, b), jnp.int32).at[:length].set(giants.T)
     dist, cape = _delta_resync_fn(length, interpret)(gt_t, inst, w)
+    cape = cape / dem_g  # resync returns real-unit excess
     dem_row = np.zeros((1, nhat), np.float32)
-    dem_row[0, : inst.n_nodes] = np.asarray(inst.demands)
+    dem_row[0, : inst.n_nodes] = np.asarray(inst.demands) / dem_g
     dp_t = dp_init(gt_t, jnp.asarray(dem_row), tile_b=tile_b, interpret=interpret)
     return gt_t, dp_t, dist, cape
 
@@ -619,16 +666,30 @@ def solve_sa_delta(
         knn_f = jnp.asarray(kf)
     else:
         knn_f = jnp.zeros((nhat, 8), jnp.float32)
+    # gcd demand scaling (kernels.sa_eval.demand_scale): the kernel's
+    # dp/cape state runs in demand/g units against capacity/g, with the
+    # g folded into the excess weight — bf16-exact for any integral
+    # demands with max/gcd <= 256 (the _delta_supported gate).
+    from vrpms_tpu.kernels.sa_eval import demand_scale
+
+    dem_g = demand_scale(inst.demands)
+    if dem_g is None:
+        raise ValueError(
+            "solve_sa_delta needs bf16-exact-scalable demands "
+            "(integral, max/gcd <= 256); see _delta_supported"
+        )
     cap0 = float(np.asarray(inst.capacities)[0])
-    scal2 = jnp.asarray([[cap0, float(w.cap)]], jnp.float32)
+    scal2 = jnp.asarray(
+        [[cap0 / dem_g, float(w.cap) * dem_g]], jnp.float32
+    )
 
     import os as _os
 
     interpret = bool(_os.environ.get("VRPMS_DELTA_INTERPRET"))
     gt_t, dp_t, dist, cape = _delta_prep(
-        giants, inst, w, lhat, nhat, tile_b, interpret
+        giants, inst, w, lhat, nhat, tile_b, dem_g, interpret
     )
-    best_c = dist + float(w.cap) * cape
+    best_c = dist + float(w.cap) * dem_g * cape
     state = (gt_t, dp_t, dist, cape, gt_t, best_c)
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
     horizon = jnp.float32(params.n_iters)
@@ -678,18 +739,25 @@ def solve_sa_delta(
         # but exactness is the contract)
         gt_t, dp_t, _, _, best_t, best_c = state
         dist, cape = resync(gt_t, inst, w)
-        state = (gt_t, dp_t, dist, cape, best_t, best_c)
+        state = (gt_t, dp_t, dist, cape / dem_g, best_t, best_c)
         if deadline_s is not None and _time.monotonic() - t_run >= deadline_s:
             break
         if did < block:
             break
 
     gt_t, dp_t, dist, cape, best_t, best_c = state
-    champ = jnp.argmin(best_c[0])
+    # Champion/elite selection by EXACT re-evaluated cost of the best
+    # pool: the kernel-tracked best_c carries accumulated delta drift
+    # that the block-boundary resync corrects only for the CURRENT
+    # state, so argmin over the raw tracker could discard a genuinely
+    # better elite (ADVICE round 3). Two fused-eval passes fix it.
+    bdist, bcape = resync(best_t, inst, w)
+    best_exact = bdist + float(w.cap) * bcape  # bcape is real-unit excess
+    champ = jnp.argmin(best_exact[0])
     g = best_t[:length, champ].T
     bd, cost = exact_cost(g, inst, w)
     elite = None
     if pool > 0:
-        order = jnp.argsort(best_c[0])[: min(pool, b)]
+        order = jnp.argsort(best_exact[0])[: min(pool, b)]
         elite = best_t[:length, :].T[order]
     return SolveResult(g, cost, bd, jnp.int32(b * done), elite)
