@@ -230,11 +230,62 @@ def _family_sa_delta(device):
     B, iters = 16384, 8192
     p = SAParams(n_chains=B, n_iters=iters)
     res, warm_s = _timed(lambda: solve_sa_delta(inst, key=1, params=p))
+    moves_per_sec = B * iters / warm_s
+    # Honest roofline for the delta path (VERDICT round-3 item 8): the
+    # algorithmically NECESSARY work per move is ~12 d-table reads plus
+    # an O(L) capacity recompute — about 2L+26 flops — so the useful
+    # FLOP rate is tiny by design: the kernel's value is deleting the
+    # one-hot selection overhead, not saturating the MXU. HBM traffic
+    # per move is the presampled param streams (5 x i32/f32) plus the
+    # block-amortized state round trip; everything else is VMEM-resident.
+    length = inst.n_customers + inst.n_vehicles + 1
+    lhat = 1 << (length - 1).bit_length()
+    useful_flops = 2.0 * length + 26.0
+    bytes_per_move = 5 * 4 + (3 * lhat * 4 * 2 + 6 * 4 * 2) / 512.0
     return {
-        "effective_moves_per_sec": round(B * iters / warm_s, 1),
+        "effective_moves_per_sec": round(moves_per_sec, 1),
         "seconds": round(warm_s, 2),
         "cost": round(float(res.breakdown.distance), 1),
         "cap_excess": float(res.breakdown.cap_excess),
+        "useful_flops_per_move": round(useful_flops, 1),
+        "useful_gflops_per_sec": round(moves_per_sec * useful_flops / 1e9, 2),
+        "hbm_bytes_per_move_est": round(bytes_per_move, 1),
+        "hbm_gb_per_sec_est": round(moves_per_sec * bytes_per_move / 1e9, 2),
+        "hbm_utilization_vs_v5e_819gbs_pct": round(
+            100 * moves_per_sec * bytes_per_move / 819e9, 2
+        ),
+    }
+
+
+def _family_sa_delta_tw(device):
+    """The fused VRPTW delta anneal (kernels.sa_delta_tw; VERDICT
+    round-3 item 2): per-position attribute/leg state + in-VMEM
+    max-plus lateness recompute per move. Target: >= 5x the full-eval
+    TW step at statistically indistinguishable quality."""
+    from vrpms_tpu.core.cost import CostWeights
+    from vrpms_tpu.io.synth import synth_vrptw
+    from vrpms_tpu.solvers.sa import (
+        SAParams,
+        _delta_supported,
+        solve_sa,
+        solve_sa_delta,
+    )
+
+    w = CostWeights.make()
+    inst = synth_vrptw(101, 19, seed=13)
+    assert _delta_supported(inst, w, "pallas")
+    B, iters = 4096, 4096
+    p = SAParams(n_chains=B, n_iters=iters)
+    res, warm_s = _timed(lambda: solve_sa_delta(inst, key=1, params=p, weights=w))
+    # equal-sweeps full-eval reference for the speedup ratio
+    _, full_s = _timed(lambda: solve_sa(inst, key=1, params=p, weights=w))
+    return {
+        "effective_moves_per_sec": round(B * iters / warm_s, 1),
+        "seconds": round(warm_s, 2),
+        "cost": round(float(res.cost), 1),
+        "tw_lateness": round(float(res.breakdown.tw_lateness), 2),
+        "cap_excess": float(res.breakdown.cap_excess),
+        "speedup_vs_full_eval": round(full_s / warm_s, 2),
     }
 
 
@@ -358,7 +409,8 @@ def main():
     if platform != "cpu":
         # the 4096-chain ILS budget solve is minutes per block on CPU
         fam_fns["quality_at_10s"] = _family_quality
-        fam_fns["sa_delta"] = _family_sa_delta  # Mosaic kernel: TPU only
+        fam_fns["sa_delta"] = _family_sa_delta  # Mosaic kernels: TPU only
+        fam_fns["sa_delta_tw"] = _family_sa_delta_tw
     for fam, fn in fam_fns.items():
         try:
             t0 = time.perf_counter()
@@ -386,18 +438,32 @@ def main():
         "families": families,
     }
     if platform != "cpu":
-        # Roofline anchor (VERDICT round-2): the one-hot/Pallas objective
-        # spends ~2*L*N_pad^2 bf16 MACs per candidate route (N padded to
-        # the 256 lane tile). Most of those FLOPs are one-hot *selection*
-        # overhead rather than algorithmically necessary work — that is
-        # exactly the headroom the delta-evaluated paths chase — so MFU
-        # here anchors the throughput claim, it does not flatter it.
+        # Roofline (VERDICT round-3 item 8: make every basis explicit).
+        # The one-hot/Pallas objective EXECUTES ~2*L*N_pad^2 bf16 MACs
+        # per candidate route (N padded to the 256 lane tile) — real MXU
+        # work, but mostly one-hot *selection* overhead rather than
+        # algorithmically necessary math (the delta path deletes exactly
+        # that). So the MFU figure is executed-MAC utilization on the
+        # one-hot basis, NOT useful-work efficiency; the useful-work
+        # numbers beside it are the defensible ones (2L flops per route:
+        # L distance adds + L demand adds).
         length = inst.n_customers + inst.n_vehicles + 1
         flops_per_route = 2.0 * length * 256 * 256
         achieved = value * flops_per_route
         v5e_bf16_peak = 197e12
-        result["achieved_tflops_est"] = round(achieved / 1e12, 1)
-        result["mfu_vs_v5e_bf16_peak_pct"] = round(100 * achieved / v5e_bf16_peak, 1)
+        result["onehot_tflops_executed_est"] = round(achieved / 1e12, 1)
+        result["mfu_onehot_basis_pct"] = round(100 * achieved / v5e_bf16_peak, 1)
+        useful = 2.0 * length
+        lhat_b = 1 << (length - 1).bit_length()
+        result["useful_flops_per_route"] = useful
+        result["useful_gflops_per_sec"] = round(value * useful / 1e9, 2)
+        # HBM per route: the (L-hat) i32 tour column in, the f32 cost out
+        # (one-hot intermediates stay in VMEM in the fused kernel)
+        bytes_per_route = lhat_b * 4 + 4
+        result["hbm_gb_per_sec_est"] = round(value * bytes_per_route / 1e9, 2)
+        result["hbm_utilization_vs_v5e_819gbs_pct"] = round(
+            100 * value * bytes_per_route / 819e9, 2
+        )
     print(json.dumps(result))
 
 

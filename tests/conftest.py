@@ -88,6 +88,11 @@ _SLOW_PATTERNS = (
     "test_fixtures.py::TestSolverBand",
     "test_sa_delta.py::TestDeltaStepKernel::test_many_steps_zero_drift_and_valid_tours",
     "test_sa_delta.py::TestSolveSaDelta",
+    # TW delta kernel: the always-accept trajectory test stays quick as
+    # the representative; the rest are interpret-mode solves
+    "test_sa_delta_tw.py::TestTwDeltaKernel::test_metropolis_never_accepts_worse_at_zero_temp",
+    "test_sa_delta_tw.py::TestTwDeltaKernel::test_uniform_window_without_knn",
+    "test_sa_delta_tw.py::TestSolveSaDeltaTw::test_solve_level_driver",
 )
 
 

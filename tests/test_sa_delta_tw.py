@@ -1,0 +1,225 @@
+"""Fused VRPTW delta-step kernel (kernels.sa_delta_tw): interpret-mode
+equivalence and state-integrity on CPU.
+
+Strategy: the kernel and the XLA reference compute lateness with
+different (both valid) max-plus combination trees, so their costs agree
+only to fp tolerance — a single flipped Metropolis accept would fork
+trajectories and break exact comparison. The trajectory test therefore
+runs ALWAYS-ACCEPT (u = 0), which is decision-independent: after N
+steps the kernel's tours must EXACTLY equal N unconditional
+move_batch_from_params applications. State integrity then pins the
+per-position transform machinery (the legs junction fixes above all):
+every maintained array must exactly re-derive from the final tours.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vrpms_tpu.core.cost import (
+    CostWeights,
+    _legs_hot,
+    tw_components_batch,
+)
+from vrpms_tpu.io.synth import synth_vrptw
+from vrpms_tpu.moves import knn_table
+from vrpms_tpu.moves.moves import (
+    move_batch_from_params,
+    presample_move_params,
+)
+from vrpms_tpu.solvers.sa import SAParams, _pow2_at_least, initial_giants
+
+pytest.importorskip("jax.experimental.pallas")
+
+from vrpms_tpu.kernels import sa_delta_tw as K  # noqa: E402
+from vrpms_tpu.kernels.sa_delta import dp_init  # noqa: E402
+
+W = CostWeights.make()
+
+
+def _setup(n=22, v=4, batch=64, seed=5, knn_k=8):
+    inst = synth_vrptw(n, v, seed=seed)
+    giants = initial_giants(jax.random.key(1), batch, inst, SAParams(), "onehot")
+    b, length = giants.shape
+    lhat = _pow2_at_least(length)
+    nhat = 128
+    knn = knn_table(inst.durations[0], knn_k)
+    d_np = np.zeros((nhat, nhat), np.float32)
+    d_np[: inst.n_nodes, : inst.n_nodes] = np.asarray(inst.durations[0])
+    kf = np.zeros((nhat, knn_k), np.float32)
+    kf[: inst.n_nodes] = np.asarray(knn, np.float32)
+
+    gt_t = jnp.zeros((lhat, b), jnp.int32).at[:length].set(giants.T)
+
+    def attr_row(vec):
+        row = np.zeros((1, nhat), np.float32)
+        row[0, : inst.n_nodes] = np.asarray(vec)
+        return jnp.asarray(row)
+
+    dp_t = dp_init(gt_t, attr_row(inst.demands), tile_b=b, interpret=True)
+    sv_t = dp_init(
+        gt_t, attr_row(inst.service), tile_b=b, exact_f32=True, interpret=True
+    )
+    rd_t = dp_init(
+        gt_t, attr_row(inst.ready), tile_b=b, exact_f32=True, interpret=True
+    )
+    du_t = dp_init(
+        gt_t, attr_row(inst.due), tile_b=b, exact_f32=True, interpret=True
+    )
+    _, _, legs, _ = _legs_hot(giants, inst)
+    lg_t = jnp.zeros((lhat, b), jnp.float32).at[: length - 1].set(legs.T)
+    cap0 = float(np.asarray(inst.capacities)[0])
+    start0 = float(np.asarray(inst.start_times)[0])
+    scal = jnp.asarray(
+        [[cap0, float(W.cap), float(W.tw), start0]], jnp.float32
+    )
+    dist, cape, late, _, _ = tw_components_batch(giants, inst)
+    cost0 = (dist + W.cap * cape + W.tw * late)[None]
+    return (
+        inst, giants, length, lhat, knn,
+        jnp.asarray(d_np, jnp.bfloat16), jnp.asarray(kf), scal,
+        gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost0,
+    )
+
+
+def _kernel_state_checks(inst, length, gt_t, dp_t, sv_t, rd_t, du_t, lg_t):
+    """Every maintained per-position array must exactly re-derive from
+    the final tours — this is what pins the roll/junction-fix algebra."""
+    g = np.asarray(gt_t[:length].T)
+    for row in g:
+        assert sorted(x for x in row if x) == list(
+            range(1, inst.n_customers + 1)
+        )
+    dem = np.asarray(inst.demands)
+    sv = np.asarray(inst.service)
+    rd = np.asarray(inst.ready)
+    du = np.asarray(inst.due)
+    np.testing.assert_array_equal(np.asarray(dp_t[:length].T), dem[g])
+    np.testing.assert_array_equal(np.asarray(sv_t[:length].T), sv[g])
+    np.testing.assert_array_equal(np.asarray(rd_t[:length].T), rd[g])
+    np.testing.assert_array_equal(np.asarray(du_t[:length].T), du[g])
+    # legs: every entry must be the bf16-table value of its current leg
+    legs_ref = np.asarray(_legs_hot(jnp.asarray(g), inst)[2])
+    np.testing.assert_array_equal(
+        np.asarray(lg_t[: length - 1].T), legs_ref
+    )
+    # pad legs must stay zero (depot-to-depot)
+    assert (np.asarray(lg_t[length - 1 :]) == 0).all()
+
+
+class TestTwDeltaKernel:
+    def test_always_accept_matches_xla_trajectory(self):
+        (inst, giants, L, lhat, knn, d_bf16, knn_f, scal,
+         gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost0) = _setup()
+        b = giants.shape[0]
+        n_steps = 40
+        i, r, mt, m, _u = presample_move_params(
+            jax.random.key(3), b, L, n_steps, knn.shape[1]
+        )
+        u0 = jnp.zeros_like(_u)  # always accept: decision-independent
+        temps = jnp.full((1, n_steps), 1e6, jnp.float32)
+        out = K.delta_tw_block(
+            gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost0, gt_t, cost0,
+            i, r, mt, m, u0, temps, d_bf16, knn_f, scal,
+            length=L, tile_b=b, has_knn=True, interpret=True,
+        )
+        g_ref = giants
+        for s in range(n_steps):
+            g_ref = move_batch_from_params(
+                i[s], r[s], mt[s], m[s], g_ref, knn, "gather"
+            )
+        assert (np.asarray(out[0][:L].T) == np.asarray(g_ref)).all()
+        _kernel_state_checks(inst, L, *out[:6])
+        # the maintained cost row must track the XLA evaluation of the
+        # same tours (fp tolerance: different max-plus trees)
+        dist, cape, late, _, _ = tw_components_batch(out[0][:L].T, inst)
+        want = np.asarray(dist + W.cap * cape + W.tw * late)
+        np.testing.assert_allclose(
+            np.asarray(out[6][0]), want, rtol=1e-4, atol=1e-2
+        )
+
+    def test_metropolis_never_accepts_worse_at_zero_temp(self):
+        (inst, giants, L, lhat, knn, d_bf16, knn_f, scal,
+         gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost0) = _setup(seed=9)
+        b = giants.shape[0]
+        n_steps = 60
+        i, r, mt, m, u = presample_move_params(
+            jax.random.key(7), b, L, n_steps, knn.shape[1]
+        )
+        u = jnp.maximum(u, 1e-9)
+        temps = jnp.full((1, n_steps), 1e-6, jnp.float32)
+        out = K.delta_tw_block(
+            gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost0, gt_t, cost0,
+            i, r, mt, m, u, temps, d_bf16, knn_f, scal,
+            length=L, tile_b=b, has_knn=True, interpret=True,
+        )
+        _kernel_state_checks(inst, L, *out[:6])
+        # at ~zero temperature the committed cost is non-increasing, so
+        # the final cost row must be <= the initial one (+fp slack)
+        assert (
+            np.asarray(out[6][0]) <= np.asarray(cost0[0]) + 1e-3
+        ).all()
+        # and best tracking can only be better than the committed state
+        assert (np.asarray(out[8][0]) <= np.asarray(out[6][0]) + 1e-4).all()
+
+    def test_uniform_window_without_knn(self):
+        (inst, giants, L, lhat, knn, d_bf16, knn_f, scal,
+         gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost0) = _setup(seed=11)
+        b = giants.shape[0]
+        n_steps = 25
+        i, r, mt, m, _u = presample_move_params(
+            jax.random.key(13), b, L, n_steps, 0
+        )
+        u0 = jnp.zeros_like(_u)
+        temps = jnp.full((1, n_steps), 1e6, jnp.float32)
+        out = K.delta_tw_block(
+            gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost0, gt_t, cost0,
+            i, r, mt, m, u0, temps, d_bf16, knn_f, scal,
+            length=L, tile_b=b, has_knn=False, interpret=True,
+        )
+        g_ref = giants
+        for s in range(n_steps):
+            g_ref = move_batch_from_params(
+                i[s], r[s], mt[s], m[s], g_ref, None, "gather"
+            )
+        assert (np.asarray(out[0][:L].T) == np.asarray(g_ref)).all()
+        _kernel_state_checks(inst, L, *out[:6])
+
+
+class TestSolveSaDeltaTw:
+    def test_solve_level_driver(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_DELTA_INTERPRET", "1")
+        from vrpms_tpu.core.cost import exact_cost
+        from vrpms_tpu.solvers.sa import solve_sa_delta
+
+        inst = synth_vrptw(18, 3, seed=2)
+        res = solve_sa_delta(
+            inst, key=4, params=SAParams(n_chains=128, n_iters=400)
+        )
+        row = [int(x) for x in np.asarray(res.giant) if x]
+        assert sorted(row) == list(range(1, inst.n_customers + 1))
+        # the returned cost is the exact re-evaluation of the champion
+        _, want = exact_cost(res.giant, inst, CostWeights.make())
+        assert np.isclose(float(res.cost), float(want), rtol=1e-6)
+
+    def test_gate_admits_tw_and_rejects_nonuniform_starts(self):
+        from vrpms_tpu.core import make_instance
+        from vrpms_tpu.solvers.sa import _delta_supported
+        from vrpms_tpu.kernels.sa_delta import _PALLAS_OK
+
+        if not _PALLAS_OK:
+            pytest.skip("pallas unavailable")
+        inst = synth_vrptw(20, 3, seed=1)
+        assert _delta_supported(inst, W, "pallas")
+        d = np.asarray(inst.durations[0])
+        inst2 = make_instance(
+            d,
+            demands=np.asarray(inst.demands),
+            capacities=np.asarray(inst.capacities).tolist(),
+            ready=np.asarray(inst.ready),
+            due=np.asarray(inst.due),
+            service=np.asarray(inst.service),
+            start_times=[0.0, 5.0, 0.0],
+        )
+        assert not _delta_supported(inst2, W, "pallas")

@@ -311,16 +311,10 @@ def _legs_hot(giants: jax.Array, inst: Instance):
     return prev_oh, next_oh, legs, dt
 
 
-def _tw_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Array:
-    """Gather-free batched objective for time-windowed instances.
-
-    The same max-plus associative-scan arrival propagation as _tw_eval
-    (see its derivation), but every per-leg quantity — leg duration,
-    service at the origin, ready/due at the destination, the route's
-    shift start — is a one-hot contraction instead of a gather, so the
-    whole evaluation vectorizes on TPU (gathers there lower to a scalar
-    loop ~50x slower). The scan itself runs batched over axis 1.
-    """
+def tw_components_batch(giants: jax.Array, inst: Instance):
+    """(distance, cap_excess, lateness, arrive, rid) of the one-hot TW
+    path — the components _tw_hot_batch combines, shared so the TW
+    delta solver can re-rank its pools in the exact same basis."""
     v = inst.n_vehicles
     prev_oh, next_oh, legs, dt = _legs_hot(giants, inst)
     dist = legs.sum(axis=1)
@@ -344,8 +338,6 @@ def _tw_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
         preferred_element_type=jnp.float32,
     )
 
-    # Max-plus affine maps, composed by a batched associative scan
-    # (semantics match _tw_eval exactly; see its docstring).
     t = jnp.where(from_depot, -BIG, legs + service_prev)
     r = jnp.where(from_depot, jnp.maximum(start + legs, ready_cur), ready_cur)
 
@@ -356,8 +348,22 @@ def _tw_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
 
     _, arrive = jax.lax.associative_scan(combine, (t, r), axis=1)
     lateness = jnp.maximum(arrive - due_cur, 0.0).sum(axis=1)
-
     cap_excess = _cap_excess_hot(prev_oh, rid, inst)
+    return dist, cap_excess, lateness, arrive, rid
+
+
+def _tw_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Array:
+    """Gather-free batched objective for time-windowed instances.
+
+    The same max-plus associative-scan arrival propagation as _tw_eval
+    (see its derivation), but every per-leg quantity — leg duration,
+    service at the origin, ready/due at the destination, the route's
+    shift start — is a one-hot contraction instead of a gather, so the
+    whole evaluation vectorizes on TPU (gathers there lower to a scalar
+    loop ~50x slower). The scan itself runs batched over axis 1.
+    """
+    v = inst.n_vehicles
+    dist, cap_excess, lateness, arrive, rid = tw_components_batch(giants, inst)
     cost = dist + w.cap * cap_excess + w.tw * lateness
     if w.use_makespan:
         # Route elapsed time = arrival at its closing depot zero minus

@@ -367,37 +367,42 @@ def delta_block(
     return out
 
 
-def _dp_init_kernel(gt_ref, dem_ref, dp_out):
+def _dp_init_kernel(gt_ref, dem_ref, dp_out, *, exact_f32):
     """dp[k, b] = demands[gt[k, b]] — per-position one-hot matvecs
     against the demand vector (VMEM-resident; no gather)."""
     lhat, t = gt_ref.shape
     nhat = dem_ref.shape[1]
     dem_col = dem_ref[:].T  # (N-hat, 1)
+    dt = jnp.float32 if exact_f32 else jnp.bfloat16
     rows = []
     for k in range(lhat):
         oh = (
             gt_ref[k : k + 1, :].T
             == jax.lax.broadcasted_iota(jnp.int32, (t, nhat), 1)
-        ).astype(jnp.bfloat16)
-        val = jnp.dot(oh, dem_col.astype(jnp.bfloat16),
+        ).astype(dt)
+        val = jnp.dot(oh, dem_col.astype(dt),
                       preferred_element_type=jnp.float32)  # (T, 1)
         rows.append(val.T)
     dp_out[:] = jnp.concatenate(rows, axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("tile_b", "interpret"))
-def dp_init(gt_t, dem_row, *, tile_b, interpret=False):
-    """(L-hat, B) tours -> (L-hat, B) per-position demands, on device.
+@functools.partial(jax.jit, static_argnames=("tile_b", "exact_f32", "interpret"))
+def dp_init(gt_t, dem_row, *, tile_b, exact_f32=False, interpret=False):
+    """(L-hat, B) tours -> (L-hat, B) per-position attribute values, on
+    device (dem_row holds demands for the capacity state; the TW path
+    reuses it for service/ready/due).
 
     Exists because both XLA alternatives are terrible at B=16k: the
     (B, L, N) one-hot einsum moves ~2 GB of intermediates, and a host
     fancy-index round-trips the whole state through the TPU tunnel.
-    bf16 is exact here as long as demands are integers <= 256 (callers
-    gate; the delta path's capacity math is f32 from here on).
+    The bf16 default is exact as long as the values are integers <= 256
+    (callers gate demands via demand_scale); exact_f32 runs the matvec
+    in f32 for arbitrary attribute values (TW ready/due) at init-only
+    cost.
     """
     lhat, b = gt_t.shape
     return pl.pallas_call(
-        _dp_init_kernel,
+        functools.partial(_dp_init_kernel, exact_f32=exact_f32),
         grid=(b // tile_b,),
         in_specs=[
             pl.BlockSpec((lhat, tile_b), lambda g: (0, g)),

@@ -1,0 +1,418 @@
+"""Pallas TPU kernel: one FUSED delta-evaluated SA step for VRPTW.
+
+VERDICT round-3 item 2: the flagship delta kernel (sa_delta.py) excluded
+exactly the instance classes the contract most prizes — time windows
+fell back to the full O(L * N-hat^2) one-hot evaluation per move. This
+sibling kernel extends the same design to VRPTW:
+
+  * every per-position NODE attribute the timed objective needs —
+    demand, service, ready, due — rides as its own (L-hat, B) state
+    array that transforms under moves exactly like the tour itself
+    (the same masked sublane-roll machinery, no gathers);
+  * the LEG durations ride as a fifth per-position array lg[k] =
+    d[g[k], g[k+1]], transformed by the same rolls plus O(1) junction
+    fixes read from the 12 pair lookups the untimed kernel already
+    performs (reverse reuses interior legs under the symmetric-matrix
+    gate; rotate/swap splice at most four junctions);
+  * the candidate's FULL timeline is then recomputed in VMEM by a
+    log-depth max-plus prefix scan over sublanes (the associative
+    arrival map of core.cost._tw_eval: a' = max(a + t, r), with depot
+    zeros resetting the clock to the shift start) — O(L log L) VPU work
+    per move with NO N^2 term anywhere, which is the whole point:
+    lateness is a global property of the tour, but the max-plus
+    structure makes recomputing it as cheap as a prefix sum.
+
+Because distance, capacity excess AND lateness are recomputed fresh
+from the (exactly-moved) state arrays at every step, the committed cost
+carries no accumulated drift at all — there is nothing to resync at
+block boundaries (unlike the untimed kernel's running dist deltas); the
+solver re-ranks the best pool in the exact one-hot basis once at the
+end.
+
+Rounding contract: leg durations are the bf16-rounded table (identical
+to every hot path); service/ready/due are f32-exact (dp_init's
+exact_f32 attribute init); demands ride gcd-scaled like the untimed
+kernel (kernels.sa_eval.demand_scale). Gates (sa._delta_supported):
+symmetric d, uniform fleet + scalable demands, uniform start times with
+max(start, ready[0]) <= due[0] (so trailing pad legs contribute zero
+lateness), n_nodes and tour length <= 256 (bf16-exact one-hot ids and
+one lane-tile of table).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.kernels.sa_delta import (
+    _PALLAS_OK,
+    _cap_excess_of,
+    _roll_up_perlane,
+    _roll_up_static,
+    _value_at,
+    _value_at_f,
+)
+
+if _PALLAS_OK:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e18
+_BIG = 1e9  # matches core.instance.BIG (the depot-reset -BIG trick)
+
+
+def _pair_lookup_stacked(d, u_rows, v_rows, nhat):
+    """d[u_k, v_k] for K (1, T) node-row pairs -> list of (1, T), via ONE
+    stacked (K*T, N-hat) one-hot matmul instead of K sequential small
+    ones. The untimed kernel found stacking a wash at its shapes
+    (sa_delta._pair_lookup's rationale); HERE the ablation showed the
+    seven sequential lookups were the single largest step cost (41 of
+    151 ms/block at tile 512), so the bigger/fewer-ops form wins."""
+    k = len(u_rows)
+    t = u_rows[0].shape[1]
+    u_stack = jnp.concatenate([u.T for u in u_rows], axis=0)  # (K*T, 1)
+    v_stack = jnp.concatenate([v.T for v in v_rows], axis=0)
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (k * t, nhat), 1)
+    u_oh = (u_stack == iota_n).astype(jnp.bfloat16)
+    rows = jnp.dot(u_oh, d, preferred_element_type=jnp.float32)
+    v_oh = (v_stack == iota_n).astype(jnp.float32)
+    vals = jnp.sum(rows * v_oh, axis=1, keepdims=True)  # (K*T, 1)
+    return [vals[j * t : (j + 1) * t].T for j in range(k)]
+
+
+def _values_at_stacked(arr, pos_rows, iota_l):
+    """arr values at K per-lane positions -> list of (1, T), as ONE
+    compare/select/reduce over a K-wide lane concatenation (the eight
+    separate _value_at reductions were ~8% of the step)."""
+    k = len(pos_rows)
+    t = arr.shape[1]
+    big = jnp.concatenate([arr] * k, axis=1)
+    pos = jnp.concatenate(pos_rows, axis=1)
+    iota_big = jnp.concatenate([iota_l] * k, axis=1)
+    vals = jnp.sum(
+        jnp.where(iota_big == pos, big, 0), axis=0, keepdims=True
+    )
+    return [vals[:, j * t : (j + 1) * t] for j in range(k)]
+
+
+def _shift_down(a, k, fill):
+    rows = a.shape[0]
+    pad = jnp.full((k, a.shape[1]), fill, a.dtype)
+    return jnp.concatenate([pad, a[: rows - k]], axis=0)
+
+
+def _maxplus_prefix(t, r, lhat):
+    """Inclusive prefix of the max-plus affine maps down the sublanes:
+    combine((t1, r1) earlier, (t2, r2) later) = (t1 + t2,
+    max(r1 + t2, r2)) — associative, so log2(L-hat) doubling steps.
+    Identity element: (t=0, r=-BIG)."""
+    k = 1
+    while k < lhat:
+        t_p = _shift_down(t, k, 0.0)
+        r_p = _shift_down(r, k, _NEG_BIG)
+        r = jnp.maximum(r_p + t, r)
+        t = t_p + t
+        k *= 2
+    return r  # arrive[k] = arrival time at position k+1
+
+
+def tw_timeline_late(cand, lg_c, sv_c, rd_c, du_c, start0, lhat):
+    """Total lateness of each lane's candidate tour from its
+    per-position state arrays (semantics of core.cost._tw_eval /
+    tw_components_batch, leg for leg).
+
+    Leg k runs position k -> k+1. A depot origin (cand[k] == 0) resets
+    the clock to the shift start; otherwise departure is arrival plus
+    the origin's service. rd/du of the DESTINATION are the roll-up-by-1
+    of the state arrays (the wrap at the last pad row reads position 0
+    = the depot, whose window the gate guarantees open at start0, so
+    pad legs contribute zero lateness).
+    """
+    rd_next = _roll_up_static(rd_c, 1)
+    du_next = _roll_up_static(du_c, 1)
+    z = cand == 0
+    t = jnp.where(z, -_BIG, lg_c + sv_c)
+    r = jnp.where(z, jnp.maximum(start0 + lg_c, rd_next), rd_next)
+    arrive = _maxplus_prefix(t, r, lhat)
+    return jnp.sum(jnp.maximum(arrive - du_next, 0.0), axis=0, keepdims=True)
+
+
+def _tw_step_body(
+    gt, at4, lg, cost, best, bestc,
+    i_row, r_row, mt_row, m_row, u_row, temp,
+    d, knn, cap0, wcap, wtw, start0, iota_l, antidiag,
+    *, length, lhat, t, nhat, has_knn,
+):
+    """One fused VRPTW delta step on VALUE arrays (shared by the
+    single-step test kernel and the in-kernel block loop). Same
+    proposal decode as sa_delta._step_body.
+
+    `at4` is the lane-axis concatenation [demand | service | ready |
+    due] of the four node-attribute arrays (one flip matmul + one roll
+    chain transforms all four); `lg` is the per-position leg-duration
+    array, transformed by the same machinery one window-row shorter
+    plus O(1) junction fixes from the pair lookups."""
+    # --- proposal decode: second endpoint (identical to the untimed kernel)
+    if has_knn:
+        a_for_knn = _value_at(gt, i_row, iota_l)
+        iota_n = jax.lax.broadcasted_iota(jnp.int32, (t, nhat), 1)
+        a_oh = (a_for_knn.T == iota_n).astype(jnp.bfloat16)
+        rows = jnp.dot(a_oh, knn, preferred_element_type=jnp.float32)
+        kw = knn.shape[1]
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (t, kw), 1)
+        r_oh = (r_row.T == iota_k).astype(jnp.float32)
+        bnode = jnp.sum(rows * r_oh, axis=1, keepdims=True)
+        bnode = bnode.astype(jnp.int32).T
+        match = gt == bnode
+        j_row = jnp.min(jnp.where(match, iota_l, lhat), axis=0, keepdims=True)
+    else:
+        j_row = r_row
+    j_row = jnp.clip(j_row, 1, length - 2)
+
+    lo = jnp.minimum(i_row, j_row)
+    hi = jnp.maximum(i_row, j_row)
+    span = hi - lo + 1
+    mm = jnp.minimum(m_row, span - 1)
+    mt = mt_row
+
+    a_, b0, x2, b1, x_, y2, c_, e_ = _values_at_stacked(
+        gt,
+        [lo - 1, lo, lo + 1, lo + mm - 1, lo + mm, hi - 1, hi, hi + 1],
+        iota_l,
+    )
+
+    (d_ac, d_be, d_ax, d_cb, d_b1e, d_cx2, d_y2b) = _pair_lookup_stacked(
+        d,
+        [a_, b0, a_, c_, b1, c_, y2],
+        [c_, e_, x_, b0, e_, x2, b0],
+        nhat,
+    )
+
+    in_win = (iota_l >= lo) & (iota_l <= hi)
+    mask = lhat - 1
+
+    def apply_move(arr, flipped, lo_, hi_, mm_, span_, in_win_, iota_):
+        rho_rev = (lhat - 1 - (lo_ + hi_)) & mask
+        rev = jnp.where(in_win_, _roll_up_perlane(flipped, rho_rev, lhat), arr)
+        fwd = _roll_up_perlane(arr, mm_ & mask, lhat)
+        wrap = _roll_up_perlane(arr, (mm_ - span_) & mask, lhat)
+        rot = jnp.where(
+            in_win_, jnp.where(iota_ + mm_ <= hi_, fwd, wrap), arr
+        )
+        return rev, rot
+
+    def flip(arr):
+        return jnp.dot(
+            antidiag, arr.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+
+    def moved(arr, lo_, hi_, mm_, span_, mt_, in_win_, iota_, is_int=False):
+        flipped = flip(arr)
+        if is_int:
+            flipped = flipped.astype(jnp.int32)
+        rev, rot = apply_move(arr, flipped, lo_, hi_, mm_, span_, in_win_, iota_)
+        at_lo = (
+            _value_at(arr, lo_, iota_) if is_int else _value_at_f(arr, lo_, iota_)
+        )
+        at_hi = (
+            _value_at(arr, hi_, iota_) if is_int else _value_at_f(arr, hi_, iota_)
+        )
+        swp = jnp.where(
+            iota_ == lo_, at_hi, jnp.where(iota_ == hi_, at_lo, arr)
+        )
+        return jnp.where(mt_ == 0, rev, jnp.where(mt_ == 1, rot, swp))
+
+    cand = moved(gt, lo, hi, mm, span, mt, in_win, iota_l, is_int=True)
+    # The four node-attribute arrays transform under the SAME per-lane
+    # move, so they ride ONE lane-axis concatenation: one flip matmul
+    # and one masked-roll chain instead of four. (A 5-wide concat that
+    # also carried the legs section was measured SLOWER — its re-concat
+    # after the junction fixes and the 5-wide commit cost more than the
+    # legs' own flip+rolls save, so legs stay separate.)
+    rep4 = lambda x: jnp.concatenate([x] * 4, axis=1)  # noqa: E731
+    lo4, hi4 = rep4(lo), rep4(hi)
+    mm4, span4, mt4 = rep4(mm), rep4(span), rep4(mt)
+    iota_l4 = rep4(iota_l)
+    in_win4 = rep4(in_win)
+    at4_c = moved(at4, lo4, hi4, mm4, span4, mt4, in_win4, iota_l4)
+    dp_c = at4_c[:, :t]
+    sv_c = at4_c[:, t : 2 * t]
+    rd_c = at4_c[:, 2 * t : 3 * t]
+    du_c = at4_c[:, 3 * t : 4 * t]
+
+    # legs: same rolls with the window one row shorter (reverse's
+    # reflection constant for legs is exactly L-lo-hi = L-1-(lo+(hi-1)),
+    # so passing hi-1 yields both the window and the roll), then O(1)
+    # junction fixes; rot fixes gate on validity span>=2 — where
+    # invalid, hi == lo and d_ac/d_be degenerate to the unchanged
+    # values, so the shared fixes stay no-ops.
+    in_win_lg = (iota_l >= lo) & (iota_l <= hi - 1)
+    lg_rev, lg_rot = apply_move(
+        lg, flip(lg), lo, hi - 1, mm, span, in_win_lg, iota_l
+    )
+    lg_c = jnp.where(mt == 0, lg_rev, jnp.where(mt == 1, lg_rot, lg))
+    rot_valid = (mt == 1) & (span >= 2) & (mm >= 1)
+    fix_lo1 = jnp.where(rot_valid, d_ax, d_ac)
+    fix_hi = jnp.where(rot_valid, d_b1e, d_be)
+    lg_c = jnp.where(iota_l == lo - 1, fix_lo1, lg_c)
+    lg_c = jnp.where(iota_l == hi, fix_hi, lg_c)
+    lg_c = jnp.where(rot_valid & (iota_l == hi - mm), d_cb, lg_c)
+    swap_gen = mt == 2
+    lg_c = jnp.where(swap_gen & (iota_l == lo), d_cx2, lg_c)
+    lg_c = jnp.where(swap_gen & (iota_l == hi - 1), d_y2b, lg_c)
+    # adjacent swap IS the reverse: one junction leg d[c, b0] at lo
+    lg_c = jnp.where(
+        swap_gen & (hi == lo + 1) & (iota_l == lo), d_cb, lg_c
+    )
+
+    dist_c = jnp.sum(lg_c, axis=0, keepdims=True)
+    cape_c = _cap_excess_of(cand, dp_c, cap0, lhat)
+    late_c = tw_timeline_late(cand, lg_c, sv_c, rd_c, du_c, start0, lhat)
+    cand_cost = dist_c + wcap * cape_c + wtw * late_c
+    delta = cand_cost - cost
+    accept = (delta < 0.0) | (u_row < jnp.exp(jnp.minimum(-delta / temp, 0.0)))
+
+    gt_n = jnp.where(accept, cand, gt)
+    at4_n = jnp.where(rep4(accept), at4_c, at4)
+    lg_n = jnp.where(accept, lg_c, lg)
+    cost_n = jnp.where(accept, cand_cost, cost)
+    better = cost_n < bestc
+    best_n = jnp.where(better, gt_n, best)
+    bestc_n = jnp.where(better, cost_n, bestc)
+    return gt_n, at4_n, lg_n, cost_n, best_n, bestc_n
+
+
+def _tw_block_kernel(
+    gt_ref, dp_ref, sv_ref, rd_ref, du_ref, lg_ref, cost_ref,
+    best_ref, bestc_ref,
+    i_ref, r_ref, mt_ref, m_ref, u_ref, temps_ref,
+    d_ref, knn_ref, scal_ref,
+    gt_o, dp_o, sv_o, rd_o, du_o, lg_o, cost_o, best_o, bestc_o,
+    *, length, has_knn, n_steps,
+):
+    """n_steps fused TW delta steps, all state VMEM-resident for the
+    whole block (one launch per block — the same dispatch-amortization
+    as sa_delta._delta_block_kernel)."""
+    lhat, t = gt_ref.shape
+    nhat = d_ref.shape[0]
+    d = d_ref[:]
+    knn = knn_ref[:]
+    cap0 = scal_ref[0, 0]
+    wcap = scal_ref[0, 1]
+    wtw = scal_ref[0, 2]
+    start0 = scal_ref[0, 3]
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (lhat, t), 0)
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
+    antidiag = (iota_r + iota_c == lhat - 1).astype(jnp.float32)
+
+    def body(k, carry):
+        gt, at4, lg, cost, best, bestc = carry
+        return _tw_step_body(
+            gt, at4, lg, cost, best, bestc,
+            i_ref[pl.ds(k, 1), :], r_ref[pl.ds(k, 1), :],
+            mt_ref[pl.ds(k, 1), :], m_ref[pl.ds(k, 1), :],
+            u_ref[pl.ds(k, 1), :], temps_ref[0, k],
+            d, knn, cap0, wcap, wtw, start0, iota_l, antidiag,
+            length=length, lhat=lhat, t=t, nhat=nhat, has_knn=has_knn,
+        )
+
+    # the four attribute arrays ride the loop as ONE lane-concat (see
+    # _tw_step_body); split back into the interface refs at the end
+    at4_0 = jnp.concatenate(
+        [dp_ref[:], sv_ref[:], rd_ref[:], du_ref[:]], axis=1
+    )
+    carry = (
+        gt_ref[:], at4_0, lg_ref[:], cost_ref[:], best_ref[:], bestc_ref[:]
+    )
+    gt, at4, lg, cost, best, bestc = jax.lax.fori_loop(0, n_steps, body, carry)
+    gt_o[:] = gt
+    dp_o[:] = at4[:, :t]
+    sv_o[:] = at4[:, t : 2 * t]
+    rd_o[:] = at4[:, 2 * t : 3 * t]
+    du_o[:] = at4[:, 3 * t :]
+    lg_o[:] = lg
+    cost_o[:] = cost
+    best_o[:] = best
+    bestc_o[:] = bestc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("length", "tile_b", "has_knn", "interpret")
+)
+def delta_tw_block(
+    gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost, best_t, best_c,
+    i, r, mt, m, u, temps, d_bf16, knn_f32, scal,
+    *, length, tile_b, has_knn, interpret=False,
+):
+    """A whole block of fused VRPTW delta steps in one kernel launch.
+
+    State: gt/dp/sv/rd/du/lg/best_t are (L-hat, B) [tour ids, scaled
+    demand, service, ready, due, leg duration, best tour]; cost/best_c
+    are (1, B). i/r/mt/m/u: (n_steps, B); temps: (1, n_steps) SMEM;
+    scal: (1, 4) SMEM [cap0_scaled, wcap*g, wtw, start0].
+    """
+    lhat, b = gt_t.shape
+    n_steps = i.shape[0]
+    grid = b // tile_b
+    kernel = functools.partial(
+        _tw_block_kernel, length=length, has_knn=has_knn, n_steps=n_steps
+    )
+    tall = pl.BlockSpec((lhat, tile_b), lambda g: (0, g))
+    row = pl.BlockSpec((1, tile_b), lambda g: (0, g))
+    steps = pl.BlockSpec((n_steps, tile_b), lambda g: (0, g))
+    tall_i32 = jax.ShapeDtypeStruct((lhat, b), jnp.int32)
+    tall_f32 = jax.ShapeDtypeStruct((lhat, b), jnp.float32)
+    row_f32 = jax.ShapeDtypeStruct((1, b), jnp.float32)
+    # The TW step carries ~2x the untimed kernel's live state (seven
+    # tall arrays) plus per-move roll temporaries, so the default 16 MB
+    # SCOPED-vmem cap overflows at production shapes (measured: 43.5 MB
+    # scoped at tile_b=256, n_steps=512 on v5e). v5e has 128 MiB of
+    # physical VMEM; raise the cap to 100 MB. NOTE the budget scales
+    # with BOTH tile_b and n_steps (the five presampled streams are
+    # (n_steps, tile_b) VMEM blocks of this launch): the driver caps
+    # launches at 512 steps and the measured-fastest tile is 512, which
+    # lands ~85-90 MB — inside the cap, with no headroom for larger
+    # launches (an unbounded n_steps would scale VMEM with the whole
+    # iteration budget).
+    params = None
+    if not interpret:
+        params = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            tall, tall, tall, tall, tall, tall, row, tall, row,
+            steps, steps, steps, steps, steps,
+            pl.BlockSpec((1, n_steps), lambda g: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(d_bf16.shape, lambda g: (0, 0)),
+            pl.BlockSpec(knn_f32.shape, lambda g: (0, 0)),
+            pl.BlockSpec((1, 4), lambda g: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[tall, tall, tall, tall, tall, tall, row, tall, row],
+        out_shape=[
+            tall_i32, tall_f32, tall_f32, tall_f32, tall_f32, tall_f32,
+            row_f32, tall_i32, row_f32,
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost, best_t, best_c,
+      i, r, mt, m, u, temps, d_bf16, knn_f32, scal)
+
+
+def tw_step(
+    gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost, best_t, best_c,
+    i, r, mt, m, u, temp, d_bf16, knn_f32, scal3,
+    *, length, tile_b, has_knn, interpret=False,
+):
+    """Single-step convenience wrapper over delta_tw_block (tests and
+    per-step host control)."""
+    temps = jnp.asarray([[temp]], jnp.float32)
+    return delta_tw_block(
+        gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost, best_t, best_c,
+        i[None], r[None], mt[None], m[None], u[None], temps,
+        d_bf16, knn_f32, scal3,
+        length=length, tile_b=tile_b, has_knn=has_knn, interpret=interpret,
+    )

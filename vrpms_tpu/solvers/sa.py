@@ -485,13 +485,20 @@ def warm_anneal_blocks(
 
 
 def _delta_supported(inst: Instance, w: CostWeights, mode: str) -> bool:
-    """Host-side gate for the fused delta-step path: untimed symmetric
-    uniform-capacity instances on a TPU backend (the reverse-move delta
-    needs symmetry; TW/TD/makespan change non-local terms; heterogeneous
-    fleets break the uniform-capacity excess recompute). Demands must
-    admit a bf16-exact gcd scaling (kernels.sa_eval.demand_scale) —
-    dp_init and the resync's packed demand column are bf16, and rounded
-    demands let slightly infeasible tours rank feasible (ADVICE r3)."""
+    """Host-side gate for the fused delta-step paths: symmetric
+    uniform-capacity instances on a TPU backend (the reverse-move legs
+    reuse needs symmetry; TD/makespan change non-local terms the
+    kernels don't model; heterogeneous fleets break the uniform-
+    capacity excess recompute). Demands must admit a bf16-exact gcd
+    scaling (kernels.sa_eval.demand_scale) — dp_init and the resync's
+    packed demand column are bf16, and rounded demands let slightly
+    infeasible tours rank feasible (ADVICE r3).
+
+    Time-windowed instances are supported since round 4 via the sibling
+    TW kernel (kernels.sa_delta_tw), under extra gates: uniform shift
+    starts with the depot window open at the start (trailing pad legs
+    must be lateness-free), and ids/table within one 256 lane tile.
+    """
     import numpy as np
 
     from vrpms_tpu.kernels.sa_delta import _PALLAS_OK
@@ -499,12 +506,23 @@ def _delta_supported(inst: Instance, w: CostWeights, mode: str) -> bool:
 
     if mode != "pallas" or not _PALLAS_OK:
         return False
-    if inst.has_tw or inst.time_dependent or w.use_makespan or inst.het_fleet:
+    if inst.time_dependent or w.use_makespan or inst.het_fleet:
         return False
     if inst.n_nodes > 512:
         return False
     if demand_scale(inst.demands) is None:
         return False
+    if inst.has_tw:
+        length = inst.n_customers + inst.n_vehicles + 1
+        if inst.n_nodes > 256 or length > 256:
+            return False
+        st = np.asarray(inst.start_times)
+        ready = np.asarray(inst.ready)
+        due = np.asarray(inst.due)
+        if not np.all(st == st[0]):
+            return False
+        if max(float(st[0]), float(ready[0])) > float(due[0]):
+            return False
     d = np.asarray(inst.durations[0])
     return bool(np.allclose(d, d.T, rtol=1e-6, atol=1e-6))
 
@@ -609,6 +627,231 @@ def _sa_delta_block_fn(
     return run
 
 
+@lru_cache(maxsize=32)
+def _sa_delta_tw_block_fn(
+    n_block: int, length: int, tile_b: int, has_knn: bool,
+    interpret: bool = False,
+):
+    """One jitted block of n_block fused VRPTW delta steps (the TW twin
+    of _sa_delta_block_fn; kernels.sa_delta_tw)."""
+    from vrpms_tpu.kernels.sa_delta_tw import delta_tw_block
+    from vrpms_tpu.moves.moves import presample_move_params
+
+    @jax.jit
+    def run(state, key, d_bf16, knn_f, scal, t0, t1, start_it, horizon):
+        gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost, best_t, best_c = state
+        b = gt_t.shape[1]
+        kb = jax.random.fold_in(key, start_it)
+        kw = knn_f.shape[1] if has_knn else 0
+        pri, prr, prmt, prm, pru = presample_move_params(
+            kb, b, length, n_block, kw
+        )
+        temps = anneal_temperature(
+            start_it + jnp.arange(n_block), t0, t1, horizon
+        )[None, :].astype(jnp.float32)
+        return delta_tw_block(
+            gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost, best_t, best_c,
+            pri, prr, prmt, prm, pru, temps, d_bf16, knn_f, scal,
+            length=length, tile_b=tile_b, has_knn=has_knn,
+            interpret=interpret,
+        )
+
+    return run
+
+
+@lru_cache(maxsize=16)
+def _tw_delta_prep_fn(length: int):
+    """Jitted TW state prep: bf16-selected legs of each giant plus the
+    kernel-basis initial cost row (the same formulas the kernel applies
+    per candidate — sum of legs, scaled capacity excess, max-plus
+    lateness — so step 1's accept compares like with like)."""
+
+    @jax.jit
+    def prep(giants, gt_t, dp_t, sv_t, rd_t, du_t, inst, scal):
+        from vrpms_tpu.core.cost import _legs_hot
+        from vrpms_tpu.kernels.sa_delta import _cap_excess_of
+        from vrpms_tpu.kernels.sa_delta_tw import tw_timeline_late
+
+        lhat = gt_t.shape[0]
+        _, _, legs, _ = _legs_hot(giants, inst)
+        lg_t = jnp.zeros_like(dp_t).at[: length - 1].set(legs.T)
+        dist = jnp.sum(lg_t, axis=0, keepdims=True)
+        cape = _cap_excess_of(gt_t, dp_t, scal[0, 0], lhat)
+        late = tw_timeline_late(
+            gt_t, lg_t, sv_t, rd_t, du_t, scal[0, 3], lhat
+        )
+        return lg_t, dist + scal[0, 1] * cape + scal[0, 2] * late
+
+    return prep
+
+
+@lru_cache(maxsize=16)
+def _tw_best_rank_fn(length: int):
+    """Exact one-hot-basis costs of the best pool (final champion/elite
+    selection; the kernel's tracker is its own basis, so ranking goes
+    through the shared tw_components_batch)."""
+
+    @jax.jit
+    def rank(best_t, inst, w):
+        from vrpms_tpu.core.cost import tw_components_batch
+
+        g = best_t[:length].T
+        dist, cape, late, _, _ = tw_components_batch(g, inst)
+        return dist + w.cap * cape + w.tw * late
+
+    return rank
+
+
+def _delta_common_setup(inst, params, knn):
+    """The device inputs both delta drivers share: padded bf16 d-table,
+    padded knn table, demand gcd scale, uniform capacity, interpret
+    flag (ONE construction so the TW and untimed paths cannot drift)."""
+    import os as _os
+
+    import numpy as np
+
+    from vrpms_tpu.kernels.sa_eval import demand_scale
+
+    nhat = -(-inst.n_nodes // 128) * 128
+    dem_g = demand_scale(inst.demands)
+    if dem_g is None:
+        raise ValueError(
+            "solve_sa_delta needs bf16-exact-scalable demands "
+            "(integral, max/gcd <= 256); see _delta_supported"
+        )
+    d_np = np.zeros((nhat, nhat), np.float32)
+    d_np[: inst.n_nodes, : inst.n_nodes] = np.asarray(inst.durations[0])
+    d_bf16 = jnp.asarray(d_np, jnp.bfloat16)
+    if knn is None and params.knn_k > 0:
+        knn = knn_table(inst.durations[0], params.knn_k)
+    has_knn = knn is not None
+    if has_knn:
+        kf = np.zeros((nhat, knn.shape[1]), np.float32)
+        kf[: inst.n_nodes] = np.asarray(knn, np.float32)
+        knn_f = jnp.asarray(kf)
+    else:
+        knn_f = jnp.zeros((nhat, 8), jnp.float32)
+    cap0 = float(np.asarray(inst.capacities)[0])
+    interpret = bool(_os.environ.get("VRPMS_DELTA_INTERPRET"))
+    return nhat, dem_g, d_bf16, knn_f, has_knn, cap0, interpret
+
+
+def _solve_sa_delta_tw(
+    inst, giants, t0, t1, k_run, params, w, deadline_s, pool, knn
+) -> SolveResult:
+    """VRPTW delta-anneal driver (dispatched from solve_sa_delta).
+
+    Simpler than the untimed driver in one way: the TW kernel
+    recomputes distance, capacity excess and lateness FRESH from the
+    exactly-moved state arrays at every step, so nothing accumulates
+    and there is nothing to resync at block boundaries — just an exact
+    re-rank of the best pool at the end. Launches are still capped at
+    512 steps like the untimed driver: the presampled param streams are
+    VMEM blocks of the single Pallas launch, so an unbounded-n_steps
+    launch scales its VMEM with the whole iteration budget.
+    """
+    import numpy as np
+
+    from vrpms_tpu.kernels.sa_delta import dp_init
+    from vrpms_tpu.solvers.common import run_blocked
+
+    b, length = giants.shape
+    lhat = _pow2_at_least(length)
+    # 512-chain tiles measured fastest (15.9 vs 14.5M moves/s at 128 on
+    # v5e, R101 shape) under the raised scoped-VMEM cap (delta_tw_block)
+    tile_b = next((tb for tb in (512, 256, 128) if b % tb == 0), None)
+    if tile_b is None:
+        raise ValueError(f"delta path needs a 128-multiple batch, got {b}")
+    nhat, dem_g, d_bf16, knn_f, has_knn, cap0, interpret = (
+        _delta_common_setup(inst, params, knn)
+    )
+    start0 = float(np.asarray(inst.start_times)[0])
+    scal = jnp.asarray(
+        [[cap0 / dem_g, float(w.cap) * dem_g, float(w.tw), start0]],
+        jnp.float32,
+    )
+    gt_t = jnp.zeros((lhat, b), jnp.int32).at[:length].set(giants.T)
+
+    def attr_row(vec):
+        row = np.zeros((1, nhat), np.float32)
+        row[0, : inst.n_nodes] = np.asarray(vec)
+        return jnp.asarray(row)
+
+    dp_t = dp_init(
+        gt_t, attr_row(np.asarray(inst.demands) / dem_g),
+        tile_b=tile_b, interpret=interpret,
+    )
+    sv_t = dp_init(
+        gt_t, attr_row(inst.service),
+        tile_b=tile_b, exact_f32=True, interpret=interpret,
+    )
+    rd_t = dp_init(
+        gt_t, attr_row(inst.ready),
+        tile_b=tile_b, exact_f32=True, interpret=interpret,
+    )
+    du_t = dp_init(
+        gt_t, attr_row(inst.due),
+        tile_b=tile_b, exact_f32=True, interpret=interpret,
+    )
+    lg_t, cost0 = _tw_delta_prep_fn(length)(
+        giants, gt_t, dp_t, sv_t, rd_t, du_t, inst, scal
+    )
+    state = (gt_t, dp_t, sv_t, rd_t, du_t, lg_t, cost0, gt_t, cost0)
+    t0j, t1j = jnp.float32(t0), jnp.float32(t1)
+    horizon = jnp.float32(params.n_iters)
+
+    base_it = 0  # global iteration offset (see the untimed driver: the
+    # schedule and the presampled RNG streams must see GLOBAL
+    # iterations across the 512-step launches)
+
+    def step_block(st, nb, start):
+        return _sa_delta_tw_block_fn(nb, length, tile_b, has_knn, interpret)(
+            st, k_run, d_bf16, knn_f, scal, t0j, t1j,
+            jnp.int32(base_it + start), horizon,
+        )
+
+    rate_key = ("delta_tw", b, length)
+    import time as _time
+
+    t_run = _time.monotonic()
+    done = 0
+    remaining = params.n_iters
+    # 512-step launch cap (the same loop shape as the untimed driver,
+    # minus its resync): each launch's presampled streams are VMEM
+    # blocks, so n_steps must stay bounded regardless of the deadline
+    while remaining > 0:
+        block = min(512, remaining)
+        state, did = run_blocked(
+            step_block, state, block, 512,
+            None if deadline_s is None else max(
+                0.0, deadline_s - (_time.monotonic() - t_run)
+            ),
+            lambda st: st[8],
+            rate_hint=_rate_get(rate_key),
+        )
+        done += did
+        base_it += did
+        remaining -= block
+        if deadline_s is not None:
+            if did:
+                el = _time.monotonic() - t_run
+                if el > 0.05:
+                    _rate_put(rate_key, done / el)
+            if _time.monotonic() - t_run >= deadline_s or did < block:
+                break
+
+    best_t = state[7]
+    best_exact = _tw_best_rank_fn(length)(best_t, inst, w)
+    champ = jnp.argmin(best_exact)
+    g = best_t[:length, champ].T
+    bd, cost = exact_cost(g, inst, w)
+    elite = None
+    if pool > 0:
+        order = jnp.argsort(best_exact)[: min(pool, b)]
+        elite = best_t[:length, :].T[order]
+    return SolveResult(g, cost, bd, jnp.int32(b * done), elite)
+
+
 def solve_sa_delta(
     inst: Instance,
     key: jax.Array | int = 0,
@@ -619,7 +862,9 @@ def solve_sa_delta(
     pool: int = 0,
     knn: jax.Array | None = None,
 ) -> SolveResult:
-    """Batched-chain SA with the FUSED delta step (kernels.sa_delta).
+    """Batched-chain SA with the FUSED delta step (kernels.sa_delta;
+    time-windowed instances take the sibling TW kernel,
+    kernels.sa_delta_tw).
 
     Same contract as solve_sa (deadline blocks, pool, warm init); the
     per-move work drops from a full O(L * N^2) evaluation to closed-form
@@ -645,47 +890,27 @@ def solve_sa_delta(
             if init_giants is None
             else init_giants
         )
+    if inst.has_tw:
+        return _solve_sa_delta_tw(
+            inst, giants, t0, t1, k_run, params, w, deadline_s, pool, knn
+        )
     b, length = giants.shape
     lhat = _pow2_at_least(length)
-    nhat = -(-inst.n_nodes // 128) * 128
     # 256-chain tiles measured fastest for the block kernel (512 blows
     # the VMEM budget once the per-block param streams move in)
     tile_b = next((t for t in (256, 128) if b % t == 0), None)
     if tile_b is None:
         raise ValueError(f"delta path needs a 128-multiple batch, got {b}")
-
-    d_np = np.zeros((nhat, nhat), np.float32)
-    d_np[: inst.n_nodes, : inst.n_nodes] = np.asarray(inst.durations[0])
-    d_bf16 = jnp.asarray(d_np, jnp.bfloat16)
-    if knn is None and params.knn_k > 0:
-        knn = knn_table(inst.durations[0], params.knn_k)
-    has_knn = knn is not None
-    if has_knn:
-        kf = np.zeros((nhat, knn.shape[1]), np.float32)
-        kf[: inst.n_nodes] = np.asarray(knn, np.float32)
-        knn_f = jnp.asarray(kf)
-    else:
-        knn_f = jnp.zeros((nhat, 8), jnp.float32)
     # gcd demand scaling (kernels.sa_eval.demand_scale): the kernel's
     # dp/cape state runs in demand/g units against capacity/g, with the
     # g folded into the excess weight — bf16-exact for any integral
     # demands with max/gcd <= 256 (the _delta_supported gate).
-    from vrpms_tpu.kernels.sa_eval import demand_scale
-
-    dem_g = demand_scale(inst.demands)
-    if dem_g is None:
-        raise ValueError(
-            "solve_sa_delta needs bf16-exact-scalable demands "
-            "(integral, max/gcd <= 256); see _delta_supported"
-        )
-    cap0 = float(np.asarray(inst.capacities)[0])
+    nhat, dem_g, d_bf16, knn_f, has_knn, cap0, interpret = (
+        _delta_common_setup(inst, params, knn)
+    )
     scal2 = jnp.asarray(
         [[cap0 / dem_g, float(w.cap) * dem_g]], jnp.float32
     )
-
-    import os as _os
-
-    interpret = bool(_os.environ.get("VRPMS_DELTA_INTERPRET"))
     gt_t, dp_t, dist, cape = _delta_prep(
         giants, inst, w, lhat, nhat, tile_b, dem_g, interpret
     )
