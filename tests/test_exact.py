@@ -95,6 +95,28 @@ class TestBranchAndBound:
             if stats_n["engine"] == "native":  # toolchain present
                 assert proven_n
 
+    def test_parallel_engine_matches_sequential(self, rng):
+        # the depth-2 task-queue engine (round 4) must prove the same
+        # optimum as the sequential walk at every thread count — on this
+        # 1-core host the speedup is structural, not wall-clock, but the
+        # equivalence is what guards the shared-incumbent/task algebra
+        from vrpms_tpu.solvers.exact import solve_cvrp_bnb
+
+        n, V = 12, 3
+        pts = rng.uniform(0, 100, (n + 1, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None], axis=-1)
+        dem = np.concatenate([[0], rng.integers(1, 10, n)])
+        cap = float(max(dem.max(), int(dem.sum() / V * 1.4)))
+        inst = make_instance(d, demands=dem, capacities=[cap] * V)
+        costs = []
+        for nt in (1, 2, 4):
+            res, proven, stats = solve_cvrp_bnb(inst, n_threads=nt)
+            if stats["engine"] != "native":
+                pytest.skip("no native toolchain")
+            assert proven
+            costs.append(float(res.cost))
+        assert np.allclose(costs, costs[0], rtol=1e-9)
+
     def test_cost_only_incumbent_never_claims_proven_fallback(self):
         # an incumbent COST below anything reachable must not stamp the
         # NN fallback as a proven optimum (code-review round 3 finding)

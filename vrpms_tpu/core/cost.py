@@ -227,6 +227,18 @@ def onehot_dtype(bound: int):
     return jnp.bfloat16 if bound <= 256 else jnp.float32
 
 
+# XLA:TPU's DEFAULT dot/einsum precision TRUNCATES f32 operands to bf16
+# on the MXU, so a one-hot contraction against a VALUE-carrying f32
+# operand silently rounds values above 256 even when every dtype in the
+# program says float32 (measured on v5e at n=502: node id 315 came out
+# 316 through the one-hot move apply; CPU is exact, which is why CI
+# never saw it). Every einsum whose VALUES are semantic — node ids,
+# demands, ready/due windows, service/start times — must pass this
+# precision. Pure 0/1 contractions and the d-table leg selections keep
+# the fast default (the table's bf16 rounding is disclosed everywhere).
+EXACT = jax.lax.Precision.HIGHEST
+
+
 def _onehot(x: jax.Array, n: int, dtype) -> jax.Array:
     return (x[..., None] == jnp.arange(n, dtype=x.dtype)).astype(dtype)
 
@@ -277,7 +289,10 @@ def _per_route_sums(vals: jax.Array, rid: jax.Array, v: int) -> jax.Array:
     le = (rid[:, :-1, None] <= jnp.arange(v)[None, None, :]).astype(
         jnp.float32
     )
-    cum = jnp.einsum("bkv,bk->bv", le, vals, preferred_element_type=jnp.float32)
+    cum = jnp.einsum(
+        "bkv,bk->bv", le, vals,
+        preferred_element_type=jnp.float32, precision=EXACT,
+    )
     return jnp.diff(cum, axis=1, prepend=jnp.zeros((b, 1), cum.dtype))
 
 
@@ -285,7 +300,8 @@ def _cap_excess_hot(prev_oh, rid, inst: Instance) -> jax.Array:
     """Batched capacity excess without scatter: per-route loads from the
     one-hot-selected per-leg demands."""
     dem_prev = jnp.einsum(
-        "bkn,n->bk", prev_oh, inst.demands, preferred_element_type=jnp.float32
+        "bkn,n->bk", prev_oh, inst.demands,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     load = _per_route_sums(dem_prev, rid, inst.n_vehicles)
     return jnp.maximum(load - inst.capacities, 0.0).sum(-1)
@@ -320,13 +336,16 @@ def tw_components_batch(giants: jax.Array, inst: Instance):
     dist = legs.sum(axis=1)
 
     service_prev = jnp.einsum(
-        "bkn,n->bk", prev_oh, inst.service, preferred_element_type=jnp.float32
+        "bkn,n->bk", prev_oh, inst.service,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     ready_cur = jnp.einsum(
-        "bkn,n->bk", next_oh, inst.ready, preferred_element_type=jnp.float32
+        "bkn,n->bk", next_oh, inst.ready,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     due_cur = jnp.einsum(
-        "bkn,n->bk", next_oh, inst.due, preferred_element_type=jnp.float32
+        "bkn,n->bk", next_oh, inst.due,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
 
     from_depot = giants[:, :-1] == 0
@@ -335,7 +354,7 @@ def tw_components_batch(giants: jax.Array, inst: Instance):
     start_oh = (route_of_leg[..., None] == jnp.arange(v)).astype(jnp.float32)
     start = jnp.einsum(
         "bkv,v->bk", start_oh, inst.start_times,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
 
     t = jnp.where(from_depot, -BIG, legs + service_prev)
@@ -404,20 +423,23 @@ def _td_hot_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Arra
     prev_oh = _onehot(prev, n, dt)
     next_oh = _onehot(cur, n, dt)
     service_prev = jnp.einsum(
-        "bkn,n->bk", prev_oh, inst.service, preferred_element_type=jnp.float32
+        "bkn,n->bk", prev_oh, inst.service,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     ready_cur = jnp.einsum(
-        "bkn,n->bk", next_oh, inst.ready, preferred_element_type=jnp.float32
+        "bkn,n->bk", next_oh, inst.ready,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     due_cur = jnp.einsum(
-        "bkn,n->bk", next_oh, inst.due, preferred_element_type=jnp.float32
+        "bkn,n->bk", next_oh, inst.due,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     rid = _rid_batch(giants)
     route_of_leg = jnp.minimum(rid[:, :-1], v - 1)
     start_oh = (route_of_leg[..., None] == jnp.arange(v)).astype(jnp.float32)
     start = jnp.einsum(
         "bkv,v->bk", start_oh, inst.start_times,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     from_depot = prev == 0
 
@@ -528,7 +550,7 @@ def objective_hot_batch(
     if w.use_makespan:
         service_prev = jnp.einsum(
             "bkn,n->bk", prev_oh, inst.service,
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=EXACT,
         )
         route_dur = _per_route_sums(legs + service_prev, rid, inst.n_vehicles)
         cost = cost + w.makespan * route_dur.max(axis=-1)

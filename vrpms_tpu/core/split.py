@@ -118,8 +118,13 @@ def greedy_split_cost_hot_batch(perms: jax.Array, inst: Instance):
 
     dt = onehot_dtype(max(n_nodes, n + 1))
     oh = _onehot(perms, n_nodes, dt)  # (B, n, N)
+    from vrpms_tpu.core.cost import EXACT
+
+    # demands are VALUES (exact f32 accumulation: TPU's default dot
+    # precision would bf16-truncate them above 256 — core.cost.EXACT)
     dem = jnp.einsum(
-        "bkn,n->bk", oh, inst.demands, preferred_element_type=jnp.float32
+        "bkn,n->bk", oh, inst.demands,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     # direct[k] = d[p_k, p_k+1]; depot detour legs from the 0-row/column.
     x = jnp.einsum(

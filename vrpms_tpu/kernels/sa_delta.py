@@ -341,6 +341,13 @@ def delta_block(
     tall = pl.BlockSpec((lhat, tile_b), lambda g: (0, g))
     row = pl.BlockSpec((1, tile_b), lambda g: (0, g))
     steps = pl.BlockSpec((n_steps, tile_b), lambda g: (0, g))
+    # At the n<=512 gate boundary (lhat = 1024) the block's state +
+    # streams overshoot the default 16 MB scoped-vmem cap by ~1 MB;
+    # v5e has 128 MiB physical VMEM, so raise the cap (same rationale
+    # as sa_delta_tw.delta_tw_block — launches stay 512 steps).
+    params = None if interpret else pltpu.CompilerParams(
+        vmem_limit_bytes=100 * 1024 * 1024
+    )
     out = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -361,6 +368,7 @@ def delta_block(
             jax.ShapeDtypeStruct((lhat, b), jnp.int32),
             jax.ShapeDtypeStruct((1, b), jnp.float32),
         ],
+        compiler_params=params,
         interpret=interpret,
     )(gt_t, dp_t, dist, cape, best_t, best_c, i, r, mt, m, u, temps,
       d_bf16, knn_f32, scal)

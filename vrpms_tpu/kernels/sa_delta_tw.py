@@ -32,7 +32,15 @@ end.
 Rounding contract: leg durations are the bf16-rounded table (identical
 to every hot path); service/ready/due are f32-exact (dp_init's
 exact_f32 attribute init); demands ride gcd-scaled like the untimed
-kernel (kernels.sa_eval.demand_scale). Gates (sa._delta_supported):
+kernel (kernels.sa_eval.demand_scale). Note on in-kernel f32 matmuls
+(the antidiag flips, exact_f32 attr init): unlike XLA's einsum DEFAULT
+precision — which bf16-truncates f32 operands on the MXU and silently
+corrupted node ids > 256 outside kernels (core.cost.EXACT) — Mosaic's
+in-kernel `jnp.dot` with f32 operands is measured EXACT on v5e: the
+n=502 untimed bit-check pushed ids 257..501 through the identical flip
+machinery bit-identically to interpret mode, and this kernel's R101-
+shape hardware bit-check carried non-bf16-representable f32 window
+values (synth horizon-1000 dues) with zero cost deviation. Gates (sa._delta_supported):
 symmetric d, uniform fleet + scalable demands, uniform start times with
 max(start, ready[0]) <= due[0] (so trailing pad legs contribute zero
 lateness), n_nodes and tour length <= 256 (bf16-exact one-hot ids and

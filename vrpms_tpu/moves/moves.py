@@ -21,6 +21,11 @@ import jax.numpy as jnp
 
 N_MOVE_TYPES = 3  # reverse (2-opt), rotate (or-opt relocation), swap
 
+# id-valued one-hot contractions need exact f32 accumulation on TPU
+# (XLA's DEFAULT dot precision truncates f32 operands to bf16 on the
+# MXU: node ids above 256 silently round — see core.cost.EXACT)
+from vrpms_tpu.core.cost import EXACT  # noqa: E402
+
 
 def reverse_segment(giant: jax.Array, i: jax.Array, j: jax.Array) -> jax.Array:
     """2-opt: reverse positions i..j (inclusive). Identity when i >= j."""
@@ -102,6 +107,7 @@ def apply_src_map(giants: jax.Array, src: jax.Array, mode: str = "gather") -> ja
             oh,
             giants.astype(dt),
             preferred_element_type=jnp.float32,
+            precision=EXACT,
         )
         return jnp.round(out).astype(giants.dtype)
     idx = jnp.arange(b, dtype=jnp.int32)[:, None] * length + src
@@ -162,14 +168,20 @@ def window_from_params(i, r, mt, m, giants, knn, mode: str):
         dt_l = onehot_dtype(length)
         oh_i = _onehot(i, length, dt_l)
         a = jnp.round(
-            jnp.einsum("bl,bl->b", oh_i, giants.astype(dt_l))
+            jnp.einsum(
+                "bl,bl->b", oh_i, giants.astype(dt_l), precision=EXACT
+            )
         ).astype(jnp.int32)
         dt_n = onehot_dtype(max(n_nodes, length))
         oh_a = _onehot(a, n_nodes, dt_n)
-        rows = jnp.einsum("bn,nk->bk", oh_a, knn.astype(dt_n))
+        rows = jnp.einsum(
+            "bn,nk->bk", oh_a, knn.astype(dt_n), precision=EXACT
+        )
         oh_r = _onehot(r, k_width, jnp.float32)
         bnode = jnp.round(
-            jnp.einsum("bk,bk->b", rows.astype(jnp.float32), oh_r)
+            jnp.einsum(
+                "bk,bk->b", rows.astype(jnp.float32), oh_r, precision=EXACT
+            )
         ).astype(jnp.int32)
     else:
         a = jnp.take_along_axis(giants, i[:, None], axis=1)[:, 0]
@@ -226,14 +238,20 @@ def knn_src_map(key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str):
         dt_l = onehot_dtype(length)
         oh_i = _onehot(i[:, 0], length, dt_l)
         a = jnp.round(
-            jnp.einsum("bl,bl->b", oh_i, giants.astype(dt_l))
+            jnp.einsum(
+                "bl,bl->b", oh_i, giants.astype(dt_l), precision=EXACT
+            )
         ).astype(jnp.int32)
         dt_n = onehot_dtype(max(n_nodes, length))
         oh_a = _onehot(a, n_nodes, dt_n)
-        rows = jnp.einsum("bn,nk->bk", oh_a, knn.astype(dt_n))
+        rows = jnp.einsum(
+            "bn,nk->bk", oh_a, knn.astype(dt_n), precision=EXACT
+        )
         oh_r = _onehot(r, k_width, jnp.float32)
         bnode = jnp.round(
-            jnp.einsum("bk,bk->b", rows.astype(jnp.float32), oh_r)
+            jnp.einsum(
+                "bk,bk->b", rows.astype(jnp.float32), oh_r, precision=EXACT
+            )
         ).astype(jnp.int32)
     else:
         a = jnp.take_along_axis(giants, i, axis=1)[:, 0]

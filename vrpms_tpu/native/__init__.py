@@ -67,6 +67,7 @@ def load_bnb():
         np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
         ctypes.c_int, ctypes.c_int64,
         ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ctypes.c_int,  # n_threads (<= 0: hardware concurrency)
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_double),
@@ -79,12 +80,14 @@ def load_bnb():
 
 def bnb_solve_native(
     d, dem_s, lam, R, Psi, cap_s, total_s, V,
-    best_cost, time_limit_s, symmetric,
+    best_cost, time_limit_s, symmetric, n_threads: int = 0,
 ):
     """Run the native DFS -> (routes | None, cost, nodes, proven) or None
     when the library cannot be built/loaded. `routes` is None when the
     search found nothing better than `best_cost` (the caller keeps its
-    incumbent)."""
+    incumbent). n_threads 0 = hardware concurrency (the parallel engine
+    splits the forest into depth-2 subtree tasks with a shared atomic
+    incumbent); 1 = the sequential walk."""
     lib = load_bnb()
     if lib is None:
         return None
@@ -104,6 +107,7 @@ def bnb_solve_native(
         float(best_cost) if np.isfinite(best_cost) else 1e300,
         -1.0 if time_limit_s is None else float(time_limit_s),
         1 if symmetric else 0,
+        int(n_threads),
         out_seq, ctypes.byref(out_len), ctypes.byref(out_cost),
         ctypes.byref(out_nodes), ctypes.byref(out_proven),
     )

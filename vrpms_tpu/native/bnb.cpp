@@ -10,6 +10,19 @@
 // owns only the hot tree walk. Built as a shared library and driven via
 // ctypes (no pybind11 in the image).
 //
+// Parallel search (round 4): the DFS forest under (first customer, second
+// branch) splitting is embarrassingly parallel — workers pull depth-2
+// subtree tasks from a shared cheapest-first queue and share one atomic
+// incumbent (each worker refreshes its local bound from it per node, and
+// publishes improvements under a mutex). Each worker owns a private
+// dominance memo: cross-thread dominance sharing would need locking on the
+// hottest structure, and the memo is a pruning accelerator, not a
+// correctness requirement. n_threads <= 0 means hardware_concurrency; 1
+// runs the exact sequential walk (no queue, no atomics on the hot path
+// beyond a relaxed load). The host this was built on exposes ONE core, so
+// the parallel speedup is validated structurally (identical results across
+// thread counts), not by wall-clock here.
+//
 // Contract notes mirrored from the Python twin:
 //  * routes open in strictly increasing order of their first customer;
 //  * for symmetric matrices a closed route with >= 2 customers must have
@@ -19,13 +32,24 @@
 //  * dominance: per (unvisited-set, last, open-route-first) a Pareto set
 //    of (cost, slack, vehicles-left) — beaten on all three => prune.
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <ctime>
+#include <mutex>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
+
+struct Shared {
+  std::atomic<double> best_cost;
+  std::atomic<bool> timed_out;
+  std::mutex mu;                // guards best_seq + best_cost publication
+  std::vector<int> best_seq;    // route-major customers, -1 between routes
+};
 
 struct Ctx {
   int n;                // customers
@@ -39,14 +63,13 @@ struct Ctx {
   int64_t total;
   int psi_rows;         // actual Psi row count = min(V, n)+1 (clamp m)
   bool symmetric;
-  double best_cost;
+  double best_cost;     // local mirror of shared->best_cost
+  Shared* shared;
   int64_t nodes;
   int64_t node_budget;  // deadline check cadence
   double deadline;      // CLOCK_MONOTONIC seconds; <0 => none
   bool timed_out;
-  // best solution: customer sequence with route breaks
-  std::vector<int> best_seq;   // route-major customers, -1 between routes
-  std::vector<int> cur_stack;  // same layout while walking
+  std::vector<int> cur_stack;  // route-major walk state
   struct Dom { double cost; int64_t slack; int m; };
   std::unordered_map<uint64_t, std::vector<Dom>> memo;
   size_t memo_cap = 0;  // max stored entries: billion-node searches must
@@ -71,17 +94,36 @@ struct Child { double step; int j; bool opens; };
 void dfs(Ctx& c, uint64_t unvis, int p, int first, int64_t slack, int m,
          double cost, double sum_lam, int64_t dem_left) {
   if (c.timed_out) return;
+  // pull the freshest incumbent (relaxed: monotone decreasing, a stale
+  // read only costs pruning power, never correctness)
+  {
+    double gb = c.shared->best_cost.load(std::memory_order_relaxed);
+    if (gb < c.best_cost) c.best_cost = gb;
+  }
   if (++c.nodes >= c.node_budget) {
     c.node_budget = c.nodes + 8192;
-    if (c.deadline >= 0 && now_s() > c.deadline) { c.timed_out = true; return; }
+    if (c.shared->timed_out.load(std::memory_order_relaxed)) {
+      c.timed_out = true;
+      return;
+    }
+    if (c.deadline >= 0 && now_s() > c.deadline) {
+      c.timed_out = true;
+      c.shared->timed_out.store(true, std::memory_order_relaxed);
+      return;
+    }
   }
   if (unvis == 0) {
     // canonical orientation: first < last for symmetric multi-customer routes
     if (c.symmetric && p != first && first > p) return;
     double total_cost = cost + dd(c, p, 0);
     if (total_cost < c.best_cost - 1e-12) {
-      c.best_cost = total_cost;
-      c.best_seq = c.cur_stack;
+      std::lock_guard<std::mutex> lk(c.shared->mu);
+      if (total_cost <
+          c.shared->best_cost.load(std::memory_order_relaxed) - 1e-12) {
+        c.shared->best_cost.store(total_cost, std::memory_order_relaxed);
+        c.shared->best_seq = c.cur_stack;
+      }
+      c.best_cost = c.shared->best_cost.load(std::memory_order_relaxed);
     }
     return;
   }
@@ -174,6 +216,18 @@ void dfs(Ctx& c, uint64_t unvis, int p, int first, int64_t slack, int m,
   }
 }
 
+// A depth-<=2 subtree root: the state after choosing the first route's
+// first customer f (and optionally one more branch), plus the stack
+// prefix that reproduces it for solution reconstruction.
+struct Task {
+  double key;      // cheapest-first ordering (cumulative cost)
+  uint64_t unvis;
+  int p, first, m;
+  int64_t slack, dem_left;
+  double cost, sum_lam;
+  std::vector<int> prefix;
+};
+
 }  // namespace
 
 extern "C" int bnb_solve(
@@ -181,6 +235,7 @@ extern "C" int bnb_solve(
     const double* d, const int64_t* dem_s, const double* lam,
     const double* R, const double* Psi, int psi_rows, int64_t total_s,
     double best_cost_in, double time_limit_s, int symmetric,
+    int n_threads,
     // outputs
     int* out_seq,        // size n + V: customers with -1 route breaks
     int* out_seq_len,
@@ -188,50 +243,159 @@ extern "C" int bnb_solve(
     int64_t* out_nodes,
     int* out_proven) {
   if (n < 1 || n > 34) return -1;
-  Ctx c;
-  c.n = n; c.V = V; c.cap = cap_s; c.d = d; c.dem = dem_s; c.lam = lam;
-  c.R = R; c.Psi = Psi; c.total = total_s; c.psi_rows = psi_rows;
-  c.symmetric = symmetric != 0;
-  c.best_cost = best_cost_in;
-  c.nodes = 0; c.node_budget = 8192;
-  c.memo_cap = 30'000'000;  // ~1.5 GB worst case, plenty for the hit rate
-  c.deadline = time_limit_s > 0 ? now_s() + time_limit_s : -1.0;
-  c.timed_out = false;
-  c.cur_stack.reserve(n + V + 2);
+  if (n_threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    n_threads = hc ? int(hc) : 1;
+  }
+
+  Shared shared;
+  shared.best_cost.store(best_cost_in, std::memory_order_relaxed);
+  shared.timed_out.store(false, std::memory_order_relaxed);
+  double deadline = time_limit_s > 0 ? now_s() + time_limit_s : -1.0;
 
   double lam_total = 0;
   int64_t dem_total = 0;
   for (int j = 0; j < n; ++j) { lam_total += lam[j]; dem_total += dem_s[j]; }
-
-  // root: every capacity-feasible first customer, nearest first
-  std::vector<std::pair<double, int>> roots;
   for (int f = 1; f <= n; ++f) {
-    if (dem_s[f - 1] > cap_s) { *out_proven = 0; *out_cost = 1e300;
-      *out_seq_len = 0; *out_nodes = 0; return 1; }  // infeasible customer
-    roots.push_back({dd(c, 0, f), f});
-  }
-  for (size_t i = 1; i < roots.size(); ++i) {  // insertion sort
-    auto x = roots[i]; size_t k = i;
-    while (k > 0 && roots[k - 1].first > x.first) { roots[k] = roots[k - 1]; --k; }
-    roots[k] = x;
+    if (dem_s[f - 1] > cap_s) {  // infeasible customer: nothing to search
+      *out_proven = 0; *out_cost = 1e300; *out_seq_len = 0; *out_nodes = 0;
+      return 1;
+    }
   }
   uint64_t full = (n == 64) ? ~0ull : ((1ull << n) - 1);
-  for (auto& rf : roots) {
-    if (c.timed_out) break;
-    int f = rf.second;
-    if (rf.first >= c.best_cost) continue;
-    c.cur_stack.clear();
-    c.cur_stack.push_back(f);
-    dfs(c, full & ~(1ull << (f - 1)), f, f, cap_s - dem_s[f - 1], V - 1,
-        rf.first, lam_total - lam[f - 1], dem_total - dem_s[f - 1]);
+
+  auto make_ctx = [&](Ctx& c, size_t memo_cap) {
+    c.n = n; c.V = V; c.cap = cap_s; c.d = d; c.dem = dem_s; c.lam = lam;
+    c.R = R; c.Psi = Psi; c.total = total_s; c.psi_rows = psi_rows;
+    c.symmetric = symmetric != 0;
+    c.best_cost = shared.best_cost.load(std::memory_order_relaxed);
+    c.shared = &shared;
+    c.nodes = 0; c.node_budget = 8192;
+    c.memo_cap = memo_cap;
+    c.deadline = deadline;
+    c.timed_out = false;
+    c.cur_stack.reserve(n + V + 2);
+  };
+  // ~1.5 GB worst case total across workers, same envelope as before
+  size_t memo_cap_total = 30'000'000;
+
+  // Depth-1 root states (one per feasible first customer, cheapest first).
+  std::vector<Task> roots;
+  for (int f = 1; f <= n; ++f) {
+    Task t;
+    t.key = d[0 * (n + 1) + f];
+    t.unvis = full & ~(1ull << (f - 1));
+    t.p = f; t.first = f; t.m = V - 1;
+    t.slack = cap_s - dem_s[f - 1];
+    t.dem_left = dem_total - dem_s[f - 1];
+    t.cost = t.key;
+    t.sum_lam = lam_total - lam[f - 1];
+    t.prefix = {f};
+    roots.push_back(std::move(t));
+  }
+  std::sort(roots.begin(), roots.end(),
+            [](const Task& a, const Task& b) { return a.key < b.key; });
+
+  int64_t total_nodes = 0;
+  bool any_timeout = false;
+
+  if (n_threads == 1) {
+    // sequential path: walk the roots directly (identical to the
+    // pre-parallel engine)
+    Ctx c;
+    make_ctx(c, memo_cap_total);
+    for (auto& t : roots) {
+      if (c.timed_out) break;
+      if (t.cost >= c.best_cost) continue;
+      c.cur_stack = t.prefix;
+      dfs(c, t.unvis, t.p, t.first, t.slack, t.m, t.cost, t.sum_lam,
+          t.dem_left);
+    }
+    total_nodes = c.nodes;
+    any_timeout = c.timed_out;
+  } else {
+    // Expand roots one level for balance: the cheapest-first root often
+    // owns most of the tree, so tasks are (first, second-branch) pairs.
+    std::vector<Task> tasks;
+    for (auto& t : roots) {
+      if (n == 1) { tasks.push_back(t); continue; }
+      uint64_t rest = t.unvis;
+      while (rest) {
+        int j = __builtin_ctzll(rest) + 1;
+        rest &= rest - 1;
+        if (dem_s[j - 1] <= t.slack) {  // extend the open route
+          Task u = t;
+          u.key = t.cost + d[t.p * (n + 1) + j];
+          u.cost = u.key;
+          u.unvis = t.unvis & ~(1ull << (j - 1));
+          u.p = j;
+          u.slack = t.slack - dem_s[j - 1];
+          u.sum_lam = t.sum_lam - lam[j - 1];
+          u.dem_left = t.dem_left - dem_s[j - 1];
+          u.prefix.push_back(j);
+          tasks.push_back(std::move(u));
+        }
+        if (t.m >= 1 && j > t.first) {  // close + open route at j
+          Task u = t;
+          u.key = t.cost + d[t.p * (n + 1) + 0] + d[0 * (n + 1) + j];
+          u.cost = u.key;
+          u.unvis = t.unvis & ~(1ull << (j - 1));
+          u.p = j; u.first = j; u.m = t.m - 1;
+          u.slack = cap_s - dem_s[j - 1];
+          u.sum_lam = t.sum_lam - lam[j - 1];
+          u.dem_left = t.dem_left - dem_s[j - 1];
+          u.prefix.push_back(-1);
+          u.prefix.push_back(j);
+          tasks.push_back(std::move(u));
+        }
+      }
+      if (t.unvis == 0) tasks.push_back(t);  // n == 1 edge
+    }
+    std::sort(tasks.begin(), tasks.end(),
+              [](const Task& a, const Task& b) { return a.key < b.key; });
+
+    std::atomic<size_t> next{0};
+    std::atomic<int64_t> nodes_sum{0};
+    std::atomic<bool> timeout_any{false};
+    size_t per_memo = memo_cap_total / size_t(n_threads);
+    auto worker = [&]() {
+      Ctx c;
+      make_ctx(c, per_memo);
+      for (;;) {
+        size_t idx = next.fetch_add(1, std::memory_order_relaxed);
+        if (idx >= tasks.size()) break;
+        if (shared.timed_out.load(std::memory_order_relaxed)) {
+          c.timed_out = true;
+          break;
+        }
+        const Task& t = tasks[idx];
+        double gb = shared.best_cost.load(std::memory_order_relaxed);
+        if (gb < c.best_cost) c.best_cost = gb;
+        if (t.cost >= c.best_cost) continue;
+        c.cur_stack = t.prefix;
+        c.timed_out = false;
+        dfs(c, t.unvis, t.p, t.first, t.slack, t.m, t.cost, t.sum_lam,
+            t.dem_left);
+        if (c.timed_out) break;
+      }
+      nodes_sum.fetch_add(c.nodes, std::memory_order_relaxed);
+      if (c.timed_out) timeout_any.store(true, std::memory_order_relaxed);
+    };
+    std::vector<std::thread> pool;
+    for (int w = 1; w < n_threads; ++w) pool.emplace_back(worker);
+    worker();
+    for (auto& th : pool) th.join();
+    total_nodes = nodes_sum.load();
+    any_timeout = timeout_any.load() ||
+                  shared.timed_out.load(std::memory_order_relaxed);
   }
 
-  *out_nodes = c.nodes;
-  *out_proven = c.timed_out ? 0 : 1;
-  *out_cost = c.best_cost;
-  int len = int(c.best_seq.size());
+  *out_nodes = total_nodes;
+  *out_proven = any_timeout ? 0 : 1;
+  *out_cost = shared.best_cost.load(std::memory_order_relaxed);
+  int len = int(shared.best_seq.size());
   if (len > n + V) len = n + V;
-  for (int i = 0; i < len; ++i) out_seq[i] = c.best_seq[i];
+  for (int i = 0; i < len; ++i) out_seq[i] = shared.best_seq[i];
   *out_seq_len = len;
   return 0;
 }

@@ -145,6 +145,7 @@ def solve_cvrp_bnb(
     incumbent_routes: list[list[int]] | None = None,
     incumbent_cost: float | None = None,
     use_native: bool = True,
+    n_threads: int = 0,
 ):
     """Exact CVRP by DFS branch-and-bound -> (SolveResult, proven, stats).
 
@@ -283,7 +284,7 @@ def solve_cvrp_bnb(
         )
         out = bnb_solve_native(
             d, dem_s, lam, R_tab, Psi, cap_s, total_s, V,
-            best_cost, remaining, symmetric,
+            best_cost, remaining, symmetric, n_threads=n_threads,
         )
         if out is not None:
             routes_n, cost_n, nodes_n, proven_n = out

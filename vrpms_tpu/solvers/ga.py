@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from vrpms_tpu.core.cost import (
+    EXACT,
     CostWeights,
     _onehot,
     exact_cost,
@@ -202,13 +203,14 @@ def order_crossover_hot(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Arr
     oh_rank = _onehot(rank_idx, n + 1, dt)
     compact = jnp.einsum(
         "pkr,pk->pr", oh_rank, (p2 * keep).astype(jnp.float32),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )[:, :n]  # (P, n) values; slot n dumped
     # Fill positions outside the segment with compact[...] in order.
     fill_rank = (jnp.cumsum(~in_seg, axis=1) - 1).astype(jnp.int32)
     oh_fill = _onehot(jnp.clip(fill_rank, 0, n - 1), n, dt)
     fill = jnp.einsum(
-        "pkr,pr->pk", oh_fill, compact, preferred_element_type=jnp.float32
+        "pkr,pr->pk", oh_fill, compact,
+        preferred_element_type=jnp.float32, precision=EXACT,
     )
     return jnp.where(in_seg, p1, jnp.round(fill).astype(p1.dtype))
 
@@ -285,6 +287,7 @@ def ga_generation(
                 winner_oh,
                 perms.astype(jnp.float32),
                 preferred_element_type=jnp.float32,
+                precision=EXACT,
             )
             return jnp.round(rows).astype(perms.dtype)
 

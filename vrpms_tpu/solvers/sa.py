@@ -702,6 +702,58 @@ def _tw_best_rank_fn(length: int):
     return rank
 
 
+def _delta_launch_loop(
+    step_block, state, n_iters, deadline_s, rate_key, sync, resync=None
+):
+    """The 512-step Pallas-launch loop shared by both delta drivers.
+
+    Each launch's presampled param streams are VMEM blocks, so launches
+    stay bounded at 512 steps regardless of the iteration budget;
+    step_block receives GLOBAL iteration offsets (the schedule and the
+    presampled RNG streams must not restart per launch). `resync`, when
+    given, re-derives exact state between launches (the untimed
+    kernel's drift kill; the TW kernel recomputes everything fresh and
+    passes None). The sweep rate persists to the hint cache only on the
+    DEADLINE path — run_blocked syncs the device there, so the clock is
+    honest; a deadline-free loop's dispatches are asynchronous and
+    would record inflated rates.
+    """
+    import time as _time
+
+    from vrpms_tpu.solvers.common import run_blocked
+
+    t_run = _time.monotonic()
+    done = 0
+    remaining = n_iters
+    while remaining > 0:
+        block = min(512, remaining)
+        base = done
+
+        def offset_block(st, nb, start, _base=base):
+            return step_block(st, nb, _base + start)
+
+        state, did = run_blocked(
+            offset_block, state, block, 512,
+            None if deadline_s is None else max(
+                0.0, deadline_s - (_time.monotonic() - t_run)
+            ),
+            sync, rate_hint=_rate_get(rate_key),
+        )
+        done += did
+        remaining -= block
+        if deadline_s is not None and did:
+            el = _time.monotonic() - t_run
+            if el > 0.05:
+                _rate_put(rate_key, done / el)
+        if resync is not None:
+            state = resync(state)
+        if deadline_s is not None and (
+            _time.monotonic() - t_run >= deadline_s or did < block
+        ):
+            break
+    return state, done
+
+
 def _delta_common_setup(inst, params, knn):
     """The device inputs both delta drivers share: padded bf16 d-table,
     padded knn table, demand gcd scale, uniform capacity, interpret
@@ -753,7 +805,6 @@ def _solve_sa_delta_tw(
     import numpy as np
 
     from vrpms_tpu.kernels.sa_delta import dp_init
-    from vrpms_tpu.solvers.common import run_blocked
 
     b, length = giants.shape
     lhat = _pow2_at_least(length)
@@ -800,45 +851,19 @@ def _solve_sa_delta_tw(
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
     horizon = jnp.float32(params.n_iters)
 
-    base_it = 0  # global iteration offset (see the untimed driver: the
-    # schedule and the presampled RNG streams must see GLOBAL
-    # iterations across the 512-step launches)
-
     def step_block(st, nb, start):
+        # `start` is the GLOBAL iteration offset (_delta_launch_loop)
         return _sa_delta_tw_block_fn(nb, length, tile_b, has_knn, interpret)(
             st, k_run, d_bf16, knn_f, scal, t0j, t1j,
-            jnp.int32(base_it + start), horizon,
+            jnp.int32(start), horizon,
         )
 
-    rate_key = ("delta_tw", b, length)
-    import time as _time
-
-    t_run = _time.monotonic()
-    done = 0
-    remaining = params.n_iters
-    # 512-step launch cap (the same loop shape as the untimed driver,
-    # minus its resync): each launch's presampled streams are VMEM
-    # blocks, so n_steps must stay bounded regardless of the deadline
-    while remaining > 0:
-        block = min(512, remaining)
-        state, did = run_blocked(
-            step_block, state, block, 512,
-            None if deadline_s is None else max(
-                0.0, deadline_s - (_time.monotonic() - t_run)
-            ),
-            lambda st: st[8],
-            rate_hint=_rate_get(rate_key),
-        )
-        done += did
-        base_it += did
-        remaining -= block
-        if deadline_s is not None:
-            if did:
-                el = _time.monotonic() - t_run
-                if el > 0.05:
-                    _rate_put(rate_key, done / el)
-            if _time.monotonic() - t_run >= deadline_s or did < block:
-                break
+    # the TW kernel recomputes dist/cape/lateness fresh each step, so
+    # there is nothing to resync between launches
+    state, done = _delta_launch_loop(
+        step_block, state, params.n_iters, deadline_s,
+        ("delta_tw", b, length), lambda st: st[8],
+    )
 
     best_t = state[7]
     best_exact = _tw_best_rank_fn(length)(best_t, inst, w)
@@ -919,56 +944,31 @@ def solve_sa_delta(
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
     horizon = jnp.float32(params.n_iters)
 
-    base_it = 0  # global iteration offset: run_blocked numbers its
-    # blocks from 0 within each call, but the schedule and the
-    # presampled RNG streams must see GLOBAL iterations (a block that
-    # restarts at 0 replays the same proposals at replayed temperatures)
-
     def step_block(st, nb, start):
+        # `start` arrives as the GLOBAL iteration offset from
+        # _delta_launch_loop (the schedule and the presampled RNG
+        # streams must not restart per launch)
         return _sa_delta_block_fn(nb, length, tile_b, has_knn, interpret)(
             st, k_run, d_bf16, knn_f, scal2, t0j, t1j,
-            jnp.int32(base_it + start), horizon,
+            jnp.int32(start), horizon,
         )
 
-    # block-wise with an exact resync between blocks (drift kill); the
+    # block-wise with an exact resync between launches (drift kill); the
     # same deadline/rate contract as solve_sa
-    from vrpms_tpu.solvers.common import run_blocked
-
     resync = _delta_resync_fn(length, interpret)
-    rate_key = ("delta", b, length)
-    import time as _time
 
-    t_run = _time.monotonic()
-    done = 0
-    remaining = params.n_iters
-    while remaining > 0:
-        block = min(512, remaining)
-        st, did = run_blocked(
-            step_block, state, block, 512,
-            None if deadline_s is None else max(
-                0.0, deadline_s - (_time.monotonic() - t_run)
-            ),
-            lambda s: s[5],
-            rate_hint=_rate_get(rate_key),
-        )
-        state = st
-        done += did
-        base_it += did
-        remaining -= block
-        if did:
-            el = _time.monotonic() - t_run
-            if el > 0.05:
-                _rate_put(rate_key, done / el)
+    def resync_state(st):
         # exact resync of the committed state (fp drift accumulates in
         # the f32 delta sums; measured well under 1e-3 per 512 steps,
         # but exactness is the contract)
-        gt_t, dp_t, _, _, best_t, best_c = state
+        gt_t, dp_t, _, _, best_t, best_c = st
         dist, cape = resync(gt_t, inst, w)
-        state = (gt_t, dp_t, dist, cape / dem_g, best_t, best_c)
-        if deadline_s is not None and _time.monotonic() - t_run >= deadline_s:
-            break
-        if did < block:
-            break
+        return (gt_t, dp_t, dist, cape / dem_g, best_t, best_c)
+
+    state, done = _delta_launch_loop(
+        step_block, state, params.n_iters, deadline_s,
+        ("delta", b, length), lambda s: s[5], resync=resync_state,
+    )
 
     gt_t, dp_t, dist, cape, best_t, best_c = state
     # Champion/elite selection by EXACT re-evaluated cost of the best
