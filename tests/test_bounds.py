@@ -131,3 +131,89 @@ class TestRouteCount:
             d, demands=[0, 3, 3, 3, 3], capacities=[12.0, 5.0, 1.0, 1.0]
         )
         assert route_count_lb(inst2) == 1
+
+
+class TestNgRoute:
+    """ng-route relaxation tables (native/ngroute.cpp + io/bounds.py
+    wiring): validity against exact optima and a pure-python oracle."""
+
+    def _py_ng(self, d, dem_s, lam, ng_sets, cap_s):
+        """Tiny pure-python ng DP twin (exponential-ish; test sizes only)."""
+        n = len(dem_s)
+        g = ng_sets.shape[1]
+        pos_of = [{int(u): p for p, u in enumerate(ng_sets[i]) if u >= 1}
+                  for i in range(n)]
+        INF = float("inf")
+        import itertools
+
+        B = {}
+        for i in range(n):
+            for M in range(1 << g):
+                B[(0, i, M)] = d[i + 1, 0]
+        for q in range(1, cap_s + 1):
+            for i in range(n):
+                for M in range(1 << g):
+                    best = INF
+                    for j in range(n):
+                        if j == i or dem_s[j] > q:
+                            continue
+                        pj = pos_of[i].get(j + 1)
+                        if pj is not None and (M >> pj) & 1:
+                            continue
+                        Mj = 1 << pos_of[j][j + 1]
+                        for p in range(g):
+                            if (M >> p) & 1:
+                                t = pos_of[j].get(int(ng_sets[i][p]))
+                                if t is not None:
+                                    Mj |= 1 << t
+                        v = d[i + 1, j + 1] + lam[j] + B[(q - dem_s[j], j, Mj)]
+                        best = min(best, v)
+                    B[(q, i, M)] = best
+        R = np.full((cap_s + 1, n), INF)
+        rq = np.full(cap_s + 1, INF)
+        for q in range(cap_s + 1):
+            for i in range(n):
+                R[q, i] = B[(q, i, 1 << pos_of[i][i + 1])]
+            for j in range(n):
+                if dem_s[j] <= q:
+                    rq[q] = min(
+                        rq[q],
+                        d[0, j + 1] + lam[j]
+                        + B[(q - dem_s[j], j, 1 << pos_of[j][j + 1])],
+                    )
+        return rq, R
+
+    def test_native_matches_python_oracle(self, rng):
+        from vrpms_tpu.io.bounds import _ng_sets
+        from vrpms_tpu.native import ngroute_tables_native
+
+        for seed in range(3):
+            r = np.random.default_rng(seed)
+            n = 6
+            d = euclid(r, n + 1)
+            dem = [int(x) for x in r.integers(1, 4, n)]
+            lam = r.uniform(-2, 5, n)
+            ng = _ng_sets(d, g=3)
+            cap = int(sum(dem) // 2 + 2)
+            out = ngroute_tables_native(d, dem, lam, ng, cap)
+            if out is None:
+                pytest.skip("no native toolchain")
+            rq_n, R_n = out
+            rq_p, R_p = self._py_ng(d, dem, lam, ng, cap)
+            rq_n = np.where(rq_n > 1e299, np.inf, rq_n)
+            R_n = np.where(R_n > 1e299, np.inf, R_n)
+            np.testing.assert_allclose(rq_n, rq_p, rtol=1e-9)
+            np.testing.assert_allclose(R_n, R_p, rtol=1e-9)
+
+    def test_ng_sharpened_bound_stays_valid(self, rng):
+        # the full ascent (with its final ng evaluation) must never
+        # exceed the exact optimum
+        for seed in range(3):
+            r = np.random.default_rng(seed + 20)
+            n = 7
+            d = euclid(r, n)
+            demands = [0] + [int(x) for x in r.integers(1, 4, n - 1)]
+            inst = make_instance(d, demands=demands, capacities=[8.0] * 3)
+            opt = float(solve_vrp_bf(inst).cost)
+            lb = cmt_qroute_lb(inst, iters=40, ub=opt)
+            assert 0 < lb <= opt * (1 + 1e-5) + 1e-4, (seed, lb, opt)

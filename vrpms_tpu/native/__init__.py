@@ -22,10 +22,17 @@ _SRC = os.path.join(_DIR, "bnb.cpp")
 _LIB = os.path.join(_DIR, "libbnb.so")
 _lib = None
 _load_failed = False
+_NG_SRC = os.path.join(_DIR, "ngroute.cpp")
+_NG_LIB = os.path.join(_DIR, "libngroute.so")
+_ng_lib = None
+_ng_load_failed = False
 
 
-def _build() -> bool:
-    cmd = ["g++", "-O2", "-march=native", "-shared", "-fPIC", "-o", _LIB, _SRC]
+def _build(src: str = _SRC, lib: str = _LIB) -> bool:
+    cmd = [
+        "g++", "-O2", "-march=native", "-pthread", "-shared", "-fPIC",
+        "-o", lib, src,
+    ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:  # no g++ / hung
@@ -124,3 +131,59 @@ def bnb_solve_native(
                 cur.append(int(v))
         routes.append(cur)
     return routes, float(out_cost.value), int(out_nodes.value), bool(out_proven.value)
+
+
+def load_ngroute():
+    """The compiled ng-route table builder; None when unavailable."""
+    global _ng_lib, _ng_load_failed
+    if _ng_lib is not None:
+        return _ng_lib
+    if _ng_load_failed:
+        return None
+    fresh = os.path.exists(_NG_LIB) and os.path.getmtime(
+        _NG_LIB
+    ) >= os.path.getmtime(_NG_SRC)
+    if not fresh and not _build(_NG_SRC, _NG_LIB):
+        _ng_load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(_NG_LIB)
+    except OSError as e:  # pragma: no cover - corrupt artifact
+        print(f"vrpms_tpu.native: ngroute load failed ({e})", file=sys.stderr)
+        _ng_load_failed = True
+        return None
+    lib.ngroute_tables.restype = ctypes.c_int
+    lib.ngroute_tables.argtypes = [
+        ctypes.c_int,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_int,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+    ]
+    _ng_lib = lib
+    return lib
+
+
+def ngroute_tables_native(d, dem_s, lam, ng_sets, cap_s):
+    """Native ng-route DP -> (route_q[cap_s+1], R[(cap_s+1), n]) or None
+    when the library cannot be built/loaded. `ng_sets` is an (n, g)
+    int32 array of 1-based customer ids; row i must contain i+1."""
+    lib = load_ngroute()
+    if lib is None:
+        return None
+    n = len(dem_s)
+    d = np.ascontiguousarray(d, np.float64)
+    dem = np.ascontiguousarray(dem_s, np.int64)
+    lam = np.ascontiguousarray(lam, np.float64)
+    ng = np.ascontiguousarray(ng_sets, np.int32)
+    g = ng.shape[1]
+    route_q = np.zeros(int(cap_s) + 1, np.float64)
+    R = np.zeros((int(cap_s) + 1, n), np.float64)
+    rc = lib.ngroute_tables(n, d, dem, int(cap_s), lam, ng, g, route_q, R)
+    if rc != 0:
+        return None
+    return route_q, R

@@ -349,7 +349,6 @@ extern "C" int bnb_solve(
           tasks.push_back(std::move(u));
         }
       }
-      if (t.unvis == 0) tasks.push_back(t);  // n == 1 edge
     }
     std::sort(tasks.begin(), tasks.end(),
               [](const Task& a, const Task& b) { return a.key < b.key; });

@@ -1,0 +1,157 @@
+// ng-route relaxation tables for the CVRP lower bound / branch-and-bound.
+//
+// The q-route machinery in io/bounds.py relaxes route elementarity down
+// to 2-cycle elimination: walks may revisit a customer after one
+// intermediate hop, which is most of why the X-n200 certificate sat at
+// 16-18% (VERDICT round-3 item 4). The ng-route relaxation
+// (Baldacci-Mingozzi-Roberti) is strictly finer-grained: every walk
+// state carries a MEMORY — the subset of recently-visited customers
+// still remembered — and a customer may be revisited only after it has
+// been forgotten (dropped by a hop whose neighbor set does not contain
+// it). With neighbor sets NG(i) = {i and its g-1 nearest customers},
+// elementary routes remain feasible trajectories, so the DP value is a
+// valid lower bound, and cheap local cycles (the ones that dominate the
+// 2-cycle table) are excluded because nearby customers remember each
+// other.
+//
+// State: B[q][i][M] = min cost of a walk that STARTS at customer i
+// (i already visited; collecting nothing for i), collects exactly q
+// more scaled demand units from entered customers (each entered j pays
+// d[.,j] + lam[j]), and ends at the depot. M is a bitmask over NG(i)'s
+// positions (i's own bit always set). Transition (pull form):
+//
+//   B[q][i][M] = min over customers j with dem_j <= q and j not in M:
+//                d[i][j] + lam[j] + B[q - dem_j][j][proj_j(M) | bit_j]
+//   B[0][i][M] = d[i][0]
+//
+// where proj_j(M) re-expresses M's node-set intersected with NG(j) in
+// NG(j)'s bit positions (a precomputed per-(i, j) bit remap). Exactly-q
+// semantics match the 2-cycle tables, so the outputs are drop-in:
+//
+//   R[q][i]    = B[q][i][{i}]   (completion table for the B&B pruner —
+//                the true completion path from i is elementary, hence a
+//                feasible trajectory from memory {i})
+//   route_q[q] = min_j d[0][j] + lam[j] + B[q - dem_j][j][{j}]
+//                (closed penalized q-routes for the combo/Psi DP)
+//
+// Neither table dominates the 2-cycle one pointwise (an ng walk may
+// 2-cycle through a customer OUTSIDE the neighbor sets), so the Python
+// side takes the elementwise MAX of both — each is a valid lower bound.
+//
+// Complexity: (cap_s+1) * n * 2^g states, n transitions each — ~300M
+// simple ops at the X-n200 scale (g=8), a second or two of single-core
+// C++; certificates are offline artifacts and the B&B builds tables
+// once at the root. Compiled into the same ctypes-loaded library
+// family as bnb.cpp (no pybind11 in the image).
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+constexpr double INF = 1e300;
+}
+
+extern "C" int ngroute_tables(
+    int n,                 // customers
+    const double* d,       // (n+1)^2 row-major
+    const int64_t* dem,    // n scaled integer demands (>= 1)
+    int64_t cap_s,         // scaled capacity (max walk load)
+    const double* lam,     // n penalties (entering j costs lam[j-1])
+    const int32_t* ng,     // n x g: NG sets as customer ids (1-based);
+                           // ng[i*g + .] MUST contain i+1; pad with 0
+    int g,                 // memory width (<= 16)
+    // outputs
+    double* route_q,       // cap_s + 1
+    double* R_out) {       // (cap_s + 1) x n, row-major R[q*n + i]
+  if (n < 1 || g < 1 || g > 16 || cap_s < 0) return -1;
+  const int np1 = n + 1;
+  const int masks = 1 << g;
+
+  // position of customer id u in NG(i), or -1
+  std::vector<int8_t> pos_of(size_t(n) * np1, -1);
+  std::vector<int8_t> self_pos(n, -1);
+  for (int i = 0; i < n; ++i) {
+    for (int p = 0; p < g; ++p) {
+      int32_t u = ng[size_t(i) * g + p];
+      if (u >= 1 && u <= n) {
+        pos_of[size_t(i) * np1 + u] = int8_t(p);
+        if (u == i + 1) self_pos[i] = int8_t(p);
+      }
+    }
+    if (self_pos[i] < 0) return -2;  // NG(i) must contain i
+  }
+
+  // per-(i, j) bit remap: bit p of a mask at i maps to bit bp[...] at j
+  // (or drops). Built once; the hot loop ORs over set bits.
+  std::vector<int8_t> bp(size_t(n) * n * g, -1);
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < n; ++j) {
+      if (i == j) continue;
+      int8_t* row = &bp[(size_t(i) * n + j) * g];
+      for (int p = 0; p < g; ++p) {
+        int32_t u = ng[size_t(i) * g + p];
+        if (u >= 1 && u <= n) row[p] = pos_of[size_t(j) * np1 + u];
+      }
+    }
+
+  // B layers: two full (n x masks) planes would be wrong — dem_j varies,
+  // so keep all q layers (the table IS the output's intermediate).
+  std::vector<double> B(size_t(cap_s + 1) * n * masks, INF);
+  auto idx = [&](int64_t q, int i, int M) {
+    return (size_t(q) * n + i) * masks + M;
+  };
+  for (int i = 0; i < n; ++i) {
+    double home = d[size_t(i + 1) * np1 + 0];
+    for (int M = 0; M < masks; ++M) B[idx(0, i, M)] = home;
+  }
+
+  for (int64_t q = 1; q <= cap_s; ++q) {
+    for (int i = 0; i < n; ++i) {
+      const double* di = d + size_t(i + 1) * np1;
+      const int8_t* pos_i = &pos_of[size_t(i) * np1];
+      for (int M = 0; M < masks; ++M) {
+        double best = INF;
+        for (int j = 0; j < n; ++j) {
+          if (j == i) continue;
+          int64_t dj = dem[j];
+          if (dj > q) continue;
+          int8_t pj = pos_i[j + 1];
+          if (pj >= 0 && (M >> pj) & 1) continue;  // j still remembered
+          // project M onto NG(j), then remember j
+          const int8_t* row = &bp[(size_t(i) * n + j) * g];
+          int Mj = 1 << self_pos[j];
+          int rest = M;
+          while (rest) {
+            int p = __builtin_ctz(rest);
+            rest &= rest - 1;
+            int8_t t = row[p];
+            if (t >= 0) Mj |= 1 << t;
+          }
+          double v = di[j + 1] + lam[j] + B[idx(q - dj, j, Mj)];
+          if (v < best) best = v;
+        }
+        B[idx(q, i, M)] = best;
+      }
+    }
+  }
+
+  // outputs
+  for (int64_t q = 0; q <= cap_s; ++q)
+    for (int i = 0; i < n; ++i)
+      R_out[size_t(q) * n + i] = B[idx(q, i, 1 << self_pos[i])];
+  for (int64_t q = 0; q <= cap_s; ++q) {
+    double best = INF;
+    for (int j = 0; j < n; ++j) {
+      int64_t dj = dem[j];
+      if (dj > q) continue;
+      double v = d[0 * np1 + (j + 1)] + lam[j] +
+                 B[idx(q - dj, j, 1 << self_pos[j])];
+      if (v < best) best = v;
+    }
+    route_q[q] = best;  // INF when no walk reaches exactly q
+  }
+  return 0;
+}

@@ -251,7 +251,9 @@ def solve_cvrp_bnb(
     )
     qtab = None
     if asc is not None:
-        tabs = qpath_completion_tables(inst, asc["lam"])
+        tabs = qpath_completion_tables(
+            inst, asc["lam"], ng_tables=asc.get("ng_tables")
+        )
         if tabs is not None:
             R_tab, Psi = tabs
             lam = asc["lam"]
