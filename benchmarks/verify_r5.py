@@ -1,0 +1,145 @@
+"""Round-5 fixture verification gauntlet (run BEFORE registering fixtures).
+
+Adjudicates the two new hand-embedded transcriptions against published
+anchors, per the methodology proven in round 3 (which certified A-n32-k5
+and convicted A-n33-k5):
+
+  E-n51-k5 (Christofides-Eilon, eil51 coordinate set):
+    - demand sum 777 <= 5*160, bin-packing minimum fleet = 5
+    - TSP on the same 51 coords (nint) has published optimum 426 (TSPLIB
+      eil51): solver must land >= 426, ideally == (never below)
+    - CVRP optimum 521 (nint rounding): solver >= 521, ideally ==
+    - CMT1 (same data, real-valued distances, cap 160): BKS 524.61
+
+  R101 (full 100-customer Solomon):
+    - rows 1..25 must EXACTLY match the in-repo R101_25.txt whose
+      transcription was certified in round 3 (exact optimum 617.1 hit)
+    - first-50 sub-instance = R101.50, exact optimum 1044.0 (Kohl et
+      al.): solver >= 1044, ideally ==
+    - full instance: distance-minimizing optimum 1637.7; solver >= and
+      within a few percent
+
+Usage: python benchmarks/verify_r5.py [--budget S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from vrpms_tpu.io.cvrplib import load_cvrplib, load_solomon, parse_solomon
+from vrpms_tpu.io import bounds
+from vrpms_tpu.solvers import ILSParams, SAParams, solve_ils
+
+FIXDIR = "vrpms_tpu/io/fixtures"
+
+
+def solomon_subset_text(path: str, k: int) -> str:
+    """Header + depot + first k customer rows of a Solomon file."""
+    out = []
+    ncust = 0
+    for ln in open(path):
+        s = ln.split()
+        if s and s[0].isdigit() and len(s) >= 7:
+            if int(s[0]) > 0:
+                ncust += 1
+                if ncust > k:
+                    continue
+        out.append(ln)
+    return "".join(out)
+
+
+def report(tag, cost, anchor, lo_ok=None):
+    gap = 100.0 * (cost - anchor) / anchor
+    flag = "OK" if cost >= anchor - 1e-4 else "!!! BELOW PUBLISHED — BAD DATA"
+    print(f"[{tag}] cost={cost:.1f} anchor={anchor} gap={gap:+.2f}%  {flag}")
+    return cost >= anchor - 1e-4
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=30.0)
+    ap.add_argument("--only", default="")
+    args = ap.parse_args()
+    t0 = time.time()
+    ok = True
+
+    # ---- prefix check: R101 rows 0..25 vs certified R101_25.txt ----
+    if not args.only or args.only == "prefix":
+        i25, _ = load_solomon(f"{FIXDIR}/R101_25.txt", n_vehicles=8)
+        full_txt = open(f"{FIXDIR}/R101.txt").read()
+        i25b, _ = parse_solomon(solomon_subset_text(f"{FIXDIR}/R101.txt", 25),
+                                n_vehicles=8)
+        for field in ("demands", "ready", "due", "service"):
+            a = np.asarray(getattr(i25, field))
+            b = np.asarray(getattr(i25b, field))
+            assert np.allclose(a, b), f"prefix mismatch in {field}"
+        da = np.asarray(i25.durations[0])
+        db = np.asarray(i25b.durations[0])
+        assert np.allclose(da, db), "prefix mismatch in distances (coords)"
+        print("[prefix] R101 rows 0..25 EXACTLY match certified R101_25.txt")
+
+    # ---- E-n51-k5 ----
+    if not args.only or args.only == "e51":
+        inst, meta = load_cvrplib(f"{FIXDIR}/E-n51-k5.vrp", round_nint=True)
+        dem = np.asarray(inst.demands)
+        assert dem.sum() == 777, f"demand sum {dem.sum()} != 777"
+        assert inst.n_vehicles == 5
+        lb = bounds.lower_bound(inst)
+        print(f"[e51] demand sum 777 OK, fleet 5, lower bound {lb:.1f} "
+              f"(must be <= 521): {'OK' if lb <= 521 else 'VIOLATED'}")
+        ok &= lb <= 521 + 1e-6
+
+        # TSP anchor: same coordinates, single vehicle -> eil51, opt 426
+        tsp, _ = load_cvrplib(f"{FIXDIR}/E-n51-k5.vrp", round_nint=True,
+                              n_vehicles=1)
+        # lift capacity so the single route is feasible
+        import dataclasses
+        tsp = dataclasses.replace(
+            tsp, capacities=tsp.capacities * 0 + float(dem.sum()))
+        res = solve_ils(tsp, key=0, params=ILSParams(
+            rounds=6, sa=SAParams(n_chains=1024, n_iters=8000), pool=32,
+            polish_sweeps=128), deadline_s=args.budget)
+        ok &= report("e51/tsp eil51", float(res.cost), 426.0)
+
+        res = solve_ils(inst, key=0, params=ILSParams(
+            rounds=6, sa=SAParams(n_chains=1024, n_iters=8000), pool=32,
+            polish_sweeps=128), deadline_s=args.budget)
+        ok &= report("e51/cvrp", float(res.cost), 521.0)
+
+        # CMT1 anchor: real-valued euclidean distances, BKS 524.61
+        instf, _ = load_cvrplib(f"{FIXDIR}/E-n51-k5.vrp", round_nint=False)
+        res = solve_ils(instf, key=0, params=ILSParams(
+            rounds=6, sa=SAParams(n_chains=1024, n_iters=8000), pool=32,
+            polish_sweeps=128), deadline_s=args.budget)
+        ok &= report("e51/cmt1 float", float(res.cost), 524.61)
+
+    # ---- R101.50 ----
+    if not args.only or args.only == "r50":
+        inst, _ = parse_solomon(solomon_subset_text(f"{FIXDIR}/R101.txt", 50),
+                                n_vehicles=12)
+        res = solve_ils(inst, key=0, params=ILSParams(
+            rounds=6, sa=SAParams(n_chains=1024, n_iters=8000), pool=32,
+            polish_sweeps=128), deadline_s=args.budget * 2)
+        ok &= report("r101.50", float(res.cost), 1044.0)
+
+    # ---- R101 full ----
+    if not args.only or args.only == "r100":
+        inst, _ = load_solomon(f"{FIXDIR}/R101.txt", n_vehicles=20)
+        res = solve_ils(inst, key=0, params=ILSParams(
+            rounds=8, sa=SAParams(n_chains=1024, n_iters=8000), pool=32,
+            polish_sweeps=128), deadline_s=args.budget * 3)
+        ok &= report("r101 full", float(res.cost), 1637.7)
+
+    print(f"[done] {'ALL CHECKS PASSED' if ok else 'FAILURES — see above'} "
+          f"({time.time() - t0:.1f}s)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import sys
+import math
 import time
 import traceback
 
@@ -264,7 +265,16 @@ def _island_setup(opts):
     return mesh, ip
 
 
-def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None, w=None):
+def _enum_certificate(res, inst, split_exact: bool) -> dict:
+    """Proof certificate for the chunked-enumeration paths: optimality
+    is proven iff every order was scored AND the per-order pricing was
+    itself exact (the greedy split under TW/TD/makespan is not)."""
+    complete = int(res.evals) >= math.factorial(inst.n_customers)
+    return {"proven": bool(complete and split_exact), "method": "enumeration"}
+
+
+def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None, w=None,
+                    extras=None):
     """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
     seed = int(opts.get("seed") or 0)
     iters = opts.get("iteration_count")
@@ -301,8 +311,17 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     and untimed
                     and not w.use_makespan
                 ):
-                    return solve_tsp_exact(inst, weights=w)
-                return solve_tsp_bf(inst, weights=w, deadline_s=deadline)
+                    res = solve_tsp_exact(inst, weights=w)
+                    if extras is not None:
+                        extras["exact"] = {"proven": True, "method": "held-karp"}
+                    return res
+                res = solve_tsp_bf(inst, weights=w, deadline_s=deadline)
+                if extras is not None:
+                    # a single-vehicle tour fully determines its
+                    # schedule, so complete enumeration is exact even
+                    # with time windows
+                    extras["exact"] = _enum_certificate(res, inst, split_exact=True)
+                return res
             from vrpms_tpu.solvers.exact import (
                 MAX_BNB_CUSTOMERS,
                 InfeasibleError,
@@ -318,10 +337,20 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 # explicit timeLimit 0 means "stop ASAP" (same semantics
                 # as _deadline everywhere else), not "no limit"
                 try:
-                    res, _proven, _stats = solve_cvrp_bnb(
+                    res, proven, bnb_stats = solve_cvrp_bnb(
                         inst, weights=w,
                         time_limit_s=60.0 if deadline is None else deadline,
                     )
+                    # the whole point of an exact endpoint is the
+                    # certificate: report whether the tree was exhausted
+                    # (optimality PROVEN) or the deadline cut the search
+                    # at an incumbent (VERDICT r4 weak-5)
+                    if extras is not None:
+                        extras["exact"] = {
+                            "proven": bool(proven),
+                            "method": "branch-and-bound",
+                            "nodes": int(bnb_stats.get("nodes", 0)),
+                        }
                     return res
                 except InfeasibleError:
                     # No capacity-feasible solution exists: the B&B has
@@ -332,8 +361,26 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     # other solver honors (ADVICE round 3).
                     from vrpms_tpu.solvers.local_search import solve_nn_2opt
 
+                    if extras is not None:
+                        extras["exact"] = {
+                            "proven": False,
+                            "method": "nn-2opt-fallback",
+                            "infeasible": True,
+                        }
                     return solve_nn_2opt(inst, weights=w)
-            return solve_vrp_bf(inst, weights=w, deadline_s=deadline)
+            res = solve_vrp_bf(inst, weights=w, deadline_s=deadline)
+            if extras is not None:
+                # timed/makespan instances are enumerated over orders
+                # but priced by the GREEDY split (solvers.bf), which is
+                # not exact over the full split space — never certify
+                # those (code review r5)
+                split_exact = not (
+                    inst.has_tw or inst.time_dependent or w.use_makespan
+                )
+                extras["exact"] = _enum_certificate(
+                    res, inst, split_exact=split_exact
+                )
+            return res
         if algorithm == "sa":
             p = SAParams(
                 n_chains=int(pop or 128),
@@ -645,13 +692,19 @@ def _polish(res, inst, opts, w, t_start):
     return SolveResult(champ, cost, bd, evals), ran
 
 
-def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm):
-    """Timed + optionally profiled dispatch; returns (res, stats|None)."""
+def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
+                extras=None):
+    """Timed + optionally profiled dispatch; returns (res, stats|None).
+
+    `extras`, when given, is filled with solver-path metadata that
+    belongs in the response regardless of includeStats — currently the
+    exact path's proof certificate (extras["exact"]).
+    """
     t0 = time.perf_counter()
     w = _request_weights(opts)
     with _profiled(opts) as trace_dir:
         res = _solve_instance(
-            inst, algorithm, opts, ga_params, errors, problem, warm, w
+            inst, algorithm, opts, ga_params, errors, problem, warm, w, extras
         )
         res, polished = _polish(res, inst, opts, w, t0)
         if res is not None:
@@ -731,8 +784,10 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     # without a warm hook, being exact).
     if opts.get("warm_start") and database is not None and algorithm != "bf":
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "vrp")
+    extras: dict = {}
     with _device_ctx(opts.get("backend")):
-        res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "vrp", warm)
+        res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "vrp", warm,
+                                 extras)
     if res is None:
         return None
 
@@ -758,6 +813,8 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
         "durationSum": _as_float(bd.duration_sum),
         "vehicles": vehicles,
     }
+    if extras.get("exact") is not None:
+        result["exact"] = extras["exact"]
     if stats is not None:
         result["stats"] = stats
     if database is not None:
@@ -830,8 +887,10 @@ def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
         )
     ):
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "tsp")
+    extras: dict = {}
     with _device_ctx(opts.get("backend")):
-        res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "tsp", warm)
+        res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "tsp", warm,
+                                 extras)
     if res is None:
         return None
 
@@ -841,6 +900,8 @@ def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
         "duration": _as_float(res.breakdown.duration_sum),
         "vehicle": tour,
     }
+    if extras.get("exact") is not None:
+        result["exact"] = extras["exact"]
     if stats is not None:
         result["stats"] = stats
     if database is not None:

@@ -69,6 +69,17 @@ extern "C" int ngroute_tables(
   if (n < 1 || g < 1 || g > 16 || cap_s < 0) return -1;
   const int np1 = n + 1;
   const int masks = 1 << g;
+  // size guard INSIDE the library (ADVICE r4): the Python wrapper's
+  // _ng_budget_ok is advisory; a direct caller with large cap_s/g must
+  // get an error code, not a std::bad_alloc escaping extern "C" into
+  // ctypes (which aborts the process). 2e9 doubles ~ 16 GB, far above
+  // any budget the wrapper admits (600 MB).
+  // computed in double: a size_t product would wrap modulo 2^64 for a
+  // huge cap_s and slip PAST the guard (code review r5)
+  if (double(cap_s) + 1.0 > 2e9 ||
+      (double(cap_s) + 1.0) * double(n) * double(masks) > 2e9)
+    return -3;
+  try {
 
   // position of customer id u in NG(i), or -1
   std::vector<int8_t> pos_of(size_t(n) * np1, -1);
@@ -154,4 +165,7 @@ extern "C" int ngroute_tables(
     route_q[q] = best;  // INF when no walk reaches exactly q
   }
   return 0;
+  } catch (...) {
+    return -3;  // allocation failure — report, never abort the host
+  }
 }

@@ -246,8 +246,12 @@ def solve_cvrp_bnb(
     from vrpms_tpu.io.bounds import cmt_qroute_ascent, qpath_completion_tables
 
     asc_iters = 80 if time_limit_s is None else min(80, max(5, int(time_limit_s * 10)))
+    # the ng sharpening pass costs seconds of native DP (plus a one-time
+    # g++ build); only afford it when the budget is generous (ADVICE r4)
     asc = cmt_qroute_ascent(
-        inst, iters=asc_iters, ub=None if not np.isfinite(best_cost) else best_cost
+        inst, iters=asc_iters,
+        ub=None if not np.isfinite(best_cost) else best_cost,
+        ng_sharpen=time_limit_s is None or time_limit_s >= 10.0,
     )
     qtab = None
     if asc is not None:

@@ -438,7 +438,7 @@ def solve_sa(
         elite = best_g[order]
     # evals from the actual batch (init_giants may differ from n_chains)
     return SolveResult(
-        g, cost, bd, jnp.int32(giants.shape[0] * done), elite
+        g, cost, bd, jnp.float32(giants.shape[0] * done), elite
     )
 
 
@@ -810,7 +810,12 @@ def _solve_sa_delta_tw(
     lhat = _pow2_at_least(length)
     # 512-chain tiles measured fastest (15.9 vs 14.5M moves/s at 128 on
     # v5e, R101 shape) under the raised scoped-VMEM cap (delta_tw_block)
-    tile_b = next((tb for tb in (512, 256, 128) if b % tb == 0), None)
+    # — but that budget was validated at lhat=128 with no headroom, and
+    # per-step temporaries scale with lhat, so halve the tile when the
+    # gate admits longer tours (lhat=256) instead of blowing VMEM
+    # (ADVICE r4 medium).
+    prefs = (512, 256, 128) if lhat <= 128 else (256, 128)
+    tile_b = next((tb for tb in prefs if b % tb == 0), None)
     if tile_b is None:
         raise ValueError(f"delta path needs a 128-multiple batch, got {b}")
     nhat, dem_g, d_bf16, knn_f, has_knn, cap0, interpret = (
@@ -874,7 +879,7 @@ def _solve_sa_delta_tw(
     if pool > 0:
         order = jnp.argsort(best_exact)[: min(pool, b)]
         elite = best_t[:length, :].T[order]
-    return SolveResult(g, cost, bd, jnp.int32(b * done), elite)
+    return SolveResult(g, cost, bd, jnp.float32(b * done), elite)
 
 
 def solve_sa_delta(
@@ -985,4 +990,4 @@ def solve_sa_delta(
     if pool > 0:
         order = jnp.argsort(best_exact[0])[: min(pool, b)]
         elite = best_t[:length, :].T[order]
-    return SolveResult(g, cost, bd, jnp.int32(b * done), elite)
+    return SolveResult(g, cost, bd, jnp.float32(b * done), elite)
