@@ -1,22 +1,21 @@
 """Benchmark entry: one JSON line on stdout for the round driver.
 
-Headline metric (BASELINE.json): candidate route evaluations per second
-per chip, on the X-n200-k36-shaped synthetic CVRP (200 nodes, 36
-vehicles — CVRPLIB files can't be fetched in this zero-egress
-container; vrpms_tpu.io.synth generates the same statistical shape
-deterministically).
+Headline metric (since round 5): the TRUE gap-to-BKS at a 10 s solve
+budget on the largest REAL embedded CVRPLIB instance (E-n51-k5,
+published optimum 521) — the metric the framework actually optimizes
+(BASELINE.json north star), measured on data with a published answer
+instead of the synthetic stand-in that fronted rounds 1-4.
+vs_baseline = same-budget host-CPU cost / TPU cost on that instance
+(>1 means the accelerator finds strictly better tours in equal
+wall-clock; the reference publishes no solver numbers at all — every
+endpoint is a stub — so its target hardware class is the baseline).
 
-vs_baseline = accelerator throughput / single-host CPU throughput of the
-identical compiled search. The reference publishes no solver numbers at
-all (BASELINE.md: every endpoint is a stub), so the honest baseline is
-the same workload on the host CPU — the hardware class the reference's
-pure-Python/serverless design targets.
-
-The single JSON line additionally carries a `families` map — one entry
-per solver family (ga / aco / vrptw one-hot / delta-polish / time-
-dependent sweep) — so BENCH_r*.json catches regressions in anything,
-not just the SA sweep. Diagnostics go to stderr; stdout carries exactly
-one JSON line.
+The `families` map carries everything else — one entry per solver
+family (ga / aco / vrptw one-hot / delta kernels / time-dependent /
+scale / real instances incl. full R101), plus `raw_sweep`, the
+candidate-routes/s/chip line that was the rounds-1-4 headline, kept for
+round-over-round continuity with its roofline fields. Diagnostics go to
+stderr; stdout carries exactly one JSON line.
 """
 
 from __future__ import annotations
@@ -158,7 +157,10 @@ def _family_vrptw(device):
 
 
 def _family_td(device):
-    """Time-dependent sweep (lean-scan hot path), T=24 slices, n=200."""
+    """Time-dependent sweep (lean-scan hot path), T=24 slices, n=200 —
+    plus, since round 5, the TD DELTA path (kernels.sa_delta_td: frozen
+    factor-weight surrogate, launch-boundary exact resyncs) on the same
+    instance."""
     import numpy as np
 
     from vrpms_tpu.core import make_instance
@@ -180,13 +182,31 @@ def _family_td(device):
     # B=4096 matches the vrptw_onehot family so the TD-vs-untimed ratio
     # in BENCH_r*.json is batch-for-batch (round-2 bar: within ~3x).
     rps, elapsed, best = _throughput(inst, device, n_chains=4096, n_iters=100)
-    return {
+    out = {
         "routes_per_sec": round(rps, 1),
         "seconds": round(elapsed, 3),
         "best_cost": round(best, 1),
         "n_slices": t_slices,
         "td_rank": int(inst.td_rank),
     }
+    from vrpms_tpu.core.cost import CostWeights
+    from vrpms_tpu.solvers.sa import SAParams, _delta_supported, solve_sa_delta
+
+    if device.platform != "cpu" and _delta_supported(
+        inst, CostWeights.make(), "pallas"
+    ):
+        B, iters = 4096, 4096
+        p = SAParams(n_chains=B, n_iters=iters)
+        res, warm_s = _timed(lambda: solve_sa_delta(inst, key=1, params=p))
+        row = sorted(int(x) for x in np.asarray(res.giant) if x)
+        assert row == list(range(1, inst.n_customers + 1)), (
+            "TD delta champion is not a valid tour"
+        )
+        out["delta_moves_per_sec"] = round(B * iters / warm_s, 1)
+        out["delta_seconds"] = round(warm_s, 2)
+        out["delta_cost"] = round(float(res.breakdown.distance), 1)
+        out["delta_cap_excess"] = float(res.breakdown.cap_excess)
+    return out
 
 
 def _family_polish(device):
@@ -274,11 +294,22 @@ def _family_sa_delta_tw(device):
     w = CostWeights.make()
     inst = synth_vrptw(101, 19, seed=13)
     assert _delta_supported(inst, w, "pallas")
-    B, iters = 4096, 4096
+    # PRODUCTION config (VERDICT r4 weak-1: the 5x bar was stated at
+    # B=16384 but recorded at B=4096, where launch overhead halves the
+    # ratio): 16k chains, a 16-launch schedule. Measured r5 on v5e:
+    # 43.5M eff. moves/s, 5.84x the equal-sweeps full-eval step.
+    B, iters = 16384, 8192
     p = SAParams(n_chains=B, n_iters=iters)
     res, warm_s = _timed(lambda: solve_sa_delta(inst, key=1, params=p, weights=w))
     # equal-sweeps full-eval reference for the speedup ratio
     _, full_s = _timed(lambda: solve_sa(inst, key=1, params=p, weights=w))
+    # ... and the old B=4096 point for round-over-round continuity
+    B2, iters2 = 4096, 4096
+    p2 = SAParams(n_chains=B2, n_iters=iters2)
+    res2, warm2_s = _timed(
+        lambda: solve_sa_delta(inst, key=1, params=p2, weights=w)
+    )
+    _, full2_s = _timed(lambda: solve_sa(inst, key=1, params=p2, weights=w))
     return {
         "effective_moves_per_sec": round(B * iters / warm_s, 1),
         "seconds": round(warm_s, 2),
@@ -286,6 +317,10 @@ def _family_sa_delta_tw(device):
         "tw_lateness": round(float(res.breakdown.tw_lateness), 2),
         "cap_excess": float(res.breakdown.cap_excess),
         "speedup_vs_full_eval": round(full_s / warm_s, 2),
+        "batch": B,
+        "effective_moves_per_sec_b4k": round(B2 * iters2 / warm2_s, 1),
+        "speedup_vs_full_eval_b4k": round(full2_s / warm2_s, 2),
+        "cost_b4k": round(float(res2.cost), 1),
     }
 
 
@@ -345,6 +380,38 @@ def _family_n500(device):
     return out
 
 
+def _family_n1001(device):
+    """The X-series top end (X-n1001-k43 shape) through the round-5
+    raised delta gate (n<=1024, lhat=2048, tile_b=128): proves the
+    fast path holds at the largest size the public series reaches.
+    The champion validity assert doubles as the id-exactness check at
+    ids 513..1000 (the round-4 bf16-truncation lesson: test exactly
+    where the representable range ends)."""
+    import numpy as np
+
+    from vrpms_tpu.core.cost import CostWeights
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.solvers.sa import SAParams, _delta_supported, solve_sa_delta
+
+    inst = synth_cvrp(1001, 43, seed=11)
+    out = {"n_nodes": inst.n_nodes}
+    if not _delta_supported(inst, CostWeights.make(), "pallas"):
+        out["delta_error"] = "gate refused (n/demands/symmetry)"
+        return out
+    b, iters = 1024, 512
+    p = SAParams(n_chains=b, n_iters=iters)
+    res, warm_s = _timed(lambda: solve_sa_delta(inst, key=2, params=p))
+    row = sorted(int(x) for x in np.asarray(res.giant) if x)
+    assert row == list(range(1, inst.n_customers + 1)), (
+        "n=1001 delta champion is not a valid tour (id corruption?)"
+    )
+    out["delta_moves_per_sec"] = round(b * iters / warm_s, 1)
+    out["delta_seconds"] = round(warm_s, 2)
+    out["delta_cost"] = round(float(res.breakdown.distance), 1)
+    out["delta_cap_excess"] = float(res.breakdown.cap_excess)
+    return out
+
+
 def _family_quality(device):
     """Cost-at-10 s on synth X-n200 — the north-star budget metric
     (BASELINE.json: <=2% of best-known in <10 s on one chip), measured
@@ -398,6 +465,69 @@ def _family_quality(device):
     }
 
 
+def _budget_ils(inst, chains: int, budget: float, key: int = 0):
+    """Warm + one clean budgeted ILS solve -> (res, wall_seconds)."""
+    from vrpms_tpu.solvers.ils import ILSParams, solve_ils
+    from vrpms_tpu.solvers.sa import SAParams, warm_anneal_blocks
+
+    rounds = 9
+    p = ILSParams.from_budget(
+        rounds, SAParams(n_chains=chains, n_iters=0), rounds * 1536, pool=32
+    )
+    solve_ils(
+        inst,
+        key=99,
+        params=ILSParams.from_budget(
+            2, SAParams(n_chains=chains, n_iters=0), 2 * 512, pool=32
+        ),
+    )
+    warm_anneal_blocks(inst, chains)
+    t0 = time.perf_counter()
+    res = solve_ils(inst, key=key, params=p, deadline_s=budget)
+    return res, time.perf_counter() - t0
+
+
+def _family_real(device):
+    """TRUE gap-to-BKS at a 10 s budget on the REAL embedded public
+    instances (VERDICT r4 missing-1/2: the flagship quality claim had
+    only ever been measured against the build's own records on
+    synthetic data). Every gap below is against a published literature
+    value a user can check, on data certified by the fixture
+    cross-check trail (io/fixtures.py docstring, BASELINE.md)."""
+    from vrpms_tpu.io.fixtures import FIXTURES, load_fixture
+    from vrpms_tpu.io.metrics import gap_percent
+
+    budget = 10.0
+    out = {}
+    from vrpms_tpu.io.fixtures import FIXTURES_XL
+
+    for name, chains in (
+        ("A-n32-k5", 4096), ("E-n51-k5", 4096), ("R101", 8192)
+    ):
+        if name not in FIXTURES and name not in FIXTURES_XL:
+            continue
+        inst, meta = load_fixture(name)
+        inst = jax.device_put(inst, device)
+        res, el = _budget_ils(inst, chains, budget)
+        dist = float(res.breakdown.distance)
+        late = float(res.breakdown.tw_lateness)
+        cape = float(res.breakdown.cap_excess)
+        entry = {
+            "bks": meta["bks"],
+            "cost_at_10s": round(dist, 1),
+            "solve_seconds": round(el, 2),
+            "cap_excess": cape,
+            "tw_lateness": round(late, 2),
+        }
+        # a gap against BKS is only meaningful for a FEASIBLE solution
+        if cape == 0.0 and late == 0.0:
+            entry["gap_to_bks_pct"] = round(gap_percent(dist, meta["bks"]), 2)
+        else:
+            entry["gap_to_bks_pct"] = None
+        out[name] = entry
+    return out
+
+
 def main():
     from vrpms_tpu.utils import enable_compile_cache
 
@@ -442,6 +572,8 @@ def main():
         fam_fns["quality_at_10s"] = _family_quality
         fam_fns["sa_delta"] = _family_sa_delta  # Mosaic kernels: TPU only
         fam_fns["sa_delta_tw"] = _family_sa_delta_tw
+        fam_fns["real_instances"] = _family_real  # headline source
+        fam_fns["scale_n1001"] = _family_n1001
     for fam, fn in fam_fns.items():
         try:
             t0 = time.perf_counter()
@@ -455,20 +587,59 @@ def main():
             print(f"[bench] {fam} FAILED: {e}", file=sys.stderr)
             families[fam] = {"error": f"{type(e).__name__}: {e}"}
 
+    # Headline (VERDICT r4 weak-2/next-9: the raw-scan sweep had been
+    # flat for four rounds and nothing in production runs it alone):
+    # the TRUE gap-to-BKS at the 10 s budget on the largest REAL
+    # embedded CVRP instance — the metric the framework actually
+    # optimizes, on data with a published answer. vs_baseline is the
+    # same-budget CPU-vs-TPU COST ratio on that instance (>1 = the
+    # accelerator finds better tours in the same wall-clock); the raw
+    # sweep continues as the families.raw_sweep line for continuity.
+    real = families.get("real_instances") or {}
+    head = real.get("E-n51-k5") or {}
+    head_gap = head.get("gap_to_bks_pct")
+    vs_b = None
+    if platform != "cpu" and head.get("cost_at_10s"):
+        try:
+            from vrpms_tpu.io.fixtures import load_fixture
+
+            cpu_dev = jax.devices("cpu")[0]
+            inst_c, _ = load_fixture("E-n51-k5")
+            inst_c = jax.device_put(inst_c, cpu_dev)
+            with jax.default_device(cpu_dev):
+                res_c, _el = _budget_ils(inst_c, 256, 10.0)
+            cpu_cost = float(res_c.breakdown.distance)
+            vs_b = round(cpu_cost / head["cost_at_10s"], 3)
+            head["cpu_cost_at_10s"] = round(cpu_cost, 1)
+        except Exception as e:
+            print(f"[bench] cpu quality baseline failed: {e}", file=sys.stderr)
+
+    # -999.0 = "headline unavailable" (CPU run, family error, or an
+    # infeasible 10 s champion): unmistakable, unlike a plausible small
+    # negative gap (code review r5)
     result = {
-        "metric": "candidate_routes_per_sec_per_chip",
-        "value": round(value, 1),
-        "unit": "routes/s/chip",
-        "vs_baseline": round(value / cpu_rps, 3),
+        "metric": "true_gap_to_bks_pct_at_10s",
+        "value": head_gap if head_gap is not None else -999.0,
+        "unit": "% over BKS 521 (E-n51-k5, real, published optimum)",
+        "vs_baseline": vs_b if vs_b is not None else -999.0,
         "device": platform,
-        "instance": "synth-X-n200-k36",
-        "best_cost": round(best, 1),
-        "measure_seconds": round(elapsed, 3),
-        "cpu_routes_per_sec": round(cpu_rps, 1),
-        "cpu_baseline": cpu_baseline,
+        "instance": "E-n51-k5 (real CVRPLIB; families.real_instances for the rest)",
+        "best_cost": head.get("cost_at_10s", round(best, 1)),
+        "measure_seconds": head.get("solve_seconds", round(elapsed, 3)),
         "families": families,
     }
+    families["raw_sweep"] = {
+        "metric": "candidate_routes_per_sec_per_chip",
+        "routes_per_sec": round(value, 1),
+        "vs_cpu": round(value / cpu_rps, 3),
+        "instance": "synth-X-n200-k36",
+        "best_cost": round(best, 1),
+        "seconds": round(elapsed, 3),
+        "cpu_routes_per_sec": round(cpu_rps, 1),
+        "cpu_baseline": cpu_baseline,
+    }
     if platform != "cpu":
+        rs = families["raw_sweep"]
         # Roofline (VERDICT round-3 item 8: make every basis explicit).
         # The one-hot/Pallas objective EXECUTES ~2*L*N_pad^2 bf16 MACs
         # per candidate route (N padded to the 256 lane tile) — real MXU
@@ -482,17 +653,17 @@ def main():
         flops_per_route = 2.0 * length * 256 * 256
         achieved = value * flops_per_route
         v5e_bf16_peak = 197e12
-        result["onehot_tflops_executed_est"] = round(achieved / 1e12, 1)
-        result["mfu_onehot_basis_pct"] = round(100 * achieved / v5e_bf16_peak, 1)
+        rs["onehot_tflops_executed_est"] = round(achieved / 1e12, 1)
+        rs["mfu_onehot_basis_pct"] = round(100 * achieved / v5e_bf16_peak, 1)
         useful = 2.0 * length
         lhat_b = 1 << (length - 1).bit_length()
-        result["useful_flops_per_route"] = useful
-        result["useful_gflops_per_sec"] = round(value * useful / 1e9, 2)
+        rs["useful_flops_per_route"] = useful
+        rs["useful_gflops_per_sec"] = round(value * useful / 1e9, 2)
         # HBM per route: the (L-hat) i32 tour column in, the f32 cost out
         # (one-hot intermediates stay in VMEM in the fused kernel)
         bytes_per_route = lhat_b * 4 + 4
-        result["hbm_gb_per_sec_est"] = round(value * bytes_per_route / 1e9, 2)
-        result["hbm_utilization_vs_v5e_819gbs_pct"] = round(
+        rs["hbm_gb_per_sec_est"] = round(value * bytes_per_route / 1e9, 2)
+        rs["hbm_utilization_vs_v5e_819gbs_pct"] = round(
             100 * value * bytes_per_route / 819e9, 2
         )
     print(json.dumps(result))

@@ -54,7 +54,7 @@ def solomon_subset_text(path: str, k: int) -> str:
     return "".join(out)
 
 
-def report(tag, cost, anchor, lo_ok=None):
+def report(tag, cost, anchor):
     gap = 100.0 * (cost - anchor) / anchor
     flag = "OK" if cost >= anchor - 1e-4 else "!!! BELOW PUBLISHED — BAD DATA"
     print(f"[{tag}] cost={cost:.1f} anchor={anchor} gap={gap:+.2f}%  {flag}")
@@ -72,7 +72,6 @@ def main():
     # ---- prefix check: R101 rows 0..25 vs certified R101_25.txt ----
     if not args.only or args.only == "prefix":
         i25, _ = load_solomon(f"{FIXDIR}/R101_25.txt", n_vehicles=8)
-        full_txt = open(f"{FIXDIR}/R101.txt").read()
         i25b, _ = parse_solomon(solomon_subset_text(f"{FIXDIR}/R101.txt", 25),
                                 n_vehicles=8)
         for field in ("demands", "ready", "due", "service"):

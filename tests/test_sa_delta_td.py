@@ -1,0 +1,271 @@
+"""Fused time-dependent delta-step kernel (kernels.sa_delta_td):
+interpret-mode equivalence and state-integrity on CPU.
+
+The TD kernel prices moves with POSITION-FROZEN factor weights (the
+surrogate objective; kernels/sa_delta_td.py rationale), so unlike the
+TW kernel there is no per-move cost identity to pin against the exact
+evaluation — acceptance noise between resyncs is by design. What IS
+exact, and what these tests pin:
+
+  * tours transform EXACTLY like the XLA move reference (always-accept
+    trajectories are decision-independent);
+  * every maintained array re-derives exactly from the final tours —
+    demands, and the R basis-leg arrays against the bf16 basis tables
+    (this pins the per-rank junction-fix algebra);
+  * the surrogate cost row is exactly sum_r fw * lgr + wcap * cape of
+    the committed state (the kernel's own invariant);
+  * the resync pass (_td_fw_fn) reprices committed tours with the TRUE
+    timeline: its distance must match core.cost._td_eval up to the
+    bf16 basis-leg rounding;
+  * the solve-level driver returns an EXACTLY-priced champion
+    (exact_cost of the giant), valid tours, and the gate admits only
+    the classes the kernel models.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vrpms_tpu.core.cost import CostWeights, exact_cost
+from vrpms_tpu.io.synth import synth_td
+from vrpms_tpu.moves import knn_table
+from vrpms_tpu.moves.moves import (
+    move_batch_from_params,
+    presample_move_params,
+)
+from vrpms_tpu.solvers.sa import (
+    SAParams,
+    _pow2_at_least,
+    _td_fw_fn,
+    _tile_interleave_r,
+    initial_giants,
+)
+
+pytest.importorskip("jax.experimental.pallas")
+
+from vrpms_tpu.kernels import sa_delta_td as K  # noqa: E402
+from vrpms_tpu.kernels.sa_delta import _cap_excess_of, dp_init  # noqa: E402
+
+W = CostWeights.make()
+
+
+def _setup(n=22, v=4, batch=64, seed=5, knn_k=8, rank=1):
+    inst = synth_td(n, v, seed=seed, rank=rank, t_slices=8)
+    giants = initial_giants(jax.random.key(1), batch, inst, SAParams(), "onehot")
+    b, length = giants.shape
+    lhat = _pow2_at_least(length)
+    nhat = 128
+    rr = inst.td_rank
+    assert rr == rank
+    knn = knn_table(inst.durations[0], knn_k)
+    kf = np.zeros((nhat, knn_k), np.float32)
+    kf[: inst.n_nodes] = np.asarray(knn, np.float32)
+
+    bas_np = np.zeros((rr, nhat, nhat), np.float32)
+    bas_np[:, : inst.n_nodes, : inst.n_nodes] = np.asarray(inst.td_basis)
+    bas_bf = jnp.asarray(bas_np, jnp.bfloat16)
+    bas_f32 = bas_bf.astype(jnp.float32)
+    d_cat = jnp.concatenate([bas_bf[r] for r in range(rr)], axis=1)
+
+    gt_t = jnp.zeros((lhat, b), jnp.int32).at[:length].set(giants.T)
+    dem_row = np.zeros((1, nhat), np.float32)
+    dem_row[0, : inst.n_nodes] = np.asarray(inst.demands)
+    dp_t = dp_init(gt_t, jnp.asarray(dem_row), tile_b=b, interpret=True)
+
+    fw_t, lgr_t, dist0 = _td_fw_fn(length, b)(giants, inst, bas_f32)
+    cap0 = float(np.asarray(inst.capacities)[0])
+    scal = jnp.asarray([[cap0, float(W.cap)]], jnp.float32)
+    cape0 = _cap_excess_of(gt_t, dp_t, scal[0, 0], lhat)
+    cost0 = dist0 + scal[0, 1] * cape0
+    return (
+        inst, giants, length, lhat, rr, knn,
+        d_cat, jnp.asarray(kf), bas_f32, fw_t, scal,
+        gt_t, dp_t, lgr_t, cost0,
+    )
+
+
+def _state_checks(inst, length, rr, bas_f32, gt_t, dp_t, lgr_t):
+    """gt must be valid tours; dp and every lgr rank-section must
+    exactly re-derive from them (pins the R-section roll/fix algebra)."""
+    b = gt_t.shape[1]
+    g = np.asarray(gt_t[:length].T)
+    for row in g:
+        assert sorted(x for x in row if x) == list(
+            range(1, inst.n_customers + 1)
+        )
+    dem = np.asarray(inst.demands)
+    np.testing.assert_array_equal(np.asarray(dp_t[:length].T), dem[g])
+    bas = np.asarray(bas_f32)
+    prev, cur = g[:, :-1], g[:, 1:]
+    # undo the tile-interleave (single tile in tests: sections adjacent)
+    lgr = np.asarray(lgr_t)
+    lhat = lgr.shape[0]
+    for r in range(rr):
+        sec = lgr[:, r * b : (r + 1) * b]
+        np.testing.assert_array_equal(
+            sec[: length - 1].T, bas[r][prev, cur]
+        )
+        assert (sec[length - 1 :] == 0).all()
+
+
+class TestTdDeltaKernel:
+    @pytest.mark.parametrize("rank", [1, 2])
+    def test_always_accept_matches_xla_trajectory(self, rank):
+        (inst, giants, L, lhat, rr, knn, d_cat, knn_f, bas_f32, fw_t,
+         scal, gt_t, dp_t, lgr_t, cost0) = _setup(rank=rank)
+        b = giants.shape[0]
+        n_steps = 40
+        i, r, mt, m, _u = presample_move_params(
+            jax.random.key(3), b, L, n_steps, knn.shape[1]
+        )
+        u0 = jnp.zeros_like(_u)
+        temps = jnp.full((1, n_steps), 1e6, jnp.float32)
+        out = K.delta_td_block(
+            gt_t, dp_t, lgr_t, cost0, gt_t, cost0,
+            i, r, mt, m, u0, temps, d_cat, knn_f, fw_t, scal,
+            length=L, rr=rr, tile_b=b, has_knn=True, interpret=True,
+        )
+        g_ref = giants
+        for s in range(n_steps):
+            g_ref = move_batch_from_params(
+                i[s], r[s], mt[s], m[s], g_ref, knn, "gather"
+            )
+        assert (np.asarray(out[0][:L].T) == np.asarray(g_ref)).all()
+        _state_checks(inst, L, rr, bas_f32, out[0], out[1], out[2])
+        # the cost row must equal the kernel's own surrogate formula on
+        # the final committed state: sum_r fw*lgr + wcap*cape
+        fw = np.asarray(fw_t)
+        lgr = np.asarray(out[2])
+        dist = sum(
+            (fw[:, r_ * b : (r_ + 1) * b] * lgr[:, r_ * b : (r_ + 1) * b]).sum(
+                axis=0
+            )
+            for r_ in range(rr)
+        )
+        cape = np.asarray(
+            _cap_excess_of(out[0], out[1], scal[0, 0], lhat)
+        )[0]
+        np.testing.assert_allclose(
+            np.asarray(out[3][0]), dist + float(W.cap) * cape,
+            rtol=1e-4, atol=1e-2,
+        )
+
+    def test_metropolis_never_accepts_worse_at_zero_temp(self):
+        (inst, giants, L, lhat, rr, knn, d_cat, knn_f, bas_f32, fw_t,
+         scal, gt_t, dp_t, lgr_t, cost0) = _setup(seed=9)
+        b = giants.shape[0]
+        n_steps = 60
+        i, r, mt, m, u = presample_move_params(
+            jax.random.key(7), b, L, n_steps, knn.shape[1]
+        )
+        u = jnp.maximum(u, 1e-9)
+        temps = jnp.full((1, n_steps), 1e-6, jnp.float32)
+        out = K.delta_td_block(
+            gt_t, dp_t, lgr_t, cost0, gt_t, cost0,
+            i, r, mt, m, u, temps, d_cat, knn_f, fw_t, scal,
+            length=L, rr=rr, tile_b=b, has_knn=True, interpret=True,
+        )
+        _state_checks(inst, L, rr, bas_f32, out[0], out[1], out[2])
+        assert (
+            np.asarray(out[3][0]) <= np.asarray(cost0[0]) + 1e-3
+        ).all()
+        assert (np.asarray(out[5][0]) <= np.asarray(out[3][0]) + 1e-4).all()
+
+
+class TestTdResync:
+    def test_fw_refresh_matches_exact_timeline(self):
+        from vrpms_tpu.core.cost import _td_eval
+
+        (inst, giants, L, lhat, rr, knn, d_cat, knn_f, bas_f32, fw_t,
+         scal, gt_t, dp_t, lgr_t, cost0) = _setup(seed=13)
+        _fw, _lg, dist = _td_fw_fn(L, giants.shape[0])(giants, inst, bas_f32)
+        # the resync distance must match the exact TD evaluation up to
+        # the bf16 basis-leg rounding it deliberately shares with the
+        # kernel (relative ~0.4% worst case per leg)
+        for row in range(4):
+            bd = _td_eval(giants[row], inst)
+            np.testing.assert_allclose(
+                float(dist[0, row]), float(bd.distance), rtol=1.5e-2
+            )
+
+    def test_tile_interleave_roundtrip(self):
+        x = jnp.arange(2 * 3 * 8, dtype=jnp.float32).reshape(2, 3, 8)
+        y = _tile_interleave_r(x, 4)  # two tiles of 4 lanes
+        assert y.shape == (2, 24)
+        # tile 0 columns: sections r=0..2 of lanes 0..3, then tile 1
+        np.testing.assert_array_equal(
+            np.asarray(y[:, :4]), np.asarray(x[:, 0, :4])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(y[:, 4:8]), np.asarray(x[:, 1, :4])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(y[:, 12:16]), np.asarray(x[:, 0, 4:])
+        )
+
+
+class TestSolveSaDeltaTd:
+    def test_solve_level_driver(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_DELTA_INTERPRET", "1")
+        from vrpms_tpu.solvers.sa import solve_sa_delta
+
+        inst = synth_td(18, 3, seed=2, t_slices=8)
+        res = solve_sa_delta(
+            inst, key=4, params=SAParams(n_chains=128, n_iters=400)
+        )
+        row = [int(x) for x in np.asarray(res.giant) if x]
+        assert sorted(row) == list(range(1, inst.n_customers + 1))
+        # the returned cost is the exact re-evaluation of the champion
+        _, want = exact_cost(res.giant, inst, W)
+        assert np.isclose(float(res.cost), float(want), rtol=1e-6)
+
+    def test_gate_size_boundary(self):
+        # round 5 raised the size gate from 512 to 1024 (the X series
+        # tops out at n=1001); past it the fast path must refuse
+        from vrpms_tpu.io.synth import synth_cvrp
+        from vrpms_tpu.kernels.sa_delta import _PALLAS_OK
+        from vrpms_tpu.solvers.sa import _delta_supported
+
+        if not _PALLAS_OK:
+            pytest.skip("pallas unavailable")
+        assert _delta_supported(synth_cvrp(1001, 43, seed=1), W, "pallas")
+        assert not _delta_supported(synth_cvrp(1100, 43, seed=1), W, "pallas")
+
+    def test_gate_classes(self):
+        from vrpms_tpu.core import make_instance
+        from vrpms_tpu.kernels.sa_delta import _PALLAS_OK
+        from vrpms_tpu.solvers.sa import _delta_supported
+
+        if not _PALLAS_OK:
+            pytest.skip("pallas unavailable")
+        inst = synth_td(20, 3, seed=1, t_slices=8)
+        assert _delta_supported(inst, W, "pallas")
+        # full-rank (unfactorizable) TD profiles fall back
+        rng = np.random.default_rng(0)
+        d0 = np.asarray(inst.durations[0])
+        slices = np.stack([
+            d0 * rng.uniform(0.8, 1.2, size=d0.shape) for _ in range(6)
+        ])
+        slices = (slices + np.swapaxes(slices, 1, 2)) / 2  # keep symmetric
+        full = make_instance(
+            slices,
+            demands=np.asarray(inst.demands),
+            capacities=np.asarray(inst.capacities).tolist(),
+            slice_axis="first",
+            slice_minutes=60.0,
+        )
+        assert full.td_rank == 0 and not _delta_supported(full, W, "pallas")
+        # an asymmetric slice falls back even when slice 0 is symmetric
+        bad = np.stack([d0, d0 * 1.1])
+        bad[1, 0, 1] += 5.0
+        asym = make_instance(
+            bad,
+            demands=np.asarray(inst.demands),
+            capacities=np.asarray(inst.capacities).tolist(),
+            slice_axis="first",
+            slice_minutes=60.0,
+        )
+        assert not _delta_supported(asym, W, "pallas")

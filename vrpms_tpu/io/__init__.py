@@ -1,3 +1,3 @@
 from vrpms_tpu.io.cvrplib import load_cvrplib, load_solomon, parse_cvrplib, parse_solomon
-from vrpms_tpu.io.synth import synth_cvrp, synth_tsp, synth_vrptw
+from vrpms_tpu.io.synth import synth_cvrp, synth_td, synth_tsp, synth_vrptw
 from vrpms_tpu.io.metrics import gap_percent

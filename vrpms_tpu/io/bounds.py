@@ -431,12 +431,60 @@ def _combo_bound(route_q, total: int, r_lo: int, r_hi: int):
     return best_val, best_r, choices
 
 
+def _lam_cache_path(inst: Instance):
+    """Warm-start store for the ascent multipliers, keyed by instance
+    content (round-5 certificate work: certificates are OFFLINE
+    artifacts, so ascent progress should compound across processes and
+    rounds instead of restarting from zero every time). Set
+    VRPMS_CERT_CACHE=0 to disable, or to a directory to relocate."""
+    import hashlib
+    import os
+
+    root = os.environ.get("VRPMS_CERT_CACHE", "")
+    if root == "0":
+        return None
+    if not root:
+        root = os.path.join(
+            os.path.expanduser("~"), ".cache", "vrpms_tpu_certs"
+        )
+    d, demands, caps = _host(inst)
+    h = hashlib.sha1()
+    for a in (d, demands, caps):
+        h.update(np.ascontiguousarray(a).tobytes())
+    return os.path.join(root, h.hexdigest()[:20] + ".npz")
+
+
+def _lam_cache_load(path):
+    if path is None:
+        return None, 0.0
+    try:
+        with np.load(path) as z:
+            return z["lam"].astype(np.float64), float(z["bound"])
+    except (OSError, ValueError, KeyError):
+        return None, 0.0
+
+
+def _lam_cache_save(path, lam, bound: float) -> None:
+    if path is None:
+        return
+    import os
+
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.npz"  # np.savez appends .npz itself
+        np.savez(tmp[:-4], lam=lam, bound=bound)
+        os.replace(tmp, path)
+    except OSError:  # best-effort: a cache must never fail a certificate
+        pass
+
+
 def cmt_qroute_ascent(
     inst: Instance,
     iters: int = 60,
     max_units: int = 4096,
     ub: float | None = None,
     ng_sharpen: bool = True,
+    warm_start: bool = True,
 ):
     """Christofides-Mingozzi-Toth q-route bound with route-combination
     DP and Lagrangian ascent on customer penalties — the strongest
@@ -484,6 +532,19 @@ def cmt_qroute_ascent(
     lam_hi = float(d.max()) * 2.0
     lam = np.zeros(k)
     best_bound, best_lam = 0.0, lam.copy()
+    # warm-start from the persisted multipliers of a previous ascent on
+    # the SAME instance: every lam is valid, so resuming from the best
+    # known point can only help (the stored bound is NOT trusted — it
+    # is re-derived below before it can beat best_bound)
+    cache_path = _lam_cache_path(inst) if warm_start else None
+    lam_w, _ = _lam_cache_load(cache_path)
+    if lam_w is not None and lam_w.shape == lam.shape:
+        lam = np.clip(lam_w, lam_lo, lam_hi)
+    # ascent snapshots for the ng pass: the k best DISTINCT multiplier
+    # points seen (the ng bound is valid at ANY lam, and the max of
+    # valid bounds is valid — round-5 certificate work; evaluating ng
+    # at several snapshots costs k native DP passes, all offline)
+    snaps: list[tuple[float, np.ndarray]] = []
     theta = 0.5
     stall = 0
     for _ in range(iters):
@@ -494,6 +555,9 @@ def cmt_qroute_ascent(
         bound = best_val - float(lam.sum())
         if bound > best_bound + 1e-9:
             best_bound, best_lam = bound, lam.copy()
+            snaps.append((bound, lam.copy()))
+            if len(snaps) > 24:
+                snaps = snaps[-24:]
             stall = 0
         else:
             stall += 1
@@ -550,6 +614,34 @@ def cmt_qroute_ascent(
         )
         if np.isfinite(best_val):
             best_bound = max(best_bound, float(best_val - best_lam.sum()))
+        # ... and at a few earlier ascent snapshots: the 2-cycle-best
+        # lam is not necessarily the ng-best lam (different relaxation,
+        # different maximizer); widely-spaced snapshots cost one native
+        # DP each and the max over them is valid
+        seen = 0
+        for b_s, lam_s in reversed(snaps[:-1]):
+            if seen >= 3:
+                break
+            if np.allclose(lam_s, best_lam):
+                continue
+            seen += 1
+            ng_s = ngroute_lb_tables(inst, lam_s, max_units=max_units)
+            if ng_s is None:
+                continue
+            rq_2c, _ = _qroute_table(d, dem_s, q_max, lam_s, want_visits=False)
+            v, _, _ = _combo_bound(
+                np.maximum(rq_2c, ng_s[0]), total, r_lo, r_hi
+            )
+            if np.isfinite(v) and float(v - lam_s.sum()) > best_bound:
+                best_bound = float(v - lam_s.sum())
+                best_lam = lam_s
+                ng = ng_s
+    # persist only on IMPROVEMENT: a short deadline-bounded ascent (the
+    # B&B root runs 5-80 iterations) must not overwrite the multipliers
+    # a long offline certificate run climbed to
+    _, stored_bound = _lam_cache_load(cache_path)
+    if best_bound > stored_bound + 1e-9:
+        _lam_cache_save(cache_path, best_lam, best_bound)
     return {
         "bound": float(best_bound),
         "lam": best_lam,
@@ -631,7 +723,7 @@ def ngroute_lb_tables(inst: Instance, lam: np.ndarray, max_units: int = 4096,
 
 
 def qpath_completion_tables(inst: Instance, lam: np.ndarray, max_units: int = 4096,
-                            ng_tables=None):
+                            ng_tables=None, build_ng: bool = True):
     """Per-node pruning tables for the branch-and-bound, from root
     multipliers `lam` -> (R, Psi) or None when inapplicable.
 
@@ -704,11 +796,16 @@ def qpath_completion_tables(inst: Instance, lam: np.ndarray, max_units: int = 40
     # ascent's precomputed pair (cmt_qroute_ascent returns them) so the
     # B&B root does not run the native DP twice; they MUST correspond
     # to the same `lam`.
-    ng = (
-        ng_tables
-        if ng_tables is not None
-        else ngroute_lb_tables(inst, lam, max_units=max_units)
-    )
+    # `build_ng=False` skips the rebuild entirely: a deadline-bounded
+    # caller that deliberately ran its ascent with ng_sharpen=False must
+    # not pay for the seconds-long native DP here instead (the fallback
+    # would otherwise defeat the whole skip — code review r5)
+    if ng_tables is not None:
+        ng = ng_tables
+    elif build_ng:
+        ng = ngroute_lb_tables(inst, lam, max_units=max_units)
+    else:
+        ng = None
     if ng is not None:
         route_q_ng, R_ng = ng
         route_q = np.maximum(route_q, route_q_ng)

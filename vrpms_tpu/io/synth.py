@@ -68,3 +68,40 @@ def synth_vrptw(
         due=due,
         service=service,
     )
+
+
+def synth_td(
+    n_nodes: int,
+    n_vehicles: int,
+    seed: int = 0,
+    t_slices: int = 24,
+    rank: int = 1,
+    slice_minutes: float = 60.0,
+) -> Instance:
+    """Time-dependent CVRP with an EXACTLY factorizable rank-R profile:
+    durations[t] = sum_r profile_r(t) * basis_r, basis_r symmetric —
+    the instance class the TD delta kernel admits (reference
+    src/solver.py:7 `time_of_day` shape)."""
+    rng = np.random.default_rng(seed)
+    coords = rng.uniform(0, 1000, size=(n_nodes, 2))
+    d = _euclid(coords)
+    demands = np.concatenate([[0], rng.integers(1, 10, size=n_nodes - 1)])
+    capacity = float(np.ceil(demands.sum() * 1.08 / n_vehicles))
+    tt = np.arange(t_slices)
+    slices = np.zeros((t_slices, n_nodes, n_nodes))
+    for r in range(rank):
+        profile = 1.0 / rank + 0.3 * np.sin(
+            2 * np.pi * (r + 1) * tt / t_slices + r
+        )
+        # rank-r basis: smooth symmetric reweighting of the base matrix
+        u = rng.uniform(0.5, 1.5, size=n_nodes)
+        basis = d * np.sqrt(np.outer(u, u)) / rank
+        slices += profile[:, None, None] * basis[None]
+    slices = np.maximum(slices, 0.0)
+    return make_instance(
+        slices,
+        demands=demands,
+        capacities=[capacity] * n_vehicles,
+        slice_axis="first",
+        slice_minutes=slice_minutes,
+    )

@@ -60,6 +60,31 @@ def _roll_up_static(x, s):
     return jnp.concatenate([x[s:], x[:s]], axis=0)
 
 
+def _flip_sublanes(x, lhat):
+    """out[k, b] = x[lhat-1-k, b] — sublane reversal WITHOUT the MXU.
+
+    Index reversal is XOR with lhat-1 (power-of-2 lhat), decomposed
+    into log2(lhat) masked static roll pairs on the original sublane
+    index: stage `bit` routes in[k ^ bit] to k, and the stages compose
+    to the full XOR because every where-mask reads position, not data.
+    Replaces the antidiagonal f32 matmul flip (round 5): XLA:TPU's
+    default-precision dot bf16-truncates f32 VALUES — measured max
+    error 2.0 on node ids <= 1001 at lhat=1024/2048 — and Mosaic's
+    in-kernel dot, exact through lhat=1024 (the n=502 round-4
+    bit-check), corrupts ids at lhat=2048 too. Pure selects are exact
+    for every dtype on every backend, and integer arrays skip the
+    f32 round-trip entirely."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (lhat, 1), 0)
+    out = x
+    bit = 1
+    while bit < lhat:
+        up = _roll_up_static(out, bit)
+        down = _roll_up_static(out, lhat - bit)
+        out = jnp.where((iota & bit) != 0, down, up)
+        bit <<= 1
+    return out
+
+
 def _roll_up_perlane(x, rho_row, lhat):
     """out[k, b] = x[(k + rho_b) mod lhat, b] — per-LANE dynamic sublane
     roll as ceil(log2(lhat)) masked static rolls (binary decomposition
@@ -151,14 +176,11 @@ def _delta_step_kernel(
     cap0 = scal_ref[0, 1]
     wcap = scal_ref[0, 2]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (lhat, t), 0)
-    iota_r = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
-    iota_c = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
-    antidiag = (iota_r + iota_c == lhat - 1).astype(jnp.float32)
     out = _step_body(
         gt_ref[:], dp_ref[:], dist_ref[:], cape_ref[:],
         best_ref[:], bestc_ref[:],
         i_ref[:], r_ref[:], mt_ref[:], m_ref[:], u_ref[:], temp,
-        d_ref[:], knn_ref[:], cap0, wcap, iota_l, antidiag,
+        d_ref[:], knn_ref[:], cap0, wcap, iota_l,
         length=length, lhat=lhat, t=t, nhat=nhat, has_knn=has_knn,
     )
     gt_out[:], dp_out[:], dist_out[:], cape_out[:], best_out[:], bestc_out[:] = out
@@ -172,7 +194,7 @@ def _value_at_f(arr, pos_row, iota_l):
 def _step_body(
     gt, dp, dist, cape, best, bestc,
     i_row, r_row, mt_row, m_row, u_row, temp,
-    d, knn, cap0, wcap, iota_l, antidiag, *, length, lhat, t, nhat, has_knn,
+    d, knn, cap0, wcap, iota_l, *, length, lhat, t, nhat, has_knn,
 ):
     """The delta-step math on VALUE arrays — shared verbatim by the
     one-step kernel (scan path) and the in-kernel block loop."""
@@ -239,10 +261,8 @@ def _step_body(
         rot = jnp.where(in_win, jnp.where(iota_l + mm <= hi, fwd, wrap), arr)
         return rev, rot
 
-    gt_flip = jnp.dot(
-        antidiag, gt.astype(jnp.float32), preferred_element_type=jnp.float32
-    ).astype(jnp.int32)
-    dp_flip = jnp.dot(antidiag, dp, preferred_element_type=jnp.float32)
+    gt_flip = _flip_sublanes(gt, lhat)
+    dp_flip = _flip_sublanes(dp, lhat)
     gt_rev, gt_rot = apply_move(gt, gt_flip)
     dp_rev, dp_rot = apply_move(dp, dp_flip)
     dem_b0 = _value_at_f(dp, lo, iota_l)
@@ -286,9 +306,6 @@ def _delta_block_kernel(
     cap0 = scal_ref[0, 0]
     wcap = scal_ref[0, 1]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (lhat, t), 0)
-    iota_r = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
-    iota_c = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
-    antidiag = (iota_r + iota_c == lhat - 1).astype(jnp.float32)
 
     def body(k, carry):
         gt, dp, dist, cape, best, bestc = carry
@@ -301,7 +318,7 @@ def _delta_block_kernel(
         return _step_body(
             gt, dp, dist, cape, best, bestc,
             i_row, r_row, mt_row, m_row, u_row, temp,
-            d, knn, cap0, wcap, iota_l, antidiag,
+            d, knn, cap0, wcap, iota_l,
             length=length, lhat=lhat, t=t, nhat=nhat, has_knn=has_knn,
         )
 
@@ -377,21 +394,27 @@ def delta_block(
 
 def _dp_init_kernel(gt_ref, dem_ref, dp_out, *, exact_f32):
     """dp[k, b] = demands[gt[k, b]] — per-position one-hot matvecs
-    against the demand vector (VMEM-resident; no gather)."""
+    against the demand vector (VMEM-resident; no gather).
+
+    A fori_loop, NOT a Python unroll: unrolled, the 2048 per-row
+    matmuls at the n=1024 gate boundary kept every row's temporaries
+    live and the register allocator spilled 174 MB of scoped VMEM
+    (round-5 hardware failure at lhat=2048); the loop body reuses one
+    row's worth."""
     lhat, t = gt_ref.shape
     nhat = dem_ref.shape[1]
     dem_col = dem_ref[:].T  # (N-hat, 1)
     dt = jnp.float32 if exact_f32 else jnp.bfloat16
-    rows = []
-    for k in range(lhat):
-        oh = (
-            gt_ref[k : k + 1, :].T
-            == jax.lax.broadcasted_iota(jnp.int32, (t, nhat), 1)
-        ).astype(dt)
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (t, nhat), 1)
+
+    def body(k, _):
+        oh = (gt_ref[pl.ds(k, 1), :].T == iota_n).astype(dt)
         val = jnp.dot(oh, dem_col.astype(dt),
                       preferred_element_type=jnp.float32)  # (T, 1)
-        rows.append(val.T)
-    dp_out[:] = jnp.concatenate(rows, axis=0)
+        dp_out[pl.ds(k, 1), :] = val.T
+        return 0
+
+    jax.lax.fori_loop(0, lhat, body, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("tile_b", "exact_f32", "interpret"))

@@ -33,7 +33,8 @@ Rounding contract: leg durations are the bf16-rounded table (identical
 to every hot path); service/ready/due are f32-exact (dp_init's
 exact_f32 attribute init); demands ride gcd-scaled like the untimed
 kernel (kernels.sa_eval.demand_scale). Note on in-kernel f32 matmuls
-(the antidiag flips, exact_f32 attr init): unlike XLA's einsum DEFAULT
+(exact_f32 attr init; flips are select-based since round 5 —
+sa_delta._flip_sublanes): unlike XLA's einsum DEFAULT
 precision — which bf16-truncates f32 operands on the MXU and silently
 corrupted node ids > 256 outside kernels (core.cost.EXACT) — Mosaic's
 in-kernel `jnp.dot` with f32 operands is measured EXACT on v5e: the
@@ -55,6 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from vrpms_tpu.kernels.sa_delta import (
+    _flip_sublanes,
     _PALLAS_OK,
     _cap_excess_of,
     _roll_up_perlane,
@@ -150,7 +152,7 @@ def tw_timeline_late(cand, lg_c, sv_c, rd_c, du_c, start0, lhat):
 def _tw_step_body(
     gt, at4, lg, cost, best, bestc,
     i_row, r_row, mt_row, m_row, u_row, temp,
-    d, knn, cap0, wcap, wtw, start0, iota_l, antidiag,
+    d, knn, cap0, wcap, wtw, start0, iota_l,
     *, length, lhat, t, nhat, has_knn,
 ):
     """One fused VRPTW delta step on VALUE arrays (shared by the
@@ -212,9 +214,9 @@ def _tw_step_body(
         return rev, rot
 
     def flip(arr):
-        return jnp.dot(
-            antidiag, arr.astype(jnp.float32), preferred_element_type=jnp.float32
-        )
+        # exact sublane reversal (sa_delta._flip_sublanes): the MXU
+        # antidiagonal flip truncates values > 256 at large lhat
+        return _flip_sublanes(arr, lhat)
 
     def moved(arr, lo_, hi_, mm_, span_, mt_, in_win_, iota_, is_int=False):
         flipped = flip(arr)
@@ -312,9 +314,6 @@ def _tw_block_kernel(
     wtw = scal_ref[0, 2]
     start0 = scal_ref[0, 3]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (lhat, t), 0)
-    iota_r = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 0)
-    iota_c = jax.lax.broadcasted_iota(jnp.int32, (lhat, lhat), 1)
-    antidiag = (iota_r + iota_c == lhat - 1).astype(jnp.float32)
 
     def body(k, carry):
         gt, at4, lg, cost, best, bestc = carry
@@ -323,7 +322,7 @@ def _tw_block_kernel(
             i_ref[pl.ds(k, 1), :], r_ref[pl.ds(k, 1), :],
             mt_ref[pl.ds(k, 1), :], m_ref[pl.ds(k, 1), :],
             u_ref[pl.ds(k, 1), :], temps_ref[0, k],
-            d, knn, cap0, wcap, wtw, start0, iota_l, antidiag,
+            d, knn, cap0, wcap, wtw, start0, iota_l,
             length=length, lhat=lhat, t=t, nhat=nhat, has_knn=has_knn,
         )
 

@@ -248,15 +248,17 @@ def solve_cvrp_bnb(
     asc_iters = 80 if time_limit_s is None else min(80, max(5, int(time_limit_s * 10)))
     # the ng sharpening pass costs seconds of native DP (plus a one-time
     # g++ build); only afford it when the budget is generous (ADVICE r4)
+    afford_ng = time_limit_s is None or time_limit_s >= 10.0
     asc = cmt_qroute_ascent(
         inst, iters=asc_iters,
         ub=None if not np.isfinite(best_cost) else best_cost,
-        ng_sharpen=time_limit_s is None or time_limit_s >= 10.0,
+        ng_sharpen=afford_ng,
     )
     qtab = None
     if asc is not None:
         tabs = qpath_completion_tables(
-            inst, asc["lam"], ng_tables=asc.get("ng_tables")
+            inst, asc["lam"], ng_tables=asc.get("ng_tables"),
+            build_ng=afford_ng,
         )
         if tabs is not None:
             R_tab, Psi = tabs

@@ -436,7 +436,10 @@ def solve_sa(
     if pool > 0:
         order = jnp.argsort(best_c)[: min(pool, best_g.shape[0])]
         elite = best_g[order]
-    # evals from the actual batch (init_giants may differ from n_chains)
+    # evals from the actual batch (init_giants may differ from n_chains).
+    # f32 (not int32): B=16k chains overflow int32 past ~131k iterations
+    # (ADVICE r4); the <= 2^-24 relative rounding above 16.7M counts is
+    # noise for a throughput metric
     return SolveResult(
         g, cost, bd, jnp.float32(giants.shape[0] * done), elite
     )
@@ -506,12 +509,31 @@ def _delta_supported(inst: Instance, w: CostWeights, mode: str) -> bool:
 
     if mode != "pallas" or not _PALLAS_OK:
         return False
-    if inst.time_dependent or w.use_makespan or inst.het_fleet:
+    if w.use_makespan or inst.het_fleet:
         return False
-    if inst.n_nodes > 512:
+    # raised from 512 in round 5 (VERDICT r4 item 10: the X series runs
+    # to n=1001); lhat=2048 state still fits the raised scoped-VMEM cap
+    # at tile_b=128, and ids to 1024 are exact under the kernels'
+    # f32-accumulated one-hot dots (bit-checked at n=1001 on hardware —
+    # the round-4 precision lesson says test exactly there)
+    if inst.n_nodes > 1024:
         return False
     if demand_scale(inst.demands) is None:
         return False
+    if inst.time_dependent:
+        # factorized TD rides the frozen-slice surrogate kernel
+        # (kernels.sa_delta_td) since round 5; the combined TD+TW class
+        # and unfactorized (full-rank) profiles still fall back
+        if inst.has_tw or not (1 <= inst.td_rank <= 2):
+            return False
+        # basis symmetry is the exact invariant the reverse move's
+        # interior-leg reuse needs, and (with the factorization exact
+        # and factor rows independent) is equivalent to every-slice
+        # symmetry at ~T/R the host cost of checking [T, N, N]
+        bas = np.asarray(inst.td_basis)
+        return bool(
+            np.allclose(bas, np.swapaxes(bas, 1, 2), rtol=1e-6, atol=1e-6)
+        )
     if inst.has_tw:
         length = inst.n_customers + inst.n_vehicles + 1
         if inst.n_nodes > 256 or length > 256:
@@ -573,18 +595,32 @@ def _delta_resync_fn(length: int, interpret: bool = False):
     def resync(gt_t, inst, w):
         import dataclasses as _dc
 
-        from vrpms_tpu.kernels.sa_eval import pallas_objective_batch
+        from vrpms_tpu.kernels.sa_eval import (
+            pallas_objective_batch,
+            pallas_supported,
+        )
 
         gt = gt_t[:length]
         w0 = _dc.replace(w, cap=0.0)
         w1 = _dc.replace(w, cap=1.0)
-        dist = pallas_objective_batch(
-            gt, inst, w0, transposed=True, interpret=interpret
-        )
-        both = pallas_objective_batch(
-            gt, inst, w1, transposed=True, interpret=interpret
-        )
-        return dist[None, :], (both - dist)[None, :]
+        if pallas_supported(inst, gt.shape[1]):
+            dist = pallas_objective_batch(
+                gt, inst, w0, transposed=True, interpret=interpret
+            )
+            both = pallas_objective_batch(
+                gt, inst, w1, transposed=True, interpret=interpret
+            )
+            return dist[None, :], (both - dist)[None, :]
+        # huge-N shapes the fused evaluator's tiles can't fit (the
+        # round-5 n<=1024 gate admits more than sa_eval does): the XLA
+        # one-hot path prices the SAME bf16 table, and a resync runs
+        # once per 512-step launch, so its (B, L, N) intermediates are
+        # amortized noise here
+        from vrpms_tpu.core.cost import objective_batch_mode
+
+        c0 = objective_batch_mode(gt.T, inst, w0, "onehot")
+        c1 = objective_batch_mode(gt.T, inst, w1, "onehot")
+        return c0[None, :], (c1 - c0)[None, :]
 
     return resync
 
@@ -657,6 +693,234 @@ def _sa_delta_tw_block_fn(
         )
 
     return run
+
+
+@lru_cache(maxsize=32)
+def _sa_delta_td_block_fn(
+    n_block: int, length: int, rr: int, tile_b: int, has_knn: bool,
+    interpret: bool = False,
+):
+    """One jitted block of n_block fused TD delta steps (the
+    time-dependent twin of _sa_delta_block_fn; kernels.sa_delta_td).
+    `fw_t` rides as an ARGUMENT, not state: it is constant within a
+    launch and refreshed by the driver's resync."""
+    from vrpms_tpu.kernels.sa_delta_td import delta_td_block
+    from vrpms_tpu.moves.moves import presample_move_params
+
+    @jax.jit
+    def run(state, fw_t, key, d_cat, knn_f, scal, t0, t1, start_it, horizon):
+        gt_t, dp_t, lgr_t, cost, best_t, best_c = state
+        b = gt_t.shape[1]
+        kb = jax.random.fold_in(key, start_it)
+        kw = knn_f.shape[1] if has_knn else 0
+        pri, prr, prmt, prm, pru = presample_move_params(
+            kb, b, length, n_block, kw
+        )
+        temps = anneal_temperature(
+            start_it + jnp.arange(n_block), t0, t1, horizon
+        )[None, :].astype(jnp.float32)
+        return delta_td_block(
+            gt_t, dp_t, lgr_t, cost, best_t, best_c,
+            pri, prr, prmt, prm, pru, temps, d_cat, knn_f, fw_t, scal,
+            length=length, rr=rr, tile_b=tile_b, has_knn=has_knn,
+            interpret=interpret,
+        )
+
+    return run
+
+
+def _tile_interleave_r(x, tile_b: int):
+    """(L-hat, R, B) -> the kernel's (L-hat, R*B) tile-interleaved
+    layout: the BlockSpec hands each grid step one contiguous
+    R*tile_b-wide chunk, so the R sections of one chain tile must be
+    adjacent (section r of tile g at columns [g*R*tile + r*tile ...])."""
+    lhat, rr, b = x.shape
+    g = b // tile_b
+    return x.reshape(lhat, rr, g, tile_b).transpose(0, 2, 1, 3).reshape(
+        lhat, rr * b
+    )
+
+
+@lru_cache(maxsize=16)
+def _td_fw_fn(length: int, tile_b: int):
+    """Jitted TRUE-timeline pass for the TD delta driver: from committed
+    giants, propagate the departure clock exactly (core.cost._td_eval
+    semantics — per-route start times, service, cyclic slices) over the
+    bf16-rounded basis legs, and emit
+
+      fw_t   — (L-hat, R*B) tile-interleaved factor weights
+               fw[r][k] = factors[r, slice(depart_k)],
+      lgr_t  — the matching basis-leg state layout,
+      dist   — (1, B) true surrogate distance (sum of true travels),
+
+    which is everything a launch-boundary resync must refresh."""
+
+    @jax.jit
+    def fw(giants, inst, bas):  # bas: (R, N-hat, N-hat) f32(bf16) tables
+        from vrpms_tpu.core.cost import _rid_batch
+
+        b = giants.shape[0]
+        rr = bas.shape[0]
+        lhat = _pow2_at_least(length)
+        prev, cur = giants[:, :-1], giants[:, 1:]
+        blegs = bas[:, prev, cur]  # [R, B, K]
+        v = inst.n_vehicles
+        rid = _rid_batch(giants)
+        route_of_leg = jnp.minimum(rid[:, :-1], v - 1)
+        start = inst.start_times[route_of_leg]  # [B, K]
+        svc = inst.service[prev]
+        rdy = inst.ready[cur]
+        reset = prev == 0
+        t_slices = inst.n_slices
+        factors = inst.td_factors  # [R, T]
+
+        def step(clock, x):
+            blegs_k, reset_k, start_k, svc_k, rdy_k = x
+            depart = jnp.where(reset_k, start_k, clock + svc_k)
+            sidx = (depart // inst.slice_minutes).astype(jnp.int32) % t_slices
+            # plain gather, NOT a one-hot matmul: this is ordinary
+            # jitted XLA (gather is fine here), and a default-precision
+            # dot would bf16-truncate the f32 factor values — the exact
+            # class of silent bias the EXACT-einsum discipline exists
+            # for (code review r5)
+            fac_rb = factors[:, sidx]  # [R, B]
+            travel = (fac_rb * blegs_k).sum(axis=0)
+            arrive = jnp.maximum(depart + travel, rdy_k)
+            return arrive, (fac_rb, travel)
+
+        xs = (
+            jnp.moveaxis(blegs, 2, 0),  # [K, R, B]
+            reset.T, start.T, svc.T, rdy.T,
+        )
+        _, (facs, travel) = jax.lax.scan(
+            step, jnp.zeros((b,), jnp.float32), xs
+        )
+        # facs: [K, R, B] -> (L-hat, R, B), pad rows zero (pad legs are
+        # zero-valued in lgr, so their fw is irrelevant; zero keeps the
+        # product exactly zero)
+        fw_full = jnp.zeros((lhat, rr, b), jnp.float32).at[: length - 1].set(
+            facs
+        )
+        lg_full = jnp.zeros((lhat, rr, b), jnp.float32).at[: length - 1].set(
+            jnp.moveaxis(blegs, 2, 0)
+        )
+        dist = jnp.sum(travel, axis=0)[None]  # (1, B)
+        return (
+            _tile_interleave_r(fw_full, tile_b),
+            _tile_interleave_r(lg_full, tile_b),
+            dist,
+        )
+
+    return fw
+
+
+@lru_cache(maxsize=16)
+def _td_best_rank_fn(length: int):
+    """Exact one-hot-basis TD costs of the best pool (final champion /
+    elite selection through the shared TD hot path)."""
+
+    @jax.jit
+    def rank(best_t, inst, w):
+        from vrpms_tpu.core.cost import objective_hot_batch
+
+        g = best_t[:length].T
+        return objective_hot_batch(g, inst, w)
+
+    return rank
+
+
+def _solve_sa_delta_td(
+    inst, giants, t0, t1, k_run, params, w, deadline_s, pool, knn
+) -> SolveResult:
+    """Time-dependent delta-anneal driver (dispatched from
+    solve_sa_delta; kernels.sa_delta_td rationale).
+
+    The kernel prices moves with POSITION-FROZEN factor weights; this
+    driver refreshes them (plus the committed cost row) with the exact
+    timeline at every launch boundary, and the final champion/elite
+    ranking runs through the exact TD hot path — so the reported result
+    is exactly priced regardless of in-launch surrogate noise."""
+    import numpy as np
+
+    from vrpms_tpu.kernels.sa_delta import _cap_excess_of, dp_init
+
+    b, length = giants.shape
+    lhat = _pow2_at_least(length)
+    rr = inst.td_rank
+    # the TD step carries 3 + 2R tall arrays (gt/dp/best + lgr/fw per
+    # rank); scale the chain tile down with both lhat and R to stay
+    # inside the scoped-VMEM cap (same discipline as the TW driver)
+    if lhat * (3 + 2 * rr) <= 128 * 7:
+        prefs = (512, 256, 128)
+    elif lhat * (3 + 2 * rr) <= 256 * 7:
+        prefs = (256, 128)
+    else:
+        prefs = (128,)
+    tile_b = next((tb for tb in prefs if b % tb == 0), None)
+    if tile_b is None:
+        raise ValueError(f"delta path needs a 128-multiple batch, got {b}")
+    nhat, dem_g, _d_bf16, knn_f, has_knn, cap0, interpret = (
+        _delta_common_setup(inst, params, knn)
+    )
+    scal = jnp.asarray(
+        [[cap0 / dem_g, float(w.cap) * dem_g]], jnp.float32
+    )
+    # basis tables: bf16-rounded once (the kernel's pair lookups read
+    # bf16; the resync timeline must price the SAME rounded legs), then
+    # lane-concatenated for the kernel's stacked lookup
+    bas_np = np.zeros((rr, nhat, nhat), np.float32)
+    bas_np[:, : inst.n_nodes, : inst.n_nodes] = np.asarray(inst.td_basis)
+    bas_bf = jnp.asarray(bas_np, jnp.bfloat16)
+    bas_f32 = bas_bf.astype(jnp.float32)
+    d_cat = jnp.concatenate([bas_bf[r] for r in range(rr)], axis=1)
+
+    gt_t = jnp.zeros((lhat, b), jnp.int32).at[:length].set(giants.T)
+    dem_row = np.zeros((1, nhat), np.float32)
+    dem_row[0, : inst.n_nodes] = np.asarray(inst.demands) / dem_g
+    dp_t = dp_init(gt_t, jnp.asarray(dem_row), tile_b=tile_b,
+                   interpret=interpret)
+
+    fw_fn = _td_fw_fn(length, tile_b)
+    fw_t, lgr_t, dist0 = fw_fn(giants, inst, bas_f32)
+    cape0 = _cap_excess_of(gt_t, dp_t, scal[0, 0], lhat)
+    cost0 = dist0 + scal[0, 1] * cape0
+    state = (gt_t, dp_t, lgr_t, cost0, gt_t, cost0)
+    t0j, t1j = jnp.float32(t0), jnp.float32(t1)
+    horizon = jnp.float32(params.n_iters)
+    fw_box = [fw_t]  # step_block closure reads the latest resync's fw
+
+    def step_block(st, nb, start):
+        return _sa_delta_td_block_fn(
+            nb, length, rr, tile_b, has_knn, interpret
+        )(st, fw_box[0], k_run, d_cat, knn_f, scal,
+          t0j, t1j, jnp.int32(start), horizon)
+
+    def resync_state(st):
+        # refresh the frozen factor weights + committed cost in the
+        # exact timeline of the committed tours (the surrogate's only
+        # drift source); lgr re-derives exactly so it stays as-is
+        gt_t, dp_t, lgr_t, _cost, best_t, best_c = st
+        g = gt_t[:length].T
+        fw_new, _lg, dist = fw_fn(g, inst, bas_f32)
+        fw_box[0] = fw_new
+        cape = _cap_excess_of(gt_t, dp_t, scal[0, 0], lhat)
+        return (gt_t, dp_t, lgr_t, dist + scal[0, 1] * cape, best_t, best_c)
+
+    state, done = _delta_launch_loop(
+        step_block, state, params.n_iters, deadline_s,
+        ("delta_td", b, length), lambda s: s[5], resync=resync_state,
+    )
+
+    best_t = state[4]
+    best_exact = _td_best_rank_fn(length)(best_t, inst, w)
+    champ = jnp.argmin(best_exact)
+    g = best_t[:length, champ].T
+    bd, cost = exact_cost(g, inst, w)
+    elite = None
+    if pool > 0:
+        order = jnp.argsort(best_exact)[: min(pool, b)]
+        elite = best_t[:length, :].T[order]
+    return SolveResult(g, cost, bd, jnp.float32(b * done), elite)
 
 
 @lru_cache(maxsize=16)
@@ -924,11 +1188,18 @@ def solve_sa_delta(
         return _solve_sa_delta_tw(
             inst, giants, t0, t1, k_run, params, w, deadline_s, pool, knn
         )
+    if inst.time_dependent:
+        return _solve_sa_delta_td(
+            inst, giants, t0, t1, k_run, params, w, deadline_s, pool, knn
+        )
     b, length = giants.shape
     lhat = _pow2_at_least(length)
     # 256-chain tiles measured fastest for the block kernel (512 blows
-    # the VMEM budget once the per-block param streams move in)
-    tile_b = next((t for t in (256, 128) if b % t == 0), None)
+    # the VMEM budget once the per-block param streams move in); above
+    # the old n=512 gate (lhat 2048) the per-move roll temporaries
+    # double again, so drop to 128
+    prefs = (256, 128) if lhat <= 1024 else (128,)
+    tile_b = next((t for t in prefs if b % t == 0), None)
     if tile_b is None:
         raise ValueError(f"delta path needs a 128-multiple batch, got {b}")
     # gcd demand scaling (kernels.sa_eval.demand_scale): the kernel's
