@@ -296,9 +296,12 @@ def _family_sa_delta_tw(device):
     assert _delta_supported(inst, w, "pallas")
     # PRODUCTION config (VERDICT r4 weak-1: the 5x bar was stated at
     # B=16384 but recorded at B=4096, where launch overhead halves the
-    # ratio): 16k chains, a 16-launch schedule. Measured r5 on v5e:
-    # 43.5M eff. moves/s, 5.84x the equal-sweeps full-eval step.
-    B, iters = 16384, 8192
+    # ratio): 16k chains, a 32-launch schedule (launches pipeline
+    # asynchronously in the deadline-free loop, so longer schedules
+    # amortize dispatch further). Recorded r5 on v5e at THIS config:
+    # 39.6M eff. moves/s, 5.27x the equal-sweeps full-eval step
+    # (16-launch runs ranged 4.9-5.8x).
+    B, iters = 16384, 16384
     p = SAParams(n_chains=B, n_iters=iters)
     res, warm_s = _timed(lambda: solve_sa_delta(inst, key=1, params=p, weights=w))
     # equal-sweeps full-eval reference for the speedup ratio
@@ -465,8 +468,13 @@ def _family_quality(device):
     }
 
 
-def _budget_ils(inst, chains: int, budget: float, key: int = 0):
-    """Warm + one clean budgeted ILS solve -> (res, wall_seconds)."""
+def _budget_ils(inst, chains: int, budget: float, key: int = 0,
+                mode: str = "auto"):
+    """Warm + one clean budgeted ILS solve -> (res, wall_seconds).
+
+    `mode` must be "gather" when the target device is the host CPU
+    inside a TPU process: "auto" resolves by default backend (tpu ->
+    pallas), and Mosaic kernels only interpret on CPU."""
     from vrpms_tpu.solvers.ils import ILSParams, solve_ils
     from vrpms_tpu.solvers.sa import SAParams, warm_anneal_blocks
 
@@ -480,10 +488,14 @@ def _budget_ils(inst, chains: int, budget: float, key: int = 0):
         params=ILSParams.from_budget(
             2, SAParams(n_chains=chains, n_iters=0), 2 * 512, pool=32
         ),
+        mode=mode,
     )
-    warm_anneal_blocks(inst, chains)
+    # warm the deadline-block shapes in the SAME eval mode the timed
+    # solve will run, or the first timed solve pays compile against its
+    # budget (that tax would bias the CPU-vs-TPU cost ratio)
+    warm_anneal_blocks(inst, chains, mode=mode)
     t0 = time.perf_counter()
-    res = solve_ils(inst, key=key, params=p, deadline_s=budget)
+    res = solve_ils(inst, key=key, params=p, deadline_s=budget, mode=mode)
     return res, time.perf_counter() - t0
 
 
@@ -607,7 +619,7 @@ def main():
             inst_c, _ = load_fixture("E-n51-k5")
             inst_c = jax.device_put(inst_c, cpu_dev)
             with jax.default_device(cpu_dev):
-                res_c, _el = _budget_ils(inst_c, 256, 10.0)
+                res_c, _el = _budget_ils(inst_c, 256, 10.0, mode="gather")
             cpu_cost = float(res_c.breakdown.distance)
             vs_b = round(cpu_cost / head["cost_at_10s"], 3)
             head["cpu_cost_at_10s"] = round(cpu_cost, 1)

@@ -450,6 +450,7 @@ def warm_anneal_blocks(
     n_chains: int,
     weights: CostWeights | None = None,
     blocks: tuple = (128, 256, 384, 512),
+    mode: str = "auto",
 ) -> None:
     """Compile/load every deadline-block shape a (B, L) solve can need
     and seed the persistent sweep-rate cache.
@@ -466,7 +467,7 @@ def warm_anneal_blocks(
     (same prep, block, resync, and final-eval programs).
     """
     w = weights or CostWeights.make()
-    mode = resolve_eval_mode("auto")
+    mode = resolve_eval_mode(mode)
     # same guard as solve_ils: the delta kernel needs a 128-multiple batch
     use_delta = _delta_supported(inst, w, mode) and n_chains % 128 == 0
     # ascending: the rate-less first call opens with a 128 block anyway
