@@ -520,7 +520,42 @@ def _family_real(device):
             continue
         inst, meta = load_fixture(name)
         inst = jax.device_put(inst, device)
-        res, el = _budget_ils(inst, chains, budget)
+        pool_best = None
+        if meta["kind"] == "vrptw":
+            # tight-TW instances take the TW delta anneal directly: the
+            # ILS pipeline's polish ranks by distance deltas and cannot
+            # repair lateness, so its rounds waste the budget (R101 at
+            # 10 s: lateness 138 via ILS vs 0.2 via one B=16k anneal
+            # with the TW-aware candidate lists — round-5 measurement)
+            from vrpms_tpu.core.cost import best_feasible_pool
+            from vrpms_tpu.solvers.sa import (
+                SAParams,
+                solve_sa_delta,
+                warm_anneal_blocks,
+            )
+
+            p = SAParams(n_chains=16384, n_iters=1_000_000)
+            # warm_anneal_blocks routes through solve_sa_delta with the
+            # deadline path engaged, so every shrunk block shape
+            # compiles AND the sweep-rate cache seeds before the timed
+            # solve; one tiny pooled solve warms the elite-gather
+            # program too
+            warm_anneal_blocks(inst, 16384)
+            solve_sa_delta(
+                inst, key=99,
+                params=SAParams(n_chains=16384, n_iters=512), pool=32,
+            )
+            t0 = time.perf_counter()
+            # key=1 matches the ladder's config-5 line; the solve-trail
+            # record documents the seed sensitivity at this budget
+            res = solve_sa_delta(
+                inst, key=1, params=p, deadline_s=budget, pool=32
+            )
+            jax.block_until_ready(res.cost)
+            el = time.perf_counter() - t0
+            pool_best = best_feasible_pool(res.pool, inst)
+        else:
+            res, el = _budget_ils(inst, chains, budget)
         dist = float(res.breakdown.distance)
         late = float(res.breakdown.tw_lateness)
         cape = float(res.breakdown.cap_excess)
@@ -531,9 +566,17 @@ def _family_real(device):
             "cap_excess": cape,
             "tw_lateness": round(late, 2),
         }
-        # a gap against BKS is only meaningful for a FEASIBLE solution
+        # a gap against BKS is only meaningful for a FEASIBLE solution;
+        # the cost-optimal champion may carry epsilon lateness while a
+        # feasible elite sits in the pool — the gap line takes the best
+        # FEASIBLE tour found
         if cape == 0.0 and late == 0.0:
             entry["gap_to_bks_pct"] = round(gap_percent(dist, meta["bks"]), 2)
+        elif pool_best is not None:
+            entry["feasible_pool_dist"] = round(pool_best, 1)
+            entry["gap_to_bks_pct"] = round(
+                gap_percent(pool_best, meta["bks"]), 2
+            )
         else:
             entry["gap_to_bks_pct"] = None
         out[name] = entry

@@ -475,22 +475,33 @@ def config5_vrptw(quick=False, solomon_path=None):
         t0 = time.perf_counter()
         res = solve_sa_delta(
             inst, key=1,
-            params=SAParams(n_chains=16384, n_iters=40960),
-            deadline_s=120.0,
+            params=SAParams(n_chains=16384, n_iters=1_000_000),
+            deadline_s=120.0, pool=32,
         )
         bd = res.breakdown
         feasible = (
             float(bd.tw_lateness) == 0.0 and float(bd.cap_excess) == 0.0
         )
+        gap = None
+        dist = float(bd.distance)
+        if feasible:
+            gap = round(gap_percent(dist, meta["bks"]), 2)
+        else:
+            # the gap line takes the best FEASIBLE pool member (the
+            # cost-champion may carry epsilon lateness)
+            from vrpms_tpu.core.cost import best_feasible_pool
+
+            fb = best_feasible_pool(res.pool, inst)
+            if fb is not None:
+                gap = round(gap_percent(fb, meta["bks"]), 2)
+                dist = fb
         _result(
             5,
             "r101-full-fixture-delta",
             cost=round(float(bd.distance), 1),
+            feasible_dist=round(dist, 1) if gap is not None else None,
             bks=meta["bks"],
-            gap_pct=(
-                round(gap_percent(float(bd.distance), meta["bks"]), 2)
-                if feasible else None
-            ),
+            gap_pct=gap,
             tw_lateness=round(float(bd.tw_lateness), 2),
             cap_excess=float(bd.cap_excess),
             seconds=round(time.perf_counter() - t0, 1),

@@ -647,3 +647,22 @@ def objective(giant: jax.Array, inst: Instance, w: CostWeights) -> jax.Array:
 
 def objective_batch(giants: jax.Array, inst: Instance, w: CostWeights) -> jax.Array:
     return jax.vmap(objective, in_axes=(0, None, None))(giants, inst, w)
+
+
+def best_feasible_pool(pool, inst) -> float | None:
+    """Min DISTANCE over the zero-lateness zero-excess members of an
+    elite pool ([K, L] giants), or None when no member is feasible.
+
+    Gap-to-BKS lines must price a FEASIBLE tour; the cost-optimal
+    champion of a penalized search may carry epsilon lateness while a
+    slightly longer feasible elite sits in the pool (round 5)."""
+    if pool is None:
+        return None
+    import numpy as np
+
+    dist, cape, late, _, _ = tw_components_batch(pool, inst)
+    dist, cape, late = map(np.asarray, (dist, cape, late))
+    feas = (cape == 0.0) & (late == 0.0)
+    if not feas.any():
+        return None
+    return float(dist[feas].min())

@@ -44,7 +44,7 @@ from vrpms_tpu.core.cost import (
 )
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
-from vrpms_tpu.moves import knn_table
+from vrpms_tpu.moves import proposal_knn
 from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
 from vrpms_tpu.solvers.ga import (
     GAParams,
@@ -342,7 +342,7 @@ def solve_sa_islands(
             )
         giants0 = init_giants
 
-    knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
+    knn = proposal_knn(inst, params.knn_k) if params.knn_k > 0 else None
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
     elite = None
     if deadline_s is None:

@@ -7,6 +7,7 @@ from vrpms_tpu.moves.moves import (
     apply_src_map,
     random_move_batch,
     knn_table,
+    proposal_knn,
     knn_src_map,
     knn_move_batch,
     N_MOVE_TYPES,

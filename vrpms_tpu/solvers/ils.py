@@ -104,10 +104,10 @@ def solve_ils(
         key = jax.random.key(key)
     # one host-side KNN build for ALL rounds (each rebuild re-transfers
     # the durations matrix — a wasted round trip per round on TPU)
-    from vrpms_tpu.moves import knn_table
+    from vrpms_tpu.moves import proposal_knn
 
     knn = (
-        knn_table(inst.durations[0], params.sa.knn_k)
+        proposal_knn(inst, params.sa.knn_k)
         if params.sa.knn_k > 0
         else None
     )

@@ -30,7 +30,7 @@ from vrpms_tpu.core.cost import (
 )
 from vrpms_tpu.core.encoding import random_giant_batch
 from vrpms_tpu.core.instance import Instance
-from vrpms_tpu.moves import knn_move_batch, knn_table, random_move_batch
+from vrpms_tpu.moves import knn_move_batch, proposal_knn, random_move_batch
 from vrpms_tpu.solvers.common import SolveResult
 
 
@@ -403,7 +403,7 @@ def solve_sa(
     # solve_sa requires a concrete instance (the temp scale above
     # already forced durations to a value), so the table can be built.
     if knn is None:
-        knn = knn_table(inst.durations[0], params.knn_k) if params.knn_k > 0 else None
+        knn = proposal_knn(inst, params.knn_k) if params.knn_k > 0 else None
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
     horizon = jnp.float32(n_iters)
     state = (giants, costs, giants, costs)
@@ -1040,7 +1040,7 @@ def _delta_common_setup(inst, params, knn):
     d_np[: inst.n_nodes, : inst.n_nodes] = np.asarray(inst.durations[0])
     d_bf16 = jnp.asarray(d_np, jnp.bfloat16)
     if knn is None and params.knn_k > 0:
-        knn = knn_table(inst.durations[0], params.knn_k)
+        knn = proposal_knn(inst, params.knn_k)
     has_knn = knn is not None
     if has_knn:
         kf = np.zeros((nhat, knn.shape[1]), np.float32)
