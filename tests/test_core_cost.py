@@ -254,3 +254,43 @@ class TestTDFactorization:
         np.testing.assert_allclose(
             recon, np.asarray(inst.durations), rtol=1e-4, atol=1e-3
         )
+
+
+class TestBestFeasiblePool:
+    def test_picks_min_distance_feasible_member(self):
+        import numpy as np
+
+        from vrpms_tpu.core.cost import best_feasible_pool, tw_components_batch
+        from vrpms_tpu.io.synth import synth_vrptw
+        from vrpms_tpu.core.encoding import random_giant_batch
+
+        inst = synth_vrptw(12, 3, seed=4)
+        pool = random_giant_batch(jax.random.key(0), 16, inst.n_customers,
+                                  inst.n_vehicles)
+        out = best_feasible_pool(pool, inst)
+        dist, cape, late, _, _ = map(
+            np.asarray, tw_components_batch(pool, inst)
+        )
+        feas = (cape == 0.0) & (late == 0.0)
+        if feas.any():
+            assert out == float(dist[feas].min())
+        else:
+            assert out is None
+
+    def test_none_pool_and_infeasible(self):
+        import numpy as np
+
+        from vrpms_tpu.core import make_instance
+        from vrpms_tpu.core.cost import best_feasible_pool
+        from vrpms_tpu.core.encoding import giant_from_routes
+
+        assert best_feasible_pool(None, object()) is None
+        # one-customer instance with an impossible window: the only
+        # tour is late, so no feasible member exists
+        d = np.array([[0.0, 5.0], [5.0, 0.0]])
+        inst = make_instance(
+            d, demands=[0, 1], capacities=[10.0],
+            ready=[0.0, 0.0], due=[100.0, 1.0], service=[0.0, 1.0],
+        )
+        g = giant_from_routes([[1]], 1, 1)
+        assert best_feasible_pool(g[None], inst) is None
