@@ -2,11 +2,10 @@
 
 from http.server import BaseHTTPRequestHandler
 
+from service.obs import RequestObsMixin
 
-class handler(BaseHTTPRequestHandler):
 
-    def log_message(self, format, *args):  # noqa: A002
-        pass
+class handler(RequestObsMixin, BaseHTTPRequestHandler):
 
     def do_GET(self):
         self.send_response(200)

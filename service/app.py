@@ -7,7 +7,8 @@ threading HTTP server:
 
     python -m service.app --port 8080 [--fixtures fixtures.json] [--store memory]
 
-Routes: /api, /api/{vrp,tsp}/{ga,sa,aco,bf}. Unknown paths -> 404.
+Routes: /api, /api/{vrp,tsp}/{ga,sa,aco,bf}, /metrics (Prometheus text
+exposition — service.obs). Unknown paths -> 404.
 """
 
 from __future__ import annotations
@@ -16,6 +17,7 @@ import argparse
 import os
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from service import obs
 from service.api.index import handler as health_handler
 from service.api.vrp.ga.index import handler as vrp_ga
 from service.api.vrp.sa.index import handler as vrp_sa
@@ -25,6 +27,7 @@ from service.api.tsp.ga.index import handler as tsp_ga
 from service.api.tsp.sa.index import handler as tsp_sa
 from service.api.tsp.aco.index import handler as tsp_aco
 from service.api.tsp.bf.index import handler as tsp_bf
+from vrpms_tpu.obs import log_event
 
 ROUTES = {
     "/api": health_handler,
@@ -36,17 +39,20 @@ ROUTES = {
     "/api/tsp/sa": tsp_sa,
     "/api/tsp/aco": tsp_aco,
     "/api/tsp/bf": tsp_bf,
+    "/metrics": obs.MetricsHandler,
 }
 
+# the request counter's route label values come from the route table —
+# an arbitrary 404 path can never mint a new series (service.obs)
+obs.KNOWN_ROUTES.update(ROUTES)
 
-class Router(BaseHTTPRequestHandler):
+
+class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
     """Delegates each request to the per-route handler class by rebinding
     the handler instance's class — the per-route classes keep the exact
     shape Vercel expects (a BaseHTTPRequestHandler subclass per file), and
-    the router stays a thin dispatch layer."""
-
-    def log_message(self, format, *args):  # noqa: A002
-        pass
+    the router stays a thin dispatch layer. Unmatched paths (404/501) are
+    logged and counted here; matched ones by the route class's own mixin."""
 
     def _dispatch(self, method: str):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
@@ -106,6 +112,7 @@ def main():
     from vrpms_tpu.utils import enable_compile_cache
 
     cache_dir = enable_compile_cache()
+    obs.set_compile_cache(cache_dir)
     if args.warmup:
         # best-effort like the compile cache: a bad shape spec or a
         # transient backend error must not crash-loop the service before
@@ -115,8 +122,18 @@ def main():
 
             warmup(args.warmup)
         except Exception as e:
-            print(f"[warmup] skipped: {type(e).__name__}: {e}")
+            log_event(
+                "warmup.skipped",
+                error=f"{type(e).__name__}: {e}",
+                spec=args.warmup,
+            )
     server = serve(args.port)
+    log_event(
+        "service.start",
+        port=args.port,
+        store=os.environ.get("VRPMS_STORE", "auto"),
+        compileCache=cache_dir or "off",
+    )
     print(
         f"vrpms_tpu service on :{args.port} "
         f"(store={os.environ.get('VRPMS_STORE', 'auto')}, "

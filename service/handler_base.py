@@ -12,6 +12,7 @@ like the reference (api/vrp/ga/index.py:57-65); the TSP save does not
 from __future__ import annotations
 
 import json
+import time
 from http.server import BaseHTTPRequestHandler
 
 import store
@@ -21,23 +22,22 @@ from service.helpers import (
     send_static_headers,
     success,
 )
+from service.obs import BODY_BYTES, RequestObsMixin
 from service.parameters import parse_solver_options
 from service.solve import run_tsp, run_vrp
+from vrpms_tpu.obs import new_request_id, reset_request_id, set_request_id
 
 
-class SolveHandler(BaseHTTPRequestHandler):
+class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
     """Base for all solve endpoints; subclasses set problem/algorithm/
-    banner and (for VRP GA) CORS preflight."""
+    banner and (for VRP GA) CORS preflight. RequestObsMixin emits one
+    structured access line + request-counter bump per response."""
 
     problem: str = "vrp"       # 'vrp' | 'tsp'
     algorithm: str = "sa"      # 'ga' | 'sa' | 'aco' | 'bf'
     banner: str = "Hi!"
     parse_common = None        # staticmethod set by subclass
     parse_algo = None          # staticmethod or None
-
-    # Quiet request logging (BaseHTTPRequestHandler logs to stderr).
-    def log_message(self, format, *args):  # noqa: A002
-        pass
 
     def do_GET(self):
         self.send_response(200)
@@ -47,8 +47,33 @@ class SolveHandler(BaseHTTPRequestHandler):
         self.wfile.write(self.banner.encode("utf-8"))
 
     def do_POST(self):
-        # Read
-        content_length = int(self.headers.get("Content-Length", 0))
+        # Request context: id + clock first, so every later log line
+        # (including solver-side ones via the contextvar) correlates
+        # and the access line carries a duration.
+        self._obs_t0 = time.perf_counter()
+        self._request_id = new_request_id()
+        token = set_request_id(self._request_id)
+        try:
+            self._solve_post()
+        finally:
+            reset_request_id(token)
+
+    def _solve_post(self):
+        # Read. A malformed/absent Content-Length must produce the
+        # contract's 400 envelope, not a ValueError-killed connection.
+        raw_length = self.headers.get("Content-Length")
+        try:
+            content_length = int(raw_length or 0)
+            if content_length < 0:
+                raise ValueError(raw_length)
+        except (TypeError, ValueError):
+            fail(self, [{
+                "what": "Bad request",
+                "reason": f"invalid Content-Length header: {raw_length!r}",
+            }])
+            return
+        self._obs_body_bytes = content_length
+        BODY_BYTES.observe(content_length)
         content_string = str(self.rfile.read(content_length).decode("utf-8"))
         try:
             content = json.loads(content_string) if content_string else dict()

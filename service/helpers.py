@@ -2,13 +2,18 @@
 
 Same four helpers as the reference (api/helpers.py): missing-parameter
 accumulation into a shared mutable errors list, location filtering for
-persistence, and the fail/success JSON envelopes.
+persistence, and the fail/success JSON envelopes. One additive field on
+the error envelope: `requestId` (when the handler generated one) so a
+400 can be correlated with its structured log line — the reference keys
+are untouched.
 """
 
 from __future__ import annotations
 
 import json
 from http.server import BaseHTTPRequestHandler
+
+from service import obs
 
 
 def get_parameter(name: str, content: dict, errors, optional=False):
@@ -34,11 +39,18 @@ def send_static_headers(handler: BaseHTTPRequestHandler):
 
 
 def fail(handler: BaseHTTPRequestHandler, errors):
+    kinds = [e.get("what", "unknown") for e in errors]
+    for what in kinds:
+        obs.ERROR_KINDS.labels(what=what).inc()
+    handler._obs_errors = sorted(set(kinds))  # for the access log line
     handler.send_response(400)
     handler.send_header("Content-type", "application/json")
     send_static_headers(handler)
     handler.end_headers()
     response = {"success": False, "errors": errors}
+    rid = getattr(handler, "_request_id", None)
+    if rid is not None:
+        response["requestId"] = rid
     handler.wfile.write(json.dumps(response).encode("utf-8"))
 
 

@@ -1,0 +1,179 @@
+"""Service observability: concrete instruments, /metrics, request logs.
+
+The generic primitives live in vrpms_tpu.obs (registry/logging/trace);
+this module owns everything service-shaped:
+
+  * the process REGISTRY and every instrument the request path records
+    (requests by route/algorithm/outcome, error-envelope kinds,
+    warm-start hit/miss, solve/polish latency, evals, body sizes);
+  * scrape-time gauges (uptime, attached devices, backend + compile
+    cache info) refreshed on each GET /metrics, never on the hot path;
+  * MetricsHandler — the GET /metrics route (Prometheus text format);
+  * RequestObsMixin — the one log_request/log_error hook shared by the
+    router and every endpoint handler, replacing the old silenced
+    log_message overrides with a structured JSON access line + the
+    request counter.
+
+Instrumentation stays out of the solve hot path: counters/histograms
+are lock-guarded floats recorded once per request, and nothing here
+runs unless a request arrives or /metrics is scraped.
+"""
+
+from __future__ import annotations
+
+import time
+from http.server import BaseHTTPRequestHandler
+
+from vrpms_tpu.obs import Registry, log_event
+
+REGISTRY = Registry()
+
+REQUESTS = REGISTRY.counter(
+    "vrpms_requests_total",
+    "HTTP requests by route, algorithm, and outcome (ok|error)",
+    labels=("route", "algorithm", "outcome"),
+)
+ERROR_KINDS = REGISTRY.counter(
+    "vrpms_error_envelope_total",
+    "Error entries returned in 400 envelopes, by kind ('what')",
+    labels=("what",),
+)
+WARMSTART = REGISTRY.counter(
+    "vrpms_warmstart_lookups_total",
+    "Warm-start checkpoint lookups by outcome (hit|miss)",
+    labels=("outcome",),
+)
+SOLVE_SECONDS = REGISTRY.histogram(
+    "vrpms_solve_seconds",
+    "End-to-end solve wall time (dispatch + anneal + polish), seconds",
+    labels=("problem", "algorithm"),
+)
+POLISH_SECONDS = REGISTRY.histogram(
+    "vrpms_polish_seconds",
+    "localSearch delta-descent polish wall time, seconds",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+SOLVE_EVALS = REGISTRY.histogram(
+    "vrpms_solve_evals",
+    "Candidate evaluations performed per solve",
+    buckets=(1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10),
+)
+BODY_BYTES = REGISTRY.histogram(
+    "vrpms_request_body_bytes",
+    "POST request body size, bytes",
+    buckets=(256, 1024, 4096, 16384, 65536, 262144, 1048576, 8388608),
+)
+UPTIME = REGISTRY.gauge(
+    "vrpms_uptime_seconds", "Seconds since service process start"
+)
+DEVICES = REGISTRY.gauge(
+    "vrpms_devices", "Accelerator devices attached to the process"
+)
+BACKEND_INFO = REGISTRY.gauge(
+    "vrpms_backend_info",
+    "Constant 1, labeled with the jax backend and compile-cache state",
+    labels=("backend", "compileCache"),
+)
+
+_START = time.time()
+_compile_cache = "off"
+
+# populated by service.app from its route table; request-counter label
+# values come from here so an arbitrary 404 path can never mint a new
+# label series (unbounded cardinality)
+KNOWN_ROUTES: set = set()
+
+
+def set_compile_cache(cache_dir) -> None:
+    """Record the compile-cache state app startup resolved (label of
+    vrpms_backend_info)."""
+    global _compile_cache
+    _compile_cache = "on" if cache_dir else "off"
+
+
+def refresh_gauges() -> None:
+    """Scrape-time gauge values. jax is imported lazily and guarded:
+    /metrics must answer even if the backend is broken."""
+    UPTIME.set(time.time() - _START)
+    try:
+        import jax
+
+        DEVICES.set(len(jax.devices()))
+        backend = jax.default_backend()
+    except Exception:
+        DEVICES.set(0)
+        backend = "unavailable"
+    BACKEND_INFO.labels(backend=backend, compileCache=_compile_cache).set(1)
+
+
+def route_label(path: str) -> str:
+    return path if path in KNOWN_ROUTES else "<unmatched>"
+
+
+class RequestObsMixin:
+    """Structured access logging + request counting for every handler.
+
+    BaseHTTPRequestHandler calls log_request from send_response, so one
+    response means exactly one access line and one counter bump — for
+    GET banners, POST solves, OPTIONS preflights, and router 404s
+    alike. Handlers that time their work stash _obs_t0 / _request_id /
+    _obs_errors on the instance; the hook picks up whatever is there.
+    """
+
+    def log_request(self, code="-", size="-"):  # noqa: A002
+        try:
+            status = int(code)
+        except (TypeError, ValueError):
+            status = 0
+        # parse_request send_error()s malformed request lines BEFORE
+        # assigning self.path/self.command — the hook still fires
+        raw_path = getattr(self, "path", "") or ""
+        path = raw_path.split("?", 1)[0].rstrip("/") or "/"
+        route = route_label(path)
+        outcome = "ok" if status < 400 else "error"
+        REQUESTS.labels(
+            route=route,
+            algorithm=getattr(self, "algorithm", ""),
+            outcome=outcome,
+        ).inc()
+        t0 = getattr(self, "_obs_t0", None)
+        errors = getattr(self, "_obs_errors", None)
+        log_event(
+            "http.request",
+            requestId=getattr(self, "_request_id", None),
+            method=getattr(self, "command", None),
+            path=path,
+            status=status,
+            durationMs=(
+                round((time.perf_counter() - t0) * 1e3, 2)
+                if t0 is not None
+                else None
+            ),
+            algorithm=getattr(self, "algorithm", None),
+            problem=getattr(self, "problem", None),
+            bodyBytes=getattr(self, "_obs_body_bytes", None),
+            errors=errors or None,
+        )
+
+    def log_error(self, format, *args):  # noqa: A002
+        log_event("http.error", message=format % args)
+
+    def log_message(self, format, *args):  # noqa: A002
+        # stray stdlib messages (malformed request lines, ...) also
+        # arrive as structured lines instead of bare stderr text
+        log_event("http.log", message=format % args)
+
+
+class MetricsHandler(RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /metrics — Prometheus text exposition of the REGISTRY."""
+
+    def do_GET(self):
+        refresh_gauges()
+        body = REGISTRY.render().encode("utf-8")
+        self.send_response(200)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import sys
 import math
 import time
 import traceback
@@ -35,6 +34,9 @@ import traceback
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from service import obs
+from vrpms_tpu.obs import collect_blocks, convergence_summary, log_event
 
 from vrpms_tpu.core import make_instance
 from vrpms_tpu.core.encoding import routes_from_giant
@@ -79,7 +81,15 @@ def _enveloped(fn):
         try:
             return fn(algorithm, params, opts, ga_params, locations, matrix, errors, **kw)
         except Exception as e:
-            traceback.print_exc(file=sys.stderr)
+            # structured line (request-correlated via the contextvar)
+            # instead of a bare stderr traceback; the envelope entry the
+            # caller returns stays byte-identical
+            log_event(
+                "solve.exception",
+                algorithm=algorithm,
+                error=f"{type(e).__name__}: {e}",
+                traceback=traceback.format_exc(),
+            )
             errors += [
                 {"what": "Data error", "reason": f"{type(e).__name__}: {e}"}
             ]
@@ -702,23 +712,42 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
     """
     t0 = time.perf_counter()
     w = _request_weights(opts)
-    with _profiled(opts) as trace_dir:
+    include_stats = bool(opts.get("include_stats"))
+    # the block-trace collector is installed ONLY under includeStats:
+    # without it the solver loops pay one ContextVar read per block and
+    # the result stays byte-identical to the pre-telemetry contract
+    with _profiled(opts) as trace_dir, collect_blocks(include_stats) as btrace:
         res = _solve_instance(
             inst, algorithm, opts, ga_params, errors, problem, warm, w, extras
         )
+        t_polish = time.perf_counter()
         res, polished = _polish(res, inst, opts, w, t0)
+        polish_s = time.perf_counter() - t_polish
         if res is not None:
             jax.block_until_ready(res.cost)
-    if res is None or not opts.get("include_stats"):
+    wall_s = time.perf_counter() - t0
+    if res is not None:
+        obs.SOLVE_SECONDS.labels(problem=problem, algorithm=algorithm).observe(
+            wall_s
+        )
+        obs.SOLVE_EVALS.observe(float(res.evals))
+        if polished:
+            obs.POLISH_SECONDS.observe(polish_s)
+    if res is None or not include_stats:
         return res, None
     stats = {
         "algorithm": algorithm,
         "evals": int(res.evals),
-        "wallMs": round((time.perf_counter() - t0) * 1e3, 1),
+        "wallMs": round(wall_s * 1e3, 1),
         "backend": jax.default_backend(),
         "warmStart": warm is not None,
         "localSearch": polished,
     }
+    if btrace is not None and btrace.blocks:
+        stats["trace"] = btrace.blocks
+        conv = convergence_summary(btrace.blocks)
+        if conv is not None:
+            stats["convergence"] = conv
     # SA/GA/ACO island-shard (bf ignores the option)
     if opts.get("islands") and algorithm in ("sa", "ga", "aco"):
         stats["islands"] = _island_devices(opts)[0]
@@ -784,6 +813,12 @@ def run_vrp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
     # without a warm hook, being exact).
     if opts.get("warm_start") and database is not None and algorithm != "bf":
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "vrp")
+        # the checkpoint feature's measurable hit rate: a miss is an
+        # absent/stale/other-problem checkpoint (or an unauthenticated
+        # request, which has no checkpoint namespace at all)
+        obs.WARMSTART.labels(
+            outcome="hit" if warm is not None else "miss"
+        ).inc()
     extras: dict = {}
     with _device_ctx(opts.get("backend")):
         res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "vrp", warm,
@@ -887,6 +922,9 @@ def run_tsp(algorithm, params, opts, ga_params, locations, matrix, errors, datab
         )
     ):
         warm = _warm_perm(database.get_warmstart(params["name"]), orig_ids, "tsp")
+        obs.WARMSTART.labels(
+            outcome="hit" if warm is not None else "miss"
+        ).inc()
     extras: dict = {}
     with _device_ctx(opts.get("backend")):
         res, stats = _run_solver(inst, algorithm, opts, ga_params, errors, "tsp", warm,

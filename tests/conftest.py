@@ -77,6 +77,7 @@ _SLOW_PATTERNS = (
     "test_perturb.py::TestRuinRecreate::test_ils_reseed_ruin_mode_runs",
     # end-to-end HTTP solves (the envelope/contract tests stay quick)
     "test_concurrency.py",
+    "test_service.py::TestObservabilitySolve",
     "test_service.py::TestVRPSolve",
     "test_service.py::TestTSPSolve",
     "test_service.py::TestTimedPaths",

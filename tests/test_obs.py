@@ -1,0 +1,292 @@
+"""Unit tests for the observability spine (vrpms_tpu.obs).
+
+Registry/exposition behavior (counter/gauge/histogram rendering, label
+escaping, the disabled no-op mode), a thread-safety smoke for the
+ThreadingHTTPServer reality, the structured JSON logger with its
+request-id contextvar, and the solver block-trace collector with its
+convergence derivation.
+"""
+
+import io
+import json
+import threading
+
+import pytest
+
+from vrpms_tpu.obs import (
+    Registry,
+    collect_blocks,
+    active_trace,
+    convergence_summary,
+    current_request_id,
+    log_event,
+    new_request_id,
+    reset_request_id,
+    set_log_stream,
+    set_request_id,
+)
+from vrpms_tpu.obs.trace import MAX_TRACE_BLOCKS
+
+
+class TestCounter:
+    def test_inc_and_render(self):
+        reg = Registry()
+        c = reg.counter("t_total", "help text")
+        c.inc()
+        c.inc(2.5)
+        out = reg.render()
+        assert "# HELP t_total help text" in out
+        assert "# TYPE t_total counter" in out
+        assert "t_total 3.5" in out
+
+    def test_labels_create_series(self):
+        reg = Registry()
+        c = reg.counter("r_total", "h", labels=("route", "outcome"))
+        c.labels(route="/api", outcome="ok").inc()
+        c.labels(route="/api", outcome="ok").inc()
+        c.labels(route="/api", outcome="error").inc()
+        out = reg.render()
+        assert 'r_total{route="/api",outcome="ok"} 2' in out
+        assert 'r_total{route="/api",outcome="error"} 1' in out
+
+    def test_wrong_labels_rejected(self):
+        reg = Registry()
+        c = reg.counter("x_total", "h", labels=("a",))
+        with pytest.raises(ValueError):
+            c.labels(b="1")
+
+    def test_negative_increment_rejected(self):
+        reg = Registry()
+        c = reg.counter("n_total", "h")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_duplicate_name_rejected(self):
+        reg = Registry()
+        reg.counter("dup_total", "h")
+        with pytest.raises(ValueError):
+            reg.gauge("dup_total", "h")
+
+    def test_label_value_escaping(self):
+        reg = Registry()
+        c = reg.counter("e_total", "h", labels=("v",))
+        c.labels(v='a"b\\c\nd').inc()
+        out = reg.render()
+        assert 'v="a\\"b\\\\c\\nd"' in out
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        reg = Registry()
+        g = reg.gauge("g", "h")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value == 3
+        assert "g 3" in reg.render()
+
+
+class TestHistogram:
+    def test_cumulative_buckets_sum_count(self):
+        reg = Registry()
+        h = reg.histogram("lat", "h", buckets=(1, 5, 10))
+        for v in (0.5, 3, 7, 100):
+            h.observe(v)
+        out = reg.render()
+        assert 'lat_bucket{le="1"} 1' in out
+        assert 'lat_bucket{le="5"} 2' in out
+        assert 'lat_bucket{le="10"} 3' in out
+        assert 'lat_bucket{le="+Inf"} 4' in out
+        assert "lat_count 4" in out
+        assert "lat_sum 110.5" in out
+
+    def test_labelled_histogram(self):
+        reg = Registry()
+        h = reg.histogram("s", "h", labels=("algo",), buckets=(1,))
+        h.labels(algo="sa").observe(0.5)
+        out = reg.render()
+        assert 's_bucket{algo="sa",le="1"} 1' in out
+        assert 's_count{algo="sa"} 1' in out
+
+    def test_inf_bucket_always_appended(self):
+        reg = Registry()
+        h = reg.histogram("b", "h", buckets=(2,))
+        assert h.buckets[-1] == float("inf")
+
+
+class TestDisabledRegistry:
+    def test_all_instruments_noop(self):
+        reg = Registry(enabled=False)
+        c = reg.counter("c_total", "h")
+        g = reg.gauge("g", "h")
+        h = reg.histogram("h", "h", buckets=(1,))
+        c.inc()
+        g.set(9)
+        h.observe(0.5)
+        out = reg.render()
+        assert "c_total 0" in out
+        assert "g 0" in out
+        assert "h_count 0" in out
+
+
+class TestThreadSafety:
+    def test_concurrent_increments_exact(self):
+        """8 writer threads on shared + per-thread label series: final
+        counts must be exact (the router is a ThreadingHTTPServer)."""
+        reg = Registry()
+        c = reg.counter("smoke_total", "h", labels=("who",))
+        h = reg.histogram("smoke_lat", "h", buckets=(0.5, 1.0))
+        n_threads, n_iter = 8, 1000
+
+        def work(i):
+            for _ in range(n_iter):
+                c.labels(who="all").inc()
+                c.labels(who=str(i)).inc()
+                h.observe(0.25)
+
+        threads = [
+            threading.Thread(target=work, args=(i,))
+            for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.labels(who="all").value == n_threads * n_iter
+        for i in range(n_threads):
+            assert c.labels(who=str(i)).value == n_iter
+        assert f"smoke_lat_count {n_threads * n_iter}" in reg.render()
+
+
+class TestStructuredLogging:
+    def test_one_json_object_per_line(self):
+        buf = io.StringIO()
+        prev = set_log_stream(buf)
+        try:
+            log_event("test.event", a=1, b="x", dropped=None)
+        finally:
+            set_log_stream(prev)
+        (line,) = buf.getvalue().strip().splitlines()
+        rec = json.loads(line)
+        assert rec["event"] == "test.event"
+        assert rec["a"] == 1 and rec["b"] == "x"
+        assert "dropped" not in rec
+        assert "ts" in rec
+
+    def test_request_id_contextvar_attached(self):
+        buf = io.StringIO()
+        prev = set_log_stream(buf)
+        rid = new_request_id()
+        token = set_request_id(rid)
+        try:
+            assert current_request_id() == rid
+            log_event("test.corr")
+        finally:
+            reset_request_id(token)
+            set_log_stream(prev)
+        assert current_request_id() is None
+        rec = json.loads(buf.getvalue())
+        assert rec["requestId"] == rid
+
+    def test_request_ids_unique_and_short(self):
+        ids = {new_request_id() for _ in range(100)}
+        assert len(ids) == 100
+        assert all(len(i) == 12 for i in ids)
+
+
+class TestBlockTrace:
+    def test_inactive_by_default(self):
+        assert active_trace() is None
+        with collect_blocks(enabled=False) as tr:
+            assert tr is None
+            assert active_trace() is None
+
+    def test_records_cumulative_entries(self):
+        with collect_blocks() as tr:
+            assert active_trace() is tr
+            tr.record([5.0, 3.0], iters=128, evals_per_iter=4)
+            tr.record([2.5], iters=128, evals_per_iter=4)
+        assert active_trace() is None
+        assert [b["evals"] for b in tr.blocks] == [512, 1024]
+        assert [b["bestCost"] for b in tr.blocks] == [3.0, 2.5]
+        assert tr.blocks[0]["wallMs"] <= tr.blocks[1]["wallMs"]
+
+    def test_truncation_keeps_eval_accounting(self):
+        with collect_blocks() as tr:
+            for _ in range(MAX_TRACE_BLOCKS + 10):
+                tr.record([1.0], iters=1, evals_per_iter=2)
+        assert len(tr.blocks) == MAX_TRACE_BLOCKS
+        assert tr.truncated
+
+    def test_convergence_summary(self):
+        blocks = [
+            {"wallMs": 100.0, "bestCost": 50.0, "evals": 1000},
+            {"wallMs": 110.0, "bestCost": 50.0, "evals": 2000},
+            {"wallMs": 120.0, "bestCost": 40.0, "evals": 3000},
+        ]
+        conv = convergence_summary(blocks)
+        assert conv["blocks"] == 3
+        assert conv["firstBlockMs"] == 100.0
+        assert conv["timeToFirstImprovementMs"] == 120.0
+        # block 0: 100 ms for 1000 evals; steady: 20 ms for 2000 more
+        assert conv["msPerKEvalFirstBlock"] == 100.0
+        assert conv["msPerKEvalSteady"] == 10.0
+
+    def test_convergence_summary_edge_cases(self):
+        assert convergence_summary([]) is None
+        conv = convergence_summary(
+            [{"wallMs": 5.0, "bestCost": 1.0, "evals": 10}]
+        )
+        assert conv["timeToFirstImprovementMs"] is None
+        assert "msPerKEvalSteady" not in conv
+
+
+class TestRunBlockedTrace:
+    """The solver loop records into an active collector with zero
+    jit-graph changes — exercised through run_blocked itself with a
+    numpy 'device' state."""
+
+    def test_deadline_path_records_blocks(self):
+        import numpy as np
+
+        from vrpms_tpu.solvers.common import run_blocked
+
+        def step(state, nb, start):
+            return state - 0.1 * nb
+
+        with collect_blocks() as tr:
+            state, done = run_blocked(
+                step, np.float32(10.0), 256, 128, deadline_s=60.0,
+                sync=lambda s: s, evals_per_iter=8,
+            )
+        assert done == 256
+        assert len(tr.blocks) >= 1
+        assert tr.blocks[-1]["evals"] == 256 * 8
+        costs = [b["bestCost"] for b in tr.blocks]
+        assert costs == sorted(costs, reverse=True)
+
+    def test_single_block_path_records_once(self):
+        import numpy as np
+
+        from vrpms_tpu.solvers.common import run_blocked
+
+        with collect_blocks() as tr:
+            _, done = run_blocked(
+                lambda s, nb, start: s, np.float32(3.0), 500, 512,
+                deadline_s=None, sync=lambda s: s, evals_per_iter=2,
+            )
+        assert done == 500
+        assert len(tr.blocks) == 1
+        assert tr.blocks[0]["evals"] == 1000
+
+    def test_no_collector_records_nothing(self):
+        import numpy as np
+
+        from vrpms_tpu.solvers.common import run_blocked
+
+        _, done = run_blocked(
+            lambda s, nb, start: s, np.float32(3.0), 128, 128,
+            deadline_s=30.0, sync=lambda s: s, evals_per_iter=2,
+        )
+        assert done == 128
+        assert active_trace() is None

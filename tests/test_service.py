@@ -827,6 +827,206 @@ class TestTimedPaths:
         assert resp["message"]["duration"] > 0
 
 
+def metric_line(text: str, prefix: str) -> float | None:
+    """Value of the first exposition sample starting with `prefix`
+    (label order is the instrument's declared order, so prefixes are
+    deterministic); None when absent."""
+    for line in text.splitlines():
+        if line.startswith(prefix):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+class TestObservabilityHTTP:
+    """Request-id correlation, the Content-Length fix, and /metrics
+    plumbing — no solver runs, so these stay in the quick tier."""
+
+    def test_400_envelope_echoes_request_id(self, server):
+        status, resp = post(server, "/api/vrp/sa", {})
+        assert status == 400
+        rid = resp["requestId"]
+        assert isinstance(rid, str) and len(rid) == 12
+        # distinct requests carry distinct ids
+        _, resp2 = post(server, "/api/vrp/sa", {})
+        assert resp2["requestId"] != rid
+
+    def test_malformed_content_length_returns_envelope(self, server):
+        # int('abc') used to raise out of do_POST and kill the
+        # connection; the contract's 400 envelope must come back instead
+        import http.client
+
+        host, port = server.replace("http://", "").split(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        try:
+            conn.putrequest("POST", "/api/vrp/sa")
+            conn.putheader("Content-Length", "abc")
+            conn.endheaders()
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+        finally:
+            conn.close()
+        assert resp.status == 400
+        assert body["success"] is False
+        assert body["errors"][0]["what"] == "Bad request"
+        assert "Content-Length" in body["errors"][0]["reason"]
+        assert "requestId" in body
+
+    def test_malformed_request_line_still_gets_400(self, server):
+        # parse_request send_error()s before self.path exists; the
+        # observability log_request hook must tolerate that instead of
+        # AttributeError-ing the connection away
+        import socket
+
+        host, port = server.replace("http://", "").split(":")
+        s = socket.create_connection((host, int(port)), timeout=10)
+        try:
+            s.sendall(b"BOGUS\r\n\r\n")
+            data = s.recv(4096)
+        finally:
+            s.close()
+        assert b"400" in data
+
+    def test_metrics_endpoint_exposes_request_counters(self, server):
+        status, resp = post(server, "/api/vrp/sa", {})  # one 400
+        assert status == 400
+        status, text = get(server, "/metrics")
+        assert status == 200
+        # valid-looking exposition: HELP/TYPE pairs and counter samples
+        assert "# TYPE vrpms_requests_total counter" in text
+        errors = metric_line(
+            text,
+            'vrpms_requests_total{route="/api/vrp/sa",algorithm="sa",'
+            'outcome="error"}',
+        )
+        assert errors is not None and errors >= 1
+        kinds = metric_line(
+            text, 'vrpms_error_envelope_total{what="Missing parameter"}'
+        )
+        assert kinds is not None and kinds >= 1
+        # gauges answer on every scrape
+        assert metric_line(text, "vrpms_uptime_seconds") > 0
+        assert 'vrpms_backend_info{backend="cpu"' in text
+        assert text.endswith("\n")
+
+    def test_unmatched_routes_do_not_mint_series(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, "/api/bogus/never-a-route")
+        assert e.value.code == 404
+        _, text = get(server, "/metrics")
+        assert "never-a-route" not in text
+        assert metric_line(
+            text,
+            'vrpms_requests_total{route="<unmatched>",algorithm="",'
+            'outcome="error"}',
+        ) >= 1
+
+
+class TestObservabilitySolve:
+    """The acceptance-criteria integration: a solved request and a 400,
+    then /metrics must carry the split request counter, the solve-
+    latency histogram, and the warm-start hit/miss counter; includeStats
+    must expose the per-block convergence trace without changing the
+    stats-less contract."""
+
+    def test_metrics_after_solve_and_400(self, server):
+        status, resp = post(
+            server, "/api/vrp/sa", vrp_body(iterationCount=60, populationSize=8)
+        )
+        assert status == 200, resp
+        status, _ = post(server, "/api/vrp/sa", {})
+        assert status == 400
+        status, text = get(server, "/metrics")
+        assert status == 200
+        ok = metric_line(
+            text,
+            'vrpms_requests_total{route="/api/vrp/sa",algorithm="sa",'
+            'outcome="ok"}',
+        )
+        err = metric_line(
+            text,
+            'vrpms_requests_total{route="/api/vrp/sa",algorithm="sa",'
+            'outcome="error"}',
+        )
+        assert ok >= 1 and err >= 1
+        assert "# TYPE vrpms_solve_seconds histogram" in text
+        assert metric_line(
+            text, 'vrpms_solve_seconds_count{problem="vrp",algorithm="sa"}'
+        ) >= 1
+        assert metric_line(
+            text, 'vrpms_solve_seconds_bucket{problem="vrp",algorithm="sa",'
+        ) is not None
+        assert metric_line(text, "vrpms_solve_evals_count") >= 1
+        assert metric_line(text, "vrpms_request_body_bytes_count") >= 1
+
+    def test_warmstart_miss_then_hit_counted(self, server):
+        body = vrp_body(
+            solutionName="obs-warm", iterationCount=60, populationSize=8,
+            warmStart=True, auth="tok-alice",
+        )
+        status, _ = post(server, "/api/vrp/sa", body)  # no checkpoint: miss
+        assert status == 200
+        status, resp = post(server, "/api/vrp/sa", body)  # checkpoint: hit
+        assert status == 200, resp
+        _, text = get(server, "/metrics")
+        assert metric_line(
+            text, 'vrpms_warmstart_lookups_total{outcome="miss"}'
+        ) >= 1
+        assert metric_line(
+            text, 'vrpms_warmstart_lookups_total{outcome="hit"}'
+        ) >= 1
+
+    def test_include_stats_exposes_trace(self, server):
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=200, populationSize=8, includeStats=True),
+        )
+        assert status == 200, resp
+        stats = resp["message"]["stats"]
+        trace = stats["trace"]
+        assert isinstance(trace, list) and len(trace) >= 1
+        for entry in trace:
+            assert set(entry) == {"wallMs", "bestCost", "evals"}
+            assert entry["wallMs"] >= 0 and entry["evals"] > 0
+        evals = [e["evals"] for e in trace]
+        assert evals == sorted(evals)
+        assert trace[-1]["evals"] == stats["evals"]
+        bests = [e["bestCost"] for e in trace]
+        assert bests == sorted(bests, reverse=True)  # best never worsens
+        conv = stats["convergence"]
+        assert conv["blocks"] == len(trace)
+        assert conv["firstBlockMs"] > 0
+
+    def test_trace_covers_deadline_blocks(self, server):
+        status, resp = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=1500, populationSize=8,
+                     includeStats=True, timeLimit=60),
+        )
+        assert status == 200, resp
+        trace = resp["message"]["stats"]["trace"]
+        # a deadline-blocked anneal syncs per block: several entries
+        assert len(trace) >= 2
+
+    def test_stats_absent_is_byte_identical_contract(self, server):
+        body = vrp_body(iterationCount=100, populationSize=8)
+        status, plain = post(server, "/api/vrp/sa", body)
+        assert status == 200, plain
+        status, with_stats = post(
+            server, "/api/vrp/sa", dict(body, includeStats=True)
+        )
+        assert status == 200, with_stats
+        assert set(plain["message"]) == {
+            "durationMax", "durationSum", "vehicles"
+        }
+        stripped = dict(with_stats["message"])
+        del stripped["stats"]
+        # identical solve modulo the additive stats key (same seed, same
+        # program — the telemetry must not perturb the search)
+        assert stripped == plain["message"]
+
+
 class TestCORS:
     def test_vrp_ga_preflight(self, server):
         req = urllib.request.Request(server + "/api/vrp/ga", method="OPTIONS")

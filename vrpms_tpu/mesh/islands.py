@@ -237,6 +237,8 @@ def _deadline_driver(
     sync_iters: int,
     deadline_s: float,
     multi_controller: bool = False,
+    best_of=None,
+    evals_per_iter: float | None = None,
 ):
     """Host-clock-checked execution of `total` island iterations: full
     migration blocks in chunks of ~sync_iters iterations, then the
@@ -251,11 +253,18 @@ def _deadline_driver(
     local clocks diverging would strand the ppermute collectives of the
     extra chunks. Process-local solves must NOT set it: the broadcast
     is itself a collective the other processes would never join.
-    Returns (state, done)."""
+    Returns (state, done).
+
+    `best_of(state)`, when given, feeds the per-request convergence
+    trace (vrpms_tpu.obs.trace) at every host sync — same contract as
+    solvers.common.run_blocked's recording; a no-op without an active
+    collector."""
     import time
 
     from vrpms_tpu.mesh.sync import controller_value
+    from vrpms_tpu.obs.trace import active_trace
 
+    trace = active_trace() if best_of is not None else None
     n_blocks, tail = _blocked_schedule(total, block_len)
     chunk = max(1, sync_iters // max(block_len, 1))
     t_start = time.monotonic()
@@ -264,15 +273,17 @@ def _deadline_driver(
         over = time.monotonic() - t_start >= deadline_s
         return controller_value(over) if multi_controller else over
 
-    def sync(st):
+    def sync(st, iters):
         jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+        if trace is not None:
+            trace.record(best_of(st), iters, evals_per_iter)
 
     done = 0
     b = 0
     while b < n_blocks:
         nb = min(chunk, n_blocks - b)
         state = call(state, nb, block_len, b * block_len)
-        sync(state)
+        sync(state, nb * block_len)
         b += nb
         done = b * block_len
         if spent():
@@ -283,7 +294,7 @@ def _deadline_driver(
             break
         nt = min(sync_iters, tail - t)
         state = call(state, nt, 0, n_blocks * block_len + t)
-        sync(state)
+        sync(state, nt)
         t += nt
         done += nt
         if spent():
@@ -373,6 +384,8 @@ def solve_sa_islands(
         state, done = _deadline_driver(
             call, state, n_iters, block_len, 512, deadline_s,
             multi_controller=mesh_spans_processes(mesh),
+            best_of=lambda st: st[3],
+            evals_per_iter=n_isl * chains_local,
         )
         _, _, best_g, best_c = state
         g, c = _champion(best_g, best_c)
@@ -572,6 +585,9 @@ def solve_ga_islands(
     local_params = dataclasses.replace(params, population=pop_local)
     generations = params.generations
     mode = resolve_eval_mode(mode)
+    per_gen = pop_local + immigrants_for(
+        local_params, pop_local, inst.n_customers
+    )
 
     k_init, k_run = jax.random.split(key)
     if init_perms is None:
@@ -605,6 +621,8 @@ def solve_ga_islands(
         state, done = _deadline_driver(
             call, state, generations, block_len, 128, deadline_s,
             multi_controller=mesh_spans_processes(mesh),
+            best_of=lambda st: st[3],
+            evals_per_iter=n_isl * per_gen,
         )
         _, _, best_p, best_f = state
         best_perm, _ = _champion(best_p, best_f)
@@ -617,7 +635,6 @@ def solve_ga_islands(
         elite = jax.vmap(lambda p: greedy_split_giant(p, inst))(
             pool_perms[order]
         )
-    per_gen = pop_local + immigrants_for(local_params, pop_local, inst.n_customers)
     return SolveResult(
         giant,
         cost,
@@ -766,6 +783,8 @@ def solve_aco_islands(
         state, done = _deadline_driver(
             call, state, params.n_iters, block_len, 64, deadline_s,
             multi_controller=mesh_spans_processes(mesh),
+            best_of=lambda st: st[2],
+            evals_per_iter=n_isl * params.n_ants,
         )
     _, best_p, best_f = state
     best_perm, _ = _champion(best_p, best_f)

@@ -1,0 +1,50 @@
+"""Stdlib-only observability spine: metrics, structured logs, traces.
+
+Three deliberately independent pieces (SURVEY.md §5 "failure detection"
+made first-class):
+
+  registry — thread-safe Counter/Gauge/Histogram instruments plus
+             Prometheus text exposition (the service's GET /metrics);
+  logging  — one-JSON-object-per-line event logger with a request-id
+             contextvar so every log line of a request correlates;
+  trace    — a contextvar block-trace collector the solver deadline
+             loops report (wall-clock, best-cost, evals) into with zero
+             jit-graph changes.
+
+Nothing here imports jax or the solver stack: the service layer owns
+the concrete instruments (service.obs) and the solvers only ever call
+`active_trace()` — absent a collector, that is one ContextVar read.
+"""
+
+from vrpms_tpu.obs.logging import (
+    current_request_id,
+    log_event,
+    new_request_id,
+    reset_request_id,
+    set_log_stream,
+    set_request_id,
+)
+from vrpms_tpu.obs.registry import Counter, Gauge, Histogram, Registry
+from vrpms_tpu.obs.trace import (
+    BlockTrace,
+    active_trace,
+    collect_blocks,
+    convergence_summary,
+)
+
+__all__ = [
+    "BlockTrace",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "active_trace",
+    "collect_blocks",
+    "convergence_summary",
+    "current_request_id",
+    "log_event",
+    "new_request_id",
+    "reset_request_id",
+    "set_log_stream",
+    "set_request_id",
+]

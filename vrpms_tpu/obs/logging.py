@@ -1,0 +1,80 @@
+"""Structured JSON logging: one object per line, request-correlated.
+
+Every line is a single JSON object on stderr with at least `ts` (epoch
+seconds), `event` (dotted name, e.g. "http.request"), and — whenever a
+request context is active — `requestId`. The request id rides a
+ContextVar set by the HTTP layer (service.handler_base), so anything
+logged from inside a solve (solver exceptions, warm-start accounting)
+correlates with the request's own access line without threading an id
+through every call signature. ThreadingHTTPServer gives each request
+its own thread, and ContextVars are per-thread, so concurrent requests
+never cross-contaminate.
+
+`VRPMS_LOG=off` silences the logger entirely (benchmarks measuring the
+hot path without I/O); `set_log_stream` redirects it (tests).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+
+_write_lock = threading.Lock()
+_stream = None  # None -> sys.stderr at call time (tests may rebind stderr)
+
+_request_id: contextvars.ContextVar = contextvars.ContextVar(
+    "vrpms_request_id", default=None
+)
+
+
+def new_request_id() -> str:
+    """12-hex-char id: short enough to read in a log line, random enough
+    that a collision within one service's retention window is noise."""
+    return uuid.uuid4().hex[:12]
+
+
+def set_request_id(rid: str):
+    """Bind `rid` to the current context; returns the reset token."""
+    return _request_id.set(rid)
+
+
+def reset_request_id(token) -> None:
+    _request_id.reset(token)
+
+
+def current_request_id() -> str | None:
+    return _request_id.get()
+
+
+def set_log_stream(stream):
+    """Redirect log output (None restores stderr); returns the previous
+    setting."""
+    global _stream
+    prev = _stream
+    _stream = stream
+    return prev
+
+
+def log_event(event: str, **fields) -> None:
+    """Emit one structured line. None-valued fields are dropped; the
+    active request id is attached unless the caller passes its own."""
+    if os.environ.get("VRPMS_LOG") == "off":
+        return
+    record = {"ts": round(time.time(), 3), "event": event}
+    rid = fields.pop("requestId", None) or _request_id.get()
+    if rid is not None:
+        record["requestId"] = rid
+    record.update((k, v) for k, v in fields.items() if v is not None)
+    line = json.dumps(record, default=str)
+    stream = _stream if _stream is not None else sys.stderr
+    with _write_lock:
+        try:
+            stream.write(line + "\n")
+            stream.flush()
+        except (OSError, ValueError):
+            pass  # a closed/broken log stream must never fail a request

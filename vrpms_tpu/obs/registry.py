@@ -1,0 +1,289 @@
+"""Thread-safe metric instruments + Prometheus text exposition.
+
+The service router is a ThreadingHTTPServer, so every instrument must
+tolerate concurrent writers; each labelled child carries its own lock
+and the registry serialises child creation. A `Registry(enabled=False)`
+turns every record call into a single attribute check — the no-op
+baseline benchmarks/obs_overhead.py measures the hot path against.
+
+Exposition follows the Prometheus text format (version 0.0.4): HELP and
+TYPE comment lines, `name{label="value"} value` samples, histograms as
+cumulative `_bucket{le=...}` series plus `_sum`/`_count`. Rendering
+takes a point-in-time snapshot under the per-child locks, so a scrape
+concurrent with a solve never sees a half-updated histogram.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+_LATENCY_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0,
+)
+
+
+def _escape_label(value) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _sample(name: str, labels: dict, value: float) -> str:
+    if labels:
+        body = ",".join(
+            f'{k}="{_escape_label(v)}"' for k, v in labels.items()
+        )
+        return f"{name}{{{body}}} {_format_value(value)}"
+    return f"{name} {_format_value(value)}"
+
+
+class _Instrument:
+    """Shared labels/children plumbing for the three instrument kinds."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: Registry, name: str, help: str,  # noqa: A002
+                 labels: tuple = ()):
+        self._registry = registry
+        self.name = name
+        self.help = help
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._children: dict = {}
+        if not self.label_names:
+            # the unlabeled instrument IS its own single child
+            self._children[()] = self._make_child()
+
+    def labels(self, **kv):
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"{self.name} expects labels {self.label_names}, got "
+                f"{tuple(kv)}"
+            )
+        key = tuple(str(kv[n]) for n in self.label_names)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._make_child())
+        return child
+
+    def _default_child(self):
+        return self._children[()]
+
+    def _snapshot(self) -> list:
+        with self._lock:
+            items = list(self._children.items())
+        return items
+
+    def render(self) -> list:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        for key, child in self._snapshot():
+            labels = dict(zip(self.label_names, key))
+            lines.extend(child.render(self.name, labels))
+        return lines
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "_value", "_enabled")
+
+    def __init__(self, enabled_ref):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._enabled = enabled_ref
+
+    def inc(self, amount: float = 1.0):
+        if not self._enabled():
+            return
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self, name: str, labels: dict) -> list:
+        return [_sample(name, labels, self.value)]
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild(lambda: self._registry.enabled)
+
+    def inc(self, amount: float = 1.0):
+        self._default_child().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "_value", "_enabled")
+
+    def __init__(self, enabled_ref):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._enabled = enabled_ref
+
+    def set(self, value: float):
+        if not self._enabled():
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0):
+        if not self._enabled():
+            return
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self, name: str, labels: dict) -> list:
+        return [_sample(name, labels, self.value)]
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild(lambda: self._registry.enabled)
+
+    def set(self, value: float):
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0):
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0):
+        self._default_child().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count", "_enabled")
+
+    def __init__(self, buckets: tuple, enabled_ref):
+        self._lock = threading.Lock()
+        self._buckets = buckets
+        self._counts = [0] * len(buckets)
+        self._sum = 0.0
+        self._count = 0
+        self._enabled = enabled_ref
+
+    def observe(self, value: float):
+        if not self._enabled():
+            return
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, ub in enumerate(self._buckets):
+                if value <= ub:
+                    self._counts[i] += 1
+                    break
+
+    def render(self, name: str, labels: dict) -> list:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        lines = []
+        cum = 0
+        for ub, c in zip(self._buckets, counts):
+            cum += c
+            le = dict(labels)
+            le["le"] = _format_value(ub)
+            lines.append(_sample(f"{name}_bucket", le, cum))
+        lines.append(_sample(f"{name}_sum", labels, s))
+        lines.append(_sample(f"{name}_count", labels, total))
+        return lines
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, labels=(),  # noqa: A002
+                 buckets=_LATENCY_BUCKETS):
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket")
+        if bs[-1] != math.inf:
+            bs = bs + (math.inf,)
+        self.buckets = bs
+        super().__init__(registry, name, help, labels)
+
+    def _make_child(self):
+        return _HistogramChild(self.buckets, lambda: self._registry.enabled)
+
+    def observe(self, value: float):
+        self._default_child().observe(value)
+
+
+class Registry:
+    """Instrument factory + exposition. One per process in practice
+    (service.obs.REGISTRY); tests and the overhead benchmark construct
+    their own. `enabled=False` makes every record call a no-op while
+    keeping render() working (all-zero output)."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._instruments: dict = {}
+
+    def _register(self, instrument):
+        with self._lock:
+            if instrument.name in self._instruments:
+                raise ValueError(
+                    f"metric {instrument.name!r} already registered"
+                )
+            self._instruments[instrument.name] = instrument
+        return instrument
+
+    def counter(self, name: str, help: str, labels: tuple = ()) -> Counter:  # noqa: A002
+        return self._register(Counter(self, name, help, labels))
+
+    def gauge(self, name: str, help: str, labels: tuple = ()) -> Gauge:  # noqa: A002
+        return self._register(Gauge(self, name, help, labels))
+
+    def histogram(self, name: str, help: str, labels: tuple = (),  # noqa: A002
+                  buckets=_LATENCY_BUCKETS) -> Histogram:
+        return self._register(Histogram(self, name, help, labels, buckets))
+
+    def render(self) -> str:
+        with self._lock:
+            instruments = list(self._instruments.values())
+        lines = []
+        for inst in instruments:
+            lines.extend(inst.render())
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,132 @@
+"""Solver convergence telemetry: per-block (wall, best-cost, evals).
+
+The SA/GA/ACO/ILS deadline loops (solvers.common.run_blocked and the
+delta drivers layered on it) already return to the host between
+device-side scan blocks — exactly the cadence an operator wants a
+convergence trace at, and the ONE place it can be recorded with zero
+jit-graph changes. A collector is installed per-request via ContextVar
+(only when the request asks for stats), so with none active the cost in
+the solver loop is a single ContextVar read per block.
+
+Each entry is cumulative at the block boundary:
+
+    {"wallMs": ms since the collector opened,
+     "bestCost": best objective seen so far (solver's tracking basis),
+     "evals": candidate evaluations performed so far}
+
+`convergence_summary` derives the two headline numbers from a trace:
+time-to-first-improvement (first block whose best beats the opening
+block's) and first-block vs steady-state cost per evaluation — the
+compile/dispatch overhead a warmed service should have amortised away.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+
+MAX_TRACE_BLOCKS = 512  # a runaway many-block solve must not grow an
+                        # unbounded response; the summary still counts
+                        # every block via `evals`
+
+
+class BlockTrace:
+    __slots__ = ("blocks", "truncated", "_t0", "_evals")
+
+    def __init__(self):
+        self.blocks: list = []
+        self.truncated = False
+        self._t0 = time.perf_counter()
+        self._evals = 0.0
+
+    def record(self, best, iters: int, evals_per_iter: float | None) -> None:
+        """Append one block-boundary entry. `best` is whatever array the
+        solver's deadline loop syncs on (per-chain bests, a scalar
+        champion fitness, ...) — its min is the best cost; it has been
+        block_until_ready'd by the caller, so reading it is a transfer,
+        not a wait. `evals_per_iter` None counts raw iterations."""
+        import numpy as np
+
+        self._evals += float(iters) * float(
+            evals_per_iter if evals_per_iter is not None else 1.0
+        )
+        if len(self.blocks) >= MAX_TRACE_BLOCKS:
+            self.truncated = True
+            return
+        try:
+            best_cost = float(np.min(np.asarray(best)))
+        except Exception:
+            # telemetry must never fail a solve: e.g. a multi-process
+            # mesh's globally-sharded best array isn't fully addressable
+            # from this host — skip the entry, keep the eval accounting
+            return
+        self.blocks.append(
+            {
+                "wallMs": round((time.perf_counter() - self._t0) * 1e3, 2),
+                "bestCost": best_cost,
+                "evals": int(self._evals),
+            }
+        )
+
+
+_active: contextvars.ContextVar = contextvars.ContextVar(
+    "vrpms_block_trace", default=None
+)
+
+
+def active_trace() -> BlockTrace | None:
+    """The collector the current request installed, if any — the only
+    call the solver hot path makes."""
+    return _active.get()
+
+
+@contextlib.contextmanager
+def collect_blocks(enabled: bool = True):
+    """Install a BlockTrace for the duration of a solve; yields it (or
+    None when disabled, so callers need no branch)."""
+    if not enabled:
+        yield None
+        return
+    trace = BlockTrace()
+    token = _active.set(trace)
+    try:
+        yield trace
+    finally:
+        _active.reset(token)
+
+
+def convergence_summary(blocks: list) -> dict | None:
+    """Headline numbers from a block trace (None on an empty trace).
+
+    timeToFirstImprovementMs: wallMs of the first block whose bestCost
+        beats the opening block's (None if nothing after block 0
+        improved — including single-block traces).
+    firstBlockMs / msPerKEvalFirstBlock: the opening block, which pays
+        any residual compile/dispatch cost.
+    msPerKEvalSteady: the remaining blocks' marginal rate; the ratio to
+        the first block's is the cold-start overhead factor.
+    """
+    if not blocks:
+        return None
+    first = blocks[0]
+    out = {
+        "blocks": len(blocks),
+        "firstBlockMs": first["wallMs"],
+        "timeToFirstImprovementMs": None,
+    }
+    for entry in blocks[1:]:
+        if entry["bestCost"] < first["bestCost"] - 1e-9:
+            out["timeToFirstImprovementMs"] = entry["wallMs"]
+            break
+    if first["evals"] > 0:
+        out["msPerKEvalFirstBlock"] = round(
+            first["wallMs"] / first["evals"] * 1e3, 4
+        )
+    last = blocks[-1]
+    d_evals = last["evals"] - first["evals"]
+    if d_evals > 0:
+        out["msPerKEvalSteady"] = round(
+            (last["wallMs"] - first["wallMs"]) / d_evals * 1e3, 4
+        )
+    return out

@@ -331,7 +331,8 @@ def solve_aco(
         )
 
     state, done = run_blocked(
-        step_block, state, params.n_iters, 16, deadline_s, lambda st: st[2]
+        step_block, state, params.n_iters, 16, deadline_s, lambda st: st[2],
+        evals_per_iter=params.n_ants,
     )
 
     _, best_perm, _, pool_perms, pool_fits = state

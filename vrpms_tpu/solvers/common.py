@@ -45,6 +45,7 @@ def run_blocked(
     deadline_s: float | None,
     sync,
     rate_hint: float | None = None,
+    evals_per_iter: float | None = None,
 ):
     """Deadline-aware composition of jitted iteration blocks — the one
     block-driver loop shared by SA, GA, and ACO (identical granularity
@@ -71,11 +72,25 @@ def run_blocked(
     block of a late-starting ILS round was the residual overshoot. The
     hint is derated 20% so a tunnel-throughput wobble errs toward
     finishing early (the loop self-corrects from measured elapsed).
+
+    `evals_per_iter` feeds the per-request convergence trace
+    (vrpms_tpu.obs.trace): when a collector is active, every block
+    boundary records (wall, best-of-sync, cumulative evals). With no
+    collector — the default — the cost is one ContextVar read, and the
+    deadline-free fast path gains no extra device sync.
     """
     import time
 
+    from vrpms_tpu.obs.trace import active_trace
+
+    trace = active_trace()
     if deadline_s is None:
-        return step_block(state, n_total, 0), n_total
+        state = step_block(state, n_total, 0)
+        if trace is not None and n_total > 0:
+            best = sync(state)
+            jax.block_until_ready(best)
+            trace.record(best, n_total, evals_per_iter)
+        return state, n_total
     block = max(1, min(n_total, block_size))
     done = 0
     t_start = time.monotonic()
@@ -107,8 +122,11 @@ def run_blocked(
             # the measured rate fits every later block.
             nb = 128
         state = step_block(state, nb, done)
-        jax.block_until_ready(sync(state))
+        best = sync(state)
+        jax.block_until_ready(best)
         done += nb
+        if trace is not None:
+            trace.record(best, nb, evals_per_iter)
         if time.monotonic() - t_start >= deadline_s:
             break
     return state, done

@@ -431,8 +431,14 @@ def solve_ga(
             st, k_run, inst, w, jnp.int32(start)
         )
 
+    # genome + immigrant evaluations per generation (also the evals
+    # accounting below — the trace and the stat must agree)
+    gen_evals = perms0.shape[0] + immigrants_for(
+        params, perms0.shape[0], inst.n_customers
+    )
     state, done = run_blocked(
-        step_block, state, params.generations, 32, deadline_s, lambda st: st[3]
+        step_block, state, params.generations, 32, deadline_s,
+        lambda st: st[3], evals_per_iter=gen_evals,
     )
 
     perms, fits, best_perm, _ = state
@@ -463,9 +469,6 @@ def solve_ga(
         bd,
         # evals from the actual population (init_perms may differ),
         # plus the immigrant evaluations each generation performs
-        jnp.int32(
-            (perms0.shape[0] + immigrants_for(params, perms0.shape[0], inst.n_customers))
-            * done
-        ),
+        jnp.int32(gen_evals * done),
         elite,
     )

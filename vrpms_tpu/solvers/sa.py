@@ -421,7 +421,7 @@ def solve_sa(
     t_run = _time.monotonic()
     state, done = run_blocked(
         step_block, state, n_iters, 512, deadline_s, lambda st: st[3],
-        rate_hint=_rate_get(rate_key),
+        rate_hint=_rate_get(rate_key), evals_per_iter=giants.shape[0],
     )
     if deadline_s is not None and done:
         el = _time.monotonic() - t_run
@@ -910,6 +910,7 @@ def _solve_sa_delta_td(
     state, done = _delta_launch_loop(
         step_block, state, params.n_iters, deadline_s,
         ("delta_td", b, length), lambda s: s[5], resync=resync_state,
+        evals_per_iter=b,
     )
 
     best_t = state[4]
@@ -968,7 +969,8 @@ def _tw_best_rank_fn(length: int):
 
 
 def _delta_launch_loop(
-    step_block, state, n_iters, deadline_s, rate_key, sync, resync=None
+    step_block, state, n_iters, deadline_s, rate_key, sync, resync=None,
+    evals_per_iter=None,
 ):
     """The 512-step Pallas-launch loop shared by both delta drivers.
 
@@ -1003,6 +1005,7 @@ def _delta_launch_loop(
                 0.0, deadline_s - (_time.monotonic() - t_run)
             ),
             sync, rate_hint=_rate_get(rate_key),
+            evals_per_iter=evals_per_iter,
         )
         done += did
         remaining -= block
@@ -1132,7 +1135,7 @@ def _solve_sa_delta_tw(
     # there is nothing to resync between launches
     state, done = _delta_launch_loop(
         step_block, state, params.n_iters, deadline_s,
-        ("delta_tw", b, length), lambda st: st[8],
+        ("delta_tw", b, length), lambda st: st[8], evals_per_iter=b,
     )
 
     best_t = state[7]
@@ -1245,6 +1248,7 @@ def solve_sa_delta(
     state, done = _delta_launch_loop(
         step_block, state, params.n_iters, deadline_s,
         ("delta", b, length), lambda s: s[5], resync=resync_state,
+        evals_per_iter=b,
     )
 
     gt_t, dp_t, dist, cape, best_t, best_c = state
