@@ -1,0 +1,234 @@
+"""Closed-loop scheduler throughput benchmark: sync-inline vs batched.
+
+Starts the service in-process and drives it with N concurrent
+closed-loop clients (each posts a solve, waits for the response, posts
+the next) for a fixed measurement window, then reports solves/sec and
+p50/p99 latency for two serving modes over the SAME request stream:
+
+  inline — VRPMS_SCHED=off: every HTTP thread solves on its own
+           (the PR-1 behavior), N threads contending for the device;
+  sched  — the scheduler path: one device-owning worker drains the
+           admission queue, merging same-shape requests into one
+           vmapped launch (vrpms_tpu.sched.batch).
+
+The ISSUE-2 acceptance gate: `sched` >= 2x `inline` solves/sec at >= 8
+concurrent same-shape clients (CPU backend acceptable). `--mixed` adds
+a second instance shape to show bucketing keeps mixed traffic correct.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.sched_throughput \
+        [--clients 8] [--duration 10] [--warmup 4] [--n 12] \
+        [--iters 2000] [--pop 64] [--mixed] [--out records/...json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _post(base: str, path: str, body: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:  # pragma: no cover - error path
+        return e.code, json.loads(e.read())
+
+
+def _seed_store(shapes: list[int]) -> None:
+    import numpy as np
+
+    import store.memory as mem
+
+    mem.reset()
+    rng = np.random.default_rng(17)
+    for n in shapes:
+        pts = rng.uniform(0, 100, size=(n, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        mem.seed_locations(
+            f"bench{n}", [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+        )
+        mem.seed_durations(f"bench{n}", d.tolist())
+
+
+def _body(problem: str, n: int, iters: int, pop: int, seed: int) -> dict:
+    body = {
+        "solutionName": f"bench-{n}",
+        "solutionDescription": "sched_throughput",
+        "locationsKey": f"bench{n}",
+        "durationsKey": f"bench{n}",
+        "seed": seed,
+        "iterationCount": iters,
+        "populationSize": pop,
+    }
+    if problem == "vrp":
+        body.update(
+            capacities=[3 * n] * 3,
+            startTimes=[0, 0, 0],
+            ignoredCustomers=[],
+            completedCustomers=[],
+        )
+    else:
+        body.update(customers=list(range(1, n)), startNode=0, startTime=0)
+    return body
+
+
+def run_phase(
+    base: str,
+    problem: str,
+    shapes: list[int],
+    clients: int,
+    duration_s: float,
+    warmup_s: float,
+    iters: int,
+    pop: int,
+) -> dict:
+    """Closed-loop drive: `clients` threads, each cycling its shape.
+
+    The warmup window runs the identical loop but discards samples, so
+    jit compiles (including the batched program's padded batch shapes)
+    never pollute the measurement.
+    """
+    stop = threading.Event()
+    measuring = threading.Event()
+    latencies: list[float] = []
+    failures: list[int] = []
+    lock = threading.Lock()
+
+    path = f"/api/{problem}/sa"
+
+    def client(i: int) -> None:
+        n = shapes[i % len(shapes)]
+        seed = 0
+        while not stop.is_set():
+            seed += 1
+            t0 = time.perf_counter()
+            status, resp = _post(base, path, _body(problem, n, iters, pop, seed))
+            dt = time.perf_counter() - t0
+            if not measuring.is_set():
+                continue
+            with lock:
+                if status == 200:
+                    latencies.append(dt)
+                else:
+                    failures.append(status)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s)
+    measuring.set()
+    t_meas = time.perf_counter()
+    time.sleep(duration_s)
+    measured_s = time.perf_counter() - t_meas
+    stop.set()
+    for t in threads:
+        t.join(timeout=600)
+    lat_ms = sorted(1e3 * x for x in latencies)
+
+    def pct(p: float) -> float | None:
+        if not lat_ms:
+            return None
+        k = min(len(lat_ms) - 1, int(round(p / 100 * (len(lat_ms) - 1))))
+        return round(lat_ms[k], 1)
+
+    return {
+        "solves": len(lat_ms),
+        "solvesPerSec": round(len(lat_ms) / measured_s, 2),
+        "p50Ms": pct(50),
+        "p99Ms": pct(99),
+        "meanMs": round(statistics.mean(lat_ms), 1) if lat_ms else None,
+        "failures": len(failures),
+        "measuredSeconds": round(measured_s, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--problem", choices=("vrp", "tsp"), default="vrp")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--warmup", type=float, default=4.0)
+    ap.add_argument("--n", type=int, default=12, help="locations per instance")
+    ap.add_argument("--iters", type=int, default=2000)
+    ap.add_argument("--pop", type=int, default=64)
+    ap.add_argument("--mixed", action="store_true",
+                    help="second shape (n+4) on half the clients")
+    ap.add_argument("--out", default=None, help="record JSON path")
+    ap.add_argument("--note", default=None, help="free-text note in record")
+    args = ap.parse_args()
+
+    os.environ["VRPMS_STORE"] = "memory"
+    shapes = [args.n, args.n + 4] if args.mixed else [args.n]
+    _seed_store(shapes)
+
+    from service import jobs as jobs_mod
+    from service.app import serve
+
+    srv = serve(port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    import jax
+
+    record = {
+        "benchmark": "sched_throughput",
+        "backend": jax.default_backend(),
+        "problem": args.problem,
+        "clients": args.clients,
+        "shapes": shapes,
+        "iterationCount": args.iters,
+        "populationSize": args.pop,
+        "durationSeconds": args.duration,
+        "note": args.note,
+        "schedConfig": {
+            "queue": int(os.environ.get("VRPMS_SCHED_QUEUE", "64")),
+            "windowMs": float(os.environ.get("VRPMS_SCHED_WINDOW_MS", "10")),
+            "maxBatch": int(os.environ.get("VRPMS_SCHED_MAX_BATCH", "16")),
+        },
+    }
+    for mode in ("inline", "sched"):
+        os.environ["VRPMS_SCHED"] = "off" if mode == "inline" else "on"
+        print(f"== {mode}: {args.clients} clients, "
+              f"{args.duration:.0f}s measure ({args.warmup:.0f}s warmup)")
+        record[mode] = run_phase(
+            base, args.problem, shapes, args.clients, args.duration,
+            args.warmup, args.iters, args.pop,
+        )
+        print(json.dumps(record[mode], indent=2))
+        jobs_mod.shutdown_scheduler()  # fresh scheduler per phase
+
+    if record["inline"]["solvesPerSec"]:
+        record["speedup"] = round(
+            record["sched"]["solvesPerSec"]
+            / record["inline"]["solvesPerSec"], 2,
+        )
+        print(f"speedup (sched/inline solves/sec): {record['speedup']}x")
+
+    srv.shutdown()
+    if args.out:
+        out = os.path.join(os.path.dirname(__file__), args.out) if not (
+            os.path.isabs(args.out)
+        ) else args.out
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        print(f"record -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from service import obs
 from service.api.index import handler as health_handler
+from service.jobs import JobsHandler, JobStatusHandler, shutdown_scheduler
 from service.api.vrp.ga.index import handler as vrp_ga
 from service.api.vrp.sa.index import handler as vrp_sa
 from service.api.vrp.aco.index import handler as vrp_aco
@@ -39,6 +40,7 @@ ROUTES = {
     "/api/tsp/sa": tsp_sa,
     "/api/tsp/aco": tsp_aco,
     "/api/tsp/bf": tsp_bf,
+    "/api/jobs": JobsHandler,
     "/metrics": obs.MetricsHandler,
 }
 
@@ -57,11 +59,21 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
     def _dispatch(self, method: str):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         cls = ROUTES.get(path)
+        if cls is None and path.startswith("/api/jobs/"):
+            # the one parameterized route: /api/jobs/{id} status polls
+            cls = JobStatusHandler
         if cls is None:
             self.send_response(404)
             self.send_header("Content-type", "text/plain")
             self.end_headers()
             self.wfile.write(b"Not found")
+            return
+        if not hasattr(cls, f"do_{method}"):
+            # e.g. POST to a GET-only route: answer 501 instead of
+            # letting getattr AttributeError kill the connection with
+            # no HTTP response at all
+            self.send_response(501)
+            self.end_headers()
             return
         self.__class__ = cls
         getattr(self, f"do_{method}")()
@@ -139,7 +151,23 @@ def main():
         f"(store={os.environ.get('VRPMS_STORE', 'auto')}, "
         f"compile_cache={cache_dir or 'off'})"
     )
-    server.serve_forever()
+    # SIGTERM (the orchestrator's stop signal) must reach the drain
+    # path — the default handler would kill the process with jobs still
+    # queued and waiters parked
+    import signal
+
+    def _sigterm(*_):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        # drain-on-shutdown: queued jobs fail cleanly (persisted records
+        # + woken waiters) instead of being silently abandoned
+        shutdown_scheduler()
 
 
 if __name__ == "__main__":

@@ -11,21 +11,23 @@ like the reference (api/vrp/ga/index.py:57-65); the TSP save does not
 
 from __future__ import annotations
 
-import json
 import time
 from http.server import BaseHTTPRequestHandler
 
 import store
 from service.helpers import (
     fail,
+    read_json_body,
     remove_unused_locations,
     send_static_headers,
     success,
+    too_busy,
 )
-from service.obs import BODY_BYTES, RequestObsMixin
+from service.jobs import scheduler_solve
+from service.obs import SCHED_REJECTS, RequestObsMixin
 from service.parameters import parse_solver_options
-from service.solve import run_tsp, run_vrp
 from vrpms_tpu.obs import new_request_id, reset_request_id, set_request_id
+from vrpms_tpu.sched import QueueFull
 
 
 class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
@@ -59,26 +61,10 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
             reset_request_id(token)
 
     def _solve_post(self):
-        # Read. A malformed/absent Content-Length must produce the
-        # contract's 400 envelope, not a ValueError-killed connection.
-        raw_length = self.headers.get("Content-Length")
-        try:
-            content_length = int(raw_length or 0)
-            if content_length < 0:
-                raise ValueError(raw_length)
-        except (TypeError, ValueError):
-            fail(self, [{
-                "what": "Bad request",
-                "reason": f"invalid Content-Length header: {raw_length!r}",
-            }])
-            return
-        self._obs_body_bytes = content_length
-        BODY_BYTES.observe(content_length)
-        content_string = str(self.rfile.read(content_length).decode("utf-8"))
-        try:
-            content = json.loads(content_string) if content_string else dict()
-        except json.JSONDecodeError as e:
-            fail(self, [{"what": "Bad request", "reason": f"invalid JSON: {e}"}])
+        # Read + parse via the one shared intake ladder (Content-Length
+        # hardening, body-size observation, JSON 400 envelopes).
+        content = read_json_body(self)
+        if content is None:
             return
 
         # Parse parameters
@@ -104,17 +90,21 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
             fail(self, errors)
             return
 
-        # Run algorithm (the reference's TODO hole, realised)
-        if self.problem == "vrp":
-            result = run_vrp(
-                self.algorithm, params, opts, algo_params, locations, durations,
-                errors, database=database,
+        # Run algorithm (the reference's TODO hole, realised) — via the
+        # scheduler: this thread submits and parks on the job event, the
+        # device-owning worker solves (merging concurrent same-shape
+        # requests into one batched launch). Queue-full sheds with 429 +
+        # Retry-After instead of holding the connection behind a queue
+        # this request would start deadline-spent in.
+        try:
+            result = scheduler_solve(
+                self.problem, self.algorithm, params, opts, algo_params,
+                locations, durations, errors, database,
             )
-        else:
-            result = run_tsp(
-                self.algorithm, params, opts, algo_params, locations, durations,
-                errors, database=database,
-            )
+        except QueueFull as e:
+            SCHED_REJECTS.labels(reason="queue_full").inc()
+            too_busy(self, e.retry_after_s)
+            return
         if result is None or len(errors) > 0:
             fail(self, errors)
             return

@@ -16,6 +16,35 @@ from http.server import BaseHTTPRequestHandler
 from service import obs
 
 
+def read_json_body(handler: BaseHTTPRequestHandler) -> dict | None:
+    """The shared POST intake ladder: Content-Length hardening, body-size
+    observation, JSON parse. Writes the contract's 400 envelope and
+    returns None on any failure; an empty body is a valid empty dict.
+    One implementation for every submit surface (handler_base, jobs) so
+    hardening fixes can never drift between them."""
+    raw_length = handler.headers.get("Content-Length")
+    try:
+        content_length = int(raw_length or 0)
+        if content_length < 0:
+            raise ValueError(raw_length)
+    except (TypeError, ValueError):
+        # a malformed/absent Content-Length must produce the contract's
+        # 400 envelope, not a ValueError-killed connection
+        fail(handler, [{
+            "what": "Bad request",
+            "reason": f"invalid Content-Length header: {raw_length!r}",
+        }])
+        return None
+    handler._obs_body_bytes = content_length
+    obs.BODY_BYTES.observe(content_length)
+    content_string = str(handler.rfile.read(content_length).decode("utf-8"))
+    try:
+        return json.loads(content_string) if content_string else dict()
+    except json.JSONDecodeError as e:
+        fail(handler, [{"what": "Bad request", "reason": f"invalid JSON: {e}"}])
+        return None
+
+
 def get_parameter(name: str, content: dict, errors, optional=False):
     if name not in content and not optional:
         errors += [
@@ -48,6 +77,39 @@ def fail(handler: BaseHTTPRequestHandler, errors):
     send_static_headers(handler)
     handler.end_headers()
     response = {"success": False, "errors": errors}
+    rid = getattr(handler, "_request_id", None)
+    if rid is not None:
+        response["requestId"] = rid
+    handler.wfile.write(json.dumps(response).encode("utf-8"))
+
+
+def too_busy(handler: BaseHTTPRequestHandler, retry_after_s: float):
+    """Backpressure response: 429 + Retry-After (admission queue full).
+
+    The scheduler's whole point is that overload sheds IMMEDIATELY with
+    a machine-readable retry hint instead of accepting work that would
+    start with a spent deadline budget (or holding the connection)."""
+    import math
+
+    obs.ERROR_KINDS.labels(what="Too busy").inc()
+    handler._obs_errors = ["Too busy"]
+    handler.send_response(429)
+    handler.send_header("Content-type", "application/json")
+    handler.send_header(
+        "Retry-After", str(max(1, int(math.ceil(retry_after_s))))
+    )
+    send_static_headers(handler)
+    handler.end_headers()
+    response = {
+        "success": False,
+        "errors": [
+            {
+                "what": "Too busy",
+                "reason": "solver admission queue is full; retry after the "
+                "Retry-After interval",
+            }
+        ],
+    }
     rid = getattr(handler, "_request_id", None)
     if rid is not None:
         response["requestId"] = rid

@@ -1,0 +1,616 @@
+"""Async jobs API + the service side of the solve scheduler.
+
+This module wires the generic scheduler (vrpms_tpu.sched: bounded
+queue, shape-bucketed micro-batcher, device-owning workers) into the
+service:
+
+  * the RUNNER — executes batches on the worker thread: solo jobs run
+    the exact run_vrp/run_tsp pipeline tail (service.solve.
+    solve_prepared); same-bucket SA jobs merge into ONE vmapped launch
+    (vrpms_tpu.sched.batch.solve_sa_batch) and split back per request;
+  * the HTTP surface — POST /api/jobs returns a jobId immediately
+    (202), GET /api/jobs/{id} polls queued|running|done|failed with the
+    standard envelope; queue-full answers 429 + Retry-After;
+  * submit-and-wait — the existing synchronous endpoints keep their
+    contract by parking on the job event instead of solving inline
+    (service.handler_base), so the accelerator is only ever driven by
+    the scheduler's workers;
+  * persistence + observability — async job records go through the
+    store.Database seam (memory and Supabase both work), and every
+    transition feeds the sched instruments (service.obs) and a
+    request-correlated structured log line.
+
+Config (env): VRPMS_SCHED=off disables the scheduler (solves run inline
+on HTTP threads — the PR-1 behavior, kept for benchmarks baselines),
+VRPMS_SCHED_QUEUE (admission bound, default 64), VRPMS_SCHED_WINDOW_MS
+(micro-batch gather window, default 10), VRPMS_SCHED_MAX_BATCH (default
+16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler
+
+import store
+from service import obs
+from service.helpers import fail, read_json_body, send_static_headers, too_busy
+from service.parameters import (
+    parse_common_tsp_parameters,
+    parse_common_vrp_parameters,
+    parse_solver_options,
+    parse_tsp_aco_parameters,
+    parse_tsp_ga_parameters,
+    parse_tsp_sa_parameters,
+    parse_vrp_aco_parameters,
+    parse_vrp_ga_parameters,
+    parse_vrp_sa_parameters,
+)
+from service.solve import (
+    Prepared,
+    finish_tsp,
+    finish_vrp,
+    prepare_request,
+    run_tsp,
+    run_vrp,
+    solve_prepared,
+)
+from vrpms_tpu.obs import (
+    current_request_id,
+    log_event,
+    new_request_id,
+    reset_request_id,
+    set_request_id,
+)
+from vrpms_tpu.sched import DONE, FAILED, Job, QueueFull, Scheduler
+
+_PARSERS = {
+    ("vrp", "ga"): (parse_common_vrp_parameters, parse_vrp_ga_parameters),
+    ("vrp", "sa"): (parse_common_vrp_parameters, parse_vrp_sa_parameters),
+    ("vrp", "aco"): (parse_common_vrp_parameters, parse_vrp_aco_parameters),
+    ("vrp", "bf"): (parse_common_vrp_parameters, parse_vrp_sa_parameters),
+    ("tsp", "ga"): (parse_common_tsp_parameters, parse_tsp_ga_parameters),
+    ("tsp", "sa"): (parse_common_tsp_parameters, parse_tsp_sa_parameters),
+    ("tsp", "aco"): (parse_common_tsp_parameters, parse_tsp_aco_parameters),
+    ("tsp", "bf"): (parse_common_tsp_parameters, parse_tsp_sa_parameters),
+}
+
+
+def scheduler_enabled() -> bool:
+    return os.environ.get("VRPMS_SCHED", "on").lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Bucketing: which jobs may merge into one batched launch
+# ---------------------------------------------------------------------------
+
+# options that change the solver program/flow beyond what the stacked
+# launch models — any of them truthy forces the solo path
+_UNBATCHABLE_OPTS = (
+    "islands", "ils_rounds", "warm_start", "profile", "include_stats",
+    "local_search", "local_search_pool", "makespan_weight",
+)
+
+
+def _bucket_key(prep: Prepared):
+    """Shape-bucket key: equal keys guarantee everything one stacked
+    vmapped SA launch requires — identical padded array shapes,
+    identical Instance metadata, identical schedule (chains/iters) and
+    identical nominal deadline. None = never merge (solo path)."""
+    if prep is None or prep.trivial is not None or prep.inst is None:
+        return None
+    if prep.algorithm != "sa":
+        return None
+    o = prep.opts
+    if any(o.get(k) for k in _UNBATCHABLE_OPTS):
+        return None
+    try:
+        chains = int(o.get("population_size") or 128)
+        iters = int(o.get("iteration_count") or 5000)
+        time_limit = (
+            None if o.get("time_limit") is None else float(o["time_limit"])
+        )
+    except (TypeError, ValueError):
+        return None  # junk values: the solo path owns the error envelope
+    inst = prep.inst
+    return (
+        prep.problem,
+        "sa",
+        tuple(inst.durations.shape),
+        int(inst.n_vehicles),
+        bool(inst.has_tw),
+        bool(inst.het_fleet),
+        int(inst.td_rank),
+        float(inst.slice_minutes),
+        chains,
+        iters,
+        time_limit,
+    )
+
+
+def _backend_label(opts) -> str:
+    b = opts.get("backend")
+    if b not in ("cpu", "tpu"):
+        return "default"
+    try:
+        import jax
+
+        if b == jax.default_backend():
+            # an explicit backend equal to the process default must not
+            # mint a SECOND device-owning worker for the same physical
+            # device (that would reintroduce contention and split
+            # batchable same-shape traffic across two queues)
+            return "default"
+    except Exception:
+        pass
+    return b
+
+
+def _job_time_limit(opts):
+    try:
+        val = opts.get("time_limit")
+        return None if val is None else float(val)
+    except (TypeError, ValueError):
+        return None  # junk -> solver-side validation owns the envelope
+
+
+# ---------------------------------------------------------------------------
+# The runner (worker-thread side)
+# ---------------------------------------------------------------------------
+
+
+def _remaining_budget(job: Job):
+    """The job's deadline minus its queue wait (the worker already
+    expired jobs whose wait spent the whole budget; explicit 0 keeps its
+    stop-ASAP meaning)."""
+    tl = job.time_limit
+    if not tl or tl <= 0:
+        return None if tl is None else tl
+    return max(0.0, tl - (job.queue_wait_s or 0.0))
+
+
+def _run_solo(job: Job) -> None:
+    prep: Prepared = job.payload["prep"]
+    if job.time_limit and job.time_limit > 0:
+        prep.opts = dict(prep.opts, time_limit=_remaining_budget(job))
+    errors: list = []
+    token = set_request_id(job.request_id)
+    try:
+        job.result = solve_prepared(prep, errors)
+    except Exception as e:  # solve_prepared's own envelope paths missed
+        log_event(
+            "solve.exception",
+            algorithm=prep.algorithm,
+            error=f"{type(e).__name__}: {e}",
+            traceback=traceback.format_exc(),
+        )
+        errors += [
+            {"what": "Data error", "reason": f"{type(e).__name__}: {e}"}
+        ]
+    finally:
+        reset_request_id(token)
+    if job.result is None:
+        job.errors = errors or [
+            {"what": "Solver error", "reason": "solve returned no result"}
+        ]
+
+
+def _run_batched(jobs: list[Job]) -> None:
+    """One vmapped SA launch for same-bucket jobs, split back per job."""
+    from vrpms_tpu.sched.batch import solve_sa_batch
+    from vrpms_tpu.solvers import SAParams
+
+    preps = [j.payload["prep"] for j in jobs]
+    o = preps[0].opts
+    params = SAParams(
+        n_chains=int(o.get("population_size") or 128),
+        n_iters=int(o.get("iteration_count") or 5000),
+    )
+    seeds = [int(p.opts.get("seed") or 0) for p in preps]
+    deadline = None
+    if o.get("time_limit") is not None:
+        # every job shares the nominal limit (bucket key); the batch runs
+        # under the MINIMUM remaining budget so no merged job overshoots
+        deadline = min(_remaining_budget(j) for j in jobs)
+    t0 = time.perf_counter()
+    results = solve_sa_batch(
+        [p.inst for p in preps], seeds, params=params, deadline_s=deadline
+    )
+    wall = time.perf_counter() - t0
+    obs.SOLVE_SECONDS.labels(
+        problem=preps[0].problem, algorithm="sa"
+    ).observe(wall)
+    for job, prep, res in zip(jobs, preps, results):
+        errors: list = []
+        token = set_request_id(job.request_id)
+        try:
+            obs.SOLVE_EVALS.observe(float(res.evals))
+            if prep.problem == "vrp":
+                job.result = finish_vrp(prep, res, None, {}, errors)
+            else:
+                job.result = finish_tsp(prep, res, None, {}, errors)
+        except Exception as e:
+            log_event(
+                "solve.exception",
+                algorithm=prep.algorithm,
+                error=f"{type(e).__name__}: {e}",
+                traceback=traceback.format_exc(),
+            )
+            errors += [
+                {"what": "Data error", "reason": f"{type(e).__name__}: {e}"}
+            ]
+        finally:
+            reset_request_id(token)
+        if job.result is None:
+            job.errors = errors
+
+
+def _runner(jobs: list[Job]) -> None:
+    """Scheduler worker entry: batches of >1 are same-bucket by
+    construction (sched.batcher) and ride the vmapped launch; anything
+    else runs the exact single-request pipeline. A batched-path failure
+    falls back to solo solves so a vmap edge case degrades to PR-1
+    behavior instead of failing K requests."""
+    solo = list(jobs)
+    if len(jobs) > 1:
+        # the batch runs under the MINIMUM remaining budget: a job
+        # whose queue wait already ate most of its own timeLimit must
+        # not drag fresh batch-mates down to its sliver of budget —
+        # below half the nominal limit it solves alone (bounded loss:
+        # a merged job is cut by at most half its budget)
+        batch = [
+            j for j in jobs
+            if not (j.time_limit and j.time_limit > 0)
+            or _remaining_budget(j) >= 0.5 * j.time_limit
+        ]
+        if len(batch) > 1:
+            t0 = time.monotonic()
+            try:
+                _run_batched(batch)
+                batched = {id(j) for j in batch}
+                solo = [j for j in jobs if id(j) not in batched]
+            except Exception as e:
+                log_event(
+                    "sched.batch_fallback",
+                    error=f"{type(e).__name__}: {e}",
+                    traceback=traceback.format_exc(),
+                    batchSize=len(batch),
+                )
+                # the failed attempt consumed real wall clock: charge it
+                # to each job's wait so the solo retry's remaining budget
+                # (and the deadline contract) stays honest
+                burned = time.monotonic() - t0
+                for job in batch:
+                    job.result, job.errors = None, []
+                    if job.queue_wait_s is not None:
+                        job.queue_wait_s += burned
+    for job in solo:
+        _run_solo(job)
+
+
+# ---------------------------------------------------------------------------
+# Job records (persisted through the store seam)
+# ---------------------------------------------------------------------------
+
+def _job_record(job: Job) -> dict:
+    rec = {
+        "id": job.id,
+        "status": job.status,
+        "problem": job.payload.get("problem"),
+        "algorithm": job.payload.get("algorithm"),
+        "submittedAt": job.submitted_at,
+        "startedAt": job.started_at,
+        "finishedAt": job.finished_at,
+        "queueWaitMs": (
+            None
+            if job.queue_wait_s is None
+            else round(job.queue_wait_s * 1e3, 2)
+        ),
+        "batchSize": job.batch_size or None,
+        "requestId": job.request_id,
+    }
+    if job.status == DONE:
+        rec["message"] = job.result
+    if job.status == FAILED:
+        rec["errors"] = job.errors
+    return rec
+
+
+def _persist(job: Job) -> None:
+    """Write the job's current record (one blind upsert, no read guard:
+    the submit thread persists 'queued' BEFORE pushing the job, and
+    every later transition is written by the one worker thread in
+    order, so writes for a given job are strictly sequenced — a
+    read-then-write here would only add a store round trip per
+    transition to the device-owning loop)."""
+    db = job.payload.get("job_db")
+    if db is None:
+        return
+    db.save_job(job.id, _job_record(job))
+
+
+def _on_event(name: str, job: Job) -> None:
+    """Scheduler observer: metrics + structured log + store record."""
+    if name == "started":
+        if job.queue_wait_s is not None:
+            obs.SCHED_QUEUE_WAIT.observe(job.queue_wait_s)
+        obs.SCHED_BATCH_SIZE.observe(job.batch_size or 1)
+    elif name == "expired":
+        obs.SCHED_REJECTS.labels(reason="deadline_spent").inc()
+        obs.JOBS_TOTAL.labels(outcome="failed").inc()
+    elif name == "drained":
+        obs.SCHED_REJECTS.labels(reason="shutdown").inc()
+        obs.JOBS_TOTAL.labels(outcome="failed").inc()
+    elif name in ("done", "failed"):
+        obs.JOBS_TOTAL.labels(outcome=name).inc()
+    log_event(
+        f"job.{name}",
+        jobId=job.id,
+        requestId=job.request_id,
+        status=job.status,
+        batchSize=job.batch_size or None,
+        queueWaitMs=(
+            None
+            if job.queue_wait_s is None
+            else round(job.queue_wait_s * 1e3, 2)
+        ),
+    )
+    if name != "queued":  # queued is persisted synchronously at submit
+        _persist(job)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler singleton
+# ---------------------------------------------------------------------------
+
+_scheduler: Scheduler | None = None
+_sched_lock = threading.Lock()
+
+
+def _queue_depths() -> dict:
+    s = _scheduler
+    return s.queues() if s is not None else {}
+
+
+def get_scheduler() -> Scheduler:
+    global _scheduler
+    with _sched_lock:
+        if _scheduler is None:
+            _scheduler = Scheduler(
+                _runner,
+                queue_limit=int(os.environ.get("VRPMS_SCHED_QUEUE", "64")),
+                window_s=float(
+                    os.environ.get("VRPMS_SCHED_WINDOW_MS", "10")
+                ) / 1e3,
+                max_batch=int(os.environ.get("VRPMS_SCHED_MAX_BATCH", "16")),
+                on_event=_on_event,
+            )
+            obs.set_queue_depth_provider(_queue_depths)
+        return _scheduler
+
+
+def shutdown_scheduler() -> int:
+    """Drain-on-shutdown: fail queued jobs cleanly, stop workers, and
+    forget the singleton (a later submit builds a fresh scheduler —
+    what tests and long-lived embedding processes need)."""
+    global _scheduler
+    with _sched_lock:
+        s, _scheduler = _scheduler, None
+    if s is None:
+        return 0
+    drained = s.shutdown()
+    if drained:
+        log_event("sched.drained", jobs=drained)
+    return drained
+
+
+# ---------------------------------------------------------------------------
+# Submit-and-wait (the synchronous endpoints' path through the scheduler)
+# ---------------------------------------------------------------------------
+
+
+def scheduler_solve(problem, algorithm, params, opts, algo_params,
+                    locations, matrix, errors, database):
+    """Solve via the scheduler, blocking until the job completes.
+
+    The synchronous endpoints' contract keeper: same envelopes as the
+    old inline run_vrp/run_tsp call, but the device work runs on the
+    scheduler's worker (merged with concurrent same-shape requests when
+    possible). Raises QueueFull — the handler turns it into 429 +
+    Retry-After. VRPMS_SCHED=off short-circuits to the inline path.
+    """
+    if not scheduler_enabled():
+        run = run_vrp if problem == "vrp" else run_tsp
+        return run(algorithm, params, opts, algo_params, locations, matrix,
+                   errors, database=database)
+    prep = prepare_request(problem, algorithm, params, opts, algo_params,
+                           locations, matrix, errors, database)
+    if prep is None or errors:
+        return None
+    if prep.trivial is not None:
+        return prep.trivial
+    job = Job(
+        payload={"prep": prep, "problem": problem, "algorithm": algorithm},
+        bucket=_bucket_key(prep),
+        time_limit=_job_time_limit(opts),
+        request_id=current_request_id(),
+    )
+    get_scheduler().submit(job, backend=_backend_label(opts))
+    job.wait()
+    if job.status == FAILED or job.result is None:
+        errors += job.errors or [
+            {"what": "Solver error", "reason": "job failed without detail"}
+        ]
+        return None
+    return job.result
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+def _respond(handler, code: int, payload: dict) -> None:
+    rid = getattr(handler, "_request_id", None)
+    if rid is not None and "requestId" not in payload:
+        payload = dict(payload, requestId=rid)
+    body = json.dumps(payload).encode("utf-8")
+    handler.send_response(code)
+    handler.send_header("Content-type", "application/json")
+    send_static_headers(handler)
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """POST /api/jobs — submit a solve job, reply with its id at once."""
+
+    algorithm = ""  # request-counter label (filled per request below)
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-type", "text/plain")
+        self.end_headers()
+        self.wfile.write(
+            b"Hi, this is the async jobs endpoint: POST a solve request "
+            b"with 'problem' and 'algorithm', poll GET /api/jobs/{id}"
+        )
+
+    def do_POST(self):
+        self._obs_t0 = time.perf_counter()
+        self._request_id = new_request_id()
+        token = set_request_id(self._request_id)
+        try:
+            self._submit()
+        finally:
+            reset_request_id(token)
+
+    def _submit(self):
+        content = read_json_body(self)
+        if content is None:
+            return
+
+        problem = content.get("problem")
+        algorithm = content.get("algorithm")
+        errors: list = []
+        if problem not in ("vrp", "tsp"):
+            errors += [{
+                "what": "Missing parameter",
+                "reason": "'problem' must be 'vrp' or 'tsp'",
+            }]
+        if algorithm not in ("ga", "sa", "aco", "bf"):
+            errors += [{
+                "what": "Missing parameter",
+                "reason": "'algorithm' must be one of ga|sa|aco|bf",
+            }]
+        if errors:
+            fail(self, errors)
+            return
+        self.algorithm = algorithm  # request-counter label parity
+        self.problem = problem
+
+        parse_common, parse_algo = _PARSERS[(problem, algorithm)]
+        params = parse_common(content, errors)
+        algo_params = parse_algo(content, errors) if parse_algo else {}
+        opts = parse_solver_options(content, errors)
+        if errors:
+            fail(self, errors)
+            return
+        try:
+            database = store.get_database(problem, params["auth"])
+        except Exception as e:
+            fail(self, [{"what": "Database error", "reason": str(e)}])
+            return
+        locations = database.get_locations_by_id(params["locations_key"], errors)
+        durations = database.get_durations_by_id(params["durations_key"], errors)
+        if errors:
+            fail(self, errors)
+            return
+        prep = prepare_request(problem, algorithm, params, opts, algo_params,
+                               locations, durations, errors, database)
+        if prep is None or errors:
+            fail(self, errors)
+            return
+
+        job = Job(
+            payload={
+                "prep": prep,
+                "problem": problem,
+                "algorithm": algorithm,
+                "job_db": store.get_database(problem, None),
+            },
+            bucket=_bucket_key(prep),
+            time_limit=_job_time_limit(opts),
+            request_id=self._request_id,
+        )
+        if prep.trivial is not None:
+            # nothing to schedule: the job is born done
+            job.result = prep.trivial
+            job.finish(DONE)
+            _persist(job)
+            obs.JOBS_TOTAL.labels(outcome="done").inc()
+            _respond(self, 202, {
+                "success": True, "jobId": job.id, "status": job.status,
+            })
+            return
+        _persist(job)  # queued record first: a poll can never 404 a
+        # job whose id was already returned
+        try:
+            get_scheduler().submit(job, backend=_backend_label(opts))
+        except QueueFull as e:
+            obs.SCHED_REJECTS.labels(reason="queue_full").inc()
+            obs.JOBS_TOTAL.labels(outcome="failed").inc()
+            job.errors = [{
+                "what": "Too busy",
+                "reason": "solver admission queue was full at submit",
+            }]
+            job.finish(FAILED)
+            _persist(job)
+            too_busy(self, e.retry_after_s)
+            return
+        _respond(self, 202, {
+            "success": True, "jobId": job.id, "status": job.status,
+        })
+
+
+class JobStatusHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/jobs/{id} — poll a job's lifecycle record."""
+
+    def do_GET(self):
+        self._obs_t0 = time.perf_counter()
+        self._request_id = new_request_id()
+        token = set_request_id(self._request_id)
+        try:
+            self._status()
+        finally:
+            reset_request_id(token)
+
+    def _status(self):
+        job_id = self.path.split("?", 1)[0].rstrip("/").rsplit("/", 1)[-1]
+        errors: list = []
+        try:
+            db = store.get_database("vrp", None)
+            record = db.get_job(job_id, errors)
+        except Exception as e:
+            fail(self, [{"what": "Database error", "reason": str(e)}])
+            return
+        if errors:
+            fail(self, errors)
+            return
+        if record is None:
+            self._obs_errors = ["Not found"]
+            _respond(self, 404, {
+                "success": False,
+                "errors": [{
+                    "what": "Not found",
+                    "reason": f"no job with id {job_id!r}",
+                }],
+            })
+            return
+        _respond(self, 200, {"success": True, "job": record})

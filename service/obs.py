@@ -63,6 +63,32 @@ BODY_BYTES = REGISTRY.histogram(
     "POST request body size, bytes",
     buckets=(256, 1024, 4096, 16384, 65536, 262144, 1048576, 8388608),
 )
+SCHED_QUEUE_DEPTH = REGISTRY.gauge(
+    "vrpms_sched_queue_depth",
+    "Jobs waiting in the scheduler admission queue, by backend",
+    labels=("backend",),
+)
+SCHED_QUEUE_WAIT = REGISTRY.histogram(
+    "vrpms_sched_queue_wait_seconds",
+    "Time jobs spent queued before their solve started, seconds",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0),
+)
+SCHED_BATCH_SIZE = REGISTRY.histogram(
+    "vrpms_sched_batch_size",
+    "Jobs merged into one scheduler launch (1 = solo)",
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+SCHED_REJECTS = REGISTRY.counter(
+    "vrpms_sched_rejected_total",
+    "Jobs the scheduler refused or failed without solving, by reason "
+    "(queue_full|deadline_spent|shutdown)",
+    labels=("reason",),
+)
+JOBS_TOTAL = REGISTRY.counter(
+    "vrpms_jobs_total",
+    "Scheduler jobs reaching a terminal state, by outcome (done|failed)",
+    labels=("outcome",),
+)
 UPTIME = REGISTRY.gauge(
     "vrpms_uptime_seconds", "Seconds since service process start"
 )
@@ -91,10 +117,26 @@ def set_compile_cache(cache_dir) -> None:
     _compile_cache = "on" if cache_dir else "off"
 
 
+_queue_depths = None
+
+
+def set_queue_depth_provider(fn) -> None:
+    """Register a callable returning {backend: depth} — the scheduler
+    (service.jobs) provides it once constructed; refreshed per scrape."""
+    global _queue_depths
+    _queue_depths = fn
+
+
 def refresh_gauges() -> None:
     """Scrape-time gauge values. jax is imported lazily and guarded:
     /metrics must answer even if the backend is broken."""
     UPTIME.set(time.time() - _START)
+    if _queue_depths is not None:
+        try:
+            for backend, depth in _queue_depths().items():
+                SCHED_QUEUE_DEPTH.labels(backend=backend).set(depth)
+        except Exception:
+            pass
     try:
         import jax
 
@@ -107,6 +149,9 @@ def refresh_gauges() -> None:
 
 
 def route_label(path: str) -> str:
+    if path.startswith("/api/jobs/"):
+        # per-id status polls must not mint a label series per job
+        return "/api/jobs/{id}"
     return path if path in KNOWN_ROUTES else "<unmatched>"
 
 

@@ -45,6 +45,42 @@ class Database:
     def _upsert_warmstart(self, owner: str, name, state: dict):
         raise NotImplementedError
 
+    def _fetch_job(self, job_id: str):
+        raise NotImplementedError
+
+    def _upsert_job(self, job_id: str, record: dict):
+        raise NotImplementedError
+
+    # -- async job records (scheduler extension) ----------------------------
+    # The jobs API (service.jobs) persists each job's lifecycle record
+    # through this seam so `GET /api/jobs/{id}` answers from whichever
+    # backend is configured — in-process memory for tests/local, Supabase
+    # for the hosted deployment (store/schema.sql `jobs`). Job ids are
+    # unguessable uuid4 hex, which is the (reference-parity) access
+    # control: like unauthenticated solves, job records are not owner-
+    # scoped. Writes are best-effort with a stderr warning (a telemetry/
+    # bookkeeping failure must never fail the solve itself); reads
+    # surface errors into the caller's envelope list.
+    def save_job(self, job_id: str, record: dict) -> bool:
+        try:
+            self._upsert_job(job_id, record)
+            return True
+        except Exception as exc:
+            print(
+                f"[store] job write failed ({type(exc).__name__}: {exc}); "
+                "job status may be stale — check store/schema.sql",
+                file=sys.stderr,
+            )
+            return False
+
+    def get_job(self, job_id: str, errors) -> dict | None:
+        try:
+            row = self._fetch_job(job_id)
+            return None if row is None else row.get("record")
+        except Exception as exc:
+            errors += [{"what": "Database read error", "reason": str(exc)}]
+            return None
+
     # -- warm-start checkpoints (framework extension) -----------------------
     # The reference has no computation checkpointing; its closest analog is
     # the ignored/completed dynamic re-solve inputs (SURVEY.md §5

@@ -28,6 +28,7 @@ _tables: dict = {
     "durations": {},
     "solutions": [],
     "warmstarts": {},
+    "jobs": {},
 }
 _tokens: dict = {}
 _fixtures_loaded = False
@@ -39,6 +40,7 @@ def reset():
         _tables["durations"].clear()
         _tables["solutions"].clear()
         _tables["warmstarts"].clear()
+        _tables["jobs"].clear()
         _tokens.clear()
         global _fixtures_loaded
         _fixtures_loaded = False
@@ -102,6 +104,23 @@ class _InMemoryMixin(Database):
 
     def _fetch_warmstart(self, owner, name):
         return _tables["warmstarts"].get((owner, str(name)))
+
+    # retained job records: dicts preserve insertion order, so eviction
+    # below drops the OLDEST job first. Bounds the jobs table for a
+    # long-lived service (every async request writes a record holding
+    # its full result; unbounded it grows with request count forever).
+    MAX_JOBS = 10_000
+
+    def _fetch_job(self, job_id):
+        return _tables["jobs"].get(str(job_id))
+
+    def _upsert_job(self, job_id, record: dict):
+        with _lock:
+            jobs = _tables["jobs"]
+            jobs.pop(str(job_id), None)  # refresh insertion order
+            jobs[str(job_id)] = {"id": job_id, "record": record}
+            while len(jobs) > self.MAX_JOBS:
+                jobs.pop(next(iter(jobs)))
 
     def _upsert_warmstart(self, owner, name, state: dict):
         with _lock:

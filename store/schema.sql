@@ -40,3 +40,17 @@ create table if not exists warmstarts (
   updated_at timestamptz not null default now(),
   primary key (owner, name)         -- upsert target: on_conflict="owner,name"
 );
+
+-- Async solve jobs (service.jobs): one lifecycle record per jobId, the
+-- whole record as one jsonb document (status, timings, result/errors —
+-- the shape service.jobs._job_record writes). Ids are unguessable uuid4
+-- hex; like unauthenticated solves, records are not owner-scoped.
+-- Records accumulate with request volume: pair with a retention job,
+-- e.g. pg_cron:  delete from jobs where updated_at < now() - '7 days';
+-- (the in-memory backend bounds itself at store.memory MAX_JOBS).
+create table if not exists jobs (
+  id text primary key,              -- upsert target: on_conflict="id"
+  record jsonb not null,
+  updated_at timestamptz not null default now()
+);
+create index if not exists jobs_updated_at on jobs (updated_at);

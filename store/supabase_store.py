@@ -77,6 +77,21 @@ class _SupabaseMixin(Database):
             .execute()
         )
 
+    def _fetch_job(self, job_id):
+        result = (
+            self.client.table("jobs").select("*").eq("id", job_id).execute()
+        )
+        if not len(result.data):
+            return None
+        return result.data[0]
+
+    def _upsert_job(self, job_id, record: dict):
+        return (
+            self.client.table("jobs")
+            .upsert({"id": job_id, "record": record}, on_conflict="id")
+            .execute()
+        )
+
 
 class SupabaseDatabaseVRP(_SupabaseMixin, DatabaseVRP):
     pass

@@ -1,0 +1,314 @@
+"""End-to-end async jobs API tests (submit -> poll -> done) over real
+HTTP against the in-memory store, under JAX_PLATFORMS=cpu.
+
+Covers the ISSUE-2 acceptance criteria: the async lifecycle against the
+store seam, deadline-spent-in-queue expiry, concurrent mixed-shape
+submits (with same-shape jobs actually merging into one batched
+launch), queue-full backpressure as 429 + Retry-After (never a hung
+connection), and drain-on-shutdown failing queued jobs cleanly.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import store.memory as mem
+from service import jobs as jobs_mod
+from service.app import serve
+
+
+@pytest.fixture(scope="module")
+def server():
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    # a fresh scheduler for this module (another test module may have
+    # built one under different env)
+    jobs_mod.shutdown_scheduler()
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    jobs_mod.shutdown_scheduler()
+
+
+@pytest.fixture(autouse=True)
+def seeded():
+    mem.reset()
+    rng = np.random.default_rng(11)
+    for key, n in (("locs7", 7), ("locs10", 10)):
+        pts = rng.uniform(0, 100, size=(n, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        mem.seed_locations(
+            key, [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+        )
+        mem.seed_durations(key, d.tolist())
+    yield
+
+
+def post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def job_body(key="locs7", n=7, **over):
+    body = {
+        "problem": "vrp",
+        "algorithm": "sa",
+        "solutionName": f"job-{key}",
+        "solutionDescription": "t",
+        "locationsKey": key,
+        "durationsKey": key,
+        "capacities": [2 * n] * 3,
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": 1,
+        "iterationCount": 300,
+        "populationSize": 16,
+    }
+    body.update(over)
+    return body
+
+
+def poll_until(base, job_id, terminal=("done", "failed"), timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, resp = get(base, f"/api/jobs/{job_id}")
+        assert status == 200, resp
+        job = resp["job"]
+        if job["status"] in terminal:
+            return job
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never reached {terminal}")
+
+
+class TestLifecycle:
+    def test_submit_poll_done(self, server):
+        status, resp, _ = post(server, "/api/jobs", job_body())
+        assert status == 202, resp
+        assert resp["success"] is True
+        job_id = resp["jobId"]
+        assert resp["status"] in ("queued", "running", "done")
+        job = poll_until(server, job_id)
+        assert job["status"] == "done", job
+        assert job["problem"] == "vrp" and job["algorithm"] == "sa"
+        msg = job["message"]
+        visited = sorted(
+            c for v in msg["vehicles"] for c in v["tour"][1:-1]
+        )
+        assert visited == [1, 2, 3, 4, 5, 6]
+        # lifecycle bookkeeping is part of the record
+        assert job["queueWaitMs"] is not None and job["queueWaitMs"] >= 0
+        assert job["batchSize"] >= 1
+        assert job["finishedAt"] >= job["startedAt"] >= job["submittedAt"]
+        assert job["requestId"]
+
+    def test_async_bf_carries_certificate(self, server):
+        status, resp, _ = post(
+            server, "/api/jobs", job_body(algorithm="bf")
+        )
+        assert status == 202, resp
+        job = poll_until(server, resp["jobId"])
+        assert job["status"] == "done", job
+        assert job["message"]["exact"]["proven"] is True
+
+    def test_bad_submit_is_400(self, server):
+        status, resp, _ = post(server, "/api/jobs", {"problem": "vrp"})
+        assert status == 400
+        assert resp["success"] is False
+        reasons = {e["reason"] for e in resp["errors"]}
+        assert "'algorithm' must be one of ga|sa|aco|bf" in reasons
+        # a parse failure inside a valid problem/algorithm pair
+        status, resp, _ = post(
+            server, "/api/jobs", {"problem": "vrp", "algorithm": "sa"}
+        )
+        assert status == 400
+        assert any(
+            "solutionName" in e["reason"] for e in resp["errors"]
+        )
+
+    def test_unknown_job_is_404(self, server):
+        status, resp = get(server, "/api/jobs/no-such-job")
+        assert status == 404
+        assert resp["success"] is False
+        assert resp["errors"][0]["what"] == "Not found"
+
+    def test_failed_job_reports_errors(self, server):
+        # nonsense solver option passes parsing but fails in the solver
+        # dispatch — the job must land `failed` with the envelope entry
+        status, resp, _ = post(
+            server, "/api/jobs", job_body(ilsRounds=-3)
+        )
+        assert status == 202, resp
+        job = poll_until(server, resp["jobId"])
+        assert job["status"] == "failed", job
+        assert any(
+            "non-negative integer" in e["reason"] for e in job["errors"]
+        )
+
+
+class TestDeadlineInQueue:
+    def test_deadline_spent_in_queue_fails_cleanly(self, server):
+        # occupy the worker with a ~2s solve, then submit a job whose
+        # whole budget is 50ms: its queue wait alone spends the budget,
+        # so it must FAIL without ever starting
+        blocker = job_body(
+            iterationCount=500_000, populationSize=64, timeLimit=2,
+            seed=9,
+        )
+        status, resp, _ = post(server, "/api/jobs", blocker)
+        assert status == 202, resp
+        blocker_id = resp["jobId"]
+        time.sleep(0.3)  # let the worker pick the blocker up
+        status, resp, _ = post(
+            server, "/api/jobs", job_body(timeLimit=0.05, seed=10)
+        )
+        assert status == 202, resp
+        doomed = poll_until(server, resp["jobId"])
+        assert doomed["status"] == "failed", doomed
+        assert doomed["errors"][0]["what"] == "Deadline exceeded"
+        assert "queue" in doomed["errors"][0]["reason"]
+        # the blocker itself completes fine
+        assert poll_until(server, blocker_id)["status"] == "done"
+
+
+class TestConcurrentMixedShapes:
+    def test_mixed_shape_submits_all_complete_and_batch(self, server):
+        # 8 concurrent submits across two shapes: every job completes
+        # with its own instance's customers, and same-shape jobs that
+        # queued behind the busy worker merge into batched launches
+        specs = [("locs7", 7), ("locs10", 10)] * 4
+        results = [None] * len(specs)
+
+        def submit(i):
+            key, n = specs[i]
+            results[i] = post(
+                server, "/api/jobs", job_body(key=key, n=n, seed=20 + i)
+            )
+
+        threads = [
+            threading.Thread(target=submit, args=(i,))
+            for i in range(len(specs))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+
+        batch_sizes = []
+        for i, (status, resp, _) in enumerate(results):
+            assert status == 202, resp
+            job = poll_until(server, resp["jobId"])
+            assert job["status"] == "done", job
+            n = specs[i][1]
+            visited = sorted(
+                c
+                for v in job["message"]["vehicles"]
+                for c in v["tour"][1:-1]
+            )
+            assert visited == list(range(1, n)), (i, job)
+            batch_sizes.append(job["batchSize"])
+        # the burst queued while the worker was busy, so at least one
+        # same-shape pair must have merged into one launch
+        assert max(batch_sizes) >= 2, batch_sizes
+
+
+class TestBackpressure:
+    @pytest.fixture()
+    def tiny_queue(self):
+        import os
+
+        jobs_mod.shutdown_scheduler()
+        os.environ["VRPMS_SCHED_QUEUE"] = "2"
+        yield
+        os.environ.pop("VRPMS_SCHED_QUEUE", None)
+        jobs_mod.shutdown_scheduler()
+
+    def test_queue_full_is_429_with_retry_after(self, server, tiny_queue):
+        # worker busy on a ~3s blocker, 2-slot queue filled, then both
+        # the async submit and the sync endpoint must shed with 429 +
+        # Retry-After immediately (not hang behind the queue)
+        status, resp, _ = post(
+            server,
+            "/api/jobs",
+            job_body(iterationCount=500_000, populationSize=64,
+                     timeLimit=3, seed=30),
+        )
+        assert status == 202, resp
+        time.sleep(0.3)  # blocker picked up; queue now empty
+        for i in (1, 2):
+            status, resp, _ = post(
+                server, "/api/jobs",
+                job_body(seed=30 + i, iterationCount=100 + i),
+            )
+            assert status == 202, resp
+        t0 = time.monotonic()
+        status, resp, headers = post(
+            server, "/api/jobs", job_body(seed=40)
+        )
+        assert status == 429, resp
+        assert time.monotonic() - t0 < 5.0  # shed, not queued-and-hung
+        assert resp["success"] is False
+        assert resp["errors"][0]["what"] == "Too busy"
+        assert int(headers["Retry-After"]) >= 1
+        # the synchronous endpoints shed identically
+        sync_body = job_body(seed=41)
+        del sync_body["problem"], sync_body["algorithm"]
+        status, resp, headers = post(server, "/api/vrp/sa", sync_body)
+        assert status == 429, resp
+        assert "Retry-After" in headers
+
+
+class TestDrainOnShutdown:
+    def test_shutdown_fails_queued_jobs_cleanly(self, server):
+        status, resp, _ = post(
+            server,
+            "/api/jobs",
+            job_body(iterationCount=500_000, populationSize=64,
+                     timeLimit=2, seed=50),
+        )
+        assert status == 202, resp
+        time.sleep(0.3)
+        queued = []
+        for i in range(2):
+            status, resp, _ = post(
+                server, "/api/jobs", job_body(seed=60 + i)
+            )
+            assert status == 202, resp
+            queued.append(resp["jobId"])
+        drained = jobs_mod.shutdown_scheduler()
+        assert drained >= 1
+        for job_id in queued:
+            job = poll_until(server, job_id, timeout=10.0)
+            assert job["status"] == "failed", job
+            assert job["errors"][0]["what"] == "Service unavailable"
+        # the NEXT request lazily builds a fresh scheduler and serves
+        status, resp, _ = post(server, "/api/jobs", job_body(seed=70))
+        assert status == 202, resp
+        assert poll_until(server, resp["jobId"])["status"] == "done"

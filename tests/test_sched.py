@@ -1,0 +1,314 @@
+"""Unit tests for the scheduler subsystem (vrpms_tpu.sched).
+
+Queue admission/backpressure, bucket-aware gathering, worker lifecycle
+(deadline-spent expiry, drain-on-shutdown), the service-side bucket key,
+and the batched-launch split/merge against solo solves — all under
+JAX_PLATFORMS=cpu, no HTTP involved (tests/test_jobs.py covers the
+end-to-end surface).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vrpms_tpu.sched import (
+    FAILED,
+    Job,
+    JobQueue,
+    QueueFull,
+    Scheduler,
+    expired,
+    gather_batch,
+)
+
+
+def make_job(bucket=None, time_limit=None, payload=None):
+    return Job(payload=payload or {}, bucket=bucket, time_limit=time_limit)
+
+
+class TestJobQueue:
+    def test_fifo_and_bounded(self):
+        q = JobQueue(limit=2)
+        a, b = make_job(), make_job()
+        q.push(a)
+        q.push(b)
+        with pytest.raises(QueueFull) as e:
+            q.push(make_job())
+        assert e.value.retry_after_s >= 1.0
+        assert q.pop(0.1) is a
+        assert q.pop(0.1) is b
+        assert q.pop(0.01) is None
+
+    def test_take_matching_extracts_bucket_preserving_order(self):
+        q = JobQueue(limit=10)
+        jobs = [
+            make_job(bucket="x"),
+            make_job(bucket="y"),
+            make_job(bucket="x"),
+            make_job(bucket="z"),
+        ]
+        for j in jobs:
+            q.push(j)
+        taken = q.take_matching("x", max_n=8)
+        assert taken == [jobs[0], jobs[2]]
+        # the skipped jobs keep FIFO order
+        assert q.pop(0.1) is jobs[1]
+        assert q.pop(0.1) is jobs[3]
+        # None never matches
+        q.push(make_job(bucket=None))
+        assert q.take_matching(None, max_n=8) == []
+
+    def test_drain_closes_admission(self):
+        q = JobQueue(limit=4)
+        q.push(make_job())
+        drained = q.drain()
+        assert len(drained) == 1
+        with pytest.raises(QueueFull):
+            q.push(make_job())
+        assert q.pop(0.01) is None
+
+
+class TestGather:
+    def test_gathers_same_bucket_within_window(self):
+        q = JobQueue(limit=10)
+        first = make_job(bucket="a")
+        matching = [make_job(bucket="a") for _ in range(2)]
+        other = make_job(bucket="b")
+        for j in matching + [other]:
+            q.push(j)
+        batch = gather_batch(q, first, window_s=0.05, max_batch=8)
+        assert batch == [first] + matching
+        assert q.pop(0.1) is other
+
+    def test_solo_bucket_none_returns_immediately(self):
+        q = JobQueue(limit=10)
+        q.push(make_job(bucket="a"))
+        t0 = time.monotonic()
+        batch = gather_batch(q, make_job(bucket=None), window_s=0.5, max_batch=8)
+        assert len(batch) == 1
+        assert time.monotonic() - t0 < 0.2  # no gather wait paid
+
+    def test_max_batch_caps_gather(self):
+        q = JobQueue(limit=10)
+        first = make_job(bucket="a")
+        for _ in range(5):
+            q.push(make_job(bucket="a"))
+        batch = gather_batch(q, first, window_s=0.05, max_batch=3)
+        assert len(batch) == 3
+        assert len(q) == 3
+
+
+class TestExpiry:
+    def test_only_positive_limits_expire(self):
+        never = make_job(time_limit=None)
+        stop_asap = make_job(time_limit=0)
+        tight = make_job(time_limit=0.001)
+        time.sleep(0.01)
+        assert not expired(never)
+        assert not expired(stop_asap)  # explicit 0 keeps stop-ASAP meaning
+        assert expired(tight)
+
+
+class TestScheduler:
+    def test_merges_same_bucket_and_completes(self):
+        seen_batches = []
+        release = threading.Event()
+
+        def runner(jobs):
+            if jobs[0].payload.get("block"):
+                release.wait(5.0)
+            seen_batches.append(list(jobs))
+            for j in jobs:
+                j.result = {"ok": j.id}
+
+        s = Scheduler(runner, queue_limit=16, window_s=0.02, max_batch=8)
+        try:
+            blocker = Job(payload={"block": True}, bucket=None)
+            s.submit(blocker)
+            batch_jobs = [make_job(bucket="same") for _ in range(3)]
+            for j in batch_jobs:
+                s.submit(j)
+            release.set()
+            for j in [blocker] + batch_jobs:
+                assert j.wait(10.0), "job did not complete"
+                assert j.status == "done"
+                assert j.result == {"ok": j.id}
+            # the three same-bucket jobs ran as ONE batch
+            assert [len(b) for b in seen_batches] == [1, 3]
+            assert batch_jobs[0].batch_size == 3
+        finally:
+            s.shutdown()
+
+    def test_deadline_spent_in_queue_fails_before_running(self):
+        ran = []
+        release = threading.Event()
+
+        def runner(jobs):
+            if jobs[0].payload.get("block"):
+                release.wait(5.0)
+            ran.extend(j.id for j in jobs)
+            for j in jobs:
+                j.result = {}
+
+        s = Scheduler(runner, queue_limit=16, window_s=0.0, max_batch=1)
+        try:
+            s.submit(Job(payload={"block": True}))
+            doomed = make_job(time_limit=0.05)
+            unbounded = make_job(time_limit=None)
+            s.submit(doomed)
+            s.submit(unbounded)
+            time.sleep(0.2)  # let the doomed job's budget drain in queue
+            release.set()
+            assert doomed.wait(10.0) and unbounded.wait(10.0)
+            assert doomed.status == FAILED
+            assert doomed.id not in ran  # never started
+            assert "Deadline exceeded" in doomed.errors[0]["what"]
+            assert doomed.queue_wait_s >= 0.05
+            assert unbounded.status == "done"
+        finally:
+            s.shutdown()
+
+    def test_runner_exception_fails_batch_cleanly(self):
+        def runner(jobs):
+            raise RuntimeError("kaboom")
+
+        s = Scheduler(runner, queue_limit=4, window_s=0.0, max_batch=1)
+        try:
+            job = make_job()
+            s.submit(job)
+            assert job.wait(10.0)
+            assert job.status == FAILED
+            assert "kaboom" in job.errors[0]["reason"]
+        finally:
+            s.shutdown()
+
+    def test_shutdown_drains_queued_jobs(self):
+        release = threading.Event()
+
+        def runner(jobs):
+            release.wait(5.0)
+            for j in jobs:
+                j.result = {}
+
+        s = Scheduler(runner, queue_limit=16, window_s=0.0, max_batch=1)
+        s.submit(Job(payload={}))  # occupies the worker
+        queued = [make_job() for _ in range(3)]
+        for j in queued:
+            s.submit(j)
+        time.sleep(0.05)
+        release.set()
+        drained = s.shutdown()
+        assert drained >= 1
+        for j in queued:
+            assert j.wait(1.0), "drained job left hanging"
+        assert all(
+            j.status in (FAILED, "done") for j in queued
+        )
+        drained_jobs = [j for j in queued if j.status == FAILED]
+        assert drained_jobs, "no queued job was drained"
+        assert all(
+            "shutting down" in j.errors[0]["reason"] for j in drained_jobs
+        )
+        # admission is closed after shutdown
+        with pytest.raises(QueueFull):
+            s.submit(make_job())
+
+
+def _prep(algorithm="sa", n=7, seed=0, **opts):
+    """A service Prepared via the real prepare path (bucket-key tests)."""
+    from service.parameters import parse_solver_options
+    from service.solve import prepare_vrp
+
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    locations = [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+    params = {
+        "name": "t", "auth": None, "description": "",
+        "capacities": [20, 20], "start_times": [0, 0],
+        "ignored_customers": [], "completed_customers": [],
+    }
+    errors: list = []
+    parsed = parse_solver_options(dict(opts), errors)
+    assert not errors
+    prep = prepare_vrp(
+        algorithm, params, parsed, {}, locations, d.tolist(), errors, None
+    )
+    assert prep is not None and not errors
+    return prep
+
+
+class TestBucketKey:
+    def test_same_shape_same_key(self):
+        from service.jobs import _bucket_key
+
+        k1 = _bucket_key(_prep(seed=1))
+        k2 = _bucket_key(_prep(seed=2))
+        assert k1 is not None and k1 == k2
+
+    def test_shape_algorithm_and_options_split_buckets(self):
+        from service.jobs import _bucket_key
+
+        base = _bucket_key(_prep())
+        assert _bucket_key(_prep(n=9)) != base
+        assert _bucket_key(_prep(algorithm="ga")) is None
+        assert _bucket_key(_prep(iterationCount=99)) != base
+        assert _bucket_key(_prep(populationSize=32)) != base
+        assert _bucket_key(_prep(timeLimit=5)) != base
+        # program-changing options force the solo path entirely
+        assert _bucket_key(_prep(includeStats=True)) is None
+        assert _bucket_key(_prep(islands=2)) is None
+        assert _bucket_key(_prep(localSearch=True)) is None
+
+
+class TestBatchSplitMerge:
+    def test_batched_results_match_their_own_instances(self):
+        """K same-shape instances solved in one vmapped launch: each
+        returned tour must visit its OWN instance's customers and price
+        to within noise of a solo solve of that instance (tiny instances
+        converge to the optimum either way — a cross-instance mixup
+        would show up as a wildly wrong cost)."""
+        from vrpms_tpu.core import make_instance
+        from vrpms_tpu.core.encoding import routes_from_giant
+        from vrpms_tpu.sched.batch import solve_sa_batch
+        from vrpms_tpu.solvers import SAParams, solve_sa
+
+        rng = np.random.default_rng(3)
+        insts = []
+        for _ in range(3):
+            pts = rng.uniform(0, 100, size=(7, 2))
+            d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+            insts.append(
+                make_instance(d, demands=[0] + [2] * 6, capacities=[8, 8])
+            )
+        p = SAParams(n_chains=32, n_iters=400)
+        batched = solve_sa_batch(insts, [1, 2, 3], params=p)
+        assert len(batched) == 3
+        for i, res in enumerate(batched):
+            visited = sorted(
+                c for r in routes_from_giant(res.giant) for c in r
+            )
+            assert visited == [1, 2, 3, 4, 5, 6]
+            solo = solve_sa(insts[i], key=1, params=p)
+            assert float(res.cost) <= float(solo.cost) * 1.1 + 1e-6
+
+    def test_batch_pads_to_power_of_two(self):
+        """3 instances pad to 4 internally; the padded clone's result is
+        discarded and exactly 3 results come back."""
+        from vrpms_tpu.core import make_instance
+        from vrpms_tpu.sched.batch import solve_sa_batch
+        from vrpms_tpu.solvers import SAParams
+
+        rng = np.random.default_rng(4)
+        insts = []
+        for _ in range(3):
+            pts = rng.uniform(0, 100, size=(6, 2))
+            d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+            insts.append(make_instance(d, demands=[0] + [1] * 5,
+                                       capacities=[9]))
+        res = solve_sa_batch(
+            insts, [5, 6, 7], params=SAParams(n_chains=32, n_iters=200)
+        )
+        assert len(res) == 3

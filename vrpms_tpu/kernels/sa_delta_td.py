@@ -43,9 +43,12 @@ surrogate part, resynced at launch boundaries:
 
 Gates (sa._delta_supported): factorized TD (td_rank in 1..2), every
 slice symmetric (reverse reuses interior basis legs), no TW, no
-makespan, uniform fleet + scalable demands, n_nodes <= 512 and ids in
-one bf16-exact range. Start times may vary per vehicle (they only
-enter the RESYNC timeline, which is exact XLA).
+makespan, uniform fleet + scalable demands, n_nodes <= 1024 (the shared
+delta-path bound — raised from 512 in round 5 with the scoped-VMEM cap;
+this driver additionally scales its chain tile down with both padded
+length and rank to stay inside it) and ids in one bf16-exact range.
+Start times may vary per vehicle (they only enter the RESYNC timeline,
+which is exact XLA).
 """
 
 from __future__ import annotations
@@ -63,10 +66,7 @@ from vrpms_tpu.kernels.sa_delta import (
     _value_at,
     _value_at_f,
 )
-from vrpms_tpu.kernels.sa_delta_tw import (
-    _pair_lookup_stacked,
-    _values_at_stacked,
-)
+from vrpms_tpu.kernels.sa_delta_tw import _values_at_stacked
 
 if _PALLAS_OK:
     from jax.experimental import pallas as pl

@@ -1,0 +1,36 @@
+"""Async solve scheduler: admission queue, shape-bucketed micro-batcher,
+device-owning workers.
+
+The subsystem between the HTTP layer and the jit-compiled solvers
+(ROADMAP "serves heavy traffic"): requests become Jobs on a bounded
+queue; one worker per backend drains it, merging same-shape jobs into
+one batched/vmapped launch (sched.batch.solve_sa_batch) within a small
+gather window. Generic pieces here are stdlib-only; the service wires
+the runner, the jobs HTTP surface, and persistence (service.jobs).
+"""
+
+from vrpms_tpu.sched.batcher import gather_batch
+from vrpms_tpu.sched.queue import (
+    DONE,
+    FAILED,
+    QUEUED,
+    RUNNING,
+    Job,
+    JobQueue,
+    QueueFull,
+)
+from vrpms_tpu.sched.worker import Scheduler, Worker, expired
+
+__all__ = [
+    "DONE",
+    "FAILED",
+    "QUEUED",
+    "RUNNING",
+    "Job",
+    "JobQueue",
+    "QueueFull",
+    "Scheduler",
+    "Worker",
+    "expired",
+    "gather_batch",
+]

@@ -1,0 +1,261 @@
+"""Batched SA over K stacked same-shape instances — one vmapped launch.
+
+The micro-batcher's payoff: K concurrent requests whose instances share
+a padded shape (and solver schedule) run as ONE device program with a
+leading instance axis, instead of K sequential launches each paying
+per-launch fixed costs (dispatch, host sync, scan-step overhead, the
+threefry presample chain). The batched block's step body is the same
+primitive chain as the single-instance block (_batch_block_fn), vmapped
+over instances, with the presampled move-parameter stream SHARED across
+the batch — so per-instance anneal semantics cannot drift, and only the
+RNG stream differs from a solo solve.
+
+Batch sizes are padded up to a power of two (replicating the last
+instance) so the set of compiled batched programs stays tiny — at most
+log2(max_batch) variants per bucket shape, each persistent-cacheable.
+
+Deadline semantics match solve_sa: the whole batch runs under ONE
+run_blocked loop whose budget is the CALLER's minimum remaining budget
+across the batch, so no merged job ever overshoots its own deadline
+(beyond the shared one-block granularity contract).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core.cost import CostWeights, resolve_eval_mode
+from vrpms_tpu.core.instance import Instance
+from vrpms_tpu.solvers.common import SolveResult, run_blocked
+from vrpms_tpu.solvers.sa import (
+    SAParams,
+    _rate_get,
+    _rate_put,
+    _sa_prep_fn,
+)
+
+
+def stack_instances(insts: list[Instance]) -> Instance:
+    """K same-shape instances -> one Instance pytree with a leading
+    instance axis on every array leaf. Static metadata (has_tw,
+    slice_minutes, het_fleet, td_rank) must agree — the bucket key the
+    service batches on guarantees it; mismatches raise here."""
+    first = insts[0]
+    for other in insts[1:]:
+        if (
+            other.has_tw != first.has_tw
+            or other.slice_minutes != first.slice_minutes
+            or other.het_fleet != first.het_fleet
+            or other.td_rank != first.td_rank
+        ):
+            raise ValueError("instances in one batch must share metadata")
+        if other.durations.shape != first.durations.shape:
+            raise ValueError("instances in one batch must share shapes")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *insts)
+
+
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@lru_cache(maxsize=8)
+def _keys_fn():
+    @jax.jit
+    def keys(seeds):
+        base = jax.random.key(0)
+        return jax.vmap(lambda s: jax.random.fold_in(base, s))(seeds)
+
+    return keys
+
+
+@lru_cache(maxsize=8)
+def _batch_prep_fn(n_chains: int, mode: str):
+    """vmap of the fused single-instance cold-start prep (NN seed +
+    clones + initial eval + temperature scale)."""
+    prep = _sa_prep_fn(n_chains, mode)
+    return jax.jit(jax.vmap(prep, in_axes=(0, 0, None)))
+
+
+@lru_cache(maxsize=32)
+def _batch_block_fn(n_block: int, mode: str):
+    """One anneal block over [K, B, L] stacked state with a SHARED
+    presampled move-parameter stream.
+
+    The step body is the same primitive chain as solvers.sa._sa_block_fn
+    (presample -> move_batch_from_params -> objective -> the one
+    metropolis_accept), vmapped over the instance axis per step — so no
+    per-instance anneal semantics can drift. The block's randomness is
+    presampled ONCE for the whole batch (common random numbers: every
+    instance's chains see the same proposal positions/uniforms, applied
+    to its OWN tours against its OWN durations): on CPU the threefry
+    presample chain is a large slice of the per-iteration fixed cost, so
+    sharing it is a big part of the batched launch's amortization — and
+    for INDEPENDENT instances, cross-request stream correlation changes
+    no per-request result distribution.
+    """
+
+    @jax.jit
+    def run(state, key, binst, w, t0s, t1s, knns, start_it, horizon):
+        from vrpms_tpu.moves.moves import (
+            move_batch_from_params,
+            presample_move_params,
+        )
+        from vrpms_tpu.solvers.sa import (
+            anneal_temperature,
+            metropolis_accept,
+        )
+
+        giants, costs, best_g, best_c = state
+        _, b, length = giants.shape
+        kb = jax.random.fold_in(key, start_it)
+        width = 0 if knns is None else knns.shape[-1]
+        pri, prr, prmt, prm, pru = presample_move_params(
+            kb, b, length, n_block, width
+        )
+
+        def step(st, xs):
+            it, i, r, mt, m, u = xs
+            giants, costs, best_g, best_c = st
+            temps = anneal_temperature(it, t0s, t1s, horizon)
+
+            def one(g, c, inst, knn, temp):
+                cands = move_batch_from_params(i, r, mt, m, g, knn, mode)
+                cand_costs = objective_batch_mode_(cands, inst, w)
+                return metropolis_accept(g, c, cands, cand_costs, u, temp)
+
+            giants, costs = jax.vmap(one)(giants, costs, binst, knns, temps)
+            better = costs < best_c
+            best_g = jnp.where(better[..., None], giants, best_g)
+            best_c = jnp.where(better, costs, best_c)
+            return (giants, costs, best_g, best_c), None
+
+        def objective_batch_mode_(cands, inst, w):
+            from vrpms_tpu.core.cost import objective_batch_mode
+
+            return objective_batch_mode(cands, inst, w, mode)
+
+        xs = (start_it + jnp.arange(n_block), pri, prr, prmt, prm, pru)
+        state, _ = jax.lax.scan(step, state, xs)
+        return state
+
+    return run
+
+
+@lru_cache(maxsize=8)
+def _batch_final_fn():
+    """Per-instance champion + exact pricing, vmapped."""
+    from vrpms_tpu.core.cost import exact_cost
+
+    @jax.jit
+    def final(best_g, best_c, binst, w):
+        def one(bg, bc, inst):
+            champ = jnp.argmin(bc)
+            g = bg[champ]
+            bd, cost = exact_cost(g, inst, w)
+            return g, bd, cost
+
+        return jax.vmap(one, in_axes=(0, 0, 0))(best_g, best_c, binst)
+
+    return final
+
+
+def solve_sa_batch(
+    insts: list[Instance],
+    seeds: list[int],
+    params: SAParams = SAParams(),
+    weights: CostWeights | None = None,
+    mode: str = "auto",
+    deadline_s: float | None = None,
+) -> list[SolveResult]:
+    """Solve K same-shape instances with SA in one vmapped launch.
+
+    Returns one SolveResult per input instance, in order. The anneal
+    uses the nn-seeded cool schedule (solve_sa's default path) with
+    per-instance temperatures from each instance's own duration scale;
+    candidate-list proposals use per-instance knn tables.
+    """
+    from vrpms_tpu.moves import proposal_knn
+
+    k = len(insts)
+    if k == 0:
+        return []
+    if len(seeds) != k:
+        raise ValueError(f"{k} instances but {len(seeds)} seeds")
+    w = weights or CostWeights.make()
+    mode = resolve_eval_mode(mode)
+
+    # pad to a power of two with clones of the last instance: bounds the
+    # compiled batched-program variants at log2(max_batch) per shape
+    p = _pad_pow2(k)
+    padded = list(insts) + [insts[-1]] * (p - k)
+    pad_seeds = [int(s) & 0x7FFFFFFF for s in seeds] + [0] * (p - k)
+
+    binst = stack_instances(padded)
+    seeds_j = jnp.asarray(pad_seeds, jnp.int32)
+    k_init = _keys_fn()(seeds_j)
+    # ONE run key for the whole batch (the shared presampled stream),
+    # mixed from every job's seed so any seed change reshuffles it
+    mix = 0
+    for s in pad_seeds:
+        mix = (mix * 1000003 ^ s) & 0x7FFFFFFF
+    k_run = jax.random.fold_in(jax.random.key(1), mix)
+
+    giants, costs, means = _batch_prep_fn(params.n_chains, mode)(
+        k_init, binst, w
+    )
+    # per-instance geometric schedule endpoints (nn-seeded cool start,
+    # matching solvers.sa._temps_from_scale for init='nn')
+    t0s = 0.05 * means
+    t1s = jnp.maximum(1e-3, 0.002 * means)
+
+    knns = (
+        jnp.stack([proposal_knn(inst, params.knn_k) for inst in padded])
+        if params.knn_k > 0
+        else None
+    )
+    n_iters = params.n_iters
+    horizon = jnp.float32(n_iters)
+    state = (giants, costs, giants, costs)
+
+    def step_block(st, nb, start):
+        return _batch_block_fn(nb, mode)(
+            st, k_run, binst, w, t0s, t1s, knns, jnp.int32(start), horizon
+        )
+
+    rate_key = ("sa_batch", p, params.n_chains, giants.shape[-1], mode)
+    import time as _time
+
+    t_run = _time.monotonic()
+    state, done = run_blocked(
+        step_block,
+        state,
+        n_iters,
+        512,
+        deadline_s,
+        lambda st: st[3],
+        rate_hint=_rate_get(rate_key),
+        evals_per_iter=p * params.n_chains,
+    )
+    if deadline_s is not None and done:
+        el = _time.monotonic() - t_run
+        if el > 0.05:
+            _rate_put(rate_key, done / el)
+
+    _, _, best_g, best_c = state
+    g, bd, cost = _batch_final_fn()(best_g, best_c, binst, w)
+    evals = jnp.float32(params.n_chains * done)
+    return [
+        SolveResult(
+            g[i],
+            cost[i],
+            jax.tree.map(lambda a: a[i], bd),
+            evals,
+        )
+        for i in range(k)
+    ]

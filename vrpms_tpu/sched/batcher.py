@@ -1,0 +1,52 @@
+"""Shape-bucketed micro-batching: gather same-bucket jobs into one launch.
+
+The economics (vrpms paper: a serverless solve API; ROADMAP: serve it
+at scale): jit-compiled solver programs are specialized by padded
+instance shape, so K concurrent requests whose instances share a shape
+can amortize ONE batched/vmapped launch instead of K sequential device
+round trips. The bucket key is computed by the service when it prepares
+the instance (service.jobs._bucket_key) — equal keys guarantee equal
+array shapes, equal static metadata, and equal solver schedule, i.e.
+everything a stacked launch requires.
+
+The gather protocol: the worker pops the oldest job, then holds it for
+at most `window_s` while same-bucket jobs accumulate, taking them out
+of FIFO order (other buckets keep their order and are served next).
+The window bounds added latency for the FIRST request of a burst; a
+bucket that fills `max_batch` early launches immediately.
+"""
+
+from __future__ import annotations
+
+import time
+
+from vrpms_tpu.sched.queue import Job, JobQueue
+
+
+def gather_batch(
+    queue: JobQueue,
+    first: Job,
+    window_s: float,
+    max_batch: int,
+) -> list[Job]:
+    """Collect jobs batchable with `first` (first included, FIFO order).
+
+    Non-batchable jobs (bucket None) and a zero window return
+    immediately — the solo path must not pay any gather latency beyond
+    one lock acquisition.
+    """
+    batch = [first]
+    if first.bucket is None or max_batch <= 1:
+        return batch
+    deadline = time.monotonic() + max(window_s, 0.0)
+    while len(batch) < max_batch:
+        batch.extend(
+            queue.take_matching(first.bucket, max_batch - len(batch))
+        )
+        if len(batch) >= max_batch:
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        queue.wait_for_more(remaining)
+    return batch

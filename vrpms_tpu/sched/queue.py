@@ -1,0 +1,197 @@
+"""Bounded admission queue for solve jobs.
+
+The service's HTTP layer is a ThreadingHTTPServer: without admission
+control, N concurrent requests mean N threads all dispatching to the
+one accelerator at once — contending for the device queue and each
+holding a connection for its full solver deadline. This queue is the
+seam that decouples them: HTTP threads `push` (never block, never
+solve), a single device-owning worker (sched.worker) drains.
+
+Admission is strictly bounded: a full queue raises QueueFull
+immediately (the service turns that into 429 + Retry-After) instead of
+queueing unbounded work that would start with an already-spent deadline
+budget. Jobs carry their submission clock so the worker can account
+queue wait against the job's own time limit (sched.worker.expired).
+
+Stdlib-only by design — no jax, no service imports — so the queue and
+its tests run anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import typing
+import uuid
+
+
+#: Lifecycle states (the jobs API contract exposes these verbatim).
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+
+class QueueFull(Exception):
+    """Admission rejected: the bounded queue is at capacity.
+
+    `retry_after_s` is the queue's own estimate of when capacity frees
+    up (depth x recent per-job seconds) — the service echoes it as the
+    429 response's Retry-After header.
+    """
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(f"queue full ({depth} jobs pending)")
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass
+class Job:
+    """One unit of solver work moving through the scheduler.
+
+    `payload` is opaque to this package (the service stores its prepared
+    instance + request context there). `bucket` is the shape-batching
+    key: jobs with EQUAL buckets may be merged into one batched launch
+    (sched.batcher); None means never merge. `time_limit` is the
+    request's nominal wall budget in seconds (None/0 = unbounded /
+    stop-ASAP semantics, matching service._deadline).
+    """
+
+    payload: typing.Any
+    bucket: typing.Hashable = None
+    time_limit: float | None = None
+    request_id: str | None = None
+    id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:16]
+    )
+    status: str = QUEUED
+    result: typing.Any = None
+    errors: list = dataclasses.field(default_factory=list)
+    # clocks: monotonic for wait accounting, epoch for the job record
+    submitted_mono: float = dataclasses.field(default_factory=time.monotonic)
+    submitted_at: float = dataclasses.field(default_factory=time.time)
+    started_at: float | None = None
+    finished_at: float | None = None
+    queue_wait_s: float | None = None
+    batch_size: int = 0
+    done_event: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False
+    )
+
+    def finish(self, status: str) -> None:
+        self.status = status
+        self.finished_at = time.time()
+        self.done_event.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the job reaches a terminal state."""
+        return self.done_event.wait(timeout)
+
+
+class JobQueue:
+    """Bounded FIFO with bucket-aware extraction.
+
+    `pop` hands the worker the oldest job; `take_matching` then pulls
+    additional same-bucket jobs out of FIFO order (the micro-batcher's
+    gather — skipped jobs keep their relative order). All operations are
+    O(depth) under one lock; depth is bounded, so that is bounded too.
+    """
+
+    def __init__(self, limit: int = 64):
+        if limit < 1:
+            raise ValueError(f"queue limit must be >= 1, got {limit}")
+        self.limit = limit
+        self._items: list[Job] = []
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+        self._pushes = 0  # wait_for_more watches this, not emptiness
+        # EWMA of per-job service seconds, maintained by the worker via
+        # note_job_seconds — the Retry-After estimate's rate term.
+        self._job_seconds = 1.0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def note_job_seconds(self, seconds: float) -> None:
+        with self._lock:
+            self._job_seconds = 0.8 * self._job_seconds + 0.2 * max(
+                seconds, 1e-3
+            )
+
+    def _retry_after_locked(self) -> float:
+        return min(max(1.0, len(self._items) * self._job_seconds), 60.0)
+
+    def retry_after_s(self) -> float:
+        with self._lock:
+            return self._retry_after_locked()
+
+    def push(self, job: Job) -> None:
+        """Admit a job or raise QueueFull; never blocks."""
+        with self._lock:
+            if self._closed:
+                raise QueueFull(len(self._items), 1.0)
+            if len(self._items) >= self.limit:
+                raise QueueFull(
+                    len(self._items), self._retry_after_locked()
+                )
+            self._items.append(job)
+            self._pushes += 1
+            self._not_empty.notify_all()
+
+    def pop(self, timeout: float | None = None) -> Job | None:
+        """Oldest job, or None on timeout/close."""
+        with self._lock:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._items:
+                if self._closed:
+                    return None
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._not_empty.wait(remaining)
+            return self._items.pop(0)
+
+    def take_matching(self, bucket, max_n: int) -> list[Job]:
+        """Remove and return up to max_n jobs whose bucket equals
+        `bucket` (None never matches); remaining jobs keep FIFO order."""
+        if bucket is None or max_n <= 0:
+            return []
+        taken: list[Job] = []
+        with self._lock:
+            kept = []
+            for job in self._items:
+                if len(taken) < max_n and job.bucket == bucket:
+                    taken.append(job)
+                else:
+                    kept.append(job)
+            self._items = kept
+        return taken
+
+    def wait_for_more(self, timeout: float) -> None:
+        """Sleep until a NEW push lands or `timeout` elapses (the gather
+        window's clock — jobs already queued in other buckets must not
+        turn this into a busy-wait; spurious wakeups are fine, the
+        caller rechecks)."""
+        with self._lock:
+            seen = self._pushes
+            deadline = time.monotonic() + timeout
+            while self._pushes == seen and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._not_empty.wait(remaining)
+
+    def drain(self) -> list[Job]:
+        """Close admission and return every queued job (shutdown path:
+        the caller fails them cleanly instead of abandoning waiters)."""
+        with self._lock:
+            self._closed = True
+            items, self._items = self._items, []
+            self._not_empty.notify_all()
+        return items

@@ -1,0 +1,240 @@
+"""Device-owning workers + the Scheduler facade.
+
+One Worker thread per backend label owns that backend's device queue:
+it is the ONLY thread that runs solver code for its backend, so N HTTP
+threads can never contend the accelerator (they park on Job.done_event
+instead). The worker's loop is: pop oldest job -> gather same-bucket
+jobs for the micro-batch window (sched.batcher) -> expire jobs whose
+queue wait already spent their deadline budget -> hand the batch to the
+injected `runner`.
+
+The runner is dependency-injected (the service provides one that knows
+how to prepare/solve/finish requests) so this package stays free of
+jax/service imports and testable with stub runners. Contract:
+
+    runner(jobs: list[Job]) -> None
+
+It must fill each job's `result` (success) or `errors` (failure); the
+worker owns every status transition and ALWAYS completes each job
+(runner exceptions fail the whole batch cleanly — a job can never be
+left un-terminal, so a submit-and-wait caller can never hang).
+
+`on_event(name, job)` is an optional observer hook (the service wires
+metrics + structured logs + store persistence there); observer failures
+are swallowed — telemetry must never kill the device loop. Events:
+queued, expired, started, done, failed, drained.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from vrpms_tpu.sched.batcher import gather_batch
+from vrpms_tpu.sched.queue import (
+    DONE,
+    FAILED,
+    RUNNING,
+    Job,
+    JobQueue,
+    QueueFull,
+)
+
+
+def expired(job: Job, now_mono: float | None = None) -> bool:
+    """Queue wait already spent the job's whole budget?
+
+    Only a POSITIVE time limit can expire: explicit 0 keeps its
+    "stop as soon as possible" semantics (service.solve._deadline) and
+    None is unbounded — both always run.
+    """
+    if not job.time_limit or job.time_limit <= 0:
+        return False
+    now = time.monotonic() if now_mono is None else now_mono
+    return (now - job.submitted_mono) >= job.time_limit
+
+
+class Worker(threading.Thread):
+    """Drains one backend's queue forever (daemon; stop() to end)."""
+
+    def __init__(
+        self,
+        backend: str,
+        queue: JobQueue,
+        runner,
+        window_s: float,
+        max_batch: int,
+        on_event=None,
+    ):
+        super().__init__(name=f"vrpms-sched-{backend}", daemon=True)
+        self.backend = backend
+        self.queue = queue
+        self._runner = runner
+        self._window_s = window_s
+        self._max_batch = max_batch
+        self._on_event = on_event
+        self._halt = threading.Event()
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def _emit(self, name: str, job: Job) -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(name, job)
+        except Exception:
+            pass  # observers must never kill the device loop
+
+    def run(self) -> None:  # pragma: no cover - exercised via Scheduler
+        while not self._halt.is_set():
+            job = self.queue.pop(timeout=0.1)
+            if job is None:
+                continue
+            batch = gather_batch(
+                self.queue, job, self._window_s, self._max_batch
+            )
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: list[Job]) -> None:
+        now = time.monotonic()
+        live: list[Job] = []
+        for job in batch:
+            job.queue_wait_s = now - job.submitted_mono
+            if expired(job, now):
+                # never start a job with a spent budget — the client's
+                # deadline contract includes the time WE made it wait
+                job.errors = [{
+                    "what": "Deadline exceeded",
+                    "reason": (
+                        f"job waited {job.queue_wait_s:.3f}s in queue, "
+                        f"past its timeLimit of {job.time_limit}s"
+                    ),
+                }]
+                job.finish(FAILED)
+                self._emit("expired", job)
+            else:
+                live.append(job)
+        if not live:
+            return
+        t0 = time.monotonic()
+        for job in live:
+            job.status = RUNNING
+            job.started_at = time.time()
+            job.batch_size = len(live)
+            self._emit("started", job)
+        try:
+            self._runner(live)
+        except Exception as e:  # a runner bug must not strand waiters
+            for job in live:
+                if not job.done_event.is_set():
+                    job.errors = job.errors or [{
+                        "what": "Scheduler error",
+                        "reason": f"{type(e).__name__}: {e}",
+                    }]
+        elapsed = time.monotonic() - t0
+        self.queue.note_job_seconds(elapsed / len(live))
+        for job in live:
+            if job.done_event.is_set():
+                continue
+            if job.result is not None:
+                job.finish(DONE)
+                self._emit("done", job)
+            else:
+                job.errors = job.errors or [{
+                    "what": "Scheduler error",
+                    "reason": "runner returned neither result nor errors",
+                }]
+                job.finish(FAILED)
+                self._emit("failed", job)
+
+
+class Scheduler:
+    """Admission front + per-backend workers + drain-on-shutdown.
+
+    submit() never blocks and never runs solver code; it either admits
+    the job to its backend's bounded queue or raises QueueFull. Workers
+    are created lazily per backend label so a deployment that only ever
+    sees default-backend requests runs exactly one device loop.
+    """
+
+    def __init__(
+        self,
+        runner,
+        queue_limit: int = 64,
+        window_s: float = 0.01,
+        max_batch: int = 16,
+        on_event=None,
+    ):
+        self._runner = runner
+        self._queue_limit = queue_limit
+        self._window_s = window_s
+        self._max_batch = max_batch
+        self._on_event = on_event
+        self._workers: dict[str, Worker] = {}
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+    def _worker(self, backend: str) -> Worker:
+        with self._lock:
+            if self._shutdown:
+                raise QueueFull(0, 1.0)
+            w = self._workers.get(backend)
+            if w is None:
+                w = Worker(
+                    backend,
+                    JobQueue(self._queue_limit),
+                    self._runner,
+                    self._window_s,
+                    self._max_batch,
+                    self._on_event,
+                )
+                self._workers[backend] = w
+                w.start()
+            return w
+
+    def submit(self, job: Job, backend: str = "default") -> Job:
+        """Admit `job` onto `backend`'s queue (QueueFull on rejection)."""
+        worker = self._worker(backend or "default")
+        worker.queue.push(job)
+        if self._on_event is not None:
+            try:
+                self._on_event("queued", job)
+            except Exception:
+                pass
+        return job
+
+    def depth(self, backend: str = "default") -> int:
+        w = self._workers.get(backend or "default")
+        return 0 if w is None else len(w.queue)
+
+    def queues(self) -> dict[str, int]:
+        with self._lock:
+            return {b: len(w.queue) for b, w in self._workers.items()}
+
+    def shutdown(self, timeout: float = 5.0) -> int:
+        """Drain: stop admission, fail every queued job cleanly, stop
+        workers. Returns the number of jobs drained. Idempotent."""
+        with self._lock:
+            if self._shutdown:
+                return 0
+            self._shutdown = True
+            workers = list(self._workers.values())
+        drained = 0
+        for w in workers:
+            w.stop()
+            for job in w.queue.drain():
+                job.errors = [{
+                    "what": "Service unavailable",
+                    "reason": "scheduler shutting down before this job ran",
+                }]
+                job.finish(FAILED)
+                drained += 1
+                if self._on_event is not None:
+                    try:
+                        self._on_event("drained", job)
+                    except Exception:
+                        pass
+        for w in workers:
+            w.join(timeout)
+        return drained

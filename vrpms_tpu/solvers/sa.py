@@ -900,11 +900,23 @@ def _solve_sa_delta_td(
         # refresh the frozen factor weights + committed cost in the
         # exact timeline of the committed tours (the surrogate's only
         # drift source); lgr re-derives exactly so it stays as-is
-        gt_t, dp_t, lgr_t, _cost, best_t, best_c = st
+        gt_t, dp_t, lgr_t, _cost, best_t, _best_c = st
         g = gt_t[:length].T
         fw_new, _lg, dist = fw_fn(g, inst, bas_f32)
         fw_box[0] = fw_new
         cape = _cap_excess_of(gt_t, dp_t, scal[0, 0], lhat)
+        # re-price best_t in the SAME fresh timeline: a best_c priced
+        # under old (optimistic) factor weights would otherwise sit
+        # below what any genuinely better tour can score under the new
+        # ones, silently suppressing later improvements for the rest of
+        # the run. One extra fw/dp pass per 512-step launch (~1/512 of
+        # a full eval per step) keeps tracker and candidates comparable.
+        dist_b = fw_fn(best_t[:length].T, inst, bas_f32)[2]
+        dp_b = dp_init(best_t, jnp.asarray(dem_row), tile_b=tile_b,
+                       interpret=interpret)
+        best_c = dist_b + scal[0, 1] * _cap_excess_of(
+            best_t, dp_b, scal[0, 0], lhat
+        )
         return (gt_t, dp_t, lgr_t, dist + scal[0, 1] * cape, best_t, best_c)
 
     state, done = _delta_launch_loop(
