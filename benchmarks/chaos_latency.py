@@ -1,0 +1,250 @@
+"""Chaos benchmark: tail latency + availability with the circuit
+breaker on vs. off, against a misbehaving store.
+
+Starts the service in-process on the fault-injecting store
+(`VRPMS_STORE=faulty:<plan>`) and drives it with N closed-loop clients
+through three store conditions:
+
+  healthy — empty plan (baseline);
+  flaky   — per-call latency + jitter + error rate: without the
+            breaker every request pays the latency tax and a slice of
+            requests 400; with it, failures trip the circuit and reads
+            serve from the last-known-rows cache — fast and degraded;
+  down    — every store call fails: without the breaker every request
+            is an error; with it the service keeps answering degraded.
+
+Each condition runs twice: `VRPMS_RESILIENCE=off` (raw store, the
+pre-ISSUE-3 behavior) and `on`. Reported per phase: solves/sec,
+p50/p99 latency, and the outcome mix (ok / degraded / shed = 4xx-5xx) —
+the acceptance contrast is the down row: off sheds ~100%, on serves
+~100% degraded at cache speed.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.chaos_latency \
+        [--clients 4] [--duration 6] [--warmup 3] [--n 8] \
+        [--iters 200] [--pop 8] [--out records/chaos_latency_r8.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import threading
+import time
+import urllib.error
+import urllib.request
+
+FLAKY_PLAN = "latency=0.05;jitter=0.05;rate=0.3;seed=5"
+DOWN_PLAN = "down"
+
+
+def _post(base: str, path: str, body: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _seed_store(n: int) -> None:
+    import numpy as np
+
+    import store.memory as mem
+
+    mem.reset()
+    rng = np.random.default_rng(29)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        "chaos", [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+    )
+    mem.seed_durations("chaos", d.tolist())
+
+
+def _body(n: int, iters: int, pop: int, seed: int) -> dict:
+    return {
+        "solutionName": "chaos-bench",
+        "solutionDescription": "chaos_latency",
+        "locationsKey": "chaos",
+        "durationsKey": "chaos",
+        "capacities": [3 * n] * 3,
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": seed,
+        "iterationCount": iters,
+        "populationSize": pop,
+    }
+
+
+def run_phase(base, clients, duration_s, warmup_s, n, iters, pop) -> dict:
+    stop = threading.Event()
+    measuring = threading.Event()
+    lock = threading.Lock()
+    lat_ok: list[float] = []
+    outcomes = {"ok": 0, "degraded": 0, "shed": 0}
+
+    def client(i: int) -> None:
+        seed = 1000 * i
+        while not stop.is_set():
+            seed += 1
+            t0 = time.perf_counter()
+            status, resp = _post(base, "/api/vrp/sa", _body(n, iters, pop, seed))
+            dt = time.perf_counter() - t0
+            if not measuring.is_set():
+                continue
+            with lock:
+                if status == 200:
+                    lat_ok.append(dt)
+                    key = (
+                        "degraded"
+                        if resp.get("message", {}).get("degraded")
+                        else "ok"
+                    )
+                    outcomes[key] += 1
+                else:
+                    outcomes["shed"] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s)
+    measuring.set()
+    t_meas = time.perf_counter()
+    time.sleep(duration_s)
+    measured_s = time.perf_counter() - t_meas
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+    lat_ms = sorted(1e3 * x for x in lat_ok)
+
+    def pct(p: float):
+        if not lat_ms:
+            return None
+        k = min(len(lat_ms) - 1, int(round(p / 100 * (len(lat_ms) - 1))))
+        return round(lat_ms[k], 1)
+
+    total = sum(outcomes.values())
+    return {
+        "requests": total,
+        "solvesPerSec": round(len(lat_ms) / measured_s, 2),
+        "p50Ms": pct(50),
+        "p99Ms": pct(99),
+        "meanMs": round(statistics.mean(lat_ms), 1) if lat_ms else None,
+        "okPct": round(100 * outcomes["ok"] / total, 1) if total else None,
+        "degradedPct": (
+            round(100 * outcomes["degraded"] / total, 1) if total else None
+        ),
+        "shedPct": round(100 * outcomes["shed"] / total, 1) if total else None,
+        "measuredSeconds": round(measured_s, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=6.0)
+    ap.add_argument("--warmup", type=float, default=3.0)
+    ap.add_argument("--n", type=int, default=8, help="locations per instance")
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--pop", type=int, default=8)
+    ap.add_argument("--out", default=None, help="record JSON path")
+    ap.add_argument("--note", default=None)
+    args = ap.parse_args()
+
+    # fast-trip resilience policy so short phases reach steady state
+    os.environ.setdefault("VRPMS_STORE_DEADLINE_S", "0.5")
+    os.environ.setdefault("VRPMS_STORE_RETRIES", "1")
+    os.environ.setdefault("VRPMS_STORE_BACKOFF_S", "0.01")
+    os.environ.setdefault("VRPMS_CB_FAILURES", "5")
+    os.environ.setdefault("VRPMS_CB_RESET_S", "1.0")
+    _seed_store(args.n)
+
+    from service import jobs as jobs_mod
+    from service.app import serve
+    from store.faulty import reset_faults
+    from store.resilient import reset_resilience
+
+    srv = serve(port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    import jax
+
+    record = {
+        "benchmark": "chaos_latency",
+        "backend": jax.default_backend(),
+        "clients": args.clients,
+        "locations": args.n,
+        "iterationCount": args.iters,
+        "populationSize": args.pop,
+        "durationSeconds": args.duration,
+        "plans": {"flaky": FLAKY_PLAN, "down": DOWN_PLAN},
+        "policy": {
+            k: os.environ[k]
+            for k in (
+                "VRPMS_STORE_DEADLINE_S", "VRPMS_STORE_RETRIES",
+                "VRPMS_STORE_BACKOFF_S", "VRPMS_CB_FAILURES",
+                "VRPMS_CB_RESET_S",
+            )
+        },
+        "note": args.note,
+    }
+    for mode in ("off", "on"):
+        os.environ["VRPMS_RESILIENCE"] = mode
+        record[f"breaker_{mode}"] = {}
+        for name, plan in (("healthy", ""), ("flaky", FLAKY_PLAN),
+                           ("down", DOWN_PLAN)):
+            reset_faults()
+            reset_resilience()
+            os.environ["VRPMS_STORE"] = "faulty:"
+            if mode == "on" and name != "healthy":
+                # one clean request warms the read-through cache — the
+                # real-world precondition for degraded serving (a store
+                # that was never up has nothing cached to fall back on)
+                _post(base, "/api/vrp/sa", _body(args.n, args.iters,
+                                                 args.pop, 7))
+            os.environ["VRPMS_STORE"] = f"faulty:{plan}" if plan else "faulty:"
+            print(f"== breaker={mode} store={name}: {args.clients} clients, "
+                  f"{args.duration:.0f}s measure")
+            record[f"breaker_{mode}"][name] = run_phase(
+                base, args.clients, args.duration, args.warmup,
+                args.n, args.iters, args.pop,
+            )
+            print(json.dumps(record[f"breaker_{mode}"][name], indent=2))
+            jobs_mod.shutdown_scheduler()
+    os.environ.pop("VRPMS_RESILIENCE", None)
+
+    down_off = record["breaker_off"]["down"]
+    down_on = record["breaker_on"]["down"]
+    record["availabilityUnderDown"] = {
+        "breakerOffServedPct": (down_off["okPct"] or 0)
+        + (down_off["degradedPct"] or 0),
+        "breakerOnServedPct": (down_on["okPct"] or 0)
+        + (down_on["degradedPct"] or 0),
+    }
+    print(json.dumps(record["availabilityUnderDown"], indent=2))
+
+    srv.shutdown()
+    if args.out:
+        out = args.out if os.path.isabs(args.out) else os.path.join(
+            os.path.dirname(__file__), args.out
+        )
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        print(f"record -> {out}")
+
+
+if __name__ == "__main__":
+    main()

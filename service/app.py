@@ -7,8 +7,10 @@ threading HTTP server:
 
     python -m service.app --port 8080 [--fixtures fixtures.json] [--store memory]
 
-Routes: /api, /api/{vrp,tsp}/{ga,sa,aco,bf}, /metrics (Prometheus text
-exposition — service.obs). Unknown paths -> 404.
+Routes: /api, /api/{vrp,tsp}/{ga,sa,aco,bf}, /api/jobs[/{id}],
+/api/ready (ok|degraded|down readiness — service.jobs.readiness),
+/metrics (Prometheus text exposition — service.obs). Unknown paths
+-> 404.
 """
 
 from __future__ import annotations
@@ -19,7 +21,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from service import obs
 from service.api.index import handler as health_handler
-from service.jobs import JobsHandler, JobStatusHandler, shutdown_scheduler
+from service.jobs import (
+    JobsHandler,
+    JobStatusHandler,
+    ReadyHandler,
+    shutdown_scheduler,
+)
 from service.api.vrp.ga.index import handler as vrp_ga
 from service.api.vrp.sa.index import handler as vrp_sa
 from service.api.vrp.aco.index import handler as vrp_aco
@@ -41,6 +48,7 @@ ROUTES = {
     "/api/tsp/aco": tsp_aco,
     "/api/tsp/bf": tsp_bf,
     "/api/jobs": JobsHandler,
+    "/api/ready": ReadyHandler,
     "/metrics": obs.MetricsHandler,
 }
 

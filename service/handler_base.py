@@ -139,6 +139,13 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
             fail(self, errors)
             return
 
+        # Degraded honesty bit: the solve itself is real, but if any
+        # store call on this request (data reads before it or the save
+        # just above) was served by a resilience fallback, the client
+        # must see that persistence was best-effort.
+        if getattr(database, "degraded", False) and "degraded" not in result:
+            result = dict(result, degraded=True)
+
         # Respond
         success(self, result)
 

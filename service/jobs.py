@@ -52,6 +52,7 @@ from service.parameters import (
 )
 from service.solve import (
     Prepared,
+    _mark_degraded,
     finish_tsp,
     finish_vrp,
     prepare_request,
@@ -347,6 +348,15 @@ def _on_event(name: str, job: Job) -> None:
     elif name == "drained":
         obs.SCHED_REJECTS.labels(reason="shutdown").inc()
         obs.JOBS_TOTAL.labels(outcome="failed").inc()
+    elif name == "runner_error":
+        # the worker already built the error envelope; without a metric
+        # and a correlated event a scheduler/runner bug is invisible
+        obs.JOBS_FAILED.labels(reason="runner").inc()
+    elif name == "requeued":
+        obs.SCHED_REQUEUES.inc()
+    elif name == "crashed":
+        obs.JOBS_FAILED.labels(reason="crash").inc()
+        obs.JOBS_TOTAL.labels(outcome="failed").inc()
     elif name in ("done", "failed"):
         obs.JOBS_TOTAL.labels(outcome=name).inc()
     log_event(
@@ -360,9 +370,28 @@ def _on_event(name: str, job: Job) -> None:
             if job.queue_wait_s is None
             else round(job.queue_wait_s * 1e3, 2)
         ),
+        errors=(
+            job.errors or None
+            if name in ("failed", "expired", "crashed", "runner_error")
+            else None
+        ),
     )
-    if name != "queued":  # queued is persisted synchronously at submit
+    if name not in ("queued", "runner_error", "requeued"):
+        # queued is persisted synchronously at submit; runner_error is
+        # always followed by the terminal `failed` persist; requeued is
+        # NOT persisted — it would race the abandoned worker's own
+        # in-order writes for the same job (two threads blind-upserting
+        # could leave a finished job recorded 'queued' forever), and
+        # the record's stale 'running' is true enough: the retry is
+        # about to run it again
         _persist(job)
+
+
+def _on_worker_event(name: str, backend: str, reason: str) -> None:
+    """Watchdog observer: a restart is an operator-grade incident."""
+    if name == "restart":
+        obs.WORKER_RESTARTS.labels(backend=backend, reason=reason).inc()
+    log_event(f"sched.worker_{name}", backend=backend, reason=reason)
 
 
 # ---------------------------------------------------------------------------
@@ -371,6 +400,10 @@ def _on_event(name: str, job: Job) -> None:
 
 _scheduler: Scheduler | None = None
 _sched_lock = threading.Lock()
+# True between a drain (shutdown_scheduler) and the lazy rebuild of a
+# fresh scheduler — the readiness probe's only window to observe "the
+# scheduler was shut down" (the global is None by then)
+_drained = False
 
 
 def _queue_depths() -> dict:
@@ -379,9 +412,10 @@ def _queue_depths() -> dict:
 
 
 def get_scheduler() -> Scheduler:
-    global _scheduler
+    global _scheduler, _drained
     with _sched_lock:
         if _scheduler is None:
+            _drained = False
             _scheduler = Scheduler(
                 _runner,
                 queue_limit=int(os.environ.get("VRPMS_SCHED_QUEUE", "64")),
@@ -390,6 +424,13 @@ def get_scheduler() -> Scheduler:
                 ) / 1e3,
                 max_batch=int(os.environ.get("VRPMS_SCHED_MAX_BATCH", "16")),
                 on_event=_on_event,
+                watchdog_s=float(
+                    os.environ.get("VRPMS_SCHED_WATCHDOG_MS", "500")
+                ) / 1e3,
+                wedge_grace_s=float(
+                    os.environ.get("VRPMS_SCHED_WEDGE_GRACE_S", "10")
+                ),
+                on_worker_event=_on_worker_event,
             )
             obs.set_queue_depth_provider(_queue_depths)
         return _scheduler
@@ -399,9 +440,11 @@ def shutdown_scheduler() -> int:
     """Drain-on-shutdown: fail queued jobs cleanly, stop workers, and
     forget the singleton (a later submit builds a fresh scheduler —
     what tests and long-lived embedding processes need)."""
-    global _scheduler
+    global _scheduler, _drained
     with _sched_lock:
         s, _scheduler = _scheduler, None
+        if s is not None:
+            _drained = True
     if s is None:
         return 0
     drained = s.shutdown()
@@ -434,7 +477,7 @@ def scheduler_solve(problem, algorithm, params, opts, algo_params,
     if prep is None or errors:
         return None
     if prep.trivial is not None:
-        return prep.trivial
+        return _mark_degraded(prep, dict(prep.trivial))
     job = Job(
         payload={"prep": prep, "problem": problem, "algorithm": algorithm},
         bucket=_bucket_key(prep),
@@ -551,7 +594,7 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
         )
         if prep.trivial is not None:
             # nothing to schedule: the job is born done
-            job.result = prep.trivial
+            job.result = _mark_degraded(prep, dict(prep.trivial))
             job.finish(DONE)
             _persist(job)
             obs.JOBS_TOTAL.labels(outcome="done").inc()
@@ -613,4 +656,82 @@ class JobStatusHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
                 }],
             })
             return
-        _respond(self, 200, {"success": True, "job": record})
+        payload = {"success": True, "job": record}
+        if getattr(db, "degraded", False):
+            # the record came from the degraded-mode fallback (possibly
+            # stale last-known state), not an authoritative store read
+            payload["degraded"] = True
+        _respond(self, 200, payload)
+
+
+# ---------------------------------------------------------------------------
+# Readiness probe
+# ---------------------------------------------------------------------------
+
+
+def readiness() -> tuple[int, dict]:
+    """Compute the service's readiness: (http status, body).
+
+    `ok`       — everything healthy.
+    `degraded` — still answering, but on fallbacks: a store circuit is
+                 open/half-open, spooled writes await replay, a worker
+                 is wedged (restart imminent), or a worker restarted in
+                 the last VRPMS_READY_RESTART_WINDOW_S seconds.
+    `down`     — not serving solves: the scheduler was shut down, or a
+                 worker is dead with the watchdog disabled (nothing
+                 will ever drain its queue). Answers 503 so load
+                 balancers rotate the instance out.
+    """
+    try:
+        from store import resilient
+
+        circuits = resilient.circuit_states()
+        journal = resilient.journal_depths()
+    except Exception:  # pragma: no cover - resilient always importable
+        circuits, journal = {}, {}
+    s = _scheduler
+    workers = s.worker_health() if s is not None else {}
+    restarts = dict(s.restarts) if s is not None else {}
+    window_s = float(os.environ.get("VRPMS_READY_RESTART_WINDOW_S", "60"))
+    recent_restart = (
+        s is not None
+        and s.last_restart_mono is not None
+        and time.monotonic() - s.last_restart_mono < window_s
+    )
+    status = "ok"
+    if (
+        any(state != "closed" for state in circuits.values())
+        or any(journal.values())
+        or any(state == "wedged" for state in workers.values())
+        or recent_restart
+    ):
+        status = "degraded"
+    watchdog_on = float(
+        os.environ.get("VRPMS_SCHED_WATCHDOG_MS", "500")
+    ) > 0
+    if (
+        (s is None and _drained)  # drained, no rebuild yet
+        or (s is not None and s.is_shutdown)
+        or (not watchdog_on and any(st == "dead" for st in workers.values()))
+    ):
+        status = "down"
+    body = {
+        "status": status,
+        "circuits": circuits,
+        "journalDepths": journal,
+        "workers": workers,
+        "workerRestarts": restarts,
+    }
+    return (503 if status == "down" else 200), body
+
+
+class ReadyHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/ready — ok|degraded|down readiness probe (503 on down)."""
+
+    def do_GET(self):
+        self._obs_t0 = time.perf_counter()
+        self._request_id = new_request_id()
+        code, body = readiness()
+        if code != 200:
+            self._obs_errors = [body["status"]]
+        _respond(self, code, dict(body, success=code == 200))

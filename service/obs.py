@@ -89,6 +89,59 @@ JOBS_TOTAL = REGISTRY.counter(
     "Scheduler jobs reaching a terminal state, by outcome (done|failed)",
     labels=("outcome",),
 )
+JOBS_FAILED = REGISTRY.counter(
+    "vrpms_jobs_failed_total",
+    "Job failures by cause (runner = runner exception, crash = worker "
+    "crashed twice on the job)",
+    labels=("reason",),
+)
+WORKER_RESTARTS = REGISTRY.counter(
+    "vrpms_sched_worker_restarts_total",
+    "Watchdog worker restarts, by backend and reason (died|wedged)",
+    labels=("backend", "reason"),
+)
+SCHED_REQUEUES = REGISTRY.counter(
+    "vrpms_sched_requeues_total",
+    "In-flight jobs re-admitted after a worker crash (once per job max)",
+)
+STORE_FAILURES = REGISTRY.counter(
+    "vrpms_store_call_failures_total",
+    "Backend store call failures, by backend kind and reason "
+    "(error|timeout)",
+    labels=("kind", "reason"),
+)
+STORE_RETRIES = REGISTRY.counter(
+    "vrpms_store_retries_total",
+    "Store read retries after a failed attempt, by backend kind",
+    labels=("kind",),
+)
+STORE_FALLBACKS = REGISTRY.counter(
+    "vrpms_store_fallbacks_total",
+    "Degraded-mode serves, by backend kind and source (cache = read "
+    "from last-known rows, journal = write spooled for replay)",
+    labels=("kind", "source"),
+)
+STORE_REPLAYS = REGISTRY.counter(
+    "vrpms_store_journal_replayed_total",
+    "Spooled writes replayed into the recovered backend, by kind",
+    labels=("kind",),
+)
+AUTH_FAILURES = REGISTRY.counter(
+    "vrpms_store_auth_failures_total",
+    "JWT set_session failures swallowed at store construction "
+    "(requests likely doomed to row-level-security errors)",
+)
+STORE_CIRCUIT_STATE = REGISTRY.gauge(
+    "vrpms_store_circuit_state",
+    "Circuit breaker state per backend kind (0=closed, 1=half-open, "
+    "2=open); refreshed per scrape",
+    labels=("kind",),
+)
+STORE_JOURNAL_DEPTH = REGISTRY.gauge(
+    "vrpms_store_journal_depth",
+    "Writes spooled in the in-memory journal awaiting replay, by kind",
+    labels=("kind",),
+)
 UPTIME = REGISTRY.gauge(
     "vrpms_uptime_seconds", "Seconds since service process start"
 )
@@ -137,6 +190,17 @@ def refresh_gauges() -> None:
                 SCHED_QUEUE_DEPTH.labels(backend=backend).set(depth)
         except Exception:
             pass
+    try:
+        from store import resilient
+
+        for kind, state in resilient.circuit_states().items():
+            STORE_CIRCUIT_STATE.labels(kind=kind).set(
+                resilient.STATE_VALUE.get(state, -1)
+            )
+        for kind, depth in resilient.journal_depths().items():
+            STORE_JOURNAL_DEPTH.labels(kind=kind).set(depth)
+    except Exception:
+        pass
     try:
         import jax
 

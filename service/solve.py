@@ -905,6 +905,20 @@ def finish_vrp(prep: Prepared, res, stats, extras, errors) -> dict:
             {"problem": "vrp", "routes": routes, "cost": chk_cost},
             better_than=lambda prev: _better_checkpoint(prev, "vrp", routes, chk_cost),
         )
+    return _mark_degraded(prep, result)
+
+
+def _mark_degraded(prep: Prepared, result: dict) -> dict:
+    """Flag results whose request was served by store fallbacks.
+
+    The resilient store wrapper (store.resilient) flips `degraded` on
+    the per-request database instance whenever a read came from the
+    last-known-rows cache or a write spooled to the replay journal —
+    the contract's honesty bit: the solve is real, the persistence
+    around it was best-effort.
+    """
+    if result is not None and getattr(prep.database, "degraded", False):
+        result["degraded"] = True
     return result
 
 
@@ -913,7 +927,7 @@ def solve_prepared(prep: Prepared, errors) -> dict | None:
     dispatch + decode + checkpoint save. The scheduler worker's solo
     path, and (composed under _enveloped) run_vrp/run_tsp's tail."""
     if prep.trivial is not None:
-        return prep.trivial
+        return _mark_degraded(prep, dict(prep.trivial))
     extras: dict = {}
     with _device_ctx(prep.opts.get("backend")):
         res, stats = _run_solver(
@@ -1031,7 +1045,7 @@ def finish_tsp(prep: Prepared, res, stats, extras, errors) -> dict:
             {"problem": "tsp", "routes": routes, "cost": chk_cost},
             better_than=lambda prev: _better_checkpoint(prev, "tsp", routes, chk_cost),
         )
-    return result
+    return _mark_degraded(prep, result)
 
 
 @_enveloped

@@ -10,9 +10,18 @@ get_durations_by_id, save_solution with identical row shapes — is a seam
   * store.supabase_store — the real adapter, import-gated so the
     framework runs without the supabase SDK installed.
 
-Selection: VRPMS_STORE env var ("memory" | "supabase"); default is
-"supabase" when SUPABASE_URL is configured (reference parity), else
-"memory".
+Selection: VRPMS_STORE env var ("memory" | "supabase" |
+"faulty[:<plan>]"); default is "supabase" when SUPABASE_URL is
+configured (reference parity), else "memory". "faulty" is the chaos
+backend: the in-memory store behind a declarative fault plan
+(store.faulty / vrpms_tpu.testing.faults).
+
+Resilience: network-ish backends (supabase, faulty) are wrapped in
+store.resilient.ResilientDatabase — per-call deadlines, read retries,
+circuit breaker, degraded-mode cache/journal fallbacks — unless
+VRPMS_RESILIENCE=off; VRPMS_RESILIENCE=on additionally wraps the
+in-process memory store (only useful for experiments — it adds a
+thread hop per call).
 """
 
 from __future__ import annotations
@@ -28,19 +37,44 @@ from vrpms_tpu.utils import load_dotenv
 load_dotenv()
 
 
+def _resilience_wraps(kind: str) -> bool:
+    mode = os.environ.get("VRPMS_RESILIENCE", "auto").lower()
+    if mode in ("off", "0", "false", "no"):
+        return False
+    if mode in ("on", "1", "true", "yes"):
+        return True
+    return kind in ("supabase", "faulty")
+
+
 def get_database(problem: str, auth=None):
     """Factory: problem is 'vrp' or 'tsp'; returns the configured store."""
     kind = os.environ.get("VRPMS_STORE")
     if kind is None:
         kind = "supabase" if os.environ.get("SUPABASE_URL") else "memory"
+    plan = ""
+    if kind.startswith("faulty"):
+        kind, _, plan = kind.partition(":")
+        if kind != "faulty":
+            raise ValueError(f"unknown VRPMS_STORE {kind!r}")
     if kind == "memory":
         from store.memory import InMemoryDatabaseTSP, InMemoryDatabaseVRP
 
         cls = InMemoryDatabaseVRP if problem == "vrp" else InMemoryDatabaseTSP
-        return cls(auth)
-    if kind == "supabase":
+        db = cls(auth)
+    elif kind == "supabase":
         from store.supabase_store import SupabaseDatabaseTSP, SupabaseDatabaseVRP
 
         cls = SupabaseDatabaseVRP if problem == "vrp" else SupabaseDatabaseTSP
-        return cls(auth)
-    raise ValueError(f"unknown VRPMS_STORE {kind!r}")
+        db = cls(auth)
+    elif kind == "faulty":
+        from store.faulty import FaultyDatabaseTSP, FaultyDatabaseVRP
+
+        cls = FaultyDatabaseVRP if problem == "vrp" else FaultyDatabaseTSP
+        db = cls(auth, plan=plan)
+    else:
+        raise ValueError(f"unknown VRPMS_STORE {kind!r}")
+    if _resilience_wraps(kind):
+        from store.resilient import wrap
+
+        db = wrap(db, kind, problem)
+    return db

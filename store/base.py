@@ -26,6 +26,11 @@ class Database:
     and _owner_email; the public methods provide the shared error
     envelope semantics."""
 
+    #: True once any call on this instance was served by a degraded-mode
+    #: fallback (store.resilient); the service marks the response
+    #: `degraded: true`. Plain backends never flip it.
+    degraded = False
+
     def __init__(self, auth=None):
         self.auth = auth
 

@@ -1,0 +1,84 @@
+"""Fault-injecting store: the in-memory backend behind a chaos plan.
+
+Selected with `VRPMS_STORE=faulty:<plan>` (plan DSL:
+vrpms_tpu.testing.faults). Every primitive store operation first runs
+the plan's injector — latency, jittered latency, hang, fail-N-then-
+succeed, error-rate, or hard-down — then delegates to the in-memory
+tables, so tests and chaos benchmarks exercise the service's real
+degradation paths (store.resilient) against real data.
+
+Injectors are process-wide, keyed by plan text: "fail the first 3
+calls" counts across the per-request store instances the service
+constructs, and a test can flip plans mid-run just by changing the env
+var (each request re-reads it). `reset_faults()` restarts the counters.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from store.memory import _InMemoryMixin
+from store.base import DatabaseTSP, DatabaseVRP
+from vrpms_tpu.testing.faults import FaultInjector, parse_plan
+
+_lock = threading.Lock()
+_injectors: dict[str, FaultInjector] = {}
+
+
+def injector_for(plan_text: str) -> FaultInjector:
+    """The process-wide injector for this plan (parse validates it)."""
+    with _lock:
+        inj = _injectors.get(plan_text)
+        if inj is None:
+            inj = _injectors[plan_text] = FaultInjector(parse_plan(plan_text))
+        return inj
+
+
+def reset_faults() -> None:
+    """Forget all injectors (fail-N counters restart) — test hygiene."""
+    with _lock:
+        _injectors.clear()
+
+
+class _FaultyMixin(_InMemoryMixin):
+    def __init__(self, auth=None, plan: str = ""):
+        super().__init__(auth)
+        self._injector = injector_for(plan)
+
+    # -- reads --------------------------------------------------------------
+    def _fetch_row(self, table, row_id):
+        self._injector.apply("read")
+        return super()._fetch_row(table, row_id)
+
+    def _owner_email(self):
+        self._injector.apply("read")
+        return super()._owner_email()
+
+    def _fetch_warmstart(self, owner, name):
+        self._injector.apply("read")
+        return super()._fetch_warmstart(owner, name)
+
+    def _fetch_job(self, job_id):
+        self._injector.apply("read")
+        return super()._fetch_job(job_id)
+
+    # -- writes -------------------------------------------------------------
+    def _insert_solution(self, data):
+        self._injector.apply("write")
+        return super()._insert_solution(data)
+
+    def _upsert_warmstart(self, owner, name, state):
+        self._injector.apply("write")
+        return super()._upsert_warmstart(owner, name, state)
+
+    def _upsert_job(self, job_id, record):
+        self._injector.apply("write")
+        return super()._upsert_job(job_id, record)
+
+
+class FaultyDatabaseVRP(_FaultyMixin, DatabaseVRP):
+    pass
+
+
+class FaultyDatabaseTSP(_FaultyMixin, DatabaseTSP):
+    pass

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 
 from store.base import Database, DatabaseTSP, DatabaseVRP
+from vrpms_tpu.obs import log_event
 
 
 class _SupabaseMixin(Database):
@@ -35,10 +36,22 @@ class _SupabaseMixin(Database):
         if auth:
             try:
                 self.client.auth.set_session(access_token=auth, refresh_token=auth)
-            except Exception:
+            except Exception as exc:
                 # Reference parity: login failures surface later as
-                # missing-owner / row-level-security errors, not here.
-                pass
+                # missing-owner / row-level-security errors, not here —
+                # but not silently: the request is RLS-doomed, so
+                # operators get a structured warning and a counter.
+                log_event(
+                    "store.auth_failed",
+                    level="warn",
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+                try:
+                    from service import obs
+
+                    obs.AUTH_FAILURES.inc()
+                except Exception:
+                    pass  # telemetry must not change auth semantics
 
     def _fetch_row(self, table: str, row_id):
         result = self.client.table(table).select("*").eq("id", row_id).execute()

@@ -1,0 +1,517 @@
+"""Unit tests for the resilience layer (ISSUE 3): the fault-plan DSL,
+the retry/backoff + circuit-breaker state machine, degraded-mode
+cache/journal fallbacks of the resilient store wrapper, and scheduler
+worker supervision (crash -> requeue-once -> clean second-crash
+failure). No HTTP, no jax — tests/test_chaos.py covers end-to-end.
+"""
+
+import threading
+import time
+
+import pytest
+
+# the supervision tests kill worker threads ON PURPOSE (SystemExit in a
+# stub runner) — the thread-death is the scenario, not a test leak
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+
+from store.base import Database, DatabaseVRP
+from store.resilient import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    FallbackStore,
+    ResilientDatabaseVRP,
+    StoreUnavailable,
+    WriteJournal,
+    backoff_s,
+    reset_resilience,
+)
+from vrpms_tpu.testing.faults import FaultInjector, StoreFault, parse_plan
+
+
+@pytest.fixture(autouse=True)
+def fresh_state(monkeypatch):
+    # fast policy defaults for every test; individual tests override
+    monkeypatch.setenv("VRPMS_STORE_DEADLINE_S", "1.0")
+    monkeypatch.setenv("VRPMS_STORE_RETRIES", "2")
+    monkeypatch.setenv("VRPMS_STORE_BACKOFF_S", "0.001")
+    monkeypatch.setenv("VRPMS_CB_FAILURES", "3")
+    monkeypatch.setenv("VRPMS_CB_RESET_S", "0.15")
+    reset_resilience()
+    yield
+    reset_resilience()
+
+
+# ---------------------------------------------------------------------------
+# Fault-plan DSL
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_parses_full_plan(self):
+        p = parse_plan("fail=3; latency=0.01, jitter=0.02;rate=0.5;"
+                       "ops=reads;seed=7;hang=1.5")
+        assert p.fail_n == 3
+        assert p.latency_s == 0.01
+        assert p.jitter_s == 0.02
+        assert p.rate == 0.5
+        assert p.ops == "reads"
+        assert p.seed == 7
+        assert p.hang_s == 1.5
+        assert not p.down
+
+    def test_empty_and_down(self):
+        assert parse_plan("") == parse_plan(None)
+        assert parse_plan("down").down is True
+
+    @pytest.mark.parametrize(
+        "bad", ["nonsense", "fail=x", "rate=1.5", "ops=sometimes",
+                "latency=-1", "down=maybe"]
+    )
+    def test_bad_tokens_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_plan(bad)
+
+    def test_fail_n_then_succeed(self):
+        inj = FaultInjector(parse_plan("fail=3"))
+        for _ in range(3):
+            with pytest.raises(StoreFault):
+                inj.apply("read")
+        inj.apply("read")  # 4th call clean
+        assert inj.faults == 3 and inj.calls == 4
+
+    def test_ops_filter(self):
+        inj = FaultInjector(parse_plan("down;ops=writes"))
+        inj.apply("read")  # unmatched: no fault, not even counted
+        with pytest.raises(StoreFault):
+            inj.apply("write")
+        assert inj.calls == 1
+
+    def test_rate_is_seeded_and_approximate(self):
+        inj = FaultInjector(parse_plan("rate=0.3;seed=11"))
+        faults = 0
+        for _ in range(400):
+            try:
+                inj.apply("read")
+            except StoreFault:
+                faults += 1
+        assert 0.2 < faults / 400 < 0.4
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker + backoff
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestCircuitBreaker:
+    def test_opens_at_threshold_and_sheds(self):
+        clk = FakeClock()
+        cb = CircuitBreaker(threshold=3, reset_s=10.0, clock=clk)
+        assert cb.state == CLOSED
+        assert not cb.record_failure()
+        assert not cb.record_failure()
+        assert cb.record_failure()  # the opening failure reports True
+        assert cb.state == OPEN
+        assert not cb.allow()
+        # straggler failures while open don't extend the window
+        clk.now = 5.0
+        assert not cb.record_failure()
+        clk.now = 10.0
+        assert cb.state == HALF_OPEN
+
+    def test_half_open_admits_exactly_one_probe(self):
+        clk = FakeClock()
+        cb = CircuitBreaker(threshold=1, reset_s=1.0, clock=clk)
+        cb.record_failure()
+        clk.now = 1.0
+        assert cb.allow()  # the probe
+        assert not cb.allow()  # everyone else still shed
+        cb.record_failure()  # probe failed -> re-open, window restarts
+        assert cb.state == OPEN
+        assert not cb.allow()
+        clk.now = 2.0
+        assert cb.allow()
+        assert cb.record_success()  # recovery reported (journal replay cue)
+        assert cb.state == CLOSED
+        assert cb.allow() and cb.allow()  # closed admits everyone
+
+    def test_success_resets_failure_count(self):
+        cb = CircuitBreaker(threshold=2, reset_s=1.0, clock=FakeClock())
+        cb.record_failure()
+        assert not cb.record_success()  # was closed: not a "recovery"
+        cb.record_failure()
+        assert cb.state == CLOSED  # count restarted after the success
+
+
+class TestBackoff:
+    def test_jittered_exponential_within_bounds(self):
+        for attempt in range(4):
+            for _ in range(50):
+                v = backoff_s(attempt, 0.1)
+                assert 0.5 * 0.1 * 2**attempt <= v < 1.5 * 0.1 * 2**attempt
+
+    def test_capped(self):
+        assert backoff_s(30, 1.0) < 2.0 * 1.5 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Resilient store wrapper
+# ---------------------------------------------------------------------------
+
+
+class ScriptedDB(DatabaseVRP):
+    """Inner backend whose primitives fail `fail_reads`/`fail_writes`
+    times (or forever with -1), optionally sleeping first."""
+
+    def __init__(self, fail_reads=0, fail_writes=0, sleep_s=0.0):
+        super().__init__(None)
+        self.fail_reads = fail_reads
+        self.fail_writes = fail_writes
+        self.sleep_s = sleep_s
+        self.read_attempts = 0
+        self.write_attempts = 0
+        self.jobs: dict = {}
+        self.solutions: list = []
+
+    def _maybe_fail(self, kind):
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        if kind == "read":
+            self.read_attempts += 1
+            if self.fail_reads == -1 or self.read_attempts <= self.fail_reads:
+                raise RuntimeError("scripted read failure")
+        else:
+            self.write_attempts += 1
+            if self.fail_writes == -1 or self.write_attempts <= self.fail_writes:
+                raise RuntimeError("scripted write failure")
+
+    def _fetch_row(self, table, row_id):
+        self._maybe_fail("read")
+        return {"id": row_id, "locations": ["L"], "matrix": [[0]]}
+
+    def _owner_email(self):
+        self._maybe_fail("read")
+        return None
+
+    def _fetch_job(self, job_id):
+        self._maybe_fail("read")
+        return self.jobs.get(str(job_id))
+
+    def _upsert_job(self, job_id, record):
+        self._maybe_fail("write")
+        self.jobs[str(job_id)] = {"id": job_id, "record": record}
+
+    def _insert_solution(self, data):
+        self._maybe_fail("write")
+        self.solutions.append(data)
+        return data
+
+
+def resilient(inner, kind="testkind"):
+    return ResilientDatabaseVRP(inner, kind)
+
+
+class TestResilientReads:
+    def test_retries_then_succeeds(self):
+        inner = ScriptedDB(fail_reads=2)
+        db = resilient(inner)
+        errors: list = []
+        assert db.get_locations_by_id(1, errors) == ["L"]
+        assert not errors
+        assert inner.read_attempts == 3  # 2 failures + 1 success
+        assert db.degraded is False
+
+    def test_exhausted_retries_without_cache_is_an_error(self):
+        inner = ScriptedDB(fail_reads=-1)
+        db = resilient(inner)
+        errors: list = []
+        assert db.get_locations_by_id(1, errors) is None
+        assert errors and errors[0]["what"] == "Database read error"
+        assert inner.read_attempts == 3  # retries bounded
+
+    def test_deadline_bounds_a_hung_call(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_STORE_DEADLINE_S", "0.1")
+        monkeypatch.setenv("VRPMS_STORE_RETRIES", "0")
+        inner = ScriptedDB(sleep_s=2.0)
+        db = resilient(inner, kind="hungkind")
+        errors: list = []
+        t0 = time.monotonic()
+        assert db.get_locations_by_id(1, errors) is None
+        assert time.monotonic() - t0 < 1.0  # never the full 2s hang
+        assert "deadline" in errors[0]["reason"]
+
+    def test_deadline_bounds_the_whole_read_across_retries(self, monkeypatch):
+        # retries must NOT multiply the hang bound: attempts share one
+        # deadline budget, so a hung backend costs one deadline total
+        monkeypatch.setenv("VRPMS_STORE_DEADLINE_S", "0.2")
+        monkeypatch.setenv("VRPMS_STORE_RETRIES", "3")
+        inner = ScriptedDB(sleep_s=5.0)
+        db = resilient(inner, kind="hungkind2")
+        errors: list = []
+        t0 = time.monotonic()
+        assert db.get_locations_by_id(1, errors) is None
+        assert time.monotonic() - t0 < 0.2 * 2 + 0.3  # ~one budget, not 4
+
+    def test_circuit_opens_then_cache_serves_degraded(self):
+        inner = ScriptedDB()
+        db = resilient(inner)
+        errors: list = []
+        assert db.get_locations_by_id(7, errors) == ["L"]  # warms cache
+        inner.fail_reads = -1
+        # threshold 3, retries 2: one request's 3 failed attempts open it
+        db2 = resilient(inner)
+        assert db2.get_locations_by_id(7, errors) == ["L"]
+        assert db2.degraded is True
+        attempts = inner.read_attempts
+        # circuit now open: the next read sheds without touching inner
+        db3 = resilient(inner)
+        assert db3.get_locations_by_id(7, errors) == ["L"]
+        assert db3.degraded is True
+        assert inner.read_attempts == attempts
+
+    def test_open_circuit_without_cache_raises_unavailable(self):
+        inner = ScriptedDB(fail_reads=-1)
+        db = resilient(inner)
+        errors: list = []
+        db.get_locations_by_id(1, errors)  # opens the circuit
+        with pytest.raises(StoreUnavailable):
+            db._read("_fetch_row", ("locations", 99), cache_key=None)
+
+
+class TestResilientWrites:
+    def test_writes_are_at_most_once_then_journaled(self):
+        inner = ScriptedDB(fail_writes=-1)
+        db = resilient(inner)
+        assert db.save_job("j1", {"status": "queued"}) is True
+        assert inner.write_attempts == 1  # no inline write retry
+        assert db.degraded is True
+        # degraded read-your-writes: the spooled record is visible
+        errors: list = []
+        inner.fail_reads = -1
+        rec = resilient(inner).get_job("j1", errors)
+        assert rec == {"status": "queued"}
+
+    def test_journal_replays_on_recovery(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_CB_RESET_S", "0.05")
+        inner = ScriptedDB(fail_writes=1, fail_reads=2)
+        db = resilient(inner)
+        db.save_job("a", {"s": 1})   # spooled (write 1 fails; failure #1)
+        errors: list = []
+        db.get_locations_by_id(1, errors)  # failures #2-3 -> circuit opens
+        db2 = resilient(inner)
+        db2.save_job("b", {"s": 2})  # circuit open -> straight to journal
+        assert inner.jobs == {}
+        time.sleep(0.08)  # past reset_s: next call is the half-open probe
+        assert resilient(inner).get_locations_by_id(1, errors) == ["L"]
+        deadline = time.monotonic() + 2.0  # replay runs in the background
+        while set(inner.jobs) != {"a", "b"} and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert set(inner.jobs) == {"a", "b"}  # journal replayed in order
+        assert inner.jobs["b"]["record"] == {"s": 2}
+
+    def test_direct_write_supersedes_spooled_version(self, monkeypatch):
+        # a spooled 'running' record must never overwrite the 'done'
+        # record a post-recovery direct write already committed
+        monkeypatch.setenv("VRPMS_CB_RESET_S", "0.05")
+        inner = ScriptedDB(fail_writes=1, fail_reads=2)
+        db = resilient(inner)
+        db.save_job("j", {"status": "running"})  # spooled (failure #1)
+        errors: list = []
+        db.get_locations_by_id(1, errors)  # failures #2-3 -> circuit opens
+        time.sleep(0.08)
+        db2 = resilient(inner)
+        db2.save_job("j", {"status": "done"})  # half-open probe: direct write
+        deadline = time.monotonic() + 2.0
+        while len(db2._res.journal) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert inner.jobs["j"]["record"] == {"status": "done"}
+
+    def test_spooled_solution_insert_returns_sentinel(self):
+        inner = ScriptedDB(fail_writes=-1)
+        db = resilient(inner)
+        # drive _insert_solution directly to check the 200-not-400 deal:
+        # a spooled save must hand _save a non-None value
+        out = db._insert_solution({"name": "x"})
+        assert out == {"name": "x"}
+        assert db.degraded is True
+
+
+class TestFallbackBounds:
+    def test_fallback_store_evicts_stalest(self):
+        fb = FallbackStore(limit=2)
+        fb.put("a", 1)
+        fb.put("b", 2)
+        fb.get("a")  # refresh a
+        fb.put("c", 3)  # evicts b
+        assert fb.get("b") == (False, None)
+        assert fb.get("a") == (True, 1)
+
+    def test_journal_bounded_drops_oldest(self):
+        j = WriteJournal(limit=2)
+        j.append("m", (1,))
+        j.append("m", (2,))
+        j.append("m", (3,))
+        assert j.dropped == 1
+        assert [e[1][0] for e in j.drain()] == [2, 3]
+
+    def test_journal_discard_and_tombstone(self):
+        j = WriteJournal(limit=8)
+        j.append("m", (1,), key="k")
+        j.discard("k")
+        assert len(j) == 0 and j.stale("k")
+        j.append("m", (2,), key="k")  # a NEW spool lifts the tombstone
+        assert not j.stale("k") and len(j) == 1
+
+
+# ---------------------------------------------------------------------------
+# Worker supervision (watchdog)
+# ---------------------------------------------------------------------------
+
+from vrpms_tpu.sched import DONE, FAILED, Job, Scheduler  # noqa: E402
+
+
+def make_scheduler(runner, **kw):
+    kw.setdefault("queue_limit", 16)
+    kw.setdefault("window_s", 0.0)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("watchdog_s", 0.03)
+    kw.setdefault("wedge_grace_s", 0.15)
+    return Scheduler(runner, **kw)
+
+
+class TestSupervision:
+    def test_crash_requeues_once_and_completes(self):
+        crashes = []
+        events = []
+
+        def runner(jobs):
+            if jobs[0].payload.get("crash") and not crashes:
+                crashes.append(1)
+                raise SystemExit("worker dies")  # BaseException: thread death
+            for j in jobs:
+                j.result = {"run": "ok"}
+
+        s = make_scheduler(runner, on_event=lambda n, j: events.append(n))
+        try:
+            job = Job(payload={"crash": True})
+            s.submit(job)
+            assert job.wait(5.0), "requeued job never completed"
+            assert job.status == DONE and job.result == {"run": "ok"}
+            assert job.requeued is True
+            assert s.restarts.get("default") == 1
+            assert "requeued" in events
+        finally:
+            s.shutdown()
+
+    def test_second_crash_fails_cleanly(self):
+        def runner(jobs):
+            if jobs[0].payload.get("crash"):
+                raise SystemExit("worker dies again")
+            for j in jobs:
+                j.result = {}
+
+        events = []
+        s = make_scheduler(runner, on_event=lambda n, j: events.append(n))
+        try:
+            job = Job(payload={"crash": True})
+            s.submit(job)
+            assert job.wait(5.0), "poison job left hanging"
+            assert job.status == FAILED
+            assert job.errors[0]["what"] == "Scheduler crashed"
+            assert s.restarts.get("default") == 2
+            assert "crashed" in events
+        finally:
+            s.shutdown()
+
+    def test_queued_jobs_survive_a_crash(self):
+        def runner(jobs):
+            if jobs[0].payload.get("crash") and not jobs[0].requeued:
+                raise SystemExit("boom")
+            for j in jobs:
+                j.result = {"id": j.id}
+
+        s = make_scheduler(runner)
+        try:
+            first = Job(payload={"crash": True}, bucket=None)
+            behind = [Job(payload={}) for _ in range(2)]
+            s.submit(first)
+            for j in behind:
+                s.submit(j)
+            for j in [first] + behind:
+                assert j.wait(5.0), "job stranded by the crash"
+                assert j.status == DONE
+            assert not behind[0].requeued  # only in-flight jobs requeue
+        finally:
+            s.shutdown()
+
+    def test_wedged_worker_is_superseded(self):
+        release = threading.Event()
+        calls = []
+
+        def runner(jobs):
+            calls.append(len(jobs))
+            if len(calls) == 1:
+                release.wait(10.0)  # wedge: far past budget + grace
+                return
+            for j in jobs:
+                j.result = {"retry": True}
+
+        s = make_scheduler(runner)
+        try:
+            job = Job(payload={}, time_limit=0.1)
+            s.submit(job)
+            assert job.wait(5.0), "wedged job never superseded"
+            assert job.status == DONE and job.result == {"retry": True}
+            assert job.requeued is True
+            assert s.restarts.get("default") == 1
+        finally:
+            release.set()  # let the abandoned thread exit
+            s.shutdown()
+
+    def test_unbounded_jobs_never_wedge_detect(self):
+        release = threading.Event()
+
+        def runner(jobs):
+            release.wait(0.6)  # longer than grace, but no budget to breach
+            for j in jobs:
+                j.result = {}
+
+        s = make_scheduler(runner)
+        try:
+            job = Job(payload={})  # no time limit
+            s.submit(job)
+            assert job.wait(5.0)
+            assert job.status == DONE
+            assert not job.requeued
+            assert not s.restarts
+        finally:
+            release.set()
+            s.shutdown()
+
+    def test_worker_health_reports_dead_without_watchdog(self):
+        def runner(jobs):
+            raise SystemExit("die")
+
+        s = Scheduler(runner, watchdog_s=0.0)  # supervision off
+        try:
+            job = Job(payload={})
+            s.submit(job)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if s.worker_health().get("default") == "dead":
+                    break
+                time.sleep(0.02)
+            assert s.worker_health() == {"default": "dead"}
+        finally:
+            s.shutdown()

@@ -1,12 +1,14 @@
 """Async solve scheduler: admission queue, shape-bucketed micro-batcher,
-device-owning workers.
+device-owning workers, watchdog supervision.
 
 The subsystem between the HTTP layer and the jit-compiled solvers
 (ROADMAP "serves heavy traffic"): requests become Jobs on a bounded
 queue; one worker per backend drains it, merging same-shape jobs into
 one batched/vmapped launch (sched.batch.solve_sa_batch) within a small
-gather window. Generic pieces here are stdlib-only; the service wires
-the runner, the jobs HTTP surface, and persistence (service.jobs).
+gather window. A watchdog restarts dead/wedged workers and re-admits
+their in-flight batch exactly once (sched.worker). Generic pieces here
+are stdlib-only; the service wires the runner, the jobs HTTP surface,
+and persistence (service.jobs).
 """
 
 from vrpms_tpu.sched.batcher import gather_batch

@@ -28,21 +28,30 @@ def gather_batch(
     first: Job,
     window_s: float,
     max_batch: int,
+    on_take=None,
 ) -> list[Job]:
     """Collect jobs batchable with `first` (first included, FIFO order).
 
     Non-batchable jobs (bucket None) and a zero window return
     immediately — the solo path must not pay any gather latency beyond
     one lock acquisition.
+
+    `on_take(batch)` fires with the full batch-so-far each time jobs
+    are extracted from the queue: once taken they are in NO queue, so
+    the caller must be able to publish them to its supervision
+    snapshot immediately — a worker thread dying mid-gather must not
+    strand batch-mates the watchdog cannot see (sched.worker).
     """
     batch = [first]
     if first.bucket is None or max_batch <= 1:
         return batch
     deadline = time.monotonic() + max(window_s, 0.0)
     while len(batch) < max_batch:
-        batch.extend(
-            queue.take_matching(first.bucket, max_batch - len(batch))
-        )
+        taken = queue.take_matching(first.bucket, max_batch - len(batch))
+        if taken:
+            batch.extend(taken)
+            if on_take is not None:
+                on_take(batch)
         if len(batch) >= max_batch:
             break
         remaining = deadline - time.monotonic()

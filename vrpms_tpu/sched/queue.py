@@ -63,6 +63,10 @@ class Job:
     bucket: typing.Hashable = None
     time_limit: float | None = None
     request_id: str | None = None
+    # supervision: True once the watchdog re-admitted this job after a
+    # worker crash — the SECOND crash fails it instead (at-most-one
+    # requeue keeps a poison job from crash-looping the worker forever)
+    requeued: bool = False
     id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex[:16]
     )
@@ -80,10 +84,39 @@ class Job:
         default_factory=threading.Event, repr=False, compare=False
     )
 
+    # guards finish vs. reopen_for_requeue: the watchdog must never
+    # overwrite the status of a job a still-alive wedged thread is
+    # finishing at the same instant
+    _term_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
     def finish(self, status: str) -> None:
-        self.status = status
-        self.finished_at = time.time()
-        self.done_event.set()
+        """First terminal transition wins: after a wedged worker is
+        superseded and its batch requeued, BOTH the abandoned thread
+        (if it ever wakes) and the replacement may try to finish the
+        same job — the late call must not flip an already-terminal
+        status under a woken waiter."""
+        with self._term_lock:
+            if self.done_event.is_set():
+                return
+            self.status = status
+            self.finished_at = time.time()
+            self.done_event.set()
+
+    def reopen_for_requeue(self) -> bool:
+        """Atomically mark this job requeued-and-queued for its ONE
+        supervised retry — or return False if a racing finish() already
+        made it terminal (then the watchdog must leave it alone). The
+        crashed run's elapsed time is forgiven: without a fresh
+        submission clock the retry would expire the instant it popped."""
+        with self._term_lock:
+            if self.done_event.is_set():
+                return False
+            self.requeued = True
+            self.status = QUEUED
+            self.submitted_mono = time.monotonic()
+            return True
 
     def wait(self, timeout: float | None = None) -> bool:
         """Block until the job reaches a terminal state."""
@@ -186,6 +219,22 @@ class JobQueue:
                 if remaining <= 0:
                     return
                 self._not_empty.wait(remaining)
+
+    def restore(self, jobs: list[Job]) -> list[Job]:
+        """Re-admit supervised jobs at the FRONT, bypassing the
+        admission bound (they were admitted once already — shedding
+        them during a worker restart would turn supervision into data
+        loss). Returns the jobs that could NOT be restored (closed
+        queue) so the caller can fail them cleanly."""
+        if not jobs:
+            return []
+        with self._lock:
+            if self._closed:
+                return list(jobs)
+            self._items[:0] = jobs
+            self._pushes += 1
+            self._not_empty.notify_all()
+        return []
 
     def drain(self) -> list[Job]:
         """Close admission and return every queued job (shutdown path:

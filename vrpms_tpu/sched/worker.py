@@ -1,4 +1,4 @@
-"""Device-owning workers + the Scheduler facade.
+"""Device-owning workers + the Scheduler facade + the watchdog.
 
 One Worker thread per backend label owns that backend's device queue:
 it is the ONLY thread that runs solver code for its backend, so N HTTP
@@ -19,10 +19,31 @@ worker owns every status transition and ALWAYS completes each job
 (runner exceptions fail the whole batch cleanly — a job can never be
 left un-terminal, so a submit-and-wait caller can never hang).
 
+Supervision (ISSUE 3): a dead or wedged worker must not strand every
+future job. The Scheduler runs a watchdog thread that checks each
+worker every `watchdog_s` seconds:
+
+  * **dead** — the thread exited (a runner raised a BaseException the
+    batch guard does not catch, or a bug in the loop itself);
+  * **wedged** — a batch has been running past every member job's
+    remaining deadline budget plus `wedge_grace_s` (deadline checks
+    inside solvers are block-granular; the grace absorbs that).
+    Batches containing any unbounded job are exempt — there is no
+    budget to measure against.
+
+Recovery swaps in a fresh Worker (new thread + new queue: the old
+queue is closed so an abandoned-but-alive thread can never race the
+replacement for new work), restores the old queue's pending jobs in
+FIFO order, and re-admits the in-flight batch exactly once per job
+(`job.requeued`); a job whose SECOND run also crashes fails with a
+clean "Scheduler crashed" envelope instead of crash-looping. Job
+events: `requeued`, `crashed`; worker events via `on_worker_event`.
+
 `on_event(name, job)` is an optional observer hook (the service wires
 metrics + structured logs + store persistence there); observer failures
 are swallowed — telemetry must never kill the device loop. Events:
-queued, expired, started, done, failed, drained.
+queued, expired, started, done, failed, runner_error, requeued,
+crashed, drained.
 """
 
 from __future__ import annotations
@@ -74,6 +95,12 @@ class Worker(threading.Thread):
         self._max_batch = max_batch
         self._on_event = on_event
         self._halt = threading.Event()
+        # supervision surface: what is in flight and for how long it
+        # may legitimately run (None budget = unbounded, never wedged)
+        self._inflight_lock = threading.Lock()
+        self._inflight: list[Job] = []
+        self._inflight_since: float | None = None
+        self._inflight_budget: float | None = None
 
     def stop(self) -> None:
         self._halt.set()
@@ -86,20 +113,51 @@ class Worker(threading.Thread):
         except Exception:
             pass  # observers must never kill the device loop
 
+    # -- supervision surface ------------------------------------------------
+    def snapshot_inflight(self) -> list[Job]:
+        with self._inflight_lock:
+            return list(self._inflight)
+
+    def wedged(self, now_mono: float, grace_s: float) -> bool:
+        """Running past every member job's budget (plus grace)?"""
+        with self._inflight_lock:
+            since, budget = self._inflight_since, self._inflight_budget
+        if since is None or budget is None:
+            return False
+        return now_mono - since > budget + grace_s
+
     def run(self) -> None:  # pragma: no cover - exercised via Scheduler
         while not self._halt.is_set():
             job = self.queue.pop(timeout=0.1)
             if job is None:
                 continue
+            # the popped job is in NO queue now — and neither is any
+            # batch-mate the gather takes: publish each to the
+            # supervision snapshot the moment it leaves the queue, so
+            # a thread death anywhere from here on loses nothing
+            # (budget stays None until the batch actually starts — no
+            # wedge detection against gather time)
+            with self._inflight_lock:
+                self._inflight = [job]
+                self._inflight_since = self._inflight_budget = None
             batch = gather_batch(
-                self.queue, job, self._window_s, self._max_batch
+                self.queue, job, self._window_s, self._max_batch,
+                on_take=self._publish_inflight,
             )
+            self._publish_inflight(batch)
             self._run_batch(batch)
+
+    def _publish_inflight(self, jobs: list[Job]) -> None:
+        with self._inflight_lock:
+            self._inflight = list(jobs)
 
     def _run_batch(self, batch: list[Job]) -> None:
         now = time.monotonic()
         live: list[Job] = []
         for job in batch:
+            if job.done_event.is_set():
+                # a requeued job the abandoned worker later completed
+                continue
             job.queue_wait_s = now - job.submitted_mono
             if expired(job, now):
                 # never start a job with a spent budget — the client's
@@ -116,13 +174,34 @@ class Worker(threading.Thread):
             else:
                 live.append(job)
         if not live:
+            with self._inflight_lock:
+                self._inflight = []
+                self._inflight_since = self._inflight_budget = None
             return
         t0 = time.monotonic()
+        # wedge budget = SUM of member budgets: the runner may legally
+        # run members sequentially (batched-launch fallback retries
+        # each solo; sub-half-budget members are split to the solo path
+        # too — service.jobs._runner), so the max alone would declare a
+        # healthy sequential worker wedged and double-solve its batch
+        budget = 0.0
+        for job in live:
+            if not job.time_limit or job.time_limit <= 0:
+                budget = None  # any unbounded job exempts the batch
+                break
+            budget += max(0.0, job.time_limit - (job.queue_wait_s or 0.0))
+        with self._inflight_lock:
+            self._inflight = list(live)
+            self._inflight_since = t0
+            self._inflight_budget = budget
         for job in live:
             job.status = RUNNING
             job.started_at = time.time()
             job.batch_size = len(live)
             self._emit("started", job)
+        # NOTE deliberately no `finally` around the runner: on a
+        # BaseException (thread death) the in-flight snapshot must
+        # SURVIVE so the watchdog can requeue exactly these jobs.
         try:
             self._runner(live)
         except Exception as e:  # a runner bug must not strand waiters
@@ -132,6 +211,11 @@ class Worker(threading.Thread):
                         "what": "Scheduler error",
                         "reason": f"{type(e).__name__}: {e}",
                     }]
+                    # the envelope alone leaves scheduler bugs invisible
+                    # to operators: surface a reason-labeled failure
+                    # metric + structured event (service maps this to
+                    # jobs_failed{reason="runner"})
+                    self._emit("runner_error", job)
         elapsed = time.monotonic() - t0
         self.queue.note_job_seconds(elapsed / len(live))
         for job in live:
@@ -147,10 +231,13 @@ class Worker(threading.Thread):
                 }]
                 job.finish(FAILED)
                 self._emit("failed", job)
+        with self._inflight_lock:
+            self._inflight = []
+            self._inflight_since = self._inflight_budget = None
 
 
 class Scheduler:
-    """Admission front + per-backend workers + drain-on-shutdown.
+    """Admission front + per-backend workers + watchdog + drain.
 
     submit() never blocks and never runs solver code; it either admits
     the job to its backend's bounded queue or raises QueueFull. Workers
@@ -165,15 +252,39 @@ class Scheduler:
         window_s: float = 0.01,
         max_batch: int = 16,
         on_event=None,
+        watchdog_s: float = 0.5,
+        wedge_grace_s: float = 10.0,
+        on_worker_event=None,
     ):
         self._runner = runner
         self._queue_limit = queue_limit
         self._window_s = window_s
         self._max_batch = max_batch
         self._on_event = on_event
+        self._watchdog_s = watchdog_s
+        self._wedge_grace_s = wedge_grace_s
+        self._on_worker_event = on_worker_event
         self._workers: dict[str, Worker] = {}
         self._lock = threading.Lock()
         self._shutdown = False
+        self._watchdog: threading.Thread | None = None
+        self.restarts: dict[str, int] = {}
+        self.last_restart_mono: float | None = None
+
+    @property
+    def is_shutdown(self) -> bool:
+        with self._lock:
+            return self._shutdown
+
+    def _make_worker(self, backend: str) -> Worker:
+        return Worker(
+            backend,
+            JobQueue(self._queue_limit),
+            self._runner,
+            self._window_s,
+            self._max_batch,
+            self._on_event,
+        )
 
     def _worker(self, backend: str) -> Worker:
         with self._lock:
@@ -181,16 +292,15 @@ class Scheduler:
                 raise QueueFull(0, 1.0)
             w = self._workers.get(backend)
             if w is None:
-                w = Worker(
-                    backend,
-                    JobQueue(self._queue_limit),
-                    self._runner,
-                    self._window_s,
-                    self._max_batch,
-                    self._on_event,
-                )
+                w = self._make_worker(backend)
                 self._workers[backend] = w
                 w.start()
+            if self._watchdog is None and self._watchdog_s:
+                self._watchdog = threading.Thread(
+                    target=self._watch, name="vrpms-sched-watchdog",
+                    daemon=True,
+                )
+                self._watchdog.start()
             return w
 
     def submit(self, job: Job, backend: str = "default") -> Job:
@@ -211,6 +321,117 @@ class Scheduler:
     def queues(self) -> dict[str, int]:
         with self._lock:
             return {b: len(w.queue) for b, w in self._workers.items()}
+
+    # -- supervision --------------------------------------------------------
+    def worker_health(self) -> dict[str, str]:
+        """{backend: ok|wedged|dead} — the readiness probe's view."""
+        with self._lock:
+            pairs = list(self._workers.items())
+        now = time.monotonic()
+        out = {}
+        for backend, w in pairs:
+            if not w.is_alive():
+                out[backend] = "dead"
+            elif w.wedged(now, self._wedge_grace_s):
+                out[backend] = "wedged"
+            else:
+                out[backend] = "ok"
+        return out
+
+    def _watch(self) -> None:  # pragma: no cover - timing-driven loop
+        while True:
+            time.sleep(self._watchdog_s)
+            with self._lock:
+                if self._shutdown:
+                    return
+                pairs = list(self._workers.items())
+            now = time.monotonic()
+            for backend, w in pairs:
+                reason = None
+                if not w.is_alive():
+                    reason = "died"
+                elif w.wedged(now, self._wedge_grace_s):
+                    reason = "wedged"
+                if reason is not None:
+                    try:
+                        self._restart(backend, w, reason)
+                    except Exception:
+                        pass  # the watchdog itself must never die
+
+    def _emit_job(self, name: str, job: Job) -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(name, job)
+        except Exception:
+            pass
+
+    def _restart(self, backend: str, old: Worker, reason: str) -> None:
+        """Replace `old` with a fresh worker, preserving its work.
+
+        Swap first (new submits land on the replacement's queue), THEN
+        move jobs, THEN start the thread — so restored jobs keep their
+        FIFO position ahead of anything submitted during the swap.
+
+        A WEDGED (still-alive) worker cannot be killed, only
+        superseded: until its runner returns, its solve runs
+        concurrently with the replacement's — the one deliberate breach
+        of the one-solver-thread-per-backend invariant, priced against
+        stranding every future job. Size wedge_grace_s above the
+        slowest legitimate stall (cold jit compiles!) so a slow batch
+        is never mistaken for a hung one.
+        """
+        with self._lock:
+            if self._shutdown or self._workers.get(backend) is not old:
+                return  # already replaced (or shutting down)
+            replacement = self._make_worker(backend)
+            self._workers[backend] = replacement
+            self.restarts[backend] = self.restarts.get(backend, 0) + 1
+            self.last_restart_mono = time.monotonic()
+        old.stop()
+        pending = old.queue.drain()  # closes the old queue for good
+        readmit: list[Job] = []
+        for job in old.snapshot_inflight():
+            if job.done_event.is_set():
+                continue
+            if job.requeued:
+                # second loss of the same job: poison — fail it clean,
+                # with an honest cause (a wedged worker never crashed;
+                # it overran its budget — likely the job itself is the
+                # reason both runs stalled)
+                if reason == "died":
+                    what, how = "Scheduler crashed", "crashed"
+                else:
+                    what, how = "Scheduler stalled", "overran its budget"
+                job.errors = [{
+                    "what": what,
+                    "reason": (
+                        f"worker {how} twice while running this job; "
+                        "not requeueing again"
+                    ),
+                }]
+                job.finish(FAILED)
+                self._emit_job("crashed", job)
+            elif job.reopen_for_requeue():
+                # atomic vs. a racing finish() from a still-alive
+                # wedged thread; result/errors are left alone (that
+                # thread may be writing them — the retry overwrites)
+                readmit.append(job)
+                self._emit_job("requeued", job)
+        rejected = replacement.queue.restore(readmit + pending)
+        for job in rejected:  # only possible if shutdown raced us
+            job.errors = [{
+                "what": "Service unavailable",
+                "reason": "scheduler shut down during worker restart",
+            }]
+            job.finish(FAILED)
+            self._emit_job("drained", job)
+        replacement.start()
+        if self._on_worker_event is not None:
+            try:
+                self._on_worker_event("restart", backend, reason)
+            except Exception:
+                pass
 
     def shutdown(self, timeout: float = 5.0) -> int:
         """Drain: stop admission, fail every queued job cleanly, stop
@@ -236,5 +457,10 @@ class Scheduler:
                     except Exception:
                         pass
         for w in workers:
-            w.join(timeout)
+            # a restart racing shutdown may have swapped in a
+            # replacement that was never started (its halt flag and
+            # closed queue make start-after-shutdown a no-op loop);
+            # joining an unstarted thread raises
+            if w.is_alive():
+                w.join(timeout)
         return drained

@@ -1,0 +1,12 @@
+"""Test/chaos support utilities (stdlib-only, importable anywhere).
+
+Currently: the declarative fault-plan DSL (testing.faults) that drives
+the fault-injecting store wrapper (store.faulty) and the chaos
+benchmark (benchmarks.chaos_latency). Lives in the library package —
+not under tests/ — because the service selects it at runtime via
+`VRPMS_STORE=faulty:<plan>`.
+"""
+
+from vrpms_tpu.testing.faults import FaultInjector, FaultPlan, StoreFault, parse_plan
+
+__all__ = ["FaultInjector", "FaultPlan", "StoreFault", "parse_plan"]

@@ -1,0 +1,141 @@
+"""Declarative fault plans: the chaos DSL behind `VRPMS_STORE=faulty:<plan>`.
+
+A plan is a small `;`/`,`-separated token string describing how store
+calls should misbehave, so degradation paths can be exercised from
+tests, benchmarks, and a live shell without code changes:
+
+    down                         every matched call fails
+    fail=3                       the first 3 matched calls fail, then succeed
+    rate=0.25                    each matched call fails with probability 0.25
+    latency=0.05                 fixed sleep (seconds) before every matched call
+    jitter=0.02                  extra uniform [0, jitter) sleep on top
+    hang=30                      sleep this long before answering (a "hung"
+                                 backend — per-call deadlines must cut it)
+    ops=reads|writes|all         which operations the faults apply to (default all)
+    seed=7                       RNG seed for rate/jitter (deterministic runs)
+
+Examples: `fail=2;latency=0.01`, `rate=0.3;jitter=0.05;ops=writes`,
+`down;ops=reads`, `hang=30`.
+
+Injected failures raise StoreFault — an ordinary Exception, so they
+surface through the store seam's normal error envelopes exactly like a
+real backend error would. Stdlib-only by design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+
+OPS = ("reads", "writes", "all")
+
+
+class StoreFault(Exception):
+    """An injected store failure (the fault plan said so)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Parsed fault plan; all-zero defaults mean "no faults"."""
+
+    down: bool = False
+    fail_n: int = 0
+    rate: float = 0.0
+    latency_s: float = 0.0
+    jitter_s: float = 0.0
+    hang_s: float = 0.0
+    ops: str = "all"
+    seed: int = 0
+
+    def matches(self, op: str) -> bool:
+        return self.ops == "all" or self.ops == op + "s"
+
+
+def parse_plan(text: str | None) -> FaultPlan:
+    """Parse the DSL; raises ValueError with the offending token so a
+    typo'd VRPMS_STORE=faulty:<plan> fails loudly at store construction
+    (an ignored plan would silently test nothing)."""
+    fields: dict = {}
+    for token in (text or "").replace(",", ";").split(";"):
+        token = token.strip()
+        if not token:
+            continue
+        key, sep, value = token.partition("=")
+        key = key.strip()
+        value = value.strip()
+        try:
+            if key == "down" and not sep:
+                fields["down"] = True
+            elif key == "fail":
+                fields["fail_n"] = int(value)
+            elif key == "rate":
+                fields["rate"] = float(value)
+                if not 0.0 <= fields["rate"] <= 1.0:
+                    raise ValueError(value)
+            elif key == "latency":
+                fields["latency_s"] = float(value)
+            elif key == "jitter":
+                fields["jitter_s"] = float(value)
+            elif key == "hang":
+                fields["hang_s"] = float(value)
+            elif key == "ops":
+                if value not in OPS:
+                    raise ValueError(value)
+                fields["ops"] = value
+            elif key == "seed":
+                fields["seed"] = int(value)
+            else:
+                raise ValueError(key)
+        except ValueError:
+            raise ValueError(
+                f"bad fault-plan token {token!r} (plan {text!r}); see "
+                "vrpms_tpu.testing.faults for the DSL"
+            ) from None
+        if any(v < 0 for v in fields.values() if isinstance(v, (int, float))
+               and not isinstance(v, bool)):
+            raise ValueError(
+                f"fault-plan token {token!r} must be non-negative (plan {text!r})"
+            )
+    return FaultPlan(**fields)
+
+
+class FaultInjector:
+    """Applies a FaultPlan to a stream of calls (thread-safe).
+
+    One injector per plan per process (store.faulty keeps the registry)
+    so "fail the first N calls" counts across the per-request store
+    instances the service constructs.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._rng = random.Random(plan.seed)
+        self.calls = 0
+        self.faults = 0
+
+    def apply(self, op: str) -> None:
+        """Sleep/raise per the plan for one call of kind `op`
+        ("read" | "write"); a no-op for unmatched ops."""
+        plan = self.plan
+        if not plan.matches(op):
+            return
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self.calls += 1
+            roll = self._rng.random()
+            jitter = self._rng.random() * plan.jitter_s
+        delay = plan.latency_s + jitter + plan.hang_s
+        if delay > 0:
+            time.sleep(delay)
+        if plan.down or seq < plan.fail_n or roll < plan.rate:
+            with self._lock:
+                self.faults += 1
+            raise StoreFault(
+                f"injected fault ({op} call #{seq}, plan: down={plan.down} "
+                f"fail_n={plan.fail_n} rate={plan.rate})"
+            )
