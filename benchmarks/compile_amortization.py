@@ -1,0 +1,169 @@
+"""Mixed-size cold sweep: time-to-first-result and distinct compiles,
+seed (exact shapes) vs tiered (shape-tier canonicalization, core.tiers).
+
+The ISSUE-4 acceptance gate. A FRESH worker process per mode (so every
+jit cache starts empty; the persistent disk cache is disabled for the
+measurement — it composes with tiering but would mask the ratio) solves
+a stream of requests whose customer counts are drawn from 10-40,
+through the service's own dispatch (service.solve._run_solver). Per
+request we record its latency (= that request's time-to-first-result)
+and the XLA backend-compile count/time around it (vrpms_tpu.obs.
+compile — cache hits emit nothing, so the counter IS the distinct-
+compile count).
+
+  exact  — VRPMS_TIERS=off: every distinct size specializes its own
+           programs; a realistic mix compiles almost per request.
+  tiered — the default ladder: sizes collapse onto a handful of padded
+           tiers; after each tier's first sighting every request in it
+           is compile-free.
+
+Gate: tiered total time-to-first-result >= 3x lower, distinct compiles
+>= 4x fewer.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.compile_amortization \
+        [--requests 40] [--iters 128] [--pop 32] [--out records/...json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _worker(mode: str, requests: int, iters: int, pop: int) -> None:
+    os.environ["VRPMS_TIERS"] = "off" if mode == "exact" else ""
+    import numpy as np
+
+    from service.solve import _run_solver
+    from vrpms_tpu.core import tiers
+    from vrpms_tpu.io.synth import synth_cvrp
+    from vrpms_tpu.obs import compile as compile_obs
+
+    compile_obs.install()
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(10, 41, size=requests).tolist()
+    out = {"mode": mode, "sizes": sizes, "requests": []}
+    for n in sizes:
+        inst = tiers.maybe_pad(synth_cvrp(int(n), 3, seed=int(n)))
+        opts = {
+            "seed": 1, "population_size": pop, "iteration_count": iters,
+        }
+        errors: list = []
+        c0, s0 = compile_obs.snapshot()
+        t0 = time.perf_counter()
+        res, _ = _run_solver(inst, "sa", opts, {}, errors, "vrp", None)
+        ttfr = time.perf_counter() - t0
+        c1, s1 = compile_obs.snapshot()
+        assert res is not None and not errors, errors
+        out["requests"].append(
+            {
+                "n": int(n),
+                "ttfr_s": round(ttfr, 4),
+                "compiles": c1 - c0,
+                "compile_s": round(s1 - s0, 4),
+            }
+        )
+    total_c, total_s = compile_obs.snapshot()
+    out["distinct_compiles"] = total_c
+    out["compile_seconds"] = round(total_s, 3)
+    out["total_ttfr_s"] = round(sum(r["ttfr_s"] for r in out["requests"]), 3)
+    out["first_ttfr_s"] = out["requests"][0]["ttfr_s"]
+    print("RESULT " + json.dumps(out))
+
+
+def _spawn(mode: str, args) -> dict:
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        VRPMS_COMPILE_CACHE="off",  # honest cold start for BOTH modes
+        VRPMS_RATE_CACHE="/dev/null",
+    )
+    cmd = [
+        sys.executable, "-m", "benchmarks.compile_amortization",
+        "--worker", mode,
+        "--requests", str(args.requests),
+        "--iters", str(args.iters),
+        "--pop", str(args.pop),
+    ]
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    wall = time.perf_counter() - t0
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise SystemExit(f"{mode} worker failed ({proc.returncode})")
+    line = next(
+        ln for ln in proc.stdout.splitlines() if ln.startswith("RESULT ")
+    )
+    rec = json.loads(line[len("RESULT "):])
+    rec["process_wall_s"] = round(wall, 2)
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", choices=["exact", "tiered"])
+    ap.add_argument("--requests", type=int, default=40)
+    ap.add_argument("--iters", type=int, default=128)
+    ap.add_argument("--pop", type=int, default=32)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if args.worker:
+        _worker(args.worker, args.requests, args.iters, args.pop)
+        return
+
+    exact = _spawn("exact", args)
+    tiered = _spawn("tiered", args)
+    ratio_ttfr = exact["total_ttfr_s"] / max(tiered["total_ttfr_s"], 1e-9)
+    ratio_comp = exact["distinct_compiles"] / max(
+        tiered["distinct_compiles"], 1
+    )
+    record = {
+        "benchmark": "compile_amortization",
+        "backend": "cpu",
+        "requests": args.requests,
+        "iters": args.iters,
+        "pop": args.pop,
+        "exact": exact,
+        "tiered": tiered,
+        "ttfr_ratio": round(ratio_ttfr, 2),
+        "compile_ratio": round(ratio_comp, 2),
+        "gate": {
+            "ttfr_3x": ratio_ttfr >= 3.0,
+            "compiles_4x": ratio_comp >= 4.0,
+        },
+    }
+    print(
+        f"exact:  total TTFR {exact['total_ttfr_s']:8.2f}s  "
+        f"compiles {exact['distinct_compiles']:4d}  "
+        f"({exact['compile_seconds']}s compiling)"
+    )
+    print(
+        f"tiered: total TTFR {tiered['total_ttfr_s']:8.2f}s  "
+        f"compiles {tiered['distinct_compiles']:4d}  "
+        f"({tiered['compile_seconds']}s compiling)"
+    )
+    print(
+        f"ratios: TTFR {ratio_ttfr:.2f}x lower, "
+        f"compiles {ratio_comp:.2f}x fewer "
+        f"(gate: >=3x / >=4x -> "
+        f"{'PASS' if ratio_ttfr >= 3 and ratio_comp >= 4 else 'FAIL'})"
+    )
+    if args.out:
+        path = args.out
+        if not os.path.isabs(path):
+            path = os.path.join(os.path.dirname(__file__), path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(record, f, indent=2)
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -116,10 +116,12 @@ def main():
     parser.add_argument(
         "--warmup",
         default=os.environ.get("VRPMS_WARMUP", ""),
-        help="pre-trace solver programs for these instance shapes before "
-        "serving, e.g. '200x36,100x12x1024' (locations x vehicles "
-        "[x population]; locations = durations-matrix size incl. depot); "
-        "also via $VRPMS_WARMUP. See service.warmup.",
+        help="pre-trace solver programs before serving: 'tiers' (or "
+        "'auto') warms the shape-tier ladder in the BACKGROUND while "
+        "the port serves (core.tiers), or give explicit shapes "
+        "'200x36,100x12x1024' (locations x vehicles [x population]; "
+        "locations = durations-matrix size incl. depot) to warm "
+        "synchronously; also via $VRPMS_WARMUP. See service.warmup.",
     )
     args = parser.parse_args()
     if args.store:
@@ -127,13 +129,35 @@ def main():
     if args.fixtures:
         os.environ["VRPMS_FIXTURES"] = args.fixtures
         os.environ.setdefault("VRPMS_STORE", "memory")
-    # persistent XLA compile cache: restarted services skip the ~30s/shape
-    # TPU compiles (the north-star 10s budget assumes this is on)
+    # resolve the tier ladder ONCE at startup: a malformed VRPMS_TIERS
+    # must be a clear boot error, not a per-request envelope (the same
+    # fail-fast contract VRPMS_STORE resolution follows)
+    from vrpms_tpu.core import tiers
+
+    try:
+        lad = tiers.ladder()
+    except ValueError as e:
+        raise SystemExit(f"invalid VRPMS_TIERS: {e}") from e
+    # persistent XLA compile cache, ON by default: restarted services
+    # skip the ~30s/shape TPU compiles (the north-star 10s budget
+    # assumes this is on). A cache dir that cannot be created logs a
+    # compile_cache.degraded event (vrpms_tpu.utils) and the service
+    # runs on without it.
     from vrpms_tpu.utils import enable_compile_cache
 
     cache_dir = enable_compile_cache()
     obs.set_compile_cache(cache_dir)
-    if args.warmup:
+    if args.warmup in ("tiers", "auto"):
+        # tier-ladder warmup in the BACKGROUND: the port binds now and
+        # the default-schedule tier programs precompile behind it, so
+        # traffic landing after the warmup finishes never pays a
+        # compile for any size inside a warmed tier (core.tiers)
+        from service.warmup import start_background_warmup, warmup_tiers
+
+        start_background_warmup(warmup_tiers)
+    elif args.warmup:
+        # explicit shape specs stay synchronous (the operator asked for
+        # exactly these shapes to be hot before the port binds);
         # best-effort like the compile cache: a bad shape spec or a
         # transient backend error must not crash-loop the service before
         # the port ever binds
@@ -153,6 +177,7 @@ def main():
         port=args.port,
         store=os.environ.get("VRPMS_STORE", "auto"),
         compileCache=cache_dir or "off",
+        tiers="off" if lad is None else f"n<= {lad.n[-1] if lad.n else 0}",
     )
     print(
         f"vrpms_tpu service on :{args.port} "

@@ -120,11 +120,19 @@ def _bucket_key(prep: Prepared):
     except (TypeError, ValueError):
         return None  # junk values: the solo path owns the error envelope
     inst = prep.inst
+    # With shape tiering (core.tiers) the instance arrives PADDED, so
+    # `durations.shape`/`n_vehicles` here are already the TIER's — jobs
+    # for N=13 and N=15 customers land in one tier-16 bucket and merge
+    # into one vmapped launch, while feature flags (TW, het fleet, TD
+    # rank, slice width) still split buckets. The padded marker keeps a
+    # padded and an unpadded instance of coincidentally equal shape from
+    # stacking (their pytree structures differ).
     return (
         prep.problem,
         "sa",
         tuple(inst.durations.shape),
         int(inst.n_vehicles),
+        inst.n_real is not None,
         bool(inst.has_tw),
         bool(inst.het_fleet),
         int(inst.td_rank),

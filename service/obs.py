@@ -142,6 +142,24 @@ STORE_JOURNAL_DEPTH = REGISTRY.gauge(
     "Writes spooled in the in-memory journal awaiting replay, by kind",
     labels=("kind",),
 )
+COMPILE_TOTAL = REGISTRY.counter(
+    "vrpms_compile_total",
+    "XLA backend compiles performed by this process (cache hits emit "
+    "nothing — with shape tiering + the persistent cache this should "
+    "flatline after warmup)",
+)
+COMPILE_SECONDS = REGISTRY.histogram(
+    "vrpms_compile_seconds",
+    "XLA backend compile durations",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
+TIER_CACHE = REGISTRY.counter(
+    "vrpms_tier_cache_total",
+    "Shape-tier canonicalization outcomes: hit = this padded shape "
+    "signature was already seen by the process (compiled programs "
+    "available), miss = first sighting (the solve may pay compiles)",
+    labels=("outcome",),
+)
 UPTIME = REGISTRY.gauge(
     "vrpms_uptime_seconds", "Seconds since service process start"
 )
@@ -286,3 +304,38 @@ class MetricsHandler(RequestObsMixin, BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+
+# ---------------------------------------------------------------------------
+# Compile + tier-cache wiring (PR 4): the jax-facing aggregation lives in
+# vrpms_tpu.obs.compile / vrpms_tpu.core.tiers (no service imports there);
+# this module, imported by every entry point, points their observer seams
+# at the Prometheus instruments above.
+# ---------------------------------------------------------------------------
+
+
+def _record_compile(duration_s: float) -> None:
+    COMPILE_TOTAL.inc()
+    COMPILE_SECONDS.observe(duration_s)
+
+
+def _record_tier(outcome: str, _key) -> None:
+    TIER_CACHE.labels(outcome=outcome).inc()
+
+
+def _wire_compile_obs() -> None:
+    try:
+        from vrpms_tpu.obs import compile as compile_obs
+
+        compile_obs.on_compile(_record_compile)
+    except Exception:
+        pass
+    try:
+        from vrpms_tpu.core import tiers
+
+        tiers.set_tier_observer(_record_tier)
+    except Exception:
+        pass
+
+
+_wire_compile_obs()

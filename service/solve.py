@@ -40,6 +40,7 @@ from service import obs
 from vrpms_tpu.obs import collect_blocks, convergence_summary, log_event
 
 from vrpms_tpu.core import make_instance
+from vrpms_tpu.core import tiers
 from vrpms_tpu.core.encoding import routes_from_giant
 from vrpms_tpu.core.split import greedy_split_giant
 from vrpms_tpu.solvers import (
@@ -299,6 +300,10 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
     pop = opts.get("population_size")
     islands = opts.get("islands")
     w = w if w is not None else _request_weights(opts)
+    if warm is not None and inst.n_real is not None:
+        # checkpoint perms are over the REAL customers; a tier-padded
+        # solver's genome carries the phantom ids at its tail
+        warm = tiers.pad_perm(warm, inst)
     try:
         # validated whenever provided; elite pools only feed the
         # multi-start polish, so they are materialised only with it.
@@ -428,6 +433,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                         b,
                         greedy_split_giant(warm, inst),
                         resolve_eval_mode("auto"),
+                        length_real=inst.move_limit,
                     )
                 if ils_rounds:
                     from vrpms_tpu.solvers import ILSParams
@@ -470,6 +476,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     p.n_chains,
                     greedy_split_giant(warm, inst),
                     resolve_eval_mode("auto"),
+                    length_real=inst.move_limit,
                 )
             deadline = _deadline(opts)
             if ils_rounds:
@@ -548,6 +555,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                         per_isl * n_isl,
                         warm,
                         resolve_eval_mode("auto"),
+                        n_real_perm=inst.perm_limit,
                     )
                 return solve_ga_islands(
                     inst,
@@ -572,6 +580,7 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                     p.population,
                     warm,
                     resolve_eval_mode("auto"),
+                    n_real_perm=inst.perm_limit,
                 )
             return solve_ga(
                 inst,
@@ -721,6 +730,12 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
     t0 = time.perf_counter()
     w = _request_weights(opts)
     include_stats = bool(opts.get("include_stats"))
+    from vrpms_tpu.obs import compile as compile_obs
+
+    # THREAD-local snapshot: the solve runs (and compiles) on this
+    # thread, so a concurrent request or the background tier warmup
+    # can't leak into this solve's compile attribution
+    compiles0, compile_s0 = compile_obs.snapshot_local()
     # the block-trace collector is installed ONLY under includeStats:
     # without it the solver loops pay one ContextVar read per block and
     # the result stays byte-identical to the pre-telemetry contract
@@ -751,6 +766,14 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
         "warmStart": warm is not None,
         "localSearch": polished,
     }
+    compiles1, compile_s1 = compile_obs.snapshot_local()
+    if compiles1 > compiles0:
+        # the solve paid XLA compiles (first sighting of its shape tier
+        # in this process): surface what cold-start actually cost
+        stats["compile"] = {
+            "count": compiles1 - compiles0,
+            "seconds": round(compile_s1 - compile_s0, 3),
+        }
     if btrace is not None and btrace.blocks:
         stats["trace"] = btrace.blocks
         conv = convergence_summary(btrace.blocks)
@@ -851,6 +874,12 @@ def prepare_vrp(algorithm, params, opts, ga_params, locations, matrix,
         slice_minutes=slice_minutes,
         slice_axis=arrays["slice_axis"],
     )
+    # shape-tier canonicalization (core.tiers): every size in a tier
+    # shares one compiled program and one micro-batch bucket. The exact
+    # solvers (bf ladder) keep the real shape — enumeration cost scales
+    # factorially with the padded size.
+    if algorithm != "bf":
+        prep.inst = tiers.maybe_pad(prep.inst)
     prep.orig_ids = [locations[i]["id"] for i in active_pos]
     # SA/GA/ACO all consume a warm seed, islands included (round 3: the
     # island paths take perturbed checkpoint clones as their first-round
@@ -875,8 +904,9 @@ def finish_vrp(prep: Prepared, res, stats, extras, errors) -> dict:
     route_durs = np.asarray(bd.route_durations)
     demands = np.asarray(prep.inst.demands)
     depot_id = prep.anchor_id
+    n_real = None if prep.inst.n_real is None else int(prep.inst.n_real)
     vehicles = []
-    for r, route in enumerate(routes_from_giant(res.giant)):
+    for r, route in enumerate(routes_from_giant(res.giant, n_real)):
         if not route:
             continue
         vehicles.append(
@@ -1004,6 +1034,8 @@ def prepare_tsp(algorithm, params, opts, ga_params, locations, matrix,
         slice_minutes=slice_minutes,
         slice_axis=arrays["slice_axis"],
     )
+    if algorithm != "bf":
+        prep.inst = tiers.maybe_pad(prep.inst)  # see prepare_vrp
     prep.orig_ids = [locations[i]["id"] for i in active_pos]
     # SA/GA consume a warm seed only without islands; ACO warms its
     # colony incumbent either way (solve_aco/solve_aco_islands init_perm).
@@ -1027,8 +1059,12 @@ def prepare_tsp(algorithm, params, opts, ga_params, locations, matrix,
 def finish_tsp(prep: Prepared, res, stats, extras, errors) -> dict:
     """Decode a TSP SolveResult to the contract shape + checkpoint it."""
     start_node = prep.anchor_id
-    routes = routes_from_giant(res.giant)
-    tour = [start_node] + [prep.orig_ids[c] for c in routes[0]] + [start_node]
+    n_real = None if prep.inst.n_real is None else int(prep.inst.n_real)
+    routes = routes_from_giant(res.giant, n_real)
+    # the single vehicle's customers; padded tours may trail phantom
+    # separators, so concatenate every (real-customer) route segment
+    customers = [c for route in routes for c in route]
+    tour = [start_node] + [prep.orig_ids[c] for c in customers] + [start_node]
     result = {
         "duration": _as_float(res.breakdown.duration_sum),
         "vehicle": tour,

@@ -68,7 +68,12 @@ def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True) -> float:
     load_bnb()
     load_ngroute()
     for n, v, pop in parse_shapes(spec):
-        inst = synth_cvrp(n, v, seed=0)
+        # pad through the request path's canonicalization (identity when
+        # tiering is off): the warmed traces must be the PADDED ones the
+        # prepared requests actually run
+        from vrpms_tpu.core import tiers
+
+        inst = tiers.maybe_pad(synth_cvrp(n, v, seed=0))
         for algo in algorithms:
             errors: list = []
             # timeLimit 0 -> one 512-sweep deadline block (the program
@@ -118,3 +123,56 @@ def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True) -> float:
         print(f"[warmup] {spec} ({','.join(algorithms)}): {elapsed:.1f}s",
               file=sys.stderr)
     return elapsed
+
+
+def tier_warm_shapes(max_locations: int = 64, vehicles: int = 4) -> str:
+    """Default tier-ladder warmup spec: one NxV shape per node tier up
+    to `max_locations` (tiers beyond that are rare cold paths whose
+    compiles amortize on first contact), at one canonical vehicle tier.
+    Within a tier EVERY size shares the warmed programs — that is the
+    point of the canonicalization (core.tiers)."""
+    from vrpms_tpu.core import tiers
+
+    lad = tiers.ladder()
+    if lad is None:
+        return ""
+    v = tiers.tier_up(vehicles, lad.v) if lad.v else vehicles
+    ns = [n for n in lad.n if n <= max_locations] or list(lad.n[:1])
+    return ",".join(f"{n}x{v}" for n in ns)
+
+
+def warmup_tiers(max_locations: int = 64, log=True) -> float:
+    """Warm the default-schedule programs for the tier ladder: every
+    request whose padded shape lands on a warmed tier then solves at
+    steady-state latency from the first hit. Instances are padded
+    through the SAME tiers.maybe_pad path requests take, so the warmed
+    traces are exactly the ones traffic reuses."""
+    spec = tier_warm_shapes(max_locations)
+    if not spec:
+        if log:
+            print("[warmup] tiering off; nothing to warm", file=sys.stderr)
+        return 0.0
+    return warmup(spec)
+
+
+def start_background_warmup(fn, *args) -> "object":
+    """Run a warmup callable on a daemon thread so the service binds its
+    port (and serves /metrics + readiness) while the tier ladder
+    precompiles behind it — the VRPMS_WARMUP=tiers startup hook. Solves
+    arriving mid-warmup just compile their own shape as before; they
+    are never blocked by the thread."""
+    import threading
+
+    def run():
+        try:
+            fn(*args)
+        except Exception as e:  # never take the service down
+            from vrpms_tpu.obs import log_event
+
+            log_event(
+                "warmup.skipped", error=f"{type(e).__name__}: {e}"
+            )
+
+    t = threading.Thread(target=run, name="vrpms-warmup", daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,320 @@
+"""Padding-equivalence suite: a tier-padded instance must cost — and for
+the deterministic/masked solvers, SOLVE — exactly like its unpadded
+original on the real customers.
+
+Kernel level: every evaluation path (gather, one-hot, TW, TD, makespan)
+prices a padded tour bit-identically to the real tour it decodes to.
+Solver level: SA and GA replay the unpadded trajectory exactly (masked
+sampling draws the same values from the same keys), BF enumerates to
+the same optimum, and ACO/ILS return valid real tours whose reported
+cost re-prices identically on the unpadded instance.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from vrpms_tpu.core import tiers
+from vrpms_tpu.core.cost import (
+    CostWeights,
+    evaluate_giant,
+    exact_cost,
+    objective_batch,
+    objective_hot_batch,
+    total_cost,
+)
+from vrpms_tpu.core.encoding import (
+    giant_from_routes,
+    random_giant_batch,
+    routes_from_giant,
+)
+from vrpms_tpu.core.instance import make_instance
+from vrpms_tpu.io.synth import synth_cvrp, synth_vrptw
+
+LADDER = tiers.TierLadder(
+    tiers.DEFAULT_N_TIERS, tiers.DEFAULT_V_TIERS, tiers.DEFAULT_T_TIERS
+)
+
+
+def _het(n, v, seed):
+    base = synth_cvrp(n, v, seed=seed)
+    caps = [20.0 + 10.0 * i for i in range(v)]
+    return make_instance(
+        np.asarray(base.durations[0]),
+        demands=np.asarray(base.demands),
+        capacities=caps,
+    )
+
+
+def _td(n, v, seed, t):
+    rng = np.random.default_rng(seed)
+    d = rng.uniform(5.0, 50.0, size=(t, n, n))
+    d[:, 0, 0] = 0.0
+    return make_instance(
+        d,
+        demands=[0.0] + [1.0] * (n - 1),
+        capacities=[float(n)] * v,
+        slice_axis="first",
+    )
+
+
+def _tw_shifted(n, v, seed):
+    """TW instance with NONZERO depot ready and shift starts — the
+    regime where a padded tail's surplus separator closes would surface
+    in route durations if they were clamped into a real route instead
+    of dropped (regression for the rid-clamp segment-sum bug)."""
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(0, 100, (n, 2))
+    d = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
+    ready = np.full(n, 480.0)
+    due = np.full(n, 2000.0)
+    due[0] = 3000.0
+    return make_instance(
+        d,
+        demands=[0.0] + [1.0] * (n - 1),
+        capacities=[5.0] * v,
+        ready=ready.tolist(),
+        due=due.tolist(),
+        service=[0.0] + [10.0] * (n - 1),
+        start_times=[480.0] * v,
+    )
+
+
+VARIANTS = {
+    "capacity": lambda: synth_cvrp(13, 3, seed=1),
+    "tw": lambda: synth_vrptw(11, 3, seed=2),
+    "tw_shifted": lambda: _tw_shifted(10, 3, seed=7),
+    "het_fleet": lambda: _het(12, 3, seed=3),
+    "td_factorized": lambda: _td(12, 3, seed=4, t=3),  # rank <= 3: exact
+    "td_flat": lambda: _td(10, 2, seed=5, t=5),  # rank 5 > max: flat path
+}
+
+
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+def test_cost_kernels_padding_neutral(variant):
+    inst = VARIANTS[variant]()
+    p = tiers.pad_instance(inst, LADDER)
+    w = CostWeights.make(makespan=0.5)  # makespan priced: route durs too
+    gs = random_giant_batch(
+        jax.random.key(7), 8, inst.n_customers, inst.n_vehicles
+    )
+    pg = jnp.stack([tiers.canonical_giant(p, g) for g in gs])
+
+    c_real = np.asarray(objective_batch(gs, inst, w))
+    c_pad = np.asarray(objective_batch(pg, p, w))
+    np.testing.assert_array_equal(c_real, c_pad)
+
+    h_real = np.asarray(objective_hot_batch(gs, inst, w))
+    h_pad = np.asarray(objective_hot_batch(pg, p, w))
+    np.testing.assert_allclose(h_real, h_pad, rtol=0, atol=1e-3)
+
+    bd_r = evaluate_giant(gs[0], inst)
+    bd_p = evaluate_giant(pg[0], p)
+    for field in ("distance", "cap_excess", "tw_lateness"):
+        assert float(getattr(bd_r, field)) == float(getattr(bd_p, field))
+    assert float(bd_r.duration_max) == float(bd_p.duration_max)
+    assert float(bd_r.duration_sum) == float(bd_p.duration_sum)
+
+
+def test_phantom_is_an_exact_separator():
+    """Swapping an interior depot zero for a phantom id (and vice versa
+    in the tail) must not move the cost by a single ulp — the invariant
+    that makes masked moves over mixed zero/phantom separators sound."""
+    inst = synth_cvrp(11, 3, seed=9)
+    p = tiers.pad_instance(inst, LADDER)
+    w = CostWeights.make(makespan=1.0)
+    g = np.asarray(
+        tiers.canonical_giant(
+            p, random_giant_batch(jax.random.key(1), 1, 10, 3)[0]
+        )
+    )
+    zeros = [
+        i
+        for i in range(1, int(p.n_real) + int(p.v_real) - 1)
+        if g[i] == 0
+    ]
+    assert zeros
+    phantom = int(p.n_real)
+    tail = [i for i in range(len(g)) if g[i] == phantom]
+    g2 = g.copy()
+    g2[zeros[0]], g2[tail[0]] = phantom, 0
+    ca = total_cost(evaluate_giant(jnp.asarray(g), p), w)
+    cb = total_cost(evaluate_giant(jnp.asarray(g2), p), w)
+    assert float(ca) == float(cb)
+
+
+def _decode_real_cost(res, pinst, inst, w):
+    """Strip phantoms, rebuild the REAL giant route-aligned, and price
+    it on the unpadded instance."""
+    routes = routes_from_giant(res.giant, int(pinst.n_real))
+    cust = sorted(c for rt in routes for c in rt)
+    assert cust == list(range(1, int(pinst.n_real))), "invalid decode"
+    v = int(pinst.v_real)
+    aligned = (routes + [[]] * v)[:v]
+    assert sorted(c for rt in aligned for c in rt) == cust, (
+        "real customers in phantom-vehicle routes"
+    )
+    real_g = giant_from_routes(aligned, inst.n_customers, inst.n_vehicles)
+    return float(exact_cost(real_g, inst, w)[1])
+
+
+class TestSolverEquivalence:
+    def test_sa_exact_trajectory(self):
+        from vrpms_tpu.solvers.sa import SAParams, solve_sa
+
+        inst = synth_cvrp(13, 3, seed=5)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        # explicit temperatures: the auto scale is a masked mean whose
+        # f32 reduction order may differ by an ulp across shapes
+        sp = SAParams(
+            n_chains=32, n_iters=400, t_initial=50.0, t_final=0.5, knn_k=4
+        )
+        r1 = solve_sa(inst, key=7, params=sp, weights=w, mode="gather")
+        r2 = solve_sa(p, key=7, params=sp, weights=w, mode="gather")
+        assert float(r1.cost) == float(r2.cost)
+        assert _decode_real_cost(r2, p, inst, w) == float(r2.cost)
+
+    def test_sa_tw_exact_trajectory(self):
+        from vrpms_tpu.solvers.sa import SAParams, solve_sa
+
+        inst = synth_vrptw(11, 3, seed=6)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        sp = SAParams(
+            n_chains=16, n_iters=300, t_initial=20.0, t_final=0.5, knn_k=4
+        )
+        r1 = solve_sa(inst, key=3, params=sp, weights=w, mode="gather")
+        r2 = solve_sa(p, key=3, params=sp, weights=w, mode="gather")
+        assert float(r1.cost) == float(r2.cost)
+
+    def test_sa_tail_invariant(self):
+        from vrpms_tpu.solvers.sa import SAParams, solve_sa
+
+        inst = synth_cvrp(13, 3, seed=5)
+        p = tiers.pad_instance(inst, LADDER)
+        sp = SAParams(n_chains=16, n_iters=200, t_initial=50.0, t_final=0.5)
+        res = solve_sa(p, key=1, params=sp, mode="gather")
+        g = np.asarray(res.giant)
+        lim = int(p.n_real) + int(p.v_real)
+        real_pos = [i for i, x in enumerate(g) if 0 < x < int(p.n_real)]
+        assert max(real_pos) <= lim - 2
+
+    def test_ga_exact_trajectory(self):
+        from vrpms_tpu.solvers.ga import GAParams, solve_ga
+
+        inst = synth_cvrp(13, 3, seed=5)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        # immigrants off on both sides: the padded path disables them
+        # (static ruin shapes can't track the traced real size)
+        gp = GAParams(population=32, generations=60, immigrants=0)
+        g1 = solve_ga(inst, key=3, params=gp, weights=w, mode="gather")
+        g2 = solve_ga(p, key=3, params=gp, weights=w, mode="gather")
+        assert float(g1.cost) == float(g2.cost)
+        assert _decode_real_cost(g2, p, inst, w) == float(g2.cost)
+
+    def test_ga_het_fleet(self):
+        from vrpms_tpu.solvers.ga import GAParams, solve_ga
+
+        inst = _het(11, 3, seed=8)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        gp = GAParams(population=24, generations=40, immigrants=0)
+        g1 = solve_ga(inst, key=2, params=gp, weights=w, mode="gather")
+        g2 = solve_ga(p, key=2, params=gp, weights=w, mode="gather")
+        assert float(g1.cost) == float(g2.cost)
+
+    def test_bf_same_optimum(self):
+        from vrpms_tpu.solvers.bf import solve_vrp_bf
+
+        inst = synth_cvrp(6, 2, seed=2)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        b1 = solve_vrp_bf(inst, weights=w)
+        b2 = solve_vrp_bf(p, weights=w)
+        assert float(b1.cost) == float(b2.cost)
+
+    def test_aco_valid_and_consistent(self):
+        from vrpms_tpu.solvers.aco import ACOParams, solve_aco
+
+        inst = synth_cvrp(13, 3, seed=5)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        res = solve_aco(
+            p, key=1, params=ACOParams(n_ants=16, n_iters=20), weights=w
+        )
+        assert _decode_real_cost(res, p, inst, w) == float(res.cost)
+
+    def test_ils_valid_and_consistent(self):
+        from vrpms_tpu.solvers.ils import ILSParams, solve_ils
+        from vrpms_tpu.solvers.sa import SAParams
+
+        inst = synth_cvrp(13, 3, seed=5)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        ip = ILSParams(
+            rounds=2, sa=SAParams(n_chains=32, n_iters=150), pool=8,
+            polish_sweeps=8,
+        )
+        res = solve_ils(p, key=2, params=ip, weights=w, mode="gather")
+        assert _decode_real_cost(res, p, inst, w) == float(res.cost)
+        # tail invariant survives ruin-reseed + delta polish
+        g = np.asarray(res.giant)
+        lim = int(p.n_real) + int(p.v_real)
+        real_pos = [i for i, x in enumerate(g) if 0 < x < int(p.n_real)]
+        assert max(real_pos) <= lim - 2
+
+    def test_ils_moves_reseed_stays_masked(self):
+        """Regression: the 'moves' reseed must confine its perturbation
+        to the real prefix — an unmasked clone parks real customers in
+        the phantom tail where per-route segment sums drop their legs."""
+        from vrpms_tpu.solvers.ils import ILSParams, solve_ils
+        from vrpms_tpu.solvers.sa import SAParams
+
+        inst = synth_cvrp(13, 3, seed=5)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        ip = ILSParams(
+            rounds=3, sa=SAParams(n_chains=32, n_iters=100), pool=8,
+            polish_sweeps=4, reseed="moves",
+        )
+        res = solve_ils(p, key=4, params=ip, weights=w, mode="gather")
+        assert _decode_real_cost(res, p, inst, w) == float(res.cost)
+        g = np.asarray(res.giant)
+        lim = int(p.n_real) + int(p.v_real)
+        real_pos = [i for i, x in enumerate(g) if 0 < x < int(p.n_real)]
+        assert max(real_pos) <= lim - 2
+
+    def test_td_sa_exact_trajectory(self):
+        from vrpms_tpu.solvers.sa import SAParams, solve_sa
+
+        inst = _td(10, 2, seed=4, t=3)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        sp = SAParams(
+            n_chains=16, n_iters=150, t_initial=20.0, t_final=0.5, knn_k=4
+        )
+        r1 = solve_sa(inst, key=2, params=sp, weights=w, mode="gather")
+        r2 = solve_sa(p, key=2, params=sp, weights=w, mode="gather")
+        assert float(r1.cost) == float(r2.cost)
+
+    def test_warm_start_padded(self):
+        from vrpms_tpu.core.split import greedy_split_giant
+        from vrpms_tpu.solvers.sa import SAParams, perturbed_clones, solve_sa
+
+        inst = synth_cvrp(12, 3, seed=11)
+        p = tiers.pad_instance(inst, LADDER)
+        w = CostWeights.make()
+        warm = tiers.pad_perm(jnp.arange(1, 12, dtype=jnp.int32), p)
+        init = perturbed_clones(
+            jax.random.key(1), 16, greedy_split_giant(warm, p), "gather",
+            length_real=p.move_limit,
+        )
+        sp = SAParams(n_chains=16, n_iters=100, t_initial=5.0, t_final=0.5)
+        res = solve_sa(
+            p, key=1, params=sp, weights=w, init_giants=init, mode="gather"
+        )
+        assert _decode_real_cost(res, p, inst, w) == float(res.cost)

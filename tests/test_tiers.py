@@ -1,0 +1,213 @@
+"""Shape-tier canonicalization: ladder config, padding mechanics, the
+scheduler bucket coarsening, and the compile-count regression guard."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vrpms_tpu.core import tiers
+from vrpms_tpu.core.instance import BIG, make_instance
+from vrpms_tpu.io.synth import synth_cvrp
+
+LADDER = tiers.TierLadder(
+    tiers.DEFAULT_N_TIERS, tiers.DEFAULT_V_TIERS, tiers.DEFAULT_T_TIERS
+)
+
+
+class TestLadderConfig:
+    def test_default_spec(self):
+        lad = tiers.parse_tiers("")
+        assert lad.n == tiers.DEFAULT_N_TIERS
+        assert lad.v == tiers.DEFAULT_V_TIERS
+        assert lad.t == tiers.DEFAULT_T_TIERS
+
+    def test_off(self):
+        assert tiers.parse_tiers("off") is None
+        assert tiers.parse_tiers("none") is None
+
+    def test_custom_axes(self):
+        lad = tiers.parse_tiers("n=8,32,16;v=")
+        assert lad.n == (8, 16, 32)  # sorted
+        assert lad.v == ()  # explicitly disabled axis
+        assert lad.t == tiers.DEFAULT_T_TIERS  # untouched axis
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError):
+            tiers.parse_tiers("q=1,2")
+
+    def test_env_ladder(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", "off")
+        assert tiers.ladder() is None
+        monkeypatch.setenv("VRPMS_TIERS", "n=4,8")
+        assert tiers.ladder().n == (4, 8)
+
+    def test_tier_up(self):
+        assert tiers.tier_up(13, (8, 16, 24)) == 16
+        assert tiers.tier_up(16, (8, 16, 24)) == 16
+        assert tiers.tier_up(99, (8, 16, 24)) == 99  # beyond the ladder
+
+    def test_tier_up_multiple(self):
+        assert tiers.tier_up_multiple(8, (1, 8, 24, 48)) == 8
+        assert tiers.tier_up_multiple(12, (1, 8, 24, 48)) == 24
+        assert tiers.tier_up_multiple(7, (1, 8, 24, 48)) == 7  # no multiple
+
+
+class TestPadInstance:
+    def test_shapes_and_counts(self):
+        inst = synth_cvrp(13, 3, seed=0)
+        p = tiers.pad_instance(inst, LADDER)
+        assert p.durations.shape == (1, 16, 16)
+        assert p.n_vehicles == 4
+        assert int(p.n_real) == 13 and int(p.v_real) == 3
+        assert p.padded and not inst.padded
+        assert int(p.move_limit) == 13 + 3
+
+    def test_depot_alias_values(self):
+        inst = synth_cvrp(11, 2, seed=1)
+        p = tiers.pad_instance(inst, LADDER)
+        d = np.asarray(p.durations[0])
+        # phantom rows/cols copy the depot's; phantom-phantom legs free
+        assert np.array_equal(d[13, :11], d[0, :11])
+        assert np.array_equal(d[:11, 14], d[:11, 0])
+        assert d[13, 14] == 0.0 and d[0, 13] == 0.0
+        assert np.all(np.asarray(p.demands)[11:] == 0.0)
+        assert np.all(np.asarray(p.due)[11:] == BIG)
+        assert np.all(np.asarray(p.capacities)[2:] == 0.0)
+
+    def test_metadata_preserved(self):
+        inst = synth_cvrp(10, 2, seed=2)
+        het = make_instance(
+            np.asarray(inst.durations[0]),
+            demands=np.asarray(inst.demands),
+            capacities=[20.0, 30.0],
+        )
+        p = tiers.pad_instance(het, LADDER)
+        # the REAL fleet's het flag survives (phantom zero capacities
+        # must not flip solver paths)
+        assert p.het_fleet == het.het_fleet
+        assert p.has_tw == het.has_tw
+
+    def test_t_axis_tiles_exactly(self):
+        rng = np.random.default_rng(3)
+        d3 = rng.uniform(5, 50, size=(3, 6, 6))
+        d3[:, 0, 0] = 0
+        ti = make_instance(d3, slice_axis="first")
+        p = tiers.pad_instance(ti, LADDER)
+        assert p.n_slices == 24  # smallest ladder multiple of 3
+        dp = np.asarray(p.durations)
+        for s in range(24):
+            assert np.array_equal(dp[s, :6, :6], np.asarray(ti.durations[s % 3]))
+
+    def test_idempotent_and_off(self, monkeypatch):
+        inst = synth_cvrp(9, 2, seed=4)
+        p = tiers.pad_instance(inst, LADDER)
+        assert tiers.pad_instance(p, LADDER) is p
+        monkeypatch.setenv("VRPMS_TIERS", "off")
+        assert tiers.maybe_pad(inst) is inst
+
+    def test_pad_perm_and_canonical_giant(self):
+        inst = synth_cvrp(9, 2, seed=5)
+        p = tiers.pad_instance(inst, LADDER)
+        perm = jnp.arange(1, 9, dtype=jnp.int32)
+        padded = np.asarray(tiers.pad_perm(perm, p))
+        assert list(padded) == list(range(1, 9)) + list(range(9, 16))
+        real_g = jnp.asarray([0, 1, 2, 3, 4, 0, 5, 6, 7, 8, 0], jnp.int32)
+        g = np.asarray(tiers.canonical_giant(p, real_g))
+        assert g.shape == (15 + 2 + 1,)
+        assert list(g[:11]) == list(np.asarray(real_g))
+        assert sorted(g[11:]) == list(range(9, 16))
+
+
+def _prep(n, opts=None, tw=False):
+    rng = np.random.default_rng(n)
+    pts = rng.uniform(0, 100, (n, 2))
+    mat = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1)).tolist()
+    locations = [{"id": i, "demand": 1 if i else 0} for i in range(n)]
+    if tw:
+        for loc in locations[1:]:
+            loc["timeWindow"] = [0, 500]
+    params = {
+        "name": "t",
+        "capacities": [10.0, 10.0],
+        "start_times": [0, 0],
+        "ignored_customers": [],
+        "completed_customers": [],
+    }
+    base_opts = {"seed": 1, "population_size": 32, "iteration_count": 200}
+    base_opts.update(opts or {})
+    errors = []
+    from service.solve import prepare_vrp
+
+    prep = prepare_vrp("sa", params, base_opts, {}, locations, mat, errors)
+    assert not errors, errors
+    return prep
+
+
+class TestBucketCoarsening:
+    def test_same_tier_sizes_share_a_bucket(self, monkeypatch):
+        monkeypatch.delenv("VRPMS_TIERS", raising=False)
+        from service.jobs import _bucket_key
+
+        k13 = _bucket_key(_prep(13))
+        k15 = _bucket_key(_prep(15))
+        assert k13 is not None
+        assert k13 == k15  # both padded to the (16, 16) tier
+        assert k13[2] == (1, 16, 16)
+
+    def test_feature_flags_still_split(self, monkeypatch):
+        monkeypatch.delenv("VRPMS_TIERS", raising=False)
+        from service.jobs import _bucket_key
+
+        assert _bucket_key(_prep(13, tw=True)) != _bucket_key(_prep(13))
+        # unbatchable options force the solo path regardless of tiering
+        assert _bucket_key(_prep(13, opts={"include_stats": True})) is None
+
+    def test_tiering_off_keeps_exact_shapes(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", "off")
+        from service.jobs import _bucket_key
+
+        k13 = _bucket_key(_prep(13))
+        k15 = _bucket_key(_prep(15))
+        assert k13 != k15
+        assert k13[2] == (1, 13, 13)
+
+
+class TestCompileGuard:
+    def test_same_tier_back_to_back_compiles_once(self, monkeypatch):
+        """The CI regression guard for the whole feature: two different
+        sizes inside one tier, solved back to back through the service
+        dispatch, must pay XLA compiles AT MOST once — the second solve
+        reuses every program of the first (counted by the
+        vrpms_compile_total source, vrpms_tpu.obs.compile)."""
+        monkeypatch.delenv("VRPMS_TIERS", raising=False)
+        from service.solve import solve_prepared
+        from vrpms_tpu.obs import compile as compile_obs
+
+        compile_obs.install()
+
+        def solve(n):
+            errors = []
+            out = solve_prepared(_prep(n, opts={"iteration_count": 64}), errors)
+            assert out is not None and not errors, errors
+            return out
+
+        solve(17)  # first sighting of the tier-24 shape may compile
+        c1, _ = compile_obs.snapshot()
+        solve(21)  # same tier: must be compile-free
+        c2, _ = compile_obs.snapshot()
+        assert c2 - c1 == 0, f"second same-tier solve paid {c2 - c1} compiles"
+
+    def test_stats_report_compiles_on_cold_tier(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", "n=20;v=2;t=1")
+        from service.solve import solve_prepared
+
+        errors = []
+        out = solve_prepared(
+            _prep(14, opts={"iteration_count": 64, "include_stats": True}),
+            errors,
+        )
+        assert out is not None and not errors
+        # a 20-node tier is minted fresh for this test, so the solve
+        # must have paid (and reported) at least one compile
+        assert out["stats"]["compile"]["count"] >= 1

@@ -46,19 +46,36 @@ def random_giant_batch(key: jax.Array, batch: int, n_customers: int, n_vehicles:
     return jax.vmap(lambda k: random_giant(k, n_customers, n_vehicles))(keys)
 
 
-def route_ids(giant: jax.Array) -> jax.Array:
-    """Route index for every position; the leg leaving position k belongs
-    to route `route_ids(giant)[k]`. A route's closing depot-zero carries
-    the next route's id (it is position-of-departure for that route)."""
-    return jnp.cumsum((giant == 0).astype(jnp.int32)) - 1
+def separators(giant: jax.Array, n_real=None) -> jax.Array:
+    """bool mask of route separators: depot zeros, plus — on tier-padded
+    instances (core.tiers) — phantom nodes (ids >= n_real). Phantoms
+    carry depot-alias durations/attributes, so treating them as
+    separators makes every padded tour price EXACTLY like the real tour
+    it decodes to. `n_real` may be traced (Instance.n_real)."""
+    s = giant == 0
+    if n_real is not None:
+        s = s | (giant >= n_real)
+    return s
 
-def routes_from_giant(giant) -> list[list[int]]:
-    """Host-side decode: split on zeros into V customer lists."""
+
+def route_ids(giant: jax.Array, n_real=None) -> jax.Array:
+    """Route index for every position; the leg leaving position k belongs
+    to route `route_ids(giant)[k]`. A route's closing separator carries
+    the next route's id (it is position-of-departure for that route)."""
+    return jnp.cumsum(separators(giant, n_real).astype(jnp.int32)) - 1
+
+def routes_from_giant(giant, n_real: int | None = None) -> list[list[int]]:
+    """Host-side decode: split on separators into customer lists.
+
+    With `n_real` (tier-padded instances), phantom ids >= n_real are
+    separators like zeros — the decoded routes contain only real
+    customers and stay index-aligned with the cost kernels' route ids.
+    """
     g = np.asarray(giant).tolist()
     routes: list[list[int]] = []
     cur: list[int] = []
     for node in g[1:]:
-        if node == 0:
+        if node == 0 or (n_real is not None and node >= n_real):
             routes.append(cur)
             cur = []
         else:
@@ -83,10 +100,14 @@ def giant_from_routes(
     return jnp.asarray(flat, dtype=jnp.int32)
 
 
-def perm_from_giant(giant) -> np.ndarray:
-    """Host-side: customer visit order with separators stripped."""
+def perm_from_giant(giant, n_real: int | None = None) -> np.ndarray:
+    """Host-side: customer visit order with separators stripped (zeros,
+    plus phantom ids >= n_real on tier-padded instances)."""
     g = np.asarray(giant)
-    return g[g != 0]
+    keep = g != 0
+    if n_real is not None:
+        keep &= g < n_real
+    return g[keep]
 
 
 def is_valid_giant(giant, n_customers: int, n_vehicles: int) -> bool:

@@ -39,6 +39,8 @@ BIG = 1e9
         "start_times",
         "td_factors",
         "td_basis",
+        "n_real",
+        "v_real",
     ],
     meta_fields=["has_tw", "slice_minutes", "het_fleet", "td_rank"],
 )
@@ -60,6 +62,17 @@ class Instance:
     het_fleet:    static bool — capacities are non-uniform; split-based
                   fitness shortcuts (which assume one capacity) must
                   give way to exact per-vehicle giant-tour pricing.
+    n_real/v_real: TRACED real node / vehicle counts of a tier-padded
+                  instance (core.tiers), or None when unpadded. Node
+                  ids >= n_real are depot-alias phantoms: their
+                  duration rows/columns copy the depot's, demands and
+                  service are zero, windows are [ready[0], BIG] — so
+                  in the giant encoding a phantom behaves EXACTLY like
+                  a depot-zero route separator (core.encoding.
+                  separators). Carrying the counts as data (not
+                  metadata) is the whole point: every instance in a
+                  tier shares one compiled program, and the masks that
+                  confine search to the real prefix are dynamic.
     td_rank/td_factors/td_basis: the time-profile factorization
                   durations[t] == sum_r td_factors[r, t] * td_basis[r]
                   (exact to f32 noise), detected at build time for
@@ -85,6 +98,8 @@ class Instance:
     td_factors: jax.Array | None = None  # [R, T]
     td_basis: jax.Array | None = None  # [R, N, N]
     td_rank: int = 0
+    n_real: jax.Array | None = None  # i32 scalar: real node count (tiers)
+    v_real: jax.Array | None = None  # i32 scalar: real vehicle count
 
     @property
     def n_nodes(self) -> int:
@@ -105,6 +120,58 @@ class Instance:
     @property
     def time_dependent(self) -> bool:
         return self.n_slices > 1
+
+    @property
+    def padded(self) -> bool:
+        """Whether this instance carries tier padding (core.tiers).
+        None-ness of n_real is pytree STRUCTURE, so branching on it
+        inside jit stays static."""
+        return self.n_real is not None
+
+    @property
+    def real_nodes(self):
+        """Real node count: traced i32 when padded, python int otherwise."""
+        return self.n_nodes if self.n_real is None else self.n_real
+
+    @property
+    def real_vehicles(self):
+        return self.n_vehicles if self.v_real is None else self.v_real
+
+    @property
+    def perm_limit(self):
+        """Traced real CUSTOMER count on tier-padded instances — the
+        mask bound for permutation-genome operators (crossover cuts,
+        mutation windows, ruin seeds); None when unpadded (operators
+        then use their static full range)."""
+        return None if self.n_real is None else self.n_real - 1
+
+    @property
+    def move_limit(self):
+        """Effective giant-tour length L_real = n_real + v_real (the
+        real prefix [0, L_real) of a padded giant; the closing depot
+        zero sits at L_real - 1 and moves touch [1, L_real - 2]).
+        None when unpadded — callers then use the static length."""
+        if self.n_real is None:
+            return None
+        return self.n_real + self.v_real
+
+
+def mean_duration(inst: Instance) -> jax.Array:
+    """Mean of the slice-0 durations over REAL nodes only (jittable).
+
+    Tier-padded instances carry depot-alias values in phantom rows and
+    columns, so a plain matrix mean would skew with the tier size; the
+    masked mean keeps temperature scales and pheromone inits a function
+    of the real problem alone.
+    """
+    d = inst.durations[0]
+    if inst.n_real is None:
+        return jnp.mean(d)
+    nr = inst.n_real
+    m = (jnp.arange(d.shape[0]) < nr).astype(d.dtype)
+    return jnp.sum(d * m[:, None] * m[None, :]) / (
+        nr.astype(d.dtype) ** 2
+    )
 
 
 def travel_duration(

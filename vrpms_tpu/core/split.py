@@ -44,16 +44,26 @@ def _greedy_fresh(perm: jax.Array, inst: Instance) -> jax.Array:
     capacities[r] in vehicle order (routes bind to vehicles positionally
     in the giant encoding); routes past the fleet bound reuse the last
     vehicle's capacity, matching greedy_split_giant's cramming rule.
+
+    Tier-padded instances (core.tiers): the vehicle clamp uses the
+    TRACED real fleet bound, so phantom zero-capacity vehicles are
+    never consulted, and phantom customers (depot aliases, demand 0)
+    never open a route — they ride the incumbent route with zero-cost
+    legs, exactly like the trailing layout the padding promises.
     """
     caps = inst.capacities
     v = caps.shape[0]
     dem = inst.demands[perm]
     n = perm.shape[0]
+    v_last = (v - 1) if inst.v_real is None else (inst.v_real - 1)
+    nr = inst.n_real
 
     def step(carry, x):
         load, r = carry
-        dk, k = x
-        fresh = load + dk > caps[jnp.minimum(r, v - 1)]
+        dk, node, k = x
+        fresh = load + dk > caps[jnp.minimum(r, v_last)]
+        if nr is not None:
+            fresh = fresh & (node < nr)
         # position 0 is route 0 even when oversized (callers don't count
         # fresh[0] as an extra route)
         r = r + (fresh & (k > 0)).astype(jnp.int32)
@@ -61,7 +71,7 @@ def _greedy_fresh(perm: jax.Array, inst: Instance) -> jax.Array:
         return (load, r), fresh
 
     _, fresh = jax.lax.scan(
-        step, (jnp.float32(0.0), jnp.int32(0)), (dem, jnp.arange(n))
+        step, (jnp.float32(0.0), jnp.int32(0)), (dem, perm, jnp.arange(n))
     )
     return fresh
 
@@ -249,12 +259,15 @@ def greedy_split_giant(perm: jax.Array, inst: Instance) -> jax.Array:
 
     If greedy needs more than V routes, the surplus is crammed into the
     last vehicle (capacity penalty then reflects the violation), keeping
-    the output shape-valid.
+    the output shape-valid. Tier-padded instances clamp to the TRACED
+    real fleet, so real customers never land in a phantom vehicle's
+    slots.
     """
     n = perm.shape[0]
     v = inst.n_vehicles
+    v_last = (v - 1) if inst.v_real is None else (inst.v_real - 1)
     fresh = _greedy_fresh(perm, inst)
-    rid = jnp.minimum(jnp.cumsum(fresh.astype(jnp.int32)) - fresh[0], v - 1)
+    rid = jnp.minimum(jnp.cumsum(fresh.astype(jnp.int32)) - fresh[0], v_last)
     pos = 1 + jnp.arange(n) + rid
     giant = jnp.zeros(giant_length(n, v), dtype=jnp.int32)
     return giant.at[pos].set(perm.astype(jnp.int32))

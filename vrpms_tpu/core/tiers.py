@@ -1,0 +1,282 @@
+"""Shape-tier canonicalization: collapse the XLA compile space.
+
+Every distinct instance shape — node count N, vehicle count V, slice
+count T — specializes a fresh XLA program in every solver, so a
+realistic traffic mix pays a multi-second compile per size and the
+micro-batcher's shape buckets almost never collide. This module pads
+every incoming instance UP to a small ladder of canonical tiers with
+**provably cost-neutral** phantom structure, so one compiled program
+(persistent-cacheable, vrpms_tpu.utils.enable_compile_cache) serves
+every size in its tier and same-tier jobs merge into one vmapped
+launch (vrpms_tpu.sched.batch).
+
+The padding recipe, axis by axis:
+
+  N — phantom customers are DEPOT ALIASES: their duration rows and
+      columns copy the depot's (every slice), demands/service are the
+      depot's (zero), windows are [ready[0], BIG]. Combined with the
+      separator semantics in core.encoding.separators (a phantom id in
+      a giant tour splits routes exactly like a depot zero) this makes
+      any padded tour price bit-identically to the real tour it
+      decodes to: phantom legs contribute exact zeros, phantom
+      "routes" are empty, and a phantom standing in for an interior
+      separator reproduces the zero's capacity/TW accounting.
+  V — phantom vehicles get capacity 0 and shift start ready[0]. The
+      traced v_real clamp in core.split keeps the greedy/optimal
+      splits from ever binding a customer to one, and solver moves
+      never reach the tail (below), so phantom vehicles only ever hold
+      empty routes (cost 0) or phantom customers (demand 0 — no
+      excess against capacity 0).
+  T — slice counts pad only to MULTIPLES on the ladder, by tiling the
+      profile cyclically: (x % kT) % T == x % T, so the slice chosen
+      for every departure time is unchanged and the time-dependent
+      paths stay exact. A T with no ladder multiple stays as-is.
+
+The real counts ride on the Instance as TRACED data (n_real/v_real),
+and every solver confines its move/crossover/construction sampling to
+the real prefix with dynamic masks — so sizes within a tier share one
+trace instead of re-specializing jit.
+
+Canonical padded layout (what constructive inits emit): positions
+[0, L_real) hold the real giant tour exactly as the unpadded encoding
+would (L_real = n_real + v_real), positions [L_real, L) hold the
+phantom customers followed by the phantom vehicles' zeros. Masked
+moves touch [1, L_real - 2] only, so the tail is invariant.
+
+Env:
+  VRPMS_TIERS  — "off" disables tiering; empty/unset uses the default
+                 ladder; otherwise "n=8,16,...;v=1,2,...;t=1,8,..."
+                 (an omitted axis keeps its default, an axis set to
+                 nothing — e.g. "v=" — disables padding on that axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from vrpms_tpu.core.instance import Instance
+
+DEFAULT_N_TIERS = (8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512, 768, 1024)
+DEFAULT_V_TIERS = (1, 2, 4, 8, 16, 32, 64)
+DEFAULT_T_TIERS = (1, 8, 24, 48)
+
+
+@dataclasses.dataclass(frozen=True)
+class TierLadder:
+    n: tuple  # node-count tiers (depot included)
+    v: tuple  # vehicle-count tiers
+    t: tuple  # slice-count tiers (pad only to MULTIPLES of the real T)
+
+
+def parse_tiers(spec: str) -> TierLadder | None:
+    """VRPMS_TIERS grammar -> TierLadder (None = tiering off).
+
+    "off"/"0"/"none" disables; "" keeps defaults; otherwise semicolon-
+    separated axis specs "n=8,16,24", "v=1,2,4", "t=1,8,24". An axis
+    given with an empty value list disables padding on that axis only.
+    """
+    spec = (spec or "").strip()
+    if spec.lower() in ("off", "0", "none", "false"):
+        return None
+    axes = {"n": DEFAULT_N_TIERS, "v": DEFAULT_V_TIERS, "t": DEFAULT_T_TIERS}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, vals = part.partition("=")
+        key = key.strip().lower()
+        if key not in axes:
+            raise ValueError(f"unknown tier axis {key!r} in VRPMS_TIERS")
+        axes[key] = tuple(
+            sorted(int(x) for x in vals.split(",") if x.strip())
+        )
+    return TierLadder(n=axes["n"], v=axes["v"], t=axes["t"])
+
+
+def ladder() -> TierLadder | None:
+    """The process ladder from $VRPMS_TIERS (read per call: tests and
+    embedders toggle the env var; parsing a short string is free)."""
+    return parse_tiers(os.environ.get("VRPMS_TIERS", ""))
+
+
+def tier_up(value: int, tiers: tuple) -> int:
+    """Smallest tier >= value, or value itself beyond the ladder."""
+    for t in tiers:
+        if t >= value:
+            return t
+    return value
+
+
+def tier_up_multiple(value: int, tiers: tuple) -> int:
+    """Smallest tier that is BOTH >= value and a multiple of it (the
+    slice axis pads by cyclic tiling, which is exact only for
+    multiples); value itself when no tier qualifies."""
+    for t in tiers:
+        if t >= value and t % value == 0:
+            return t
+    return value
+
+
+# --- tier-cache observability ----------------------------------------------
+# A "hit" means this padded shape signature was already seen by this
+# process (its programs are in the jit caches, or at worst one disk-
+# cache load away); a "miss" is the first sighting — the solve about to
+# run may pay compiles. The observer seam keeps vrpms_tpu free of
+# service imports; service.obs wires Prometheus counters in.
+
+_seen_lock = threading.Lock()
+_seen_tiers: set = set()
+_observer = None
+
+
+def set_tier_observer(fn) -> None:
+    """fn(outcome: 'hit'|'miss', key: tuple) — called once per pad."""
+    global _observer
+    _observer = fn
+
+
+def _record_tier(key: tuple) -> str:
+    with _seen_lock:
+        outcome = "hit" if key in _seen_tiers else "miss"
+        _seen_tiers.add(key)
+    if _observer is not None:
+        try:
+            _observer(outcome, key)
+        except Exception:
+            pass
+    return outcome
+
+
+def tier_key(inst: Instance) -> tuple:
+    """The shape+metadata signature one compiled program serves."""
+    return (
+        tuple(inst.durations.shape),
+        int(inst.n_vehicles),
+        bool(inst.has_tw),
+        bool(inst.het_fleet),
+        int(inst.td_rank),
+        float(inst.slice_minutes),
+    )
+
+
+def pad_instance(inst: Instance, lad: TierLadder | None = None) -> Instance:
+    """Pad `inst` up to its (N, V, T) tier; host-side, returns a new
+    Instance carrying traced n_real/v_real. Instances already at tier
+    size are tagged too (every tiered instance shares one pytree
+    structure, which is what lets same-tier jobs stack)."""
+    lad = lad if lad is not None else ladder()
+    if lad is None:
+        return inst
+    if inst.n_real is not None:
+        return inst  # already padded
+    n = inst.n_nodes
+    v = inst.n_vehicles
+    t = inst.n_slices
+    nt = tier_up(n, lad.n) if lad.n else n
+    vt = tier_up(v, lad.v) if lad.v else v
+    tt = tier_up_multiple(t, lad.t) if lad.t else t
+
+    f32 = np.float32
+    d = np.asarray(inst.durations, dtype=f32)
+    dp = np.zeros((tt, nt, nt), f32)
+    for s in range(tt):
+        dp[s, :n, :n] = d[s % t]
+    # depot-alias phantoms: copy the depot column into phantom columns
+    # first, then the (now full-width) depot row into phantom rows, so
+    # phantom-to-phantom entries land on d[0, 0] == 0.
+    dp[:, :n, n:] = dp[:, :n, :1]
+    dp[:, n:, :] = dp[:, :1, :]
+
+    def pad_vec(vec, fill):
+        out = np.full(nt, fill, f32)
+        out[:n] = np.asarray(vec, dtype=f32)
+        return out
+
+    demands = pad_vec(inst.demands, 0.0)
+    service = pad_vec(inst.service, 0.0)
+    ready0 = float(np.asarray(inst.ready)[0])
+    ready = pad_vec(inst.ready, ready0)
+    from vrpms_tpu.core.instance import BIG
+
+    due = pad_vec(inst.due, BIG)
+    capacities = np.zeros(vt, f32)
+    capacities[:v] = np.asarray(inst.capacities, dtype=f32)
+    # phantom shift starts = depot ready: an empty phantom route's
+    # closing arrival is then exactly its start, so its elapsed time
+    # (and hence durationSum/makespan) stays zero
+    start_times = np.full(vt, ready0, f32)
+    start_times[:v] = np.asarray(inst.start_times, dtype=f32)
+
+    td_factors = td_basis = None
+    if inst.td_rank > 0:
+        fac = np.asarray(inst.td_factors, dtype=f32)  # [R, T]
+        td_factors = fac[:, np.arange(tt) % t]
+        bas = np.asarray(inst.td_basis, dtype=f32)  # [R, N, N]
+        bp = np.zeros((bas.shape[0], nt, nt), f32)
+        bp[:, :n, :n] = bas
+        bp[:, :n, n:] = bp[:, :n, :1]
+        bp[:, n:, :] = bp[:, :1, :]
+        td_basis = bp
+
+    out = Instance(
+        durations=jnp.asarray(dp),
+        demands=jnp.asarray(demands),
+        capacities=jnp.asarray(capacities),
+        ready=jnp.asarray(ready),
+        due=jnp.asarray(due),
+        service=jnp.asarray(service),
+        start_times=jnp.asarray(start_times),
+        has_tw=inst.has_tw,
+        slice_minutes=inst.slice_minutes,
+        # the REAL fleet's het flag: phantom zero-capacities are never
+        # read by the (v_real-clamped) split or by any non-empty route
+        het_fleet=inst.het_fleet,
+        td_factors=None if td_factors is None else jnp.asarray(td_factors),
+        td_basis=None if td_basis is None else jnp.asarray(td_basis),
+        td_rank=inst.td_rank,
+        n_real=jnp.int32(n),
+        v_real=jnp.int32(v),
+    )
+    _record_tier(tier_key(out))
+    return out
+
+
+def maybe_pad(inst: Instance) -> Instance:
+    """pad_instance under the env ladder; identity when tiering is off."""
+    lad = ladder()
+    return inst if lad is None else pad_instance(inst, lad)
+
+
+def pad_perm(perm, inst: Instance):
+    """Extend a REAL customer permutation (ids 1..n_real-1) with the
+    phantom ids at its tail — the warm-start seed adapter (a padded
+    solver's genome length is the tier's customer count)."""
+    if inst.n_real is None:
+        return perm
+    nr = int(inst.n_real)
+    phantoms = jnp.arange(nr, inst.n_nodes, dtype=jnp.int32)
+    return jnp.concatenate([jnp.asarray(perm, jnp.int32), phantoms])
+
+
+def canonical_giant(inst: Instance, real_giant) -> jnp.ndarray:
+    """Embed a REAL giant tour into the padded layout: the real tour
+    occupies [0, L_real) verbatim, phantoms then zeros fill the tail.
+    Host-side helper (tests, warm starts)."""
+    if inst.n_real is None:
+        return jnp.asarray(real_giant, jnp.int32)
+    nr, vr = int(inst.n_real), int(inst.v_real)
+    length = inst.n_customers + inst.n_vehicles + 1
+    g = np.zeros(length, np.int32)
+    real = np.asarray(real_giant)
+    if real.shape[0] != nr + vr:
+        raise ValueError(
+            f"real giant length {real.shape[0]} != L_real {nr + vr}"
+        )
+    g[: nr + vr] = real
+    g[nr + vr : nr + vr + (inst.n_nodes - nr)] = np.arange(nr, inst.n_nodes)
+    return jnp.asarray(g)

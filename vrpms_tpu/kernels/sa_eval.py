@@ -438,6 +438,10 @@ def pallas_supported(inst: Instance, batch: int) -> bool:
     dispatchers can fall back to XLA instead of failing at compile."""
     if not _PALLAS_OK or inst.has_tw or inst.time_dependent:
         return False
+    if inst.n_real is not None:
+        # tier-padded instances (core.tiers): the kernel's route logic
+        # keys on literal zeros and does not model phantom separators
+        return False
     if batch % 128:
         return False
     length = inst.n_customers + inst.n_vehicles + 1

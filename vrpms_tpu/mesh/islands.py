@@ -67,11 +67,24 @@ class IslandParams:
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """1-D island mesh over the available (or given) devices."""
+    """1-D island mesh over the available (or given) devices.
+
+    Canonicalized: the same device set always returns the SAME Mesh
+    object. Every jitted-factory cache below is keyed on the mesh, and
+    the service builds a mesh per request (_island_setup) — identity
+    reuse guarantees those caches hit regardless of how a given jax
+    version hashes Mesh, so no request can rebuild (and recompile) the
+    sharded programs.
+    """
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
+    return _mesh_for(tuple(devices))
+
+
+@lru_cache(maxsize=16)
+def _mesh_for(devices: tuple) -> Mesh:
     return Mesh(np.array(devices), ("islands",))
 
 
@@ -105,67 +118,6 @@ def _pick_champion(per_island_best, per_island_score):
 def _blocked_schedule(total: int, block: int):
     """(n_full_blocks, tail) with n_full_blocks*block + tail == total."""
     return total // block, total % block
-
-
-@lru_cache(maxsize=64)
-def _sa_islands_fn(mesh: Mesh, n_iters: int, island_params: IslandParams, mode: str):
-    """Build (and cache) the jitted sharded SA run for one configuration.
-
-    Cached on the hashable statics — Mesh, n_iters, migration schedule,
-    eval mode — so repeated solves reuse the compile; instance data,
-    temperatures, and keys stay dynamic arguments (keying on the full
-    SAParams would recompile whenever t_initial/t_final change, which
-    the trace never sees). A per-call jit(shard_map(...)) closure would
-    recompile every request.
-    """
-    n_isl = mesh.shape["islands"]
-    block_len = island_params.migrate_every
-    n_blocks, tail = _blocked_schedule(n_iters, block_len)
-    k_mig = island_params.n_migrants
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P("islands"), P(), P(), P(), P(), P(), P()),
-        out_specs=(P("islands"), P("islands")),
-        # Library scans (split/cost kernels) carry unvarying literals;
-        # skip the VMA replication checker rather than pvary them all.
-        check_vma=False,
-    )
-    def run(giants, k_run, inst, w, t0, t1, knn):
-        isl = jax.lax.axis_index("islands")
-        k_isl = jax.random.fold_in(k_run, isl)
-        costs = objective_batch_mode(giants, inst, w, mode)
-
-        def inner(st, it):
-            giants, costs, best_g, best_c = st
-            giants, costs = sa_chain_step(
-                giants, costs, k_isl, it, t0, t1, n_iters, inst, w, mode, knn
-            )
-            better = costs < best_c
-            best_g = jnp.where(better[:, None], giants, best_g)
-            best_c = jnp.where(better, costs, best_c)
-            return (giants, costs, best_g, best_c), None
-
-        def block(state, b):
-            state, _ = jax.lax.scan(
-                inner, state, b * block_len + jnp.arange(block_len)
-            )
-            giants, costs, best_g, best_c = state
-            giants, costs = _migrate(giants, costs, k_mig, "islands", n_isl)
-            return (giants, costs, best_g, best_c), None
-
-        state = (giants, costs, giants, costs)
-        state, _ = jax.lax.scan(block, state, jnp.arange(n_blocks))
-        if tail:
-            state, _ = jax.lax.scan(
-                inner, state, n_blocks * block_len + jnp.arange(tail)
-            )
-        _, _, best_g, best_c = state
-        champ = jnp.argmin(best_c)
-        return best_g[champ][None], best_c[champ][None]
-
-    return jax.jit(run)
 
 
 @lru_cache(maxsize=64)
@@ -321,9 +273,8 @@ def solve_sa_islands(
     single-shot one exactly when the deadline is never hit.
     `init_giants` ([B, L], B a multiple of the island count) overrides
     the constructive seeds — the warm-start/ILS-reseed hook. `pool` > 0
-    returns an elite pool (SolveResult.pool, best first): the per-island
-    champions (single-shot path; at most one per island) or the global
-    top chains (deadline path).
+    returns an elite pool (SolveResult.pool, best first): the global
+    top chains of the final sharded state.
     """
     w = weights or CostWeights.make()
     mode = resolve_eval_mode(mode)
@@ -356,42 +307,40 @@ def solve_sa_islands(
     knn = proposal_knn(inst, params.knn_k) if params.knn_k > 0 else None
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
     elite = None
-    if deadline_s is None:
-        run = _sa_islands_fn(mesh, n_iters, island_params, mode)
-        g_all, c_all = run(giants0, k_run, inst, w, t0j, t1j, knn)
-        g, c = _pick_champion(g_all, c_all)
-        if pool > 0:
-            order = jnp.argsort(c_all)[: min(pool, g_all.shape[0])]
-            elite = g_all[order]
-        done = n_iters
-    else:
-        from vrpms_tpu.solvers.sa import _sa_init_fn
+    from vrpms_tpu.solvers.sa import _sa_init_fn
 
-        block_len = island_params.migrate_every
-        k_mig = island_params.n_migrants
-        horizon = jnp.float32(n_iters)
-        costs0 = _sa_init_fn(mode)(giants0, inst, w)
-        state = (giants0, costs0, giants0, costs0)
+    block_len = island_params.migrate_every
+    k_mig = island_params.n_migrants
+    horizon = jnp.float32(n_iters)
+    costs0 = _sa_init_fn(mode)(giants0, inst, w)
+    state = (giants0, costs0, giants0, costs0)
 
-        def call(st, n, bl, start):
-            return _sa_islands_chunk_fn(mesh, n, bl, k_mig, mode)(
-                st, k_run, inst, w, t0j, t1j, knn, jnp.int32(start), horizon
-            )
-
-        from vrpms_tpu.mesh.sync import mesh_spans_processes
-
-        # ~512 iterations per host sync
-        state, done = _deadline_driver(
-            call, state, n_iters, block_len, 512, deadline_s,
-            multi_controller=mesh_spans_processes(mesh),
-            best_of=lambda st: st[3],
-            evals_per_iter=n_isl * chains_local,
+    def call(st, n, bl, start):
+        return _sa_islands_chunk_fn(mesh, n, bl, k_mig, mode)(
+            st, k_run, inst, w, t0j, t1j, knn, jnp.int32(start), horizon
         )
-        _, _, best_g, best_c = state
-        g, c = _champion(best_g, best_c)
-        if pool > 0:
-            order = jnp.argsort(best_c)[: min(pool, best_g.shape[0])]
-            elite = best_g[order]
+
+    from vrpms_tpu.mesh.sync import mesh_spans_processes
+
+    # Deadline-free solves drive the SAME bounded set of chunked
+    # programs with an infinite budget (the offsets/horizon are dynamic
+    # scalars), instead of the old single-shot factory keyed on the
+    # request's raw n_iters — which minted one fresh XLA program per
+    # distinct iteration budget, a per-request recompile under varied
+    # traffic. ~512 iterations per host sync.
+    state, done = _deadline_driver(
+        call, state, n_iters, block_len, 512,
+        float("inf") if deadline_s is None else deadline_s,
+        multi_controller=mesh_spans_processes(mesh),
+        best_of=lambda st: st[3],
+        evals_per_iter=n_isl * chains_local,
+    )
+    done = max(done, n_iters) if deadline_s is None else done
+    _, _, best_g, best_c = state
+    g, c = _champion(best_g, best_c)
+    if pool > 0:
+        order = jnp.argsort(best_c)[: min(pool, best_g.shape[0])]
+        elite = best_g[order]
     bd, cost = exact_cost(g, inst, w)
     return SolveResult(
         g,
@@ -400,63 +349,6 @@ def solve_sa_islands(
         jnp.int32(n_isl * chains_local * done),
         elite,
     )
-
-
-@lru_cache(maxsize=64)
-def _ga_islands_fn(
-    mesh: Mesh, local_params: GAParams, island_params: IslandParams, mode: str
-):
-    """Build (and cache) the jitted sharded GA run (see _sa_islands_fn)."""
-    n_isl = mesh.shape["islands"]
-    generations = local_params.generations
-    block_len = island_params.migrate_every
-    n_blocks, tail = _blocked_schedule(generations, block_len)
-    k_mig = island_params.n_migrants
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P("islands"), P(), P(), P()),
-        out_specs=(P("islands"), P("islands")),
-        check_vma=False,
-    )
-    def run(perms, k_run, inst, w):
-        fitness = perm_fitness_fn(inst, w, local_params.fleet_penalty, mode=mode)
-        isl = jax.lax.axis_index("islands")
-        k_isl = jax.random.fold_in(k_run, isl)
-        fits = fitness(perms)
-        champ0 = jnp.argmin(fits)
-
-        def inner(st, gen):
-            perms, fits, best_p, best_f = st
-            perms, fits = ga_generation(
-                perms, fits, k_isl, gen, fitness, local_params, mode,
-                d=inst.durations[0],
-            )
-            champ = jnp.argmin(fits)
-            better = fits[champ] < best_f
-            best_p = jnp.where(better, perms[champ], best_p)
-            best_f = jnp.where(better, fits[champ], best_f)
-            return (perms, fits, best_p, best_f), None
-
-        def block(state, b):
-            state, _ = jax.lax.scan(
-                inner, state, b * block_len + jnp.arange(block_len)
-            )
-            perms, fits, best_p, best_f = state
-            perms, fits = _migrate(perms, fits, k_mig, "islands", n_isl)
-            return (perms, fits, best_p, best_f), None
-
-        state = (perms, fits, perms[champ0], fits[champ0])
-        state, _ = jax.lax.scan(block, state, jnp.arange(n_blocks))
-        if tail:
-            state, _ = jax.lax.scan(
-                inner, state, n_blocks * block_len + jnp.arange(tail)
-            )
-        _, _, best_p, best_f = state
-        return best_p[None], best_f[None]
-
-    return jax.jit(run)
 
 
 @lru_cache(maxsize=64)
@@ -487,6 +379,7 @@ def _ga_islands_chunk_fn(
         fitness = perm_fitness_fn(inst, w, local_params.fleet_penalty, mode=mode)
         isl = jax.lax.axis_index("islands")
         k_isl = jax.random.fold_in(k_run, isl)
+        nrp = inst.perm_limit
         perms, fits, best_p1, best_f1 = state
         st = (perms, fits, best_p1[0], best_f1[0])
 
@@ -494,7 +387,7 @@ def _ga_islands_chunk_fn(
             perms, fits, best_p, best_f = st
             perms, fits = ga_generation(
                 perms, fits, k_isl, gen, fitness, local_params, mode,
-                d=inst.durations[0],
+                d=inst.durations[0], n_real_perm=nrp,
             )
             champ = jnp.argmin(fits)
             better = fits[champ] < best_f
@@ -585,8 +478,10 @@ def solve_ga_islands(
     local_params = dataclasses.replace(params, population=pop_local)
     generations = params.generations
     mode = resolve_eval_mode(mode)
-    per_gen = pop_local + immigrants_for(
-        local_params, pop_local, inst.n_customers
+    per_gen = pop_local + (
+        0
+        if inst.n_real is not None
+        else immigrants_for(local_params, pop_local, inst.n_customers)
     )
 
     k_init, k_run = jax.random.split(key)
@@ -595,38 +490,36 @@ def solve_ga_islands(
     else:
         perms0 = init_perms
 
-    if deadline_s is None:
-        run = _ga_islands_fn(mesh, local_params, island_params, mode)
-        p_all, f_all = run(perms0, k_run, inst, w)
-        best_perm, _ = _pick_champion(p_all, f_all)
-        pool_perms, pool_fits = p_all, f_all
-        done = generations
-    else:
-        block_len = island_params.migrate_every
-        k_mig = island_params.n_migrants
-        chunk_params = dataclasses.replace(local_params, generations=0)
-        fits0, best_p0, best_f0 = _ga_islands_init_fn(
-            params.fleet_penalty, n_isl, mode
-        )(perms0, inst, w)
-        state = (perms0, fits0, best_p0, best_f0)
+    block_len = island_params.migrate_every
+    k_mig = island_params.n_migrants
+    chunk_params = dataclasses.replace(local_params, generations=0)
+    fits0, best_p0, best_f0 = _ga_islands_init_fn(
+        params.fleet_penalty, n_isl, mode
+    )(perms0, inst, w)
+    state = (perms0, fits0, best_p0, best_f0)
 
-        def call(st, n, bl, start):
-            return _ga_islands_chunk_fn(
-                mesh, n, bl, chunk_params, k_mig, mode
-            )(st, k_run, inst, w, jnp.int32(start))
+    def call(st, n, bl, start):
+        return _ga_islands_chunk_fn(
+            mesh, n, bl, chunk_params, k_mig, mode
+        )(st, k_run, inst, w, jnp.int32(start))
 
-        from vrpms_tpu.mesh.sync import mesh_spans_processes
+    from vrpms_tpu.mesh.sync import mesh_spans_processes
 
-        # ~128 generations per host sync (a generation costs more)
-        state, done = _deadline_driver(
-            call, state, generations, block_len, 128, deadline_s,
-            multi_controller=mesh_spans_processes(mesh),
-            best_of=lambda st: st[3],
-            evals_per_iter=n_isl * per_gen,
-        )
-        _, _, best_p, best_f = state
-        best_perm, _ = _champion(best_p, best_f)
-        pool_perms, pool_fits = best_p, best_f
+    # One bounded set of chunked programs for every budget (deadline-
+    # free solves pass an infinite budget) — the old single-shot
+    # factory keyed on raw `generations` recompiled per distinct
+    # budget. ~128 generations per host sync (a generation costs more).
+    state, done = _deadline_driver(
+        call, state, generations, block_len, 128,
+        float("inf") if deadline_s is None else deadline_s,
+        multi_controller=mesh_spans_processes(mesh),
+        best_of=lambda st: st[3],
+        evals_per_iter=n_isl * per_gen,
+    )
+    done = max(done, generations) if deadline_s is None else done
+    _, _, best_p, best_f = state
+    best_perm, _ = _champion(best_p, best_f)
+    pool_perms, pool_fits = best_p, best_f
     giant = greedy_split_giant(best_perm, inst)
     bd, cost = exact_cost(giant, inst, w)
     elite = None

@@ -75,10 +75,20 @@ def _segment_src_map(lo, hi, mt, m, length: int) -> jax.Array:
     return jnp.where(mt == 0, src_rev, jnp.where(mt == 1, src_rot, src_swp))
 
 
-def random_src_map(key: jax.Array, batch: int, length: int) -> jax.Array:
-    """Batched proposal: a uniform random reverse/rotate/swap per chain."""
+def random_src_map(
+    key: jax.Array, batch: int, length: int, length_real=None
+) -> jax.Array:
+    """Batched proposal: a uniform random reverse/rotate/swap per chain.
+
+    `length_real` (traced; Instance.move_limit) confines the window to
+    the real prefix of a tier-padded tour: positions are drawn from
+    [1, length_real - 2], exactly the range an unpadded tour of that
+    size would use — so a padded chain replays the unpadded chain's
+    draws bit for bit from the same key.
+    """
+    eff = length if length_real is None else length_real
     k_pos, k_type, k_rot = jax.random.split(key, 3)
-    ij = jax.random.randint(k_pos, (batch, 2), 1, length - 1)
+    ij = jax.random.randint(k_pos, (batch, 2), 1, eff - 1)
     i = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
     j = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
     m = jax.random.randint(k_rot, (batch, 1), 1, 4)
@@ -115,15 +125,16 @@ def apply_src_map(giants: jax.Array, src: jax.Array, mode: str = "gather") -> ja
 
 
 def random_move_batch(
-    key: jax.Array, giants: jax.Array, mode: str = "gather"
+    key: jax.Array, giants: jax.Array, mode: str = "gather", length_real=None
 ) -> jax.Array:
     """Sample and apply one random move per chain; the SA batch proposal."""
-    src = random_src_map(key, giants.shape[0], giants.shape[1])
+    src = random_src_map(key, giants.shape[0], giants.shape[1], length_real)
     return apply_src_map(giants, src, mode=mode)
 
 
 def presample_move_params(
-    key: jax.Array, batch: int, length: int, n_steps: int, knn_width: int
+    key: jax.Array, batch: int, length: int, n_steps: int, knn_width: int,
+    length_real=None,
 ):
     """Draw EVERY random number an n_steps anneal block needs, in one
     shot: (i, r_or_j, mt, m, u) each [n_steps, batch].
@@ -139,23 +150,29 @@ def presample_move_params(
     """
     k_i, k_r, k_t, k_m, k_u = jax.random.split(key, 5)
     shape = (n_steps, batch)
-    i = jax.random.randint(k_i, shape, 1, length - 1, dtype=jnp.int32)
+    # tier-padded tours draw positions from the TRACED real prefix
+    # (same draws as an unpadded tour of the real size — the bound is a
+    # value, not a shape, so one compiled program serves every size)
+    eff = length if length_real is None else length_real
+    i = jax.random.randint(k_i, shape, 1, eff - 1, dtype=jnp.int32)
     if knn_width > 0:
         r = jax.random.randint(k_r, shape, 0, knn_width, dtype=jnp.int32)
     else:
-        r = jax.random.randint(k_r, shape, 1, length - 1, dtype=jnp.int32)
+        r = jax.random.randint(k_r, shape, 1, eff - 1, dtype=jnp.int32)
     mt = jax.random.randint(k_t, shape, 0, N_MOVE_TYPES, dtype=jnp.int32)
     m = jax.random.randint(k_m, shape, 1, 4, dtype=jnp.int32)
     u = jax.random.uniform(k_u, shape)
     return i, r, mt, m, u
 
 
-def window_from_params(i, r, mt, m, giants, knn, mode: str):
+def window_from_params(i, r, mt, m, giants, knn, mode: str, length_real=None):
     """(lo, hi, mt, m) columns for one presampled step.
 
     knn None: (i, r) are two uniform positions (random_src_map). Else r
     ranks into the candidate list of the node at position i and the
-    window closes at that neighbor's current position (knn_src_map)."""
+    window closes at that neighbor's current position (knn_src_map).
+    `length_real` clips the knn-endpoint position into the real prefix
+    of tier-padded tours."""
     if knn is None:
         j = r[:, None]
         i = i[:, None]
@@ -186,16 +203,21 @@ def window_from_params(i, r, mt, m, giants, knn, mode: str):
     else:
         a = jnp.take_along_axis(giants, i[:, None], axis=1)[:, 0]
         bnode = knn[a, r]
+    eff = length if length_real is None else length_real
     j = jnp.argmax(giants == bnode[:, None], axis=1).astype(jnp.int32)
-    j = jnp.clip(j, 1, length - 2)[:, None]
+    j = jnp.clip(j, 1, eff - 2)[:, None]
     i = i[:, None]
     return jnp.minimum(i, j), jnp.maximum(i, j), mt[:, None], m[:, None]
 
 
-def move_batch_from_params(i, r, mt, m, giants, knn, mode: str) -> jax.Array:
+def move_batch_from_params(
+    i, r, mt, m, giants, knn, mode: str, length_real=None
+) -> jax.Array:
     """Apply one presampled move per chain (the block-RNG twin of
     random_move_batch / knn_move_batch)."""
-    lo, hi, mtc, mc = window_from_params(i, r, mt, m, giants, knn, mode)
+    lo, hi, mtc, mc = window_from_params(
+        i, r, mt, m, giants, knn, mode, length_real
+    )
     src = _segment_src_map(lo, hi, mtc, mc, giants.shape[1])
     return apply_src_map(giants, src, mode=mode)
 
@@ -218,7 +240,30 @@ def proposal_knn(inst, k: int):
     if inst.has_tw:
         ready = np.asarray(inst.ready)
         d = d + 0.5 * np.abs(ready[:, None] - ready[None, :])
-    return knn_table(d, k)
+    if inst.n_real is None:
+        return knn_table(d, k)
+    # Tier-padded instance: candidate lists are built over the REAL
+    # subgraph only (phantom columns masked out — their depot-alias
+    # distances would otherwise flood every list), with width bounded
+    # by the real size so a padded solve draws the same ranks an
+    # unpadded one would. Phantom ROWS alias the depot's row: a phantom
+    # standing in for a route separator then proposes exactly what a
+    # depot zero at that position proposes.
+    nr = int(inst.n_real)
+    tbl = np.asarray(knn_table(d[:nr, :nr], min(k, nr - 1)))
+    # tier-constant WIDTH (table shape feeds the traces): a real size
+    # too small for k candidates repeats its last column — a duplicated
+    # candidate skews sampling slightly, never validity — so every size
+    # in the tier shares one compiled program
+    width = min(k, inst.n_nodes - 1)
+    if tbl.shape[1] < width:
+        tbl = np.concatenate(
+            [tbl] + [tbl[:, -1:]] * (width - tbl.shape[1]), axis=1
+        )
+    full = np.zeros((inst.n_nodes, tbl.shape[1]), tbl.dtype)
+    full[:nr] = tbl
+    full[nr:] = tbl[0]
+    return jnp.asarray(full)
 
 
 def knn_table(durations: jax.Array, k: int):
@@ -241,7 +286,10 @@ def knn_table(durations: jax.Array, k: int):
     return jnp.asarray(order.astype(np.int32))
 
 
-def knn_src_map(key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str):
+def knn_src_map(
+    key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str,
+    length_real=None,
+):
     """Candidate-list proposal: position i uniform, position j = where the
     tour currently visits a random K-nearest-neighbor of the node at i;
     then a uniform reverse/rotate/swap over [i, j]. Node lookups run as
@@ -250,8 +298,9 @@ def knn_src_map(key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str):
     """
     b, length = giants.shape
     n_nodes, k_width = knn.shape
+    eff = length if length_real is None else length_real
     k_i, k_r, k_type, k_rot = jax.random.split(key, 4)
-    i = jax.random.randint(k_i, (b, 1), 1, length - 1)
+    i = jax.random.randint(k_i, (b, 1), 1, eff - 1)
     r = jax.random.randint(k_r, (b,), 0, k_width)
     if mode != "gather":  # onehot/pallas: no elementwise gathers on TPU
         from vrpms_tpu.core.cost import _onehot, onehot_dtype
@@ -280,7 +329,7 @@ def knn_src_map(key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str):
     # Position of the neighbor node; a depot neighbor maps to the first
     # zero (position 0), clamped into the movable interior.
     j = jnp.argmax(giants == bnode[:, None], axis=1).astype(jnp.int32)
-    j = jnp.clip(j, 1, length - 2)[:, None]
+    j = jnp.clip(j, 1, eff - 2)[:, None]
     lo, hi = jnp.minimum(i, j), jnp.maximum(i, j)
     mt = jax.random.randint(k_type, (b, 1), 0, N_MOVE_TYPES)
     m = jax.random.randint(k_rot, (b, 1), 1, 4)
@@ -288,10 +337,11 @@ def knn_src_map(key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str):
 
 
 def knn_move_batch(
-    key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str = "gather"
+    key: jax.Array, giants: jax.Array, knn: jax.Array, mode: str = "gather",
+    length_real=None,
 ) -> jax.Array:
     """Sample and apply one candidate-list move per chain."""
-    src = knn_src_map(key, giants, knn, mode)
+    src = knn_src_map(key, giants, knn, mode, length_real)
     return apply_src_map(giants, src, mode=mode)
 
 

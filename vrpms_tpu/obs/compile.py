@@ -1,0 +1,84 @@
+"""XLA compile observability: count and time every backend compile.
+
+jax.monitoring emits a `/jax/core/compile/backend_compile_duration`
+event for every XLA compilation this process performs (cache hits —
+in-process jit cache or the persistent disk cache — emit nothing), so
+listening to it gives an exact distinct-compile counter and a compile-
+seconds histogram source with zero instrumentation in the solver code.
+
+This module owns only the jax-facing aggregation (stdlib + jax
+monitoring; no service imports). The service layer (service.obs) wires
+`on_compile` into its Prometheus registry, and the tier layer's
+includeStats path snapshots before/after a solve to attach a `compile`
+block when a request actually paid one.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_COMPILE_KEY = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_count = 0
+_seconds = 0.0
+_installed = False
+_callbacks: list = []
+# per-thread tallies: XLA compiles run synchronously on the dispatching
+# thread, so a thread-local snapshot attributes compiles to the solve
+# that actually paid them (a background tier warmup or a concurrent
+# request must not leak into another request's stats.compile block)
+_local = threading.local()
+
+
+def _listener(key: str, duration: float, **_kw) -> None:
+    global _count, _seconds
+    if key != _COMPILE_KEY:
+        return
+    with _lock:
+        _count += 1
+        _seconds += float(duration)
+        callbacks = tuple(_callbacks)
+    _local.count = getattr(_local, "count", 0) + 1
+    _local.seconds = getattr(_local, "seconds", 0.0) + float(duration)
+    for cb in callbacks:
+        try:
+            cb(float(duration))
+        except Exception:
+            pass
+
+
+def install() -> None:
+    """Register the jax.monitoring listener (idempotent, best-effort —
+    observability must never break a solve)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_listener)
+    except Exception:
+        pass
+
+
+def on_compile(cb) -> None:
+    """Register cb(duration_s) for every backend compile; installs the
+    listener on first use."""
+    install()
+    with _lock:
+        _callbacks.append(cb)
+
+
+def snapshot() -> tuple[int, float]:
+    """(total compiles, total compile seconds) so far this process."""
+    with _lock:
+        return _count, _seconds
+
+
+def snapshot_local() -> tuple[int, float]:
+    """(compiles, compile seconds) paid by the CALLING THREAD — the
+    per-request attribution source (see _local)."""
+    return getattr(_local, "count", 0), getattr(_local, "seconds", 0.0)

@@ -54,6 +54,13 @@ def stack_instances(insts: list[Instance]) -> Instance:
             raise ValueError("instances in one batch must share metadata")
         if other.durations.shape != first.durations.shape:
             raise ValueError("instances in one batch must share shapes")
+        if (other.n_real is None) != (first.n_real is None):
+            # pytree structures differ; the bucket key's padded marker
+            # should have split these
+            raise ValueError("padded and unpadded instances cannot stack")
+    # tier-padded instances: n_real/v_real are data leaves, so each
+    # stacked instance keeps its own traced real size — one vmapped
+    # launch serves a MIX of real sizes within the tier
     return jax.tree.map(lambda *xs: jnp.stack(xs), *insts)
 
 
@@ -125,7 +132,22 @@ def _batch_block_fn(n_block: int, mode: str):
             temps = anneal_temperature(it, t0s, t1s, horizon)
 
             def one(g, c, inst, knn, temp):
-                cands = move_batch_from_params(i, r, mt, m, g, knn, mode)
+                # the presampled stream is SHARED across the batch and
+                # drawn over the full padded length; tier-padded
+                # instances fold each draw into their OWN real prefix
+                # (positions {1..L_real-2}) so moves never touch the
+                # phantom tail. A modulo remap keeps the stream shared
+                # (its slight nonuniformity is irrelevant to SA).
+                lim = inst.move_limit
+                if lim is None:
+                    i2, r2 = i, r
+                else:
+                    span = lim - 2  # movable position count
+                    i2 = 1 + (i - 1) % span
+                    r2 = r if knns is not None else 1 + (r - 1) % span
+                cands = move_batch_from_params(
+                    i2, r2, mt, m, g, knn, mode, length_real=lim
+                )
                 cand_costs = objective_batch_mode_(cands, inst, w)
                 return metropolis_accept(g, c, cands, cand_costs, u, temp)
 
@@ -214,6 +236,9 @@ def solve_sa_batch(
     t0s = 0.05 * means
     t1s = jnp.maximum(1e-3, 0.002 * means)
 
+    # stackable by construction: the bucket key fixes the padded node
+    # count and knn_k, and proposal_knn returns a size-independent
+    # (tier-constant) width for padded instances
     knns = (
         jnp.stack([proposal_knn(inst, params.knn_k) for inst in padded])
         if params.knn_k > 0

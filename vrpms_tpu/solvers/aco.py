@@ -52,7 +52,9 @@ class ACOParams:
                               # raw construction noise; 0 = off
 
 
-def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto", knn_mask=None):
+def _construct_orders(
+    key, tau, eta, n_ants: int, mode: str = "auto", knn_mask=None, n_real=None
+):
     """All ants build customer orders in lockstep.
 
     Step k: score[a, c] = alpha*log tau[cur_a, c] + beta*log eta[cur_a, c]
@@ -67,6 +69,13 @@ def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto", knn_mask=N
     — the classic construction speed/quality lever (most good next hops
     are geometric neighbors) — falling back to all unvisited nodes for
     ants whose whole candidate list is already visited.
+
+    Tier-padded instances (`n_real` traced): phantom nodes start out
+    marked visited, so ants only ever construct over the real set; once
+    every real customer is placed the remaining steps emit depot zeros
+    (the all-masked argmax fallback), which the split prices as empty
+    separators — cost-neutral tail filler, exactly like the phantoms
+    the genome-level operators park there.
     """
     from vrpms_tpu.core.cost import resolve_eval_mode
 
@@ -92,6 +101,8 @@ def _construct_orders(key, tau, eta, n_ants: int, mode: str = "auto", knn_mask=N
         )
 
     visited0 = jnp.zeros((n_ants, n_nodes), dtype=bool).at[:, 0].set(True)
+    if n_real is not None:
+        visited0 = visited0 | (jnp.arange(n_nodes) >= n_real)[None, :]
     if hot:
         def step(carry, k):
             cur_oh, visited = carry
@@ -186,7 +197,8 @@ def aco_iteration(state, it, key, inst, w, params: ACOParams, knn_mask, hot: boo
     tau, best_perm, best_fit, pool_perms, pool_fits = state
     k_it = jax.random.fold_in(key, it)
     orders = _construct_orders(
-        k_it, tau ** params.alpha, eta, params.n_ants, knn_mask=knn_mask
+        k_it, tau ** params.alpha, eta, params.n_ants, knn_mask=knn_mask,
+        n_real=inst.n_real,
     )
     fits = fitness(orders)
     champ = jnp.argmin(fits)
@@ -271,13 +283,16 @@ def _aco_init_fn(params: ACOParams, pool: int, warm: bool = False):
 
     @jax.jit
     def init(inst, w, init_perm):
-        n = inst.n_customers
+        from vrpms_tpu.core.instance import mean_duration
+
+        n = inst.real_nodes - 1  # real customer count (traced if padded)
         fitness = perm_fitness_fn(inst, w, params.fleet_penalty)
         d = inst.durations[0]
         hot = resolve_eval_mode("auto") != "gather"
         # Rough NN-scale init: tau0 = 1 / (n * mean-duration); exact
-        # value is irrelevant once MMAS clipping engages.
-        tau0 = 1.0 / (n * jnp.maximum(jnp.mean(d), 1e-6))
+        # value is irrelevant once MMAS clipping engages. Masked on
+        # padded instances so the scale tracks the real problem.
+        tau0 = 1.0 / (n * jnp.maximum(mean_duration(inst), 1e-6))
         tau = jnp.full((inst.n_nodes, inst.n_nodes), tau0)
         if warm:
             tau = deposit(

@@ -51,6 +51,7 @@ from vrpms_tpu.core.cost import (
     _onehot,
     _rid_batch,
 )
+from vrpms_tpu.core.encoding import separators
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.moves.moves import _segment_src_map, apply_src_map
 from vrpms_tpu.solvers.common import SolveResult
@@ -101,6 +102,10 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
     """
     mode = resolve_eval_mode(mode)
     b, length = giants.shape
+    nr = inst.n_real
+    # last movable position: tier-padded tours confine every window to
+    # the real prefix (the tail's phantom/zero filler must stay put)
+    last = (length - 2) if nr is None else (inst.n_real + inst.v_real - 2)
     p = _permuted_matrix(giants, inst, mode)
 
     # Leg vectors over positions, padded to length L (out-of-range = 0).
@@ -123,8 +128,8 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
 
     i_idx = jnp.arange(length)[None, :, None]
     j_idx = jnp.arange(length)[None, None, :]
-    interior_i = (i_idx >= 1) & (i_idx <= length - 2)
-    interior_j = (j_idx >= 1) & (j_idx <= length - 2)
+    interior_i = (i_idx >= 1) & (i_idx <= last)
+    interior_j = (j_idx >= 1) & (j_idx <= last)
 
     fwd_im1 = row(rshift(fwd_at, -1))
     fwd_i = row(fwd_at)
@@ -170,10 +175,10 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
             + _shift(p, s - 1, 1)     # P[i+s-1, j+1]
             - fwd_j
         )
-        seg_ok = interior_i & (i_idx + s - 1 <= length - 2)
+        seg_ok = interior_i & (i_idx + s - 1 <= last)
         # j outside [i-1, i+s-1]; j = 0 (insert right after the start
         # depot) is valid, j = L-1 is not (no leg leaves the last depot).
-        j_ok = (j_idx <= length - 2) & ((j_idx <= i_idx - 2) | (j_idx >= i_idx + s))
+        j_ok = (j_idx <= last) & ((j_idx <= i_idx - 2) | (j_idx >= i_idx + s))
         rel = jnp.where(seg_ok & j_ok, insertion - removal, _INF)
         tables.append(rel)
         if s >= 2:
@@ -199,8 +204,8 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
     # degenerates to a direct close. Orientation is preserved, so no
     # interior re-costing — this is the inter-route tail move the
     # window-based families above cannot express.
-    rid = _rid_batch(giants)
-    nz_after, at_idx, suf_len = _suffix_structure(giants)
+    rid = _rid_batch(giants, nr)
+    nz_after, at_idx, suf_len = _suffix_structure(giants, nr)
     nz_clip = jnp.clip(nz_after, 0, length - 1)
     if mode == "gather":
         # direct O(L^2) indexing on CPU; the one-hot matmuls below would
@@ -235,8 +240,8 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
     removed_b = fwd_j + jnp.where(b_empty, 0.0, col(fwd_tail))
     star_ok = (
         (col(rid) > row(rid))
-        & (i_idx <= length - 2)
-        & (j_idx <= length - 2)
+        & (i_idx <= last)
+        & (j_idx <= last)
         & ~(a_empty & b_empty)
     )
     star = jnp.where(star_ok, added_a + added_b - removed_a - removed_b, _INF)
@@ -244,14 +249,15 @@ def move_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> 
     return jnp.stack(tables + flip_tables + [star], axis=1)
 
 
-def _suffix_structure(giants: jax.Array):
+def _suffix_structure(giants: jax.Array, n_real=None):
     """(nz_after, at_idx, suf_len): per position, the index of the next
     separator strictly after it, the index of its route-suffix tail, and
     that suffix's length (0 when the next position is a separator).
+    Phantom ids >= n_real are separators on tier-padded tours.
     Entries at L-1 are wrapped garbage; consumers mask them."""
     b, length = giants.shape
     idx = jnp.arange(length, dtype=jnp.int32)[None, :]
-    masked = jnp.where(giants == 0, idx, length)
+    masked = jnp.where(separators(giants, n_real), idx, length)
     nz_geq = jnp.flip(
         jax.lax.cummin(jnp.flip(masked, axis=1), axis=1), axis=1
     )
@@ -302,8 +308,8 @@ def cap_delta_tables(giants: jax.Array, inst: Instance, mode: str = "auto") -> j
     mode = resolve_eval_mode(mode)
     b, length = giants.shape
     v = inst.n_vehicles
-    is_zero = giants == 0
-    rid = _rid_batch(giants)
+    is_zero = separators(giants, inst.n_real)
+    rid = _rid_batch(giants, inst.n_real)
     rid_c = jnp.clip(rid, 0, v - 1)
     rid_oh = _onehot(rid_c, v, jnp.float32)
     if mode == "gather":
@@ -476,7 +482,9 @@ def decode_move(t: jax.Array, i: jax.Array, j: jax.Array):
     return mt, lo, hi, m
 
 
-def move_src_map(t, i, j, length: int, giants: jax.Array | None = None) -> jax.Array:
+def move_src_map(
+    t, i, j, length: int, giants: jax.Array | None = None, n_real=None
+) -> jax.Array:
     """(M,) table slots -> (M, L) gather maps applying each move.
 
     The single apply path for every table (the sweep and the tests use
@@ -525,7 +533,7 @@ def move_src_map(t, i, j, length: int, giants: jax.Array | None = None) -> jax.A
     # where Asuf/Bsuf are the (possibly empty) suffixes of i's and j's
     # routes and zA closes i's route. The middle block (zA..j) shifts by
     # the suffix-length difference; both suffixes keep orientation.
-    nz_after, _, _ = _suffix_structure(giants)
+    nz_after, _, _ = _suffix_structure(giants, n_real)
     za = jnp.take_along_axis(nz_after, jnp.clip(i, 0, length - 1), axis=1)
     zb = jnp.take_along_axis(nz_after, jnp.clip(j, 0, length - 1), axis=1)
     la = za - i - 1
@@ -566,7 +574,7 @@ def _sweep(giants, costs, inst, w, mode, top_k):
     i = jnp.where(valid, i, one)
     j = jnp.where(valid, j, one)
     rep = jnp.repeat(giants, top_k, axis=0)
-    src = move_src_map(t, i, j, length, giants=rep)
+    src = move_src_map(t, i, j, length, giants=rep, n_real=inst.n_real)
     cands = apply_src_map(rep, src, mode=mode).reshape(b, top_k, length)
     cand_costs = objective_batch_mode(
         cands.reshape(b * top_k, length), inst, w, mode

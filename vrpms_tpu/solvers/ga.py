@@ -86,6 +86,34 @@ def _random_perms(key, pop: int, n: int) -> jax.Array:
     )
 
 
+@lru_cache(maxsize=8)
+def _random_padded_perms_fn(pop: int, n: int):
+    """Uniform random genomes for tier-padded instances: the REAL
+    prefix [0, n_real-1) shuffled, phantoms fixed at the tail (the
+    genome invariant every masked operator preserves)."""
+
+    @jax.jit
+    def fn(key, inst):
+        base = jnp.arange(1, n + 1, dtype=jnp.int32)
+        nrc = inst.n_real - 1  # real customer count, traced
+        pos = jnp.arange(n)
+        movable = pos < nrc
+
+        def one(k):
+            u = jax.random.uniform(k, (n,))
+            order = jnp.argsort(jnp.where(movable, u, jnp.inf))
+            src = jnp.where(movable, order, pos)
+            return base[src]
+
+        return jax.vmap(one)(jax.random.split(key, pop))
+
+    return fn
+
+
+def _random_padded_perms(key, pop: int, inst) -> jax.Array:
+    return _random_padded_perms_fn(pop, inst.n_customers)(key, inst)
+
+
 def initial_perms(
     key: jax.Array, pop: int, inst: Instance, params: GAParams, mode: str
 ) -> jax.Array:
@@ -97,12 +125,17 @@ def initial_perms(
     (synth n=100, pop 512); crossover/mutation resupply diversity.
     "random": uniform random permutations.
     """
+    n_real_perm = inst.perm_limit
     if params.init == "random":
+        if inst.n_real is not None:
+            return _random_padded_perms(key, pop, inst)
         return _random_perms(key, pop, inst.n_customers)
     if params.init != "nn":
         raise ValueError(f"GAParams.init must be 'nn' or 'random', got {params.init!r}")
 
-    return perturbed_perm_clones(key, pop, _nn_perm_fn()(inst), mode)
+    return perturbed_perm_clones(
+        key, pop, _nn_perm_fn()(inst), mode, n_real_perm=n_real_perm
+    )
 
 
 @lru_cache(maxsize=8)
@@ -122,12 +155,12 @@ def _perturb_perms_fn(pop: int, mode: str, n_moves: int):
     reason)."""
 
     @jax.jit
-    def fn(key, perm):
+    def fn(key, perm, lim):
         n = perm.shape[0]
         perms = jnp.tile(perm[None], (pop, 1))
         for _ in range(n_moves):
             key, k_pos, k_type = jax.random.split(key, 3)
-            ij = jax.random.randint(k_pos, (pop, 2), 0, n)
+            ij = jax.random.randint(k_pos, (pop, 2), 0, lim)
             lo = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
             hi = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
             mt = jax.random.randint(k_type, (pop, 1), 0, 2)
@@ -139,19 +172,31 @@ def _perturb_perms_fn(pop: int, mode: str, n_moves: int):
 
 
 def perturbed_perm_clones(
-    key: jax.Array, pop: int, perm: jax.Array, mode: str, n_moves: int = 6
+    key: jax.Array, pop: int, perm: jax.Array, mode: str, n_moves: int = 6,
+    n_real_perm=None,
 ) -> jax.Array:
     """One genome cloned per population slot, decorrelated by a few
     segment moves — the population recipe for any constructive or warm
     seed (the GA twin of sa.perturbed_clones). Slot 0 stays EXACTLY the
-    seed so best tracking can never return worse than the seed."""
-    return _perturb_perms_fn(pop, mode, n_moves)(key, perm)
+    seed so best tracking can never return worse than the seed.
+    `n_real_perm` (traced real customer count) confines the moves to a
+    padded genome's real prefix."""
+    lim = perm.shape[0] if n_real_perm is None else n_real_perm
+    return _perturb_perms_fn(pop, mode, n_moves)(key, perm, jnp.int32(lim))
 
 
-def order_crossover(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Array:
-    """OX: keep p1[i..j], fill remaining slots with p2's order."""
+def order_crossover(
+    p1: jax.Array, p2: jax.Array, key: jax.Array, lim=None
+) -> jax.Array:
+    """OX: keep p1[i..j], fill remaining slots with p2's order.
+
+    `lim` (traced) bounds the cut to a padded genome's real prefix;
+    phantom genes — always at both parents' tails, never inside the
+    segment — are all "kept" from p2, so the stable compaction returns
+    them to the tail of the child and the invariant survives crossover.
+    """
     n = p1.shape[0]
-    ij = jax.random.randint(key, (2,), 0, n)
+    ij = jax.random.randint(key, (2,), 0, n if lim is None else lim)
     i, j = jnp.minimum(ij[0], ij[1]), jnp.maximum(ij[0], ij[1])
     pos = jnp.arange(n)
     in_seg = (pos >= i) & (pos <= j)
@@ -170,7 +215,9 @@ def order_crossover(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Array:
     return jnp.where(in_seg, p1, compact[rank]).astype(jnp.int32)
 
 
-def order_crossover_hot(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Array:
+def order_crossover_hot(
+    p1: jax.Array, p2: jax.Array, key: jax.Array, lim=None
+) -> jax.Array:
     """Batched gather-free OX for (P, n) parents (the accelerator path).
 
     Same semantics as order_crossover, reformulated so nothing gathers,
@@ -182,7 +229,7 @@ def order_crossover_hot(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Arr
     """
     pop, n = p1.shape
     dt = onehot_dtype(n + 1)
-    ij = jax.random.randint(key, (pop, 2), 0, n)
+    ij = jax.random.randint(key, (pop, 2), 0, n if lim is None else lim)
     i = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
     j = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
     pos = jnp.arange(n)[None, :]
@@ -215,10 +262,10 @@ def order_crossover_hot(p1: jax.Array, p2: jax.Array, key: jax.Array) -> jax.Arr
     return jnp.where(in_seg, p1, jnp.round(fill).astype(p1.dtype))
 
 
-def mutate(perm: jax.Array, key: jax.Array, rate: float) -> jax.Array:
+def mutate(perm: jax.Array, key: jax.Array, rate: float, lim=None) -> jax.Array:
     n = perm.shape[0]
     k_do, k_pos, k_type = jax.random.split(key, 3)
-    ij = jax.random.randint(k_pos, (2,), 0, n)
+    ij = jax.random.randint(k_pos, (2,), 0, n if lim is None else lim)
     i, j = jnp.minimum(ij[0], ij[1]), jnp.maximum(ij[0], ij[1])
     mutated = jax.lax.switch(
         jax.random.randint(k_type, (), 0, 2),
@@ -232,12 +279,12 @@ def mutate(perm: jax.Array, key: jax.Array, rate: float) -> jax.Array:
     return jnp.where(do, mutated, perm)
 
 
-def mutate_batch(perms, key, rate: float, mode: str) -> jax.Array:
+def mutate_batch(perms, key, rate: float, mode: str, lim=None) -> jax.Array:
     """Batched segment mutation: one reverse/rotate per genome, applied
     through the mode-aware src-map machinery (one-hot apply on TPU)."""
     pop, n = perms.shape
     k_do, k_pos, k_type = jax.random.split(key, 3)
-    ij = jax.random.randint(k_pos, (pop, 2), 0, n)
+    ij = jax.random.randint(k_pos, (pop, 2), 0, n if lim is None else lim)
     lo = jnp.minimum(ij[:, 0], ij[:, 1])[:, None]
     hi = jnp.maximum(ij[:, 0], ij[:, 1])[:, None]
     mt = jax.random.randint(k_type, (pop, 1), 0, 2)  # reverse / rotate-1
@@ -248,7 +295,8 @@ def mutate_batch(perms, key, rate: float, mode: str) -> jax.Array:
 
 
 def ga_generation(
-    perms, fits, key, gen, fitness, params: GAParams, mode="gather", d=None
+    perms, fits, key, gen, fitness, params: GAParams, mode="gather", d=None,
+    n_real_perm=None,
 ):
     """One generation: selection -> OX -> mutation -> elitism
     [-> immigrants].
@@ -258,8 +306,12 @@ def ga_generation(
     gather (CPU) or one-hot (accelerator) formulation of selection,
     crossover, and mutation — both implement the same operators. `d`
     (durations[0]) enables the immigrant step when params.immigrants>0.
+    `n_real_perm` (traced real customer count; Instance.n_real - 1)
+    confines crossover cuts and mutation windows to a tier-padded
+    genome's real prefix, keeping phantom genes parked at the tail.
     """
     pop = perms.shape[0]
+    lim = n_real_perm  # None on unpadded instances (static full range)
     hot = mode in ("onehot", "pallas")
     k_gen = jax.random.fold_in(key, gen)
     k_t1, k_t2, k_cx, k_cxdo, k_mut = jax.random.split(k_gen, 5)
@@ -293,7 +345,7 @@ def ga_generation(
 
         pa = tournament(k_t1)
         pb = tournament(k_t2)
-        children = order_crossover_hot(pa, pb, k_cx)
+        children = order_crossover_hot(pa, pb, k_cx, lim)
     else:
         def tournament(k):
             draws = jax.random.randint(k, (pop, params.tournament), 0, pop)
@@ -301,22 +353,27 @@ def ga_generation(
 
         pa = perms[tournament(k_t1)]
         pb = perms[tournament(k_t2)]
-        children = jax.vmap(order_crossover)(
-            pa, pb, jax.random.split(k_cx, pop)
+        children = jax.vmap(order_crossover, in_axes=(0, 0, 0, None))(
+            pa, pb, jax.random.split(k_cx, pop), lim
         )
     do_cx = jax.random.uniform(k_cxdo, (pop,)) < params.crossover_rate
     children = jnp.where(do_cx[:, None], children, pa)
     if hot:
-        children = mutate_batch(children, k_mut, params.mutation_rate, mode)
+        children = mutate_batch(children, k_mut, params.mutation_rate, mode, lim)
     else:
-        children = jax.vmap(mutate, in_axes=(0, 0, None))(
-            children, jax.random.split(k_mut, pop), params.mutation_rate
+        children = jax.vmap(mutate, in_axes=(0, 0, None, None))(
+            children, jax.random.split(k_mut, pop), params.mutation_rate, lim
         )
     # Elitism: overwrite the first E children with the current best E.
     elite_idx = jnp.argsort(fits)[: params.elites]
     children = children.at[: params.elites].set(perms[elite_idx])
     new_fits = fitness(children)
     imm_n = immigrants_for(params, pop, perms.shape[1])
+    # tier-padded genomes skip the immigrant step: the ruin-and-recreate
+    # cluster size is a STATIC shape (top_k) and cannot track the traced
+    # real size; masked crossover/mutation still resupply diversity
+    if n_real_perm is not None:
+        imm_n = 0
     if imm_n > 0 and d is not None:
         # replace the worst children with ruin-and-recreate variants of
         # the generation champion — structurally fresh, high-quality
@@ -358,12 +415,13 @@ def _ga_block_fn(params: GAParams, n_block: int, mode: str):
     @jax.jit
     def run(state, key, inst, w, start_gen):
         fitness = perm_fitness_fn(inst, w, params.fleet_penalty, mode=mode)
+        nrp = inst.perm_limit
 
         def step(state, gen):
             perms, fits, best_p, best_f = state
             perms, fits = ga_generation(
                 perms, fits, key, gen, fitness, params, mode,
-                d=inst.durations[0],
+                d=inst.durations[0], n_real_perm=nrp,
             )
             champ = jnp.argmin(fits)
             better = fits[champ] < best_f
@@ -432,9 +490,12 @@ def solve_ga(
         )
 
     # genome + immigrant evaluations per generation (also the evals
-    # accounting below — the trace and the stat must agree)
-    gen_evals = perms0.shape[0] + immigrants_for(
-        params, perms0.shape[0], inst.n_customers
+    # accounting below — the trace and the stat must agree); padded
+    # instances run without immigrants (see ga_generation)
+    gen_evals = perms0.shape[0] + (
+        0
+        if inst.n_real is not None
+        else immigrants_for(params, perms0.shape[0], inst.n_customers)
     )
     state, done = run_blocked(
         step_block, state, params.generations, 32, deadline_s,

@@ -301,7 +301,10 @@ def ils_loop(
                     k_reseed, reseed_batch, jnp.asarray(best_g), inst
                 )
             else:
-                init = perturbed_clones(k_reseed, reseed_batch, best_g, mode)
+                init = perturbed_clones(
+                    k_reseed, reseed_batch, best_g, mode,
+                    length_real=inst.move_limit,
+                )
             tlog(f"round {r}: reseeded ({params.reseed})")
         # everything after the anneal is this round's fixed tail
         fixed_tail = time.monotonic() - t_anneal_done

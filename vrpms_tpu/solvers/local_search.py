@@ -35,10 +35,21 @@ def nearest_neighbor_perm(inst: Instance, start_time: float = 0.0) -> jax.Array:
     slice_idx = int(start_time // inst.slice_minutes) % inst.n_slices
     d = inst.durations[slice_idx]
     n = inst.n_customers
+    # tier-padded instances: phantom columns (depot aliases) are pushed
+    # behind every real customer, so the construction visits the real
+    # set in exactly the unpadded order and parks phantoms at the tail
+    # (the canonical padded layout the masked moves rely on)
+    phantom_pen = None
+    if inst.n_real is not None:
+        phantom_pen = jnp.where(
+            jnp.arange(1, inst.n_nodes) >= inst.n_real, 1e17, 0.0
+        )
 
     def step(carry, _):
         cur, visited = carry
         dist = jnp.where(visited[1:], jnp.inf, d[cur, 1:])
+        if phantom_pen is not None:
+            dist = dist + phantom_pen
         nxt = jnp.argmin(dist).astype(jnp.int32) + 1
         return (nxt, visited.at[nxt].set(True)), nxt
 

@@ -40,23 +40,37 @@ from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
 
 
-def _ruin_recreate_one_batch(key, perm, batch: int, d, k_remove: int):
+def _ruin_recreate_one_batch(key, perm, batch: int, d, k_remove: int,
+                             n_real=None):
     """[batch, n] perturbed customer orders from ONE incumbent perm.
 
     d is the [N, N] duration matrix (slice 0). Every row is perturbed;
     the keep-best guarantee (chain 0 == exact incumbent giant) lives in
     ONE place, _rr_giants_fn's final overwrite.
+
+    Tier-padded instances (`n_real` traced): the incumbent perm carries
+    its phantom genes at the tail; seeds draw from the real prefix,
+    phantom columns are masked out of the ruin, and insertion gaps are
+    confined to the real region — so phantoms stay parked at the tail
+    and, for a fixed key, the real-prefix trajectory matches what the
+    unpadded perm would do wherever the random shapes allow.
     """
     n = perm.shape[0]
     k_seed, k_order, k_jit = jax.random.split(key, 3)
+    nrc = None if n_real is None else n_real - 1  # real customer count
 
     # --- ruin: per-chain seed customer + its k nearest customers -----
-    seeds = jax.random.randint(k_seed, (batch,), 0, n)
+    seeds = jax.random.randint(k_seed, (batch,), 0, n if nrc is None else nrc)
     seed_nodes = perm[seeds]  # node ids
     rows = d[seed_nodes][:, 1:]  # distances to customers 1..n (B, n)
     # jitter breaks ties so chains ruin different clusters even from
     # identical seeds
     rows = rows * (1.0 + 0.1 * jax.random.uniform(k_jit, rows.shape))
+    if n_real is not None:
+        # phantoms (depot-alias distances) must never be "ruined"
+        rows = jnp.where(
+            (jnp.arange(1, n + 1) >= n_real)[None, :], jnp.inf, rows
+        )
     # the seed itself is distance 0 -> always removed; take k nearest
     _, rm_idx = jax.lax.top_k(-rows, k_remove)  # customer ids - 1
     removed_nodes = rm_idx + 1  # (B, k)
@@ -94,6 +108,13 @@ def _ruin_recreate_one_batch(key, perm, batch: int, d, k_remove: int):
             valid == m, 0, jnp.take_along_axis(seq, jnp.minimum(valid, m - 1), axis=1)
         )  # successor node of gap j (depot for j == m)
         delta = d[a, c[:, None]] + d[c[:, None], b] - d[a, b]
+        if nrc is not None:
+            # gaps beyond the real survivors (i.e. inside the phantom
+            # tail) are off limits; real survivor count this step is
+            # nrc - k_remove + t
+            delta = jnp.where(
+                valid <= (nrc - k_remove + t), delta, jnp.inf
+            )
         j = jnp.argmin(delta, axis=1)  # (B,) best gap
         shift = pos[None, :] > j[:, None]  # positions after j shift right
         at = pos[None, :] == j[:, None]
@@ -116,7 +137,8 @@ def default_k_remove(n: int) -> int:
 
 
 def ruin_recreate_perms(
-    key: jax.Array, perm: jax.Array, batch: int, d, k_remove: int | None = None
+    key: jax.Array, perm: jax.Array, batch: int, d, k_remove: int | None = None,
+    n_real=None,
 ) -> jax.Array:
     """[batch, n] perturbed customer orders from one incumbent perm —
     the perm-level entry (GA immigrants); every row is perturbed."""
@@ -124,7 +146,7 @@ def ruin_recreate_perms(
     if k_remove is None:
         k_remove = default_k_remove(n)
     k_remove = max(1, min(int(k_remove), n - 1))  # explicit values clamp too
-    return _ruin_recreate_one_batch(key, perm, batch, d, k_remove)
+    return _ruin_recreate_one_batch(key, perm, batch, d, k_remove, n_real)
 
 
 def ruin_recreate_clones(
@@ -138,7 +160,10 @@ def ruin_recreate_clones(
     ruin-and-recreate perturbed per chain, re-split greedily. Chain 0 is
     the exact incumbent (keep-best guarantee). One jitted program.
     """
-    n = inst.n_customers
+    # the cluster size is a STATIC shape (top_k), so it comes from the
+    # CONCRETE real size; the handful of distinct values (default_k_remove
+    # quantizes hard) bounds the extra compiles per tier
+    n = inst.n_customers if inst.n_real is None else int(inst.n_real) - 1
     if k_remove is None:
         k_remove = default_k_remove(n)
     k_remove = max(1, min(int(k_remove), n - 1))  # explicit values clamp too
@@ -149,9 +174,9 @@ def ruin_recreate_clones(
 def _rr_giants_fn(batch: int, k_remove: int):
     @jax.jit
     def fn(key, giant, inst):
-        perm = _perm_of_giant(giant, inst.n_customers)
+        perm = _perm_of_giant(giant, inst.n_customers, inst.n_real)
         seqs = _ruin_recreate_one_batch(
-            key, perm, batch, inst.durations[0], k_remove
+            key, perm, batch, inst.durations[0], k_remove, inst.n_real
         )
         out = jax.vmap(lambda p: greedy_split_giant(p, inst))(seqs)
         # chain 0 keeps the incumbent GIANT byte-exact — a greedy
@@ -162,9 +187,17 @@ def _rr_giants_fn(batch: int, k_remove: int):
     return fn
 
 
-def _perm_of_giant(giant: jax.Array, n: int) -> jax.Array:
-    """Customer order of a giant tour (separators stripped), fixed
-    shape [n]: stable-sort positions by is-separator."""
-    is_sep = (giant == 0).astype(jnp.int32)
-    order = jnp.argsort(is_sep, axis=0, stable=True)
+def _perm_of_giant(giant: jax.Array, n: int, n_real=None) -> jax.Array:
+    """Customer order of a giant tour, fixed shape [n]: real customers
+    in tour order first, then (tier-padded) the phantoms, then the
+    zeros are dropped by the [:n] cut — one stable three-way sort, so a
+    phantom standing in for an interior separator still lands at the
+    genome tail where the masked ruin expects it."""
+    if n_real is None:
+        key = (giant == 0).astype(jnp.int32)
+    else:
+        key = jnp.where(
+            giant == 0, 2, jnp.where(giant >= n_real, 1, 0)
+        ).astype(jnp.int32)
+    order = jnp.argsort(key, axis=0, stable=True)
     return giant[order][:n]

@@ -29,7 +29,7 @@ from vrpms_tpu.core.cost import (
     resolve_eval_mode,
 )
 from vrpms_tpu.core.encoding import random_giant_batch
-from vrpms_tpu.core.instance import Instance
+from vrpms_tpu.core.instance import Instance, mean_duration
 from vrpms_tpu.moves import knn_move_batch, proposal_knn, random_move_batch
 from vrpms_tpu.solvers.common import SolveResult
 
@@ -115,15 +115,16 @@ def _temps_from_scale(scale: float, params: SAParams) -> tuple[float, float]:
 
 def _auto_temps(inst: Instance, params: SAParams) -> tuple[float, float]:
     """Schedule endpoints from the instance (one jitted mean dispatch)."""
-    return _temps_from_scale(float(_mean_fn()(inst.durations[0])), params)
+    return _temps_from_scale(float(_mean_fn()(inst)), params)
 
 
 @lru_cache(maxsize=1)
 def _mean_fn():
-    """Jitted matrix mean (one cacheable dispatch; the eager reduction
-    costs a multi-second compile round trip per process on a tunneled
-    TPU — see _perturb_fn)."""
-    return jax.jit(jnp.mean)
+    """Jitted real-region matrix mean (one cacheable dispatch; the eager
+    reduction costs a multi-second compile round trip per process on a
+    tunneled TPU — see _perturb_fn). Masked on tier-padded instances so
+    the temperature scale tracks the real problem, not the tier size."""
+    return jax.jit(mean_duration)
 
 
 @lru_cache(maxsize=8)
@@ -141,6 +142,44 @@ def _nn_seed_fn():
     return fn
 
 
+@lru_cache(maxsize=8)
+def _random_padded_fn(batch: int, length: int):
+    """Jitted uniform random padded giants: the canonical padded layout
+    (real customers + real separators in [1, L_real-2], phantoms then
+    zeros in the tail) with the movable interior uniformly shuffled —
+    the padded twin of encoding.random_giant_batch."""
+
+    @jax.jit
+    def fn(key, inst):
+        nr, vr = inst.n_real, inst.v_real
+        lim = nr + vr
+        n_phantom = inst.n_nodes - nr  # traced
+        pos = jnp.arange(length, dtype=jnp.int32)
+        # canonical values: customers 1..nr-1, zeros to L_real-1, the
+        # phantoms nr..N-1, zeros for the phantom vehicles
+        is_cust = (pos >= 1) & (pos <= nr - 1)
+        is_phan = (pos >= lim) & (pos < lim + n_phantom)
+        canonical = jnp.where(
+            is_cust, pos, jnp.where(is_phan, nr + (pos - lim), 0)
+        )
+        movable = (pos >= 1) & (pos <= lim - 2)
+
+        def one(k):
+            u = jax.random.uniform(k, (length,))
+            order = jnp.argsort(jnp.where(movable, u, jnp.inf))
+            src = jnp.where(movable, jnp.roll(order, 1), pos)
+            return canonical[src]
+
+        return jax.vmap(one)(jax.random.split(key, batch))
+
+    return fn
+
+
+def _random_padded_giants(key, batch: int, inst: Instance) -> jax.Array:
+    length = inst.n_customers + inst.n_vehicles + 1
+    return _random_padded_fn(batch, length)(key, inst)
+
+
 def initial_giants(
     key: jax.Array, batch: int, inst: Instance, params: SAParams, mode: str
 ) -> jax.Array:
@@ -153,11 +192,13 @@ def initial_giants(
     reference src/solver.py:22-24, batched).
     """
     if params.init == "random":
+        if inst.n_real is not None:
+            return _random_padded_giants(key, batch, inst)
         return random_giant_batch(key, batch, inst.n_customers, inst.n_vehicles)
     if params.init != "nn":
         raise ValueError(f"SAParams.init must be 'nn' or 'random', got {params.init!r}")
     seed = _nn_seed_fn()(inst)
-    return perturbed_clones(key, batch, seed, mode)
+    return perturbed_clones(key, batch, seed, mode, length_real=inst.move_limit)
 
 
 @lru_cache(maxsize=32)
@@ -167,21 +208,24 @@ def _perturb_fn(batch: int, mode: str, n_moves: int):
     calls issue dozens of small device programs; on a tunneled TPU that
     cost ~45 s of pure dispatch latency per cold solve (measured on the
     X-n200 shape) — as ONE jitted program it is milliseconds warm and
-    one persistent-cacheable compile cold."""
+    one persistent-cacheable compile cold. `lim` is the move bound
+    (tour length, or the traced real prefix of a padded tour) — a
+    dynamic scalar, so padded sizes share the compile."""
 
     @jax.jit
-    def fn(key, giant):
+    def fn(key, giant, lim):
         giants = jnp.tile(giant[None], (batch, 1))
         for _ in range(n_moves):
             key, k = jax.random.split(key)
-            giants = random_move_batch(k, giants, mode=mode)
+            giants = random_move_batch(k, giants, mode=mode, length_real=lim)
         return giants.at[0].set(giant)
 
     return fn
 
 
 def perturbed_clones(
-    key: jax.Array, batch: int, giant: jax.Array, mode: str, n_moves: int = 8
+    key: jax.Array, batch: int, giant: jax.Array, mode: str,
+    n_moves: int = 8, length_real=None,
 ) -> jax.Array:
     """One seed tour cloned per chain, decorrelated by a few random
     moves — the chain-start recipe for any constructive or warm seed.
@@ -190,8 +234,11 @@ def perturbed_clones(
     re-solves with tiny budgets must not regress below their
     checkpoint). Callers pairing this with solve_sa should keep the
     default (cool) schedule: seeded starts are refined, not unscrambled.
+    `length_real` (Instance.move_limit) confines the moves to a padded
+    tour's real prefix.
     """
-    return _perturb_fn(batch, mode, n_moves)(key, giant)
+    lim = giant.shape[0] if length_real is None else length_real
+    return _perturb_fn(batch, mode, n_moves)(key, giant, jnp.int32(lim))
 
 
 def anneal_temperature(it, t0, t1, horizon):
@@ -237,10 +284,11 @@ def sa_chain_step(
     temp = anneal_temperature(it, t0, t1, n_iters)
     k_it = jax.random.fold_in(key, it)
     k_moves, k_accept = jax.random.split(k_it)
+    lim = inst.move_limit  # traced real prefix on tier-padded instances
     if knn is not None:
-        cands = knn_move_batch(k_moves, giants, knn, mode=mode)
+        cands = knn_move_batch(k_moves, giants, knn, mode=mode, length_real=lim)
     else:
-        cands = random_move_batch(k_moves, giants, mode=mode)
+        cands = random_move_batch(k_moves, giants, mode=mode, length_real=lim)
     cand_costs = objective_batch_mode(cands, inst, w, mode)
     u = jax.random.uniform(k_accept, (b,))
     return metropolis_accept(giants, costs, cands, cand_costs, u, temp)
@@ -284,15 +332,18 @@ def _sa_block_fn(n_block: int, mode: str):
         # apply plus the one-hot objective (presample_move_params).
         kb = jax.random.fold_in(key, start_it)
         width = 0 if knn is None else knn.shape[1]
+        lim = inst.move_limit  # traced real prefix on padded instances
         pri, prr, prmt, prm, pru = presample_move_params(
-            kb, b, length, n_block, width
+            kb, b, length, n_block, width, length_real=lim
         )
 
         def step(state, xs):
             it, i, r, mt, m, u = xs
             giants, costs, best_g, best_c = state
             temp = anneal_temperature(it, t0, t1, horizon)
-            cands = move_batch_from_params(i, r, mt, m, giants, knn, mode)
+            cands = move_batch_from_params(
+                i, r, mt, m, giants, knn, mode, length_real=lim
+            )
             cand_costs = objective_batch_mode(cands, inst, w, mode)
             giants, costs = metropolis_accept(
                 giants, costs, cands, cand_costs, u, temp
@@ -341,12 +392,13 @@ def _sa_prep_fn(batch: int, mode: str, n_moves: int = 8):
 
         seed = greedy_split_giant(nearest_neighbor_perm(inst), inst)
         giants = jnp.tile(seed[None], (batch, 1))
+        lim = inst.move_limit  # traced real prefix on padded instances
         for _ in range(n_moves):
             key, k = jax.random.split(key)
-            giants = random_move_batch(k, giants, mode=mode)
+            giants = random_move_batch(k, giants, mode=mode, length_real=lim)
         giants = giants.at[0].set(seed)
         costs = objective_batch_mode(giants, inst, w, mode)
-        return giants, costs, jnp.mean(inst.durations[0])
+        return giants, costs, mean_duration(inst)
 
     return prep
 
@@ -509,6 +561,12 @@ def _delta_supported(inst: Instance, w: CostWeights, mode: str) -> bool:
     from vrpms_tpu.kernels.sa_eval import demand_scale
 
     if mode != "pallas" or not _PALLAS_OK:
+        return False
+    if inst.n_real is not None:
+        # tier-padded instances: the fused kernels' packed route state
+        # keys on literal zeros and does not model phantom separators;
+        # padded traffic stays on the XLA one-hot paths (which ARE
+        # tier-shared and persistent-cacheable)
         return False
     if w.use_makespan or inst.het_fleet:
         return False

@@ -57,7 +57,19 @@ def enable_compile_cache(path: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         return str(path)
-    except Exception:
+    except Exception as e:
+        # the service runs on (degraded: every restart re-pays compiles)
+        # but the condition must be visible — the store.degraded pattern
+        try:
+            from vrpms_tpu.obs import log_event
+
+            log_event(
+                "compile_cache.degraded",
+                path=str(path),
+                error=f"{type(e).__name__}: {e}",
+            )
+        except Exception:
+            pass
         return None
 
 
