@@ -1,0 +1,142 @@
+"""Span-tracing overhead micro-check: tracing on vs VRPMS_TRACING=off.
+
+    python -m benchmarks.trace_overhead [--reps 10] [--iters 1000]
+                                        [--customers 100] [--chains 64]
+
+The tracing subsystem's acceptance bar (ISSUE 5): always-on span
+recording — a Trace per request, the root/solver/finish spans the
+service records, the completed-trace ring push, and the histogram
+exemplar — must cost < 1% of solve wall time on a warmed SA solve.
+Measured like benchmarks/obs_overhead.py: the REAL request path
+(service.solve.run_vrp on a synthetic euclidean instance) bracketed by
+the same trace lifecycle the HTTP layer runs (start_trace -> root span
+-> activate -> finish), alternating VRPMS_TRACING between on and off
+with a paired within-rep design so host drift cancels. Structured
+logging is off so only the span-recording delta is measured; metrics
+stay on in BOTH arms (their cost was priced by obs_overhead).
+
+Prints one JSON line on stdout (bench.py convention); diagnostics to
+stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def build_request(n_customers: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n = n_customers + 1
+    pts = rng.uniform(0, 100, size=(n, 2))
+    matrix = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1).tolist()
+    locations = [
+        {"id": i, "demand": 2 if i else 0} for i in range(n)
+    ]
+    n_vehicles = max(2, n_customers // 10)
+    cap = 2.0 * n_customers / n_vehicles * 1.3
+    params = {
+        "name": "trace-overhead",
+        "description": "bench",
+        "auth": None,
+        "ignored_customers": [],
+        "completed_customers": [],
+        "capacities": [cap] * n_vehicles,
+        "start_times": [0.0] * n_vehicles,
+    }
+    return params, locations, matrix
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--reps", type=int, default=10,
+                        help="measured solve pairs (one per tracing state)")
+    parser.add_argument("--iters", type=int, default=1000)
+    parser.add_argument("--customers", type=int, default=100)
+    parser.add_argument("--chains", type=int, default=64)
+    args = parser.parse_args()
+
+    os.environ["VRPMS_LOG"] = "off"  # isolate the span-recording delta
+    from service.solve import run_vrp
+    from vrpms_tpu.obs import spans
+
+    params, locations, matrix = build_request(args.customers)
+    opts = {
+        "seed": 1,
+        "iteration_count": args.iters,
+        "population_size": args.chains,
+    }
+
+    def one_solve(seed: int):
+        """One request-shaped solve under the current VRPMS_TRACING:
+        the exact per-request span lifecycle the service runs."""
+        errors: list = []
+        t0 = time.perf_counter()
+        trace = spans.start_trace(None)
+        tokens = None
+        if trace is not None:
+            root = trace.span("POST /api/vrp/sa")
+            tokens = spans.activate(trace, root)
+        try:
+            result = run_vrp(
+                "sa", params, dict(opts, seed=seed), {}, locations, matrix,
+                errors, database=None,
+            )
+        finally:
+            if trace is not None:
+                trace.root().end()
+                spans.deactivate(tokens)
+                trace.finish()
+        elapsed = (time.perf_counter() - t0) * 1e3
+        assert result is not None and not errors, errors
+        return elapsed
+
+    print(
+        f"[trace_overhead] warmup solve ({args.customers} customers, "
+        f"{args.chains}x{args.iters})",
+        file=sys.stderr,
+    )
+    os.environ["VRPMS_TRACING"] = "on"
+    one_solve(0)  # compile
+
+    on_ms, off_ms = [], []
+    # paired design (see obs_overhead): each rep runs the SAME seed once
+    # per tracing state, flipping the within-pair order each rep so
+    # drift (thermal, GC, cache) cancels; the estimator is the median of
+    # per-pair relative deltas
+    for rep in range(args.reps):
+        pair = (("on", on_ms), ("off", off_ms))
+        if rep % 2:
+            pair = pair[::-1]
+        for state, sink in pair:
+            os.environ["VRPMS_TRACING"] = state
+            sink.append(one_solve(rep + 1))
+    os.environ["VRPMS_TRACING"] = "on"
+
+    overhead_pct = 100.0 * statistics.median(
+        (on - off) / off for on, off in zip(on_ms, off_ms)
+    )
+    line = {
+        "bench": "trace_overhead",
+        "customers": args.customers,
+        "chains": args.chains,
+        "iters": args.iters,
+        "reps": args.reps,
+        "solve_ms_tracing_on": round(statistics.median(on_ms), 2),
+        "solve_ms_tracing_off": round(statistics.median(off_ms), 2),
+        "overhead_pct": round(overhead_pct, 3),
+        # negative deltas are timing noise; the bar is one-sided
+        "pass": overhead_pct < 1.0,
+    }
+    print(json.dumps(line))
+    return 0 if line["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

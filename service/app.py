@@ -9,6 +9,7 @@ threading HTTP server:
 
 Routes: /api, /api/{vrp,tsp}/{ga,sa,aco,bf}, /api/jobs[/{id}],
 /api/ready (ok|degraded|down readiness — service.jobs.readiness),
+/api/debug/traces[/{traceId}] (recent request traces — service.debug),
 /metrics (Prometheus text exposition — service.obs). Unknown paths
 -> 404.
 """
@@ -21,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from service import obs
 from service.api.index import handler as health_handler
+from service.debug import TraceDetailHandler, TracesHandler
 from service.jobs import (
     JobsHandler,
     JobStatusHandler,
@@ -49,6 +51,7 @@ ROUTES = {
     "/api/tsp/bf": tsp_bf,
     "/api/jobs": JobsHandler,
     "/api/ready": ReadyHandler,
+    "/api/debug/traces": TracesHandler,
     "/metrics": obs.MetricsHandler,
 }
 
@@ -68,8 +71,11 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         cls = ROUTES.get(path)
         if cls is None and path.startswith("/api/jobs/"):
-            # the one parameterized route: /api/jobs/{id} status polls
+            # parameterized route: /api/jobs/{id} status polls
             cls = JobStatusHandler
+        if cls is None and path.startswith("/api/debug/traces/"):
+            # parameterized route: /api/debug/traces/{traceId}
+            cls = TraceDetailHandler
         if cls is None:
             self.send_response(404)
             self.send_header("Content-type", "text/plain")

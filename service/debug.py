@@ -1,0 +1,108 @@
+"""Trace debug surface: the operator's window into recent requests.
+
+Two read-only endpoints over the completed-trace ring
+(vrpms_tpu.obs.spans):
+
+  GET /api/debug/traces            — newest-first summaries, filterable
+                                     by ?minMs= (minimum duration),
+                                     ?status= (ok|error), ?limit=
+  GET /api/debug/traces/{traceId}  — one trace's full span tree
+
+These answer the question aggregate histograms cannot: WHERE did that
+slow request spend its time — queue wait, compile, batch-neighbor
+interference, or a store retry storm. The histogram exemplars on
+/metrics (`# {trace_id="..."}`) and the `traceId` echoed in every
+response envelope are the join keys into this surface.
+
+Header-sampled like the poll/readiness GETs (service.obs
+begin_request_obs): debug reads only trace when the caller sends a
+valid traceparent, so inspecting the ring doesn't churn it.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+
+from service import obs
+from service.helpers import respond_json
+from vrpms_tpu.obs import spans
+
+
+class TracesHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/debug/traces — the recent-trace ring, filtered."""
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            self._list()
+        finally:
+            obs.end_request_obs(self)
+
+    def _list(self):
+        query = urllib.parse.parse_qs(self.path.partition("?")[2])
+        try:
+            min_ms = float(query.get("minMs", ["0"])[0])
+            limit = int(query.get("limit", ["50"])[0])
+        except (TypeError, ValueError):
+            self._obs_errors = ["Bad request"]
+            respond_json(self, 400, {
+                "success": False,
+                "errors": [{
+                    "what": "Bad request",
+                    "reason": "'minMs' must be a number and 'limit' an "
+                    "integer",
+                }],
+            })
+            return
+        status = query.get("status", [None])[0]
+        if status is not None and status not in ("ok", "error"):
+            self._obs_errors = ["Bad request"]
+            respond_json(self, 400, {
+                "success": False,
+                "errors": [{
+                    "what": "Bad request",
+                    "reason": "'status' must be 'ok' or 'error'",
+                }],
+            })
+            return
+        respond_json(self, 200, {
+            "success": True,
+            "tracing": spans.tracing_enabled(),
+            "capacity": spans.ring_capacity(),
+            "traces": spans.ring_snapshot(
+                min_duration_ms=min_ms, status=status, limit=limit
+            ),
+        })
+
+
+class TraceDetailHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/debug/traces/{traceId} — one trace's full span tree."""
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            self._detail()
+        finally:
+            obs.end_request_obs(self)
+
+    def _detail(self):
+        trace_id = (
+            self.path.split("?", 1)[0].rstrip("/").rsplit("/", 1)[-1]
+        )
+        trace = spans.ring_get(trace_id)
+        if trace is None:
+            self._obs_errors = ["Not found"]
+            respond_json(self, 404, {
+                "success": False,
+                "errors": [{
+                    "what": "Not found",
+                    "reason": (
+                        f"no completed trace {trace_id!r} in the ring "
+                        "(it may not have finished yet, or was evicted "
+                        "— see VRPMS_TRACE_RING)"
+                    ),
+                }],
+            })
+            return
+        respond_json(self, 200, {"success": True, "trace": trace.to_dict()})

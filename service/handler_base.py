@@ -11,7 +11,6 @@ like the reference (api/vrp/ga/index.py:57-65); the TSP save does not
 
 from __future__ import annotations
 
-import time
 from http.server import BaseHTTPRequestHandler
 
 import store
@@ -24,9 +23,14 @@ from service.helpers import (
     too_busy,
 )
 from service.jobs import scheduler_solve
-from service.obs import SCHED_REJECTS, RequestObsMixin
+from service.obs import (
+    SCHED_REJECTS,
+    RequestObsMixin,
+    begin_request_obs,
+    end_request_obs,
+)
 from service.parameters import parse_solver_options
-from vrpms_tpu.obs import new_request_id, reset_request_id, set_request_id
+from vrpms_tpu.obs import spans
 from vrpms_tpu.sched import QueueFull
 
 
@@ -49,29 +53,29 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
         self.wfile.write(self.banner.encode("utf-8"))
 
     def do_POST(self):
-        # Request context: id + clock first, so every later log line
-        # (including solver-side ones via the contextvar) correlates
-        # and the access line carries a duration.
-        self._obs_t0 = time.perf_counter()
-        self._request_id = new_request_id()
-        token = set_request_id(self._request_id)
+        # Request context: id + clock + trace root first, so every
+        # later log line and span (including solver-side ones via the
+        # contextvars) correlates and the access line carries a
+        # duration. The trace adopts an incoming W3C traceparent.
+        begin_request_obs(self)
         try:
             self._solve_post()
         finally:
-            reset_request_id(token)
+            end_request_obs(self)
 
     def _solve_post(self):
         # Read + parse via the one shared intake ladder (Content-Length
         # hardening, body-size observation, JSON 400 envelopes).
-        content = read_json_body(self)
-        if content is None:
-            return
+        with spans.span("parse"):
+            content = read_json_body(self)
+            if content is None:
+                return
 
-        # Parse parameters
-        errors: list = []
-        params = type(self).parse_common(content, errors)
-        algo_params = type(self).parse_algo(content, errors) if type(self).parse_algo else {}
-        opts = parse_solver_options(content, errors)
+            # Parse parameters
+            errors: list = []
+            params = type(self).parse_common(content, errors)
+            algo_params = type(self).parse_algo(content, errors) if type(self).parse_algo else {}
+            opts = parse_solver_options(content, errors)
 
         if len(errors) > 0:
             fail(self, errors)
@@ -83,8 +87,9 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
         except Exception as e:
             fail(self, [{"what": "Database error", "reason": str(e)}])
             return
-        locations = database.get_locations_by_id(params["locations_key"], errors)
-        durations = database.get_durations_by_id(params["durations_key"], errors)
+        with spans.span("store.read", tables="locations,durations"):
+            locations = database.get_locations_by_id(params["locations_key"], errors)
+            durations = database.get_durations_by_id(params["durations_key"], errors)
 
         if len(errors) > 0:
             fail(self, errors)
@@ -111,29 +116,30 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
 
         # Save results
         if params["auth"]:
-            if self.problem == "vrp":
-                database.save_solution(
-                    name=params["name"],
-                    description=params["description"],
-                    locations=remove_unused_locations(
-                        locations,
-                        params["ignored_customers"],
-                        params["completed_customers"],
-                    ),
-                    vehicles=result["vehicles"],
-                    duration_max=result["durationMax"],
-                    duration_sum=result["durationSum"],
-                    errors=errors,
-                )
-            else:
-                database.save_solution(
-                    name=params["name"],
-                    description=params["description"],
-                    locations=locations,
-                    vehicle=result["vehicle"],
-                    duration=result["duration"],
-                    errors=errors,
-                )
+            with spans.span("store.persist", table="solutions"):
+                if self.problem == "vrp":
+                    database.save_solution(
+                        name=params["name"],
+                        description=params["description"],
+                        locations=remove_unused_locations(
+                            locations,
+                            params["ignored_customers"],
+                            params["completed_customers"],
+                        ),
+                        vehicles=result["vehicles"],
+                        duration_max=result["durationMax"],
+                        duration_sum=result["durationSum"],
+                        errors=errors,
+                    )
+                else:
+                    database.save_solution(
+                        name=params["name"],
+                        description=params["description"],
+                        locations=locations,
+                        vehicle=result["vehicle"],
+                        duration=result["duration"],
+                        errors=errors,
+                    )
 
         if len(errors) > 0:
             fail(self, errors)
@@ -145,6 +151,19 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
         # must see that persistence was best-effort.
         if getattr(database, "degraded", False) and "degraded" not in result:
             result = dict(result, degraded=True)
+
+        # includeStats waterfall, rebuilt at respond time so the spans
+        # recorded AFTER the solve (the solution save just above) are in
+        # it too — the worker-side injection only saw up to the solve
+        if (
+            self._trace is not None
+            and isinstance(result.get("stats"), dict)
+        ):
+            result = dict(result, stats=dict(
+                result["stats"],
+                spans=self._trace.waterfall(),
+                traceId=self._trace.trace_id,
+            ))
 
         # Respond
         success(self, result)

@@ -2,9 +2,12 @@
 
 Same four helpers as the reference (api/helpers.py): missing-parameter
 accumulation into a shared mutable errors list, location filtering for
-persistence, and the fail/success JSON envelopes. One additive field on
-the error envelope: `requestId` (when the handler generated one) so a
-400 can be correlated with its structured log line — the reference keys
+persistence, and the fail/success JSON envelopes. Additive fields on
+every envelope (success and error alike, 400/429/503 included):
+`requestId` and `traceId` (when the handler generated them) so any
+response — including the sheds and outage answers — correlates with
+its structured log lines and its trace (GET /api/debug/traces/{id});
+responses also carry a W3C `traceparent` header. The reference keys
 are untouched.
 """
 
@@ -62,9 +65,39 @@ def send_static_headers(handler: BaseHTTPRequestHandler):
     """Route-attached response headers (the reference's edge config pins
     CORS headers to every /api/vrp/ga RESPONSE, not just the OPTIONS
     preflight — reference vercel.json:4-11). Handlers opt in via a
-    `static_headers` class attribute; emitted by every response writer."""
+    `static_headers` class attribute; emitted by every response writer,
+    together with the request's outgoing `traceparent`."""
     for key, value in getattr(handler, "static_headers", ()):
         handler.send_header(key, value)
+    for key, value in obs.trace_response_headers(handler):
+        handler.send_header(key, value)
+
+
+def attach_ids(handler, response: dict) -> dict:
+    """Echo the request id and trace id into an envelope (every writer,
+    every status code — a 429 shed or a 503 outage answer must be as
+    correlatable as a 400)."""
+    rid = getattr(handler, "_request_id", None)
+    if rid is not None and "requestId" not in response:
+        response["requestId"] = rid
+    tid = getattr(handler, "_trace_id", None)
+    if tid is not None and "traceId" not in response:
+        response["traceId"] = tid
+    return response
+
+
+def respond_json(handler: BaseHTTPRequestHandler, code: int,
+                 payload: dict) -> None:
+    """The one JSON responder for envelope-shaped non-solve routes
+    (jobs API, readiness, debug traces): ids attached, static +
+    traceparent headers emitted."""
+    payload = attach_ids(handler, dict(payload))
+    body = json.dumps(payload).encode("utf-8")
+    handler.send_response(code)
+    handler.send_header("Content-type", "application/json")
+    send_static_headers(handler)
+    handler.end_headers()
+    handler.wfile.write(body)
 
 
 def fail(handler: BaseHTTPRequestHandler, errors):
@@ -76,10 +109,7 @@ def fail(handler: BaseHTTPRequestHandler, errors):
     handler.send_header("Content-type", "application/json")
     send_static_headers(handler)
     handler.end_headers()
-    response = {"success": False, "errors": errors}
-    rid = getattr(handler, "_request_id", None)
-    if rid is not None:
-        response["requestId"] = rid
+    response = attach_ids(handler, {"success": False, "errors": errors})
     handler.wfile.write(json.dumps(response).encode("utf-8"))
 
 
@@ -100,7 +130,7 @@ def too_busy(handler: BaseHTTPRequestHandler, retry_after_s: float):
     )
     send_static_headers(handler)
     handler.end_headers()
-    response = {
+    response = attach_ids(handler, {
         "success": False,
         "errors": [
             {
@@ -109,10 +139,7 @@ def too_busy(handler: BaseHTTPRequestHandler, retry_after_s: float):
                 "Retry-After interval",
             }
         ],
-    }
-    rid = getattr(handler, "_request_id", None)
-    if rid is not None:
-        response["requestId"] = rid
+    })
     handler.wfile.write(json.dumps(response).encode("utf-8"))
 
 
@@ -121,5 +148,5 @@ def success(handler: BaseHTTPRequestHandler, result: dict):
     handler.send_header("Content-type", "application/json")
     send_static_headers(handler)
     handler.end_headers()
-    response = {"success": True, "message": result}
+    response = attach_ids(handler, {"success": True, "message": result})
     handler.wfile.write(json.dumps(response).encode("utf-8"))

@@ -29,7 +29,6 @@ VRPMS_SCHED_QUEUE (admission bound, default 64), VRPMS_SCHED_WINDOW_MS
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
@@ -38,7 +37,12 @@ from http.server import BaseHTTPRequestHandler
 
 import store
 from service import obs
-from service.helpers import fail, read_json_body, send_static_headers, too_busy
+from service.helpers import (
+    fail,
+    read_json_body,
+    respond_json,
+    too_busy,
+)
 from service.parameters import (
     parse_common_tsp_parameters,
     parse_common_vrp_parameters,
@@ -63,9 +67,9 @@ from service.solve import (
 from vrpms_tpu.obs import (
     current_request_id,
     log_event,
-    new_request_id,
     reset_request_id,
     set_request_id,
+    spans,
 )
 from vrpms_tpu.sched import DONE, FAILED, Job, QueueFull, Scheduler
 
@@ -184,14 +188,73 @@ def _remaining_budget(job: Job):
     return max(0.0, tl - (job.queue_wait_s or 0.0))
 
 
+def _record_queue_wait(job: Job) -> None:
+    """Retroactive queue.wait span — the worker can only measure the
+    wait once the job pops. Recorded at most once per admission (the
+    batch-fallback solo retry must not duplicate it; a watchdog requeue
+    resets submitted_mono, so the SECOND wait records again — span
+    continuity across the crash, attempt marked requeued)."""
+    if job.trace is None or job.queue_wait_s is None:
+        return
+    if getattr(job, "_qw_span_mark", None) == job.submitted_mono:
+        return
+    job._qw_span_mark = job.submitted_mono
+    job.trace.span_at(
+        "queue.wait",
+        parent_id=job.span.span_id if job.span is not None else None,
+        start_mono=job.submitted_mono,
+        duration_s=job.queue_wait_s,
+        jobId=job.id,
+        requeued=job.requeued or None,
+    )
+
+
+def _activate_job_context(job: Job):
+    """Re-activate a job's carried trace context on the worker thread
+    (the explicit cross-thread hop), recording the queue wait the
+    worker just measured as a retroactive span. Returns deactivation
+    tokens (None when the job carries no trace)."""
+    if job.trace is None:
+        return None
+    tokens = spans.activate(job.trace, job.span)
+    _record_queue_wait(job)
+    return tokens
+
+
+def _solve_span_attrs(job: Job) -> dict:
+    return {
+        "jobId": job.id,
+        "batchSize": job.batch_size or 1,
+        "bucket": None if job.bucket is None else str(job.bucket),
+        # a requeued job's second attempt parents under the SAME trace:
+        # the waterfall shows both attempts, attempt 2 annotated
+        "attempt": 2 if job.requeued else 1,
+    }
+
+
+def _inject_span_stats(job: Job) -> None:
+    """includeStats responses gain the request waterfall (stats.spans).
+    Injected at solve completion on the worker; the sync handler
+    rebuilds it at respond time to include post-solve store spans."""
+    if job.trace is None or not isinstance(job.result, dict):
+        return
+    stats = job.result.get("stats")
+    if isinstance(stats, dict):
+        stats["spans"] = job.trace.waterfall()
+        stats["traceId"] = job.trace.trace_id
+
+
 def _run_solo(job: Job) -> None:
     prep: Prepared = job.payload["prep"]
     if job.time_limit and job.time_limit > 0:
         prep.opts = dict(prep.opts, time_limit=_remaining_budget(job))
     errors: list = []
     token = set_request_id(job.request_id)
+    span_tokens = _activate_job_context(job)
     try:
-        job.result = solve_prepared(prep, errors)
+        with spans.span("solve", **_solve_span_attrs(job)):
+            job.result = solve_prepared(prep, errors)
+        _inject_span_stats(job)
     except Exception as e:  # solve_prepared's own envelope paths missed
         log_event(
             "solve.exception",
@@ -203,6 +266,8 @@ def _run_solo(job: Job) -> None:
             {"what": "Data error", "reason": f"{type(e).__name__}: {e}"}
         ]
     finally:
+        if span_tokens is not None:
+            spans.deactivate(span_tokens)
         reset_request_id(token)
     if job.result is None:
         job.errors = errors or [
@@ -227,17 +292,48 @@ def _run_batched(jobs: list[Job]) -> None:
         # every job shares the nominal limit (bucket key); the batch runs
         # under the MINIMUM remaining budget so no merged job overshoots
         deadline = min(_remaining_budget(j) for j in jobs)
+    # each batched job gets its OWN solve span in its OWN trace (the
+    # launch is shared; the latency story is per request): opened before
+    # the launch, annotated with batch size + bucket, closed after its
+    # decode — so batch-neighbor interference is visible as K solve
+    # spans of near-identical duration across K traces
+    solve_spans = []
+    for job in jobs:
+        if job.trace is None:
+            solve_spans.append(None)
+            continue
+        _record_queue_wait(job)
+        s = job.trace.span(
+            "solve",
+            parent_id=job.span.span_id if job.span is not None else None,
+        )
+        s.set(**_solve_span_attrs(job))
+        solve_spans.append(s)
     t0 = time.perf_counter()
-    results = solve_sa_batch(
-        [p.inst for p in preps], seeds, params=params, deadline_s=deadline
-    )
+    try:
+        results = solve_sa_batch(
+            [p.inst for p in preps], seeds, params=params, deadline_s=deadline
+        )
+    except BaseException:
+        # the batch-fallback path (_runner) will re-run each job solo
+        # with a fresh solve span; this attempt's spans must terminate
+        # as errors, not dangle open and inflate the trace duration
+        for s in solve_spans:
+            if s is not None:
+                s.end(status="error")
+        raise
     wall = time.perf_counter() - t0
     obs.SOLVE_SECONDS.labels(
         problem=preps[0].problem, algorithm="sa"
-    ).observe(wall)
-    for job, prep, res in zip(jobs, preps, results):
+    ).observe(wall, trace_id=jobs[0].trace.trace_id if jobs[0].trace else None)
+    for job, prep, res, solve_span in zip(jobs, preps, results, solve_spans):
         errors: list = []
         token = set_request_id(job.request_id)
+        span_tokens = (
+            spans.activate(job.trace, solve_span)
+            if job.trace is not None
+            else None
+        )
         try:
             obs.SOLVE_EVALS.observe(float(res.evals))
             if prep.problem == "vrp":
@@ -255,6 +351,12 @@ def _run_batched(jobs: list[Job]) -> None:
                 {"what": "Data error", "reason": f"{type(e).__name__}: {e}"}
             ]
         finally:
+            if span_tokens is not None:
+                spans.deactivate(span_tokens)
+            if solve_span is not None:
+                solve_span.end(
+                    status="error" if job.result is None else None
+                )
             reset_request_id(token)
         if job.result is None:
             job.errors = errors
@@ -323,6 +425,7 @@ def _job_record(job: Job) -> dict:
         ),
         "batchSize": job.batch_size or None,
         "requestId": job.request_id,
+        "traceId": job.trace.trace_id if job.trace is not None else None,
     }
     if job.status == DONE:
         rec["message"] = job.result
@@ -341,14 +444,43 @@ def _persist(job: Job) -> None:
     db = job.payload.get("job_db")
     if db is None:
         return
-    db.save_job(job.id, _job_record(job))
+    if job.trace is None:
+        db.save_job(job.id, _job_record(job))
+        return
+    # explicit span on the job's own trace: terminal persists run on
+    # the worker/watchdog thread where no trace context is active
+    s = job.trace.span(
+        "store.persist_job",
+        parent_id=job.span.span_id if job.span is not None else None,
+    )
+    s.set(status=job.status)
+    try:
+        db.save_job(job.id, _job_record(job))
+    finally:
+        s.end()
+
+
+#: job transitions mirrored as events on the job's root span — the
+#: waterfall tells the lifecycle story without cross-referencing logs
+_SPAN_EVENTS = (
+    "queued", "started", "expired", "requeued", "crashed", "drained",
+    "runner_error",
+)
 
 
 def _on_event(name: str, job: Job) -> None:
-    """Scheduler observer: metrics + structured log + store record."""
+    """Scheduler observer: metrics + structured log + store record +
+    trace lifecycle (events on the root span; DEFERRED traces — async
+    jobs whose 202 long left — finish here at the terminal transition,
+    entering the debug ring / slow-capture)."""
+    if job.trace is not None and name in _SPAN_EVENTS and job.span is not None:
+        job.span.event(f"job.{name}", jobId=job.id)
     if name == "started":
         if job.queue_wait_s is not None:
-            obs.SCHED_QUEUE_WAIT.observe(job.queue_wait_s)
+            obs.SCHED_QUEUE_WAIT.observe(
+                job.queue_wait_s,
+                trace_id=job.trace.trace_id if job.trace else None,
+            )
         obs.SCHED_BATCH_SIZE.observe(job.batch_size or 1)
     elif name == "expired":
         obs.SCHED_REJECTS.labels(reason="deadline_spent").inc()
@@ -384,6 +516,12 @@ def _on_event(name: str, job: Job) -> None:
             else None
         ),
     )
+    terminal = name in ("done", "failed", "expired", "crashed", "drained")
+    if terminal and job.trace is not None and job.trace.deferred:
+        # finish BEFORE the terminal persist: once a poll can read the
+        # job as done, GET /api/debug/traces/{traceId} must find the
+        # trace in the ring
+        job.trace.finish(status="ok" if name == "done" else "error")
     if name not in ("queued", "runner_error", "requeued"):
         # queued is persisted synchronously at submit; runner_error is
         # always followed by the terminal `failed` persist; requeued is
@@ -491,6 +629,11 @@ def scheduler_solve(problem, algorithm, params, opts, algo_params,
         bucket=_bucket_key(prep),
         time_limit=_job_time_limit(opts),
         request_id=current_request_id(),
+        # span context crosses the thread hop ON the job: the worker
+        # re-activates it (sync path: the trace stays the handler's to
+        # finish — this thread parks right here until the job ends)
+        trace=spans.current_trace(),
+        span=spans.current_span(),
     )
     get_scheduler().submit(job, backend=_backend_label(opts))
     job.wait()
@@ -507,16 +650,7 @@ def scheduler_solve(problem, algorithm, params, opts, algo_params,
 # ---------------------------------------------------------------------------
 
 
-def _respond(handler, code: int, payload: dict) -> None:
-    rid = getattr(handler, "_request_id", None)
-    if rid is not None and "requestId" not in payload:
-        payload = dict(payload, requestId=rid)
-    body = json.dumps(payload).encode("utf-8")
-    handler.send_response(code)
-    handler.send_header("Content-type", "application/json")
-    send_static_headers(handler)
-    handler.end_headers()
-    handler.wfile.write(body)
+_respond = respond_json
 
 
 class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
@@ -534,42 +668,41 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
         )
 
     def do_POST(self):
-        self._obs_t0 = time.perf_counter()
-        self._request_id = new_request_id()
-        token = set_request_id(self._request_id)
+        obs.begin_request_obs(self)
         try:
             self._submit()
         finally:
-            reset_request_id(token)
+            obs.end_request_obs(self)
 
     def _submit(self):
-        content = read_json_body(self)
-        if content is None:
-            return
+        with spans.span("parse"):
+            content = read_json_body(self)
+            if content is None:
+                return
 
-        problem = content.get("problem")
-        algorithm = content.get("algorithm")
-        errors: list = []
-        if problem not in ("vrp", "tsp"):
-            errors += [{
-                "what": "Missing parameter",
-                "reason": "'problem' must be 'vrp' or 'tsp'",
-            }]
-        if algorithm not in ("ga", "sa", "aco", "bf"):
-            errors += [{
-                "what": "Missing parameter",
-                "reason": "'algorithm' must be one of ga|sa|aco|bf",
-            }]
-        if errors:
-            fail(self, errors)
-            return
-        self.algorithm = algorithm  # request-counter label parity
-        self.problem = problem
+            problem = content.get("problem")
+            algorithm = content.get("algorithm")
+            errors: list = []
+            if problem not in ("vrp", "tsp"):
+                errors += [{
+                    "what": "Missing parameter",
+                    "reason": "'problem' must be 'vrp' or 'tsp'",
+                }]
+            if algorithm not in ("ga", "sa", "aco", "bf"):
+                errors += [{
+                    "what": "Missing parameter",
+                    "reason": "'algorithm' must be one of ga|sa|aco|bf",
+                }]
+            if errors:
+                fail(self, errors)
+                return
+            self.algorithm = algorithm  # request-counter label parity
+            self.problem = problem
 
-        parse_common, parse_algo = _PARSERS[(problem, algorithm)]
-        params = parse_common(content, errors)
-        algo_params = parse_algo(content, errors) if parse_algo else {}
-        opts = parse_solver_options(content, errors)
+            parse_common, parse_algo = _PARSERS[(problem, algorithm)]
+            params = parse_common(content, errors)
+            algo_params = parse_algo(content, errors) if parse_algo else {}
+            opts = parse_solver_options(content, errors)
         if errors:
             fail(self, errors)
             return
@@ -578,8 +711,9 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
         except Exception as e:
             fail(self, [{"what": "Database error", "reason": str(e)}])
             return
-        locations = database.get_locations_by_id(params["locations_key"], errors)
-        durations = database.get_durations_by_id(params["durations_key"], errors)
+        with spans.span("store.read", tables="locations,durations"):
+            locations = database.get_locations_by_id(params["locations_key"], errors)
+            durations = database.get_durations_by_id(params["durations_key"], errors)
         if errors:
             fail(self, errors)
             return
@@ -599,6 +733,8 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             bucket=_bucket_key(prep),
             time_limit=_job_time_limit(opts),
             request_id=self._request_id,
+            trace=self._trace,
+            span=self._trace_root,
         )
         if prep.trivial is not None:
             # nothing to schedule: the job is born done
@@ -612,9 +748,15 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             return
         _persist(job)  # queued record first: a poll can never 404 a
         # job whose id was already returned
+        if self._trace is not None:
+            # the 202 leaves now; the worker finishes the trace at the
+            # job's terminal transition (service._on_event)
+            self._trace.deferred = True
         try:
             get_scheduler().submit(job, backend=_backend_label(opts))
         except QueueFull as e:
+            if self._trace is not None:
+                self._trace.deferred = False  # never scheduled: ours again
             obs.SCHED_REJECTS.labels(reason="queue_full").inc()
             obs.JOBS_TOTAL.labels(outcome="failed").inc()
             job.errors = [{
@@ -634,20 +776,21 @@ class JobStatusHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
     """GET /api/jobs/{id} — poll a job's lifecycle record."""
 
     def do_GET(self):
-        self._obs_t0 = time.perf_counter()
-        self._request_id = new_request_id()
-        token = set_request_id(self._request_id)
+        # header-sampled: a poll loop must not evict solve traces from
+        # the debug ring; polls that DO carry traceparent join fully
+        obs.begin_request_obs(self, sample="header")
         try:
             self._status()
         finally:
-            reset_request_id(token)
+            obs.end_request_obs(self)
 
     def _status(self):
         job_id = self.path.split("?", 1)[0].rstrip("/").rsplit("/", 1)[-1]
         errors: list = []
         try:
             db = store.get_database("vrp", None)
-            record = db.get_job(job_id, errors)
+            with spans.span("store.read", tables="jobs"):
+                record = db.get_job(job_id, errors)
         except Exception as e:
             fail(self, [{"what": "Database error", "reason": str(e)}])
             return
@@ -734,12 +877,16 @@ def readiness() -> tuple[int, dict]:
 
 
 class ReadyHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
-    """GET /api/ready — ok|degraded|down readiness probe (503 on down)."""
+    """GET /api/ready — ok|degraded|down readiness probe (503 on down).
+    The 503 envelope carries requestId/traceId like every error path:
+    an outage answer is exactly the response that must correlate."""
 
     def do_GET(self):
-        self._obs_t0 = time.perf_counter()
-        self._request_id = new_request_id()
-        code, body = readiness()
-        if code != 200:
-            self._obs_errors = [body["status"]]
-        _respond(self, code, dict(body, success=code == 200))
+        obs.begin_request_obs(self, sample="header")
+        try:
+            code, body = readiness()
+            if code != 200:
+                self._obs_errors = [body["status"]]
+            _respond(self, code, dict(body, success=code == 200))
+        finally:
+            obs.end_request_obs(self)

@@ -24,7 +24,14 @@ from __future__ import annotations
 import time
 from http.server import BaseHTTPRequestHandler
 
-from vrpms_tpu.obs import Registry, log_event
+from vrpms_tpu.obs import (
+    Registry,
+    log_event,
+    new_request_id,
+    reset_request_id,
+    set_request_id,
+    spans,
+)
 
 REGISTRY = Registry()
 
@@ -160,6 +167,17 @@ TIER_CACHE = REGISTRY.counter(
     "available), miss = first sighting (the solve may pay compiles)",
     labels=("outcome",),
 )
+BUILD_INFO = REGISTRY.gauge(
+    "vrpms_build_info",
+    "Constant 1, labeled with the package version, jax version, and "
+    "backend platform — correlate deploys with behavior shifts",
+    labels=("version", "jaxVersion", "platform"),
+)
+TRACE_RING_SIZE = REGISTRY.gauge(
+    "vrpms_trace_ring_size",
+    "Completed traces currently retained in the debug ring "
+    "(GET /api/debug/traces); refreshed per scrape",
+)
 UPTIME = REGISTRY.gauge(
     "vrpms_uptime_seconds", "Seconds since service process start"
 )
@@ -219,22 +237,111 @@ def refresh_gauges() -> None:
             STORE_JOURNAL_DEPTH.labels(kind=kind).set(depth)
     except Exception:
         pass
+    TRACE_RING_SIZE.set(spans.ring_size())
+    jax_version = "unavailable"
     try:
         import jax
 
         DEVICES.set(len(jax.devices()))
         backend = jax.default_backend()
+        jax_version = jax.__version__
     except Exception:
         DEVICES.set(0)
         backend = "unavailable"
     BACKEND_INFO.labels(backend=backend, compileCache=_compile_cache).set(1)
+    try:
+        from vrpms_tpu import __version__ as pkg_version
+    except Exception:  # pragma: no cover - version attr always present
+        pkg_version = "unknown"
+    BUILD_INFO.labels(
+        version=pkg_version, jaxVersion=jax_version, platform=backend
+    ).set(1)
 
 
 def route_label(path: str) -> str:
     if path.startswith("/api/jobs/"):
         # per-id status polls must not mint a label series per job
         return "/api/jobs/{id}"
+    if path.startswith("/api/debug/traces/"):
+        # same rule for per-trace detail reads
+        return "/api/debug/traces/{traceId}"
     return path if path in KNOWN_ROUTES else "<unmatched>"
+
+
+# ---------------------------------------------------------------------------
+# Per-request context: id + trace, opened/closed around every handler body
+# ---------------------------------------------------------------------------
+
+
+def begin_request_obs(handler, sample: str = "always") -> None:
+    """Open the request's observability context on the HTTP thread:
+    clock, request id (contextvar-bound), and — tracing on — a Trace
+    adopted from the W3C `traceparent` header (fresh ids when absent or
+    malformed) with a root span named after the route. Every handler
+    body runs between begin/end so each log line, metric exemplar, and
+    span of the request correlates.
+
+    `sample="header"` traces only when the client sent a VALID
+    traceparent — the cheap high-frequency surfaces (job status polls,
+    readiness probes, debug reads) must not evict real solve traces
+    from the debug ring, and a malformed header minting a fresh trace
+    per poll would defeat exactly that."""
+    handler._obs_t0 = time.perf_counter()
+    handler._request_id = new_request_id()
+    handler._rid_token = set_request_id(handler._request_id)
+    header = handler.headers.get("traceparent")
+    if sample == "header" and spans.parse_traceparent(header)[0] is None:
+        trace = None
+    else:
+        trace = spans.start_trace(header)
+    handler._trace = trace
+    handler._trace_id = trace.trace_id if trace is not None else None
+    handler._trace_root = None
+    handler._span_tokens = None
+    if trace is not None:
+        path = (
+            (getattr(handler, "path", "") or "").split("?", 1)[0].rstrip("/")
+            or "/"
+        )
+        root = trace.span(
+            f"{getattr(handler, 'command', 'HTTP')} {route_label(path)}"
+        )
+        root.set(requestId=handler._request_id)
+        handler._trace_root = root
+        handler._span_tokens = spans.activate(trace, root)
+
+
+def end_request_obs(handler) -> None:
+    """Close the context: end the root span, drop the activation, and
+    finish the trace (ring + slow-capture) — unless the trace was
+    DEFERRED to the scheduler worker (async jobs: the 202 left long
+    before the solve will end; the worker finishes it at the job's
+    terminal transition)."""
+    trace = getattr(handler, "_trace", None)
+    if trace is not None:
+        status = "error" if getattr(handler, "_obs_errors", None) else None
+        root = handler._trace_root
+        if root is not None:
+            root.end(status=status)
+        if handler._span_tokens is not None:
+            spans.deactivate(handler._span_tokens)
+        if not trace.deferred:
+            trace.finish(status=status)
+    token = getattr(handler, "_rid_token", None)
+    if token is not None:
+        reset_request_id(token)
+
+
+def trace_response_headers(handler) -> list[tuple[str, str]]:
+    """The outgoing `traceparent` header (parent = this request's root
+    span) — emitted by every envelope writer so downstream hops and
+    clients join the same trace."""
+    trace = getattr(handler, "_trace", None)
+    if trace is None:
+        return []
+    root = getattr(handler, "_trace_root", None)
+    span_id = root.span_id if root is not None else spans.new_span_id()
+    return [("traceparent", spans.format_traceparent(trace.trace_id, span_id))]
 
 
 class RequestObsMixin:
@@ -292,14 +399,26 @@ class RequestObsMixin:
 
 
 class MetricsHandler(RequestObsMixin, BaseHTTPRequestHandler):
-    """GET /metrics — Prometheus text exposition of the REGISTRY."""
+    """GET /metrics — Prometheus exposition of the REGISTRY.
+
+    Content-negotiated: scrapers advertising OpenMetrics in Accept
+    (modern Prometheus does by default) get the OpenMetrics exposition
+    WITH trace-id exemplars and the `# EOF` terminator; everyone else
+    gets the classic 0.0.4 text format without exemplars — a classic
+    parser errors on the exemplar `#` and fails the whole scrape.
+    """
 
     def do_GET(self):
         refresh_gauges()
-        body = REGISTRY.render().encode("utf-8")
+        accept = self.headers.get("Accept", "")
+        openmetrics = "application/openmetrics-text" in accept
+        body = REGISTRY.render(openmetrics=openmetrics).encode("utf-8")
         self.send_response(200)
         self.send_header(
-            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            "Content-Type",
+            "application/openmetrics-text; version=1.0.0; charset=utf-8"
+            if openmetrics
+            else "text/plain; version=0.0.4; charset=utf-8",
         )
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

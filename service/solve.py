@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from service import obs
-from vrpms_tpu.obs import collect_blocks, convergence_summary, log_event
+from vrpms_tpu.obs import collect_blocks, convergence_summary, log_event, spans
 
 from vrpms_tpu.core import make_instance
 from vrpms_tpu.core import tiers
@@ -740,22 +740,40 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
     # without it the solver loops pay one ContextVar read per block and
     # the result stays byte-identical to the pre-telemetry contract
     with _profiled(opts) as trace_dir, collect_blocks(include_stats) as btrace:
-        res = _solve_instance(
-            inst, algorithm, opts, ga_params, errors, problem, warm, w, extras
-        )
+        with spans.span(
+            "solver.solve", algorithm=algorithm, problem=problem
+        ) as solve_span:
+            res = _solve_instance(
+                inst, algorithm, opts, ga_params, errors, problem, warm, w,
+                extras,
+            )
         t_polish = time.perf_counter()
-        res, polished = _polish(res, inst, opts, w, t0)
+        if _polish_spec(opts) and res is not None:
+            with spans.span("solver.polish"):
+                res, polished = _polish(res, inst, opts, w, t0)
+        else:
+            res, polished = _polish(res, inst, opts, w, t0)
         polish_s = time.perf_counter() - t_polish
         if res is not None:
             jax.block_until_ready(res.cost)
     wall_s = time.perf_counter() - t0
+    # compile attribution joins the span tree too: a slow trace whose
+    # solve span carries compile* attrs is a cold-start, not a solver
+    # regression (the exact question an operator asks about a p99 spike)
+    compiles1, compile_s1 = compile_obs.snapshot_local()
+    if solve_span is not None and compiles1 > compiles0:
+        solve_span.set(
+            compileCount=compiles1 - compiles0,
+            compileSeconds=round(compile_s1 - compile_s0, 3),
+        )
     if res is not None:
+        trace_id = spans.current_trace_id()
         obs.SOLVE_SECONDS.labels(problem=problem, algorithm=algorithm).observe(
-            wall_s
+            wall_s, trace_id=trace_id
         )
         obs.SOLVE_EVALS.observe(float(res.evals))
         if polished:
-            obs.POLISH_SECONDS.observe(polish_s)
+            obs.POLISH_SECONDS.observe(polish_s, trace_id=trace_id)
     if res is None or not include_stats:
         return res, None
     stats = {
@@ -766,7 +784,6 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
         "warmStart": warm is not None,
         "localSearch": polished,
     }
-    compiles1, compile_s1 = compile_obs.snapshot_local()
     if compiles1 > compiles0:
         # the solve paid XLA compiles (first sighting of its shape tier
         # in this process): surface what cold-start actually cost
@@ -900,6 +917,11 @@ def prepare_vrp(algorithm, params, opts, ga_params, locations, matrix,
 
 def finish_vrp(prep: Prepared, res, stats, extras, errors) -> dict:
     """Decode a VRP SolveResult to the contract shape + checkpoint it."""
+    with spans.span("finish", problem="vrp"):
+        return _finish_vrp(prep, res, stats, extras, errors)
+
+
+def _finish_vrp(prep: Prepared, res, stats, extras, errors) -> dict:
     bd = res.breakdown
     route_durs = np.asarray(bd.route_durations)
     demands = np.asarray(prep.inst.demands)
@@ -930,11 +952,12 @@ def finish_vrp(prep: Prepared, res, stats, extras, errors) -> dict:
     if prep.database is not None:
         routes = [v["tour"][1:-1] for v in vehicles]
         chk_cost = _as_float(res.cost)  # penalized objective, not raw duration
-        prep.database.save_warmstart(
-            prep.params["name"],
-            {"problem": "vrp", "routes": routes, "cost": chk_cost},
-            better_than=lambda prev: _better_checkpoint(prev, "vrp", routes, chk_cost),
-        )
+        with spans.span("store.persist", table="warmstarts"):
+            prep.database.save_warmstart(
+                prep.params["name"],
+                {"problem": "vrp", "routes": routes, "cost": chk_cost},
+                better_than=lambda prev: _better_checkpoint(prev, "vrp", routes, chk_cost),
+            )
     return _mark_degraded(prep, result)
 
 
@@ -1058,6 +1081,11 @@ def prepare_tsp(algorithm, params, opts, ga_params, locations, matrix,
 
 def finish_tsp(prep: Prepared, res, stats, extras, errors) -> dict:
     """Decode a TSP SolveResult to the contract shape + checkpoint it."""
+    with spans.span("finish", problem="tsp"):
+        return _finish_tsp(prep, res, stats, extras, errors)
+
+
+def _finish_tsp(prep: Prepared, res, stats, extras, errors) -> dict:
     start_node = prep.anchor_id
     n_real = None if prep.inst.n_real is None else int(prep.inst.n_real)
     routes = routes_from_giant(res.giant, n_real)
@@ -1076,11 +1104,12 @@ def finish_tsp(prep: Prepared, res, stats, extras, errors) -> dict:
     if prep.database is not None:
         routes = [tour[1:-1]]
         chk_cost = _as_float(res.cost)  # penalized objective, not raw duration
-        prep.database.save_warmstart(
-            prep.params["name"],
-            {"problem": "tsp", "routes": routes, "cost": chk_cost},
-            better_than=lambda prev: _better_checkpoint(prev, "tsp", routes, chk_cost),
-        )
+        with spans.span("store.persist", table="warmstarts"):
+            prep.database.save_warmstart(
+                prep.params["name"],
+                {"problem": "tsp", "routes": routes, "cost": chk_cost},
+                better_than=lambda prev: _better_checkpoint(prev, "tsp", routes, chk_cost),
+            )
     return _mark_degraded(prep, result)
 
 
@@ -1103,8 +1132,9 @@ def prepare_request(problem, algorithm, params, opts, ga_params, locations,
     Data-error envelope entry, never a raised exception."""
     fn = prepare_vrp if problem == "vrp" else prepare_tsp
     try:
-        return fn(algorithm, params, opts, ga_params, locations, matrix,
-                  errors, database)
+        with spans.span("prepare", problem=problem, algorithm=algorithm):
+            return fn(algorithm, params, opts, ga_params, locations, matrix,
+                      errors, database)
     except Exception as e:
         log_event(
             "prepare.exception",

@@ -57,7 +57,7 @@ import threading
 import time
 
 from store.base import Database, DatabaseTSP, DatabaseVRP
-from vrpms_tpu.obs import log_event
+from vrpms_tpu.obs import log_event, spans
 
 CLOSED, HALF_OPEN, OPEN = "closed", "half-open", "open"
 #: Prometheus encoding of breaker state (gauge value on /metrics).
@@ -393,6 +393,17 @@ class _ResilientMixin(Database):
 
     # -- read path: deadline + retries + cache fallback ---------------------
     def _read(self, method: str, args: tuple, cache_key=None):
+        # the resilience story joins the request's trace: each guarded
+        # call is one span recording attempts/retries, the breaker
+        # state it saw, and whether a degraded fallback served it —
+        # the "store retry storm" a p99 spike needs attributed
+        with spans.span(
+            "store.resilient", op="read", method=method.lstrip("_"),
+            kind=self.kind,
+        ) as sp:
+            return self._read_guarded(method, args, cache_key, sp)
+
+    def _read_guarded(self, method: str, args: tuple, cache_key, sp):
         # the deadline bounds the WHOLE read — attempts and backoff
         # sleeps share it, so retries help against fast flaky errors
         # but a hung backend costs exactly one deadline, never
@@ -409,12 +420,20 @@ class _ResilientMixin(Database):
                 if remaining <= 0:
                     break  # the read's whole budget is spent
             if not res.breaker.allow():
+                if sp is not None:
+                    sp.set(breaker=res.breaker.state)
                 break  # shed instantly; fall through to the cache
             try:
                 value = self._attempt(method, args, timeout=remaining)
             except Exception as exc:
                 last_exc = exc
                 self._note_failure(method, exc)
+                if sp is not None:
+                    sp.event(
+                        "store.retry",
+                        attempt=attempt,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
                 if attempt < self.retries:
                     obs = _obs()
                     if obs is not None:
@@ -428,6 +447,8 @@ class _ResilientMixin(Database):
                     time.sleep(delay)
                 continue
             self._note_success()
+            if sp is not None and attempt:
+                sp.set(attempts=attempt + 1)
             if cache_key is not None:
                 res.fallback.put(cache_key, value)
             return value
@@ -435,6 +456,11 @@ class _ResilientMixin(Database):
             hit, value = res.fallback.get(cache_key)
             if hit:
                 self._served_fallback("cache", method)
+                if sp is not None:
+                    sp.set(
+                        fallback="cache", degraded=True,
+                        breaker=res.breaker.state,
+                    )
                 return value
         if last_exc is not None:
             raise StoreUnavailable(
@@ -448,6 +474,14 @@ class _ResilientMixin(Database):
     # -- write path: at-most-once inline, then the journal ------------------
     def _write(self, method: str, args: tuple, fallback_row=None,
                sentinel=None):
+        with spans.span(
+            "store.resilient", op="write", method=method.lstrip("_"),
+            kind=self.kind,
+        ) as sp:
+            return self._write_guarded(method, args, fallback_row, sentinel, sp)
+
+    def _write_guarded(self, method: str, args: tuple, fallback_row,
+                       sentinel, sp):
         res = self._res
         key = fallback_row[0] if fallback_row is not None else None
         if res.breaker.allow():
@@ -455,6 +489,11 @@ class _ResilientMixin(Database):
                 value = self._attempt(method, args)
             except Exception as exc:
                 self._note_failure(method, exc)
+                if sp is not None:
+                    sp.event(
+                        "store.write_failed",
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
             else:
                 # supersede any stale spooled version of this key
                 # BEFORE _note_success can kick off a replay — a
@@ -476,6 +515,11 @@ class _ResilientMixin(Database):
         if fallback_row is not None:
             res.fallback.put(*fallback_row)  # degraded reads see the write
         self._served_fallback("journal", method)
+        if sp is not None:
+            sp.set(
+                fallback="journal", degraded=True,
+                breaker=res.breaker.state, journalDepth=len(res.journal),
+            )
         log_event("store.journal_spool", kind=self.kind, method=method,
                   depth=len(res.journal))
         return sentinel

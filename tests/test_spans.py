@@ -1,0 +1,347 @@
+"""Unit tests for the span-tracing subsystem (vrpms_tpu.obs.spans).
+
+Model behavior (span tree, attributes, events, caps), W3C traceparent
+parsing with its full malformed-header ladder (a bad header means a
+fresh trace, never an error), context propagation across threads (the
+scheduler hop the Job models), the completed-trace ring with its
+filters, slow-trace auto-capture, and the registry's histogram
+exemplars.
+"""
+
+import io
+import json
+import threading
+
+import pytest
+
+from vrpms_tpu.obs import Registry, collect_blocks, set_log_stream, spans
+
+
+@pytest.fixture(autouse=True)
+def clean_ring():
+    spans.reset_ring()
+    yield
+    spans.reset_ring()
+
+
+class TestSpanModel:
+    def test_root_and_children(self):
+        t = spans.Trace()
+        tokens = spans.activate(t)
+        try:
+            with spans.span("root") as root:
+                with spans.span("child", algorithm="sa") as child:
+                    child.event("tick", n=1)
+                with spans.span("sibling"):
+                    pass
+        finally:
+            spans.deactivate(tokens)
+        wf = t.waterfall()
+        assert [s["name"] for s in wf] == ["root", "child", "sibling"]
+        by_name = {s["name"]: s for s in wf}
+        assert by_name["child"]["parentId"] == by_name["root"]["spanId"]
+        assert by_name["sibling"]["parentId"] == by_name["root"]["spanId"]
+        assert by_name["root"]["parentId"] is None
+        assert by_name["child"]["attributes"]["algorithm"] == "sa"
+        assert by_name["child"]["events"][0]["name"] == "tick"
+        for s in wf:
+            assert s["durationMs"] is not None and s["durationMs"] >= 0
+            assert len(s["spanId"]) == 16
+
+    def test_span_without_trace_is_noop(self):
+        assert spans.current_trace() is None
+        with spans.span("nothing") as s:
+            assert s is None
+        assert spans.current_span() is None
+
+    def test_exception_marks_error_and_reraises(self):
+        t = spans.Trace()
+        tokens = spans.activate(t)
+        try:
+            with pytest.raises(ValueError):
+                with spans.span("boom"):
+                    raise ValueError("nope")
+        finally:
+            spans.deactivate(tokens)
+        (s,) = t.waterfall()
+        assert s["status"] == "error"
+        assert "ValueError" in s["attributes"]["error"]
+
+    def test_end_is_idempotent_first_wins(self):
+        t = spans.Trace()
+        s = t.span("once")
+        s.end()
+        first = s.duration_ms
+        s.end(status="error")
+        assert s.duration_ms == first
+        assert s.status == "error"  # status may still be corrected
+
+    def test_span_cap_truncates_but_returns_usable_span(self):
+        t = spans.Trace()
+        for i in range(spans.MAX_SPANS_PER_TRACE + 5):
+            s = t.span(f"s{i}")
+            s.end()
+        assert len(t.spans) == spans.MAX_SPANS_PER_TRACE
+        assert t.truncated
+
+    def test_event_cap(self):
+        t = spans.Trace()
+        s = t.span("busy")
+        for i in range(spans.MAX_EVENTS_PER_SPAN + 10):
+            s.event("e", i=i)
+        assert len(s.events) == spans.MAX_EVENTS_PER_SPAN
+        assert t.truncated
+
+    def test_retroactive_span_at(self):
+        import time
+
+        t = spans.Trace()
+        now = time.monotonic()
+        s = t.span_at("queue.wait", None, now - 0.25, 0.25, jobId="j1")
+        assert s.duration_ms == 250.0
+        assert s.attributes["jobId"] == "j1"
+
+    def test_cross_thread_activation(self):
+        """The scheduler hop: a worker thread re-activates the carried
+        context and its spans land in the same trace."""
+        t = spans.Trace()
+        root = t.span("root")
+        seen = {}
+
+        def worker():
+            tokens = spans.activate(t, root)
+            try:
+                with spans.span("solve") as s:
+                    seen["trace_id"] = spans.current_trace_id()
+                    seen["parent"] = s.parent_id
+            finally:
+                spans.deactivate(tokens)
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+        assert seen["trace_id"] == t.trace_id
+        assert seen["parent"] == root.span_id
+        assert [s.name for s in t.spans] == ["root", "solve"]
+
+    def test_waterfall_is_json_serializable(self):
+        t = spans.Trace()
+        with_tokens = spans.activate(t)
+        with spans.span("a", n=3, label="x"):
+            spans.add_event("ev", v=1.5)
+        spans.deactivate(with_tokens)
+        json.dumps(t.waterfall())
+
+
+class TestTraceparent:
+    GOOD = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+    def test_valid_header_adopted(self):
+        tid, pid = spans.parse_traceparent(self.GOOD)
+        assert tid == "ab" * 16
+        assert pid == "cd" * 8
+        t = spans.start_trace(self.GOOD)
+        assert t.trace_id == tid and t.remote_parent_id == pid
+        root = t.span("root")
+        assert root.parent_id == pid  # parents under the remote span
+
+    @pytest.mark.parametrize(
+        "header",
+        [
+            None,
+            "",
+            "garbage",
+            "00-short-" + "cd" * 8 + "-01",                     # bad trace len
+            "00-" + "ab" * 16 + "-short-01",                    # bad span len
+            "0-" + "ab" * 16 + "-" + "cd" * 8 + "-01",          # bad version len
+            "zz-" + "ab" * 16 + "-" + "cd" * 8 + "-01",         # non-hex version
+            "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",         # forbidden ff
+            "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",         # uppercase hex
+            "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",          # all-zero trace
+            "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",         # all-zero span
+            "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01-extra",   # v00 extra part
+            "00-" + "ab" * 16 + "-" + "cd" * 8 + "-0x",         # non-hex flags
+            "00-" + "ab" * 16 + "-" + "cd" * 8,                 # missing flags
+            "00-" + "ab" * 5000 + "-" + "cd" * 8 + "-01",       # oversized
+        ],
+    )
+    def test_malformed_header_means_fresh_trace(self, header):
+        tid, pid = spans.parse_traceparent(header)
+        assert tid is None and pid is None
+        t = spans.start_trace(header)
+        assert t is not None
+        assert len(t.trace_id) == 32 and t.remote_parent_id is None
+
+    def test_future_version_tolerated(self):
+        # W3C: unknown versions parse the known prefix (extra parts ok)
+        header = "01-" + "ab" * 16 + "-" + "cd" * 8 + "-01-whatever"
+        tid, pid = spans.parse_traceparent(header)
+        assert tid == "ab" * 16 and pid == "cd" * 8
+
+    def test_format_roundtrip(self):
+        tid, sid = spans.new_trace_id(), spans.new_span_id()
+        out = spans.format_traceparent(tid, sid)
+        assert spans.parse_traceparent(out) == (tid, sid)
+
+    def test_tracing_off_disables(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TRACING", "off")
+        assert spans.start_trace(self.GOOD) is None
+
+
+class TestRing:
+    def _finished_trace(self, name="root", status=None):
+        t = spans.Trace()
+        t.span(name).end()
+        t.finish(status=status)
+        return t
+
+    def test_finish_pushes_once(self):
+        t = self._finished_trace()
+        t.finish()  # idempotent
+        assert spans.ring_size() == 1
+        assert spans.ring_get(t.trace_id) is t
+
+    def test_empty_trace_not_retained(self):
+        t = spans.Trace()
+        t.finish()
+        assert spans.ring_size() == 0
+
+    def test_capacity_evicts_oldest(self):
+        spans.reset_ring(capacity=3)
+        traces = [self._finished_trace() for _ in range(5)]
+        assert spans.ring_size() == 3
+        assert spans.ring_get(traces[0].trace_id) is None
+        assert spans.ring_get(traces[-1].trace_id) is traces[-1]
+
+    def test_snapshot_filters(self):
+        slow = spans.Trace()
+        s = slow.span_at("root", None, slow.start_mono, 2.0)  # 2000 ms
+        s.end()
+        slow.finish()
+        fast = self._finished_trace()
+        bad = self._finished_trace(status="error")
+        got = spans.ring_snapshot(min_duration_ms=1000.0)
+        assert [g["traceId"] for g in got] == [slow.trace_id]
+        got = spans.ring_snapshot(status="error")
+        assert [g["traceId"] for g in got] == [bad.trace_id]
+        assert len(spans.ring_snapshot(limit=2)) == 2
+        # newest first
+        all_ids = [g["traceId"] for g in spans.ring_snapshot()]
+        assert all_ids == [bad.trace_id, fast.trace_id, slow.trace_id]
+
+    def test_env_ring_capacity(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TRACE_RING", "2")
+        spans.reset_ring()
+        assert spans.ring_capacity() == 2
+
+
+class TestSlowCapture:
+    def test_slow_trace_logged_with_waterfall(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TRACE_SLOW_MS", "1")
+        t = spans.Trace()
+        t.span_at("solve", None, t.start_mono, 0.05).end()
+        buf = io.StringIO()
+        prev = set_log_stream(buf)
+        try:
+            t.finish()
+        finally:
+            set_log_stream(prev)
+        (line,) = [
+            ln for ln in buf.getvalue().splitlines() if "trace.slow" in ln
+        ]
+        rec = json.loads(line)
+        assert rec["traceId"] == t.trace_id
+        assert rec["durationMs"] >= 1
+        assert [s["name"] for s in rec["spans"]] == ["solve"]
+
+    def test_fast_trace_not_logged(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TRACE_SLOW_MS", "60000")
+        t = spans.Trace()
+        t.span("quick").end()
+        buf = io.StringIO()
+        prev = set_log_stream(buf)
+        try:
+            t.finish()
+        finally:
+            set_log_stream(prev)
+        assert "trace.slow" not in buf.getvalue()
+
+
+class TestBlockTraceFeedsSpans:
+    def test_block_entries_become_span_events(self):
+        t = spans.Trace()
+        tokens = spans.activate(t)
+        try:
+            with spans.span("solver.solve") as s:
+                with collect_blocks() as bt:
+                    bt.record([5.0, 3.0], iters=128, evals_per_iter=4)
+                    bt.record([2.5], iters=128, evals_per_iter=4)
+        finally:
+            spans.deactivate(tokens)
+        events = [e for e in s.events if e["name"] == "block"]
+        assert [e["evals"] for e in events] == [512, 1024]
+        assert [e["bestCost"] for e in events] == [3.0, 2.5]
+
+
+class TestHistogramExemplars:
+    def test_worst_per_bucket_remembered(self):
+        reg = Registry()
+        h = reg.histogram("lat", "h", buckets=(1, 10))
+        h.observe(0.5, trace_id="t-small")
+        h.observe(0.9, trace_id="t-big")
+        h.observe(0.7, trace_id="t-mid")
+        h.observe(5.0, trace_id="t-other-bucket")
+        out = reg.render(openmetrics=True)
+        assert 'lat_bucket{le="1"} 3 # {trace_id="t-big"} 0.9' in out
+        assert 'lat_bucket{le="10"} 4 # {trace_id="t-other-bucket"} 5' in out
+        assert out.endswith("# EOF\n")
+
+    def test_classic_render_is_exemplar_free_and_preserves_them(self):
+        # exemplars are OpenMetrics-only: one in the classic 0.0.4
+        # output would fail the WHOLE scrape of a classic parser — and
+        # a classic scrape must not drain the window's exemplars either
+        reg = Registry()
+        h = reg.histogram("lat", "h", buckets=(1,))
+        h.observe(0.5, trace_id="t1")
+        classic = reg.render()
+        assert "trace_id" not in classic and "# EOF" not in classic
+        assert 'trace_id="t1"' in reg.render(openmetrics=True)
+
+    def test_openmetrics_render_drains_exemplars(self):
+        reg = Registry()
+        h = reg.histogram("lat", "h", buckets=(1,))
+        h.observe(0.5, trace_id="t1")
+        first = reg.render(openmetrics=True)
+        assert 'trace_id="t1"' in first
+        second = reg.render(openmetrics=True)
+        assert "trace_id" not in second  # since-last-scrape semantics
+        assert 'lat_bucket{le="1"} 1' in second  # counts persist
+
+    def test_openmetrics_family_naming(self):
+        reg = Registry()
+        reg.counter("req_total", "h").inc()
+        om = reg.render(openmetrics=True)
+        # the counter FAMILY drops _total; the sample keeps it
+        assert "# TYPE req counter" in om
+        assert "req_total 1" in om
+        classic = reg.render()
+        assert "# TYPE req_total counter" in classic
+
+    def test_no_trace_id_no_exemplar(self):
+        reg = Registry()
+        h = reg.histogram("lat", "h", buckets=(1,))
+        h.observe(0.5)
+        assert "trace_id" not in reg.render(openmetrics=True)
+
+    def test_labelled_children_carry_exemplars(self):
+        reg = Registry()
+        h = reg.histogram("lat", "h", labels=("algo",), buckets=(1,))
+        h.labels(algo="sa").observe(0.5, trace_id="abc")
+        out = reg.render(openmetrics=True)
+        assert 'lat_bucket{algo="sa",le="1"} 1 # {trace_id="abc"} 0.5' in out
+
+    def test_disabled_registry_records_nothing(self):
+        reg = Registry(enabled=False)
+        h = reg.histogram("lat", "h", buckets=(1,))
+        h.observe(0.5, trace_id="t1")
+        assert "trace_id" not in reg.render(openmetrics=True)

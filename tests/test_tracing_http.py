@@ -1,0 +1,543 @@
+"""End-to-end request tracing over real HTTP (ISSUE 5 acceptance).
+
+A request submitted with a W3C `traceparent` through the async jobs
+path returns a `stats.spans` waterfall covering >= 95% of the job's
+measured end-to-end wall time with distinct queue-wait / solve / store
+spans, and the same trace is retrievable from
+GET /api/debug/traces/{traceId}. Plus: traceparent echo on responses,
+malformed-header hardening over HTTP (never a 500), request/trace ids
+on EVERY error path (400, 404, 429, 503), span continuity across a
+worker crash + watchdog requeue, store-retry spans under an injected
+fault plan, and a Prometheus-text parse guard for /metrics with
+exemplars present.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import store
+import store.memory as mem
+from service import jobs as jobs_mod
+from service.app import serve
+from vrpms_tpu.obs import spans
+
+GOOD_TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+@pytest.fixture(scope="module")
+def server():
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    jobs_mod.shutdown_scheduler()
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    jobs_mod.shutdown_scheduler()
+
+
+@pytest.fixture(autouse=True)
+def seeded():
+    mem.reset()
+    spans.reset_ring()
+    rng = np.random.default_rng(7)
+    n = 7
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        "locs7", [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+    )
+    mem.seed_durations("locs7", d.tolist())
+    yield
+
+
+def post(base, path, body, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(), headers=hdrs,
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def get(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def solve_body(**over):
+    body = {
+        "problem": "vrp",
+        "algorithm": "sa",
+        "solutionName": "trace-test",
+        "solutionDescription": "t",
+        "locationsKey": "locs7",
+        "durationsKey": "locs7",
+        "capacities": [14, 14, 14],
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": 1,
+        "iterationCount": 1500,
+        "populationSize": 16,
+    }
+    body.update(over)
+    return body
+
+
+def poll_until_done(base, job_id, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, resp, _ = get(base, f"/api/jobs/{job_id}")
+        assert status == 200, resp
+        if resp["job"]["status"] in ("done", "failed"):
+            return resp["job"]
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never finished")
+
+
+def ring_detail(base, trace_id, timeout=5.0):
+    """The trace lands in the ring at the job's terminal transition —
+    allow the handful of milliseconds between poll and push."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, resp, _ = get(base, f"/api/debug/traces/{trace_id}")
+        if status == 200:
+            return resp["trace"]
+        time.sleep(0.02)
+    raise AssertionError(f"trace {trace_id} never reached the ring")
+
+
+class TestJobsPathWaterfall:
+    def test_traceparent_to_stats_spans_and_debug_ring(self, server):
+        status, resp, headers = post(
+            server, "/api/jobs",
+            solve_body(includeStats=True),
+            headers={"traceparent": GOOD_TP},
+        )
+        assert status == 202, resp
+        # the submitted trace id is adopted and echoed: envelope + header
+        assert resp["traceId"] == "ab" * 16
+        assert resp["requestId"]
+        echoed = headers["traceparent"]
+        tid, _ = spans.parse_traceparent(echoed)
+        assert tid == "ab" * 16
+
+        job = poll_until_done(server, resp["jobId"])
+        assert job["status"] == "done", job
+        assert job["traceId"] == "ab" * 16
+
+        stats = job["message"]["stats"]
+        assert stats["traceId"] == "ab" * 16
+        waterfall = stats["spans"]
+        names = [s["name"] for s in waterfall]
+        # distinct queue-wait / solve / store spans (acceptance)
+        assert "queue.wait" in names
+        assert "solve" in names
+        assert any(n.startswith("store.") for n in names)
+        by_name = {s["name"]: s for s in waterfall}
+        # >= 95% coverage of the measured end-to-end wall time: the job
+        # record's own clocks are the measurement; queue wait + solve
+        # are the spans that must account for it
+        e2e_ms = (job["finishedAt"] - job["submittedAt"]) * 1e3
+        covered = job["queueWaitMs"] + by_name["solve"]["durationMs"]
+        assert covered >= 0.95 * e2e_ms, (covered, e2e_ms, names)
+        # the solve span carries its scheduler context
+        attrs = by_name["solve"]["attributes"]
+        assert attrs["batchSize"] >= 1 and attrs["attempt"] == 1
+        # the remote header's span id parents the root
+        assert waterfall[0]["parentId"] == "cd" * 8
+
+        # the same trace, full tree, from the debug surface
+        detail = ring_detail(server, "ab" * 16)
+        detail_names = [s["name"] for s in detail["spans"]]
+        for required in ("queue.wait", "solve", "solver.solve", "prepare"):
+            assert required in detail_names, detail_names
+        assert detail["status"] == "ok"
+        # and the ring listing can filter it
+        status, resp, _ = get(server, "/api/debug/traces?minMs=1")
+        assert status == 200
+        assert any(t["traceId"] == "ab" * 16 for t in resp["traces"])
+        status, resp, _ = get(
+            server, "/api/debug/traces?minMs=10000000"
+        )
+        assert all(t["traceId"] != "ab" * 16 for t in resp["traces"])
+
+    def test_sync_endpoint_stats_spans(self, server):
+        status, resp, headers = post(
+            server, "/api/vrp/sa", solve_body(includeStats=True),
+        )
+        assert status == 200, resp
+        tid = resp["traceId"]
+        assert re.fullmatch(r"[0-9a-f]{32}", tid)
+        stats = resp["message"]["stats"]
+        names = [s["name"] for s in stats["spans"]]
+        assert "queue.wait" in names and "solve" in names
+        assert any(n.startswith("store.") for n in names)
+        # convergence telemetry joins the span tree as block events
+        solve_spans = [s for s in stats["spans"] if s["name"] == "solver.solve"]
+        assert solve_spans and any(
+            e["name"] == "block" for e in solve_spans[0].get("events", [])
+        )
+        # sync traces finish at respond time: already retrievable
+        detail = ring_detail(server, tid)
+        assert detail["traceId"] == tid
+
+    def test_block_events_absent_without_include_stats(self, server):
+        status, resp, _ = post(server, "/api/vrp/sa", solve_body())
+        assert status == 200, resp
+        detail = ring_detail(server, resp["traceId"])
+        solver = [s for s in detail["spans"] if s["name"] == "solver.solve"]
+        assert solver and not solver[0].get("events")
+
+
+class TestTraceparentEdgeCasesHTTP:
+    @pytest.mark.parametrize(
+        "header",
+        [
+            "garbage",
+            "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",
+            "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+            "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",
+            "00-" + "ab" * 2000 + "-" + "cd" * 8 + "-01",
+        ],
+    )
+    def test_malformed_header_gets_fresh_trace_never_500(
+        self, server, header
+    ):
+        status, resp, _ = post(
+            server, "/api/jobs", solve_body(iterationCount=200),
+            headers={"traceparent": header},
+        )
+        assert status == 202, resp  # hardening: never a 500
+        tid = resp["traceId"]
+        assert re.fullmatch(r"[0-9a-f]{32}", tid)
+        assert tid != "ab" * 16  # fresh, not adopted
+        assert poll_until_done(server, resp["jobId"])["status"] == "done"
+
+
+class TestErrorEnvelopesCarryIds:
+    def test_400_carries_ids(self, server):
+        status, resp, _ = post(
+            server, "/api/jobs", {"problem": "vrp"},
+            headers={"traceparent": GOOD_TP},
+        )
+        assert status == 400
+        assert resp["requestId"] and resp["traceId"] == "ab" * 16
+
+    def test_404_job_poll_carries_ids(self, server):
+        status, resp, _ = get(
+            server, "/api/jobs/no-such-job",
+            headers={"traceparent": GOOD_TP},
+        )
+        assert status == 404
+        assert resp["requestId"] and resp["traceId"] == "ab" * 16
+
+    def test_429_queue_full_carries_ids(self, server):
+        import os
+
+        jobs_mod.shutdown_scheduler()
+        os.environ["VRPMS_SCHED_QUEUE"] = "1"
+        try:
+            # blocker occupies the worker, next job fills the 1-slot
+            # queue, the one after must shed 429 WITH ids
+            status, resp, _ = post(
+                server, "/api/jobs",
+                solve_body(iterationCount=500_000, populationSize=64,
+                           timeLimit=3, seed=9),
+            )
+            assert status == 202, resp
+            time.sleep(0.3)
+            status, resp, _ = post(
+                server, "/api/jobs", solve_body(seed=10)
+            )
+            assert status == 202, resp
+            status, resp, headers = post(
+                server, "/api/jobs", solve_body(seed=11),
+                headers={"traceparent": GOOD_TP},
+            )
+            assert status == 429, resp
+            assert resp["requestId"] and resp["traceId"] == "ab" * 16
+            assert "Retry-After" in headers
+            # the sync endpoints shed with ids too
+            status, resp, _ = post(
+                server, "/api/vrp/sa", solve_body(seed=12),
+                headers={"traceparent": GOOD_TP},
+            )
+            assert status == 429, resp
+            assert resp["requestId"] and resp["traceId"] == "ab" * 16
+        finally:
+            os.environ.pop("VRPMS_SCHED_QUEUE", None)
+            jobs_mod.shutdown_scheduler()
+
+    def test_503_down_carries_ids(self, server):
+        # drain the scheduler: readiness reports down until a new
+        # submit lazily rebuilds it
+        jobs_mod.shutdown_scheduler()
+        try:
+            status, resp, _ = get(
+                server, "/api/ready", headers={"traceparent": GOOD_TP}
+            )
+            assert status == 503, resp
+            assert resp["status"] == "down"
+            assert resp["requestId"] and resp["traceId"] == "ab" * 16
+            # without a traceparent the 503 still carries the requestId
+            status, resp, _ = get(server, "/api/ready")
+            assert status == 503
+            assert resp["requestId"]
+        finally:
+            # next submit rebuilds a fresh scheduler for later tests
+            status, resp, _ = post(
+                server, "/api/jobs", solve_body(iterationCount=100)
+            )
+            assert status == 202, resp
+            poll_until_done(server, resp["jobId"])
+
+
+class TestCrashContinuity:
+    def test_requeued_job_parents_under_the_same_trace(
+        self, server, monkeypatch
+    ):
+        """A worker crash mid-solve + watchdog requeue: the second
+        attempt's spans land in the SAME trace — two queue.wait spans
+        (the retry marked requeued), a second solve span with
+        attempt=2, and the job.requeued lifecycle event on the root."""
+        import os
+
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.setitem(os.environ, "VRPMS_SCHED_WATCHDOG_MS", "30")
+        real = jobs_mod.solve_prepared
+        crashed = []
+
+        def crash_once(prep, errors):
+            if not crashed:
+                crashed.append(1)
+                raise SystemExit("induced worker death")  # thread dies
+            return real(prep, errors)
+
+        monkeypatch.setattr(jobs_mod, "solve_prepared", crash_once)
+        try:
+            status, resp, _ = post(
+                server, "/api/jobs", solve_body(seed=21),
+                headers={"traceparent": GOOD_TP},
+            )
+            assert status == 202, resp
+            job = poll_until_done(server, resp["jobId"])
+            assert job["status"] == "done", job
+            assert crashed  # the first attempt really died
+
+            detail = ring_detail(server, "ab" * 16)
+            names = [s["name"] for s in detail["spans"]]
+            waits = [s for s in detail["spans"] if s["name"] == "queue.wait"]
+            solves = [s for s in detail["spans"] if s["name"] == "solve"]
+            assert len(waits) == 2, names
+            assert waits[1]["attributes"].get("requeued") is True
+            assert len(solves) == 2, names
+            # attempt 1 died mid-span (no duration); attempt 2 finished
+            attempts = sorted(
+                s["attributes"]["attempt"] for s in solves
+            )
+            assert attempts == [1, 2]
+            done = [s for s in solves if s["attributes"]["attempt"] == 2]
+            assert done[0]["durationMs"] is not None
+            root = detail["spans"][0]
+            assert any(
+                e["name"] == "job.requeued" for e in root.get("events", [])
+            )
+        finally:
+            jobs_mod.shutdown_scheduler()
+
+
+class TestStoreFaultSpans:
+    def test_injected_read_faults_record_retry_events(self, monkeypatch):
+        """The resilient wrapper's spans carry the retry storm: a
+        fail-twice fault plan (vrpms_tpu.testing.faults) produces a
+        store span with two retry events and a success on attempt 3."""
+        from store.faulty import reset_faults
+        from store.resilient import reset_resilience
+
+        reset_faults()
+        reset_resilience()
+        monkeypatch.setenv("VRPMS_STORE", "faulty:fail=2;ops=reads")
+        monkeypatch.setenv("VRPMS_STORE_BACKOFF_S", "0.001")
+        trace = spans.Trace()
+        tokens = spans.activate(trace, trace.span("root"))
+        try:
+            db = store.get_database("vrp", None)
+            errors: list = []
+            db.get_locations_by_id("locs7", errors)
+            assert not errors
+        finally:
+            spans.deactivate(tokens)
+            reset_faults()
+            reset_resilience()
+        store_spans = [
+            s for s in trace.waterfall() if s["name"] == "store.resilient"
+        ]
+        assert store_spans, [s["name"] for s in trace.waterfall()]
+        sp = store_spans[0]
+        assert sp["attributes"]["op"] == "read"
+        assert sp["attributes"]["attempts"] == 3
+        retries = [
+            e for e in sp.get("events", []) if e["name"] == "store.retry"
+        ]
+        assert len(retries) == 2
+
+    def test_store_down_serves_degraded_with_fallback_span(
+        self, monkeypatch
+    ):
+        from store.faulty import reset_faults
+        from store.resilient import reset_resilience
+
+        reset_faults()
+        reset_resilience()
+        # warm the fallback cache while healthy, then go down
+        monkeypatch.setenv("VRPMS_STORE", "faulty:")
+        db = store.get_database("vrp", None)
+        errors: list = []
+        db.get_locations_by_id("locs7", errors)
+        assert not errors
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down;ops=reads")
+        monkeypatch.setenv("VRPMS_STORE_BACKOFF_S", "0.001")
+        trace = spans.Trace()
+        tokens = spans.activate(trace, trace.span("root"))
+        try:
+            db = store.get_database("vrp", None)
+            db.get_locations_by_id("locs7", errors)
+            assert not errors
+            assert db.degraded
+        finally:
+            spans.deactivate(tokens)
+            reset_faults()
+            reset_resilience()
+        sp = [
+            s for s in trace.waterfall() if s["name"] == "store.resilient"
+        ][0]
+        assert sp["attributes"]["fallback"] == "cache"
+        assert sp["attributes"]["degraded"] is True
+
+
+# the exposition line grammar: `name{labels} value` with an optional
+# OpenMetrics exemplar suffix `# {labels} value`; label values are
+# quoted strings that may themselves contain braces ("/api/jobs/{id}")
+_LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\}'
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"               # metric name
+    rf"({_LABELS})?"                           # optional labels
+    r" (-?[0-9.eE+]+|\+Inf|-Inf|NaN)"          # value
+    rf"( # {_LABELS} (-?[0-9.eE+]+|\+Inf))?$"  # optional exemplar
+)
+
+
+class TestMetricsParseGuard:
+    @staticmethod
+    def _parse(text, allow_exemplars):
+        seen_types: dict = {}
+        exemplars = 0
+        for line in text.splitlines():
+            if not line or line == "# EOF":
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                parts = line.split(" ", 3)
+                assert len(parts) >= 3, line
+                if parts[1] == "TYPE":
+                    assert parts[3] in (
+                        "counter", "gauge", "histogram", "untyped",
+                        "unknown",
+                    ), line
+                    seen_types[parts[2]] = parts[3]
+                continue
+            assert _METRIC_LINE.match(line), f"unparseable line: {line!r}"
+            if "# {" in line:
+                assert allow_exemplars, f"exemplar in classic text: {line!r}"
+                exemplars += 1
+                assert 'trace_id="' in line
+        return seen_types, exemplars
+
+    def test_negotiated_openmetrics_carries_exemplars(self, server):
+        # a traced solve guarantees at least one fresh exemplar
+        status, resp, _ = post(
+            server, "/api/vrp/sa", solve_body(iterationCount=200),
+            headers={"traceparent": GOOD_TP},
+        )
+        assert status == 200, resp
+        req = urllib.request.Request(
+            server + "/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            text = r.read().decode()
+            ctype = r.headers["Content-Type"]
+        assert ctype.startswith("application/openmetrics-text")
+        assert text.endswith("# EOF\n")  # the mandatory terminator
+        seen_types, exemplars = self._parse(text, allow_exemplars=True)
+        assert exemplars >= 1, "no exemplar found after a traced solve"
+        assert seen_types.get("vrpms_solve_seconds") == "histogram"
+        assert seen_types.get("vrpms_build_info") == "gauge"
+        assert seen_types.get("vrpms_trace_ring_size") == "gauge"
+        # OpenMetrics counter families drop the _total suffix
+        assert seen_types.get("vrpms_requests") == "counter"
+
+    def test_classic_scrape_stays_exemplar_free(self, server):
+        # classic 0.0.4 parsers reject exemplars — a plain scrape must
+        # never see one, even right after a traced solve recorded some
+        status, resp, _ = post(
+            server, "/api/vrp/sa", solve_body(iterationCount=200),
+            headers={"traceparent": GOOD_TP},
+        )
+        assert status == 200, resp
+        with urllib.request.urlopen(server + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+            ctype = r.headers["Content-Type"]
+        assert ctype.startswith("text/plain; version=0.0.4")
+        assert "# EOF" not in text
+        seen_types, exemplars = self._parse(text, allow_exemplars=False)
+        assert exemplars == 0
+        assert seen_types.get("vrpms_requests_total") == "counter"
+        # and the classic scrape did NOT drain the pending exemplars:
+        # the next OpenMetrics scrape still gets them
+        req = urllib.request.Request(
+            server + "/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            om = r.read().decode()
+        assert "# {" in om
+
+    def test_build_info_and_ring_gauges(self, server):
+        status, resp, _ = post(
+            server, "/api/vrp/sa", solve_body(iterationCount=200)
+        )
+        assert status == 200, resp
+        with urllib.request.urlopen(server + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        (info_line,) = [
+            ln for ln in text.splitlines()
+            if ln.startswith("vrpms_build_info{")
+        ]
+        assert 'version="' in info_line and 'jaxVersion="' in info_line
+        assert 'platform="' in info_line
+        (ring_line,) = [
+            ln for ln in text.splitlines()
+            if ln.startswith("vrpms_trace_ring_size ")
+        ]
+        assert float(ring_line.split()[-1]) >= 1

@@ -1,21 +1,27 @@
 """Stdlib-only observability spine: metrics, structured logs, traces.
 
-Three deliberately independent pieces (SURVEY.md §5 "failure detection"
+Four deliberately independent pieces (SURVEY.md §5 "failure detection"
 made first-class):
 
   registry — thread-safe Counter/Gauge/Histogram instruments plus
-             Prometheus text exposition (the service's GET /metrics);
+             Prometheus text exposition (the service's GET /metrics),
+             with per-bucket trace-id exemplars;
   logging  — one-JSON-object-per-line event logger with a request-id
              contextvar so every log line of a request correlates;
   trace    — a contextvar block-trace collector the solver deadline
              loops report (wall-clock, best-cost, evals) into with zero
-             jit-graph changes.
+             jit-graph changes;
+  spans    — Dapper-style per-request span tracing: W3C traceparent
+             in/out, explicit context propagation across the
+             scheduler's thread hops, a bounded ring of completed
+             traces, and slow-trace auto-capture.
 
 Nothing here imports jax or the solver stack: the service layer owns
 the concrete instruments (service.obs) and the solvers only ever call
 `active_trace()` — absent a collector, that is one ContextVar read.
 """
 
+from vrpms_tpu.obs import spans
 from vrpms_tpu.obs.logging import (
     current_request_id,
     log_event,
@@ -47,4 +53,5 @@ __all__ = [
     "reset_request_id",
     "set_log_stream",
     "set_request_id",
+    "spans",
 ]

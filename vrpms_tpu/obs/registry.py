@@ -11,6 +11,19 @@ TYPE comment lines, `name{label="value"} value` samples, histograms as
 cumulative `_bucket{le=...}` series plus `_sum`/`_count`. Rendering
 takes a point-in-time snapshot under the per-child locks, so a scrape
 concurrent with a solve never sees a half-updated histogram.
+
+Histograms additionally carry **exemplars** (OpenMetrics syntax,
+`... # {trace_id="..."} value`): `observe(v, trace_id=...)` remembers
+the WORST observation landing in each bucket since the last scrape, so
+a dashboard's p99 spike links straight to the trace that caused it
+(GET /api/debug/traces/{traceId}). Exemplars are only legal in the
+OpenMetrics exposition — a classic text-format parser errors on the
+`#` where it expects an optional timestamp and the WHOLE scrape fails
+— so `render(openmetrics=True)` emits them (with OpenMetrics family
+naming: counters' `_total` suffix stripped from HELP/TYPE, `untyped`
+-> `unknown`) and drains them, while the default classic render leaves
+them untouched for the next OpenMetrics scrape. The service's
+/metrics negotiates via the Accept header (service.obs).
 """
 
 from __future__ import annotations
@@ -89,14 +102,21 @@ class _Instrument:
             items = list(self._children.items())
         return items
 
-    def render(self) -> list:
+    def render(self, openmetrics: bool = False) -> list:
+        family, kind = self.name, self.kind
+        if openmetrics:
+            # OpenMetrics names the counter FAMILY without the _total
+            # suffix (samples keep it) and calls untyped "unknown"
+            if kind == "counter" and family.endswith("_total"):
+                family = family[: -len("_total")]
+            kind = "unknown" if kind == "untyped" else kind
         lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} {self.kind}",
+            f"# HELP {family} {self.help}",
+            f"# TYPE {family} {kind}",
         ]
         for key, child in self._snapshot():
             labels = dict(zip(self.label_names, key))
-            lines.extend(child.render(self.name, labels))
+            lines.extend(child.render(self.name, labels, openmetrics))
         return lines
 
 
@@ -121,7 +141,8 @@ class _CounterChild:
         with self._lock:
             return self._value
 
-    def render(self, name: str, labels: dict) -> list:
+    def render(self, name: str, labels: dict,
+               openmetrics: bool = False) -> list:
         return [_sample(name, labels, self.value)]
 
 
@@ -167,7 +188,8 @@ class _GaugeChild:
         with self._lock:
             return self._value
 
-    def render(self, name: str, labels: dict) -> list:
+    def render(self, name: str, labels: dict,
+               openmetrics: bool = False) -> list:
         return [_sample(name, labels, self.value)]
 
 
@@ -192,7 +214,8 @@ class Gauge(_Instrument):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count", "_enabled")
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count",
+                 "_enabled", "_exemplars")
 
     def __init__(self, buckets: tuple, enabled_ref):
         self._lock = threading.Lock()
@@ -201,8 +224,11 @@ class _HistogramChild:
         self._sum = 0.0
         self._count = 0
         self._enabled = enabled_ref
+        # per-bucket (trace_id, value): the worst observation that
+        # landed in the bucket since the last render (scrape) drained it
+        self._exemplars: dict = {}
 
-    def observe(self, value: float):
+    def observe(self, value: float, trace_id: str | None = None):
         if not self._enabled():
             return
         value = float(value)
@@ -212,19 +238,39 @@ class _HistogramChild:
             for i, ub in enumerate(self._buckets):
                 if value <= ub:
                     self._counts[i] += 1
+                    if trace_id is not None:
+                        worst = self._exemplars.get(i)
+                        if worst is None or value > worst[1]:
+                            self._exemplars[i] = (trace_id, value)
                     break
 
-    def render(self, name: str, labels: dict) -> list:
+    def render(self, name: str, labels: dict,
+               openmetrics: bool = False) -> list:
         with self._lock:
             counts = list(self._counts)
             total, s = self._count, self._sum
+            if openmetrics:
+                # drained only when actually emitted: a classic scrape
+                # must not silently discard the window's exemplars
+                exemplars, self._exemplars = self._exemplars, {}
+            else:
+                exemplars = {}
         lines = []
         cum = 0
-        for ub, c in zip(self._buckets, counts):
+        for i, (ub, c) in enumerate(zip(self._buckets, counts)):
             cum += c
             le = dict(labels)
             le["le"] = _format_value(ub)
-            lines.append(_sample(f"{name}_bucket", le, cum))
+            line = _sample(f"{name}_bucket", le, cum)
+            ex = exemplars.get(i)
+            if ex is not None:
+                # OpenMetrics exemplar: the trace to pull up for this
+                # bucket's worst observation of the scrape window
+                line += (
+                    f' # {{trace_id="{_escape_label(ex[0])}"}} '
+                    f"{_format_value(ex[1])}"
+                )
+            lines.append(line)
         lines.append(_sample(f"{name}_sum", labels, s))
         lines.append(_sample(f"{name}_count", labels, total))
         return lines
@@ -246,8 +292,8 @@ class Histogram(_Instrument):
     def _make_child(self):
         return _HistogramChild(self.buckets, lambda: self._registry.enabled)
 
-    def observe(self, value: float):
-        self._default_child().observe(value)
+    def observe(self, value: float, trace_id: str | None = None):
+        self._default_child().observe(value, trace_id)
 
 
 class Registry:
@@ -280,10 +326,16 @@ class Registry:
                   buckets=_LATENCY_BUCKETS) -> Histogram:
         return self._register(Histogram(self, name, help, labels, buckets))
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """The exposition body. `openmetrics=True` emits exemplars
+        (draining them) with OpenMetrics family naming and the
+        mandatory `# EOF` terminator; the default classic text format
+        (0.0.4) is exemplar-free — classic parsers reject them."""
         with self._lock:
             instruments = list(self._instruments.values())
         lines = []
         for inst in instruments:
-            lines.extend(inst.render())
+            lines.extend(inst.render(openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"

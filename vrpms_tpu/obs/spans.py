@@ -1,0 +1,486 @@
+"""Dapper-style per-request span tracing (the model OpenTelemetry
+standardizes; Sigelman et al., 2010).
+
+Since the async scheduler (PR 2) a request's life crosses four threads
+— HTTP handler -> admission queue -> micro-batcher -> device worker ->
+store I/O — and aggregate histograms cannot say WHERE a slow request
+spent its time. This module records that: every request owns a Trace (a
+thread-safe per-trace span collector), code brackets its work in named
+Spans (trace_id / span_id / parent, start, duration, attributes,
+events), and context rides two ContextVars that the scheduler
+re-activates explicitly on the worker side of every thread hop (the
+Job carries its Trace + parent Span through queue.push/pop/
+take_matching — see vrpms_tpu.sched.queue.Job and service.jobs).
+
+Surfaces (wired by the service layer):
+  * W3C `traceparent` accepted on requests and emitted on responses;
+    `traceId` echoed in every envelope;
+  * `stats.spans` — the request's latency waterfall under includeStats;
+  * GET /api/debug/traces[/{traceId}] — a bounded in-memory ring of
+    recently completed traces;
+  * histogram exemplars (obs.registry) carry the worst trace id per
+    latency bucket;
+  * traces slower than VRPMS_TRACE_SLOW_MS log a `trace.slow` event
+    with the full waterfall — tail-latency evidence on disk before
+    anyone asks.
+
+Env knobs: VRPMS_TRACING (on|off, default on), VRPMS_TRACE_RING (ring
+capacity, default 128), VRPMS_TRACE_SLOW_MS (default 5000).
+
+Stdlib-only, like the rest of vrpms_tpu.obs: no jax, no service
+imports. With tracing off — or simply no active trace — `span()` is one
+ContextVar read.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import uuid
+
+from vrpms_tpu.obs.logging import log_event
+
+#: hard caps so a runaway request can never grow an unbounded trace
+MAX_SPANS_PER_TRACE = 256
+MAX_EVENTS_PER_SPAN = 64
+#: anything longer than a legal traceparent (55 chars) plus slack is
+#: rejected outright — never parsed, never echoed
+MAX_TRACEPARENT_LEN = 128
+
+_DEF_RING = 128
+_DEF_SLOW_MS = 5000.0
+
+
+def tracing_enabled() -> bool:
+    return os.environ.get("VRPMS_TRACING", "on").lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+def slow_threshold_ms() -> float:
+    try:
+        return float(os.environ.get("VRPMS_TRACE_SLOW_MS", _DEF_SLOW_MS))
+    except (TypeError, ValueError):
+        return _DEF_SLOW_MS
+
+
+def new_trace_id() -> str:
+    """32 lowercase hex chars (W3C trace-id width)."""
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    """16 lowercase hex chars (W3C parent-id width)."""
+    return uuid.uuid4().hex[:16]
+
+
+# ---------------------------------------------------------------------------
+# W3C traceparent
+# ---------------------------------------------------------------------------
+
+
+def _is_hex(s: str) -> bool:
+    return all(c in "0123456789abcdef" for c in s)
+
+
+def parse_traceparent(header) -> tuple[str | None, str | None]:
+    """(trace_id, parent_span_id) from a W3C traceparent header, or
+    (None, None) for anything malformed — a bad header means a FRESH
+    trace, never an error (the contract the edge cases test pins:
+    malformed version/ids, all-zero ids, oversized headers)."""
+    if not header or not isinstance(header, str):
+        return None, None
+    header = header.strip()
+    if len(header) > MAX_TRACEPARENT_LEN:
+        return None, None
+    parts = header.split("-")
+    if len(parts) < 4:
+        return None, None
+    version, trace_id, parent_id = parts[0], parts[1], parts[2]
+    if len(version) != 2 or not _is_hex(version) or version == "ff":
+        return None, None
+    if version == "00" and len(parts) != 4:
+        return None, None
+    if len(trace_id) != 32 or not _is_hex(trace_id):
+        return None, None
+    if len(parent_id) != 16 or not _is_hex(parent_id):
+        return None, None
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None, None
+    if len(parts[3]) != 2 or not _is_hex(parts[3]):
+        return None, None
+    return trace_id, parent_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """The header a response (or downstream call) should carry; sampled
+    flag always 01 — if we have a trace id at all, we recorded."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One named, timed operation inside a Trace.
+
+    Mutations (set/event/end) are cheap and lock the owning trace only
+    for event appends; a span may be annotated after `end` (the solve
+    path attaches compile attribution once the delta is known).
+    """
+
+    __slots__ = (
+        "name", "span_id", "parent_id", "start_mono", "start_ts",
+        "duration_ms", "status", "attributes", "events", "_trace",
+    )
+
+    def __init__(self, trace, name: str, parent_id: str | None,
+                 start_mono: float | None = None):
+        self._trace = trace
+        self.name = name
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.start_mono = (
+            time.monotonic() if start_mono is None else start_mono
+        )
+        self.start_ts = time.time()
+        self.duration_ms: float | None = None
+        self.status = "ok"
+        self.attributes: dict = {}
+        self.events: list = []
+
+    def set(self, **attrs) -> None:
+        """Attach attributes (None values dropped, like log_event)."""
+        self.attributes.update(
+            (k, v) for k, v in attrs.items() if v is not None
+        )
+
+    def event(self, name: str, **attrs) -> None:
+        """Append a point-in-time event; bounded per span."""
+        if len(self.events) >= MAX_EVENTS_PER_SPAN:
+            self._trace.truncated = True
+            return
+        ev = {
+            "name": name,
+            "offsetMs": round(
+                (time.monotonic() - self._trace.start_mono) * 1e3, 2
+            ),
+        }
+        ev.update((k, v) for k, v in attrs.items() if v is not None)
+        self.events.append(ev)
+
+    def end(self, status: str | None = None) -> None:
+        """First end wins (a requeued job's abandoned attempt may race
+        its own watchdog bookkeeping)."""
+        if self.duration_ms is None:
+            self.duration_ms = round(
+                (time.monotonic() - self.start_mono) * 1e3, 3
+            )
+        if status is not None:
+            self.status = status
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "startMs": round(
+                (self.start_mono - self._trace.start_mono) * 1e3, 3
+            ),
+            "durationMs": self.duration_ms,
+            "status": self.status,
+        }
+        if self.attributes:
+            d["attributes"] = dict(self.attributes)
+        if self.events:
+            d["events"] = list(self.events)
+        return d
+
+
+class Trace:
+    """Thread-safe per-trace span collector.
+
+    One per request; crosses threads by reference (the Job carries it),
+    so every append locks. `deferred` marks traces whose completion the
+    HTTP thread hands to the scheduler worker (async jobs: the 202 goes
+    out long before the solve ends)."""
+
+    def __init__(self, trace_id: str | None = None,
+                 remote_parent_id: str | None = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.remote_parent_id = remote_parent_id
+        self.start_mono = time.monotonic()
+        self.start_ts = time.time()
+        self.spans: list[Span] = []
+        self.truncated = False
+        self.status = "ok"
+        self.deferred = False
+        self._finished = False
+        self._lock = threading.Lock()
+
+    # -- span creation ------------------------------------------------------
+    def span(self, name: str, parent_id: str | None = None,
+             start_mono: float | None = None) -> Span:
+        """Create (and register) a span. Over the cap the span is still
+        returned — callers never branch — but not retained."""
+        if parent_id is None:
+            parent_id = self.remote_parent_id
+        s = Span(self, name, parent_id, start_mono=start_mono)
+        with self._lock:
+            if len(self.spans) < MAX_SPANS_PER_TRACE:
+                self.spans.append(s)
+            else:
+                self.truncated = True
+        return s
+
+    def span_at(self, name: str, parent_id: str | None,
+                start_mono: float, duration_s: float, **attrs) -> Span:
+        """Retroactive completed span — how the worker records the
+        queue wait it can only measure once the job pops."""
+        s = self.span(name, parent_id=parent_id, start_mono=start_mono)
+        s.duration_ms = round(max(duration_s, 0.0) * 1e3, 3)
+        if attrs:
+            s.set(**attrs)
+        return s
+
+    def root(self) -> Span | None:
+        with self._lock:
+            return self.spans[0] if self.spans else None
+
+    # -- completion ---------------------------------------------------------
+    def duration_ms(self) -> float:
+        """Trace start to the latest span end seen (open spans count up
+        to 'now' — a finished trace has none on the request path)."""
+        end = 0.0
+        with self._lock:
+            spans = list(self.spans)
+        for s in spans:
+            off = (s.start_mono - self.start_mono) * 1e3
+            end = max(
+                end,
+                off + (
+                    s.duration_ms
+                    if s.duration_ms is not None
+                    else (time.monotonic() - s.start_mono) * 1e3
+                ),
+            )
+        return round(end, 3)
+
+    def finish(self, status: str | None = None) -> None:
+        """Idempotent terminal step: push to the completed-trace ring,
+        and log the full waterfall if the trace breached the slow bar
+        (VRPMS_TRACE_SLOW_MS)."""
+        with self._lock:
+            if self._finished:
+                return
+            self._finished = True
+        if status is not None:
+            self.status = status
+        dur = self.duration_ms()
+        _ring_push(self)
+        if dur >= slow_threshold_ms():
+            log_event(
+                "trace.slow",
+                traceId=self.trace_id,
+                durationMs=dur,
+                status=self.status,
+                spans=self.waterfall(),
+            )
+
+    @property
+    def finished(self) -> bool:
+        with self._lock:
+            return self._finished
+
+    # -- export -------------------------------------------------------------
+    def waterfall(self) -> list[dict]:
+        """The latency waterfall: spans as dicts, by start offset."""
+        with self._lock:
+            spans = list(self.spans)
+        return [s.to_dict() for s in sorted(spans, key=lambda s: s.start_mono)]
+
+    def to_dict(self) -> dict:
+        return {
+            "traceId": self.trace_id,
+            "startedAt": self.start_ts,
+            "durationMs": self.duration_ms(),
+            "status": self.status,
+            "truncated": self.truncated,
+            "remoteParent": self.remote_parent_id,
+            "spans": self.waterfall(),
+        }
+
+    def summary(self) -> dict:
+        root = self.root()
+        return {
+            "traceId": self.trace_id,
+            "startedAt": self.start_ts,
+            "durationMs": self.duration_ms(),
+            "status": self.status,
+            "root": root.name if root is not None else None,
+            "spans": len(self.spans),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Context propagation
+# ---------------------------------------------------------------------------
+
+_trace_var: contextvars.ContextVar = contextvars.ContextVar(
+    "vrpms_trace", default=None
+)
+_span_var: contextvars.ContextVar = contextvars.ContextVar(
+    "vrpms_span", default=None
+)
+
+
+def current_trace() -> Trace | None:
+    return _trace_var.get()
+
+
+def current_span() -> Span | None:
+    return _span_var.get()
+
+
+def current_trace_id() -> str | None:
+    """The active trace's id — the histogram-exemplar source (one
+    ContextVar read; None with no trace active)."""
+    t = _trace_var.get()
+    return None if t is None else t.trace_id
+
+
+def start_trace(traceparent: str | None = None) -> Trace | None:
+    """Begin a trace for one request. Adopts the incoming W3C context
+    when valid (same trace_id, spans parent under the remote span);
+    anything malformed starts fresh. None when tracing is off."""
+    if not tracing_enabled():
+        return None
+    trace_id, parent_id = parse_traceparent(traceparent)
+    return Trace(trace_id=trace_id, remote_parent_id=parent_id)
+
+
+def activate(trace: Trace | None, span: Span | None = None):
+    """Bind (trace, span) to the current context — the worker-side hop:
+    the runner re-activates each job's carried context before touching
+    solver code. Returns an opaque token pair for `deactivate`."""
+    return (_trace_var.set(trace), _span_var.set(span))
+
+
+def deactivate(tokens) -> None:
+    t_tok, s_tok = tokens
+    _trace_var.reset(t_tok)
+    _span_var.reset(s_tok)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Bracket the enclosed work in a child span of the current context.
+
+    No active trace -> yields None at the cost of one ContextVar read
+    (the always-on hot-path contract, same as active_trace()). An
+    escaping exception marks the span status=error (and re-raises)."""
+    trace = _trace_var.get()
+    if trace is None:
+        yield None
+        return
+    parent = _span_var.get()
+    s = trace.span(
+        name, parent_id=parent.span_id if parent is not None else None
+    )
+    if attrs:
+        s.set(**attrs)
+    token = _span_var.set(s)
+    try:
+        yield s
+    except BaseException as e:
+        s.set(error=f"{type(e).__name__}: {e}")
+        s.end(status="error")
+        raise
+    finally:
+        _span_var.reset(token)
+        s.end()
+
+
+def add_event(name: str, **attrs) -> None:
+    """Attach an event to the current span, if any (the BlockTrace
+    cadence feeds per-block solver events through this)."""
+    s = _span_var.get()
+    if s is not None:
+        s.event(name, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# Completed-trace ring
+# ---------------------------------------------------------------------------
+
+def _ring_capacity_env() -> int:
+    """VRPMS_TRACE_RING, defaulting (not crashing) on junk — a typo'd
+    knob must degrade to the default, same as slow_threshold_ms."""
+    try:
+        return max(1, int(os.environ.get("VRPMS_TRACE_RING", _DEF_RING)))
+    except (TypeError, ValueError):
+        return _DEF_RING
+
+
+_ring_lock = threading.Lock()
+_ring: collections.deque = collections.deque(maxlen=_ring_capacity_env())
+
+
+def _ring_push(trace: Trace) -> None:
+    if not trace.spans:
+        return  # an empty trace carries no evidence
+    with _ring_lock:
+        _ring.append(trace)
+
+
+def ring_size() -> int:
+    with _ring_lock:
+        return len(_ring)
+
+
+def ring_capacity() -> int:
+    with _ring_lock:
+        return _ring.maxlen or 0
+
+
+def ring_get(trace_id: str) -> Trace | None:
+    with _ring_lock:
+        for t in reversed(_ring):
+            if t.trace_id == trace_id:
+                return t
+    return None
+
+
+def ring_snapshot(min_duration_ms: float = 0.0, status: str | None = None,
+                  limit: int = 50) -> list[dict]:
+    """Newest-first summaries of recently completed traces, filterable
+    by minimum duration and status (the /api/debug/traces contract)."""
+    with _ring_lock:
+        traces = list(_ring)
+    out = []
+    for t in reversed(traces):
+        if status is not None and t.status != status:
+            continue
+        if t.duration_ms() < min_duration_ms:
+            continue
+        out.append(t.summary())
+        if len(out) >= max(1, limit):
+            break
+    return out
+
+
+def reset_ring(capacity: int | None = None) -> None:
+    """Drop every retained trace (tests; ops escape hatch). `capacity`
+    re-sizes the ring — otherwise VRPMS_TRACE_RING is re-read so tests
+    that tweak the env see it applied."""
+    global _ring
+    if capacity is None:
+        capacity = _ring_capacity_env()
+    with _ring_lock:
+        _ring = collections.deque(maxlen=max(1, capacity))

@@ -26,6 +26,8 @@ import contextlib
 import contextvars
 import time
 
+from vrpms_tpu.obs import spans
+
 MAX_TRACE_BLOCKS = 512  # a runaway many-block solve must not grow an
                         # unbounded response; the summary still counts
                         # every block via `evals`
@@ -61,13 +63,16 @@ class BlockTrace:
             # mesh's globally-sharded best array isn't fully addressable
             # from this host — skip the entry, keep the eval accounting
             return
-        self.blocks.append(
-            {
-                "wallMs": round((time.perf_counter() - self._t0) * 1e3, 2),
-                "bestCost": best_cost,
-                "evals": int(self._evals),
-            }
-        )
+        entry = {
+            "wallMs": round((time.perf_counter() - self._t0) * 1e3, 2),
+            "bestCost": best_cost,
+            "evals": int(self._evals),
+        }
+        self.blocks.append(entry)
+        # feed the same cadence into the request's span tree (no-op
+        # without an active span — one ContextVar read): the waterfall
+        # shows per-block solver progress inside the solve span
+        spans.add_event("block", **entry)
 
 
 _active: contextvars.ContextVar = contextvars.ContextVar(

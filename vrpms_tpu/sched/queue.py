@@ -63,6 +63,18 @@ class Job:
     bucket: typing.Hashable = None
     time_limit: float | None = None
     request_id: str | None = None
+    # distributed-trace context: the submitting thread's Trace collector
+    # and the Span worker-side spans should parent under. Opaque to this
+    # package (vrpms_tpu.obs.spans objects in practice) — they simply
+    # ride the Job through push/pop/take_matching/restore so the runner
+    # can re-activate them on the far side of every thread hop,
+    # including the watchdog's requeue (the retry keeps the same trace).
+    trace: typing.Any = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    span: typing.Any = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
     # supervision: True once the watchdog re-admitted this job after a
     # worker crash — the SECOND crash fails it instead (at-most-one
     # requeue keeps a poison job from crash-looping the worker forever)
