@@ -1,0 +1,207 @@
+"""Solution-cache benchmark: a repeat-heavy trace, cache on vs. off.
+
+Million-user traffic repeats: the same city/depot/customer set arrives
+again and again. This bench replays an 80%-repeat trace (K distinct
+requests, each repeated until repeats are 80% of the trace) against the
+in-process service twice — `VRPMS_CACHE=off` (every request pays a full
+metaheuristic solve, the pre-ISSUE-6 behavior) and cache on (repeats
+are exact hits served at store-read latency, bypassing the admission
+queue and the solver).
+
+Reported: p50/p99 per phase, hit-only p50/p99, `solvesAvoided` (exact
+hits that cost a store read instead of a solve), and the headline
+ratio cache-off p50 / hit p50 — gated >= 5x (ISSUE 6 acceptance).
+
+    JAX_PLATFORMS=cpu python -m benchmarks.cache_hit \
+        [--distinct 5] [--repeat-pct 80] [--n 8] [--iters 300] \
+        [--out records/cache_hit_r11.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import threading
+import time
+import urllib.error
+import urllib.request
+
+GATE_HIT_P50_SPEEDUP = 5.0
+
+
+def _post(base: str, path: str, body: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _seed_store(n: int) -> None:
+    import numpy as np
+
+    import store.memory as mem
+
+    mem.reset()
+    rng = np.random.default_rng(31)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        "cachebench", [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+    )
+    mem.seed_durations("cachebench", d.tolist())
+
+
+def _body(n: int, iters: int, seed: int) -> dict:
+    return {
+        "solutionName": "cache-bench",
+        "solutionDescription": "cache_hit",
+        "locationsKey": "cachebench",
+        "durationsKey": "cachebench",
+        "capacities": [3 * n] * 3,
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": seed,
+        "iterationCount": iters,
+        "populationSize": 8,
+    }
+
+
+def _trace(distinct: int, repeat_pct: float, rng) -> list[int]:
+    """Seed indices for the request trace: each of the `distinct`
+    requests appears once cold, then repeats fill the trace until
+    repeats/total reaches `repeat_pct` — shuffled deterministically."""
+    repeats_per = max(1, round(repeat_pct / (100.0 - repeat_pct)))
+    trace = list(range(distinct)) * (1 + repeats_per)
+    rng.shuffle(trace)
+    return trace
+
+
+def _pct(sorted_ms: list[float], p: float):
+    if not sorted_ms:
+        return None
+    k = min(len(sorted_ms) - 1, int(round(p / 100 * (len(sorted_ms) - 1))))
+    return round(sorted_ms[k], 2)
+
+
+def run_phase(base, trace, n, iters) -> dict:
+    lat_all: list[float] = []
+    lat_hit: list[float] = []
+    lat_solve: list[float] = []
+    for seed_idx in trace:
+        t0 = time.perf_counter()
+        status, resp = _post(base, "/api/vrp/sa", _body(n, iters, seed_idx + 1))
+        dt_ms = 1e3 * (time.perf_counter() - t0)
+        assert status == 200, resp
+        lat_all.append(dt_ms)
+        if resp["message"].get("cacheHit"):
+            lat_hit.append(dt_ms)
+        else:
+            lat_solve.append(dt_ms)
+    lat_all.sort(), lat_hit.sort(), lat_solve.sort()
+    return {
+        "requests": len(lat_all),
+        "p50Ms": _pct(lat_all, 50),
+        "p99Ms": _pct(lat_all, 99),
+        "meanMs": round(statistics.mean(lat_all), 2),
+        "hits": len(lat_hit),
+        "hitP50Ms": _pct(lat_hit, 50),
+        "hitP99Ms": _pct(lat_hit, 99),
+        "solves": len(lat_solve),
+        "solveP50Ms": _pct(lat_solve, 50),
+    }
+
+
+def main() -> None:
+    import numpy as np
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--distinct", type=int, default=5,
+                    help="distinct requests in the trace")
+    ap.add_argument("--repeat-pct", type=float, default=80.0)
+    ap.add_argument("--n", type=int, default=8, help="locations per instance")
+    ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--out", default=None, help="record JSON path")
+    ap.add_argument("--note", default=None)
+    args = ap.parse_args()
+
+    os.environ["VRPMS_STORE"] = "memory"
+    _seed_store(args.n)
+    trace = _trace(args.distinct, args.repeat_pct, np.random.default_rng(17))
+    repeats = len(trace) - args.distinct
+
+    from service import jobs as jobs_mod
+    from service import obs
+    from service.app import serve
+    import store.memory as mem
+
+    srv = serve(port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    # one throwaway solve warms the tier's compiled program so neither
+    # phase pays XLA compiles inside the measurement
+    os.environ["VRPMS_CACHE"] = "off"
+    _post(base, "/api/vrp/sa", _body(args.n, args.iters, 999))
+
+    import jax
+
+    record = {
+        "benchmark": "cache_hit",
+        "backend": jax.default_backend(),
+        "locations": args.n,
+        "iterationCount": args.iters,
+        "distinctRequests": args.distinct,
+        "traceLength": len(trace),
+        "repeatPct": round(100.0 * repeats / len(trace), 1),
+        "note": args.note,
+    }
+
+    print(f"== cache off: {len(trace)} requests, every one solves")
+    record["cache_off"] = run_phase(base, trace, args.n, args.iters)
+    print(json.dumps(record["cache_off"], indent=2))
+
+    os.environ.pop("VRPMS_CACHE", None)
+    mem._tables["solution_cache"].clear()
+    avoided0 = obs.CACHE_SOLVES_AVOIDED.value
+    print(f"== cache on: same trace, repeats should hit")
+    record["cache_on"] = run_phase(base, trace, args.n, args.iters)
+    record["cache_on"]["solvesAvoided"] = int(
+        obs.CACHE_SOLVES_AVOIDED.value - avoided0
+    )
+    print(json.dumps(record["cache_on"], indent=2))
+
+    off_p50 = record["cache_off"]["p50Ms"]
+    hit_p50 = record["cache_on"]["hitP50Ms"]
+    speedup = round(off_p50 / hit_p50, 1) if hit_p50 else None
+    record["hitP50SpeedupX"] = speedup
+    record["gate"] = {
+        "requiredHitP50SpeedupX": GATE_HIT_P50_SPEEDUP,
+        "passed": bool(speedup and speedup >= GATE_HIT_P50_SPEEDUP),
+    }
+    print(json.dumps({"hitP50SpeedupX": speedup, "gate": record["gate"]},
+                     indent=2))
+
+    jobs_mod.shutdown_scheduler()
+    srv.shutdown()
+    if args.out:
+        out = args.out if os.path.isabs(args.out) else os.path.join(
+            os.path.dirname(__file__), args.out
+        )
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        print(f"record -> {out}")
+
+
+if __name__ == "__main__":
+    main()

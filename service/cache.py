@@ -1,0 +1,527 @@
+"""Content-addressed solution cache: exact-hit serving + near-hit seeding.
+
+Million-user traffic repeats — same city, same depot, overlapping
+customer sets — yet every repeat used to pay a full metaheuristic
+solve. This module turns the pieces the service already has (tier
+padding canonicalizes instance shape, the warm-start machinery seeds
+solvers from a prior tour, the store seam persists documents) into a
+cache keyed on CONTENT, not on request names:
+
+  * **fingerprint** — `vrpms_tpu.core.tiers.fingerprint(inst)`: a
+    SHA-256 of the padded tier tensors. Equal instances hash equal no
+    matter how the request spelled them.
+  * **exact key** — fingerprint + problem + algorithm + every
+    result-relevant option (seed, budgets, weights, polish knobs) +
+    the original-id mapping + the auth scope. An exact hit serves the
+    cached routes/cost/certificate at store-read latency, bypassing
+    the admission queue and the solver entirely (`cacheHit: true`).
+  * **family key** — dataset content (full matrix + locations) + fleet
+    config + problem + auth scope, WITHOUT the customer subset or
+    solver options. One keyed read returns every cached solution over
+    the same data, so near hits (small Hamming distance on the
+    customer set) and legacy `warmStart` retrieval are the same
+    indexed lookup — one warm-start code path, not two.
+
+A near hit repairs the cached giant tour via the separator encoding
+(strip dropped customers, greedy-insert new ones at their cheapest
+position) and seeds the solver through the existing warm-start
+machinery instead of NN construction. For implicit near hits the seed
+application is DEFERRED to solo dispatch (solve_prepared): a job that
+would merge into a vmapped micro-batch keeps its batch — the batched
+launch has no per-job init, and trading a K-way launch for K seeded
+solo solves would undo PR 2.
+
+Everything is best-effort behind the `store.base` seam, wrapped by
+ResilientDatabase for network backends: a cache outage degrades to
+solving (the lookup fails fast under the shared breaker), never to
+failing. `VRPMS_CACHE=off` disables the whole module — responses are
+then byte-identical to the pre-cache service. `VRPMS_CACHE_NEAR` caps
+the Hamming distance an implicit near hit may bridge (default 4;
+0 disables near seeding; explicit `warmStart` requests accept the
+closest family entry at any distance, like the legacy checkpoint did).
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from service import obs
+from store.base import cache_enabled
+from vrpms_tpu.core import tiers
+from vrpms_tpu.obs import log_event, spans
+
+#: request options that parameterize the solver program or its result —
+#: the exact-hit key must cover everything that can change the response
+#: bytes (includeStats/profile are deliberately absent: they only add
+#: volatile telemetry, which is stripped from stored entries, so a
+#: stats-requesting solve can still warm the cache for plain requests)
+_KEY_OPTS = (
+    "backend", "seed", "iteration_count", "population_size", "time_limit",
+    "makespan_weight", "local_search", "local_search_pool", "ils_rounds",
+    "ils_reseed", "islands", "migrate_every", "migrants", "warm_start",
+)
+
+#: stored-entry keys stripped before serving comparisons / persistence
+_VOLATILE_KEYS = ("stats", "degraded", "cacheHit")
+
+
+def near_limit() -> int:
+    """Max Hamming distance (|A symmetric-difference B| over customer-id
+    sets) an implicit near hit may bridge; 0 disables near seeding."""
+    try:
+        return max(0, int(os.environ.get("VRPMS_CACHE_NEAR", "4")))
+    except (TypeError, ValueError):
+        return 4
+
+
+def _warm_supported(prep) -> bool:
+    """Which (problem, algorithm, opts) combinations consume a warm
+    seed — the ONE predicate both the legacy warmStart option and
+    near-hit seeding obey (mirrors the historical per-problem rules:
+    bf is exact and has no seed hook; TSP islands only wire an initial
+    incumbent for ACO)."""
+    if prep.problem == "vrp":
+        return prep.algorithm != "bf"
+    return prep.algorithm == "aco" or (
+        prep.algorithm in ("sa", "ga") and not prep.opts.get("islands")
+    )
+
+
+# ---------------------------------------------------------------------------
+# Keys
+# ---------------------------------------------------------------------------
+
+
+def _family_key(prep, locations, matrix) -> str:
+    """Hash of everything that survives a customer-subset change: the
+    FULL dataset content, the fleet/start config, the problem kind, and
+    the auth scope (tenants must never share entries — the raw token is
+    scoped like PR 3's degraded cache keys)."""
+    h = hashlib.sha256()
+    h.update(b"family:v1:")
+    h.update(repr(prep.params.get("auth") or "").encode())
+    h.update(prep.problem.encode())
+    arr = np.asarray(matrix, dtype=np.float64)
+    h.update(repr(arr.shape).encode())
+    h.update(arr.tobytes())
+    h.update(json.dumps(locations, sort_keys=True, default=str).encode())
+    if prep.problem == "vrp":
+        cfg = {
+            "capacities": prep.params.get("capacities"),
+            "startTimes": prep.params.get("start_times"),
+        }
+    else:
+        cfg = {
+            "startNode": prep.params.get("start_node"),
+            "startTime": prep.params.get("start_time"),
+        }
+    cfg["timeSliceDuration"] = prep.opts.get("time_slice_duration")
+    h.update(json.dumps(cfg, sort_keys=True, default=str).encode())
+    return h.hexdigest()
+
+
+def _ensure_family(prep) -> str:
+    """Compute (once) and return the request's family key; the dataset
+    refs ride prep.cache until first use."""
+    cache = prep.cache
+    if "family" not in cache:
+        locations, matrix = cache.pop("_family_args")
+        cache["family"] = _family_key(prep, locations, matrix)
+    return cache["family"]
+
+
+def _request_key(prep, fingerprint: str) -> str:
+    """The exact-hit key. The instance fingerprint covers the padded
+    tensor content; the original-id list and anchor must join it
+    because two different subsets of duplicate locations can produce
+    identical tensors while their responses (tours of ORIGINAL ids)
+    differ."""
+    opts = {
+        k: prep.opts.get(k) for k in _KEY_OPTS
+        if prep.opts.get(k) is not None
+    }
+    ga = {
+        k: v for k, v in sorted((prep.ga_params or {}).items())
+        if v is not None
+    }
+    # ids ride the payload as-is: json keeps 3 and "3" distinct (and
+    # default=str covers exotic id types), while coercing with int()
+    # would both collide those spellings and 400 requests whose stored
+    # datasets use non-numeric ids the pre-cache service accepted
+    payload = {
+        "v": 1,
+        "problem": prep.problem,
+        "algorithm": prep.algorithm,
+        "auth": prep.params.get("auth") or "",
+        "fingerprint": fingerprint,
+        "ids": list(prep.orig_ids),
+        "anchor": prep.anchor_id,
+        "opts": opts,
+        "ga": ga,
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Near-hit repair: cached giant tour -> warm permutation for THIS request
+# ---------------------------------------------------------------------------
+
+
+def strip_order(routes, active_ids: list) -> tuple[list, set]:
+    """The shared strip step of every cached-tour repair: surviving
+    customers of `routes` (ORIGINAL location ids) as positions in the
+    CURRENT active indexing, relative visit order preserved; also the
+    set of positions covered. Used by both the legacy checkpoint
+    re-seed (service.solve._warm_perm) and near-hit repair."""
+    index_of = {cid: i for i, cid in enumerate(active_ids)}
+    seen: set = set()
+    order: list = []
+    for route in routes:
+        for cid in route:
+            pos = index_of.get(cid)
+            if pos is not None and pos > 0 and pos not in seen:
+                order.append(pos)
+                seen.add(pos)
+    return order, seen
+
+
+def _repair_perm(prep, routes):
+    """Strip-and-insert repair over the separator encoding.
+
+    `routes` hold ORIGINAL location ids from the cached solution.
+    Surviving customers keep their relative visit order (strip = drop
+    ids not in the current active set); new customers are greedy-
+    inserted at the cheapest position by slice-0 durations from the
+    prepared instance (active indexing — the padded tensor's real
+    prefix). Returns an int32 permutation of the active positions
+    1..n-1, the exact shape the warm-start machinery consumes, or None
+    when nothing survives to seed from.
+    """
+    order, seen = strip_order(routes, prep.orig_ids)
+    new = [i for i in range(1, len(prep.orig_ids)) if i not in seen]
+    if not order:
+        # nothing survived: appending alone would be an arbitrary-order
+        # seed, no better than construction — decline to seed
+        return None
+    if new:
+        d = np.asarray(prep.inst.durations)[0]
+        seq = [0] + order + [0]
+        for c in new:
+            best_delta, best_at = None, 1
+            for k in range(1, len(seq)):
+                a, b = seq[k - 1], seq[k]
+                delta = float(d[a, c] + d[c, b] - d[a, b])
+                if best_delta is None or delta < best_delta:
+                    best_delta, best_at = delta, k
+            seq.insert(best_at, c)
+        order = seq[1:-1]
+    return jnp.asarray(order, dtype=jnp.int32)
+
+
+def _pick_seed(prep, rows, explicit: bool):
+    """Key of the best family entry to seed from: same problem,
+    overlapping customer set, ranked by (Hamming distance, cost).
+    Implicit near hits respect the VRPMS_CACHE_NEAR distance cap; an
+    explicit warmStart request takes the closest entry at any distance
+    (the legacy checkpoint semantics). Rows may carry the ranking
+    fields nested under 'entry' (memory backend) or flat (the slim
+    supabase projection); the caller hydrates the winner by key."""
+    current = set(prep.orig_ids[1:])
+    limit = None if explicit else near_limit()
+    best_rank, best_key = None, None
+    for row in rows:
+        entry = row.get("entry") or row
+        if entry.get("problem") != prep.problem:
+            continue
+        cached = set(entry.get("customers") or [])
+        if not cached & current:
+            continue
+        dist = len(cached ^ current)
+        if limit is not None and dist > limit:
+            continue
+        try:
+            cost = float(entry.get("cost"))
+        except (TypeError, ValueError):
+            cost = float("inf")
+        rank = (dist, cost)
+        if row.get("key") is not None and (
+            best_rank is None or rank < best_rank
+        ):
+            best_rank, best_key = rank, row["key"]
+    return best_key
+
+
+# ---------------------------------------------------------------------------
+# The request-path hooks
+# ---------------------------------------------------------------------------
+
+
+def _legacy_warm(prep, database) -> None:
+    """The pre-cache warmStart retrieval: the (owner, solutionName)
+    checkpoint row. Still the fallback when the cache is off or the
+    family index is cold (fresh process, evicted entries) — the
+    checkpoint table is keep-best and persists independently."""
+    from service.solve import _warm_perm
+
+    state = database.get_warmstart(prep.params["name"])
+    prep.warm = _warm_perm(state, prep.orig_ids, prep.problem)
+
+
+def attach(prep, locations, matrix, database) -> None:
+    """Consult the cache for a prepared request (the one choke point,
+    called at the tail of prepare_vrp/prepare_tsp on the HTTP thread).
+
+    Outcomes, in order of preference:
+      exact — identical fingerprint + options: `prep.cached` holds the
+              servable response; submit paths return it without ever
+              enqueueing (and solve_prepared serves it inline when the
+              scheduler is off). Requests asking for includeStats or
+              profile solve anyway — unseeded, so the result matches a
+              plain twin that also solved unseeded bit for bit — with
+              the same "exact" outcome disclosed in stats.cache (and
+              store_result leaves the existing entry untouched).
+      warm  — explicit warmStart: seeded immediately from the closest
+              family entry (falling back to the legacy checkpoint row).
+      near  — implicit: a small-Hamming-distance family entry rides
+              `prep.cache['seed']`, applied only at solo dispatch.
+      miss  — nothing usable; the solve proceeds untouched.
+
+    With VRPMS_CACHE=off nothing here runs except the legacy warmStart
+    path — responses stay byte-identical to the pre-cache service.
+    """
+    wants_warm = bool(prep.opts.get("warm_start")) and _warm_supported(prep)
+    if database is None:
+        return
+    if not cache_enabled():
+        if wants_warm:
+            _legacy_warm(prep, database)
+            obs.WARMSTART.labels(
+                outcome="hit" if prep.warm is not None else "miss"
+            ).inc()
+        return
+    try:
+        outcome = _lookup(prep, locations, matrix, database, wants_warm)
+    except Exception as exc:
+        # the module contract — a cache problem degrades to solving,
+        # never to failing — must hold above the store seam too: a
+        # malformed entry document (migration script, truncated jsonb,
+        # junk customers list) raises HERE, not in store I/O, and the
+        # request it fronts would solve fine without us
+        prep.cached = None
+        if not isinstance(prep.cache, dict):
+            prep.cache = {}
+        prep.cache.pop("seed", None)
+        prep.cache["outcome"] = outcome = "miss"
+        log_event(
+            "cache.error", op="lookup",
+            error=f"{type(exc).__name__}: {exc}",
+        )
+        if wants_warm:
+            if prep.warm is None:
+                try:
+                    _legacy_warm(prep, database)
+                except Exception:
+                    prep.warm = None
+            if prep.warm is not None:
+                prep.cache["outcome"] = outcome = "warm"
+    obs.CACHE_LOOKUPS.labels(outcome=outcome).inc()
+    if wants_warm:
+        # the checkpoint feature's measurable hit rate, source-agnostic
+        obs.WARMSTART.labels(
+            outcome="hit" if prep.warm is not None else "miss"
+        ).inc()
+
+
+def _lookup(prep, locations, matrix, database, wants_warm: bool) -> str:
+    """The fallible body of attach(): key computation, store reads,
+    seed selection. Returns the lookup outcome."""
+    with spans.span("store.cache", op="lookup") as sp:
+        fingerprint = tiers.fingerprint(prep.inst)
+        key = _request_key(prep, fingerprint)
+        # the family key hashes the FULL dataset matrix + locations —
+        # deliberately lazy (_ensure_family): the exact-hit fast path
+        # and seed-less misses never need it, and it would dominate the
+        # store-read-latency budget on large instances
+        prep.cache = {
+            "fingerprint": fingerprint,
+            "key": key,
+            "outcome": "miss",
+            "_family_args": (locations, matrix),
+        }
+        servable = not (
+            prep.opts.get("include_stats")
+            or prep.opts.get("profile")
+            or prep.opts.get("warm_start")
+        )
+        # exact lookup first: ONE keyed (primary-key) read — the family
+        # scan only runs when a seed could actually be consumed, so the
+        # hottest path never transfers a family's worth of documents
+        entry = None
+        if not wants_warm:
+            row = database.get_cached_solution(key)
+            entry = (row or {}).get("entry")
+        outcome = "miss"
+        if entry is not None and entry.get("result") is not None:
+            if servable:
+                prep.cached = copy.deepcopy(entry["result"])
+            # else: includeStats/profile — the solve runs for real
+            # telemetry, unseeded so it reproduces the plain solve;
+            # the stats disclose the lookup found an exact entry it
+            # couldn't serve
+            outcome = "exact"
+        elif wants_warm or (near_limit() > 0 and _warm_supported(prep)):
+            rows = database.get_cache_family(_ensure_family(prep))
+            winner = _pick_seed(prep, rows, explicit=wants_warm)
+            if sp is not None:
+                sp.set(entries=len(rows))
+            seed = None
+            if winner is not None:
+                # hydrate the ONE winning row by key: the family scan
+                # returns slim ranking rows (no routes on the network
+                # backends), and the keyed read marks the row as USED
+                # for the memory tier's LRU — scanned-but-unused rows
+                # keep their recency
+                full = (database.get_cached_solution(winner) or {}).get(
+                    "entry"
+                ) or {}
+                if full.get("routes"):
+                    seed = {
+                        "routes": full["routes"],
+                        "cost": full.get("cost"),
+                    }
+            if seed is not None:
+                if wants_warm:
+                    prep.warm = _repair_perm(prep, seed["routes"])
+                    if prep.warm is not None:
+                        outcome = "warm"
+                else:
+                    prep.cache["seed"] = seed
+                    outcome = "near"
+        if wants_warm and prep.warm is None:
+            _legacy_warm(prep, database)
+            if prep.warm is not None:
+                outcome = "warm"
+        prep.cache["outcome"] = outcome
+        if sp is not None:
+            sp.set(outcome=outcome, fingerprint=fingerprint[:16])
+    return outcome
+
+
+def apply_deferred_seed(prep) -> None:
+    """Materialize an implicit near-hit seed at SOLO dispatch time.
+
+    Called by solve_prepared just before the solver runs: only jobs
+    that did NOT merge into a micro-batch reach it, so a near hit never
+    costs a request its batched launch. The repair happens here (not at
+    lookup) for the same reason — no point paying it for a job the
+    batcher will absorb."""
+    if prep.warm is not None or not prep.cache:
+        return
+    seed = prep.cache.get("seed")
+    if not seed:
+        return
+    try:
+        prep.warm = _repair_perm(prep, seed["routes"])
+    except Exception as exc:
+        # a junk cached tour must not fail the solve it would have seeded
+        prep.warm = None
+        log_event(
+            "cache.error", op="seed",
+            error=f"{type(exc).__name__}: {exc}",
+        )
+
+
+def mark_trivial(prep) -> dict:
+    """Contract uniformity for trivial zero-customer responses: they
+    short-circuit before attach() runs, but should carry `cacheHit`
+    exactly when solved responses would (cache enabled + a store) so
+    clients can read the key unconditionally."""
+    result = dict(prep.trivial)
+    if cache_enabled() and prep.database is not None:
+        result["cacheHit"] = False
+    return result
+
+
+def serve_hit(prep) -> dict:
+    """Serve an exact hit: a deep copy of the cached response, marked
+    `cacheHit: true`, honest about degraded data reads. The solver, the
+    admission queue, and the checkpoint write are all bypassed — the
+    whole request costs its store reads."""
+    # attach() already deep-copied the entry off the store's live row,
+    # and prep is per-request, so mutating in place is safe — a second
+    # copy would be pure overhead on the store-read-latency hot path
+    result = prep.cached
+    result["cacheHit"] = True
+    obs.CACHE_SOLVES_AVOIDED.inc()
+    log_event(
+        "cache.hit",
+        problem=prep.problem,
+        algorithm=prep.algorithm,
+        fingerprint=prep.cache["fingerprint"][:16],
+    )
+    if getattr(prep.database, "degraded", False):
+        result["degraded"] = True
+    return result
+
+
+def store_result(prep, result, routes, cost) -> dict:
+    """Annotate + persist a solved result (the finish_vrp/finish_tsp
+    tail, so solo, batched, sync, and async paths all land here).
+
+    `routes` are the decoded routes in ORIGINAL location ids; `cost` is
+    the penalized solver objective (comparable across entries of one
+    customer set, like the warm-start checkpoint stores). The persisted
+    entry strips volatile keys (stats/degraded/cacheHit) so an exact
+    hit can serve any later identical request byte-identically."""
+    if result is None or not prep.cache:
+        return result
+    result["cacheHit"] = False
+    stats = result.get("stats")
+    if isinstance(stats, dict):
+        stats["cache"] = {
+            "fingerprint": prep.cache.get("fingerprint"),
+            "lookup": prep.cache.get("outcome", "miss"),
+            "seeded": bool(
+                prep.warm is not None
+                and prep.cache.get("outcome") in ("near", "warm")
+            ),
+        }
+    if prep.cache.get("outcome") == "exact" or "key" not in prep.cache:
+        # exact: the canonical entry already exists (this solve ran only
+        # for fresh telemetry) and re-writing could flap the served
+        # result if the original solve was seeded and this one
+        # deliberately not; no key: the lookup failed before the keys
+        # were computed, so there is nothing to index the entry under
+        return result
+    try:
+        entry = {
+            "problem": prep.problem,
+            "algorithm": prep.algorithm,
+            "fingerprint": prep.cache["fingerprint"],
+            "customers": sorted(prep.orig_ids[1:], key=repr),
+            "routes": routes,
+            "cost": float(cost),
+            "result": {
+                k: v for k, v in result.items() if k not in _VOLATILE_KEYS
+            },
+        }
+        with spans.span("store.cache", op="store"):
+            prep.database.put_cached_solution(
+                prep.cache["key"], _ensure_family(prep), entry
+            )
+    except Exception as exc:
+        # best-effort persistence: the solved response is already in
+        # hand and must ship whether or not the cache accepted the entry
+        log_event(
+            "cache.error", op="store",
+            error=f"{type(exc).__name__}: {exc}",
+        )
+    return result

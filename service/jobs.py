@@ -37,6 +37,7 @@ from http.server import BaseHTTPRequestHandler
 
 import store
 from service import obs
+from service import cache as solution_cache
 from service.helpers import (
     fail,
     read_json_body,
@@ -623,7 +624,11 @@ def scheduler_solve(problem, algorithm, params, opts, algo_params,
     if prep is None or errors:
         return None
     if prep.trivial is not None:
-        return _mark_degraded(prep, dict(prep.trivial))
+        return _mark_degraded(prep, solution_cache.mark_trivial(prep))
+    if prep.cached is not None:
+        # exact cache hit: served at store-read latency, never enqueued
+        # — immune to queue-full 429s and to solver wait entirely
+        return solution_cache.serve_hit(prep)
     job = Job(
         payload={"prep": prep, "problem": problem, "algorithm": algorithm},
         bucket=_bucket_key(prep),
@@ -736,9 +741,17 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             trace=self._trace,
             span=self._trace_root,
         )
-        if prep.trivial is not None:
-            # nothing to schedule: the job is born done
-            job.result = _mark_degraded(prep, dict(prep.trivial))
+        if prep.trivial is not None or prep.cached is not None:
+            # nothing to schedule: the job is born done (a trivial
+            # zero-customer request, or an exact cache hit — the cached
+            # routes/cost/certificate ARE the result, so the admission
+            # queue and the solver are bypassed entirely)
+            if prep.cached is not None:
+                job.result = solution_cache.serve_hit(prep)
+            else:
+                job.result = _mark_degraded(
+                    prep, solution_cache.mark_trivial(prep)
+                )
             job.finish(DONE)
             _persist(job)
             obs.JOBS_TOTAL.labels(outcome="done").inc()

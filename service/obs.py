@@ -167,6 +167,26 @@ TIER_CACHE = REGISTRY.counter(
     "available), miss = first sighting (the solve may pay compiles)",
     labels=("outcome",),
 )
+CACHE_LOOKUPS = REGISTRY.counter(
+    "vrpms_cache_lookups_total",
+    "Content-addressed solution-cache LOOKUP outcomes (exact = "
+    "identical entry found — served without solving unless the request "
+    "demanded fresh telemetry; near = a similar cached tour was found "
+    "to seed from, applied only if the job dispatches solo — "
+    "stats.cache.seeded tells per request; warm = explicit warmStart "
+    "retrieval via the family index; miss = solved cold)",
+    labels=("outcome",),
+)
+CACHE_SOLVES_AVOIDED = REGISTRY.counter(
+    "vrpms_cache_solves_avoided_total",
+    "Requests served entirely from the solution cache (exact hits): "
+    "each one cost a store read instead of a metaheuristic solve",
+)
+CACHE_EVICTIONS = REGISTRY.counter(
+    "vrpms_cache_evictions_total",
+    "Entries LRU-evicted from the in-memory solution-cache tier "
+    "(bounded by the VRPMS_CACHE entry cap)",
+)
 BUILD_INFO = REGISTRY.gauge(
     "vrpms_build_info",
     "Constant 1, labeled with the package version, jax version, and "
@@ -453,6 +473,12 @@ def _wire_compile_obs() -> None:
         from vrpms_tpu.core import tiers
 
         tiers.set_tier_observer(_record_tier)
+    except Exception:
+        pass
+    try:
+        from store import base as store_base
+
+        store_base.set_cache_observer(lambda n: CACHE_EVICTIONS.inc(n))
     except Exception:
         pass
 

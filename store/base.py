@@ -18,7 +18,61 @@ Errors are accumulated into the caller's mutable list as
 
 from __future__ import annotations
 
+import os
 import sys
+
+
+# --- solution-cache configuration (the VRPMS_CACHE knob) -------------------
+# One knob controls the whole content-addressed solution cache
+# (service.cache): "off"/"0"/"false"/"no" disables everything, an
+# integer sets the in-memory backend's LRU entry cap, anything else
+# (including unset) means enabled with the default cap. Read per call —
+# tests and embedders toggle the env var at runtime.
+
+DEFAULT_CACHE_CAP = 512
+
+
+def cache_mode() -> str:
+    return os.environ.get("VRPMS_CACHE", "").strip().lower()
+
+
+def cache_enabled() -> bool:
+    return cache_mode() not in ("off", "0", "false", "no")
+
+
+def cache_cap(default: int = DEFAULT_CACHE_CAP) -> int:
+    """In-memory cache tier entry cap (0 = cache disabled)."""
+    mode = cache_mode()
+    if not cache_enabled():
+        return 0
+    try:
+        return max(1, int(mode))
+    except ValueError:
+        return default
+
+
+# Eviction observer seam (service.obs wires a Prometheus counter in;
+# the store package stays free of service imports — the tiers
+# set_tier_observer pattern).
+_cache_observer = None
+
+
+def set_cache_observer(fn) -> None:
+    """fn(evicted: int) — called when the in-memory tier evicts."""
+    global _cache_observer
+    _cache_observer = fn
+
+
+def notify_cache_evictions(n: int) -> None:
+    if n and _cache_observer is not None:
+        try:
+            _cache_observer(n)
+        except Exception:
+            pass  # telemetry must never break an upsert
+
+
+# per-op "already warned this outage" latches (cleared on any success)
+_cache_warned: dict = {}
 
 
 class Database:
@@ -55,6 +109,107 @@ class Database:
 
     def _upsert_job(self, job_id: str, record: dict):
         raise NotImplementedError
+
+    def _fetch_cache_family(self, family: str) -> list:
+        raise NotImplementedError
+
+    def _fetch_cached_solution(self, key: str) -> dict | None:
+        raise NotImplementedError
+
+    def _upsert_cached_solution(self, key: str, family: str, entry: dict):
+        raise NotImplementedError
+
+    # -- content-addressed solution cache (perf extension) ------------------
+    # One row per (instance fingerprint + request options) under `key`,
+    # grouped by `family` — the hash of the underlying dataset + fleet
+    # config + auth scope, which survives customer-subset changes so
+    # near-hit lookups are ONE keyed read (service.cache). Strictly
+    # best-effort: the cache is an optimization, so a miss is always a
+    # safe answer and no failure here may ever fail (or even slow — see
+    # store.resilient's single-attempt guard) the solve it fronts.
+    def _cache_warn(self, op: str, exc: Exception) -> None:
+        # one structured event per outage, not one line per request: an
+        # open breaker fails every lookup instantly, so unthrottled
+        # logging would scale 1:1 with traffic for the outage's duration
+        if _cache_warned.get(op):
+            return
+        _cache_warned[op] = True
+        try:
+            from vrpms_tpu.obs import log_event
+
+            log_event(
+                "store.cache_degraded",
+                op=op,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+        except Exception:
+            print(
+                f"[store] solution-cache {op} failed "
+                f"({type(exc).__name__}: {exc}); continuing without cache",
+                file=sys.stderr,
+            )
+
+    def _cache_recovered(self, op: str) -> None:
+        # clear only the succeeding op's latch: a partial outage (reads
+        # fine, writes denied — e.g. a one-sided RLS policy) must not
+        # re-arm the write latch on every successful read, or the
+        # one-event-per-outage throttle never engages
+        _cache_warned.pop(op, None)
+
+    # One failed cache call disables the cache for the REST of this
+    # instance's lifetime — instances are per-request (store.
+    # get_database in the handlers), so this caps what an outage can
+    # cost a single request at ONE store deadline: without it, a hung
+    # backend with the breaker still closed would charge a near-eligible
+    # miss up to three sequential deadlines (exact read, family scan,
+    # winner hydration) before the solve even starts.
+    _cache_down = False
+
+    def get_cache_family(self, family: str) -> list:
+        """Rows for a family — at minimum the seed-ranking fields
+        (`key` + problem/customers/cost, nested under 'entry' or flat);
+        [] on failure. The winning row is re-read by key afterwards
+        (service.cache), so backends may return slim rows here."""
+        if self._cache_down:
+            return []
+        try:
+            rows = self._fetch_cache_family(family)
+        except Exception as exc:
+            self._cache_warn("read", exc)
+            self._cache_down = True
+            return []
+        self._cache_recovered("read")
+        return list(rows or [])
+
+    def get_cached_solution(self, key: str) -> dict | None:
+        """The exact-hit path: ONE keyed read (primary-key lookup on the
+        network backends — no family scan on the hot path); None on miss
+        or failure."""
+        if self._cache_down:
+            return None
+        try:
+            row = self._fetch_cached_solution(key)
+        except Exception as exc:
+            self._cache_warn("read", exc)
+            self._cache_down = True
+            return None
+        self._cache_recovered("read")
+        return row
+
+    def put_cached_solution(self, key: str, family: str, entry: dict) -> bool:
+        if self._cache_down:
+            # entries are recomputable; the next healthy request
+            # re-populates — don't spend another deadline after a solve
+            # whose lookup already found the cache store unreachable
+            return False
+        try:
+            self._upsert_cached_solution(key, family, entry)
+        except Exception as exc:
+            self._cache_warn("write", exc)
+            self._cache_down = True
+            return False
+        self._cache_recovered("write")
+        return True
 
     # -- async job records (scheduler extension) ----------------------------
     # The jobs API (service.jobs) persists each job's lifecycle record

@@ -62,6 +62,14 @@ class _FaultyMixin(_InMemoryMixin):
         self._injector.apply("read")
         return super()._fetch_job(job_id)
 
+    def _fetch_cache_family(self, family):
+        self._injector.apply("read")
+        return super()._fetch_cache_family(family)
+
+    def _fetch_cached_solution(self, key):
+        self._injector.apply("read")
+        return super()._fetch_cached_solution(key)
+
     # -- writes -------------------------------------------------------------
     def _insert_solution(self, data):
         self._injector.apply("write")
@@ -74,6 +82,10 @@ class _FaultyMixin(_InMemoryMixin):
     def _upsert_job(self, job_id, record):
         self._injector.apply("write")
         return super()._upsert_job(job_id, record)
+
+    def _upsert_cached_solution(self, key, family, entry):
+        self._injector.apply("write")
+        return super()._upsert_cached_solution(key, family, entry)
 
 
 class FaultyDatabaseVRP(_FaultyMixin, DatabaseVRP):

@@ -20,7 +20,13 @@ import json
 import os
 import threading
 
-from store.base import Database, DatabaseTSP, DatabaseVRP
+from store.base import (
+    Database,
+    DatabaseTSP,
+    DatabaseVRP,
+    cache_cap,
+    notify_cache_evictions,
+)
 
 _lock = threading.Lock()
 _tables: dict = {
@@ -29,6 +35,7 @@ _tables: dict = {
     "solutions": [],
     "warmstarts": {},
     "jobs": {},
+    "solution_cache": {},
 }
 _tokens: dict = {}
 _fixtures_loaded = False
@@ -41,6 +48,7 @@ def reset():
         _tables["solutions"].clear()
         _tables["warmstarts"].clear()
         _tables["jobs"].clear()
+        _tables["solution_cache"].clear()
         _tokens.clear()
         global _fixtures_loaded
         _fixtures_loaded = False
@@ -121,6 +129,48 @@ class _InMemoryMixin(Database):
             jobs[str(job_id)] = {"id": job_id, "record": record}
             while len(jobs) > self.MAX_JOBS:
                 jobs.pop(next(iter(jobs)))
+
+    # -- solution cache: LRU-bounded in-memory tier -------------------------
+    # Insertion order is recency: writes re-insert and a keyed read
+    # refreshes, so eviction drops the least-recently-USED entry, not
+    # merely the oldest-written. A family SCAN deliberately does not
+    # refresh — scanning is not using, and a large family's misses must
+    # not evict other entries' genuinely hot rows; the one row a scan's
+    # winner actually seeds from is re-read by key (service.cache) and
+    # refreshes there. The cap re-reads VRPMS_CACHE per upsert (tests
+    # and live tuning change it at runtime).
+    def _fetch_cache_family(self, family):
+        with _lock:
+            return [
+                r for r in _tables["solution_cache"].values()
+                if r["family"] == family
+            ]
+
+    def _fetch_cached_solution(self, key):
+        with _lock:
+            cache = _tables["solution_cache"]
+            row = cache.pop(str(key), None)
+            if row is None:
+                return None
+            cache[str(key)] = row  # refresh recency
+            return row
+
+    def _upsert_cached_solution(self, key, family, entry: dict):
+        cap = cache_cap()
+        if cap <= 0:
+            # VRPMS_CACHE flipped to off after this request attached:
+            # skip the write rather than clamp the cap to 1, which
+            # would mass-evict every existing entry
+            return
+        evicted = 0
+        with _lock:
+            cache = _tables["solution_cache"]
+            cache.pop(str(key), None)  # refresh insertion order
+            cache[str(key)] = {"key": key, "family": family, "entry": entry}
+            while len(cache) > cap:
+                cache.pop(next(iter(cache)))
+                evicted += 1
+        notify_cache_evictions(evicted)
 
     def _upsert_warmstart(self, owner, name, state: dict):
         with _lock:

@@ -678,6 +678,41 @@ class _ResilientMixin(Database):
             ),
         )
 
+    # -- solution-cache primitives: single attempt, fail fast ---------------
+    # The content cache (service.cache) is a pure optimization whose
+    # safe answer is always "miss", so its resilience policy inverts
+    # the read path's: NO retries (a retry storm on the pre-solve hot
+    # path defeats the cache's purpose), NO degraded-cache fallback and
+    # NO degraded flag (a missed lookup solves normally — nothing about
+    # the response is best-effort), and NO journal spooling for writes
+    # (cache entries are recomputable; spooling them would burn bounded
+    # journal slots that job records and checkpoints need during an
+    # outage). Calls still run under the per-call deadline and feed the
+    # shared circuit breaker, so a down store costs at most one deadline
+    # before the open circuit sheds cache traffic instantly.
+    def _cache_call(self, method: str, args: tuple):
+        res = self._res
+        if not res.breaker.allow():
+            raise StoreUnavailable(f"store circuit open for {method}")
+        try:
+            value = self._attempt(method, args)
+        except Exception as exc:
+            self._note_failure(method, exc)
+            raise
+        self._note_success()
+        return value
+
+    def _fetch_cache_family(self, family):
+        return self._cache_call("_fetch_cache_family", (family,))
+
+    def _fetch_cached_solution(self, key):
+        return self._cache_call("_fetch_cached_solution", (key,))
+
+    def _upsert_cached_solution(self, key, family, entry):
+        return self._cache_call(
+            "_upsert_cached_solution", (key, family, entry)
+        )
+
 
 class ResilientDatabaseVRP(_ResilientMixin, DatabaseVRP):
     pass

@@ -54,3 +54,24 @@ create table if not exists jobs (
   updated_at timestamptz not null default now()
 );
 create index if not exists jobs_updated_at on jobs (updated_at);
+
+-- Content-addressed solution cache (service/cache.py): one row per
+-- (instance fingerprint + algorithm-relevant request options) under
+-- `key`; `family` groups rows by dataset + fleet config + auth scope so
+-- near-hit (warm-start-from-similar) lookups are one indexed read. The
+-- entry document carries the served result, the giant-tour routes in
+-- original location ids, the penalized cost, and the customer-id set
+-- (store/base.py get_cache_family / put_cached_solution). Auth scope is
+-- hashed INTO both key and family, so tenants can never share entries.
+-- Rows accumulate with distinct-request volume: pair with a retention
+-- job, e.g. pg_cron:
+--   delete from solution_cache where updated_at < now() - '7 days';
+-- (the in-memory backend LRU-bounds itself at the VRPMS_CACHE cap).
+create table if not exists solution_cache (
+  key text primary key,             -- upsert target: on_conflict="key"
+  family text not null,
+  entry jsonb not null,
+  updated_at timestamptz not null default now()
+);
+create index if not exists solution_cache_family
+  on solution_cache (family, updated_at desc);

@@ -105,6 +105,59 @@ class _SupabaseMixin(Database):
             .execute()
         )
 
+    def _fetch_cache_family(self, family):
+        # bounded: a hot family (one city's dataset) accumulates one row
+        # per distinct request shape; 64 most-recent rows are plenty of
+        # near-hit candidates and keep the read one indexed round trip.
+        # Slim projection: seed RANKING needs only problem/customers/
+        # cost per row (service.cache._pick_seed reads flat rows too) —
+        # each full entry jsonb embeds the whole served response, and 64
+        # of those would be hundreds of KB of pre-solve transfer on the
+        # HTTP thread; the single winner is hydrated by a keyed read
+        result = (
+            self.client.table("solution_cache")
+            .select(
+                "key,problem:entry->problem,"
+                "customers:entry->customers,cost:entry->cost"
+            )
+            .eq("family", family)
+            .order("updated_at", desc=True)
+            .limit(64)
+            .execute()
+        )
+        return list(result.data)
+
+    def _fetch_cached_solution(self, key):
+        # exact-hit hot path: one primary-key read, no family scan
+        result = (
+            self.client.table("solution_cache")
+            .select("*")
+            .eq("key", key)
+            .limit(1)
+            .execute()
+        )
+        return result.data[0] if result.data else None
+
+    def _upsert_cached_solution(self, key, family, entry: dict):
+        # updated_at must ride the payload: the column default fires on
+        # INSERT only, and recency ordering + the documented retention
+        # job both read it — a re-solved entry refreshes its slot
+        from datetime import datetime, timezone
+
+        return (
+            self.client.table("solution_cache")
+            .upsert(
+                {
+                    "key": key,
+                    "family": family,
+                    "entry": entry,
+                    "updated_at": datetime.now(timezone.utc).isoformat(),
+                },
+                on_conflict="key",
+            )
+            .execute()
+        )
+
 
 class SupabaseDatabaseVRP(_SupabaseMixin, DatabaseVRP):
     pass

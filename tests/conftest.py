@@ -85,6 +85,9 @@ _SLOW_PATTERNS = (
     "test_service.py::TestErrorEnvelope::test_tsp_duplicate_customers_deduped",
     "test_makespan.py::TestServiceMakespan",
     "test_warmstart.py::TestWarmStartHTTP",
+    # 3 solves incl. a 500-iteration cache warmer; the rest of the
+    # cache suite stays quick (and tier1.yml runs the file in full)
+    "test_cache.py::TestNearHit::test_never_loses_to_cold_start",
     "test_utils_info.py::TestSolveInfo",
     "test_fixtures.py::TestSolverBand",
     "test_sa_delta.py::TestDeltaStepKernel::test_many_steps_zero_drift_and_valid_tours",

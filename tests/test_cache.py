@@ -1,0 +1,532 @@
+"""Content-addressed solution cache (ISSUE 6).
+
+Covers the cache contract end-to-end over real HTTP plus the unit
+seams: exact hits serve byte-identical responses without solving, near
+hits repair the cached giant tour to exactly the requested customer
+set and never lose to a cold start at equal budget, the legacy
+warmStart option rides the same family index, tenants never share
+entries, the in-memory tier is LRU-bounded with an eviction counter,
+and `VRPMS_CACHE=off` restores the pre-cache responses bit for bit.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import store.memory as mem
+from service import cache as solution_cache
+from service import obs
+from vrpms_tpu.core import make_instance, tiers
+from tests.test_service import (  # noqa: F401  (fixtures)
+    get,
+    post,
+    seeded,
+    server,
+    vrp_body,
+    tsp_body,
+)
+
+
+@pytest.fixture(autouse=True)
+def cache_env():
+    """Restore the cache knobs after each test (they are read per call)."""
+    keys = ("VRPMS_CACHE", "VRPMS_CACHE_NEAR")
+    saved = {k: os.environ.get(k) for k in keys}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def served_customers(msg):
+    return sorted(c for v in msg["vehicles"] for c in v["tour"][1:-1])
+
+
+def strip_hit(msg):
+    return {k: v for k, v in msg.items() if k != "cacheHit"}
+
+
+# ---------------------------------------------------------------------------
+# Unit: the fingerprint is a content address
+# ---------------------------------------------------------------------------
+
+
+class TestFingerprint:
+    def _inst(self, d, caps=(6, 6)):
+        return make_instance(
+            np.asarray(d), demands=[0, 2, 2, 2], capacities=list(caps)
+        )
+
+    def test_equal_content_equal_hash(self, rng):
+        d = rng.uniform(1, 10, size=(4, 4))
+        a = tiers.fingerprint(self._inst(d))
+        b = tiers.fingerprint(self._inst(d.copy()))
+        assert a == b
+        assert len(a) == 64  # sha256 hex
+
+    def test_any_tensor_change_changes_hash(self, rng):
+        d = rng.uniform(1, 10, size=(4, 4))
+        base = tiers.fingerprint(self._inst(d))
+        d2 = d.copy()
+        d2[1, 2] += 0.5
+        assert tiers.fingerprint(self._inst(d2)) != base
+
+    def test_fleet_change_changes_hash(self, rng):
+        d = rng.uniform(1, 10, size=(4, 4))
+        assert tiers.fingerprint(self._inst(d, caps=(6, 6))) != tiers.fingerprint(
+            self._inst(d, caps=(6, 6, 6))
+        )
+
+    def test_padding_canonicalizes(self, rng):
+        # the cache-critical property: the PADDED instance hashes equal
+        # no matter how the request spelled the same content
+        d = rng.uniform(1, 10, size=(4, 4))
+        p1 = tiers.pad_instance(self._inst(d))
+        p2 = tiers.pad_instance(self._inst(np.asarray(d.tolist())))
+        assert tiers.fingerprint(p1) == tiers.fingerprint(p2)
+
+
+# ---------------------------------------------------------------------------
+# Unit: the in-memory tier is LRU-bounded
+# ---------------------------------------------------------------------------
+
+
+class TestLRUBound:
+    def test_cap_evicts_least_recently_used(self):
+        os.environ["VRPMS_CACHE"] = "2"
+        mem.reset()
+        db = mem.InMemoryDatabaseVRP(None)
+        before = obs.CACHE_EVICTIONS.value
+        db.put_cached_solution("k1", "famA", {"cost": 1.0})
+        db.put_cached_solution("k2", "famB", {"cost": 2.0})
+        # USE k1 (the keyed read the lookup path issues for hits and
+        # hydrated seeds): k1 becomes most-recently-used
+        assert db.get_cached_solution("k1")["key"] == "k1"
+        db.put_cached_solution("k3", "famC", {"cost": 3.0})
+        # k2 (least recently used) was evicted, k1 survived
+        assert db.get_cache_family("famB") == []
+        assert [r["key"] for r in db.get_cache_family("famA")] == ["k1"]
+        assert obs.CACHE_EVICTIONS.value == before + 1
+
+    def test_family_scan_does_not_refresh_recency(self):
+        # scanning is not using: a big family's misses must not evict
+        # other tenants' hot rows — only the hydrating keyed read counts
+        os.environ["VRPMS_CACHE"] = "2"
+        mem.reset()
+        db = mem.InMemoryDatabaseVRP(None)
+        db.put_cached_solution("k1", "famA", {"cost": 1.0})
+        db.put_cached_solution("k2", "famB", {"cost": 2.0})
+        assert [r["key"] for r in db.get_cache_family("famA")] == ["k1"]
+        db.put_cached_solution("k3", "famC", {"cost": 3.0})
+        # the famA scan did NOT refresh k1: k1 was still the LRU entry
+        assert db.get_cache_family("famA") == []
+        assert [r["key"] for r in db.get_cache_family("famB")] == ["k2"]
+
+    def test_rewrite_refreshes_not_evicts(self):
+        os.environ["VRPMS_CACHE"] = "2"
+        mem.reset()
+        db = mem.InMemoryDatabaseVRP(None)
+        db.put_cached_solution("k1", "famA", {"cost": 1.0})
+        db.put_cached_solution("k1", "famA", {"cost": 1.5})  # same key
+        db.put_cached_solution("k2", "famB", {"cost": 2.0})
+        assert [r["entry"]["cost"] for r in db.get_cache_family("famA")] == [1.5]
+        assert [r["key"] for r in db.get_cache_family("famB")] == ["k2"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP: exact hits
+# ---------------------------------------------------------------------------
+
+
+class TestExactHit:
+    def test_byte_identical_and_counted(self, server):
+        b = vrp_body(iterationCount=150)
+        avoided0 = obs.CACHE_SOLVES_AVOIDED.value
+        s1, r1 = post(server, "/api/vrp/sa", b)
+        assert s1 == 200 and r1["message"]["cacheHit"] is False
+        s2, r2 = post(server, "/api/vrp/sa", b)
+        assert s2 == 200 and r2["message"]["cacheHit"] is True
+        assert json.dumps(strip_hit(r1["message"]), sort_keys=True) == json.dumps(
+            strip_hit(r2["message"]), sort_keys=True
+        )
+        assert obs.CACHE_SOLVES_AVOIDED.value == avoided0 + 1
+
+    def test_certificate_served_from_cache(self, server):
+        # the BF proof certificate is part of the cached response
+        b = vrp_body()
+        s1, r1 = post(server, "/api/vrp/bf", b)
+        s2, r2 = post(server, "/api/vrp/bf", b)
+        assert s2 == 200 and r2["message"]["cacheHit"] is True
+        assert r2["message"]["exact"] == r1["message"]["exact"]
+
+    def test_tsp_exact_hit(self, server):
+        b = tsp_body(iterationCount=150)
+        post(server, "/api/tsp/sa", b)
+        s2, r2 = post(server, "/api/tsp/sa", b)
+        assert s2 == 200 and r2["message"]["cacheHit"] is True
+
+    def test_option_change_is_a_miss(self, server):
+        b = vrp_body(iterationCount=150)
+        post(server, "/api/vrp/sa", b)
+        for variant in (
+            vrp_body(iterationCount=150, seed=2),
+            vrp_body(iterationCount=151),
+            vrp_body(iterationCount=150, completedCustomers=[2]),
+        ):
+            _, r = post(server, "/api/vrp/sa", variant)
+            assert r["message"]["cacheHit"] is False
+
+    def test_stats_requests_solve_anyway(self, server):
+        # includeStats telemetry must be real: the exact entry is found
+        # but NOT served — and not seeded either, so the solve stays
+        # byte-identical to its plain twin (same seed, same program)
+        b = vrp_body(iterationCount=150)
+        _, plain = post(server, "/api/vrp/sa", b)
+        _, r = post(server, "/api/vrp/sa", dict(b, includeStats=True))
+        assert r["message"]["cacheHit"] is False
+        assert r["message"]["stats"]["cache"]["lookup"] == "exact"
+        assert r["message"]["stats"]["cache"]["seeded"] is False
+        stripped = strip_hit(r["message"])
+        stripped.pop("stats")
+        assert stripped == strip_hit(plain["message"])
+
+    def test_async_job_born_done_on_hit(self, server):
+        b = dict(vrp_body(iterationCount=150), problem="vrp", algorithm="sa")
+        s, r = post(server, "/api/jobs", b)
+        assert s == 202
+        import time
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            _, poll = post_get(server, f"/api/jobs/{r['jobId']}")
+            if poll["job"]["status"] in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        assert poll["job"]["status"] == "done"
+        # identical submit: the job is born done from the cache — it
+        # never touches the admission queue or the solver
+        s2, r2 = post(server, "/api/jobs", b)
+        assert s2 == 202
+        _, poll2 = post_get(server, f"/api/jobs/{r2['jobId']}")
+        assert poll2["job"]["status"] == "done"
+        assert poll2["job"]["message"]["cacheHit"] is True
+
+    def test_trivial_response_carries_cache_hit_key(self, server):
+        # zero-customer requests short-circuit before the cache lookup
+        # but keep the contract key uniform: present (false) when the
+        # cache is on, absent when it is off
+        b = vrp_body(completedCustomers=[1, 2, 3, 4, 5, 6])
+        s, r = post(server, "/api/vrp/sa", b)
+        assert s == 200 and r["message"]["vehicles"] == []
+        assert r["message"]["cacheHit"] is False
+        os.environ["VRPMS_CACHE"] = "off"
+        s, r = post(server, "/api/vrp/sa", b)
+        assert s == 200 and "cacheHit" not in r["message"]
+
+    def test_metrics_expose_cache_series(self, server):
+        b = vrp_body(iterationCount=150)
+        post(server, "/api/vrp/sa", b)
+        post(server, "/api/vrp/sa", b)
+        _, text = get(server, "/metrics")
+        assert 'vrpms_cache_lookups_total{outcome="exact"}' in text
+        assert "vrpms_cache_solves_avoided_total" in text
+        assert "vrpms_cache_evictions_total" in text
+
+
+def post_get(base, path):
+    import urllib.request
+
+    with urllib.request.urlopen(base + path) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# HTTP: tenant isolation + the off switch
+# ---------------------------------------------------------------------------
+
+
+class TestTenantIsolation:
+    def test_auth_scopes_never_share_entries(self, server):
+        mem.register_token("tok-bob", "bob@example.com")
+        b = vrp_body(iterationCount=150, auth="tok-alice")
+        post(server, "/api/vrp/sa", b)
+        _, hit = post(server, "/api/vrp/sa", b)
+        assert hit["message"]["cacheHit"] is True
+        # same body, different tenant: must solve, not serve alice's row
+        _, bob = post(server, "/api/vrp/sa", dict(b, auth="tok-bob"))
+        assert bob["message"]["cacheHit"] is False
+        # anonymous scope is its own tenant too
+        _, anon = post(server, "/api/vrp/sa", vrp_body(iterationCount=150))
+        assert anon["message"]["cacheHit"] is False
+
+
+class TestCacheOff:
+    def test_responses_byte_identical_to_pre_cache(self, server):
+        os.environ["VRPMS_CACHE"] = "off"
+        b = vrp_body(iterationCount=150)
+        s1, r1 = post(server, "/api/vrp/sa", b)
+        s2, r2 = post(server, "/api/vrp/sa", b)
+        assert s1 == s2 == 200
+        # no cache annotations, no cache rows, every request solves
+        assert "cacheHit" not in r1["message"]
+        assert "cacheHit" not in r2["message"]
+        assert mem._tables["solution_cache"] == {}
+        # deterministic solver, same seed: the two solves agree, which
+        # is exactly the seed-era response for this body
+        assert json.dumps(r1["message"], sort_keys=True) == json.dumps(
+            r2["message"], sort_keys=True
+        )
+
+    def test_off_still_serves_legacy_warmstart(self, server):
+        os.environ["VRPMS_CACHE"] = "off"
+        b = vrp_body(iterationCount=150, auth="tok-alice", includeStats=True)
+        post(server, "/api/vrp/sa", b)
+        _, r = post(server, "/api/vrp/sa", dict(b, warmStart=True))
+        assert r["message"]["stats"]["warmStart"] is True
+        assert "cache" not in r["message"]["stats"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP: near hits repair + seed
+# ---------------------------------------------------------------------------
+
+
+class TestNearHit:
+    def test_strip_preserves_customer_set(self, server):
+        post(server, "/api/vrp/sa", vrp_body(iterationCount=150))
+        _, r = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=150, completedCustomers=[2], includeStats=True),
+        )
+        assert served_customers(r["message"]) == [1, 3, 4, 5, 6]
+        assert r["message"]["stats"]["cache"]["lookup"] == "near"
+        assert r["message"]["stats"]["cache"]["seeded"] is True
+
+    def test_insert_preserves_customer_set(self, server):
+        post(server, "/api/vrp/sa", vrp_body(iterationCount=150, completedCustomers=[2, 5]))
+        _, r = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=150, includeStats=True),
+        )
+        # the cached 4-customer tour greedy-inserts 2 and 5 back: the
+        # served set is exactly the requested one, nothing lost or kept
+        assert served_customers(r["message"]) == [1, 2, 3, 4, 5, 6]
+        assert r["message"]["stats"]["cache"]["lookup"] == "near"
+
+    def test_distance_cap_and_disable(self, server):
+        post(server, "/api/vrp/sa", vrp_body(iterationCount=150))
+        os.environ["VRPMS_CACHE_NEAR"] = "1"
+        _, r = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=150, completedCustomers=[2, 3], includeStats=True),
+        )
+        assert r["message"]["stats"]["cache"]["lookup"] == "miss"
+        os.environ["VRPMS_CACHE_NEAR"] = "0"
+        _, r = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=150, completedCustomers=[2], includeStats=True),
+        )
+        assert r["message"]["stats"]["cache"]["lookup"] == "miss"
+
+    def test_never_loses_to_cold_start_at_equal_budget(self, server):
+        # acceptance: warm-start-from-similar matches or beats the cold
+        # NN construction at the SAME iteration budget and seed
+        post(server, "/api/vrp/sa", vrp_body(iterationCount=500))
+        near = vrp_body(iterationCount=40, seed=3, completedCustomers=[6])
+        _, warm = post(server, "/api/vrp/sa", near)
+        assert warm["message"]["cacheHit"] is False  # seeded, not served
+        os.environ["VRPMS_CACHE"] = "off"
+        _, cold = post(server, "/api/vrp/sa", near)
+        assert (
+            warm["message"]["durationSum"]
+            <= cold["message"]["durationSum"] + 1e-6
+        )
+
+
+# ---------------------------------------------------------------------------
+# HTTP: one warm-start code path through the family index
+# ---------------------------------------------------------------------------
+
+
+class TestWarmStartViaIndex:
+    def test_explicit_warmstart_served_from_index(self, server):
+        b = vrp_body(iterationCount=200, auth="tok-alice")
+        post(server, "/api/vrp/sa", b)
+        # kill the legacy checkpoint row: the ONLY remaining source is
+        # the fingerprint/family index — the keyed read must still warm
+        mem._tables["warmstarts"].clear()
+        warm0 = obs.CACHE_LOOKUPS.labels(outcome="warm").value
+        _, r = post(
+            server, "/api/vrp/sa", dict(b, warmStart=True, includeStats=True)
+        )
+        assert r["message"]["stats"]["warmStart"] is True
+        assert r["message"]["stats"]["cache"]["lookup"] == "warm"
+        assert obs.CACHE_LOOKUPS.labels(outcome="warm").value == warm0 + 1
+
+    def test_cold_index_falls_back_to_checkpoint(self, server):
+        b = vrp_body(iterationCount=200, auth="tok-alice")
+        post(server, "/api/vrp/sa", b)
+        # inverse: evicted/cold family index, surviving checkpoint row
+        mem._tables["solution_cache"].clear()
+        _, r = post(
+            server, "/api/vrp/sa", dict(b, warmStart=True, includeStats=True)
+        )
+        assert r["message"]["stats"]["warmStart"] is True
+
+
+# ---------------------------------------------------------------------------
+# Unit: repair over the separator encoding
+# ---------------------------------------------------------------------------
+
+
+class TestRepairPerm:
+    class _Prep:
+        def __init__(self, ids, durations):
+            self.orig_ids = ids
+            self.inst = type("I", (), {"durations": durations})()
+
+    def test_strip_keeps_relative_order(self):
+        # cached routes over original ids 10,20,30,40; request drops 30
+        d = np.ones((1, 5, 5), dtype=np.float32)
+        prep = self._Prep([0, 10, 20, 40], d)
+        got = solution_cache._repair_perm(prep, [[40, 30], [20, 10]])
+        assert np.asarray(got).tolist() == [3, 2, 1]
+
+    def test_insert_places_new_customer_cheapest(self):
+        # depot (0,0) -> 1 (1,0) -> 2 (2,0); new customer 3 at (2,1)
+        # is cheapest appended after 2, not wedged before it
+        pts = np.array([[0.0, 0.0], [1.0, 0.0], [2.0, 0.0], [2.0, 1.0]])
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        d = d[None, :, :].astype(np.float32)
+        prep = self._Prep([0, 1, 2, 3], d)
+        got = solution_cache._repair_perm(prep, [[1, 2]])
+        assert np.asarray(got).tolist() == [1, 2, 3]
+
+    def test_nothing_survives_declines_to_seed(self):
+        d = np.ones((1, 3, 3), dtype=np.float32)
+        prep = self._Prep([0, 7, 8], d)
+        assert solution_cache._repair_perm(prep, [[99]]) is None
+
+
+# ---------------------------------------------------------------------------
+# Containment: a cache problem degrades to solving, never to failing
+# ---------------------------------------------------------------------------
+
+
+class TestContainment:
+    def test_corrupt_row_degrades_to_solving(self, server):
+        # store I/O errors are contained at the seam; a malformed entry
+        # DOCUMENT (migration script, truncated jsonb) raises above it
+        # and attach() must degrade that to a normal solve, never a 400
+        b = vrp_body(iterationCount=150)
+        s1, r1 = post(server, "/api/vrp/sa", b)
+        assert s1 == 200
+        for row in mem._tables["solution_cache"].values():
+            row["entry"] = ["not", "a", "document"]
+        s2, r2 = post(server, "/api/vrp/sa", b)
+        assert s2 == 200, r2
+        assert r2["message"]["cacheHit"] is False  # solved for real
+        assert json.dumps(strip_hit(r1["message"]), sort_keys=True) == json.dumps(
+            strip_hit(r2["message"]), sort_keys=True
+        )
+
+    def test_junk_customers_degrade_to_solving(self, server):
+        # unhashable members poison the near-hit set arithmetic; the
+        # request must fall back to an unseeded solve of the right set
+        post(server, "/api/vrp/sa", vrp_body(iterationCount=150))
+        for row in mem._tables["solution_cache"].values():
+            row["entry"]["customers"] = [["un"], ["hashable"]]
+        s, r = post(
+            server,
+            "/api/vrp/sa",
+            vrp_body(iterationCount=150, completedCustomers=[2]),
+        )
+        assert s == 200, r
+        assert r["message"]["cacheHit"] is False
+        assert served_customers(r["message"]) == [1, 3, 4, 5, 6]
+
+
+class TestNonIntegerIds:
+    def test_string_ids_cache_and_hit(self, server):
+        # the schema doc says int ids but nothing validates; pre-cache
+        # the service accepted any id type, so the cache keys must too
+        rng = np.random.default_rng(5)
+        pts = rng.uniform(0, 100, size=(5, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        mem.seed_locations(
+            "locs_str",
+            [
+                {"id": f"loc-{i}" if i else "depot", "demand": 2 if i else 0}
+                for i in range(5)
+            ],
+        )
+        mem.seed_durations("durs_str", d.tolist())
+        b = vrp_body(
+            locationsKey="locs_str",
+            durationsKey="durs_str",
+            capacities=[8, 8],
+            startTimes=[0, 0],
+            iterationCount=150,
+        )
+        s1, r1 = post(server, "/api/vrp/sa", b)
+        assert s1 == 200, r1
+        assert r1["message"]["cacheHit"] is False
+        s2, r2 = post(server, "/api/vrp/sa", b)
+        assert s2 == 200 and r2["message"]["cacheHit"] is True
+        assert json.dumps(strip_hit(r1["message"]), sort_keys=True) == json.dumps(
+            strip_hit(r2["message"]), sort_keys=True
+        )
+
+    def test_off_flip_skips_write_without_mass_evict(self):
+        # VRPMS_CACHE flips to off between a request's attach and its
+        # finish: the late write must be skipped, not clamp the cap to
+        # 1 and evict every existing entry
+        os.environ["VRPMS_CACHE"] = "8"
+        mem.reset()
+        db = mem.InMemoryDatabaseVRP(None)
+        for i in range(4):
+            db.put_cached_solution(f"k{i}", "famA", {"cost": float(i)})
+        os.environ["VRPMS_CACHE"] = "off"
+        before = obs.CACHE_EVICTIONS.value
+        db.put_cached_solution("k-late", "famA", {"cost": 9.0})
+        assert len(mem._tables["solution_cache"]) == 4
+        assert "k-late" not in mem._tables["solution_cache"]
+        assert obs.CACHE_EVICTIONS.value == before
+
+
+class TestSingleDeadline:
+    def test_first_failure_disables_cache_for_the_request(self):
+        # a hung/failing cache store must cost a request at most ONE
+        # call before the instance-level latch sheds the rest — not one
+        # deadline per lookup step (exact read, family scan, hydration)
+        calls = []
+
+        class _Failing(mem.InMemoryDatabaseVRP):
+            def _fetch_cached_solution(self, key):
+                calls.append("exact")
+                raise RuntimeError("store hang")
+
+            def _fetch_cache_family(self, family):
+                calls.append("family")
+                raise RuntimeError("store hang")
+
+            def _upsert_cached_solution(self, key, family, entry):
+                calls.append("write")
+                raise RuntimeError("store hang")
+
+        db = _Failing(None)
+        assert db.get_cached_solution("k") is None
+        assert db.get_cache_family("fam") == []
+        assert db.put_cached_solution("k", "fam", {}) is False
+        assert calls == ["exact"]  # only the first call reached the store
+        # a fresh instance (the next request) tries again
+        assert db.__class__(None).get_cache_family("fam") == []
+        assert calls == ["exact", "family"]

@@ -233,6 +233,28 @@ class TestDegradedServing:
         status, resp = get(server, "/api/ready")
         assert status == 200 and resp["status"] == "ok", resp
 
+    def test_cached_instances_still_solve_when_store_down(self, server):
+        # ISSUE 6: a cache-store outage degrades to SOLVING, never to
+        # failing — previously-cached instances lose their fast path
+        # (`cacheHit: false`) but 100% of requests are served
+        warm = warm_cache(server)
+        # healthy: the repeat is an exact hit
+        status, resp = post(server, "/api/vrp/sa", body())
+        assert status == 200 and resp["message"]["cacheHit"] is True, resp
+        os.environ["VRPMS_STORE"] = "faulty:down"
+        for _ in range(3):
+            status, resp = post(server, "/api/vrp/sa", body())
+            assert status == 200, resp
+            msg = resp["message"]
+            # the cache lookup failed fast under the breaker: the solve
+            # ran for real and the response says so honestly
+            assert msg["cacheHit"] is False
+            assert msg.get("degraded") is True
+            assert_valid_vrp(msg)
+            assert msg["durationSum"] == pytest.approx(
+                warm["message"]["durationSum"]
+            )
+
     def test_metrics_expose_resilience_series(self, server):
         warm_cache(server)
         os.environ["VRPMS_STORE"] = "faulty:down"

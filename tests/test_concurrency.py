@@ -52,6 +52,22 @@ def seeded():
     yield
 
 
+@pytest.fixture
+def no_cache():
+    """Disable the solution cache: the serial ground-truth round would
+    otherwise warm it and turn the concurrent round into exact hits —
+    correct, but no longer exercising concurrent SOLVES."""
+    import os
+
+    saved = os.environ.get("VRPMS_CACHE")
+    os.environ["VRPMS_CACHE"] = "off"
+    yield
+    if saved is None:
+        os.environ.pop("VRPMS_CACHE", None)
+    else:
+        os.environ["VRPMS_CACHE"] = saved
+
+
 def post(base, path, body):
     req = urllib.request.Request(
         base + path,
@@ -116,7 +132,7 @@ REQUESTS = [
 
 
 class TestConcurrentRequests:
-    def test_parallel_posts_match_serial_results(self, server):
+    def test_parallel_posts_match_serial_results(self, server, no_cache):
         # serial ground truth first (also pre-compiles every shape, so
         # the concurrent round exercises dispatch, not compile races)
         serial = [post(server, path, body) for path, body in REQUESTS]

@@ -235,9 +235,10 @@ class TestVRPSolve:
         assert status == 200, resp
         assert resp["success"] is True
         msg = resp["message"]
-        # the exact endpoint ADDS its proof certificate (round 5); the
-        # reference keys stay byte-identical
-        want = {"durationMax", "durationSum", "vehicles"}
+        # the exact endpoint ADDS its proof certificate (round 5) and
+        # the solution cache its hit marker (round 6); the reference
+        # keys stay byte-identical
+        want = {"durationMax", "durationSum", "vehicles", "cacheHit"}
         if route.endswith("/bf"):
             want = want | {"exact"}
         assert set(msg) == want
@@ -699,7 +700,7 @@ class TestTSPSolve:
         status, resp = post(server, route, tsp_body())
         assert status == 200, resp
         msg = resp["message"]
-        want = {"duration", "vehicle"}
+        want = {"duration", "vehicle", "cacheHit"}
         if route.endswith("/bf"):
             want = want | {"exact"}  # additive proof certificate (round 5)
         assert set(msg) == want
@@ -1018,7 +1019,7 @@ class TestObservabilitySolve:
         )
         assert status == 200, with_stats
         assert set(plain["message"]) == {
-            "durationMax", "durationSum", "vehicles"
+            "durationMax", "durationSum", "vehicles", "cacheHit"
         }
         stripped = dict(with_stats["message"])
         del stripped["stats"]

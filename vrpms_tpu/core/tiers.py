@@ -53,6 +53,7 @@ Env:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import os
 import threading
 
@@ -162,6 +163,50 @@ def tier_key(inst: Instance) -> tuple:
         int(inst.td_rank),
         float(inst.slice_minutes),
     )
+
+
+def fingerprint(inst: Instance) -> str:
+    """Content address of an instance: SHA-256 over every tensor's
+    canonical float32 bytes (shape-tagged) plus the non-tensor metadata.
+
+    Run on the PADDED instance this is the equal-instance detector the
+    solution cache keys on: tier padding canonicalizes shape, so two
+    requests for the same city/depot/customer set produce bit-identical
+    padded tensors and therefore identical fingerprints, while any
+    change to a duration, demand, window, fleet, or time profile changes
+    the hash. Host-side (pulls the arrays off device once); the cost is
+    one sha256 pass over the tier tensors — microseconds next to a
+    solve, and comparable to the store read it gates.
+    """
+    h = hashlib.sha256()
+
+    def _update(tag: str, arr) -> None:
+        a = np.asarray(arr, dtype=np.float32)
+        h.update(tag.encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+
+    _update("durations", inst.durations)
+    _update("demands", inst.demands)
+    _update("capacities", inst.capacities)
+    _update("ready", inst.ready)
+    _update("due", inst.due)
+    _update("service", inst.service)
+    _update("start_times", inst.start_times)
+    if inst.td_rank > 0:
+        _update("td_factors", inst.td_factors)
+        _update("td_basis", inst.td_basis)
+    meta = (
+        int(inst.n_vehicles),
+        bool(inst.has_tw),
+        bool(inst.het_fleet),
+        int(inst.td_rank),
+        float(inst.slice_minutes),
+        -1 if inst.n_real is None else int(inst.n_real),
+        -1 if inst.v_real is None else int(inst.v_real),
+    )
+    h.update(repr(meta).encode())
+    return h.hexdigest()
 
 
 def pad_instance(inst: Instance, lad: TierLadder | None = None) -> Instance:
