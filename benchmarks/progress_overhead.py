@@ -1,0 +1,134 @@
+"""Live-progress overhead micro-check: sink attached vs not.
+
+    python -m benchmarks.progress_overhead [--reps 11] [--iters 4096]
+                                           [--customers 100] [--chains 64]
+
+The live-progress subsystem's acceptance bar (ISSUE 7): always-on
+progress recording — a ProgressSink attached for the whole solve,
+publishing the synced incumbent at every improving block boundary —
+must cost < 1% of solve wall time. Measured on the block-cadence path
+the production scheduler actually runs (a generous deadline engages
+run_blocked's timed loop, so the solve crosses many 512-iteration
+block boundaries and the sink is exercised at full cadence, while the
+iteration budget — not the clock — bounds the work, keeping wall time
+comparable across the pair).
+
+Same paired design as benchmarks/obs_overhead.py: each rep solves the
+SAME seed once per sink state in alternating within-pair order, and
+the estimator is the median per-pair relative delta. Prints one JSON
+line on stdout (bench.py convention); diagnostics to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def build_instance(n_customers: int, seed: int = 0):
+    import numpy as np
+
+    from vrpms_tpu.core import make_instance
+
+    rng = np.random.default_rng(seed)
+    n = n_customers + 1
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    n_vehicles = max(2, n_customers // 10)
+    cap = 2.0 * n_customers / n_vehicles * 1.3
+    return make_instance(
+        d,
+        demands=[0.0] + [2.0] * n_customers,
+        capacities=[cap] * n_vehicles,
+    )
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--reps", type=int, default=11,
+                        help="measured solve pairs (one per sink state); "
+                        "sub-percent deltas need many pairs on a noisy "
+                        "shared host")
+    parser.add_argument("--iters", type=int, default=4096,
+                        help="SA iterations (>= several 512-blocks)")
+    parser.add_argument("--customers", type=int, default=100)
+    parser.add_argument("--chains", type=int, default=64)
+    args = parser.parse_args()
+
+    os.environ["VRPMS_LOG"] = "off"  # isolate the progress delta
+    import jax
+
+    from vrpms_tpu.io.bounds import quick_lower_bound
+    from vrpms_tpu.obs import progress
+    from vrpms_tpu.solvers import SAParams, solve_sa
+
+    inst = build_instance(args.customers)
+    lb = quick_lower_bound(inst)
+    params = SAParams(n_chains=args.chains, n_iters=args.iters)
+
+    def one_solve(seed: int, with_sink: bool) -> tuple[float, int]:
+        sink = (
+            progress.ProgressSink(
+                job_id="bench", problem="vrp", algorithm="sa",
+                lower_bound=lb,
+            )
+            if with_sink
+            else None
+        )
+        ctx = progress.attach(sink) if with_sink else contextlib.nullcontext()
+        t0 = time.perf_counter()
+        with ctx:
+            res = solve_sa(inst, key=seed, params=params, deadline_s=3600.0)
+        jax.block_until_ready(res.cost)
+        elapsed = (time.perf_counter() - t0) * 1e3
+        blocks = 0
+        if sink is not None:
+            prof = sink.profile()
+            blocks = 0 if prof is None else prof["blocks"]
+        return elapsed, blocks
+
+    print(
+        f"[progress_overhead] warmup solve ({args.customers} customers, "
+        f"{args.chains}x{args.iters})",
+        file=sys.stderr,
+    )
+    one_solve(0, True)  # compile + seed the sweep-rate cache
+
+    on_ms, off_ms, blocks_seen = [], [], 0
+    for rep in range(args.reps):
+        pair = ((True, on_ms), (False, off_ms))
+        if rep % 2:
+            pair = pair[::-1]
+        for with_sink, bucket in pair:
+            elapsed, blocks = one_solve(rep + 1, with_sink)
+            bucket.append(elapsed)
+            blocks_seen = max(blocks_seen, blocks)
+
+    overhead_pct = 100.0 * statistics.median(
+        (on - off) / off for on, off in zip(on_ms, off_ms)
+    )
+    line = {
+        "bench": "progress_overhead",
+        "customers": args.customers,
+        "chains": args.chains,
+        "iters": args.iters,
+        "reps": args.reps,
+        "blocks_per_solve": blocks_seen,
+        "lower_bound": None if lb is None else round(lb, 1),
+        "solve_ms_sink_on": round(statistics.median(on_ms), 2),
+        "solve_ms_sink_off": round(statistics.median(off_ms), 2),
+        "overhead_pct": round(overhead_pct, 3),
+        # negative deltas are timing noise; the bar is one-sided
+        "pass": overhead_pct < 1.0,
+    }
+    print(json.dumps(line))
+    return 0 if line["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

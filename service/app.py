@@ -26,6 +26,7 @@ from service.debug import TraceDetailHandler, TracesHandler
 from service.jobs import (
     JobsHandler,
     JobStatusHandler,
+    JobStreamHandler,
     ReadyHandler,
     shutdown_scheduler,
 )
@@ -71,8 +72,13 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         cls = ROUTES.get(path)
         if cls is None and path.startswith("/api/jobs/"):
-            # parameterized route: /api/jobs/{id} status polls
-            cls = JobStatusHandler
+            # parameterized routes: /api/jobs/{id} status polls and
+            # cancels, /api/jobs/{id}/stream live SSE progress
+            cls = (
+                JobStreamHandler
+                if path.endswith("/stream")
+                else JobStatusHandler
+            )
         if cls is None and path.startswith("/api/debug/traces/"):
             # parameterized route: /api/debug/traces/{traceId}
             cls = TraceDetailHandler
@@ -97,6 +103,12 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
 
     def do_POST(self):
         self._dispatch("POST")
+
+    def do_DELETE(self):
+        # today only /api/jobs/{id} accepts DELETE (cooperative job
+        # cancellation); everything else answers 501 via the method
+        # check in _dispatch
+        self._dispatch("DELETE")
 
     def do_OPTIONS(self):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"

@@ -29,6 +29,7 @@ VRPMS_SCHED_QUEUE (admission bound, default 64), VRPMS_SCHED_WINDOW_MS
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -68,11 +69,20 @@ from service.solve import (
 from vrpms_tpu.obs import (
     current_request_id,
     log_event,
+    progress,
     reset_request_id,
     set_request_id,
     spans,
 )
-from vrpms_tpu.sched import DONE, FAILED, Job, QueueFull, Scheduler
+from vrpms_tpu.sched import (
+    DONE,
+    FAILED,
+    QUEUED,
+    RUNNING,
+    Job,
+    QueueFull,
+    Scheduler,
+)
 
 _PARSERS = {
     ("vrp", "ga"): (parse_common_vrp_parameters, parse_vrp_ga_parameters),
@@ -175,6 +185,62 @@ def _job_time_limit(opts):
 
 
 # ---------------------------------------------------------------------------
+# Live-job registry + progress sinks
+# ---------------------------------------------------------------------------
+# GET /api/jobs/{id} during a solve must read the LIVE incumbent (the
+# store record only updates at lifecycle transitions — persisting every
+# block would put a store write on the device loop), and DELETE /
+# /stream need the in-flight Job object. This registry is the
+# in-process index: jobs enter at async submit and leave at their
+# terminal transition. It is per-replica by design — the persisted
+# record (with the final incumbent + convergence profile) is the
+# cross-replica view.
+
+_live_lock = threading.Lock()
+_live_jobs: dict[str, Job] = {}
+
+
+def _register_live(job: Job) -> None:
+    with _live_lock:
+        _live_jobs[job.id] = job
+
+
+def _drop_live(job_id: str) -> None:
+    with _live_lock:
+        _live_jobs.pop(job_id, None)
+
+
+def get_live_job(job_id: str) -> Job | None:
+    """The in-flight Job for this id, if this process owns it."""
+    with _live_lock:
+        return _live_jobs.get(job_id)
+
+
+def _running_count() -> int:
+    with _live_lock:
+        return sum(1 for j in _live_jobs.values() if j.status == RUNNING)
+
+
+def _attach_sink(job: Job, prep: Prepared) -> None:
+    """Give an async job its live-progress mailbox (VRPMS_PROGRESS=on,
+    the default). The quick lower bound is computed HERE, on the submit
+    thread — milliseconds of host numpy, never on the device loop — so
+    every snapshot can carry a gap. With progress off the job carries
+    no sink and the solve path is byte-identical to the pre-progress
+    contract."""
+    if not progress.enabled() or prep is None or prep.inst is None:
+        return
+    from vrpms_tpu.io.bounds import quick_lower_bound
+
+    job.sink = progress.ProgressSink(
+        job_id=job.id,
+        problem=prep.problem,
+        algorithm=prep.algorithm,
+        lower_bound=quick_lower_bound(prep.inst),
+    )
+
+
+# ---------------------------------------------------------------------------
 # The runner (worker-thread side)
 # ---------------------------------------------------------------------------
 
@@ -253,8 +319,13 @@ def _run_solo(job: Job) -> None:
     token = set_request_id(job.request_id)
     span_tokens = _activate_job_context(job)
     try:
-        with spans.span("solve", **_solve_span_attrs(job)):
-            job.result = solve_prepared(prep, errors)
+        # the sink rides the contextvar through the solve so the
+        # deadline drivers publish each block's incumbent to it (and
+        # honor a pending cancel between blocks)
+        with progress.attach(job.sink):
+            with spans.span("solve", **_solve_span_attrs(job)):
+                job.result = solve_prepared(prep, errors)
+        _mark_cancelled(job)
         _inject_span_stats(job)
     except Exception as e:  # solve_prepared's own envelope paths missed
         log_event(
@@ -274,6 +345,21 @@ def _run_solo(job: Job) -> None:
         job.errors = errors or [
             {"what": "Solver error", "reason": "solve returned no result"}
         ]
+
+
+def _mark_cancelled(job: Job) -> None:
+    """A cooperatively-cancelled solve still returns its incumbent —
+    the contract marks it so the client knows the budget was cut short
+    by its own DELETE, not exhausted. Gated on the driver having
+    ACKNOWLEDGED the cancel at a boundary: a deadline-free single-block
+    solve has no boundary left once launched, runs its full budget, and
+    must not claim it was cut short."""
+    if (
+        job.sink is not None
+        and job.sink.cancel_acknowledged
+        and isinstance(job.result, dict)
+    ):
+        job.result["cancelled"] = True
 
 
 def _run_batched(jobs: list[Job]) -> None:
@@ -312,9 +398,22 @@ def _run_batched(jobs: list[Job]) -> None:
         solve_spans.append(s)
     t0 = time.perf_counter()
     try:
-        results = solve_sa_batch(
-            [p.inst for p in preps], seeds, params=params, deadline_s=deadline
-        )
+        # per-job sinks behind ONE contextvar slot: the fanout splits
+        # each synced [K, B] best row to its job's sink, and reports
+        # cancelled only when every member job cancelled (one job's
+        # DELETE must not cut its batch-mates' budget). No member with
+        # a sink (VRPMS_PROGRESS=off) -> attach nothing, keeping the
+        # off switch's no-extra-host-work contract on the fast path
+        sinks = [j.sink for j in jobs]
+        with progress.attach(
+            progress.ProgressFanout(sinks)
+            if any(s is not None for s in sinks)
+            else None
+        ):
+            results = solve_sa_batch(
+                [p.inst for p in preps], seeds, params=params,
+                deadline_s=deadline,
+            )
     except BaseException:
         # the batch-fallback path (_runner) will re-run each job solo
         # with a fresh solve span; this attempt's spans must terminate
@@ -341,6 +440,7 @@ def _run_batched(jobs: list[Job]) -> None:
                 job.result = finish_vrp(prep, res, None, {}, errors)
             else:
                 job.result = finish_tsp(prep, res, None, {}, errors)
+            _mark_cancelled(job)
         except Exception as e:
             log_event(
                 "solve.exception",
@@ -428,6 +528,19 @@ def _job_record(job: Job) -> dict:
         "requestId": job.request_id,
         "traceId": job.trace.trace_id if job.trace is not None else None,
     }
+    if job.sink is not None:
+        snap = job.sink.snapshot()
+        if snap is not None:
+            # latest incumbent: cost monotone non-increasing across
+            # polls by sink construction
+            rec["incumbent"] = snap
+        if job.status in (DONE, FAILED):
+            # terminal: the convergence profile (every improving
+            # snapshot, bounded) persists with the record so the
+            # post-hoc view survives this process
+            prof = job.sink.profile()
+            if prof is not None:
+                rec["progress"] = prof
     if job.status == DONE:
         rec["message"] = job.result
     if job.status == FAILED:
@@ -532,6 +645,14 @@ def _on_event(name: str, job: Job) -> None:
         # the record's stale 'running' is true enough: the retry is
         # about to run it again
         _persist(job)
+    if terminal:
+        # wake every stream waiter AFTER the terminal persist: a
+        # reader woken by the close may poll GET /api/jobs/{id}
+        # immediately and must find the terminal record, not the stale
+        # 'running' one; then drop the live-registry entry
+        if job.sink is not None:
+            job.sink.close("done" if name == "done" else "failed")
+        _drop_live(job.id)
 
 
 def _on_worker_event(name: str, backend: str, reason: str) -> None:
@@ -759,17 +880,25 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
                 "success": True, "jobId": job.id, "status": job.status,
             })
             return
-        _persist(job)  # queued record first: a poll can never 404 a
-        # job whose id was already returned
-        if self._trace is not None:
-            # the 202 leaves now; the worker finishes the trace at the
-            # job's terminal transition (service._on_event)
-            self._trace.deferred = True
+        # live-progress mailbox + registry entry BEFORE the submit: the
+        # worker may pop the job the instant it lands, and the runner
+        # reads job.sink then
+        _attach_sink(job, prep)
+        _register_live(job)
         try:
+            _persist(job)  # queued record first: a poll can never 404
+            # a job whose id was already returned
+            if self._trace is not None:
+                # the 202 leaves now; the worker finishes the trace at
+                # the job's terminal transition (service._on_event)
+                self._trace.deferred = True
             get_scheduler().submit(job, backend=_backend_label(opts))
         except QueueFull as e:
             if self._trace is not None:
                 self._trace.deferred = False  # never scheduled: ours again
+            if job.sink is not None:
+                job.sink.close("failed")
+            _drop_live(job.id)
             obs.SCHED_REJECTS.labels(reason="queue_full").inc()
             obs.JOBS_TOTAL.labels(outcome="failed").inc()
             job.errors = [{
@@ -780,9 +909,59 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             _persist(job)
             too_busy(self, e.retry_after_s)
             return
+        except BaseException:
+            # any other submit-path failure: the job will never run —
+            # a leaked registry entry would hold the prepared instance
+            # forever and answer DELETEs 202 for a ghost
+            if self._trace is not None:
+                self._trace.deferred = False
+            if job.sink is not None:
+                job.sink.close("failed")
+            _drop_live(job.id)
+            raise
         _respond(self, 202, {
             "success": True, "jobId": job.id, "status": job.status,
         })
+
+
+def _job_id_from_path(path: str) -> str:
+    """The {id} segment of /api/jobs/{id}[/stream] — the ONE parser
+    every per-job handler uses."""
+    parts = [p for p in path.split("?", 1)[0].rstrip("/").split("/") if p]
+    if parts and parts[-1] == "stream":
+        parts = parts[:-1]
+    return parts[-1] if parts else ""
+
+
+def _load_job_record(handler, job_id: str) -> dict | None:
+    """Fetch a job's persisted record for an HTTP handler — the ONE
+    store-read + error-envelope ladder behind the status poll, the
+    cancel, and the stream. Writes the Database-error / 400 / 404
+    envelope itself and returns None when it already responded; flags
+    degraded reads on `handler._job_db_degraded`."""
+    errors: list = []
+    try:
+        db = store.get_database("vrp", None)
+        with spans.span("store.read", tables="jobs"):
+            record = db.get_job(job_id, errors)
+        handler._job_db_degraded = getattr(db, "degraded", False)
+    except Exception as e:
+        fail(handler, [{"what": "Database error", "reason": str(e)}])
+        return None
+    if errors:
+        fail(handler, errors)
+        return None
+    if record is None:
+        handler._obs_errors = ["Not found"]
+        _respond(handler, 404, {
+            "success": False,
+            "errors": [{
+                "what": "Not found",
+                "reason": f"no job with id {job_id!r}",
+            }],
+        })
+        return None
+    return record
 
 
 class JobStatusHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
@@ -798,34 +977,223 @@ class JobStatusHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             obs.end_request_obs(self)
 
     def _status(self):
-        job_id = self.path.split("?", 1)[0].rstrip("/").rsplit("/", 1)[-1]
-        errors: list = []
-        try:
-            db = store.get_database("vrp", None)
-            with spans.span("store.read", tables="jobs"):
-                record = db.get_job(job_id, errors)
-        except Exception as e:
-            fail(self, [{"what": "Database error", "reason": str(e)}])
-            return
-        if errors:
-            fail(self, errors)
-            return
+        job_id = _job_id_from_path(self.path)
+        record = _load_job_record(self, job_id)
         if record is None:
-            self._obs_errors = ["Not found"]
-            _respond(self, 404, {
-                "success": False,
-                "errors": [{
-                    "what": "Not found",
-                    "reason": f"no job with id {job_id!r}",
-                }],
-            })
             return
+        live = get_live_job(job_id)
+        if live is not None:
+            # the store record only updates at lifecycle transitions —
+            # overlay the live view, COPYING (the memory store hands
+            # out its live row). The status overlays only while
+            # PRE-terminal: a live job that just turned done has its
+            # message/errors in the terminal persist, and handing out
+            # status='done' off a stale 'running' record would end a
+            # client's poll loop without the result.
+            overlay: dict = {}
+            if live.status in (QUEUED, RUNNING):
+                overlay["status"] = live.status
+            snap = live.sink.snapshot() if live.sink is not None else None
+            if snap is not None:
+                overlay["incumbent"] = snap
+            if overlay:
+                record = dict(record, **overlay)
         payload = {"success": True, "job": record}
-        if getattr(db, "degraded", False):
+        if self._job_db_degraded:
             # the record came from the degraded-mode fallback (possibly
             # stale last-known state), not an authoritative store read
             payload["degraded"] = True
         _respond(self, 200, payload)
+
+    def do_DELETE(self):
+        """DELETE /api/jobs/{id} — cooperative cancellation: flags the
+        job's sink; the deadline driver stops at the next block
+        boundary and the job completes with its incumbent marked
+        `cancelled: true`. Boundary-granular by design: a deadline-free
+        solve runs as ONE device block, so a cancel landing mid-block
+        runs out its budget and the (complete) result is NOT marked
+        cancelled — the 202 records the request, the mark records that
+        a driver actually stopped for it."""
+        obs.begin_request_obs(self, sample="header")
+        try:
+            self._cancel()
+        finally:
+            obs.end_request_obs(self)
+
+    def _cancel(self):
+        job_id = _job_id_from_path(self.path)
+        job = get_live_job(job_id)
+        if job is not None and not job.done_event.is_set():
+            if job.sink is None:
+                self._obs_errors = ["Not cancellable"]
+                _respond(self, 409, {
+                    "success": False,
+                    "errors": [{
+                        "what": "Not cancellable",
+                        "reason": "job carries no progress sink "
+                        "(VRPMS_PROGRESS=off); it will run to completion",
+                    }],
+                })
+                return
+            job.sink.cancel()
+            log_event("job.cancel_requested", jobId=job_id,
+                      status=job.status)
+            _respond(self, 202, {
+                "success": True, "jobId": job_id, "status": job.status,
+                "cancelRequested": True,
+            })
+            return
+        # not live here: either already terminal (answer the record —
+        # cancelling a finished job is a no-op, not an error) or unknown
+        record = _load_job_record(self, job_id)
+        if record is None:
+            return
+        _respond(self, 200, {
+            "success": True, "job": record, "cancelRequested": False,
+        })
+
+
+class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/jobs/{id}/stream — Server-Sent Events of the live solve.
+
+    Event protocol (SSE framing, one `event:` + one `data:` JSON line):
+
+      * `progress` — an improving incumbent snapshot
+        {block, wallMs, bestCost, gap, evals}; emitted once per
+        improvement the sink publishes (the current incumbent is
+        replayed first on connect, so a late subscriber starts from
+        the latest state, never from silence);
+      * `done` / `failed` — the terminal job record (same shape as
+        GET /api/jobs/{id}); the stream closes after it;
+      * `timeout` — the stream outlived VRPMS_STREAM_TIMEOUT_S
+        (default 600 s) with the job still running; reconnect to
+        resume (the replay-first rule makes that lossless for the
+        incumbent).
+
+    Keep-alive comment lines (`: keep-alive`) go out during quiet
+    waits so a dead client surfaces as a write error — the handler
+    logs `stream.disconnect` and returns; a mid-stream disconnect
+    never touches the solve."""
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            self._stream()
+        finally:
+            obs.end_request_obs(self)
+
+    def _stream(self):
+        job_id = _job_id_from_path(self.path)
+        job = get_live_job(job_id)
+        record = None
+        if job is None:
+            record = _load_job_record(self, job_id)
+            if record is None:
+                return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream; charset=utf-8")
+        self.send_header("Cache-Control", "no-cache")
+        from service.helpers import send_static_headers
+
+        send_static_headers(self)
+        self.end_headers()
+        try:
+            if job is None:
+                self._follow_record(job_id, record)
+                return
+            self._follow(job)
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            # client went away mid-stream; the solve is unaffected
+            log_event(
+                "stream.disconnect", jobId=job_id,
+                error=f"{type(e).__name__}: {e}",
+            )
+
+    def _emit(self, name: str, payload: dict) -> None:
+        self.wfile.write(
+            f"event: {name}\ndata: {json.dumps(payload)}\n\n".encode("utf-8")
+        )
+        self.wfile.flush()
+
+    def _follow_record(self, job_id: str, record: dict) -> None:
+        """Stream a job this process does NOT own (another replica's, or
+        one predating a restart of this one): no live sink exists, so
+        follow the persisted record — terminal already means one
+        terminal event now; otherwise poll the store at a gentle cadence
+        until it turns terminal, emitting its incumbent snapshots as
+        they land. A non-terminal record must NEVER be reported as
+        `failed`: the job is healthy, just not ours."""
+        timeout_s = float(os.environ.get("VRPMS_STREAM_TIMEOUT_S", "600"))
+        deadline = time.monotonic() + timeout_s
+        last_block = None
+        while True:
+            status = record.get("status")
+            snap = record.get("incumbent")
+            if snap is not None and snap.get("block") != last_block:
+                last_block = snap.get("block")
+                self._emit("progress", snap)
+            if status in ("done", "failed"):
+                self._emit("done" if status == "done" else "failed", record)
+                return
+            if time.monotonic() >= deadline:
+                self._emit("timeout", {"jobId": job_id})
+                return
+            self.wfile.write(b": keep-alive\n\n")
+            self.wfile.flush()
+            time.sleep(2.0)
+            errors: list = []
+            try:
+                db = store.get_database("vrp", None)
+                fresh = db.get_job(job_id, errors)
+            except Exception:
+                fresh = None
+            if fresh is not None and not errors:
+                record = fresh
+
+    def _follow(self, job: Job) -> None:
+        timeout_s = float(os.environ.get("VRPMS_STREAM_TIMEOUT_S", "600"))
+        deadline = time.monotonic() + timeout_s
+        sink = job.sink
+        if sink is None:
+            # progress off: only the terminal event exists — park on
+            # the job's own done event, heartbeating so disconnects
+            # surface
+            while not job.done_event.wait(timeout=15.0):
+                if time.monotonic() >= deadline:
+                    self._emit("timeout", {"jobId": job.id})
+                    return
+                self.wfile.write(b": keep-alive\n\n")
+                self.wfile.flush()
+            self._emit_terminal(job)
+            return
+        seen, last_block = 0, None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._emit("timeout", {"jobId": job.id})
+                return
+            seq, snap, closed = sink.wait_progress(
+                seen, timeout=min(15.0, remaining)
+            )
+            if snap is not None and snap.get("block") != last_block:
+                last_block = snap.get("block")
+                self._emit("progress", snap)
+            if closed:
+                self._emit_terminal(job)
+                return
+            if seq == seen:
+                # quiet wait elapsed with no movement: heartbeat
+                self.wfile.write(b": keep-alive\n\n")
+                self.wfile.flush()
+            seen = seq
+
+    def _emit_terminal(self, job: Job) -> None:
+        # the live Job is authoritative here (the terminal store
+        # persist may still be in flight when the close wakes us)
+        job.wait(timeout=30.0)
+        self._emit(
+            "done" if job.status == DONE else "failed", _job_record(job)
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -903,3 +1271,8 @@ class ReadyHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             _respond(self, code, dict(body, success=code == 200))
         finally:
             obs.end_request_obs(self)
+
+
+# scrape-time vrpms_jobs_running comes from the live registry (the
+# same pattern as the queue-depth provider above)
+obs.set_jobs_running_provider(_running_count)

@@ -96,6 +96,23 @@ JOBS_TOTAL = REGISTRY.counter(
     "Scheduler jobs reaching a terminal state, by outcome (done|failed)",
     labels=("outcome",),
 )
+JOBS_RUNNING = REGISTRY.gauge(
+    "vrpms_jobs_running",
+    "Async jobs currently executing on a device worker (live in-process "
+    "view); refreshed per scrape",
+)
+INCUMBENT_GAP = REGISTRY.gauge(
+    "vrpms_incumbent_gap",
+    "Last published incumbent gap vs the instance's quick lower bound "
+    "(io.bounds.quick_lower_bound), per job class — the live answer to "
+    "'how good are the solutions we are currently shipping'",
+    labels=("problem", "algorithm"),
+)
+PROGRESS_EVENTS = REGISTRY.counter(
+    "vrpms_progress_events_total",
+    "Incumbent progress snapshots published by running solves "
+    "(improving block boundaries; the SSE stream's event source)",
+)
 JOBS_FAILED = REGISTRY.counter(
     "vrpms_jobs_failed_total",
     "Job failures by cause (runner = runner exception, crash = worker "
@@ -227,6 +244,7 @@ def set_compile_cache(cache_dir) -> None:
 
 
 _queue_depths = None
+_jobs_running = None
 
 
 def set_queue_depth_provider(fn) -> None:
@@ -234,6 +252,13 @@ def set_queue_depth_provider(fn) -> None:
     (service.jobs) provides it once constructed; refreshed per scrape."""
     global _queue_depths
     _queue_depths = fn
+
+
+def set_jobs_running_provider(fn) -> None:
+    """Register a callable returning the count of live RUNNING jobs
+    (service.jobs' in-process registry); refreshed per scrape."""
+    global _jobs_running
+    _jobs_running = fn
 
 
 def refresh_gauges() -> None:
@@ -244,6 +269,11 @@ def refresh_gauges() -> None:
         try:
             for backend, depth in _queue_depths().items():
                 SCHED_QUEUE_DEPTH.labels(backend=backend).set(depth)
+        except Exception:
+            pass
+    if _jobs_running is not None:
+        try:
+            JOBS_RUNNING.set(_jobs_running())
         except Exception:
             pass
     try:
@@ -280,7 +310,9 @@ def refresh_gauges() -> None:
 
 def route_label(path: str) -> str:
     if path.startswith("/api/jobs/"):
-        # per-id status polls must not mint a label series per job
+        # per-id status polls / streams must not mint a series per job
+        if path.endswith("/stream"):
+            return "/api/jobs/{id}/stream"
         return "/api/jobs/{id}"
     if path.startswith("/api/debug/traces/"):
         # same rule for per-trace detail reads
@@ -481,6 +513,24 @@ def _wire_compile_obs() -> None:
         store_base.set_cache_observer(lambda n: CACHE_EVICTIONS.inc(n))
     except Exception:
         pass
+    try:
+        from vrpms_tpu.obs import progress
+
+        progress.set_observer(_record_progress)
+    except Exception:
+        pass
+
+
+def _record_progress(sink, snap: dict) -> None:
+    """Progress-sink observer (vrpms_tpu.obs.progress.set_observer):
+    one counter bump per published snapshot, and the per-class
+    last-value gap gauge when the snapshot carries one."""
+    PROGRESS_EVENTS.inc()
+    gap = snap.get("gap")
+    if gap is not None:
+        INCUMBENT_GAP.labels(
+            problem=sink.problem or "", algorithm=sink.algorithm or ""
+        ).set(gap)
 
 
 _wire_compile_obs()

@@ -279,10 +279,16 @@ def _enum_certificate(res, inst, split_exact: bool) -> dict:
     a fallback answer, never a proven optimum (ADVICE round 5)."""
     complete = int(res.evals) >= math.factorial(inst.n_customers)
     feasible = float(res.breakdown.cap_excess) <= 0.0
-    return {
+    cert = {
         "proven": bool(complete and split_exact and feasible),
         "method": "enumeration",
     }
+    if not feasible:
+        # match the B&B InfeasibleError fallback's honesty flag: the
+        # answer is a penalized best-effort packing, and the reason it
+        # is unproven is infeasibility, not a truncated search
+        cert["infeasible"] = True
+    return cert
 
 
 def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None, w=None,

@@ -77,6 +77,10 @@ _SLOW_PATTERNS = (
     "test_perturb.py::TestRuinRecreate::test_ils_reseed_ruin_mode_runs",
     # end-to-end HTTP solves (the envelope/contract tests stay quick)
     "test_concurrency.py",
+    "test_progress.py::TestStreamHTTP",
+    "test_progress.py::TestCancellationHTTP",
+    "test_progress.py::TestBatchedProgress",
+    "test_progress.py::TestProgressOffContract",
     "test_service.py::TestObservabilitySolve",
     "test_service.py::TestVRPSolve",
     "test_service.py::TestTSPSolve",

@@ -160,6 +160,36 @@ class TestBranchAndBound:
         assert float(res.breakdown.cap_excess) == 0.0
         assert np.isfinite(float(res.breakdown.distance))
 
+    def test_enum_certificate_never_proves_infeasible_fallback(self, rng):
+        """ADVICE r5 het-fleet hole, pinned: a COMPLETE untimed
+        enumeration whose every order had a capacity-infeasible optimal
+        split (total demand > total fleet capacity) falls back to a
+        penalized greedy packing — the certificate must report that as
+        unproven + infeasible, never as a proven optimum."""
+        from vrpms_tpu.solvers import solve_vrp_bf
+        from service.solve import _enum_certificate
+
+        pts = rng.uniform(0, 100, (6, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None], axis=-1)
+        # het fleet, total demand 10 > total capacity 7
+        inst = make_instance(
+            d, demands=[0, 2, 2, 2, 2, 2], capacities=[4.0, 3.0]
+        )
+        res = solve_vrp_bf(inst)
+        assert int(res.evals) >= 120  # 5! orders: enumeration COMPLETE
+        assert float(res.breakdown.cap_excess) > 0.0  # fallback packing
+        cert = _enum_certificate(res, inst, split_exact=True)
+        assert cert["proven"] is False
+        assert cert["infeasible"] is True
+        # ... while the same fleet with enough capacity stays provable
+        feasible = make_instance(
+            d, demands=[0, 2, 2, 2, 2, 2], capacities=[6.0, 5.0]
+        )
+        res2 = solve_vrp_bf(feasible)
+        cert2 = _enum_certificate(res2, feasible, split_exact=True)
+        assert cert2["proven"] is True
+        assert "infeasible" not in cert2
+
     def test_proves_e_n22_k4_optimum(self):
         # The strongest fixture cross-check there is: the branch-and-bound
         # proves the embedded E-n22-k4 transcription has optimum exactly

@@ -2,6 +2,16 @@
 
 import numpy as np
 import jax
+import pytest
+
+# the island mesh is built on jax.shard_map, which older jax (e.g. the
+# 0.4.x line some containers pin) does not expose — there the islands
+# suite is PRE-BROKEN by the environment, not by the code under test:
+# report skips, not failures
+pytestmark = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax.shard_map unavailable (old jax); islands need it",
+)
 
 from vrpms_tpu.core.encoding import is_valid_giant
 from vrpms_tpu.mesh import make_mesh, solve_sa_islands, solve_ga_islands, IslandParams

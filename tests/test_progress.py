@@ -1,0 +1,592 @@
+"""Live solve observability (ISSUE 7): incumbent snapshots, SSE
+streaming, gap telemetry, cooperative cancellation.
+
+Unit layers (quick): the ProgressSink/ProgressFanout contract —
+monotone non-increasing published costs, the gap formula against the
+quick lower bound, cancel semantics — plus the solver-seam guarantees:
+fixed-seed results are BIT-identical with a sink attached vs not, and
+a deadline-bounded solve publishes at block cadence.
+
+End-to-end layers (slow, via conftest patterns; tier1.yml runs the
+file in full): the /api/jobs/{id}/stream SSE surface (≥1 intermediate
+incumbent before the terminal event, framing, client disconnect
+mid-stream), per-job snapshots for micro-batched jobs, DELETE
+cancellation returning the incumbent marked cancelled, and the
+VRPMS_PROGRESS=off byte-identity contract.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import store.memory as mem
+from service import jobs as jobs_mod
+from service.app import serve
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.io.bounds import quick_lower_bound
+from vrpms_tpu.obs import progress
+
+
+# ---------------------------------------------------------------------------
+# unit: the sink contract
+# ---------------------------------------------------------------------------
+
+
+class TestProgressSink:
+    def test_publishes_only_improvements_and_stays_monotone(self):
+        sink = progress.ProgressSink(lower_bound=None)
+        sink.record(np.asarray([50.0, 60.0]), 128, 2)
+        first = sink.snapshot()
+        assert first["bestCost"] == 50.0 and first["block"] == 1
+        assert first["evals"] == 256
+        sink.record(np.asarray([55.0]), 128, 2)  # worse: not published
+        assert sink.snapshot()["block"] == 1
+        sink.record(np.asarray([40.0]), 128, 2)  # better: published
+        snap = sink.snapshot()
+        assert snap["bestCost"] == 40.0 and snap["block"] == 3
+        assert snap["evals"] == 3 * 256  # skipped blocks still count
+        prof = sink.profile()
+        assert prof["blocks"] == 3
+        costs = [s["bestCost"] for s in prof["improvements"]]
+        assert costs == sorted(costs, reverse=True)
+
+    def test_gap_is_relative_to_lower_bound(self):
+        sink = progress.ProgressSink(lower_bound=100.0)
+        sink.record(np.asarray([125.0]), 1, None)
+        assert sink.snapshot()["gap"] == pytest.approx(0.25)
+        unbounded = progress.ProgressSink(lower_bound=None)
+        unbounded.record(np.asarray([125.0]), 1, None)
+        assert unbounded.snapshot()["gap"] is None
+
+    def test_wait_progress_wakes_on_publish_and_close(self):
+        sink = progress.ProgressSink()
+        seq, snap, closed = sink.wait_progress(0, timeout=0.01)
+        assert seq == 0 and snap is None and not closed
+        sink.record(np.asarray([9.0]), 1, None)
+        seq, snap, closed = sink.wait_progress(0, timeout=5)
+        assert seq == 1 and snap["bestCost"] == 9.0 and not closed
+        sink.close("done")
+        seq, snap, closed = sink.wait_progress(seq, timeout=5)
+        assert closed and sink.status == "done"
+
+    def test_fanout_splits_rows_per_job(self):
+        a, b = progress.ProgressSink(), progress.ProgressSink()
+        fan = progress.ProgressFanout([a, None, b])
+        best = np.asarray([[7.0, 9.0], [1.0, 1.0], [3.0, 5.0]])
+        fan.record(best, 512, 6.0)  # 6 evals/iter over 3 rows -> 2 each
+        assert a.snapshot()["bestCost"] == 7.0
+        assert b.snapshot()["bestCost"] == 3.0
+        assert a.snapshot()["evals"] == 1024
+
+    def test_fanout_cancel_requires_every_member(self):
+        a, b = progress.ProgressSink(), progress.ProgressSink()
+        fan = progress.ProgressFanout([a, b])
+        a.cancel()
+        assert not fan.cancelled  # one job's DELETE spares batch-mates
+        b.cancel()
+        assert fan.cancelled
+        # acknowledgement fans out to the cancelled members
+        fan.note_cancel_seen()
+        assert a.cancel_acknowledged and b.cancel_acknowledged
+
+    def test_cancelled_mark_requires_driver_acknowledgement(self):
+        # a cancel the driver never got to act on (deadline-free solve
+        # already inside its single block) must NOT claim a cut-short
+        # run: only a driver break acknowledges
+        sink = progress.ProgressSink()
+        sink.cancel()
+        assert sink.cancelled and not sink.cancel_acknowledged
+        with progress.attach(sink):
+            assert progress.cancel_requested()  # a driver breaking...
+        assert sink.cancel_acknowledged  # ...is the acknowledgement
+
+    def test_attach_contextvar(self):
+        assert progress.active_sink() is None
+        sink = progress.ProgressSink()
+        with progress.attach(sink):
+            assert progress.active_sink() is sink
+            assert not progress.cancel_requested()
+            sink.cancel()
+            assert progress.cancel_requested()
+        assert progress.active_sink() is None
+        with progress.attach(None):
+            assert progress.active_sink() is None
+
+
+# ---------------------------------------------------------------------------
+# gap sanity: the quick bound vs the exact oracle (test_bounds-style)
+# ---------------------------------------------------------------------------
+
+
+class TestQuickLowerBound:
+    def test_vrp_bound_below_bf_optimum(self, rng):
+        from vrpms_tpu.solvers import solve_vrp_bf
+
+        for _ in range(3):
+            n = int(rng.integers(5, 8))
+            pts = rng.uniform(0, 100, (n + 1, 2))
+            d = np.linalg.norm(pts[:, None] - pts[None], axis=-1)
+            inst = make_instance(
+                d, demands=[0] + [2] * n, capacities=[2 * n] * 3
+            )
+            lb = quick_lower_bound(inst)
+            opt = float(solve_vrp_bf(inst).cost)
+            assert lb is not None and 0 < lb <= opt + 1e-6
+
+    def test_tsp_bound_below_bf_optimum(self, rng):
+        from vrpms_tpu.solvers import solve_tsp_bf
+
+        pts = rng.uniform(0, 100, (7, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None], axis=-1)
+        inst = make_instance(d, n_vehicles=1)
+        lb = quick_lower_bound(inst)
+        opt = float(solve_tsp_bf(inst).cost)
+        assert lb is not None and 0 < lb <= opt + 1e-6
+
+    def test_padded_instance_bound_stays_valid(self, rng):
+        # the sink computes its bound on the TIER-PADDED instance the
+        # solver actually runs; phantoms are zero-cost depot aliases,
+        # so the bound must still sit below the REAL optimum
+        from vrpms_tpu.core import tiers
+        from vrpms_tpu.solvers import solve_vrp_bf
+
+        pts = rng.uniform(0, 100, (7, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None], axis=-1)
+        inst = make_instance(d, demands=[0] + [2] * 6, capacities=[12.0] * 3)
+        opt = float(solve_vrp_bf(inst).cost)
+        padded = tiers.maybe_pad(inst)
+        lb = quick_lower_bound(padded)
+        assert lb is not None and 0 < lb <= opt + 1e-6
+
+    def test_never_raises(self):
+        # telemetry bound must answer None, not raise, on junk
+        inst = make_instance(np.zeros((2, 2)), n_vehicles=1)
+        assert quick_lower_bound(inst) in (None,) or isinstance(
+            quick_lower_bound(inst), float
+        )
+
+
+# ---------------------------------------------------------------------------
+# solver seam: byte-identity + block cadence + cooperative cancel
+# ---------------------------------------------------------------------------
+
+
+def small_cvrp(seed=5, n=9):
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    return make_instance(d, demands=[0] + [2] * (n - 1), capacities=[8.0] * 3)
+
+
+class TestSolverSeam:
+    def test_fixed_seed_results_bit_identical_with_sink(self):
+        import jax.numpy as jnp
+
+        from vrpms_tpu.solvers import SAParams, solve_sa
+
+        inst = small_cvrp()
+        p = SAParams(n_chains=32, n_iters=600)
+        plain = solve_sa(inst, key=7, params=p)
+        with progress.attach(progress.ProgressSink(lower_bound=10.0)):
+            sunk = solve_sa(inst, key=7, params=p)
+        assert bool(jnp.array_equal(plain.giant, sunk.giant))
+        assert float(plain.cost) == float(sunk.cost)
+        # deadline path too (generous budget: same block decomposition)
+        plain_d = solve_sa(inst, key=7, params=p, deadline_s=3600.0)
+        sink = progress.ProgressSink(lower_bound=10.0)
+        with progress.attach(sink):
+            sunk_d = solve_sa(inst, key=7, params=p, deadline_s=3600.0)
+        assert bool(jnp.array_equal(plain_d.giant, sunk_d.giant))
+        assert sink.snapshot() is not None
+
+    def test_deadline_solve_publishes_at_block_cadence(self):
+        from vrpms_tpu.solvers import SAParams, solve_sa
+
+        inst = small_cvrp()
+        sink = progress.ProgressSink(
+            lower_bound=quick_lower_bound(inst)
+        )
+        with progress.attach(sink):
+            solve_sa(
+                inst, key=3,
+                params=SAParams(n_chains=32, n_iters=1200),
+                deadline_s=3600.0,
+            )
+        prof = sink.profile()
+        assert prof is not None and prof["blocks"] >= 2
+        snap = sink.snapshot()
+        assert snap["gap"] is not None and snap["gap"] >= -1e-6
+        # gap consistency with io.bounds: invert the published formula
+        implied = snap["bestCost"] / (1.0 + snap["gap"])
+        assert implied == pytest.approx(sink.lower_bound, rel=1e-4)
+
+    def test_cancel_between_blocks_returns_incumbent_early(self):
+        from vrpms_tpu.core.encoding import is_valid_giant
+        from vrpms_tpu.solvers import SAParams, solve_sa
+
+        inst = small_cvrp()
+        sink = progress.ProgressSink()
+        # cancel as soon as the first snapshot lands
+        def cancel_on_first():
+            sink.wait_progress(0, timeout=60)
+            sink.cancel()
+
+        t = threading.Thread(target=cancel_on_first, daemon=True)
+        t.start()
+        t0 = time.monotonic()
+        with progress.attach(sink):
+            res = solve_sa(
+                inst, key=3,
+                params=SAParams(n_chains=32, n_iters=50_000_000),
+                deadline_s=3600.0,
+            )
+        t.join(timeout=10)
+        assert time.monotonic() - t0 < 120  # nowhere near the budget
+        assert is_valid_giant(res.giant, 8, 3)
+        assert float(res.cost) == pytest.approx(
+            sink.snapshot()["bestCost"], rel=1e-3
+        )
+
+
+# ---------------------------------------------------------------------------
+# end-to-end HTTP (slow lane; tier1.yml runs these in its own step)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    jobs_mod.shutdown_scheduler()  # fresh scheduler under this env
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    jobs_mod.shutdown_scheduler()
+
+
+@pytest.fixture(autouse=True)
+def seeded():
+    mem.reset()
+    rng = np.random.default_rng(11)
+    pts = rng.uniform(0, 100, size=(7, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        "locs7", [{"id": i, "demand": 2 if i else 0} for i in range(7)]
+    )
+    mem.seed_durations("locs7", d.tolist())
+    yield
+
+
+def request(base, method, path, body=None):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"} if body else {},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def job_body(**over):
+    body = {
+        "problem": "vrp",
+        "algorithm": "sa",
+        "solutionName": "prog",
+        "solutionDescription": "t",
+        "locationsKey": "locs7",
+        "durationsKey": "locs7",
+        "capacities": [14, 14, 14],
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": 1,
+        "iterationCount": 2000,
+        "populationSize": 16,
+    }
+    body.update(over)
+    return body
+
+
+def poll_done(base, job_id, timeout=180.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, resp = request(base, "GET", f"/api/jobs/{job_id}")
+        assert status == 200, resp
+        if resp["job"]["status"] in ("done", "failed"):
+            return resp["job"]
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never finished")
+
+
+def read_sse(base, job_id, timeout=180.0):
+    """Collect (event, payload) pairs until a terminal event."""
+    events = []
+    req = urllib.request.Request(base + f"/api/jobs/{job_id}/stream")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        assert resp.headers.get("Content-Type", "").startswith(
+            "text/event-stream"
+        )
+        name = None
+        for raw in resp:
+            line = raw.decode("utf-8").rstrip("\n")
+            if line.startswith("event: "):
+                name = line[len("event: "):]
+            elif line.startswith("data: "):
+                events.append((name, json.loads(line[len("data: "):])))
+                if name in ("done", "failed", "timeout"):
+                    break
+    return events
+
+
+class TestStreamHTTP:
+    def test_stream_delivers_intermediate_incumbent_then_done(self, server):
+        # budgeted multi-block solve: enough iterations that the
+        # deadline loop runs several 512-blocks inside the budget
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=5_000_000, timeLimit=4.0),
+        )
+        assert status == 202, resp
+        events = read_sse(server, resp["jobId"])
+        kinds = [k for k, _ in events]
+        assert kinds[-1] == "done", kinds
+        prog = [p for k, p in events if k == "progress"]
+        assert len(prog) >= 1  # ≥1 intermediate incumbent before done
+        costs = [p["bestCost"] for p in prog]
+        assert costs == sorted(costs, reverse=True)  # monotone
+        # every snapshot's gap inverts to the SAME lower bound
+        implied = {
+            round(p["bestCost"] / (1.0 + p["gap"]), 3)
+            for p in prog
+            if p.get("gap") is not None
+        }
+        assert len(implied) <= 1
+        record = events[-1][1]
+        assert record["status"] == "done"
+        assert record["incumbent"]["bestCost"] == pytest.approx(
+            costs[-1]
+        )
+        assert record["message"]["durationSum"] > 0
+        assert record["progress"]["blocks"] >= 1
+
+    def test_poll_overlays_live_incumbent_and_persists_profile(self, server):
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=5_000_000, timeLimit=4.0, seed=3),
+        )
+        assert status == 202, resp
+        jid = resp["jobId"]
+        saw_running_incumbent = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            status, r = request(server, "GET", f"/api/jobs/{jid}")
+            job = r["job"]
+            if job["status"] in ("done", "failed"):
+                break
+            if job.get("incumbent") is not None:
+                saw_running_incumbent = True
+            time.sleep(0.05)
+        record = poll_done(server, jid)
+        assert record["status"] == "done"
+        # the terminal record persists the final incumbent + profile
+        assert record.get("incumbent") is not None
+        assert record.get("progress", {}).get("blocks", 0) >= 1
+        # live overlay is timing-dependent but should virtually always
+        # land with a 4 s budget and 50 ms polls
+        assert saw_running_incumbent
+
+    def test_stream_of_finished_job_replays_then_terminates(self, server):
+        status, resp = request(server, "POST", "/api/jobs", job_body())
+        jid = resp["jobId"]
+        poll_done(server, jid)
+        events = read_sse(server, jid)
+        kinds = [k for k, _ in events]
+        # replay-first contract holds for store-backed follows too: at
+        # most the final incumbent, then the terminal event — and a
+        # terminal record is NEVER misreported
+        assert kinds[-1] == "done" and set(kinds[:-1]) <= {"progress"}
+        assert events[-1][1]["status"] == "done"
+
+    def test_stream_of_unowned_running_record_never_reports_failed(
+        self, server
+    ):
+        # cross-replica view: a record another process owns (no live
+        # Job here) that is still RUNNING must never be streamed as
+        # `failed` — the handler follows the store until it actually
+        # turns terminal, replaying persisted incumbents as they land
+        import store
+
+        db = store.get_database("vrp", None)
+        db.save_job("foreign01", {
+            "id": "foreign01", "status": "running",
+            "incumbent": {"block": 2, "wallMs": 5.0, "bestCost": 42.0,
+                          "gap": None, "evals": 10},
+        })
+
+        def other_replica_finishes():
+            time.sleep(3.0)
+            db.save_job("foreign01", {
+                "id": "foreign01", "status": "done",
+                "message": {"ok": True},
+                "incumbent": {"block": 3, "wallMs": 9.9, "bestCost": 41.0,
+                              "gap": None, "evals": 20},
+            })
+
+        threading.Thread(target=other_replica_finishes, daemon=True).start()
+        events = read_sse(server, "foreign01", timeout=60)
+        kinds = [k for k, _ in events]
+        assert "failed" not in kinds
+        assert kinds[-1] == "done"
+        assert [p["block"] for k, p in events if k == "progress"] == [2, 3]
+
+    def test_stream_unknown_job_404(self, server):
+        status, resp = request(
+            server, "GET", "/api/jobs/nosuchjob/stream"
+        )
+        assert status == 404
+        assert resp["success"] is False
+
+    def test_client_disconnect_mid_stream_leaves_solve_unharmed(
+        self, server
+    ):
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=5_000_000, timeLimit=4.0, seed=5),
+        )
+        assert status == 202, resp
+        jid = resp["jobId"]
+        host, port = server.replace("http://", "").split(":")
+        sock = socket.create_connection((host, int(port)), timeout=10)
+        sock.sendall(
+            f"GET /api/jobs/{jid}/stream HTTP/1.1\r\n"
+            f"Host: {host}\r\nConnection: close\r\n\r\n".encode()
+        )
+        sock.recv(512)  # response headers started streaming
+        sock.close()  # hang up mid-stream
+        record = poll_done(server, jid)
+        assert record["status"] == "done"  # the solve never noticed
+        # and the service still serves: a fresh stream works end to end
+        events = read_sse(server, jid)
+        assert events[-1][0] == "done"
+
+
+class TestCancellationHTTP:
+    def test_delete_returns_incumbent_marked_cancelled(self, server):
+        t0 = time.monotonic()
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=50_000_000, timeLimit=120.0, seed=2),
+        )
+        assert status == 202, resp
+        jid = resp["jobId"]
+        # wait for the first published incumbent, then cancel
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            _, r = request(server, "GET", f"/api/jobs/{jid}")
+            if r["job"].get("incumbent") or r["job"]["status"] in (
+                "done", "failed",
+            ):
+                break
+            time.sleep(0.05)
+        status, r = request(server, "DELETE", f"/api/jobs/{jid}")
+        assert status == 202 and r["cancelRequested"] is True
+        record = poll_done(server, jid)
+        assert record["status"] == "done"
+        assert record["message"].get("cancelled") is True
+        assert record.get("incumbent") is not None
+        assert time.monotonic() - t0 < 90  # nowhere near the 120 s budget
+
+    def test_delete_finished_job_is_noop(self, server):
+        status, resp = request(server, "POST", "/api/jobs", job_body())
+        jid = resp["jobId"]
+        poll_done(server, jid)
+        status, r = request(server, "DELETE", f"/api/jobs/{jid}")
+        assert status == 200 and r["cancelRequested"] is False
+
+    def test_delete_unknown_job_404(self, server):
+        status, r = request(server, "DELETE", "/api/jobs/missing")
+        assert status == 404
+
+
+class TestBatchedProgress:
+    def test_batched_jobs_get_per_job_snapshots(self, server, monkeypatch):
+        import os
+
+        # widen the gather window so the three same-bucket submits
+        # reliably merge into one vmapped launch
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.setenv("VRPMS_SCHED_WINDOW_MS", "200")
+        ids = []
+        for seed in (1, 2, 3):
+            status, resp = request(
+                server, "POST", "/api/jobs",
+                job_body(seed=seed, iterationCount=3000, timeLimit=5.0),
+            )
+            assert status == 202, resp
+            ids.append(resp["jobId"])
+        records = [poll_done(server, jid) for jid in ids]
+        jobs_mod.shutdown_scheduler()  # restore default window
+        assert any((r.get("batchSize") or 1) > 1 for r in records)
+        for r in records:
+            assert r["status"] == "done", r
+            assert r.get("incumbent") is not None
+            assert r["incumbent"]["bestCost"] == pytest.approx(
+                r["message"]["durationSum"], rel=0.25
+            )
+
+
+class TestProgressOffContract:
+    def test_off_restores_pre_progress_records_and_bytes(
+        self, server, monkeypatch
+    ):
+        # cache off: the second identical solve must actually solve
+        # (an exact cache hit would serve the first response and mask
+        # any solver-trajectory difference)
+        monkeypatch.setenv("VRPMS_CACHE", "off")
+        body = job_body(seed=9)  # deadline-free: deterministic blocks
+
+        monkeypatch.setenv("VRPMS_PROGRESS", "off")
+        status, resp = request(server, "POST", "/api/jobs", body)
+        assert status == 202, resp
+        rec_off = poll_done(server, resp["jobId"])
+        assert "incumbent" not in rec_off
+        assert "progress" not in rec_off
+
+        monkeypatch.delenv("VRPMS_PROGRESS", raising=False)
+        status, resp = request(server, "POST", "/api/jobs", body)
+        rec_on = poll_done(server, resp["jobId"])
+        # progress on adds record keys but the SOLVE RESULT is
+        # byte-identical for the fixed seed
+        assert json.dumps(rec_on["message"], sort_keys=True) == json.dumps(
+            rec_off["message"], sort_keys=True
+        )
+
+    def test_off_means_no_sink_and_no_cancel(self, server, monkeypatch):
+        monkeypatch.setenv("VRPMS_PROGRESS", "off")
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=100_000, timeLimit=5.0, seed=4),
+        )
+        assert status == 202, resp
+        jid = resp["jobId"]
+        # a DELETE while running (or queued) answers 409 Not cancellable;
+        # if the job already finished, the no-op 200 applies instead
+        status, r = request(server, "DELETE", f"/api/jobs/{jid}")
+        assert status in (200, 409)
+        record = poll_done(server, jid)
+        assert record["status"] == "done"
+        assert "incumbent" not in record

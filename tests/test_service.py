@@ -18,6 +18,23 @@ import pytest
 import store.memory as mem
 from service.app import serve
 
+# the islands option drives shard_map-built solvers; on old-jax
+# containers (no jax.shard_map) those requests can only fail in the
+# solver — environment-pre-broken, so the islands cases skip there
+# (see tests/test_islands.py)
+
+
+def _has_shard_map():
+    import jax
+
+    return hasattr(jax, "shard_map")
+
+
+needs_shard_map = pytest.mark.skipif(
+    not _has_shard_map(),
+    reason="jax.shard_map unavailable (old jax); islands need it",
+)
+
 
 @pytest.fixture(scope="module")
 def server():
@@ -295,6 +312,7 @@ class TestVRPSolve:
         visited = [c for v in pol["message"]["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    @needs_shard_map
     def test_islands_sa_solves_over_virtual_mesh(self, server):
         """islands rides the conftest's 8 virtual CPU devices: the
         sharded ring-migration program must serve the same contract."""
@@ -316,6 +334,7 @@ class TestVRPSolve:
         visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    @needs_shard_map
     def test_islands_ga_solves_and_clamps(self, server):
         status, resp = post(
             server,
@@ -387,6 +406,7 @@ class TestVRPSolve:
         visited = [c for v in msg["vehicles"] for c in v["tour"][1:-1]]
         assert sorted(visited) == [1, 2, 3, 4, 5, 6]
 
+    @needs_shard_map
     def test_ils_composes_with_islands(self, server):
         status, resp = post(
             server,
@@ -540,6 +560,7 @@ class TestVRPSolve:
         )
         assert visited == list(range(1, 13))
 
+    @needs_shard_map
     def test_aco_islands_and_pool(self, server):
         # ACO honors islands (per-device colonies, elite ring) and
         # localSearchPool (per-island champions polished)
@@ -592,6 +613,7 @@ class TestVRPSolve:
         )
         assert status == 400
 
+    @needs_shard_map
     def test_local_search_pool_composes_with_islands(self, server):
         """Island solvers return their per-island champions as the
         elite pool, so pool polish composes with islands."""

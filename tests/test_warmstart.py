@@ -12,7 +12,12 @@ import pytest
 
 import store.memory as mem
 from service.solve import _warm_perm
-from tests.test_service import post, server, seeded  # noqa: F401  (fixtures)
+from tests.test_service import (  # noqa: F401  (fixtures)
+    needs_shard_map,
+    post,
+    seeded,
+    server,
+)
 
 
 ALICE = "alice@example.com"  # registered for "tok-alice" by the seeded fixture
@@ -192,6 +197,7 @@ class TestWarmStartHTTP:
         assert status == 200 and resp["success"]
         assert resp["message"]["stats"]["warmStart"] is True
 
+    @needs_shard_map
     def test_sa_islands_consume_warm_start(self, server):
         # round 3 (VERDICT r2 item 8): islands + warmStart no longer
         # silently drops the checkpoint for SA — the island chains start
@@ -210,6 +216,7 @@ class TestWarmStartHTTP:
         assert resp["message"]["stats"]["islands"] == 4
         assert resp["message"]["durationSum"] <= chk + 1e-6
 
+    @needs_shard_map
     def test_ga_islands_consume_warm_start(self, server):
         status, _ = post(server, "/api/vrp/sa", vrp_body())
         assert status == 200

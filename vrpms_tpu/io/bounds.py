@@ -856,6 +856,43 @@ def lower_bound(inst: Instance, ub: float | None = None) -> float:
     return float(max(bounds))
 
 
+#: node-count cap for the O(n^3) Hungarian solve inside the QUICK bound
+#: (past it the assignment relaxation costs more than a solve block)
+QUICK_ASSIGNMENT_MAX_N = 256
+
+
+def quick_lower_bound(inst: Instance) -> float | None:
+    """Cheap applicable lower bound for LIVE gap telemetry — the
+    milliseconds-scale subset of `lower_bound` (no Lagrangian ascent:
+    that is an offline certificate tool at ~minutes per instance,
+    while this runs once per submitted job on the HTTP thread).
+
+    TSP: a short Held-Karp 1-tree ascent (symmetric) or the AP
+    relaxation. VRP: max of the AP relaxation and the symmetric MST
+    bound. Tier-padded instances are fine as-is: phantom customers are
+    zero-cost depot aliases, so bounds on the padded tensor remain
+    valid lower bounds of the real objective. Returns None when every
+    applicable bound is vacuous (then gaps are simply not reported) —
+    and on ANY failure: telemetry must never fail a submit.
+    """
+    try:
+        d, _, caps = _host(inst)
+        n = d.shape[0]
+        tsp = len(caps) == 1 and caps[0] >= BIG / 2
+        bounds = [0.0]
+        if n <= QUICK_ASSIGNMENT_MAX_N:
+            bounds.append(assignment_lb(inst))
+        if tsp:
+            if n <= 128:
+                bounds.append(held_karp_1tree_lb(inst, iters=30))
+        else:
+            bounds.append(mst_lb(inst))
+        lb = float(max(bounds))
+        return lb if lb > 0 else None
+    except Exception:
+        return None
+
+
 def certified_gap_percent(cost: float, inst: Instance) -> float | None:
     """Certified upper bound (percent) on this cost's optimality gap:
     gap_true <= (cost - LB) / LB. None when the bound is vacuous. The

@@ -21,7 +21,7 @@ the concrete instruments (service.obs) and the solvers only ever call
 `active_trace()` — absent a collector, that is one ContextVar read.
 """
 
-from vrpms_tpu.obs import spans
+from vrpms_tpu.obs import progress, spans
 from vrpms_tpu.obs.logging import (
     current_request_id,
     log_event,
@@ -50,6 +50,7 @@ __all__ = [
     "current_request_id",
     "log_event",
     "new_request_id",
+    "progress",
     "reset_request_id",
     "set_log_stream",
     "set_request_id",

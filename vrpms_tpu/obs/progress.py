@@ -1,0 +1,306 @@
+"""Live solve progress: anytime incumbent snapshots + cooperative cancel.
+
+The solvers are anytime metaheuristics whose deadline drivers already
+return to the host between device-side scan blocks (solvers.common.
+run_blocked — the same cadence the BlockTrace collector records at).
+This module is the seam that publishes that cadence LIVE, while the
+solve is still running, instead of only in the post-hoc stats:
+
+  * ProgressSink — a thread-safe mailbox one job owns. The solver
+    thread `record()`s the synced best at each block boundary; any
+    number of reader threads (`GET /api/jobs/{id}` polls, the SSE
+    stream) take `snapshot()`/`wait_progress()` without ever touching
+    the device. Snapshots are published only when the incumbent
+    IMPROVES, so the stream is quiet exactly when the solver is, and
+    the published bestCost is monotone non-increasing by construction.
+  * ProgressFanout — the micro-batched launch's adapter: one vmapped
+    SA launch carries K jobs, the fanout splits the per-instance best
+    rows to K per-job sinks (service.jobs._run_batched installs it).
+  * cooperative cancellation — `cancel()` flips a flag the deadline
+    drivers check between blocks (run_blocked, the delta launch loop,
+    the ILS round loop); the solve stops at the next boundary and
+    returns its incumbent instead of burning the rest of its budget.
+
+Like the BlockTrace, the sink rides a ContextVar: with none active the
+solver hot path pays one ContextVar read per block, and with
+VRPMS_PROGRESS=off the service never installs one — solver
+trajectories are bit-identical to the pre-progress contract either way
+(recording only READS the already-synced best; it never changes the
+block decomposition or any device computation).
+
+Nothing here imports jax or the service: the concrete instruments
+(vrpms_progress_events_total, vrpms_incumbent_gap) are wired in by
+service.obs through `set_observer`, the tiers/set_tier_observer
+pattern.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+
+from vrpms_tpu.obs import spans
+
+#: published (improving) snapshots kept for the terminal convergence
+#: profile — the record persisted with the job must stay bounded
+MAX_PROFILE_SNAPSHOTS = 256
+
+
+def enabled() -> bool:
+    """The VRPMS_PROGRESS master switch (default on). Read per call so
+    tests and embedders can toggle at runtime."""
+    return os.environ.get("VRPMS_PROGRESS", "on").strip().lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+# observer seam: service.obs wires the Prometheus instruments in;
+# fn(sink, snapshot) is called once per PUBLISHED snapshot
+_observer = None
+
+
+def set_observer(fn) -> None:
+    global _observer
+    _observer = fn
+
+
+class ProgressSink:
+    """One job's live incumbent mailbox (see module docstring).
+
+    `lower_bound`, when given, is the instance's best cheap applicable
+    lower bound (io.bounds.quick_lower_bound) — every snapshot carries
+    `gap` = (bestCost - LB) / LB against it, the certified-style
+    optimality-gap ceiling a dispatch client sheds budget on.
+    """
+
+    def __init__(self, job_id: str | None = None, problem: str | None = None,
+                 algorithm: str | None = None,
+                 lower_bound: float | None = None):
+        self.job_id = job_id
+        self.problem = problem
+        self.algorithm = algorithm
+        self.lower_bound = (
+            float(lower_bound)
+            if lower_bound is not None and lower_bound > 0
+            else None
+        )
+        self._lock = threading.Lock()
+        self._new = threading.Condition(self._lock)
+        self._t0 = time.perf_counter()
+        self._evals = 0.0
+        self._block = 0
+        self._latest: dict | None = None
+        self._profile: list[dict] = []
+        self._profile_truncated = False
+        self.seq = 0          # bumped per published snapshot + on close
+        self.closed = False
+        self.status: str | None = None   # terminal: done|failed|...
+        self._cancel = False
+        self._ack = False  # a driver stopped FOR the cancel
+
+    # -- solver side (device-owning thread) ---------------------------------
+    def record(self, best, iters: int, evals_per_iter: float | None) -> None:
+        """Block-boundary report — same contract as BlockTrace.record:
+        `best` is the array the deadline loop synced on (already
+        block_until_ready'd), its min is the incumbent cost. Publishes
+        a snapshot only when the incumbent improves (or on the first
+        block); telemetry failures never fail the solve."""
+        import numpy as np
+
+        with self._lock:
+            self._evals += float(iters) * float(
+                evals_per_iter if evals_per_iter is not None else 1.0
+            )
+            self._block += 1
+        try:
+            best_cost = float(np.min(np.asarray(best)))
+        except Exception:
+            return  # keep eval accounting, skip the unreadable entry
+        with self._new:
+            if (
+                self._latest is not None
+                and best_cost >= self._latest["bestCost"] - 1e-9
+            ):
+                return
+            snap = {
+                "block": self._block,
+                "wallMs": round((time.perf_counter() - self._t0) * 1e3, 2),
+                "bestCost": best_cost,
+                "gap": (
+                    None
+                    if self.lower_bound is None
+                    else round(
+                        (best_cost - self.lower_bound) / self.lower_bound, 6
+                    )
+                ),
+                "evals": int(self._evals),
+            }
+            self._latest = snap
+            if len(self._profile) < MAX_PROFILE_SNAPSHOTS:
+                self._profile.append(snap)
+            else:
+                self._profile_truncated = True
+            self.seq += 1
+            self._new.notify_all()
+        # the snapshot joins the request's span waterfall too (no-op
+        # without an active span — one ContextVar read); distinct from
+        # the includeStats-only "block" events of the BlockTrace cadence
+        spans.add_event("progress", **{k: v for k, v in snap.items()})
+        obs = _observer
+        if obs is not None:
+            try:
+                obs(self, snap)
+            except Exception:
+                pass  # telemetry must never kill the device loop
+
+    def close(self, status: str | None = None) -> None:
+        """Terminal transition: wake every stream waiter for good."""
+        with self._new:
+            if self.closed:
+                return
+            self.closed = True
+            self.status = status
+            self.seq += 1
+            self._new.notify_all()
+
+    # -- cancellation --------------------------------------------------------
+    def cancel(self) -> None:
+        """Request a cooperative stop: the deadline drivers check this
+        between device blocks and return their incumbent."""
+        with self._new:
+            self._cancel = True
+            self._new.notify_all()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel
+
+    def note_cancel_seen(self) -> None:
+        """A driver observed the cancel at a boundary and STOPPED —
+        only then may the result honestly be marked `cancelled`: a
+        single-block (deadline-free) solve has no boundary left to
+        check and runs its full budget, which is not a cut-short run."""
+        self._ack = True
+
+    @property
+    def cancel_acknowledged(self) -> bool:
+        return self._ack
+
+    # -- reader side (HTTP threads) -----------------------------------------
+    def snapshot(self) -> dict | None:
+        """Latest published incumbent snapshot (a copy), or None."""
+        with self._lock:
+            return None if self._latest is None else dict(self._latest)
+
+    def wait_progress(self, seen_seq: int, timeout: float):
+        """Park until the sink moves past `seen_seq` (a new snapshot or
+        the terminal close) or `timeout` elapses. Returns
+        (seq, snapshot|None, closed)."""
+        deadline = time.monotonic() + timeout
+        with self._new:
+            while self.seq == seen_seq and not self.closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._new.wait(remaining)
+            snap = None if self._latest is None else dict(self._latest)
+            return self.seq, snap, self.closed
+
+    def profile(self) -> dict | None:
+        """Terminal convergence profile for the persisted job record:
+        every published (improving) snapshot, bounded."""
+        with self._lock:
+            if not self._profile:
+                return None
+            out = {
+                "blocks": self._block,
+                "improvements": [dict(s) for s in self._profile],
+            }
+            if self.lower_bound is not None:
+                out["lowerBound"] = self.lower_bound
+            if self._profile_truncated:
+                out["truncated"] = True
+            return out
+
+
+class ProgressFanout:
+    """Per-job sinks behind one batched launch's contextvar slot.
+
+    The batched SA launch syncs a [K, B] per-instance best array;
+    `record` splits row i to sink i (None entries — jobs without
+    progress — are skipped). `cancelled` only when EVERY participating
+    sink is cancelled: one job's cancel must not kill its batch-mates'
+    solve (a cancelled batched job simply gets its incumbent when the
+    launch ends)."""
+
+    def __init__(self, sinks: list):
+        self._sinks = list(sinks)
+
+    def record(self, best, iters: int, evals_per_iter: float | None) -> None:
+        import numpy as np
+
+        try:
+            rows = np.asarray(best)
+        except Exception:
+            return
+        if rows.ndim == 0 or rows.shape[0] < len(self._sinks):
+            return
+        per = (
+            None
+            if evals_per_iter is None
+            else float(evals_per_iter) / max(1, rows.shape[0])
+        )
+        for i, sink in enumerate(self._sinks):
+            if sink is not None:
+                sink.record(rows[i], iters, per)
+
+    @property
+    def cancelled(self) -> bool:
+        live = [s for s in self._sinks if s is not None]
+        return bool(live) and all(s.cancelled for s in live)
+
+    def note_cancel_seen(self) -> None:
+        for s in self._sinks:
+            if s is not None and s.cancelled:
+                s.note_cancel_seen()
+
+
+_active: contextvars.ContextVar = contextvars.ContextVar(
+    "vrpms_progress_sink", default=None
+)
+
+
+def active_sink():
+    """The sink (or fanout) the current solve installed, if any — the
+    only call the solver hot path makes."""
+    return _active.get()
+
+
+def cancel_requested() -> bool:
+    """Between-blocks cancellation check for drivers layered above
+    run_blocked (the delta launch loop, the ILS round loop, chunked
+    enumeration). A True answer means the caller is about to STOP, so
+    it doubles as the acknowledgement that makes `cancelled: true`
+    honest (see ProgressSink.note_cancel_seen)."""
+    sink = _active.get()
+    if sink is None or not sink.cancelled:
+        return False
+    sink.note_cancel_seen()
+    return True
+
+
+@contextlib.contextmanager
+def attach(sink):
+    """Install a sink (or fanout) for the duration of a solve; a None
+    sink yields without installing, so callers need no branch."""
+    if sink is None:
+        yield None
+        return
+    token = _active.set(sink)
+    try:
+        yield sink
+    finally:
+        _active.reset(token)

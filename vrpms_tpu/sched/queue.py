@@ -75,6 +75,14 @@ class Job:
     span: typing.Any = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # live-progress mailbox (vrpms_tpu.obs.progress.ProgressSink in
+    # practice): opaque to this package, rides the Job through every
+    # hop — queue, micro-batch gather, worker, watchdog requeue — so
+    # the runner can publish block-cadence incumbents and honor
+    # cooperative cancellation wherever the job lands
+    sink: typing.Any = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
     # supervision: True once the watchdog re-admitted this job after a
     # worker crash — the SECOND crash fails it instead (at-most-one
     # requeue keeps a poison job from crash-looping the worker forever)

@@ -183,6 +183,8 @@ def _enumerate_deadline(n: int, kind: str, inst: Instance, w, deadline_s: float)
     best over >= ~262k orders (or the whole space when smaller); when
     the deadline cuts enumeration short the result is best-so-far, NOT
     exact — the caller reports the scored count via SolveResult.evals."""
+    from vrpms_tpu.obs.progress import cancel_requested
+
     n_perms = math.factorial(n)
     n_batches = (n_perms + _BATCH - 1) // _BATCH
     carry = (jnp.int32(0), jnp.float32(jnp.inf))
@@ -193,7 +195,9 @@ def _enumerate_deadline(n: int, kind: str, inst: Instance, w, deadline_s: float)
         carry = run(carry, jnp.int32(b), inst, w)
         jax.block_until_ready(carry[1])
         b += _CHUNK_BATCHES
-        if time.monotonic() - t0 >= deadline_s:
+        # chunk-granular cooperative cancel, same seam as the deadline
+        # (a cancelled enumeration is best-effort, never exact)
+        if time.monotonic() - t0 >= deadline_s or cancel_requested():
             break
     scored = min(b * _BATCH, n_perms)
     return carry[0], scored, scored >= n_perms

@@ -78,23 +78,47 @@ def run_blocked(
     boundary records (wall, best-of-sync, cumulative evals). With no
     collector — the default — the cost is one ContextVar read, and the
     deadline-free fast path gains no extra device sync.
+
+    The live-progress sink (vrpms_tpu.obs.progress) rides the SAME
+    cadence: when one is active, every block boundary also publishes
+    the synced best to it, and a cooperative CANCEL flag is honored
+    between blocks — the loop stops and the caller returns its
+    incumbent. Neither path changes the block decomposition or any
+    device computation, so fixed-seed trajectories are bit-identical
+    with or without a sink attached.
     """
     import time
 
+    from vrpms_tpu.obs.progress import active_sink
     from vrpms_tpu.obs.trace import active_trace
 
     trace = active_trace()
+    sink = active_sink()
     if deadline_s is None:
+        if sink is not None and sink.cancelled:
+            # cancelled before the single unbounded block launched: the
+            # caller's prepared state IS the incumbent. A cancel landing
+            # mid-block instead runs the whole budget — there is no
+            # boundary left to stop at, and the result is then NOT
+            # marked cancelled (sink.note_cancel_seen never fires).
+            sink.note_cancel_seen()
+            return state, 0
         state = step_block(state, n_total, 0)
-        if trace is not None and n_total > 0:
+        if (trace is not None or sink is not None) and n_total > 0:
             best = sync(state)
             jax.block_until_ready(best)
-            trace.record(best, n_total, evals_per_iter)
+            if trace is not None:
+                trace.record(best, n_total, evals_per_iter)
+            if sink is not None:
+                sink.record(best, n_total, evals_per_iter)
         return state, n_total
     block = max(1, min(n_total, block_size))
     done = 0
     t_start = time.monotonic()
     while done < n_total:
+        if sink is not None and sink.cancelled:
+            sink.note_cancel_seen()
+            break
         nb = min(block, n_total - done)
         elapsed = time.monotonic() - t_start
         remaining_t = deadline_s - elapsed
@@ -127,6 +151,8 @@ def run_blocked(
         done += nb
         if trace is not None:
             trace.record(best, nb, evals_per_iter)
+        if sink is not None:
+            sink.record(best, nb, evals_per_iter)
         if time.monotonic() - t_start >= deadline_s:
             break
     return state, done

@@ -213,6 +213,8 @@ def ils_loop(
             elapsed = controller_value(elapsed)
         return deadline_s - elapsed
 
+    from vrpms_tpu.obs.progress import cancel_requested
+
     best_g = None
     best_c = float("inf")
     evals = 0
@@ -225,6 +227,8 @@ def ils_loop(
     # (26-round budget solves overshot ~25% on the static floor alone).
     fixed_tail = 0.0
     for r in range(params.rounds):
+        if cancel_requested() and best_g is not None:
+            break  # cooperative cancel: the incumbent is the answer
         budget = remaining()
         if (
             budget is not None
@@ -250,7 +254,7 @@ def ils_loop(
         sweeps_left = params.polish_sweeps
         top_k = 8  # delta_polish_batch default; fixed for the eval test
         first_polish = True
-        while sweeps_left > 0:
+        while sweeps_left > 0 and not cancel_requested():
             # At least ONE polish block always runs (same rule as the
             # deadline drivers' at-least-one-chunk): the polish is part
             # of the ILS algorithm, measured −7% on an anneal champion

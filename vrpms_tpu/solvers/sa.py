@@ -1057,6 +1057,7 @@ def _delta_launch_loop(
     """
     import time as _time
 
+    from vrpms_tpu.obs.progress import cancel_requested
     from vrpms_tpu.solvers.common import run_blocked
 
     t_run = _time.monotonic()
@@ -1088,6 +1089,11 @@ def _delta_launch_loop(
         if deadline_s is not None and (
             _time.monotonic() - t_run >= deadline_s or did < block
         ):
+            break
+        # cooperative cancel between launches: run_blocked already
+        # stopped its inner loop; without this the deadline-free outer
+        # loop would keep issuing (instantly-skipped) launches
+        if cancel_requested():
             break
     return state, done
 
