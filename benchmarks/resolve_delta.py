@@ -1,0 +1,252 @@
+"""Dynamic re-solve benchmark: a rolling-horizon trace, warm vs cold.
+
+Real dispatch re-solves a rolling horizon: every step a customer
+completes (drop) and a new one arrives (add), and the fleet wants the
+updated plan NOW. This bench replays such a trace against the
+in-process service and measures what the warm-start continuation path
+(ISSUE 8) buys over solving each horizon cold:
+
+  * per step, the COLD baseline solves the post-delta instance from
+    scratch at the full iteration budget I (fixed seed);
+  * the WARM path sends the SAME instance as the PREVIOUS horizon's
+    request body plus a `delta` (drop/add) and a `warmStart` inline
+    tour carrying the previous horizon's solution — the service
+    repairs the tour over the separator encoding and SA continues
+    annealing from the repaired incumbent at a continuation
+    temperature (solvers.sa.continuation_params);
+  * the warm path then re-runs at shrinking budgets (I, I/2, ... I/16)
+    to find the smallest budget whose cost still MATCHES the cold
+    result — evals-to-match is the headline: how much of the budget
+    the continuation actually needs.
+
+Cache OFF throughout (VRPMS_CACHE=off): the point is the continuation
+machinery itself, and the warm path must work without the cache (the
+jobId/tour seed sources do not ride it).
+
+Gates (ISSUE 8 acceptance):
+  * every step's warm re-solve matches the cold cost with >= 2x fewer
+    evals (evalsColdFull / evalsWarmAtMatch >= 2, min over steps);
+  * at the FULL budget the warm cost is never worse than cold.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.resolve_delta \
+        [--n 14] [--steps 4] [--iters 600] [--chains 16] \
+        [--out records/resolve_delta_r13.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+GATE_EVALS_RATIO = 2.0
+REL_EPS = 1e-6
+
+
+def _post(base: str, path: str, body: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _seed_store(n: int) -> None:
+    import numpy as np
+
+    import store.memory as mem
+
+    mem.reset()
+    rng = np.random.default_rng(43)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        "resolvebench",
+        [{"id": i, "demand": 2 if i else 0} for i in range(n)],
+    )
+    mem.seed_durations("resolvebench", d.tolist())
+
+
+def _body(n: int, iters: int, chains: int, seed: int, ignored: list) -> dict:
+    return {
+        "solutionName": "resolve-bench",
+        "solutionDescription": "resolve_delta",
+        "locationsKey": "resolvebench",
+        "durationsKey": "resolvebench",
+        "capacities": [3 * n] * 3,
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": list(ignored),
+        "completedCustomers": [],
+        "seed": seed,
+        "iterationCount": iters,
+        "populationSize": chains,
+        "includeStats": True,
+    }
+
+
+def _solve(base, body):
+    t0 = time.perf_counter()
+    status, resp = _post(base, "/api/vrp/sa", body)
+    wall_ms = 1e3 * (time.perf_counter() - t0)
+    assert status == 200, resp
+    msg = resp["message"]
+    return {
+        "cost": float(msg["durationSum"]),
+        "evals": int(msg["stats"]["evals"]),
+        "wallMs": round(wall_ms, 1),
+        "routes": [v["tour"][1:-1] for v in msg["vehicles"]],
+        "stats": msg["stats"],
+    }
+
+
+def run_trace(base, n, steps, iters, chains, horizon) -> list[dict]:
+    """The rolling horizon: start with the last `horizon` customers
+    ignored (not yet arrived); each step completes the lowest active
+    customer and admits the next arrival. Returns one record per
+    re-solve step."""
+    customers = list(range(1, n))
+    ignored = customers[-horizon:]
+    active = [c for c in customers if c not in ignored]
+    # horizon 0: the plan in hand before the first re-solve
+    carried = _solve(base, _body(n, iters, chains, 1, ignored))
+    results = []
+    budgets = []
+    b = iters
+    while b >= max(1, iters // 16):
+        budgets.append(b)
+        b //= 2
+    for step in range(1, steps + 1):
+        drop = active[0]
+        add = ignored[0]
+        prev_ignored = list(ignored)
+        ignored = [c for c in ignored if c != add] + [drop]
+        active = [c for c in active if c != drop] + [add]
+        seed = 1 + step
+        delta = {"drop": [drop], "add": [add]}
+        # COLD: the post-delta instance, spelled directly, full budget
+        cold = _solve(base, _body(n, iters, chains, seed, ignored))
+        # WARM: previous horizon's body + delta + carried tour
+        warm_runs = {}
+        for budget in budgets:
+            body = _body(n, budget, chains, seed, prev_ignored)
+            body["delta"] = delta
+            body["warmStart"] = {"tour": carried["routes"]}
+            warm_runs[budget] = _solve(base, body)
+        full = warm_runs[iters]
+        match_budget = None
+        for budget in sorted(budgets):
+            if warm_runs[budget]["cost"] <= cold["cost"] * (1 + REL_EPS):
+                match_budget = budget
+                break
+        rec = {
+            "step": step,
+            "drop": drop,
+            "add": add,
+            "coldCost": cold["cost"],
+            "coldEvals": cold["evals"],
+            "coldWallMs": cold["wallMs"],
+            "warmFullCost": full["cost"],
+            "warmFullEvals": full["evals"],
+            "neverWorse": full["cost"] <= cold["cost"] * (1 + REL_EPS),
+            "matchBudget": match_budget,
+            "matchEvals": (
+                None if match_budget is None
+                else warm_runs[match_budget]["evals"]
+            ),
+            "matchWallMs": (
+                None if match_budget is None
+                else warm_runs[match_budget]["wallMs"]
+            ),
+            "evalsRatio": (
+                None if match_budget is None
+                else round(
+                    cold["evals"]
+                    / max(1, warm_runs[match_budget]["evals"]), 2
+                )
+            ),
+            "seeded": full["stats"]["resolve"]["seeded"],
+            "continuation": full["stats"]["resolve"]["continuation"],
+        }
+        results.append(rec)
+        carried = full  # the fleet runs the warm plan forward
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=14,
+                    help="locations incl. depot")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=600)
+    ap.add_argument("--chains", type=int, default=16)
+    ap.add_argument("--horizon", type=int, default=4,
+                    help="customers initially outside the horizon")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    os.environ["VRPMS_STORE"] = "memory"
+    os.environ["VRPMS_CACHE"] = "off"
+    _seed_store(args.n)
+    from service.app import serve
+
+    srv = serve(port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        steps = run_trace(
+            base, args.n, args.steps, args.iters, args.chains, args.horizon
+        )
+    finally:
+        srv.shutdown()
+        from service.jobs import shutdown_scheduler
+
+        shutdown_scheduler()
+
+    ratios = [s["evalsRatio"] for s in steps]
+    never_worse = all(s["neverWorse"] for s in steps)
+    matched = all(r is not None for r in ratios)
+    min_ratio = min(ratios) if matched else 0.0
+    import jax
+
+    record = {
+        "bench": "resolve_delta",
+        "config": {
+            "n": args.n, "steps": args.steps, "iters": args.iters,
+            "chains": args.chains, "horizon": args.horizon,
+            "backend": jax.default_backend(),
+            "cache": "off",
+        },
+        "steps": steps,
+        "summary": {
+            "minEvalsRatio": min_ratio,
+            "medianEvalsRatio": sorted(ratios)[len(ratios) // 2]
+            if matched else None,
+            "neverWorseAtEqualBudget": never_worse,
+        },
+        "gate": {
+            "evalsRatioMin": GATE_EVALS_RATIO,
+            "pass": bool(never_worse and matched
+                         and min_ratio >= GATE_EVALS_RATIO),
+        },
+    }
+    out = json.dumps(record, indent=2)
+    print(out)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0 if record["gate"]["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

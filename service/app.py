@@ -24,6 +24,7 @@ from service import obs
 from service.api.index import handler as health_handler
 from service.debug import TraceDetailHandler, TracesHandler
 from service.jobs import (
+    JobResolveHandler,
     JobsHandler,
     JobStatusHandler,
     JobStreamHandler,
@@ -73,12 +74,14 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
         cls = ROUTES.get(path)
         if cls is None and path.startswith("/api/jobs/"):
             # parameterized routes: /api/jobs/{id} status polls and
-            # cancels, /api/jobs/{id}/stream live SSE progress
-            cls = (
-                JobStreamHandler
-                if path.endswith("/stream")
-                else JobStatusHandler
-            )
+            # cancels, /api/jobs/{id}/stream live SSE progress,
+            # /api/jobs/{id}/resolve cancel-and-resolve
+            if path.endswith("/stream"):
+                cls = JobStreamHandler
+            elif path.endswith("/resolve"):
+                cls = JobResolveHandler
+            else:
+                cls = JobStatusHandler
         if cls is None and path.startswith("/api/debug/traces/"):
             # parameterized route: /api/debug/traces/{traceId}
             cls = TraceDetailHandler

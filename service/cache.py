@@ -49,11 +49,11 @@ import json
 import os
 
 import numpy as np
-import jax.numpy as jnp
 
 from service import obs
 from store.base import cache_enabled
 from vrpms_tpu.core import tiers
+from vrpms_tpu.core.delta import repair_perm, strip_order  # noqa: F401
 from vrpms_tpu.obs import log_event, spans
 
 #: request options that parameterize the solver program or its result —
@@ -175,55 +175,17 @@ def _request_key(prep, fingerprint: str) -> str:
 # ---------------------------------------------------------------------------
 
 
-def strip_order(routes, active_ids: list) -> tuple[list, set]:
-    """The shared strip step of every cached-tour repair: surviving
-    customers of `routes` (ORIGINAL location ids) as positions in the
-    CURRENT active indexing, relative visit order preserved; also the
-    set of positions covered. Used by both the legacy checkpoint
-    re-seed (service.solve._warm_perm) and near-hit repair."""
-    index_of = {cid: i for i, cid in enumerate(active_ids)}
-    seen: set = set()
-    order: list = []
-    for route in routes:
-        for cid in route:
-            pos = index_of.get(cid)
-            if pos is not None and pos > 0 and pos not in seen:
-                order.append(pos)
-                seen.add(pos)
-    return order, seen
-
-
 def _repair_perm(prep, routes):
-    """Strip-and-insert repair over the separator encoding.
-
-    `routes` hold ORIGINAL location ids from the cached solution.
-    Surviving customers keep their relative visit order (strip = drop
-    ids not in the current active set); new customers are greedy-
-    inserted at the cheapest position by slice-0 durations from the
-    prepared instance (active indexing — the padded tensor's real
-    prefix). Returns an int32 permutation of the active positions
-    1..n-1, the exact shape the warm-start machinery consumes, or None
-    when nothing survives to seed from.
-    """
-    order, seen = strip_order(routes, prep.orig_ids)
-    new = [i for i in range(1, len(prep.orig_ids)) if i not in seen]
-    if not order:
-        # nothing survived: appending alone would be an arbitrary-order
-        # seed, no better than construction — decline to seed
-        return None
-    if new:
-        d = np.asarray(prep.inst.durations)[0]
-        seq = [0] + order + [0]
-        for c in new:
-            best_delta, best_at = None, 1
-            for k in range(1, len(seq)):
-                a, b = seq[k - 1], seq[k]
-                delta = float(d[a, c] + d[c, b] - d[a, b])
-                if best_delta is None or delta < best_delta:
-                    best_delta, best_at = delta, k
-            seq.insert(best_at, c)
-        order = seq[1:-1]
-    return jnp.asarray(order, dtype=jnp.int32)
+    """Strip-and-insert repair over the separator encoding — the shared
+    vrpms_tpu.core.delta.repair_perm, bound to this request's active
+    ids and its prepared instance's slice-0 durations (active indexing
+    — the padded tensor's real prefix). `routes` hold ORIGINAL location
+    ids from the prior solution; the result is the int32 permutation of
+    active positions 1..n-1 the warm-start machinery consumes, or None
+    when nothing survives to seed from."""
+    return repair_perm(
+        routes, prep.orig_ids, np.asarray(prep.inst.durations)[0]
+    )
 
 
 def _pick_seed(prep, rows, explicit: bool):
@@ -275,6 +237,198 @@ def _legacy_warm(prep, database) -> None:
     prep.warm = _warm_perm(state, prep.orig_ids, prep.problem)
 
 
+#: explicit warm-start spec keys — a request's `warmStart` may be an
+#: OBJECT naming its seed source instead of the legacy boolean
+_RESOLVE_KEYS = ("tour", "jobId", "fingerprint")
+
+
+def validate_warm_spec(spec: dict) -> None:
+    """Shape-validate an explicit warmStart object; raises ValueError
+    with the 400-envelope wording. Exposed so the resolve endpoint can
+    reject a malformed spec BEFORE cancelling the predecessor job
+    (service.jobs._parse_submit) — _attach_resolve re-runs it at
+    prepare time for every other intake path."""
+    unknown = [k for k in spec if k not in _RESOLVE_KEYS]
+    if unknown:
+        raise ValueError(
+            f"unknown warmStart key(s) {unknown}; a warmStart object "
+            f"takes one of {list(_RESOLVE_KEYS)}"
+        )
+    if not any(spec.get(k) is not None for k in _RESOLVE_KEYS):
+        raise ValueError(
+            f"a warmStart object must carry one of {list(_RESOLVE_KEYS)}"
+        )
+    tour = spec.get("tour")
+    if tour is not None and (not isinstance(tour, list) or not tour):
+        raise ValueError(
+            "warmStart.tour must be a non-empty list (routes of "
+            "location ids, or one flat visit order)"
+        )
+    job_id = spec.get("jobId")
+    if job_id is not None and (not isinstance(job_id, str) or not job_id):
+        raise ValueError("warmStart.jobId must be a job id string")
+    fp = spec.get("fingerprint")
+    if fp is not None and (not isinstance(fp, str) or not fp):
+        raise ValueError(
+            "warmStart.fingerprint must be an instance fingerprint "
+            "string (stats.cache.fingerprint of a prior solve)"
+        )
+
+
+def _routes_from_job_record(record, problem: str):
+    """Routes (original ids) out of a terminal job record's result
+    message, or None when the record cannot seed (not done, wrong
+    problem, no tours)."""
+    if not isinstance(record, dict) or record.get("status") != "done":
+        return None
+    rec_problem = record.get("problem")
+    if rec_problem is not None and rec_problem != problem:
+        return None
+    msg = record.get("message")
+    if not isinstance(msg, dict):
+        return None
+    if problem == "vrp":
+        vehicles = msg.get("vehicles")
+        if not isinstance(vehicles, list):
+            return None
+        return [
+            v["tour"][1:-1]
+            for v in vehicles
+            if isinstance(v, dict) and isinstance(v.get("tour"), list)
+        ]
+    tour = msg.get("vehicle")
+    if not isinstance(tour, list):
+        return None
+    return [tour[1:-1]]
+
+
+def _job_seed_record(job_id: str, database):
+    """A prior job's record for seeding: the live in-process registry
+    first (a just-cancelled predecessor's result is authoritative there
+    the instant its done_event fires, before the terminal store persist
+    settles), then the store's record. Best-effort — a miss degrades to
+    an unseeded solve."""
+    try:
+        from service.jobs import get_live_job
+
+        job = get_live_job(job_id)
+        if (
+            job is not None
+            and job.done_event.is_set()
+            and isinstance(job.result, dict)
+        ):
+            return {
+                "status": job.status,
+                "problem": (job.payload or {}).get("problem"),
+                "message": job.result,
+            }
+    except Exception:
+        pass
+    return database.get_job_seed(job_id)
+
+
+def _resolve_seed_routes(prep, spec: dict, database):
+    """(routes, seed_source) for an explicit warm-start spec, trying the
+    spec's sources in fidelity order: an inline tour needs no store at
+    all; a jobId reads the job record (live registry, then store —
+    INDEPENDENT of VRPMS_CACHE, job records are not cache entries); a
+    fingerprint needs the cache family index and so only resolves with
+    the cache on."""
+    tour = spec.get("tour")
+    if tour is not None:
+        routes = tour if isinstance(tour[0], list) else [tour]
+        return routes, "tour"
+    job_id = spec.get("jobId")
+    if job_id is not None:
+        if database is not None:
+            routes = _routes_from_job_record(
+                _job_seed_record(job_id, database), prep.problem
+            )
+            if routes:
+                return routes, "job"
+        return None, "miss"
+    fp = spec.get("fingerprint")
+    if fp is not None:
+        if database is not None and cache_enabled():
+            rows = database.get_cache_family(_ensure_family(prep))
+            for row in rows:
+                entry = row.get("entry") or row
+                if (
+                    entry.get("fingerprint") == fp
+                    and entry.get("problem") == prep.problem
+                    and row.get("key") is not None
+                ):
+                    full = (
+                        database.get_cached_solution(row["key"]) or {}
+                    ).get("entry") or {}
+                    if full.get("routes"):
+                        return full["routes"], "fingerprint"
+        return None, "miss"
+    return None, "miss"
+
+
+def _attach_resolve(prep, spec: dict, locations, matrix, database) -> None:
+    """Resolve an EXPLICIT warm-start spec (warmStart as an object) —
+    the dynamic re-solve seed path. Runs whether or not the solution
+    cache is enabled: an inline tour and a jobId must keep seeding with
+    VRPMS_CACHE=off (only the fingerprint source rides the cache's
+    family index). Malformed specs raise ValueError, which the prepare
+    wrappers turn into the contract's 400 Data-error envelope; a
+    well-formed spec that simply fails to resolve degrades to an
+    unseeded solve, disclosed in stats.resolve and the
+    vrpms_resolve_total{seed_source="miss"} counter."""
+    validate_warm_spec(spec)
+    if cache_enabled() and database is not None:
+        # cache bookkeeping: the outcome is the resolve path's own
+        # (never exact — an explicitly seeded request is never SERVED
+        # from the index, because its seed content can drift under an
+        # unchanged key), but the solved result still WRITES a family
+        # entry, so later rolling-horizon requests can near-hit-seed
+        # from this horizon's solution without an explicit spec
+        fingerprint = tiers.fingerprint(prep.inst)
+        prep.cache = {
+            "outcome": "resolve",
+            "fingerprint": fingerprint,
+            "key": _request_key(prep, fingerprint),
+            "_family_args": (locations, matrix),
+        }
+    source = "miss"
+    with spans.span("resolve", op="seed") as sp:
+        routes = None
+        if _warm_supported(prep):
+            try:
+                routes, source = _resolve_seed_routes(prep, spec, database)
+            except ValueError:
+                raise
+            except Exception as exc:
+                # a junk store row or record shape must degrade to an
+                # unseeded solve, never fail the request it fronts
+                routes, source = None, "miss"
+                log_event(
+                    "resolve.error",
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+        if routes:
+            prep.warm = _repair_perm(prep, routes)
+        if prep.warm is None:
+            source = "miss"
+        if sp is not None:
+            sp.set(seedSource=source, seeded=prep.warm is not None)
+    prep.resolve = {
+        "seedSource": source,
+        "seeded": prep.warm is not None,
+    }
+    if spec.get("jobId") is not None:
+        prep.resolve["jobId"] = spec["jobId"]
+    obs.RESOLVE.labels(seed_source=source).inc()
+    log_event(
+        "resolve.seed",
+        seedSource=source,
+        seeded=prep.warm is not None,
+        jobId=spec.get("jobId"),
+    )
+
+
 def attach(prep, locations, matrix, database) -> None:
     """Consult the cache for a prepared request (the one choke point,
     called at the tail of prepare_vrp/prepare_tsp on the HTTP thread).
@@ -297,7 +451,14 @@ def attach(prep, locations, matrix, database) -> None:
     With VRPMS_CACHE=off nothing here runs except the legacy warmStart
     path — responses stay byte-identical to the pre-cache service.
     """
-    wants_warm = bool(prep.opts.get("warm_start")) and _warm_supported(prep)
+    spec = prep.opts.get("warm_start")
+    if isinstance(spec, dict):
+        # explicit seed source (dynamic re-solve): its own path, live
+        # with or without the cache — an inline tour needs no store at
+        # all, so this runs BEFORE the database/None early-out
+        _attach_resolve(prep, spec, locations, matrix, database)
+        return
+    wants_warm = bool(spec) and _warm_supported(prep)
     if database is None:
         return
     if not cache_enabled():
@@ -491,7 +652,7 @@ def store_result(prep, result, routes, cost) -> dict:
             "lookup": prep.cache.get("outcome", "miss"),
             "seeded": bool(
                 prep.warm is not None
-                and prep.cache.get("outcome") in ("near", "warm")
+                and prep.cache.get("outcome") in ("near", "warm", "resolve")
             ),
         }
     if prep.cache.get("outcome") == "exact" or "key" not in prep.cache:

@@ -95,6 +95,22 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
             fail(self, errors)
             return
 
+        # Dynamic re-solve delta: rewrite the dataset view BEFORE the
+        # solve AND the save — the active-set params mutate in place and
+        # the returned locations carry demand/time-window changes, so
+        # the instance build, the cache keys, and the persisted solution
+        # all see the post-delta world (vrpms_tpu.core.delta).
+        if opts.get("delta") is not None:
+            from vrpms_tpu.core.delta import apply_request_delta
+
+            with spans.span("resolve.delta", problem=self.problem):
+                locations = apply_request_delta(
+                    self.problem, params, locations, opts["delta"], errors
+                )
+            if locations is None or errors:
+                fail(self, errors)
+                return
+
         # Run algorithm (the reference's TODO hole, realised) — via the
         # scheduler: this thread submits and parks on the job event, the
         # device-owning worker solves (merging concurrent same-shape

@@ -528,6 +528,11 @@ def _job_record(job: Job) -> dict:
         "requestId": job.request_id,
         "traceId": job.trace.trace_id if job.trace is not None else None,
     }
+    resolved_from = (job.payload or {}).get("resolved_from")
+    if resolved_from:
+        # cancel-and-resolve lineage: this job continued that one's
+        # incumbent (POST /api/jobs/{id}/resolve)
+        rec["resolvedFrom"] = resolved_from
     if job.sink is not None:
         snap = job.sink.snapshot()
         if snap is not None:
@@ -796,139 +801,213 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
     def do_POST(self):
         obs.begin_request_obs(self)
         try:
-            self._submit()
+            content = read_json_body(self)
+            if content is not None:
+                _submit_content(self, content)
         finally:
             obs.end_request_obs(self)
 
-    def _submit(self):
-        with spans.span("parse"):
-            content = read_json_body(self)
-            if content is None:
-                return
 
-            problem = content.get("problem")
-            algorithm = content.get("algorithm")
-            errors: list = []
-            if problem not in ("vrp", "tsp"):
-                errors += [{
-                    "what": "Missing parameter",
-                    "reason": "'problem' must be 'vrp' or 'tsp'",
-                }]
-            if algorithm not in ("ga", "sa", "aco", "bf"):
-                errors += [{
-                    "what": "Missing parameter",
-                    "reason": "'algorithm' must be one of ga|sa|aco|bf",
-                }]
-            if errors:
-                fail(self, errors)
-                return
-            self.algorithm = algorithm  # request-counter label parity
-            self.problem = problem
-
-            parse_common, parse_algo = _PARSERS[(problem, algorithm)]
-            params = parse_common(content, errors)
-            algo_params = parse_algo(content, errors) if parse_algo else {}
-            opts = parse_solver_options(content, errors)
-        if errors:
-            fail(self, errors)
-            return
-        try:
-            database = store.get_database(problem, params["auth"])
-        except Exception as e:
-            fail(self, [{"what": "Database error", "reason": str(e)}])
-            return
-        with spans.span("store.read", tables="locations,durations"):
-            locations = database.get_locations_by_id(params["locations_key"], errors)
-            durations = database.get_durations_by_id(params["durations_key"], errors)
-        if errors:
-            fail(self, errors)
-            return
-        prep = prepare_request(problem, algorithm, params, opts, algo_params,
-                               locations, durations, errors, database)
-        if prep is None or errors:
-            fail(self, errors)
-            return
-
-        job = Job(
-            payload={
-                "prep": prep,
-                "problem": problem,
-                "algorithm": algorithm,
-                "job_db": store.get_database(problem, None),
-            },
-            bucket=_bucket_key(prep),
-            time_limit=_job_time_limit(opts),
-            request_id=self._request_id,
-            trace=self._trace,
-            span=self._trace_root,
-        )
-        if prep.trivial is not None or prep.cached is not None:
-            # nothing to schedule: the job is born done (a trivial
-            # zero-customer request, or an exact cache hit — the cached
-            # routes/cost/certificate ARE the result, so the admission
-            # queue and the solver are bypassed entirely)
-            if prep.cached is not None:
-                job.result = solution_cache.serve_hit(prep)
-            else:
-                job.result = _mark_degraded(
-                    prep, solution_cache.mark_trivial(prep)
-                )
-            job.finish(DONE)
-            _persist(job)
-            obs.JOBS_TOTAL.labels(outcome="done").inc()
-            _respond(self, 202, {
-                "success": True, "jobId": job.id, "status": job.status,
-            })
-            return
-        # live-progress mailbox + registry entry BEFORE the submit: the
-        # worker may pop the job the instant it lands, and the runner
-        # reads job.sink then
-        _attach_sink(job, prep)
-        _register_live(job)
-        try:
-            _persist(job)  # queued record first: a poll can never 404
-            # a job whose id was already returned
-            if self._trace is not None:
-                # the 202 leaves now; the worker finishes the trace at
-                # the job's terminal transition (service._on_event)
-                self._trace.deferred = True
-            get_scheduler().submit(job, backend=_backend_label(opts))
-        except QueueFull as e:
-            if self._trace is not None:
-                self._trace.deferred = False  # never scheduled: ours again
-            if job.sink is not None:
-                job.sink.close("failed")
-            _drop_live(job.id)
-            obs.SCHED_REJECTS.labels(reason="queue_full").inc()
-            obs.JOBS_TOTAL.labels(outcome="failed").inc()
-            job.errors = [{
-                "what": "Too busy",
-                "reason": "solver admission queue was full at submit",
+def _parse_submit(handler, content: dict) -> dict | None:
+    """The fallible-without-side-effects front half of an async submit:
+    body shape, params/options parsing, store reads, and delta
+    validation+application — everything that can 400 WITHOUT consulting
+    the scheduler (or, on the resolve path, before the predecessor job
+    is touched). Responds with the error envelope itself and returns
+    None, or returns the parsed request context."""
+    self = handler
+    with spans.span("parse"):
+        problem = content.get("problem")
+        algorithm = content.get("algorithm")
+        errors: list = []
+        if problem not in ("vrp", "tsp"):
+            errors += [{
+                "what": "Missing parameter",
+                "reason": "'problem' must be 'vrp' or 'tsp'",
             }]
-            job.finish(FAILED)
-            _persist(job)
-            too_busy(self, e.retry_after_s)
-            return
-        except BaseException:
-            # any other submit-path failure: the job will never run —
-            # a leaked registry entry would hold the prepared instance
-            # forever and answer DELETEs 202 for a ghost
-            if self._trace is not None:
-                self._trace.deferred = False
-            if job.sink is not None:
-                job.sink.close("failed")
-            _drop_live(job.id)
-            raise
+        if algorithm not in ("ga", "sa", "aco", "bf"):
+            errors += [{
+                "what": "Missing parameter",
+                "reason": "'algorithm' must be one of ga|sa|aco|bf",
+            }]
+        if errors:
+            fail(self, errors)
+            return None
+        self.algorithm = algorithm  # request-counter label parity
+        self.problem = problem
+
+        parse_common, parse_algo = _PARSERS[(problem, algorithm)]
+        params = parse_common(content, errors)
+        algo_params = parse_algo(content, errors) if parse_algo else {}
+        opts = parse_solver_options(content, errors)
+        spec = opts.get("warm_start")
+        if isinstance(spec, dict):
+            # spec SHAPE errors are 400s and must surface here, before
+            # any resolve-path cancellation (resolution itself — the
+            # store reads — stays in prepare)
+            try:
+                solution_cache.validate_warm_spec(spec)
+            except ValueError as e:
+                errors += [{"what": "Data error", "reason": str(e)}]
+    if errors:
+        fail(self, errors)
+        return None
+    try:
+        database = store.get_database(problem, params["auth"])
+    except Exception as e:
+        fail(self, [{"what": "Database error", "reason": str(e)}])
+        return None
+    with spans.span("store.read", tables="locations,durations"):
+        locations = database.get_locations_by_id(params["locations_key"], errors)
+        durations = database.get_durations_by_id(params["durations_key"], errors)
+    if errors:
+        fail(self, errors)
+        return None
+    # dynamic re-solve delta, same hook as the sync surface
+    # (service.handler_base): the dataset view is rewritten before the
+    # instance is built so fingerprints/tiers/cache keys see the
+    # post-delta world
+    if opts.get("delta") is not None:
+        from vrpms_tpu.core.delta import apply_request_delta
+
+        with spans.span("resolve.delta", problem=problem):
+            locations = apply_request_delta(
+                problem, params, locations, opts["delta"], errors
+            )
+        if locations is None or errors:
+            fail(self, errors)
+            return None
+    return {
+        "problem": problem,
+        "algorithm": algorithm,
+        "params": params,
+        "algo_params": algo_params,
+        "opts": opts,
+        "database": database,
+        "locations": locations,
+        "durations": durations,
+    }
+
+
+def _submit_content(handler, content: dict, resolve_from: str | None = None):
+    """The async submit pipeline shared by POST /api/jobs and POST
+    /api/jobs/{id}/resolve: parse -> store reads -> delta -> prepare ->
+    enqueue (or born-done) -> 202. `resolve_from` marks a successor job
+    from the cancel-and-resolve path: it rides the job payload into the
+    persisted record (`resolvedFrom`) and annotates the trace root, so
+    the lineage from the cancelled job to its successor is visible in
+    both the record and the waterfall."""
+    ctx = _parse_submit(handler, content)
+    if ctx is None:
+        return
+    _submit_parsed(handler, ctx, resolve_from)
+
+
+def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
+    """The back half of an async submit: prepare (instance build + seed
+    resolution) and enqueue. On the resolve path this runs AFTER the
+    predecessor was cancelled and reached its terminal record — seed
+    retrieval needs the final incumbent to exist."""
+    self = handler
+    problem, algorithm = ctx["problem"], ctx["algorithm"]
+    params, opts, algo_params = ctx["params"], ctx["opts"], ctx["algo_params"]
+    database = ctx["database"]
+    errors: list = []
+    prep = prepare_request(problem, algorithm, params, opts, algo_params,
+                           ctx["locations"], ctx["durations"], errors,
+                           database)
+    if prep is None or errors:
+        fail(self, errors)
+        return
+
+    if resolve_from and self._trace_root is not None:
+        # the successor's waterfall names its predecessor — the other
+        # half of the lineage lives in the persisted record below
+        self._trace_root.set(resolvedFrom=resolve_from)
+    payload = {
+        "prep": prep,
+        "problem": problem,
+        "algorithm": algorithm,
+        "job_db": store.get_database(problem, None),
+    }
+    if resolve_from:
+        payload["resolved_from"] = resolve_from
+    job = Job(
+        payload=payload,
+        bucket=_bucket_key(prep),
+        time_limit=_job_time_limit(opts),
+        request_id=self._request_id,
+        trace=self._trace,
+        span=self._trace_root,
+    )
+    if prep.trivial is not None or prep.cached is not None:
+        # nothing to schedule: the job is born done (a trivial
+        # zero-customer request, or an exact cache hit — the cached
+        # routes/cost/certificate ARE the result, so the admission
+        # queue and the solver are bypassed entirely)
+        if prep.cached is not None:
+            job.result = solution_cache.serve_hit(prep)
+        else:
+            job.result = _mark_degraded(
+                prep, solution_cache.mark_trivial(prep)
+            )
+        job.finish(DONE)
+        _persist(job)
+        obs.JOBS_TOTAL.labels(outcome="done").inc()
         _respond(self, 202, {
             "success": True, "jobId": job.id, "status": job.status,
         })
+        return
+    # live-progress mailbox + registry entry BEFORE the submit: the
+    # worker may pop the job the instant it lands, and the runner
+    # reads job.sink then
+    _attach_sink(job, prep)
+    _register_live(job)
+    try:
+        _persist(job)  # queued record first: a poll can never 404
+        # a job whose id was already returned
+        if self._trace is not None:
+            # the 202 leaves now; the worker finishes the trace at
+            # the job's terminal transition (service._on_event)
+            self._trace.deferred = True
+        get_scheduler().submit(job, backend=_backend_label(opts))
+    except QueueFull as e:
+        if self._trace is not None:
+            self._trace.deferred = False  # never scheduled: ours again
+        if job.sink is not None:
+            job.sink.close("failed")
+        _drop_live(job.id)
+        obs.SCHED_REJECTS.labels(reason="queue_full").inc()
+        obs.JOBS_TOTAL.labels(outcome="failed").inc()
+        job.errors = [{
+            "what": "Too busy",
+            "reason": "solver admission queue was full at submit",
+        }]
+        job.finish(FAILED)
+        _persist(job)
+        too_busy(self, e.retry_after_s)
+        return
+    except BaseException:
+        # any other submit-path failure: the job will never run —
+        # a leaked registry entry would hold the prepared instance
+        # forever and answer DELETEs 202 for a ghost
+        if self._trace is not None:
+            self._trace.deferred = False
+        if job.sink is not None:
+            job.sink.close("failed")
+        _drop_live(job.id)
+        raise
+    resp = {"success": True, "jobId": job.id, "status": job.status}
+    if resolve_from:
+        resp["resolvedFrom"] = resolve_from
+    _respond(self, 202, resp)
 
 
 def _job_id_from_path(path: str) -> str:
-    """The {id} segment of /api/jobs/{id}[/stream] — the ONE parser
-    every per-job handler uses."""
+    """The {id} segment of /api/jobs/{id}[/stream|/resolve] — the ONE
+    parser every per-job handler uses."""
     parts = [p for p in path.split("?", 1)[0].rstrip("/").split("/") if p]
-    if parts and parts[-1] == "stream":
+    if parts and parts[-1] in ("stream", "resolve"):
         parts = parts[:-1]
     return parts[-1] if parts else ""
 
@@ -1194,6 +1273,90 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
         self._emit(
             "done" if job.status == DONE else "failed", _job_record(job)
         )
+
+
+class JobResolveHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """POST /api/jobs/{id}/resolve — cancel-and-resolve for dynamic
+    re-solves: cooperatively cancel a running job, take its final
+    incumbent as the warm seed, apply the request's `delta`, and submit
+    the successor job.
+
+    The body is a full solve request (same schema as POST /api/jobs,
+    `delta` and `warmStart` included); when it carries no explicit
+    `warmStart`, `{"jobId": "{id}"}` is injected so the successor seeds
+    from the predecessor's result. Sequence:
+
+      1. fully parse and validate the body — params, options, the
+         warm-spec shape, and the delta against the dataset — so every
+         400 lands BEFORE the predecessor is touched (a malformed
+         successor must not cost the running job its budget);
+      2. if the job is live here, flag its sink (the PR-7 cooperative
+         cancel) and wait for the terminal transition — the cancelled
+         job completes with its incumbent as a normal `done` record;
+      3. submit the successor through the standard async pipeline; the
+         202 carries the new jobId plus `resolvedFrom`, the successor's
+         record and trace are linked the same way, and — because clone
+         0 of a warm seed is exactly the seed — its first published
+         incumbent is never worse than the predecessor's final one on
+         the unchanged customer set.
+
+    Answers: 202 (submitted), 400 (bad body), 404 (unknown job), 409
+    (the predecessor did not reach a terminal state in time — e.g. a
+    sink-less VRPMS_PROGRESS=off job mid-solve)."""
+
+    algorithm = ""
+
+    def do_POST(self):
+        obs.begin_request_obs(self)
+        try:
+            self._resolve()
+        finally:
+            obs.end_request_obs(self)
+
+    def _resolve(self):
+        job_id = _job_id_from_path(self.path)
+        content = read_json_body(self)
+        if content is None:
+            return
+        # the FULL fallible front half — body shape, params, options,
+        # warm-spec shape, store reads, delta validation — runs before
+        # the predecessor is touched: a malformed successor must not
+        # cost the running job its budget (every 400 lands here)
+        ctx = _parse_submit(self, content)
+        if ctx is None:
+            return
+        live = get_live_job(job_id)
+        if live is not None and not live.done_event.is_set():
+            if live.sink is not None:
+                live.sink.cancel()
+                log_event(
+                    "job.cancel_requested", jobId=job_id,
+                    status=live.status, resolve=True,
+                )
+            wait_s = float(os.environ.get("VRPMS_RESOLVE_WAIT_S", "30"))
+            if not live.wait(timeout=wait_s):
+                self._obs_errors = ["Conflict"]
+                _respond(self, 409, {
+                    "success": False,
+                    "errors": [{
+                        "what": "Conflict",
+                        "reason": f"job {job_id!r} did not reach a "
+                        f"terminal state within {wait_s:g}s "
+                        "(cancellation is cooperative; a sink-less job "
+                        "runs to completion) — retry once it finishes",
+                    }],
+                })
+                return
+        elif live is None:
+            # not ours and not live: the persisted record decides 404
+            # vs. proceed (another replica's finished job seeds fine)
+            record = _load_job_record(self, job_id)
+            if record is None:
+                return
+        if ctx["opts"].get("warm_start") is None:
+            ctx["opts"]["warm_start"] = {"jobId": job_id}
+        log_event("job.resolve", jobId=job_id)
+        _submit_parsed(self, ctx, resolve_from=job_id)
 
 
 # ---------------------------------------------------------------------------

@@ -113,6 +113,13 @@ PROGRESS_EVENTS = REGISTRY.counter(
     "Incumbent progress snapshots published by running solves "
     "(improving block boundaries; the SSE stream's event source)",
 )
+RESOLVE = REGISTRY.counter(
+    "vrpms_resolve_total",
+    "Dynamic re-solve warm-seed resolutions by seed source (tour = "
+    "inline giant tour, job = a prior job's result record, fingerprint "
+    "= cache family index entry, miss = no usable seed — solved cold)",
+    labels=("seed_source",),
+)
 JOBS_FAILED = REGISTRY.counter(
     "vrpms_jobs_failed_total",
     "Job failures by cause (runner = runner exception, crash = worker "
@@ -313,6 +320,8 @@ def route_label(path: str) -> str:
         # per-id status polls / streams must not mint a series per job
         if path.endswith("/stream"):
             return "/api/jobs/{id}/stream"
+        if path.endswith("/resolve"):
+            return "/api/jobs/{id}/resolve"
         return "/api/jobs/{id}"
     if path.startswith("/api/debug/traces/"):
         # same rule for per-trace detail reads

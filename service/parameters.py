@@ -78,10 +78,36 @@ def parse_solver_options(content: dict, errors):
                         require it; optional everywhere else)
     populationSize:     SA chains / GA population / ACO ants
     timeSliceDuration:  minutes per time-of-day slice of a 3-D matrix
-    warmStart:          seed the search from the solution previously
-                        checkpointed under this solutionName (SA/GA
-                        chain/population seeding; ACO: colony incumbent
-                        + pheromone head start)
+    warmStart:          seed the search from a prior solution. A truthy
+                        scalar keeps the legacy semantics (the solution
+                        previously checkpointed under this solutionName,
+                        retrieved through the cache family index; SA/GA
+                        chain/population seeding, ACO colony incumbent).
+                        An OBJECT names an explicit seed source for a
+                        dynamic re-solve — one of:
+                          {"tour": [[...route ids], ...] | [flat order]}
+                            an inline giant tour / visit order,
+                          {"jobId": "..."} a prior job's result
+                            (live registry or the persisted job record;
+                            works with VRPMS_CACHE=off),
+                          {"fingerprint": "..."} a cached solution by
+                            instance fingerprint (needs the cache on).
+                        The seed is repaired onto the CURRENT active
+                        customer set over the separator encoding (drop
+                        stripped, new greedy-inserted) and SA treats it
+                        as a CONTINUATION: the anneal re-enters at a
+                        temperature estimated from the repaired tour's
+                        cost instead of re-running the hot phase
+    delta:              instance delta relative to the stored dataset —
+                        {"add": [ids], "drop": [ids],
+                         "demands": {id: value},
+                         "timeWindows": {id: [ready, due] | null}} —
+                        applied before the instance is built (VRP:
+                        add/drop move ids out of / into the ignored
+                        list; TSP: they edit the customers list).
+                        Composes with warmStart for rolling-horizon
+                        re-solves; invalid ids and duplicate adds are
+                        400 Data errors
     includeStats:       attach solver statistics to the result message
     profile:            capture a jax.profiler trace of the solve
     timeLimit:          wall-clock budget in seconds; every solver
@@ -139,6 +165,7 @@ def parse_solver_options(content: dict, errors):
             "timeSliceDuration", content, errors, optional=True
         ),
         "warm_start": get_parameter("warmStart", content, errors, optional=True),
+        "delta": get_parameter("delta", content, errors, optional=True),
         "include_stats": get_parameter("includeStats", content, errors, optional=True),
         "profile": get_parameter("profile", content, errors, optional=True),
         "time_limit": get_parameter("timeLimit", content, errors, optional=True),

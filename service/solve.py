@@ -292,8 +292,16 @@ def _enum_certificate(res, inst, split_exact: bool) -> dict:
 
 
 def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None, w=None,
-                    extras=None):
-    """Dispatch to the solver; returns a SolveResult or None (errors filled)."""
+                    extras=None, continuation=False):
+    """Dispatch to the solver; returns a SolveResult or None (errors filled).
+
+    `continuation` marks a warm seed that came from an explicit re-solve
+    source (a prior job's incumbent, an inline tour, a fingerprint) —
+    SA then CONTINUES annealing from the repaired incumbent instead of
+    re-running the high-temperature phase: the schedule's t0 is
+    estimated from the seed tour's cost (sa.continuation_params), which
+    is what lets a warm delta re-solve match a cold solve's cost in a
+    fraction of the evals (benchmarks/resolve_delta.py)."""
     seed = int(opts.get("seed") or 0)
     iters = opts.get("iteration_count")
     pop = opts.get("population_size")
@@ -408,6 +416,16 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 n_chains=int(pop or 128),
                 n_iters=int(iters or 5000),
             )
+            if continuation and warm is not None:
+                # continuation budget: re-enter the anneal at a
+                # temperature estimated from the repaired seed's cost
+                # (never hotter than a plain warm start) so the whole
+                # iteration budget refines instead of re-melting
+                from vrpms_tpu.solvers.sa import continuation_params
+
+                p = continuation_params(
+                    inst, p, greedy_split_giant(warm, inst), w
+                )
             # explicit 0 means "ILS off" (plain SA), like timeLimit's 0
             ils_rounds = _positive_int(opts, "ils_rounds", 0, "ilsRounds", zero_ok=True)
             if islands:
@@ -719,7 +737,7 @@ def _polish(res, inst, opts, w, t_start):
 
 
 def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
-                extras=None):
+                extras=None, continuation=False):
     """Timed + optionally profiled dispatch; returns (res, stats|None).
 
     `extras`, when given, is filled with solver-path metadata that
@@ -744,7 +762,7 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
         ) as solve_span:
             res = _solve_instance(
                 inst, algorithm, opts, ga_params, errors, problem, warm, w,
-                extras,
+                extras, continuation,
             )
         t_polish = time.perf_counter()
         if _polish_spec(opts) and res is not None:
@@ -835,6 +853,11 @@ class Prepared:
     # without enqueueing; solve_prepared serves it inline)
     cache: dict | None = None
     cached: dict | None = None
+    # dynamic re-solve context (service.cache._attach_resolve): how an
+    # explicit warmStart spec resolved — {seedSource, seeded, jobId?}.
+    # A seeded resolve drives SA's continuation schedule and is
+    # disclosed under stats.resolve
+    resolve: dict | None = None
 
 
 def prepare_vrp(algorithm, params, opts, ga_params, locations, matrix,
@@ -990,13 +1013,19 @@ def solve_prepared(prep: Prepared, errors) -> dict | None:
     # merged never reaches solve_prepared, so batching is preserved
     solution_cache.apply_deferred_seed(prep)
     extras: dict = {}
+    continuation = bool(prep.resolve and prep.resolve.get("seeded"))
     with _device_ctx(prep.opts.get("backend")):
         res, stats = _run_solver(
             prep.inst, prep.algorithm, prep.opts, prep.ga_params, errors,
-            prep.problem, prep.warm, extras,
+            prep.problem, prep.warm, extras, continuation,
         )
     if res is None:
         return None
+    if stats is not None and prep.resolve is not None:
+        stats["resolve"] = dict(
+            prep.resolve,
+            continuation=continuation and prep.algorithm == "sa",
+        )
     if prep.problem == "vrp":
         return finish_vrp(prep, res, stats, extras, errors)
     return finish_tsp(prep, res, stats, extras, errors)

@@ -241,6 +241,20 @@ class Database:
             errors += [{"what": "Database read error", "reason": str(exc)}]
             return None
 
+    def get_job_seed(self, job_id: str) -> dict | None:
+        """Best-effort job-record read for dynamic re-solve seeding
+        (service.cache's `warmStart: {"jobId": ...}` resolution): like
+        get_job but with NO error side channel — a seed that cannot be
+        retrieved degrades to an unseeded solve, never to a failed
+        request. Reads the jobs table directly, so jobId-seeded
+        re-solves stay functional with the solution cache off
+        (VRPMS_CACHE does not gate job records)."""
+        try:
+            row = self._fetch_job(job_id)
+            return None if row is None else row.get("record")
+        except Exception:
+            return None
+
     # -- warm-start checkpoints (framework extension) -----------------------
     # The reference has no computation checkpointing; its closest analog is
     # the ignored/completed dynamic re-solve inputs (SURVEY.md §5

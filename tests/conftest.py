@@ -92,6 +92,11 @@ _SLOW_PATTERNS = (
     # 3 solves incl. a 500-iteration cache warmer; the rest of the
     # cache suite stays quick (and tier1.yml runs the file in full)
     "test_cache.py::TestNearHit::test_never_loses_to_cold_start",
+    # dynamic re-solve end-to-end solves (unit/envelope layers stay
+    # quick; tier1.yml runs the file in full)
+    "test_resolve.py::TestDeltaHTTP",
+    "test_resolve.py::TestWarmStartSpec",
+    "test_resolve.py::TestResolveEndpoint",
     "test_utils_info.py::TestSolveInfo",
     "test_fixtures.py::TestSolverBand",
     "test_sa_delta.py::TestDeltaStepKernel::test_many_steps_zero_drift_and_valid_tours",

@@ -1,0 +1,542 @@
+"""Dynamic re-solve (ISSUE 8): warm-start continuation, instance
+deltas, cancel-and-resolve.
+
+Unit layers (quick): the shared strip/insert repair always yields a
+valid permutation (and a structurally valid giant after the greedy
+split), degenerate deltas behave (everything dropped, empty routes),
+request-delta validation rejects duplicate adds / unknown ids with
+Data-error envelope entries, and the SA continuation schedule stays
+inside [t_final, warm-start t0].
+
+End-to-end layers (slow via conftest patterns; tier1.yml runs the file
+in full): delta requests solve exactly the post-delta customer set,
+`warmStart` objects seed from an inline tour and from a prior jobId
+with the cache OFF (seed retrieval must not silently depend on
+VRPMS_CACHE), and `POST /api/jobs/{id}/resolve` cancels a running job
+and hands its incumbent to the successor — whose first published
+incumbent never costs more than the predecessor's final one.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from vrpms_tpu.core import make_instance
+from vrpms_tpu.core import delta as delta_mod
+from vrpms_tpu.core.encoding import is_valid_giant
+from vrpms_tpu.core.split import greedy_split_giant
+from tests.test_progress import (  # noqa: F401  (fixtures)
+    job_body,
+    poll_done,
+    request,
+    seeded,
+    server,
+)
+
+
+@pytest.fixture(autouse=True)
+def cache_env():
+    """Restore the cache knob after each test (read per call)."""
+    saved = os.environ.get("VRPMS_CACHE")
+    yield
+    if saved is None:
+        os.environ.pop("VRPMS_CACHE", None)
+    else:
+        os.environ["VRPMS_CACHE"] = saved
+
+
+def served_customers(msg):
+    return sorted(c for v in msg["vehicles"] for c in v["tour"][1:-1])
+
+
+# ---------------------------------------------------------------------------
+# unit: the shared repair
+# ---------------------------------------------------------------------------
+
+
+class TestRepair:
+    def _durations(self, rng, n):
+        pts = rng.uniform(0, 100, size=(n, 2))
+        return np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+
+    def test_repair_always_yields_valid_permutation(self, rng):
+        # randomized: arbitrary prior routes (dropped ids, new ids,
+        # duplicates across routes) repair to a permutation of the
+        # CURRENT active positions 1..n-1, every customer exactly once
+        for trial in range(30):
+            n = int(rng.integers(3, 12))
+            active_ids = [0] + sorted(
+                rng.choice(np.arange(1, 50), size=n - 1, replace=False)
+                .tolist()
+            )
+            d = self._durations(rng, n)
+            # prior solution over a random overlapping id set
+            prior_ids = [
+                i for i in active_ids[1:] if rng.random() < 0.6
+            ] + rng.choice(np.arange(50, 70), size=2, replace=False).tolist()
+            prior_ids = [int(x) for x in rng.permutation(prior_ids)]
+            cut = len(prior_ids) // 2
+            routes = [prior_ids[:cut], prior_ids[cut:]]
+            order = delta_mod.repair_order(routes, active_ids, d)
+            survivors = {
+                i for i, cid in enumerate(active_ids)
+                if i > 0 and cid in set(prior_ids)
+            }
+            if not survivors:
+                assert order is None
+                continue
+            assert sorted(order) == list(range(1, n))
+
+    def test_survivors_keep_relative_order(self):
+        active = [0, 10, 20, 30, 40]
+        d = np.ones((5, 5))
+        order = delta_mod.repair_order([[40, 20, 10]], active, d)
+        # 30 is new (greedy-inserted somewhere); survivors stay 4,2,1
+        assert [p for p in order if p in (4, 2, 1)] == [4, 2, 1]
+        assert sorted(order) == [1, 2, 3, 4]
+
+    def test_nothing_survives_declines_to_seed(self):
+        d = np.ones((4, 4))
+        assert delta_mod.repair_order([[99], []], [0, 1, 2, 3], d) is None
+        assert delta_mod.repair_perm([], [0, 1, 2, 3], d) is None
+
+    def test_empty_routes_in_prior_solution_are_fine(self):
+        # a cancelled/partial predecessor can hold empty routes
+        d = np.ones((4, 4))
+        order = delta_mod.repair_order([[], [3, 1], []], [0, 1, 2, 3], d)
+        assert sorted(order) == [1, 2, 3]
+
+    def test_repaired_giant_is_structurally_valid(self, rng):
+        # through the greedy split, the repaired permutation decodes to
+        # a giant with the encoding's exact separator count
+        n, v = 7, 3
+        d = self._durations(rng, n)
+        inst = make_instance(
+            d, demands=[0] + [1] * (n - 1), capacities=[n] * v
+        )
+        active_ids = list(range(n))
+        routes = [[3, 1], [5, 2]]  # drops 4, 6; nothing new beyond them
+        perm = delta_mod.repair_perm(routes, active_ids, d)
+        giant = greedy_split_giant(perm, inst)
+        assert is_valid_giant(giant, n - 1, v)
+
+    def test_greedy_insert_picks_cheapest_position(self):
+        # a 1-D line: inserting 2 between 1 and 3 is cheapest
+        pts = np.asarray([0.0, 1.0, 2.0, 3.0])
+        d = np.abs(pts[:, None] - pts[None, :])
+        order = delta_mod.repair_order([[1, 3]], [0, 1, 2, 3], d)
+        assert order == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# unit: request-delta validation + application
+# ---------------------------------------------------------------------------
+
+
+def _vrp_params(ignored=(), completed=()):
+    return {
+        "ignored_customers": list(ignored),
+        "completed_customers": list(completed),
+    }
+
+
+def _locs(n=5):
+    return [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+
+
+class TestApplyDelta:
+    def test_vrp_drop_moves_id_into_ignored(self):
+        params, errors = _vrp_params(), []
+        out = delta_mod.apply_request_delta(
+            "vrp", params, _locs(), {"drop": [2]}, errors
+        )
+        assert not errors and out is not None
+        assert params["ignored_customers"] == [2]
+
+    def test_vrp_add_reactivates_excluded(self):
+        params, errors = _vrp_params(ignored=[2], completed=[3]), []
+        out = delta_mod.apply_request_delta(
+            "vrp", params, _locs(), {"add": [2, 3]}, errors
+        )
+        assert not errors and out is not None
+        assert params["ignored_customers"] == []
+        assert params["completed_customers"] == []
+
+    def test_duplicate_add_rejected(self):
+        params, errors = _vrp_params(), []
+        out = delta_mod.apply_request_delta(
+            "vrp", params, _locs(), {"add": [1]}, errors
+        )
+        assert out is None
+        assert any("duplicate add" in e["reason"] for e in errors)
+
+    def test_drop_of_inactive_rejected(self):
+        params, errors = _vrp_params(ignored=[2]), []
+        out = delta_mod.apply_request_delta(
+            "vrp", params, _locs(), {"drop": [2]}, errors
+        )
+        assert out is None and any(
+            "not active" in e["reason"] for e in errors
+        )
+
+    def test_unknown_id_and_unknown_key_rejected(self):
+        params, errors = _vrp_params(), []
+        assert delta_mod.apply_request_delta(
+            "vrp", params, _locs(), {"drop": [99]}, errors
+        ) is None
+        errors2: list = []
+        assert delta_mod.apply_request_delta(
+            "vrp", params, _locs(), {"remove": [1]}, errors2
+        ) is None
+        assert any("unknown delta key" in e["reason"] for e in errors2)
+
+    def test_depot_protected(self):
+        params, errors = _vrp_params(), []
+        assert delta_mod.apply_request_delta(
+            "vrp", params, _locs(), {"drop": [0]}, errors
+        ) is None
+
+    def test_demand_and_window_changes_copy_locations(self):
+        locs = _locs()
+        params, errors = _vrp_params(), []
+        out = delta_mod.apply_request_delta(
+            "vrp", params, locs,
+            {"demands": {"2": 5}, "timeWindows": {"3": [10, 20]}}, errors,
+        )
+        assert not errors
+        assert out[2]["demand"] == 5.0 and out[3]["timeWindow"] == [10, 20]
+        # the stored dataset rows were never mutated
+        assert locs[2]["demand"] == 2 and "timeWindow" not in locs[3]
+
+    def test_window_null_clears_and_inverted_rejected(self):
+        locs = _locs()
+        locs[2]["timeWindow"] = [0, 9]
+        params, errors = _vrp_params(), []
+        out = delta_mod.apply_request_delta(
+            "vrp", params, locs, {"timeWindows": {"2": None}}, errors
+        )
+        assert not errors and "timeWindow" not in out[2]
+        errors2: list = []
+        assert delta_mod.apply_request_delta(
+            "vrp", params, locs, {"timeWindows": {"2": [9, 1]}}, errors2
+        ) is None
+
+    def test_tsp_add_drop_edit_customer_list(self):
+        params, errors = {"customers": [1, 2, 3], "start_node": 0}, []
+        out = delta_mod.apply_request_delta(
+            "tsp", params, _locs(), {"drop": [2], "add": [4]}, errors
+        )
+        assert not errors and out is not None
+        assert params["customers"] == [1, 3, 4]
+
+    def test_tsp_demands_rejected(self):
+        params, errors = {"customers": [1, 2], "start_node": 0}, []
+        assert delta_mod.apply_request_delta(
+            "tsp", params, _locs(), {"demands": {"1": 3}}, errors
+        ) is None
+        assert any("VRP" in e["reason"] for e in errors)
+
+
+# ---------------------------------------------------------------------------
+# unit: the SA continuation schedule
+# ---------------------------------------------------------------------------
+
+
+class TestContinuation:
+    def test_t0_clamped_between_final_and_warm(self, rng):
+        import jax.numpy as jnp
+
+        from vrpms_tpu.solvers.sa import (
+            SAParams,
+            _temps_from_scale,
+            continuation_params,
+        )
+        from vrpms_tpu.solvers.sa import _mean_fn
+
+        n = 8
+        pts = rng.uniform(0, 100, size=(n, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        inst = make_instance(
+            d, demands=[0] + [1] * (n - 1), capacities=[n, n]
+        )
+        perm = jnp.arange(1, n, dtype=jnp.int32)
+        giant = greedy_split_giant(perm, inst)
+        p = continuation_params(inst, SAParams(), giant)
+        scale = float(_mean_fn()(inst))
+        t_warm, t1 = _temps_from_scale(scale, SAParams())
+        assert p.t_initial is not None
+        assert t1 <= p.t_initial <= t_warm
+        # an explicit t_initial always wins untouched
+        explicit = SAParams(t_initial=123.0)
+        assert continuation_params(inst, explicit, giant).t_initial == 123.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP: envelopes (no solving — quick)
+# ---------------------------------------------------------------------------
+
+
+class TestEnvelopes:
+    def test_duplicate_add_400(self, server):
+        status, r = request(
+            server, "POST", "/api/vrp/sa", job_body(delta={"add": [1]})
+        )
+        assert status == 400
+        assert any("duplicate add" in e["reason"] for e in r["errors"])
+
+    def test_unknown_delta_key_400(self, server):
+        status, r = request(
+            server, "POST", "/api/vrp/sa", job_body(delta={"append": [1]})
+        )
+        assert status == 400
+
+    def test_async_submit_validates_delta_too(self, server):
+        status, r = request(
+            server, "POST", "/api/jobs", job_body(delta={"drop": [99]})
+        )
+        assert status == 400
+        assert any("not in the locations" in e["reason"] for e in r["errors"])
+
+    def test_bad_warmstart_spec_400(self, server):
+        status, r = request(
+            server, "POST", "/api/vrp/sa",
+            job_body(warmStart={"sessionId": "x"}),
+        )
+        assert status == 400
+        assert any("warmStart" in e["reason"] for e in r["errors"])
+        status, r = request(
+            server, "POST", "/api/vrp/sa", job_body(warmStart={})
+        )
+        assert status == 400
+
+    def test_resolve_unknown_job_404(self, server):
+        status, r = request(
+            server, "POST", "/api/jobs/nope/resolve", job_body()
+        )
+        assert status == 404
+
+    def test_resolve_malformed_body_400_without_record_read(self, server):
+        status, r = request(
+            server, "POST", "/api/jobs/nope/resolve", {"problem": "vrp"}
+        )
+        assert status == 400
+
+    def test_all_customers_dropped_is_trivial(self, server):
+        status, r = request(
+            server, "POST", "/api/vrp/sa",
+            job_body(delta={"drop": [1, 2, 3, 4, 5, 6]}),
+        )
+        assert status == 200, r
+        assert r["message"]["durationMax"] == 0
+        assert r["message"]["vehicles"] == []
+
+
+# ---------------------------------------------------------------------------
+# HTTP: delta solves (slow)
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaHTTP:
+    def test_solve_covers_exactly_the_post_delta_set(self, server):
+        body = job_body(
+            ignoredCustomers=[6], iterationCount=300, populationSize=8
+        )
+        status, r = request(
+            server, "POST", "/api/vrp/sa",
+            dict(body, delta={"drop": [2], "add": [6]}),
+        )
+        assert status == 200, r
+        assert served_customers(r["message"]) == [1, 3, 4, 5, 6]
+
+    def test_demand_change_fails_capacity_differently(self, server):
+        # raising one demand past every capacity must change the load
+        # the response reports (the instance really was rebuilt)
+        body = job_body(iterationCount=200, populationSize=8)
+        status, r = request(
+            server, "POST", "/api/vrp/sa",
+            dict(body, delta={"demands": {"1": 9}}),
+        )
+        assert status == 200, r
+        loads = {
+            c: v["load"]
+            for v in r["message"]["vehicles"]
+            for c in v["tour"][1:-1]
+        }
+        assert loads  # solved normally
+        v1 = next(
+            v for v in r["message"]["vehicles"] if 1 in v["tour"][1:-1]
+        )
+        assert v1["load"] >= 9
+
+
+# ---------------------------------------------------------------------------
+# HTTP: explicit warm-start specs (slow)
+# ---------------------------------------------------------------------------
+
+
+class TestWarmStartSpec:
+    def test_inline_tour_seeds_and_continues(self, server):
+        body = job_body(
+            iterationCount=300, populationSize=8, includeStats=True
+        )
+        status, r = request(server, "POST", "/api/vrp/sa", body)
+        assert status == 200, r
+        routes = [v["tour"][1:-1] for v in r["message"]["vehicles"]]
+        status, r2 = request(
+            server, "POST", "/api/vrp/sa",
+            dict(body, warmStart={"tour": routes}),
+        )
+        assert status == 200, r2
+        stats = r2["message"]["stats"]
+        assert stats["warmStart"] is True
+        assert stats["resolve"] == {
+            "seedSource": "tour", "seeded": True, "continuation": True,
+        }
+        # never worse than the cold solve it was seeded from
+        assert (
+            r2["message"]["durationSum"]
+            <= r["message"]["durationSum"] + 1e-6
+        )
+
+    def test_jobid_seed_works_with_cache_off(self, server):
+        os.environ["VRPMS_CACHE"] = "off"
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=300, populationSize=8),
+        )
+        assert status == 202, resp
+        record = poll_done(server, resp["jobId"])
+        assert record["status"] == "done"
+        status, r = request(
+            server, "POST", "/api/vrp/sa",
+            job_body(
+                iterationCount=300, populationSize=8, includeStats=True,
+                warmStart={"jobId": resp["jobId"]},
+            ),
+        )
+        assert status == 200, r
+        stats = r["message"]["stats"]
+        assert stats["resolve"]["seedSource"] == "job"
+        assert stats["resolve"]["seeded"] is True
+        # cache off: no cacheHit key, exactly like the pre-cache contract
+        assert "cacheHit" not in r["message"]
+
+    def test_unknown_jobid_degrades_to_cold_solve(self, server):
+        status, r = request(
+            server, "POST", "/api/vrp/sa",
+            job_body(
+                iterationCount=200, populationSize=8, includeStats=True,
+                warmStart={"jobId": "no-such-job"},
+            ),
+        )
+        assert status == 200, r
+        stats = r["message"]["stats"]
+        assert stats["resolve"] == {
+            "seedSource": "miss", "seeded": False, "continuation": False,
+            "jobId": "no-such-job",
+        }
+        assert stats["warmStart"] is False
+
+    def test_tour_with_delta_covers_new_set_and_seeds(self, server):
+        body = job_body(
+            ignoredCustomers=[6], iterationCount=300, populationSize=8
+        )
+        status, r = request(server, "POST", "/api/vrp/sa", body)
+        assert status == 200, r
+        routes = [v["tour"][1:-1] for v in r["message"]["vehicles"]]
+        status, r2 = request(
+            server, "POST", "/api/vrp/sa",
+            dict(
+                body, includeStats=True,
+                warmStart={"tour": routes},
+                delta={"drop": [1], "add": [6]},
+            ),
+        )
+        assert status == 200, r2
+        assert served_customers(r2["message"]) == [2, 3, 4, 5, 6]
+        assert r2["message"]["stats"]["resolve"]["seeded"] is True
+
+
+# ---------------------------------------------------------------------------
+# HTTP: cancel-and-resolve (slow)
+# ---------------------------------------------------------------------------
+
+
+class TestResolveEndpoint:
+    def test_cancel_and_resolve_continues_from_incumbent(self, server):
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=50_000_000, timeLimit=120.0, seed=3),
+        )
+        assert status == 202, resp
+        pred_id = resp["jobId"]
+        # wait for a published incumbent so there is something to seize
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            _, r = request(server, "GET", f"/api/jobs/{pred_id}")
+            if r["job"].get("incumbent") or r["job"]["status"] in (
+                "done", "failed",
+            ):
+                break
+            time.sleep(0.05)
+        status, r = request(
+            server, "POST", f"/api/jobs/{pred_id}/resolve",
+            job_body(iterationCount=2000, seed=4),
+        )
+        assert status == 202, r
+        assert r["resolvedFrom"] == pred_id
+        succ = poll_done(server, r["jobId"])
+        pred = poll_done(server, pred_id)
+        assert pred["status"] == "done"
+        assert pred["message"].get("cancelled") is True
+        assert succ["status"] == "done"
+        assert succ["resolvedFrom"] == pred_id
+        # acceptance: the successor's FIRST published incumbent costs no
+        # more than the predecessor's final one (same customer set —
+        # clone 0 of the seed is exactly the predecessor's incumbent)
+        pred_final = pred["incumbent"]["bestCost"]
+        succ_first = succ["progress"]["improvements"][0]["bestCost"]
+        assert succ_first <= pred_final + 1e-6
+
+    def test_bad_body_never_cancels_the_predecessor(self, server):
+        # the full parse ladder (delta validation included) runs BEFORE
+        # the predecessor is touched: a malformed successor must not
+        # cost the running job its budget
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=50_000_000, timeLimit=60.0, seed=5),
+        )
+        assert status == 202, resp
+        pred_id = resp["jobId"]
+        status, r = request(
+            server, "POST", f"/api/jobs/{pred_id}/resolve",
+            job_body(delta={"drop": [99]}),
+        )
+        assert status == 400
+        _, rr = request(server, "GET", f"/api/jobs/{pred_id}")
+        assert rr["job"]["status"] in ("queued", "running")
+        assert rr["job"].get("message", {}).get("cancelled") is not True
+        # clean up so the suite does not wait out the 60 s budget
+        request(server, "DELETE", f"/api/jobs/{pred_id}")
+        poll_done(server, pred_id)
+
+    def test_resolve_finished_job_seeds_without_cancel(self, server):
+        status, resp = request(
+            server, "POST", "/api/jobs",
+            job_body(iterationCount=300, populationSize=8),
+        )
+        assert status == 202, resp
+        poll_done(server, resp["jobId"])
+        status, r = request(
+            server, "POST", f"/api/jobs/{resp['jobId']}/resolve",
+            job_body(
+                iterationCount=300, populationSize=8,
+                delta={"drop": [4]},
+            ),
+        )
+        assert status == 202, r
+        succ = poll_done(server, r["jobId"])
+        assert succ["status"] == "done"
+        assert served_customers(succ["message"]) == [1, 2, 3, 5, 6]

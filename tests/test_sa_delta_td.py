@@ -234,6 +234,20 @@ class TestSolveSaDeltaTd:
         assert _delta_supported(synth_cvrp(1001, 43, seed=1), W, "pallas")
         assert not _delta_supported(synth_cvrp(1100, 43, seed=1), W, "pallas")
 
+    def test_td_gate_is_512(self):
+        # the TD surrogate path keeps the ORIGINAL 512 bound: the shared
+        # delta gate admits untimed instances to 1024, but TD above 512
+        # has never been hardware-validated (ADVICE round 5)
+        from vrpms_tpu.kernels.sa_delta import _PALLAS_OK
+        from vrpms_tpu.solvers.sa import _delta_supported
+
+        if not _PALLAS_OK:
+            pytest.skip("pallas unavailable")
+        assert _delta_supported(synth_td(500, 20, seed=1, t_slices=8), W, "pallas")
+        assert not _delta_supported(
+            synth_td(600, 20, seed=1, t_slices=8), W, "pallas"
+        )
+
     def test_gate_classes(self):
         from vrpms_tpu.core import make_instance
         from vrpms_tpu.kernels.sa_delta import _PALLAS_OK

@@ -1,0 +1,297 @@
+"""Instance deltas + tour repair over the separator encoding — the
+dynamic re-solve core.
+
+Real fleets re-solve a rolling horizon: customers are added and
+dropped, demands and time windows change, and every such request used
+to pay a full cold metaheuristic solve. This module holds the two pure
+pieces that make a re-solve cheap, shared by every consumer (the
+solution cache's near-hit seeding, the explicit `warmStart` spec, and
+the `POST /api/jobs/{id}/resolve` cancel-and-resolve path):
+
+  * **tour repair** (`strip_order` / `repair_order` / `repair_perm`) —
+    a prior solution's routes (ORIGINAL location ids) are repaired onto
+    the CURRENT active customer set over the separator encoding: ids no
+    longer active are stripped (surviving customers keep their relative
+    visit order), customers the prior tour never saw are greedy-
+    inserted at their cheapest position by slice-0 durations. The
+    result is an int32 permutation of the active positions 1..n-1 —
+    exactly the shape the warm-start machinery consumes — and the
+    greedy split re-tiers it into V routes with the encoding's V+1
+    separators intact.
+
+  * **request deltas** (`apply_request_delta`) — a request may carry a
+    `delta` relative to its stored dataset instead of re-spelling the
+    whole instance: customers added back / dropped (rolling-horizon
+    arrivals and completions, riding the reference's ignored/completed
+    dynamic inputs) and per-location demand / time-window changes.
+    Applied at the HTTP intake (handler_base / jobs submit), BEFORE the
+    instance is built, so the fingerprint, the tier padding, and the
+    cache keys all see the post-delta instance. Validation errors
+    accumulate as the contract's Data-error envelope entries (a
+    duplicate add or an unknown id is a 400, never a silent no-op).
+
+Host-side and jax-light by design (one jnp.asarray at the very end):
+repair is O(n^2) python over lists, which is microseconds at service
+sizes and never touches the device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Tour repair: prior routes -> warm permutation for the current active set
+# ---------------------------------------------------------------------------
+
+
+def strip_order(routes, active_ids: list) -> tuple[list, set]:
+    """The shared strip step of every cached-tour repair: surviving
+    customers of `routes` (ORIGINAL location ids) as positions in the
+    CURRENT active indexing, relative visit order preserved; also the
+    set of positions covered. Used by the legacy checkpoint re-seed
+    (service.solve._warm_perm), the cache's near-hit repair, and the
+    explicit warm-start spec resolution."""
+    index_of = {cid: i for i, cid in enumerate(active_ids)}
+    seen: set = set()
+    order: list = []
+    for route in routes:
+        for cid in route:
+            pos = index_of.get(cid)
+            if pos is not None and pos > 0 and pos not in seen:
+                order.append(pos)
+                seen.add(pos)
+    return order, seen
+
+
+def greedy_insert_positions(order: list, new: list, durations) -> list:
+    """Insert each position in `new` into the depot-anchored sequence
+    implied by `order` at its cheapest position (classic cheapest-
+    insertion deltas over the slice-0 duration matrix, active
+    indexing). Returns the extended order."""
+    d = np.asarray(durations)
+    seq = [0] + list(order) + [0]
+    for c in new:
+        best_delta, best_at = None, 1
+        for k in range(1, len(seq)):
+            a, b = seq[k - 1], seq[k]
+            delta = float(d[a, c] + d[c, b] - d[a, b])
+            if best_delta is None or delta < best_delta:
+                best_delta, best_at = delta, k
+        seq.insert(best_at, c)
+    return seq[1:-1]
+
+
+def repair_order(routes, active_ids: list, durations) -> list | None:
+    """Strip-and-insert repair: prior `routes` (original ids) -> visit
+    order over the CURRENT active positions 1..len(active_ids)-1, every
+    active customer exactly once. `durations` is the active-indexed
+    slice-0 matrix the insertions price against. Returns None when no
+    prior customer survives — appending alone would be an arbitrary-
+    order seed, no better than construction, so callers decline to
+    seed."""
+    order, seen = strip_order(routes, active_ids)
+    if not order:
+        return None
+    new = [i for i in range(1, len(active_ids)) if i not in seen]
+    if new:
+        order = greedy_insert_positions(order, new, durations)
+    return order
+
+
+def repair_perm(routes, active_ids: list, durations):
+    """repair_order as the int32 device array the warm-start machinery
+    consumes (service.solve passes it through tiers.pad_perm on padded
+    instances), or None when nothing survives to seed from."""
+    order = repair_order(routes, active_ids, durations)
+    if order is None:
+        return None
+    return jnp.asarray(order, dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Request deltas: {add, drop, demands, timeWindows} against the dataset
+# ---------------------------------------------------------------------------
+
+_DELTA_KEYS = ("add", "drop", "demands", "timeWindows")
+
+
+def _err(errors, reason: str) -> None:
+    errors += [{"what": "Data error", "reason": reason}]
+
+
+def _id_list(delta: dict, key: str, errors) -> list | None:
+    val = delta.get(key)
+    if val is None:
+        return []
+    if not isinstance(val, list):
+        _err(errors, f"delta.{key} must be a list of location ids")
+        return None
+    if len(set(map(repr, val))) != len(val):
+        _err(errors, f"delta.{key} contains duplicate ids")
+        return None
+    return val
+
+
+def _attr_map(delta: dict, key: str, errors) -> dict | None:
+    """A per-id attribute-change map. JSON object keys are strings, so
+    ids are matched by their string form (str(3) == "3"); a list of
+    [id, value] pairs is accepted too and keeps exotic id types exact."""
+    val = delta.get(key)
+    if val is None:
+        return {}
+    if isinstance(val, dict):
+        return {str(k): v for k, v in val.items()}
+    if isinstance(val, list) and all(
+        isinstance(p, (list, tuple)) and len(p) == 2 for p in val
+    ):
+        return {str(k): v for k, v in val}
+    _err(
+        errors,
+        f"delta.{key} must be an object of id -> value (or a list of "
+        "[id, value] pairs)",
+    )
+    return None
+
+
+def apply_request_delta(
+    problem: str, params: dict, locations: list, delta, errors
+) -> list | None:
+    """Apply a request `delta` to its dataset view before the instance
+    is built. Mutates the ACTIVE-SET parameters in place (VRP:
+    ignored/completed lists; TSP: the customers list) so every later
+    consumer — instance build, cache keys, the save-path location
+    filter — sees the post-delta world, and returns a locations list
+    with demand/time-window changes applied (changed dicts are copies;
+    the stored dataset rows are never mutated). On any contract
+    violation appends Data-error envelope entries and returns None.
+    """
+    if not isinstance(delta, dict):
+        _err(errors, "'delta' must be an object")
+        return None
+    unknown = [k for k in delta if k not in _DELTA_KEYS]
+    if unknown:
+        _err(
+            errors,
+            f"unknown delta key(s) {unknown}; expected one of "
+            f"{list(_DELTA_KEYS)}",
+        )
+        return None
+    add = _id_list(delta, "add", errors)
+    drop = _id_list(delta, "drop", errors)
+    demands = _attr_map(delta, "demands", errors)
+    windows = _attr_map(delta, "timeWindows", errors)
+    if add is None or drop is None or demands is None or windows is None:
+        return None
+    both = [c for c in add if c in drop]
+    if both:
+        _err(errors, f"delta adds and drops the same id(s) {both}")
+        return None
+
+    ids = [loc.get("id") for loc in locations]
+    id_set = set(map(repr, ids))
+    for cid in add + drop:
+        if repr(cid) not in id_set:
+            _err(errors, f"delta id {cid!r} is not in the locations dataset")
+            return None
+
+    if problem == "vrp":
+        depot_id = locations[ids.index(0) if 0 in ids else 0].get("id")
+        ignored = list(params.get("ignored_customers") or [])
+        completed = list(params.get("completed_customers") or [])
+        excluded = set(map(repr, ignored + completed))
+        for cid in add:
+            if repr(cid) == repr(depot_id):
+                _err(errors, "delta cannot add the depot")
+                return None
+            if repr(cid) not in excluded:
+                _err(
+                    errors,
+                    f"duplicate add: customer {cid!r} is already active",
+                )
+                return None
+        for cid in drop:
+            if repr(cid) == repr(depot_id):
+                _err(errors, "delta cannot drop the depot")
+                return None
+            if repr(cid) in excluded:
+                _err(errors, f"cannot drop customer {cid!r}: not active")
+                return None
+        add_set = set(map(repr, add))
+        params["ignored_customers"] = [
+            c for c in ignored if repr(c) not in add_set
+        ] + list(drop)
+        params["completed_customers"] = [
+            c for c in completed if repr(c) not in add_set
+        ]
+    else:
+        customers = list(params.get("customers") or [])
+        active = set(map(repr, customers + [params.get("start_node")]))
+        for cid in add:
+            if repr(cid) in active:
+                _err(
+                    errors,
+                    f"duplicate add: customer {cid!r} is already active",
+                )
+                return None
+        drop_set = set(map(repr, drop))
+        for cid in drop:
+            if repr(cid) not in set(map(repr, customers)):
+                _err(errors, f"cannot drop customer {cid!r}: not active")
+                return None
+        params["customers"] = [
+            c for c in customers if repr(c) not in drop_set
+        ] + list(add)
+        if demands:
+            # TSP instances carry no demands (make_instance demands=None)
+            _err(errors, "delta.demands applies to VRP requests only")
+            return None
+
+    id_strs = {str(i) for i in ids}
+    for key in list(demands) + list(windows):
+        if key not in id_strs:
+            _err(errors, f"delta id {key!r} is not in the locations dataset")
+            return None
+    out = []
+    for loc in locations:
+        key = str(loc.get("id"))
+        if key not in demands and key not in windows:
+            out.append(loc)
+            continue
+        loc = dict(loc)
+        if key in demands:
+            try:
+                loc["demand"] = float(demands[key])
+            except (TypeError, ValueError):
+                _err(errors, f"delta demand for id {key} must be a number")
+                return None
+        if key in windows:
+            tw = windows[key]
+            if tw is None:
+                loc.pop("timeWindow", None)  # null clears the window
+            elif not isinstance(tw, (list, tuple)) or len(tw) != 2:
+                _err(
+                    errors,
+                    f"delta time window for id {key} must be "
+                    "[ready, due] or null",
+                )
+                return None
+            else:
+                try:
+                    ready, due = float(tw[0]), float(tw[1])
+                except (TypeError, ValueError):
+                    _err(
+                        errors,
+                        f"delta time window for id {key} must be numeric",
+                    )
+                    return None
+                if ready > due:
+                    _err(
+                        errors,
+                        f"delta time window for id {key}: ready > due",
+                    )
+                    return None
+                loc["timeWindow"] = [ready, due]
+        out.append(loc)
+    return out

@@ -43,12 +43,16 @@ surrogate part, resynced at launch boundaries:
 
 Gates (sa._delta_supported): factorized TD (td_rank in 1..2), every
 slice symmetric (reverse reuses interior basis legs), no TW, no
-makespan, uniform fleet + scalable demands, n_nodes <= 1024 (the shared
-delta-path bound — raised from 512 in round 5 with the scoped-VMEM cap;
-this driver additionally scales its chain tile down with both padded
-length and rank to stay inside it) and ids in one bf16-exact range.
-Start times may vary per vehicle (they only enter the RESYNC timeline,
-which is exact XLA).
+makespan, uniform fleet + scalable demands, and n_nodes <= 512 — a
+TD-SPECIFIC bound, tighter than the shared delta-path n <= 1024: the
+untimed kernel was bit-checked on hardware at n=1001 when the shared
+bound was raised in round 5, but this surrogate path has only ever
+been validated to 512, so the 512-1024 range stays gated off until a
+coverage point exists there (ADVICE round 5; the driver also scales
+its chain tile down with both padded length and rank to respect the
+scoped-VMEM cap). Ids stay in one bf16-exact range. Start times may
+vary per vehicle (they only enter the RESYNC timeline, which is exact
+XLA).
 """
 
 from __future__ import annotations

@@ -158,6 +158,18 @@ def run_blocked(
     return state, done
 
 
+def seed_objective(giant, inst: Instance, w: CostWeights | None = None) -> float:
+    """Exact scalar objective of a seed tour — the ONE pricing that
+    continuation-budget decisions use (sa.continuation_params estimates
+    the re-entry temperature from it), so the schedule a warm re-solve
+    continues with is derived from the same objective the solver
+    anneals. One device dispatch; host float out."""
+    from vrpms_tpu.core.cost import exact_cost
+
+    _, cost = exact_cost(giant, inst, w or CostWeights.make())
+    return float(cost)
+
+
 def solve_info(res: SolveResult, unvisited: list | None = None) -> dict:
     """Reference-shaped solve summary: {tour, total_time, unvisited, date}.
 

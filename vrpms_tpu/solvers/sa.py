@@ -241,6 +241,46 @@ def perturbed_clones(
     return _perturb_fn(batch, mode, n_moves)(key, giant, jnp.int32(lim))
 
 
+#: continuation re-entry temperature, as a fraction of the seed's mean
+#: LEG cost: a typical neighborhood move rewires O(1) legs, so t0 at
+#: half a mean leg accepts only small local worsenings — the anneal
+#: CONTINUES refining the repaired incumbent instead of re-running the
+#: high-temperature phase that built it (a dynamic re-solve's seed is
+#: an already-annealed tour of a neighboring instance, not a raw
+#: constructive seed — even the warm-start 0.05x schedule re-melts more
+#: of it than a small delta warrants)
+CONTINUATION_LEG_FRACTION = 0.5
+
+
+def continuation_params(
+    inst: Instance,
+    params: SAParams,
+    seed_giant,
+    weights: CostWeights | None = None,
+) -> SAParams:
+    """SAParams for a CONTINUATION re-solve: skip the high-temperature
+    phase by estimating the initial temperature from the repaired seed
+    tour's cost (mean leg cost x CONTINUATION_LEG_FRACTION), clamped
+    into [t_final, warm-start t0] so the schedule never inverts and
+    never runs hotter than a plain warm start. Explicit t_initial wins
+    untouched. The budget interpretation follows: with the same n_iters
+    the geometric schedule now spends every sweep in the refinement
+    band, which is what lets a warm delta re-solve match a cold solve's
+    cost at a fraction of the evals (benchmarks/resolve_delta.py)."""
+    if params.t_initial is not None:
+        return params
+    from vrpms_tpu.solvers.common import seed_objective
+
+    scale = float(_mean_fn()(inst))
+    cost = seed_objective(seed_giant, inst, weights)
+    nr = inst.n_customers if inst.n_real is None else int(inst.n_real) - 1
+    vr = inst.n_vehicles if inst.v_real is None else int(inst.v_real)
+    n_legs = max(1, nr + vr)
+    t_warm, t1 = _temps_from_scale(scale, params)
+    t0 = min(t_warm, max(CONTINUATION_LEG_FRACTION * cost / n_legs, t1))
+    return dataclasses.replace(params, t_initial=float(t0), t_final=t1)
+
+
 def anneal_temperature(it, t0, t1, horizon):
     """Geometric schedule value at iteration `it` of `horizon`."""
     frac = it.astype(jnp.float32) / jnp.maximum(
@@ -584,6 +624,13 @@ def _delta_supported(inst: Instance, w: CostWeights, mode: str) -> bool:
         # (kernels.sa_delta_td) since round 5; the combined TD+TW class
         # and unfactorized (full-rank) profiles still fall back
         if inst.has_tw or not (1 <= inst.td_rank <= 2):
+            return False
+        if inst.n_nodes > 512:
+            # the shared delta bound above was raised to 1024 in round
+            # 5, but the TD surrogate path has only ever been hardware-
+            # validated to n=512 (the scale_n1001 bench family exercises
+            # the untimed kernel alone) — gate TD there until a
+            # 512-1024 coverage point exists (ADVICE round 5)
             return False
         # basis symmetry is the exact invariant the reverse move's
         # interior-leg reuse needs, and (with the factorization exact
