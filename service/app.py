@@ -168,6 +168,14 @@ def main():
 
     cache_dir = enable_compile_cache()
     obs.set_compile_cache(cache_dir)
+    from service import jobs as jobs_mod
+
+    if jobs_mod.dist_queue_enabled():
+        # start the claim loop NOW, not at the first local submit: a
+        # replica added purely for capacity may never receive direct
+        # traffic, and it must still lease (and reclaim) the fleet's
+        # shared-queue work from the moment it boots
+        jobs_mod.get_replica()
     if args.warmup in ("tiers", "auto"):
         # tier-ladder warmup in the BACKGROUND: the port binds now and
         # the default-schedule tier programs precompile behind it, so

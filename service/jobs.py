@@ -533,6 +533,11 @@ def _job_record(job: Job) -> dict:
         # cancel-and-resolve lineage: this job continued that one's
         # incumbent (POST /api/jobs/{id}/resolve)
         rec["resolvedFrom"] = resolved_from
+    attempt = (job.payload or {}).get("dist_attempt")
+    if attempt:
+        # distributed-queue lineage: which claim generation produced
+        # this record (2 = a peer reclaimed a crashed replica's lease)
+        rec["attempt"] = attempt
     if job.sink is not None:
         snap = job.sink.snapshot()
         if snap is not None:
@@ -640,6 +645,11 @@ def _on_event(name: str, job: Job) -> None:
         # finish BEFORE the terminal persist: once a poll can read the
         # job as done, GET /api/debug/traces/{traceId} must find the
         # trace in the ring
+        if (job.payload or {}).get("dist") and job.span is not None:
+            # distributed jobs own their root span (no HTTP handler
+            # closes it on this replica): end it so the waterfall's
+            # duration is the execution, not open-ended
+            job.span.end(status=None if name == "done" else "error")
         job.trace.finish(status="ok" if name == "done" else "error")
     if name not in ("queued", "runner_error", "requeued"):
         # queued is persisted synchronously at submit; runner_error is
@@ -650,11 +660,14 @@ def _on_event(name: str, job: Job) -> None:
         # the record's stale 'running' is true enough: the retry is
         # about to run it again
         _persist(job)
-    if terminal:
+    if terminal and not (job.payload or {}).get("dist"):
         # wake every stream waiter AFTER the terminal persist: a
         # reader woken by the close may poll GET /api/jobs/{id}
         # immediately and must find the terminal record, not the stale
-        # 'running' one; then drop the live-registry entry
+        # 'running' one; then drop the live-registry entry. For
+        # DISTRIBUTED jobs the terminal persist is ack-gated and
+        # happens in _dist_complete — close/drop there, after it, for
+        # exactly the same reason.
         if job.sink is not None:
             job.sink.close("done" if name == "done" else "failed")
         _drop_live(job.id)
@@ -712,8 +725,17 @@ def get_scheduler() -> Scheduler:
 def shutdown_scheduler() -> int:
     """Drain-on-shutdown: fail queued jobs cleanly, stop workers, and
     forget the singleton (a later submit builds a fresh scheduler —
-    what tests and long-lived embedding processes need)."""
-    global _scheduler, _drained
+    what tests and long-lived embedding processes need). Stops the
+    distributed-queue replica FIRST (drain: in-flight leased jobs get a
+    window to finish and ack; anything still running re-queues to peers
+    via lease expiry — never silent loss)."""
+    global _scheduler, _drained, _replica
+    with _replica_lock:
+        r, _replica = _replica, None
+    if r is not None:
+        r.stop(drain_s=_env_float("VRPMS_REPLICA_DRAIN_S", 5.0))
+    global _replica_id_cached
+    _replica_id_cached = None  # a rebuilt service re-reads the env
     with _sched_lock:
         s, _scheduler = _scheduler, None
         if s is not None:
@@ -724,6 +746,441 @@ def shutdown_scheduler() -> int:
     if drained:
         log_event("sched.drained", jobs=drained)
     return drained
+
+
+# ---------------------------------------------------------------------------
+# Distributed job queue (horizontal scale-out)
+# ---------------------------------------------------------------------------
+# VRPMS_QUEUE=store swaps the async jobs surface from the process-local
+# admission queue to the store-backed SHARED queue (store.base.
+# JobQueueStore): submits enqueue the raw request; every replica runs a
+# claim loop (vrpms_tpu.sched.Replica) that leases jobs — preferring
+# the consistent-hash arc of tier keys it owns, so the tier compile
+# cache and take_matching micro-batching keep their hit rates — and
+# executes them on its own local scheduler under a heartbeat-renewed
+# lease. Terminal records are ACK-GATED: only the replica that still
+# holds the lease publishes, so a crashed replica's jobs are reclaimed
+# and completed by peers exactly once. The default (VRPMS_QUEUE=local)
+# path is untouched. Sync endpoints keep the local scheduler either
+# way: their submit-and-wait contract parks on the in-process job
+# event, and a same-box solve needs no routing.
+
+
+def dist_queue_enabled() -> bool:
+    return os.environ.get("VRPMS_QUEUE", "local").strip().lower() in (
+        "store", "shared", "dist",
+    )
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+_replica = None
+_replica_lock = threading.Lock()
+_replica_id_cached: str | None = None
+
+
+def replica_id() -> str:
+    """This process's stable replica identity: VRPMS_REPLICA_ID (set it
+    to the pod/host name in real deployments so restarts keep their
+    ring arcs — and their warmed tiers) or a generated one."""
+    global _replica_id_cached
+    if _replica_id_cached is None:
+        import uuid
+
+        _replica_id_cached = (
+            os.environ.get("VRPMS_REPLICA_ID")
+            or f"replica-{uuid.uuid4().hex[:8]}"
+        )
+    return _replica_id_cached
+
+
+def ring_token(problem: str, inst) -> str | None:
+    """The ring routing key: the PADDED tier shape plus the feature
+    flags that split compiled programs — deliberately COARSER than
+    _bucket_key (no chains/iters/deadline), so every job of a tier
+    lands on the tier's owner regardless of its budget and the owner's
+    warmed programs serve all of them."""
+    if inst is None:
+        return None
+    shape = "x".join(str(int(d)) for d in inst.durations.shape)
+    return (
+        f"{problem}:{shape}x{int(inst.n_vehicles)}"
+        f":tw{int(bool(inst.has_tw))}:het{int(bool(inst.het_fleet))}"
+        f":td{int(inst.td_rank)}"
+    )
+
+
+def _dist_depth_provider() -> int:
+    r = _replica
+    return r.store.depth() if r is not None else 0
+
+
+def _dist_event(name: str, replicaId: str | None = None, **kw) -> None:
+    """Replica observer: lease/steal/claim telemetry -> Prometheus +
+    structured log (claim-CONFLICT counts arrive separately, via the
+    store.base queue-observer seam — conflicts happen inside backend
+    conditional updates, not in the replica loop)."""
+    if name == "claim":
+        obs.DIST_CLAIMS.labels(kind=kw.get("kind") or "own").inc()
+    elif name == "lease_renewed":
+        obs.DIST_LEASES.labels(event="renewed").inc()
+        return  # heartbeat cadence: counter only, no log line
+    elif name == "lease_reclaimed":
+        obs.DIST_LEASES.labels(event="reclaimed").inc()
+    elif name == "lease_expired_dead":
+        obs.DIST_LEASES.labels(event="expired_dead").inc()
+    elif name == "lease_lost":
+        obs.DIST_LEASES.labels(event="lost").inc()
+    elif name == "ack_lost":
+        obs.DIST_LEASES.labels(event="ack_lost").inc()
+    elif name == "nack":
+        obs.DIST_LEASES.labels(event="nack").inc()
+    log_event(
+        f"dist.{name}", replicaId=replicaId or replica_id(), **kw
+    )
+
+
+def _materialize_entry(entry: dict, rid: str | None = None) -> Job:
+    """Rebuild a leased queue entry into a runnable local Job on THIS
+    replica: same parse (_parse_content), same prepare_request — so the
+    leasing replica pads to ITS tier ladder, hits ITS compile cache,
+    and its micro-batcher sees the same bucket keys a local submit
+    would. Never raises: parse/prepare failures return an
+    already-FAILED job (the replica acks it and publishes the clean
+    envelope); a cache exact-hit or trivial request returns a born-DONE
+    job. Trace continuity: the entry's traceparent re-roots this
+    attempt under the SUBMITTING request's trace, and a reclaimed
+    entry (attempt > 0) is marked requeued so its solve span carries
+    attempt=2 — the PR-3/PR-5 crash-continuity contract, across
+    replicas."""
+    payload = entry.get("payload") or {}
+    content = payload.get("content") or {}
+    problem = payload.get("problem") or content.get("problem")
+    algorithm = payload.get("algorithm") or content.get("algorithm")
+    attempt = int(entry.get("attempt") or 0) + 1
+    job = Job(
+        payload={
+            "problem": problem,
+            "algorithm": algorithm,
+            # ack-gated publishing: the scheduler's observer must NOT
+            # persist this job's records — the replica does, only
+            # after the store confirms it still held the lease
+            "job_db": None,
+            "dist": True,
+            "dist_attempt": attempt,
+        },
+        time_limit=entry.get("time_limit"),
+        request_id=payload.get("requestId"),
+    )
+    job.id = str(entry.get("id") or job.id)
+    if payload.get("resolvedFrom"):
+        job.payload["resolved_from"] = payload["resolvedFrom"]
+    if entry.get("submitted_at"):
+        # the deadline budget includes SHARED-queue wait: back-date the
+        # monotonic submit clock by the entry's wall-clock age so the
+        # worker's expiry check measures from the original submit
+        job.submitted_at = float(entry["submitted_at"])
+        age = max(0.0, time.time() - job.submitted_at)
+        job.submitted_mono = time.monotonic() - age
+    if entry.get("attempt"):
+        job.requeued = True  # reclaimed once already: attempt=2, and
+        # at-most-once parity with the local watchdog (a local crash
+        # on top of a reclaim fails clean instead of a third run)
+    tp = payload.get("traceparent")
+    if tp:
+        trace = spans.start_trace(tp)
+        if trace is not None:
+            root = trace.span("dist.execute")
+            root.set(
+                jobId=job.id,
+                replicaId=rid or replica_id(),
+                attempt=attempt,
+            )
+            trace.deferred = True
+            job.trace, job.span = trace, root
+    token = set_request_id(job.request_id)
+    span_tokens = (
+        spans.activate(job.trace, job.span)
+        if job.trace is not None
+        else None
+    )
+    errors: list = []
+    try:
+        ctx = _parse_content(content, errors)
+        prep = None
+        if ctx is not None:
+            prep = prepare_request(
+                ctx["problem"], ctx["algorithm"], ctx["params"],
+                ctx["opts"], ctx["algo_params"], ctx["locations"],
+                ctx["durations"], errors, ctx["database"],
+            )
+        if prep is None or errors:
+            job.errors = errors or [{
+                "what": "Data error",
+                "reason": "request could not be rebuilt from the "
+                "shared-queue entry",
+            }]
+            job.finish(FAILED)
+            return job
+        if prep.trivial is not None or prep.cached is not None:
+            # born done on the leasing replica (e.g. the cache filled
+            # between submit and claim): serve it, skip the scheduler
+            if prep.cached is not None:
+                job.result = solution_cache.serve_hit(prep)
+            else:
+                job.result = _mark_degraded(
+                    prep, solution_cache.mark_trivial(prep)
+                )
+            job.finish(DONE)
+            return job
+        job.payload["prep"] = prep
+        job.payload["backend"] = _backend_label(ctx["opts"])
+        job.bucket = _bucket_key(prep)
+        _attach_sink(job, prep)
+        _register_live(job)
+        return job
+    except Exception as e:
+        log_event(
+            "dist.materialize_error",
+            jobId=job.id,
+            error=f"{type(e).__name__}: {e}",
+            traceback=traceback.format_exc(),
+        )
+        job.errors = [{
+            "what": "Scheduler error",
+            "reason": f"{type(e).__name__}: {e}",
+        }]
+        job.finish(FAILED)
+        return job
+    finally:
+        if span_tokens is not None:
+            spans.deactivate(span_tokens)
+        reset_request_id(token)
+
+
+def _dist_complete(job: Job, entry: dict, acked: bool) -> None:
+    """Replica completion hook: publish the terminal record IFF the ack
+    confirmed we still held the lease. An ack-refused completion is a
+    lease we lost — the reclaiming peer owns the record, and writing
+    ours too is exactly the duplicate-terminal bug leases prevent."""
+    if (
+        job.trace is not None
+        and job.trace.deferred
+        and not job.trace.finished
+    ):
+        # born-terminal jobs never reach the scheduler's observer, so
+        # their deferred trace closes here
+        status = "ok" if job.status == DONE else "error"
+        if job.span is not None:
+            job.span.end(status=None if job.status == DONE else "error")
+        job.trace.finish(status=status)
+    if acked:
+        # persist BEFORE waking stream/poll waiters (below): a reader
+        # woken by the sink close must find the terminal record
+        db = store.get_database(job.payload.get("problem") or "vrp", None)
+        job.payload["job_db"] = db
+        _persist(job)
+        if "prep" not in job.payload:
+            # born terminal at materialize (cache hit, trivial, or
+            # build failure): never passed through the scheduler, so
+            # its terminal was not counted by _on_event
+            obs.JOBS_TOTAL.labels(
+                outcome="done" if job.status == DONE else "failed"
+            ).inc()
+    # an un-acked completion publishes nothing (the reclaimer owns the
+    # record — counted + logged by the replica's ack_lost event), but
+    # local waiters still get released
+    if job.sink is not None:
+        job.sink.close("done" if job.status == DONE else "failed")
+    _drop_live(job.id)
+
+
+def _dist_dead(entry: dict) -> None:
+    """A twice-crashed entry (lease expired at the attempt ceiling):
+    write its clean failure record — the cross-replica analog of the
+    watchdog's 'Scheduler crashed' envelope."""
+    payload = entry.get("payload") or {}
+    job_id = str(entry.get("id"))
+    rec = {
+        "id": job_id,
+        "status": FAILED,
+        "problem": payload.get("problem"),
+        "algorithm": payload.get("algorithm"),
+        "submittedAt": entry.get("submitted_at"),
+        "startedAt": None,
+        "finishedAt": time.time(),
+        "requestId": payload.get("requestId"),
+        "attempt": int(entry.get("attempt") or 0),
+        "errors": [{
+            "what": "Scheduler crashed",
+            "reason": "replica lease expired twice while running this "
+            "job; not requeueing again",
+        }],
+    }
+    tp = payload.get("traceparent")
+    if tp:
+        rec["traceId"] = spans.parse_traceparent(tp)[0]
+    try:
+        store.get_database(payload.get("problem") or "vrp", None).save_job(
+            job_id, rec
+        )
+    except Exception:
+        pass  # save_job is already best-effort; never kill the loop
+    obs.JOBS_FAILED.labels(reason="crash").inc()
+    obs.JOBS_TOTAL.labels(outcome="failed").inc()
+
+
+def build_replica(rid: str, scheduler=None, **kw):
+    """A Replica wired to the service's materialize/complete path — the
+    in-process multi-replica harness (tests, benchmarks/multi_replica)
+    and the production singleton both build here. `scheduler` defaults
+    to the process scheduler; pass a dedicated Scheduler to model
+    one-replica-per-box."""
+    from vrpms_tpu.sched import Replica
+
+    def submit(job):
+        target = scheduler if scheduler is not None else get_scheduler()
+        try:
+            target.submit(
+                job, backend=job.payload.get("backend") or "default"
+            )
+        except QueueFull:
+            # the replica nacks the entry back to the shared queue —
+            # this process no longer owns the job, so its live-registry
+            # entry must go too, or polls here would overlay a ghost
+            # 'queued' over the eventual peer-published terminal record
+            # forever (and the prepared instance would leak). The sink
+            # stays open: attached streams ride keep-alives to their
+            # timeout and reconnect onto the record-follow path.
+            _drop_live(job.id)
+            raise
+
+    defaults = dict(
+        lease_s=_env_float("VRPMS_LEASE_S", 15.0),
+        poll_s=_env_float("VRPMS_QUEUE_POLL_MS", 50.0) / 1e3,
+        heartbeat_s=_env_float("VRPMS_HEARTBEAT_S", 5.0),
+        reclaim_s=_env_float("VRPMS_RECLAIM_S", 1.0),
+        max_inflight=_env_int("VRPMS_QUEUE_MAX_INFLIGHT", 16),
+        steal=os.environ.get("VRPMS_QUEUE_STEAL", "on").lower()
+        not in ("off", "0", "false", "no"),
+        vnodes=_env_int("VRPMS_RING_VNODES", 64),
+    )
+    defaults.update(kw)
+    return Replica(
+        store.get_queue_store(),
+        rid,
+        materialize=lambda entry: _materialize_entry(entry, rid),
+        submit=submit,
+        complete=_dist_complete,
+        dead=_dist_dead,
+        on_event=lambda name, **ekw: _dist_event(name, replicaId=rid, **ekw),
+        **defaults,
+    )
+
+
+def get_replica():
+    """The process replica singleton (started lazily at the first
+    store-queue submit, or eagerly by warmup)."""
+    global _replica
+    with _replica_lock:
+        if _replica is None or not _replica.alive:
+            _replica = build_replica(replica_id()).start()
+            obs.set_dist_depth_provider(_dist_depth_provider)
+        return _replica
+
+
+def _submit_distributed(handler, ctx, job: Job, prep, resolve_from=None):
+    """Enqueue an async job onto the SHARED store-backed queue.
+
+    Backpressure accounts for the shared queue, not just the local
+    bound: the admission ceiling scales with live membership (each
+    replica brings one local queue's worth of capacity), and
+    Retry-After divides the shared backlog by the fleet's drain rate."""
+    self = handler
+    replica = get_replica()
+    qs = replica.store
+    limit = _env_int("VRPMS_SCHED_QUEUE", 64)
+    # membership from the replica's cached ring (refreshed every
+    # heartbeat) — the admission hot path pays ONE store read (depth),
+    # not two
+    ring = replica.ring()
+    members = max(1, len(ring.members)) if ring is not None else 1
+    try:
+        depth = qs.depth()
+    except Exception:
+        depth = 0  # unreadable depth must not block admits
+    if depth >= limit * members:
+        retry_after = min(
+            max(1.0, depth * replica.job_seconds_ewma() / members), 60.0
+        )
+        obs.SCHED_REJECTS.labels(reason="queue_full").inc()
+        obs.JOBS_TOTAL.labels(outcome="failed").inc()
+        job.errors = [{
+            "what": "Too busy",
+            "reason": "shared solver queue was full at submit",
+        }]
+        job.finish(FAILED)
+        _persist(job)
+        too_busy(self, retry_after)
+        return
+    token = ring_token(ctx["problem"], prep.inst)
+    payload = {
+        "content": ctx["content"],
+        "requestId": self._request_id,
+        "problem": ctx["problem"],
+        "algorithm": ctx["algorithm"],
+    }
+    if resolve_from:
+        payload["resolvedFrom"] = resolve_from
+    if self._trace is not None and self._trace_root is not None:
+        payload["traceparent"] = spans.format_traceparent(
+            self._trace.trace_id, self._trace_root.span_id
+        )
+    from vrpms_tpu.sched import ring as ring_mod
+
+    entry = {
+        "id": job.id,
+        "slot": ring_mod.slot(token if token is not None else job.id),
+        "bucket": token,
+        "time_limit": job.time_limit,
+        "submitted_at": job.submitted_at,
+        "payload": payload,
+    }
+    _persist(job)  # queued record first: a poll can never 404 a jobId
+    # this 202 is about to hand out
+    try:
+        qs.enqueue(entry)
+    except Exception as e:
+        job.errors = [{
+            "what": "Service unavailable",
+            "reason": f"shared job queue enqueue failed: "
+            f"{type(e).__name__}: {e}",
+        }]
+        job.finish(FAILED)
+        _persist(job)
+        self._obs_errors = ["Service unavailable"]
+        obs.JOBS_TOTAL.labels(outcome="failed").inc()
+        _respond(self, 503, {"success": False, "errors": job.errors})
+        return
+    log_event(
+        "dist.enqueued", jobId=job.id, slot=entry["slot"], bucket=token
+    )
+    resp = {"success": True, "jobId": job.id, "status": job.status}
+    if resolve_from:
+        resp["resolvedFrom"] = resolve_from
+    _respond(self, 202, resp)
 
 
 # ---------------------------------------------------------------------------
@@ -808,18 +1265,21 @@ class JobsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             obs.end_request_obs(self)
 
 
-def _parse_submit(handler, content: dict) -> dict | None:
-    """The fallible-without-side-effects front half of an async submit:
-    body shape, params/options parsing, store reads, and delta
-    validation+application — everything that can 400 WITHOUT consulting
-    the scheduler (or, on the resolve path, before the predecessor job
-    is touched). Responds with the error envelope itself and returns
-    None, or returns the parsed request context."""
-    self = handler
+def _parse_content(content: dict, errors: list, handler=None) -> dict | None:
+    """The fallible-without-side-effects front half of a submit: body
+    shape, params/options parsing, store reads, and delta validation+
+    application — everything that can reject a request WITHOUT
+    consulting the scheduler (or, on the resolve path, before the
+    predecessor job is touched). HEADLESS by design: the HTTP wrapper
+    (_parse_submit) turns a None return into the 400 envelope, and the
+    distributed-queue claim path (_materialize_entry) runs the same
+    parse on whichever replica leased the job — one parser, every
+    intake. Fills `errors` and returns None on rejection, or the parsed
+    request context; `handler` (when given) only receives the
+    request-counter labels."""
     with spans.span("parse"):
         problem = content.get("problem")
         algorithm = content.get("algorithm")
-        errors: list = []
         if problem not in ("vrp", "tsp"):
             errors += [{
                 "what": "Missing parameter",
@@ -831,10 +1291,10 @@ def _parse_submit(handler, content: dict) -> dict | None:
                 "reason": "'algorithm' must be one of ga|sa|aco|bf",
             }]
         if errors:
-            fail(self, errors)
             return None
-        self.algorithm = algorithm  # request-counter label parity
-        self.problem = problem
+        if handler is not None:
+            handler.algorithm = algorithm  # request-counter label parity
+            handler.problem = problem
 
         parse_common, parse_algo = _PARSERS[(problem, algorithm)]
         params = parse_common(content, errors)
@@ -850,18 +1310,16 @@ def _parse_submit(handler, content: dict) -> dict | None:
             except ValueError as e:
                 errors += [{"what": "Data error", "reason": str(e)}]
     if errors:
-        fail(self, errors)
         return None
     try:
         database = store.get_database(problem, params["auth"])
     except Exception as e:
-        fail(self, [{"what": "Database error", "reason": str(e)}])
+        errors += [{"what": "Database error", "reason": str(e)}]
         return None
     with spans.span("store.read", tables="locations,durations"):
         locations = database.get_locations_by_id(params["locations_key"], errors)
         durations = database.get_durations_by_id(params["durations_key"], errors)
     if errors:
-        fail(self, errors)
         return None
     # dynamic re-solve delta, same hook as the sync surface
     # (service.handler_base): the dataset view is rewritten before the
@@ -875,7 +1333,6 @@ def _parse_submit(handler, content: dict) -> dict | None:
                 problem, params, locations, opts["delta"], errors
             )
         if locations is None or errors:
-            fail(self, errors)
             return None
     return {
         "problem": problem,
@@ -886,7 +1343,19 @@ def _parse_submit(handler, content: dict) -> dict | None:
         "database": database,
         "locations": locations,
         "durations": durations,
+        "content": content,
     }
+
+
+def _parse_submit(handler, content: dict) -> dict | None:
+    """HTTP wrapper around _parse_content: responds with the error
+    envelope itself and returns None, or returns the parsed context."""
+    errors: list = []
+    ctx = _parse_content(content, errors, handler=handler)
+    if ctx is None:
+        fail(handler, errors)
+        return None
+    return ctx
 
 
 def _submit_content(handler, content: dict, resolve_from: str | None = None):
@@ -957,6 +1426,13 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
         _respond(self, 202, {
             "success": True, "jobId": job.id, "status": job.status,
         })
+        return
+    if dist_queue_enabled() and scheduler_enabled():
+        # store-backed shared queue: enqueue the REQUEST (not the
+        # prepared instance) so any replica can lease, rebuild, and
+        # solve it — the claim path re-runs this exact parse/prepare
+        # on the leasing replica (_materialize_entry)
+        _submit_distributed(self, ctx, job, prep, resolve_from)
         return
     # live-progress mailbox + registry entry BEFORE the submit: the
     # worker may pop the job the instant it lands, and the runner
@@ -1353,8 +1829,34 @@ class JobResolveHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             record = _load_job_record(self, job_id)
             if record is None:
                 return
+            if (
+                dist_queue_enabled()
+                and record.get("status") not in (DONE, FAILED)
+            ):
+                # the job is executing on ANOTHER replica: cooperative
+                # cancellation is replica-local, so proceeding would
+                # silently skip the cancel, seed from a record with no
+                # final incumbent, and leave two solves burning budget
+                # on the same request — refuse honestly instead
+                self._obs_errors = ["Conflict"]
+                _respond(self, 409, {
+                    "success": False,
+                    "errors": [{
+                        "what": "Conflict",
+                        "reason": f"job {job_id!r} is in progress on "
+                        "another replica; cancellation is replica-local "
+                        "— retry once it reaches a terminal state (or "
+                        "route the resolve to the replica running it)",
+                    }],
+                })
+                return
         if ctx["opts"].get("warm_start") is None:
             ctx["opts"]["warm_start"] = {"jobId": job_id}
+            # the raw content is what a distributed-queue entry carries
+            # (the leasing replica re-parses it): the injected seed
+            # source must ride along or a cross-replica resolve would
+            # silently solve cold
+            ctx["content"] = dict(ctx["content"], warmStart={"jobId": job_id})
         log_event("job.resolve", jobId=job_id)
         _submit_parsed(self, ctx, resolve_from=job_id)
 
@@ -1417,6 +1919,30 @@ def readiness() -> tuple[int, dict]:
         "workers": workers,
         "workerRestarts": restarts,
     }
+    if dist_queue_enabled():
+        # operators see the ring from any replica: who am I, who else
+        # is alive, which share of the tier space (and therefore which
+        # warmed tiers) this replica owns, and the shared backlog
+        info: dict = {"replicaId": replica_id(), "queue": "store"}
+        rep = _replica
+        if rep is not None:
+            ring = rep.ring()
+            if ring is not None:
+                info["ringMembers"] = ring.members
+                info["ringArcs"] = len(ring.arcs(rep.replica_id))
+                info["arcShare"] = round(ring.share(rep.replica_id), 4)
+            info["inflight"] = rep.inflight()
+            try:
+                info["sharedDepth"] = rep.store.depth()
+            except Exception:
+                pass  # a queue-store blip must not fail readiness
+        try:
+            from service import warmup as warmup_mod
+
+            info["tiersWarmed"] = warmup_mod.warmed_tiers()
+        except Exception:
+            info["tiersWarmed"] = []
+        body["replica"] = info
     return (503 if status == "down" else 200), body
 
 

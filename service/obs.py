@@ -126,6 +126,32 @@ JOBS_FAILED = REGISTRY.counter(
     "crashed twice on the job)",
     labels=("reason",),
 )
+DIST_CLAIMS = REGISTRY.counter(
+    "vrpms_dist_claims_total",
+    "Distributed-queue claims by this replica, by kind (own = the job's "
+    "tier hashed into this replica's ring arc — the compile-affinity "
+    "path; steal = off-arc work taken because the own arc was empty)",
+    labels=("kind",),
+)
+DIST_CLAIM_CONFLICTS = REGISTRY.counter(
+    "vrpms_dist_claim_conflicts_total",
+    "Conditional claim/reclaim updates that lost the race to another "
+    "replica (the exactly-once arbitration firing, not an error)",
+)
+DIST_LEASES = REGISTRY.counter(
+    "vrpms_dist_lease_events_total",
+    "Lease lifecycle events (renewed | reclaimed = an expired peer "
+    "lease re-queued | expired_dead = reclaimed past the attempt "
+    "ceiling, failed clean | lost = this replica's lease was taken — "
+    "its result is discarded | nack = entry returned, local admission "
+    "full | ack_lost = terminal ack refused, record not published)",
+    labels=("event",),
+)
+DIST_QUEUE_DEPTH = REGISTRY.gauge(
+    "vrpms_dist_queue_depth",
+    "Unleased jobs waiting in the SHARED store-backed queue (the "
+    "cross-replica backpressure signal); refreshed per scrape",
+)
 WORKER_RESTARTS = REGISTRY.counter(
     "vrpms_sched_worker_restarts_total",
     "Watchdog worker restarts, by backend and reason (died|wedged)",
@@ -252,6 +278,15 @@ def set_compile_cache(cache_dir) -> None:
 
 _queue_depths = None
 _jobs_running = None
+_dist_depth = None
+
+
+def set_dist_depth_provider(fn) -> None:
+    """Register a callable returning the shared queue's depth (the
+    replica layer provides it once a queue store exists); refreshed per
+    scrape like the local queue-depth provider."""
+    global _dist_depth
+    _dist_depth = fn
 
 
 def set_queue_depth_provider(fn) -> None:
@@ -281,6 +316,11 @@ def refresh_gauges() -> None:
     if _jobs_running is not None:
         try:
             JOBS_RUNNING.set(_jobs_running())
+        except Exception:
+            pass
+    if _dist_depth is not None:
+        try:
+            DIST_QUEUE_DEPTH.set(_dist_depth())
         except Exception:
             pass
     try:
@@ -520,6 +560,11 @@ def _wire_compile_obs() -> None:
         from store import base as store_base
 
         store_base.set_cache_observer(lambda n: CACHE_EVICTIONS.inc(n))
+        store_base.set_queue_observer(
+            lambda event, n=1: DIST_CLAIM_CONFLICTS.inc(n)
+            if event == "claim_conflict"
+            else None
+        )
     except Exception:
         pass
     try:

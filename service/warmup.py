@@ -34,7 +34,48 @@ own single-block anneal once.
 from __future__ import annotations
 
 import sys
+import threading
 import time
+
+# tiers this process has actually warmed ("NxV" tokens, in completion
+# order) — the readiness probe's `replica.tiersWarmed` surface, so an
+# operator can see a replica's owned-and-ready slice of the ladder
+_warmed_lock = threading.Lock()
+_warmed: list[str] = []
+
+
+def warmed_tiers() -> list[str]:
+    with _warmed_lock:
+        return list(_warmed)
+
+
+def _note_warmed(token: str) -> None:
+    with _warmed_lock:
+        if token not in _warmed:
+            _warmed.append(token)
+
+
+def _owns_shape(inst, problem: str = "vrp") -> bool:
+    """Ring-ownership check for a padded warmup instance: with the
+    store-backed distributed queue active, each replica warms ONLY the
+    tiers whose ring token hashes into its owned arc — the whole point
+    of tier-affinity routing is that nobody pays compiles for tiers
+    they will not serve. (Stolen off-arc jobs still compile lazily on
+    first contact, exactly like any unwarmed shape.) Local-queue mode
+    owns everything."""
+    try:
+        from service import jobs as jobs_mod
+
+        if not jobs_mod.dist_queue_enabled():
+            return True
+        from vrpms_tpu.sched import ring as ring_mod
+
+        token = jobs_mod.ring_token(problem, inst)
+        if token is None:
+            return True
+        return jobs_mod.get_replica().owns_slot(ring_mod.slot(token))
+    except Exception:
+        return True  # warmup must never be blocked by ring plumbing
 
 
 def parse_shapes(spec: str) -> list[tuple[int, int, int | None]]:
@@ -56,8 +97,11 @@ def parse_shapes(spec: str) -> list[tuple[int, int, int | None]]:
     return shapes
 
 
-def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True) -> float:
-    """Run the warmup for every shape in `spec`; returns seconds spent."""
+def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True,
+           owned_only: bool = False) -> float:
+    """Run the warmup for every shape in `spec`; returns seconds spent.
+    `owned_only` skips shapes whose tier this replica does not own on
+    the distributed-queue ring (the scale-out warmup contract)."""
     from service.solve import _run_solver
     from vrpms_tpu.io.synth import synth_cvrp
 
@@ -76,6 +120,11 @@ def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True) -> float:
         from vrpms_tpu.core import tiers
 
         inst = tiers.maybe_pad(synth_cvrp(n, v, seed=0))
+        if owned_only and not _owns_shape(inst):
+            if log:
+                print(f"[warmup] {n}x{v}: tier owned by a peer replica; "
+                      "skipped", file=sys.stderr)
+            continue
         for algo in algorithms:
             errors: list = []
             # timeLimit 0 -> one 512-sweep deadline block (the program
@@ -133,6 +182,7 @@ def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True) -> float:
                     from vrpms_tpu.solvers.sa import warm_anneal_blocks
 
                     warm_anneal_blocks(inst, pop or 128)
+        _note_warmed(f"{n}x{v}")
     elapsed = time.perf_counter() - t_start
     if log:
         print(f"[warmup] {spec} ({','.join(algorithms)}): {elapsed:.1f}s",
@@ -167,7 +217,17 @@ def warmup_tiers(max_locations: int = 64, log=True) -> float:
         if log:
             print("[warmup] tiering off; nothing to warm", file=sys.stderr)
         return 0.0
-    return warmup(spec)
+    # with the store-backed distributed queue, warm ONLY the arcs this
+    # replica owns on the consistent-hash ring — N replicas split the
+    # ladder's warmup cost ~N ways instead of each paying all of it
+    owned_only = False
+    try:
+        from service import jobs as jobs_mod
+
+        owned_only = jobs_mod.dist_queue_enabled()
+    except Exception:
+        pass
+    return warmup(spec, log=log, owned_only=owned_only)
 
 
 def start_background_warmup(fn, *args) -> "object":

@@ -78,3 +78,34 @@ def get_database(problem: str, auth=None):
 
         db = wrap(db, kind, problem)
     return db
+
+
+def get_queue_store():
+    """Factory for the distributed job-queue backend (the scale-out
+    seam — store.base.JobQueueStore): same VRPMS_STORE selection as
+    get_database, so the shared queue and the job records live in the
+    same store. NOT wrapped in ResilientDatabase: the replica claim
+    loop is already a retry loop by construction (it polls), claims
+    must stay conditional single attempts (a blind retry could
+    double-claim after a commit-then-timeout), and a queue outage
+    degrades to "this replica claims nothing for a while", never to a
+    failed request — the resilience policy is the loop itself."""
+    kind = os.environ.get("VRPMS_STORE")
+    if kind is None:
+        kind = "supabase" if os.environ.get("SUPABASE_URL") else "memory"
+    plan = ""
+    if kind.startswith("faulty"):
+        kind, _, plan = kind.partition(":")
+    if kind == "memory":
+        from store.memory import InMemoryJobQueue
+
+        return InMemoryJobQueue()
+    if kind == "supabase":
+        from store.supabase_store import SupabaseJobQueue
+
+        return SupabaseJobQueue()
+    if kind == "faulty":
+        from store.faulty import FaultyJobQueue
+
+        return FaultyJobQueue(plan)
+    raise ValueError(f"unknown VRPMS_STORE {kind!r}")

@@ -75,6 +75,124 @@ def notify_cache_evictions(n: int) -> None:
 _cache_warned: dict = {}
 
 
+# --- distributed job queue (scale-out extension) ---------------------------
+# Queue lifecycle states for shared-queue entries. A row is QUEUED until
+# a replica claims it (LEASED); ack removes it, a crashed replica's
+# lease expires and the entry is re-queued exactly once by whichever
+# peer's reclaim scan wins the conditional update.
+
+Q_QUEUED = "queued"
+Q_LEASED = "leased"
+
+# Observer seam for queue-layer telemetry (service.obs wires Prometheus
+# counters in; the store package stays free of service imports — the
+# set_cache_observer pattern). Events: "claim_conflict" (a conditional
+# claim lost the race to another replica and retried the next row).
+_queue_observer = None
+
+
+def set_queue_observer(fn) -> None:
+    """fn(event: str, n: int) — queue-backend telemetry events."""
+    global _queue_observer
+    _queue_observer = fn
+
+
+def notify_queue_event(event: str, n: int = 1) -> None:
+    if _queue_observer is not None:
+        try:
+            _queue_observer(event, n)
+        except Exception:
+            pass  # telemetry must never break a claim
+
+
+class JobQueueStore:
+    """The distributed job-queue seam: N replicas lease work from one
+    shared queue (the horizontal-scale-out counterpart of `Database`).
+
+    Contract every backend must honor:
+
+      * `claim` is ATOMIC per entry — implemented as a single
+        conditional update (memory: under the table lock; Postgres:
+        `update ... where id = X and queue_state = 'queued'`), so two
+        replicas can NEVER hold the same job at once;
+      * a lease is exclusive but temporary: `renew` heartbeats extend
+        it, and an expired lease makes the entry reclaimable by ANY
+        replica's `reclaim_expired` scan — exactly once (the same
+        conditional-update rule), with the attempt counter carried so
+        a twice-crashed entry dies clean instead of crash-looping;
+      * `ack`/`nack`/`renew` are conditional on still OWNING the lease:
+        a replica that lost its lease learns it from the False return
+        and must not publish that job's terminal record (the reclaimer
+        owns it now).
+
+    Entries are plain JSON-able dicts:
+
+        {"id", "slot", "bucket", "state", "attempt", "lease_owner",
+         "lease_expires_at", "submitted_at", "time_limit", "payload"}
+
+    `slot` is the consistent-hash ring position of the job's tier key
+    (vrpms_tpu.sched.ring.slot) — precomputed at enqueue so backends
+    can filter claims to a replica's owned arcs with plain range
+    predicates. `payload` is opaque to this package (the service stores
+    the original request content + trace context so ANY replica can
+    rebuild and solve the job). Clocks are epoch seconds (time.time) —
+    comparable across processes, unlike monotonic clocks.
+    """
+
+    #: default ceiling on completed-claim generations: attempt 0 is the
+    #: first claim, a reclaim re-queues at attempt 1, and a SECOND
+    #: expiry (attempt would reach 2) fails the job clean — the
+    #: cross-replica generalization of sched.worker's at-most-one
+    #: requeue rule.
+    MAX_ATTEMPTS = 2
+
+    def enqueue(self, entry: dict) -> None:
+        """Make a job visible to the shared queue (state=queued)."""
+        raise NotImplementedError
+
+    def claim(self, owner: str, lease_s: float, slots=None) -> dict | None:
+        """Atomically lease the oldest QUEUED entry (optionally
+        restricted to ring-slot ranges `slots` = [(lo, hi), ...),
+        half-open) for `owner`; None when nothing matches."""
+        raise NotImplementedError
+
+    def renew(self, owner: str, job_id: str, lease_s: float) -> bool:
+        """Heartbeat: extend `owner`'s lease; False if the lease is no
+        longer theirs (expired and reclaimed)."""
+        raise NotImplementedError
+
+    def ack(self, owner: str, job_id: str) -> bool:
+        """Terminal: remove the entry if `owner` still holds the lease.
+        False means the lease was lost — the caller must NOT publish
+        the job's terminal record."""
+        raise NotImplementedError
+
+    def nack(self, owner: str, job_id: str) -> bool:
+        """Voluntarily return a leased entry to the queue (local
+        admission full, shutdown) WITHOUT burning an attempt."""
+        raise NotImplementedError
+
+    def reclaim_expired(self, max_attempts: int | None = None):
+        """Re-queue every entry whose lease expired — exactly once per
+        expiry across all callers. Returns (requeued, dead): `requeued`
+        entries are claimable again at attempt+1; `dead` entries hit
+        the attempt ceiling and were REMOVED — the caller owns writing
+        their clean failure record."""
+        raise NotImplementedError
+
+    def depth(self) -> int:
+        """QUEUED (unleased) entries — the shared backpressure signal."""
+        raise NotImplementedError
+
+    def register_replica(self, replica_id: str, ttl_s: float) -> None:
+        """Heartbeat this replica into the ring membership."""
+        raise NotImplementedError
+
+    def replicas(self) -> list[str]:
+        """Replica ids with a live (unexpired) heartbeat, sorted."""
+        raise NotImplementedError
+
+
 class Database:
     """Abstract store. Subclasses implement _fetch_row / _insert_solution
     and _owner_email; the public methods provide the shared error

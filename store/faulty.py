@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 
-from store.memory import _InMemoryMixin
+from store.memory import InMemoryJobQueue, _InMemoryMixin
 from store.base import DatabaseTSP, DatabaseVRP
 from vrpms_tpu.testing.faults import FaultInjector, parse_plan
 
@@ -94,3 +94,51 @@ class FaultyDatabaseVRP(_FaultyMixin, DatabaseVRP):
 
 class FaultyDatabaseTSP(_FaultyMixin, DatabaseTSP):
     pass
+
+
+class FaultyJobQueue(InMemoryJobQueue):
+    """The in-memory shared queue behind the same chaos plan: claims,
+    renews, and reclaims count as reads (polling), mutations of the
+    queue's durable truth (enqueue/ack/nack) as writes — so
+    `ops=reads`/`ops=writes` plans can fail the lease machinery and the
+    admission path independently. The replica loop's exactly-once
+    contract must hold under every plan (tests/test_distqueue.py)."""
+
+    def __init__(self, plan: str = ""):
+        self._injector = injector_for(plan)
+
+    def enqueue(self, entry):
+        self._injector.apply("write")
+        return super().enqueue(entry)
+
+    def claim(self, owner, lease_s, slots=None):
+        self._injector.apply("read")
+        return super().claim(owner, lease_s, slots)
+
+    def renew(self, owner, job_id, lease_s):
+        self._injector.apply("read")
+        return super().renew(owner, job_id, lease_s)
+
+    def ack(self, owner, job_id):
+        self._injector.apply("write")
+        return super().ack(owner, job_id)
+
+    def nack(self, owner, job_id):
+        self._injector.apply("write")
+        return super().nack(owner, job_id)
+
+    def reclaim_expired(self, max_attempts=None):
+        self._injector.apply("read")
+        return super().reclaim_expired(max_attempts)
+
+    def depth(self):
+        self._injector.apply("read")
+        return super().depth()
+
+    def register_replica(self, replica_id, ttl_s):
+        self._injector.apply("read")
+        return super().register_replica(replica_id, ttl_s)
+
+    def replicas(self):
+        self._injector.apply("read")
+        return super().replicas()

@@ -20,10 +20,15 @@ import json
 import os
 import threading
 
+import time
+
 from store.base import (
     Database,
     DatabaseTSP,
     DatabaseVRP,
+    JobQueueStore,
+    Q_LEASED,
+    Q_QUEUED,
     cache_cap,
     notify_cache_evictions,
 )
@@ -36,6 +41,8 @@ _tables: dict = {
     "warmstarts": {},
     "jobs": {},
     "solution_cache": {},
+    "job_queue": {},
+    "replicas": {},
 }
 _tokens: dict = {}
 _fixtures_loaded = False
@@ -49,6 +56,8 @@ def reset():
         _tables["warmstarts"].clear()
         _tables["jobs"].clear()
         _tables["solution_cache"].clear()
+        _tables["job_queue"].clear()
+        _tables["replicas"].clear()
         _tokens.clear()
         global _fixtures_loaded
         _fixtures_loaded = False
@@ -203,3 +212,123 @@ class InMemoryDatabaseVRP(_InMemoryMixin, DatabaseVRP):
 
 class InMemoryDatabaseTSP(_InMemoryMixin, DatabaseTSP):
     pass
+
+
+class InMemoryJobQueue(JobQueueStore):
+    """Shared-queue backend on the process-wide tables: every in-process
+    replica (tests, the multi-replica bench) sees the SAME queue, and
+    the one table lock makes each claim/reclaim a single atomic
+    conditional update — the reference semantics the Supabase backend's
+    conditional UPDATEs must match. Dicts preserve insertion order, so
+    FIFO claim order falls out of iteration."""
+
+    def _rows(self) -> dict:
+        return _tables["job_queue"]
+
+    @staticmethod
+    def _in_slots(slot, slots) -> bool:
+        if slots is None:
+            return True
+        return any(lo <= slot < hi for lo, hi in slots)
+
+    def enqueue(self, entry: dict) -> None:
+        row = dict(entry)
+        row.setdefault("state", Q_QUEUED)
+        row.setdefault("attempt", 0)
+        row.setdefault("slot", 0)
+        row.setdefault("submitted_at", time.time())
+        row["lease_owner"] = None
+        row["lease_expires_at"] = None
+        with _lock:
+            self._rows()[str(row["id"])] = row
+
+    def claim(self, owner: str, lease_s: float, slots=None) -> dict | None:
+        now = time.time()
+        with _lock:
+            for row in self._rows().values():
+                if row["state"] != Q_QUEUED:
+                    continue
+                if not self._in_slots(row.get("slot", 0), slots):
+                    continue
+                row["state"] = Q_LEASED
+                row["lease_owner"] = owner
+                row["lease_expires_at"] = now + lease_s
+                return dict(row)
+        return None
+
+    def _owned(self, owner: str, job_id: str):
+        row = self._rows().get(str(job_id))
+        if row is None or row["state"] != Q_LEASED:
+            return None
+        if row["lease_owner"] != owner:
+            return None
+        return row
+
+    def renew(self, owner: str, job_id: str, lease_s: float) -> bool:
+        with _lock:
+            row = self._owned(owner, job_id)
+            if row is None:
+                return False
+            row["lease_expires_at"] = time.time() + lease_s
+            return True
+
+    def ack(self, owner: str, job_id: str) -> bool:
+        with _lock:
+            row = self._owned(owner, job_id)
+            if row is None:
+                return False
+            del self._rows()[str(job_id)]
+            return True
+
+    def nack(self, owner: str, job_id: str) -> bool:
+        with _lock:
+            row = self._owned(owner, job_id)
+            if row is None:
+                return False
+            row["state"] = Q_QUEUED
+            row["lease_owner"] = None
+            row["lease_expires_at"] = None
+            return True
+
+    def reclaim_expired(self, max_attempts: int | None = None):
+        if max_attempts is None:
+            max_attempts = self.MAX_ATTEMPTS
+        now = time.time()
+        requeued, dead = [], []
+        with _lock:
+            rows = self._rows()
+            for job_id in list(rows):
+                row = rows[job_id]
+                if row["state"] != Q_LEASED:
+                    continue
+                if row["lease_expires_at"] is None:
+                    continue
+                if row["lease_expires_at"] > now:
+                    continue
+                row["attempt"] = int(row.get("attempt", 0)) + 1
+                row["lease_owner"] = None
+                row["lease_expires_at"] = None
+                if row["attempt"] >= max_attempts:
+                    dead.append(dict(rows.pop(job_id)))
+                else:
+                    row["state"] = Q_QUEUED
+                    requeued.append(dict(row))
+        return requeued, dead
+
+    def depth(self) -> int:
+        with _lock:
+            return sum(
+                1 for r in self._rows().values() if r["state"] == Q_QUEUED
+            )
+
+    def register_replica(self, replica_id: str, ttl_s: float) -> None:
+        with _lock:
+            _tables["replicas"][replica_id] = time.time() + ttl_s
+
+    def replicas(self) -> list[str]:
+        now = time.time()
+        with _lock:
+            reg = _tables["replicas"]
+            for rid in [r for r, exp in reg.items() if exp <= now]:
+                del reg[rid]
+            return sorted(reg)

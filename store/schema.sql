@@ -75,3 +75,59 @@ create table if not exists solution_cache (
 );
 create index if not exists solution_cache_family
   on solution_cache (family, updated_at desc);
+
+-- Distributed job queue (horizontal scale-out; store/base.py
+-- JobQueueStore, store/supabase_store.py SupabaseJobQueue): the jobs
+-- table doubles as the shared queue. A submitting replica enqueues by
+-- setting queue_state='queued' with the request payload in queue_entry
+-- and the job's consistent-hash ring position in slot; peers claim via
+-- ONE conditional update
+--   update jobs set queue_state='leased', lease_owner=$me,
+--          lease_expires_at=now() + $lease
+--    where id=$candidate and queue_state='queued';
+-- (zero rows updated = another replica won the race), heartbeat-renew
+-- while solving, and clear the queue columns on ack. A crashed
+-- replica's lease expires and any peer's reclaim scan re-queues the
+-- entry exactly once (conditional on the observed lease_owner),
+-- bumping attempt; attempt >= 2 fails the job clean instead of
+-- crash-looping. Replicas must run NTP-sane clocks (skew well under
+-- the lease, 15 s default).
+alter table jobs add column if not exists queue_state text;
+alter table jobs add column if not exists lease_owner text;
+alter table jobs add column if not exists lease_expires_at timestamptz;
+alter table jobs add column if not exists slot integer;
+alter table jobs add column if not exists attempt integer not null default 0;
+alter table jobs add column if not exists queue_entry jsonb;
+-- claim scans filter on state (+ slot arcs) ordered by age; the partial
+-- index keeps settled job rows (queue_state null) out of it entirely
+create index if not exists jobs_queue_claim
+  on jobs (queue_state, slot, updated_at)
+  where queue_state is not null;
+
+-- Ring membership: one heartbeat row per live replica; consistent-hash
+-- arcs are derived client-side from the live id set (sched/ring.py).
+create table if not exists replicas (
+  id text primary key,              -- upsert target: on_conflict="id"
+  expires_at timestamptz not null
+);
+
+-- Belt-and-braces stale-lease sweep: reclaim normally happens in every
+-- replica's scan loop, but if ALL replicas die mid-lease the entries
+-- sit leased until one comes back. A pg_cron job returns them to the
+-- queue (and ages out dead replica heartbeats) on the server side.
+-- The attempt ceiling MUST carry over: an entry already reclaimed once
+-- (attempt >= 1) gets retired, not a third execution — the same
+-- at-most-one-requeue rule the in-process reclaim enforces.
+--   select cron.schedule('vrpms-stale-leases', '* * * * *', $$
+--     update jobs set queue_state = 'queued', lease_owner = null,
+--            lease_expires_at = null, attempt = attempt + 1
+--      where queue_state = 'leased' and attempt < 1
+--        and lease_expires_at < now() - interval '5 minutes';
+--     update jobs set queue_state = null, lease_owner = null,
+--            lease_expires_at = null, attempt = attempt + 1
+--      where queue_state = 'leased' and attempt >= 1
+--        and lease_expires_at < now() - interval '5 minutes';
+--     delete from replicas where expires_at < now() - interval '5 minutes';
+--   $$);
+-- (retired entries keep their last persisted record; operators find
+-- them via queue_state is null + attempt >= 2 and can re-submit)

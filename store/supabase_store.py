@@ -13,7 +13,15 @@ from __future__ import annotations
 
 import os
 
-from store.base import Database, DatabaseTSP, DatabaseVRP
+from store.base import (
+    Database,
+    DatabaseTSP,
+    DatabaseVRP,
+    JobQueueStore,
+    Q_LEASED,
+    Q_QUEUED,
+    notify_queue_event,
+)
 from vrpms_tpu.obs import log_event
 
 
@@ -165,3 +173,252 @@ class SupabaseDatabaseVRP(_SupabaseMixin, DatabaseVRP):
 
 class SupabaseDatabaseTSP(_SupabaseMixin, DatabaseTSP):
     pass
+
+
+class SupabaseJobQueue(JobQueueStore):
+    """Shared-queue backend on the `jobs` table's lease columns
+    (store/schema.sql: queue_state / lease_owner / lease_expires_at /
+    slot / attempt / queue_entry, plus the jobs_queue_claim index).
+
+    Claims are a SELECT of candidate ids followed by one conditional
+    UPDATE per candidate (`... where id = X and queue_state = 'queued'`)
+    — Postgres updates a row atomically, so when two replicas race, one
+    UPDATE matches zero rows and that replica moves to the next
+    candidate (surfaced as a claim_conflict event). The same pattern
+    guards renew/ack/nack (`... and lease_owner = me`) and the expiry
+    reclaim (`... and lease_owner = <observed>` so concurrent scanners
+    re-queue each crashed job exactly once). Lease clocks are client
+    epoch seconds stored as ISO timestamps — replicas must run NTP-sane
+    clocks within a fraction of the lease (15 s default)."""
+
+    CLAIM_CANDIDATES = 8
+
+    def __init__(self):
+        try:
+            from supabase.client import create_client
+            from supabase.lib.client_options import ClientOptions
+        except ImportError as e:  # pragma: no cover - environment dependent
+            raise RuntimeError(
+                "supabase SDK not installed; set VRPMS_STORE=memory or "
+                "install supabase to use the hosted job queue"
+            ) from e
+        url = os.environ.get("SUPABASE_URL") or ""
+        key = os.environ.get("SUPABASE_KEY") or ""
+        self.client = create_client(
+            url, key, options=ClientOptions(persist_session=False)
+        )
+
+    @staticmethod
+    def _iso(epoch_s: float) -> str:
+        from datetime import datetime, timezone
+
+        return datetime.fromtimestamp(epoch_s, timezone.utc).isoformat()
+
+    @staticmethod
+    def _epoch(iso: str | None) -> float | None:
+        if not iso:
+            return None
+        from datetime import datetime
+
+        return datetime.fromisoformat(iso).timestamp()
+
+    def _entry(self, row: dict) -> dict:
+        entry = dict(row.get("queue_entry") or {})
+        entry["id"] = row["id"]
+        entry["slot"] = row.get("slot") or 0
+        entry["state"] = row.get("queue_state")
+        entry["attempt"] = row.get("attempt") or 0
+        entry["lease_owner"] = row.get("lease_owner")
+        entry["lease_expires_at"] = self._epoch(row.get("lease_expires_at"))
+        return entry
+
+    def enqueue(self, entry: dict) -> None:
+        import time as _time
+
+        doc = {
+            k: v
+            for k, v in entry.items()
+            if k
+            not in ("id", "slot", "state", "attempt", "lease_owner",
+                    "lease_expires_at")
+        }
+        self.client.table("jobs").upsert(
+            {
+                "id": entry["id"],
+                "queue_state": Q_QUEUED,
+                "slot": int(entry.get("slot") or 0),
+                "attempt": int(entry.get("attempt") or 0),
+                "lease_owner": None,
+                "lease_expires_at": None,
+                "queue_entry": doc,
+                "updated_at": self._iso(_time.time()),
+            },
+            on_conflict="id",
+        ).execute()
+
+    def _candidates(self, slots, states, expired_before=None) -> list:
+        # slim scan (the PR-6 family-scan precedent): candidate rows
+        # carry only the lease/ordering columns — at most ONE candidate
+        # wins, and the winner's full row (queue_entry payload
+        # included) comes back on the conditional UPDATE's returning
+        # representation, so polling replicas never transfer payloads
+        # they will not run
+        q = (
+            self.client.table("jobs")
+            .select("id,slot,queue_state,lease_owner,lease_expires_at,attempt")
+            .in_("queue_state", list(states))
+            .order("updated_at", desc=False)
+            .limit(self.CLAIM_CANDIDATES)
+        )
+        if expired_before is not None:
+            q = q.lt("lease_expires_at", self._iso(expired_before))
+        if slots:
+            q = q.or_(
+                ",".join(
+                    f"and(slot.gte.{lo},slot.lt.{hi})" for lo, hi in slots
+                )
+            )
+        return list(q.execute().data)
+
+    def claim(self, owner: str, lease_s: float, slots=None) -> dict | None:
+        import time as _time
+
+        if slots is not None and not slots:
+            return None
+        for row in self._candidates(slots, (Q_QUEUED,)):
+            upd = (
+                self.client.table("jobs")
+                .update(
+                    {
+                        "queue_state": Q_LEASED,
+                        "lease_owner": owner,
+                        "lease_expires_at": self._iso(
+                            _time.time() + lease_s
+                        ),
+                    }
+                )
+                .eq("id", row["id"])
+                .eq("queue_state", Q_QUEUED)
+                .execute()
+            )
+            if upd.data:
+                return self._entry(dict(row, **upd.data[0]))
+            notify_queue_event("claim_conflict")
+        return None
+
+    def _owned_update(self, owner: str, job_id: str, patch: dict) -> bool:
+        upd = (
+            self.client.table("jobs")
+            .update(patch)
+            .eq("id", job_id)
+            .eq("queue_state", Q_LEASED)
+            .eq("lease_owner", owner)
+            .execute()
+        )
+        return bool(upd.data)
+
+    def renew(self, owner: str, job_id: str, lease_s: float) -> bool:
+        import time as _time
+
+        return self._owned_update(
+            owner, job_id,
+            {"lease_expires_at": self._iso(_time.time() + lease_s)},
+        )
+
+    def ack(self, owner: str, job_id: str) -> bool:
+        # "remove from the queue", not "delete the job": the row stays
+        # (it carries the persisted record) with the queue columns
+        # cleared so no scan ever matches it again
+        return self._owned_update(
+            owner, job_id,
+            {
+                "queue_state": None,
+                "lease_owner": None,
+                "lease_expires_at": None,
+                "queue_entry": None,
+            },
+        )
+
+    def nack(self, owner: str, job_id: str) -> bool:
+        return self._owned_update(
+            owner, job_id,
+            {
+                "queue_state": Q_QUEUED,
+                "lease_owner": None,
+                "lease_expires_at": None,
+            },
+        )
+
+    def reclaim_expired(self, max_attempts: int | None = None):
+        import time as _time
+
+        if max_attempts is None:
+            max_attempts = self.MAX_ATTEMPTS
+        requeued, dead = [], []
+        now = _time.time()
+        for row in self._candidates(
+            None, (Q_LEASED,), expired_before=now
+        ):
+            attempt = int(row.get("attempt") or 0) + 1
+            terminal = attempt >= max_attempts
+            upd = (
+                self.client.table("jobs")
+                .update(
+                    {
+                        "queue_state": None if terminal else Q_QUEUED,
+                        "lease_owner": None,
+                        "lease_expires_at": None,
+                        "attempt": attempt,
+                    }
+                )
+                .eq("id", row["id"])
+                .eq("queue_state", Q_LEASED)
+                .eq("lease_owner", row.get("lease_owner") or "")
+                # re-check expiry IN the update: the owner's heartbeat
+                # may have renewed between our SELECT and now — a live,
+                # renewed lease must never be stolen (the memory
+                # backend does this check-and-reset under one lock)
+                .lt("lease_expires_at", self._iso(now))
+                .execute()
+            )
+            if not upd.data:
+                notify_queue_event("claim_conflict")
+                continue  # a peer's scan won this expiry
+            # the returned representation carries the full row (the
+            # candidate scan is slim) — queue_entry included, which
+            # the dead-entry failure record needs
+            entry = self._entry(dict(upd.data[0], attempt=attempt))
+            (dead if terminal else requeued).append(entry)
+        return requeued, dead
+
+    def depth(self) -> int:
+        result = (
+            self.client.table("jobs")
+            .select("id", count="exact")
+            .eq("queue_state", Q_QUEUED)
+            .limit(1)
+            .execute()
+        )
+        return int(result.count or 0)
+
+    def register_replica(self, replica_id: str, ttl_s: float) -> None:
+        import time as _time
+
+        self.client.table("replicas").upsert(
+            {
+                "id": replica_id,
+                "expires_at": self._iso(_time.time() + ttl_s),
+            },
+            on_conflict="id",
+        ).execute()
+
+    def replicas(self) -> list[str]:
+        import time as _time
+
+        result = (
+            self.client.table("replicas")
+            .select("id")
+            .gt("expires_at", self._iso(_time.time()))
+            .execute()
+        )
+        return sorted(row["id"] for row in result.data)

@@ -92,6 +92,11 @@ _SLOW_PATTERNS = (
     # 3 solves incl. a 500-iteration cache warmer; the rest of the
     # cache suite stays quick (and tier1.yml runs the file in full)
     "test_cache.py::TestNearHit::test_never_loses_to_cold_start",
+    # distributed-queue end-to-end layers: real cross-replica solves +
+    # the HTTP surface (ring/lease/replica units stay quick; tier1.yml
+    # runs the file in full)
+    "test_distqueue.py::TestCrossReplicaChaos",
+    "test_distqueue.py::TestServiceDistHTTP",
     # dynamic re-solve end-to-end solves (unit/envelope layers stay
     # quick; tier1.yml runs the file in full)
     "test_resolve.py::TestDeltaHTTP",

@@ -1,0 +1,786 @@
+"""Distributed job queue tests: ring determinism, lease semantics,
+tier-affinity claiming, exactly-once reclaim under crashes and store
+faults, and the HTTP surface with VRPMS_QUEUE=store.
+
+Layers:
+
+  * TestRing — consistent-hash units: owner/arcs agreement, full slot
+    coverage, bounded movement on membership change;
+  * TestMemoryQueueStore — the JobQueueStore contract on the shared
+    in-memory backend: exclusive leases, conditional renew/ack/nack,
+    exactly-once expiry reclaim with the attempt ceiling;
+  * TestReplicaRouting — stub-runner replicas: hash-routed claims land
+    on ring owners, off-arc work is stolen only when the own arc is
+    empty;
+  * TestReplicaChaos — kill a replica mid-flight: peers reclaim its
+    leases exactly once, a twice-crashed entry dies clean, and claims
+    keep working under a VRPMS_STORE=faulty fault plan;
+  * TestCrossReplicaChaos (slow) — the ISSUE-9 acceptance gate with
+    REAL solves through the service materialize path: a mixed-tier
+    trace across two in-process replicas sharing one memory-backed
+    queue, one replica killed mid-flight, every job `done` exactly
+    once with trace continuity (same traceId, attempt=2);
+  * TestServiceDistHTTP (slow) — the HTTP surface end to end under
+    VRPMS_QUEUE=store, readiness ring reporting, shared-depth 429s,
+    and the default-path-untouched guard.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+import store
+import store.memory as mem
+from store.base import Q_QUEUED
+from store.faulty import reset_faults
+from vrpms_tpu.sched import Job, Replica, Scheduler
+from vrpms_tpu.sched.ring import SLOTS, HashRing, slot
+
+
+@pytest.fixture(autouse=True)
+def clean_store(monkeypatch):
+    monkeypatch.setenv("VRPMS_STORE", "memory")
+    monkeypatch.delenv("VRPMS_QUEUE", raising=False)
+    mem.reset()
+    reset_faults()
+    yield
+    mem.reset()
+
+
+# ---------------------------------------------------------------------------
+# Ring
+# ---------------------------------------------------------------------------
+
+
+class TestRing:
+    def test_owner_and_arcs_agree_everywhere(self):
+        ring = HashRing(["alpha", "beta", "gamma"], vnodes=16)
+        rng = np.random.default_rng(0)
+        for s in rng.integers(0, SLOTS, size=500):
+            owner = ring.owner(int(s))
+            assert any(
+                lo <= s < hi for lo, hi in ring.arcs(owner)
+            ), (s, owner)
+            for m in ring.members:
+                if m != owner:
+                    assert not any(
+                        lo <= s < hi for lo, hi in ring.arcs(m)
+                    )
+
+    def test_full_coverage_no_overlap(self):
+        ring = HashRing(["a", "b"], vnodes=32)
+        covered = sum(
+            hi - lo for m in ring.members for lo, hi in ring.arcs(m)
+        )
+        assert covered == SLOTS
+
+    def test_deterministic_across_instances(self):
+        a = HashRing(["r1", "r2", "r3"])
+        b = HashRing(["r3", "r1", "r2"])  # order must not matter
+        for s in (0, 7, 9999, SLOTS - 1):
+            assert a.owner(s) == b.owner(s)
+
+    def test_single_member_owns_everything(self):
+        ring = HashRing(["only"])
+        assert ring.arcs("only") == [(0, SLOTS)]
+        assert ring.share("only") == 1.0
+        assert ring.arcs("stranger") == []
+
+    def test_member_death_moves_only_its_arc(self):
+        before = HashRing(["a", "b", "c"], vnodes=32)
+        after = HashRing(["a", "b"], vnodes=32)
+        moved = 0
+        probes = 2000
+        rng = np.random.default_rng(1)
+        for s in rng.integers(0, SLOTS, size=probes):
+            o1, o2 = before.owner(int(s)), after.owner(int(s))
+            if o1 != o2:
+                moved += 1
+                # only slots c owned may move, and only to survivors
+                assert o1 == "c", (s, o1, o2)
+        # c owned roughly a third of the ring; nothing else remapped
+        assert 0 < moved < 0.6 * probes
+
+
+# ---------------------------------------------------------------------------
+# JobQueueStore (memory backend)
+# ---------------------------------------------------------------------------
+
+
+def _entry(job_id, s=0, payload=None, time_limit=None):
+    return {
+        "id": job_id,
+        "slot": s,
+        "bucket": f"tier-{s}",
+        "time_limit": time_limit,
+        "payload": payload or {},
+    }
+
+
+class TestMemoryQueueStore:
+    def test_claim_is_exclusive_and_fifo(self):
+        qs = store.get_queue_store()
+        qs.enqueue(_entry("j1", 5))
+        qs.enqueue(_entry("j2", 5))
+        e1 = qs.claim("r1", 5.0)
+        e2 = qs.claim("r2", 5.0)
+        assert e1["id"] == "j1" and e2["id"] == "j2"
+        assert qs.claim("r3", 5.0) is None
+        assert qs.depth() == 0
+
+    def test_slot_ranges_filter_claims(self):
+        qs = store.get_queue_store()
+        qs.enqueue(_entry("low", 10))
+        qs.enqueue(_entry("high", 60000))
+        assert qs.claim("r1", 5.0, [(0, 100)])["id"] == "low"
+        assert qs.claim("r1", 5.0, [(0, 100)]) is None
+        assert qs.claim("r1", 5.0, [(50000, SLOTS)])["id"] == "high"
+
+    def test_renew_ack_nack_are_owner_conditional(self):
+        qs = store.get_queue_store()
+        qs.enqueue(_entry("j1"))
+        qs.claim("r1", 5.0)
+        assert qs.renew("r1", "j1", 5.0)
+        assert not qs.renew("r2", "j1", 5.0)
+        assert not qs.ack("r2", "j1")
+        assert not qs.nack("r2", "j1")
+        assert qs.nack("r1", "j1")  # back to queued, attempt unchanged
+        e = qs.claim("r2", 5.0)
+        assert e["attempt"] == 0
+        assert qs.ack("r2", "j1")
+        assert not qs.ack("r2", "j1")  # gone
+
+    def test_expired_lease_reclaims_exactly_once(self):
+        qs = store.get_queue_store()
+        qs.enqueue(_entry("j1"))
+        qs.claim("r1", 0.05)
+        time.sleep(0.08)
+        req1, dead1 = qs.reclaim_expired()
+        req2, dead2 = qs.reclaim_expired()  # a racing peer's scan
+        assert [e["id"] for e in req1] == ["j1"] and req1[0]["attempt"] == 1
+        assert req2 == [] and dead1 == [] and dead2 == []
+        # the crashed owner cannot ack or renew its way back in
+        assert not qs.ack("r1", "j1")
+        assert not qs.renew("r1", "j1", 5.0)
+
+    def test_second_expiry_is_dead_not_requeued(self):
+        qs = store.get_queue_store()
+        qs.enqueue(_entry("poison"))
+        qs.claim("r1", 0.05)
+        time.sleep(0.08)
+        req, dead = qs.reclaim_expired()
+        assert len(req) == 1 and not dead
+        qs.claim("r2", 0.05)
+        time.sleep(0.08)
+        req, dead = qs.reclaim_expired()
+        assert not req and [e["id"] for e in dead] == ["poison"]
+        assert dead[0]["attempt"] == 2
+        assert qs.claim("r3", 5.0) is None  # removed, not claimable
+
+    def test_renew_keeps_lease_alive_past_ttl(self):
+        qs = store.get_queue_store()
+        qs.enqueue(_entry("j1"))
+        qs.claim("r1", 0.1)
+        for _ in range(4):
+            time.sleep(0.05)
+            assert qs.renew("r1", "j1", 0.1)
+        req, dead = qs.reclaim_expired()
+        assert not req and not dead
+
+    def test_replica_registry_expires(self):
+        qs = store.get_queue_store()
+        qs.register_replica("a", 5.0)
+        qs.register_replica("b", 0.05)
+        time.sleep(0.08)
+        assert qs.replicas() == ["a"]
+
+    def test_faulty_plan_injects_into_queue_ops(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        qs = store.get_queue_store()
+        with pytest.raises(Exception):
+            qs.enqueue(_entry("j1"))
+        with pytest.raises(Exception):
+            qs.claim("r1", 5.0)
+
+
+# ---------------------------------------------------------------------------
+# Replica routing (stub runners; no jax)
+# ---------------------------------------------------------------------------
+
+
+def _stub_replica(rid, claims, qs=None, steal=True, **kw):
+    """A Replica whose 'scheduler' completes jobs instantly, recording
+    (bucket, kind) per claim into `claims[rid]`."""
+    qs = qs or store.get_queue_store()
+    kinds = {}
+
+    def materialize(entry):
+        job = Job(payload={"entry": entry})
+        job.id = str(entry["id"])
+        return job
+
+    def submit(job):
+        entry = job.payload["entry"]
+        claims.setdefault(rid, []).append(
+            (entry.get("bucket"), kinds.get(job.id, "own"))
+        )
+        job.result = {"ok": True}
+        job.finish("done")
+
+    def on_event(name, **ekw):
+        if name == "claim":
+            kinds[str(ekw.get("jobId"))] = ekw.get("kind")
+
+    defaults = dict(
+        lease_s=2.0, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.1,
+        steal=steal, vnodes=16,
+    )
+    defaults.update(kw)
+    return Replica(
+        qs, rid, materialize, submit, on_event=on_event, **defaults
+    )
+
+
+def _wait(cond, timeout=10.0, every=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return False
+
+
+class TestReplicaRouting:
+    def test_claims_land_on_ring_owners(self):
+        qs = store.get_queue_store()
+        claims: dict = {}
+        reps = [
+            _stub_replica(rid, claims, qs, steal=False)
+            for rid in ("rep-a", "rep-b")
+        ]
+        # register both BEFORE enqueueing so the first ring each
+        # replica derives already has two members
+        for r in reps:
+            qs.register_replica(r.replica_id, 60.0)
+        ring = HashRing(["rep-a", "rep-b"], vnodes=16)
+        tokens = [f"tier-{i}" for i in range(6)]
+        want = {t: ring.owner(slot(t)) for t in tokens}
+        n_jobs = 0
+        for i in range(18):
+            t = tokens[i % len(tokens)]
+            qs.enqueue(
+                {"id": f"j{i}", "slot": slot(t), "bucket": t, "payload": {}}
+            )
+            n_jobs += 1
+        for r in reps:
+            r.start()
+        assert _wait(
+            lambda: sum(len(v) for v in claims.values()) == n_jobs
+        ), claims
+        for r in reps:
+            r.stop()
+        # with stealing OFF every token's jobs went to its ring owner
+        for rid, got in claims.items():
+            for bucket, kind in got:
+                assert want[bucket] == rid, (bucket, rid, want)
+                assert kind == "own"
+
+    def test_steal_only_when_own_arc_empty(self):
+        qs = store.get_queue_store()
+        claims: dict = {}
+        # stealer owns nothing that we enqueue: all jobs pinned to the
+        # other member's arc
+        qs.register_replica("owner", 60.0)
+        qs.register_replica("stealer", 60.0)
+        ring = HashRing(["owner", "stealer"], vnodes=16)
+        owned_by_owner = next(
+            s for s in range(0, SLOTS, 911) if ring.owner(s) == "owner"
+        )
+        for i in range(4):
+            qs.enqueue(
+                {"id": f"j{i}", "slot": owned_by_owner,
+                 "bucket": "hot-tier", "payload": {}}
+            )
+        rep = _stub_replica("stealer", claims, qs, steal=True)
+        rep.start()
+        assert _wait(lambda: len(claims.get("stealer", [])) == 4)
+        rep.stop()
+        assert all(kind == "steal" for _, kind in claims["stealer"])
+
+
+# ---------------------------------------------------------------------------
+# Replica chaos (stub runners; no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaChaos:
+    def test_killed_replica_jobs_reclaimed_exactly_once(self):
+        qs = store.get_queue_store()
+        done: dict = {}
+        done_lock = threading.Lock()
+
+        def materialize(entry):
+            job = Job(payload={"entry": entry})
+            job.id = str(entry["id"])
+            return job
+
+        def blocked_submit(job):
+            pass  # claims, then never completes: a wedged box
+
+        def good_submit(job):
+            job.result = {"ok": True}
+            job.finish("done")
+
+        def complete(job, entry, acked):
+            with done_lock:
+                done.setdefault(job.id, []).append(
+                    (entry.get("attempt"), acked)
+                )
+
+        victim = Replica(
+            qs, "victim", materialize, blocked_submit, complete=complete,
+            lease_s=0.3, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.05,
+        )
+        for i in range(4):
+            qs.enqueue(_entry(f"j{i}", s=i))
+        victim.start()
+        assert _wait(lambda: victim.inflight() == 4)
+        victim.kill()  # crash WITHOUT acking: leases orphaned
+
+        rescuer = Replica(
+            qs, "rescuer", materialize, good_submit, complete=complete,
+            lease_s=0.3, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.05,
+        )
+        rescuer.start()
+        assert _wait(lambda: len(done) == 4), done
+        # momentum: let any stray double-completions surface
+        time.sleep(0.3)
+        rescuer.stop()
+        for job_id, completions in done.items():
+            assert completions == [(1, True)], (job_id, completions)
+
+    def test_double_crash_fails_clean(self):
+        qs = store.get_queue_store()
+        dead_seen: list = []
+
+        def materialize(entry):
+            job = Job(payload={"entry": entry})
+            job.id = str(entry["id"])
+            return job
+
+        victims = [
+            Replica(
+                qs, f"victim{i}", materialize, lambda job: None,
+                dead=lambda e: dead_seen.append(e),
+                lease_s=0.2, poll_s=0.005, heartbeat_s=0.05,
+                reclaim_s=0.05,
+            )
+            for i in range(2)
+        ]
+        qs.enqueue(_entry("poison"))
+        victims[0].start()
+        assert _wait(lambda: victims[0].inflight() == 1)
+        victims[0].kill()
+        victims[1].start()  # reclaims (attempt 1) and re-claims it
+        assert _wait(lambda: victims[1].inflight() == 1, timeout=5)
+        victims[1].kill()
+        # a healthy third party's scan declares it dead — exactly once
+        sentinel = Replica(
+            qs, "sentinel", materialize, lambda job: None,
+            dead=lambda e: dead_seen.append(e),
+            lease_s=0.2, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.05,
+            steal=False,
+        )
+        sentinel.start()
+        assert _wait(lambda: len(dead_seen) == 1, timeout=5), dead_seen
+        time.sleep(0.3)
+        sentinel.stop()
+        assert len(dead_seen) == 1
+        assert dead_seen[0]["id"] == "poison"
+        assert dead_seen[0]["attempt"] == 2
+        assert qs.depth() == 0
+
+    def test_exactly_once_under_faulty_store(self, monkeypatch):
+        # every queue-store call fails with probability 0.25 —
+        # registration, claims, renews, acks alike: the loop must back
+        # off, retry, and still complete every job exactly once (no
+        # loss, no duplicates). The memory backend injects BEFORE
+        # mutating, so a failed ack never committed and the retry is
+        # safe — the same semantics a failed Postgres UPDATE has.
+        monkeypatch.setenv("VRPMS_STORE", "faulty:rate=0.25;seed=3")
+        qs = store.get_queue_store()
+        done: dict = {}
+        lock = threading.Lock()
+
+        def materialize(entry):
+            job = Job(payload={"entry": entry})
+            job.id = str(entry["id"])
+            return job
+
+        def submit(job):
+            job.result = {"ok": True}
+            job.finish("done")
+
+        def complete(job, entry, acked):
+            with lock:
+                done.setdefault(job.id, []).append(acked)
+
+        rep = Replica(
+            qs, "survivor", materialize, submit, complete=complete,
+            lease_s=1.0, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.1,
+        )
+        rep.start()
+        for i in range(5):
+            for _ in range(50):
+                try:
+                    # an injected enqueue failure is the submit path's
+                    # 503: the job was never admitted — retry like a
+                    # client would
+                    qs.enqueue(_entry(f"j{i}", s=i))
+                    break
+                except Exception:
+                    continue
+            else:
+                raise AssertionError("enqueue never succeeded")
+        assert _wait(lambda: len(done) == 5, timeout=20), done
+        time.sleep(0.3)
+        rep.stop()
+        assert all(acks == [True] for acks in done.values()), done
+
+
+# ---------------------------------------------------------------------------
+# Cross-replica chaos with REAL solves (the ISSUE-9 acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+def _seed_dataset(key, n, seed=11):
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        key, [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+    )
+    mem.seed_durations(key, d.tolist())
+
+
+def _solve_content(key, n, seed=1):
+    return {
+        "problem": "vrp",
+        "algorithm": "sa",
+        "solutionName": f"dist-{key}",
+        "solutionDescription": "t",
+        "locationsKey": key,
+        "durationsKey": key,
+        "capacities": [2 * n] * 3,
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": seed,
+        "iterationCount": 200,
+        "populationSize": 8,
+    }
+
+
+def _service_replica(rid, runner=None, **kw):
+    """A replica wired to the REAL service materialize/complete path,
+    executing on its own scheduler — one-replica-per-box in-process."""
+    from service import jobs as jobs_mod
+
+    sched = Scheduler(
+        runner if runner is not None else jobs_mod._runner,
+        queue_limit=64,
+        window_s=0.005,
+        max_batch=8,
+        on_event=jobs_mod._on_event,
+        watchdog_s=0,  # the lease layer is the supervision under test
+    )
+    defaults = dict(
+        lease_s=1.0, poll_s=0.01, heartbeat_s=0.1, reclaim_s=0.05,
+        vnodes=16,
+    )
+    defaults.update(kw)
+    rep = Replica(
+        store.get_queue_store(),
+        rid,
+        materialize=lambda e: jobs_mod._materialize_entry(e, rid),
+        submit=lambda job: sched.submit(
+            job, backend=job.payload.get("backend") or "default"
+        ),
+        complete=jobs_mod._dist_complete,
+        dead=jobs_mod._dist_dead,
+        **defaults,
+    )
+    rep._test_scheduler = sched
+    return rep
+
+
+TRACEPARENT = "00-{tid}-{sid}-01"
+
+
+class TestCrossReplicaChaos:
+    def test_mixed_tier_trace_survives_replica_kill_exactly_once(
+        self, monkeypatch
+    ):
+        """Two in-process replicas, one memory-backed queue, a
+        mixed-tier trace; the replica holding half the leases dies
+        mid-flight. Every job must end `done` EXACTLY once, reclaimed
+        jobs under their ORIGINAL trace id at attempt=2."""
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        from vrpms_tpu.sched import ring as ring_mod
+
+        for key, n in (("dq7", 7), ("dq9", 9)):
+            _seed_dataset(key, n)
+        qs = store.get_queue_store()
+
+        block = threading.Event()
+
+        def blocked_runner(jobs):
+            block.wait(timeout=600)  # a wedged box: never completes
+
+        # stealing OFF on both: the claim assignment must stay exactly
+        # the ring's, so the victim provably holds its half's leases
+        # when it dies and the rescuer only gets them via ring
+        # rebalance (membership expiry) + lease reclaim — the crash
+        # path, not the work-stealing path
+        victim = _service_replica("victim", runner=blocked_runner,
+                                  lease_s=0.8, steal=False)
+        rescuer = _service_replica("rescuer", lease_s=0.8, steal=False)
+        qs.register_replica("victim", 60.0)
+        qs.register_replica("rescuer", 60.0)
+        ring = HashRing(["victim", "rescuer"], vnodes=16)
+
+        specs = [("dq7", 7), ("dq9", 9)] * 3
+        entries, traces = [], {}
+        for i, (key, n) in enumerate(specs):
+            content = _solve_content(key, n, seed=30 + i)
+            # pin half the jobs to each replica's arc via the slot, so
+            # the victim definitely claims work before it dies
+            target = "victim" if i % 2 == 0 else "rescuer"
+            s = next(
+                x for x in range(i, SLOTS, 191)
+                if ring.owner(x) == target
+            )
+            tid = uuid.uuid4().hex
+            sid = uuid.uuid4().hex[:16]
+            job_id = uuid.uuid4().hex[:16]
+            traces[job_id] = (tid, target)
+            entries.append({
+                "id": job_id,
+                "slot": s,
+                "bucket": f"{key}-tier",
+                "time_limit": None,
+                "submitted_at": time.time(),
+                "payload": {
+                    "content": content,
+                    "requestId": f"req-{i}",
+                    "problem": "vrp",
+                    "algorithm": "sa",
+                    "traceparent": TRACEPARENT.format(tid=tid, sid=sid),
+                },
+            })
+        for e in entries:
+            qs.enqueue(e)
+        victim.start()
+        rescuer.start()
+        # the victim must hold leases before the crash
+        assert _wait(lambda: victim.inflight() >= 3, timeout=20)
+        victim.kill()
+
+        db = store.get_database("vrp", None)
+
+        def all_done():
+            for e in entries:
+                rec = db.get_job_seed(e["id"])
+                if rec is None or rec.get("status") != "done":
+                    return False
+            return True
+
+        assert _wait(all_done, timeout=120), {
+            e["id"]: db.get_job_seed(e["id"]) for e in entries
+        }
+        time.sleep(0.5)  # let any stray duplicate publication land
+        rescuer.stop()
+        victim._test_scheduler.shutdown(timeout=0.2)
+        rescuer._test_scheduler.shutdown(timeout=5.0)
+
+        reclaimed = 0
+        for e in entries:
+            rec = db.get_job_seed(e["id"])
+            assert rec["status"] == "done", rec
+            tid, target = traces[e["id"]]
+            # trace continuity: the record carries the SUBMIT trace id
+            assert rec["traceId"] == tid, (rec["traceId"], tid)
+            visited = sorted(
+                c for v in rec["message"]["vehicles"]
+                for c in v["tour"][1:-1]
+            )
+            n = 7 if "dq7" in e["bucket"] else 9
+            assert visited == list(range(1, n)), rec
+            if target == "victim":
+                # reclaimed from the dead replica: attempt 2, exactly
+                # the PR-3 watchdog contract across replicas
+                assert rec["attempt"] == 2, rec
+                reclaimed += 1
+            else:
+                assert rec["attempt"] == 1, rec
+        assert reclaimed == 3
+        assert qs.depth() == 0  # nothing left behind
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface under VRPMS_QUEUE=store
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    from service import jobs as jobs_mod
+    from service.app import serve
+
+    jobs_mod.shutdown_scheduler()
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    jobs_mod.shutdown_scheduler()
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _poll(base, job_id, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, resp = _get(base, f"/api/jobs/{job_id}")
+        assert status == 200, resp
+        if resp["job"]["status"] in ("done", "failed"):
+            return resp["job"]
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never finished")
+
+
+class TestServiceDistHTTP:
+    @pytest.fixture(autouse=True)
+    def dist_env(self, server, monkeypatch):
+        from service import jobs as jobs_mod
+
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_LEASE_S", "5")
+        monkeypatch.setenv("VRPMS_QUEUE_POLL_MS", "10")
+        monkeypatch.setenv("VRPMS_RECLAIM_S", "0.1")
+        _seed_dataset("http7", 7)
+        yield
+        jobs_mod.shutdown_scheduler()
+
+    def test_submit_claim_solve_poll_done(self, server):
+        status, resp, _ = _post(
+            server, "/api/jobs", _solve_content("http7", 7)
+        )
+        assert status == 202 and resp["success"], resp
+        job = _poll(server, resp["jobId"])
+        assert job["status"] == "done", job
+        assert job["attempt"] == 1
+        visited = sorted(
+            c for v in job["message"]["vehicles"] for c in v["tour"][1:-1]
+        )
+        assert visited == [1, 2, 3, 4, 5, 6]
+
+    def test_ready_reports_replica_and_ring(self, server):
+        # force the replica up via one submit
+        status, resp, _ = _post(
+            server, "/api/jobs", _solve_content("http7", 7, seed=2)
+        )
+        assert status == 202, resp
+        _poll(server, resp["jobId"])
+        status, ready = _get(server, "/api/ready")
+        assert status == 200, ready
+        rep = ready["replica"]
+        assert rep["queue"] == "store"
+        assert rep["replicaId"]
+        assert rep["replicaId"] in rep.get("ringMembers", []), rep
+        assert 0.0 < rep["arcShare"] <= 1.0
+        assert isinstance(rep["tiersWarmed"], list)
+
+    def test_shared_queue_backpressure_is_429(self, server, monkeypatch):
+        # a zero shared bound sheds EVERY submit at the shared-depth
+        # check — before the local scheduler is even consulted
+        monkeypatch.setenv("VRPMS_SCHED_QUEUE", "0")
+        status, resp, headers = _post(
+            server, "/api/jobs", _solve_content("http7", 7, seed=3)
+        )
+        assert status == 429, resp
+        assert resp["errors"][0]["what"] == "Too busy"
+        assert int(headers["Retry-After"]) >= 1
+        # shed at the SHARED-depth check: the job never reached the
+        # store queue (and the local scheduler was never consulted)
+        assert mem._tables["job_queue"] == {}
+        monkeypatch.delenv("VRPMS_SCHED_QUEUE")
+        status, resp, _ = _post(
+            server, "/api/jobs", _solve_content("http7", 7, seed=4)
+        )
+        assert status == 202, resp
+        assert _poll(server, resp["jobId"])["status"] == "done"
+
+    def test_resolve_of_peer_running_job_is_409(self, server):
+        # a job mid-flight on ANOTHER replica (non-terminal record, no
+        # live entry here): resolve must refuse — cancellation is
+        # replica-local, and proceeding would double-solve
+        db = store.get_database("vrp", None)
+        db.save_job("peer-job-1", {
+            "id": "peer-job-1", "status": "running",
+            "problem": "vrp", "algorithm": "sa",
+        })
+        status, resp, _ = _post(
+            server, "/api/jobs/peer-job-1/resolve",
+            _solve_content("http7", 7, seed=9),
+        )
+        assert status == 409, resp
+        assert resp["errors"][0]["what"] == "Conflict"
+        assert "another replica" in resp["errors"][0]["reason"]
+
+    def test_default_path_does_not_build_a_replica(
+        self, server, monkeypatch
+    ):
+        monkeypatch.delenv("VRPMS_QUEUE", raising=False)
+        from service import jobs as jobs_mod
+
+        jobs_mod.shutdown_scheduler()
+        status, resp, _ = _post(
+            server, "/api/jobs", _solve_content("http7", 7, seed=5)
+        )
+        assert status == 202, resp
+        assert _poll(server, resp["jobId"])["status"] == "done"
+        # the local path never touches the distributed machinery
+        assert jobs_mod._replica is None
+        assert mem._tables["job_queue"] == {}

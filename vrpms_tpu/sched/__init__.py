@@ -21,6 +21,8 @@ from vrpms_tpu.sched.queue import (
     JobQueue,
     QueueFull,
 )
+from vrpms_tpu.sched.replica import Replica
+from vrpms_tpu.sched.ring import SLOTS, HashRing, slot
 from vrpms_tpu.sched.worker import Scheduler, Worker, expired
 
 __all__ = [
@@ -28,11 +30,15 @@ __all__ = [
     "FAILED",
     "QUEUED",
     "RUNNING",
+    "SLOTS",
+    "HashRing",
     "Job",
     "JobQueue",
     "QueueFull",
+    "Replica",
     "Scheduler",
     "Worker",
     "expired",
     "gather_batch",
+    "slot",
 ]

@@ -1,0 +1,375 @@
+"""Replica: one process's membership in the distributed job queue.
+
+The scale-out counterpart of sched.worker's supervision story. Each
+service process runs ONE Replica; together they turn N disjoint
+schedulers into one deployment:
+
+  * **identity + ring** — the replica heartbeats itself into the store's
+    membership registry and derives the consistent-hash ring
+    (sched.ring) from the live id set, so every peer computes the same
+    tier->replica ownership with no coordinator;
+  * **tier-affinity claiming** — the claim loop first asks the queue
+    store for jobs whose ring slot falls in its OWN arcs (compile-cache
+    locality: the tiers it warmed are the tiers it serves); only when
+    its arc is empty does it steal off-arc work, so a hot replica never
+    idles while peers drown, but routing holds whenever there is a
+    choice;
+  * **lease lifecycle** — every claimed job is executed under a
+    heartbeat-renewed lease; completion acks conditionally (a replica
+    that lost its lease must NOT publish the job's terminal record —
+    the reclaimer owns it now, and double records are exactly the bug
+    leases exist to prevent);
+  * **exactly-once reclaim** — the loop also scans for expired leases:
+    a crashed peer's in-flight jobs re-queue exactly once (the store's
+    conditional update arbitrates racing scanners), carrying the
+    attempt counter so a job that kills its SECOND replica dies with a
+    clean failure record instead of crash-looping the fleet — the
+    cross-replica generalization of the PR-3 watchdog's at-most-one
+    requeue.
+
+The Replica knows nothing about HTTP, jax, or stores' internals: the
+service injects `materialize` (entry -> local Job), `submit` (Job ->
+local scheduler), `complete` (terminal + ack outcome) and `dead`
+(twice-crashed entry -> failure record); all store calls go through the
+JobQueueStore seam. Store failures never propagate: the loop logs,
+backs off, and keeps polling — a queue outage means this replica claims
+nothing for a while, never that it crashes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from vrpms_tpu.sched.queue import FAILED, Job, QueueFull
+from vrpms_tpu.sched.ring import HashRing
+
+
+class Replica:
+    """Claim/lease/reclaim loop against a shared JobQueueStore."""
+
+    def __init__(
+        self,
+        store,
+        replica_id: str,
+        materialize,
+        submit,
+        complete=None,
+        dead=None,
+        on_event=None,
+        *,
+        lease_s: float = 15.0,
+        poll_s: float = 0.05,
+        heartbeat_s: float = 5.0,
+        reclaim_s: float = 1.0,
+        max_inflight: int = 16,
+        max_attempts: int = 2,
+        steal: bool = True,
+        vnodes: int = 64,
+    ):
+        self.store = store
+        self.replica_id = replica_id
+        self._materialize = materialize
+        self._submit = submit
+        self._complete = complete
+        self._dead = dead
+        self._on_event = on_event
+        self.lease_s = max(0.05, float(lease_s))
+        self.poll_s = max(0.005, float(poll_s))
+        self.heartbeat_s = max(0.05, float(heartbeat_s))
+        self.reclaim_s = max(0.05, float(reclaim_s))
+        self.max_inflight = max(1, int(max_inflight))
+        self.max_attempts = max(1, int(max_attempts))
+        self.steal = steal
+        self.vnodes = vnodes
+        self._halt = threading.Event()
+        self._stopping = False  # drain mode: ack/renew, claim nothing
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        # {job_id: (job, entry, lost)} — claimed, not yet acked
+        self._inflight: dict[str, tuple[Job, dict, bool]] = {}
+        self._next_heartbeat = 0.0
+        self._next_reclaim = 0.0
+        self._ring: HashRing | None = None
+        # EWMA of per-job service seconds (shared-depth Retry-After)
+        self._job_seconds = 1.0
+        self._backoff_until = 0.0
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Replica":
+        if self._thread is None or not self._thread.is_alive():
+            self._halt.clear()
+            self._stopping = False
+            self._thread = threading.Thread(
+                target=self._run,
+                name=f"vrpms-replica-{self.replica_id}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Graceful exit: stop CLAIMING first, then give in-flight jobs
+        `drain_s` to finish (and ack), then halt. Claiming must stop
+        before the drain wait — otherwise every ack frees a slot the
+        claim loop refills and the drain never converges, orphaning a
+        full window of fresh leases (each a burned attempt on a peer).
+        Jobs still running after the window keep their leases and are
+        reclaimed by peers on expiry."""
+        self._stopping = True
+        deadline = time.monotonic() + max(0.0, drain_s)
+        while self.inflight() and time.monotonic() < deadline:
+            time.sleep(min(0.02, self.poll_s))
+        self._halt.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=drain_s + 1.0)
+
+    def kill(self) -> None:
+        """Simulated crash (tests/bench): halt instantly WITHOUT acking
+        or draining — in-flight leases are orphaned and expire, which is
+        exactly what peers' reclaim scans exist for."""
+        self._halt.set()
+
+    @property
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive() and not self._halt.is_set()
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def job_seconds_ewma(self) -> float:
+        with self._lock:
+            return self._job_seconds
+
+    def ring(self) -> HashRing | None:
+        """Latest membership snapshot this replica derived (readiness)."""
+        with self._lock:
+            return self._ring
+
+    def owns_slot(self, s: int) -> bool:
+        ring = self.ring()
+        if ring is None:
+            ring = self._refresh_ring()
+        return ring is not None and ring.owner(s) == self.replica_id
+
+    # -- events -------------------------------------------------------------
+    def _emit(self, name: str, **kw) -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(name, **kw)
+        except Exception:
+            pass  # observers must never kill the claim loop
+
+    def _store_error(self, op: str, exc: Exception) -> None:
+        self._emit("store_error", op=op, error=f"{type(exc).__name__}: {exc}")
+        # linear backoff, capped: a down queue store must not busy-spin
+        self._backoff_until = time.monotonic() + min(
+            1.0, 10 * self.poll_s
+        )
+
+    # -- loop ---------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._halt.is_set():
+            now = time.monotonic()
+            if now >= self._backoff_until:
+                if now >= self._next_heartbeat:
+                    self._heartbeat()
+                    self._next_heartbeat = now + self.heartbeat_s
+                if now >= self._next_reclaim:
+                    self._reclaim()
+                    self._next_reclaim = now + self.reclaim_s
+                progressed = self._monitor()
+                claimed = self._claim_one()
+                if claimed or progressed:
+                    continue  # momentum: drain acks/claims back to back
+            self._halt.wait(self.poll_s)
+
+    def _heartbeat(self) -> None:
+        try:
+            # membership TTL = 3 heartbeats: one missed beat (GC pause,
+            # slow store call) must not flap the ring
+            self.store.register_replica(self.replica_id, 3 * self.heartbeat_s)
+        except Exception as exc:
+            self._store_error("register_replica", exc)
+            return
+        self._refresh_ring()
+
+    def _refresh_ring(self) -> HashRing | None:
+        try:
+            members = self.store.replicas()
+        except Exception as exc:
+            self._store_error("replicas", exc)
+            return None
+        if self.replica_id not in members:
+            members = members + [self.replica_id]
+        ring = HashRing(members, vnodes=self.vnodes)
+        with self._lock:
+            self._ring = ring
+        return ring
+
+    def _reclaim(self) -> None:
+        try:
+            requeued, dead = self.store.reclaim_expired(self.max_attempts)
+        except Exception as exc:
+            self._store_error("reclaim_expired", exc)
+            return
+        for entry in requeued:
+            self._emit(
+                "lease_reclaimed",
+                jobId=entry.get("id"),
+                attempt=entry.get("attempt"),
+            )
+        for entry in dead:
+            self._emit(
+                "lease_expired_dead",
+                jobId=entry.get("id"),
+                attempt=entry.get("attempt"),
+            )
+            if self._dead is not None:
+                try:
+                    self._dead(entry)
+                except Exception:
+                    pass
+
+    def _monitor(self) -> bool:
+        """Ack finished jobs, renew live leases. Returns True if any
+        job reached terminal (momentum for the outer loop)."""
+        with self._lock:
+            items = list(self._inflight.items())
+        progressed = False
+        now = time.monotonic()
+        for job_id, (job, entry, lost) in items:
+            if job.done_event.is_set():
+                acked = False
+                if not lost:
+                    try:
+                        acked = self.store.ack(self.replica_id, job_id)
+                    except Exception as exc:
+                        self._store_error("ack", exc)
+                        continue  # retry the ack next pass
+                with self._lock:
+                    self._inflight.pop(job_id, None)
+                    if job.started_at and job.finished_at:
+                        dt = max(1e-3, job.finished_at - job.started_at)
+                        self._job_seconds = (
+                            0.8 * self._job_seconds + 0.2 * dt
+                        )
+                if not acked:
+                    self._emit("ack_lost", jobId=job_id)
+                self._finish(job, entry, acked)
+                progressed = True
+                continue
+            # renew at half-life so one slow store call cannot let a
+            # healthy lease lapse
+            renew_due = entry.get("_renew_mono", 0.0)
+            if lost or now < renew_due:
+                continue
+            try:
+                ok = self.store.renew(self.replica_id, job_id, self.lease_s)
+            except Exception as exc:
+                self._store_error("renew", exc)
+                continue
+            if ok:
+                entry["_renew_mono"] = now + self.lease_s / 2.0
+                self._emit("lease_renewed", jobId=job_id)
+            else:
+                # the lease is someone else's now: stop renewing, ask
+                # the local solve to stand down at its next boundary
+                # (cooperative — the result, if any, is discarded)
+                with self._lock:
+                    if job_id in self._inflight:
+                        self._inflight[job_id] = (job, entry, True)
+                self._emit("lease_lost", jobId=job_id)
+                sink = getattr(job, "sink", None)
+                if sink is not None:
+                    try:
+                        sink.cancel()
+                    except Exception:
+                        pass
+        return progressed
+
+    def _finish(self, job: Job, entry: dict, acked: bool) -> None:
+        if self._complete is None:
+            return
+        try:
+            self._complete(job, entry, acked)
+        except Exception:
+            pass
+
+    def _claim_one(self) -> bool:
+        if self._stopping:
+            return False
+        with self._lock:
+            room = len(self._inflight) < self.max_inflight
+            ring = self._ring
+        if not room:
+            return False
+        if ring is None:
+            ring = self._refresh_ring()
+            if ring is None:
+                return False
+        arcs = ring.arcs(self.replica_id)
+        entry = None
+        stolen = False
+        try:
+            entry = self.store.claim(self.replica_id, self.lease_s, arcs)
+            if entry is None and self.steal:
+                # own arc empty: steal ANY queued work — affinity is a
+                # preference, idle capacity is waste
+                entry = self.store.claim(self.replica_id, self.lease_s, None)
+                stolen = entry is not None
+        except Exception as exc:
+            self._store_error("claim", exc)
+            return False
+        if entry is None:
+            return False
+        entry["_renew_mono"] = time.monotonic() + self.lease_s / 2.0
+        self._emit(
+            "claim",
+            jobId=entry.get("id"),
+            kind="steal" if stolen else "own",
+            attempt=entry.get("attempt"),
+            slot=entry.get("slot"),
+        )
+        try:
+            job = self._materialize(entry)
+        except Exception as exc:
+            # materialize must not raise; if it does, fail the entry
+            # clean rather than leave the lease to expire into a
+            # pointless second attempt of a job that cannot build
+            job = Job(payload={})
+            job.id = str(entry.get("id"))
+            job.errors = [{
+                "what": "Scheduler error",
+                "reason": f"materialize failed: {type(exc).__name__}: {exc}",
+            }]
+            job.finish(FAILED)
+        if job.done_event.is_set():
+            # born terminal (cache hit, trivial, or failed to build):
+            # nothing to schedule — ack and publish right here
+            acked = False
+            try:
+                acked = self.store.ack(self.replica_id, job.id)
+            except Exception as exc:
+                self._store_error("ack", exc)
+            self._finish(job, entry, acked)
+            return True
+        try:
+            self._submit(job)
+        except QueueFull:
+            # local admission full: hand the entry back untouched (no
+            # attempt burned) and back off — a peer with room takes it
+            try:
+                self.store.nack(self.replica_id, job.id)
+            except Exception as exc:
+                self._store_error("nack", exc)
+            self._emit("nack", jobId=job.id)
+            self._backoff_until = time.monotonic() + 5 * self.poll_s
+            return False
+        with self._lock:
+            self._inflight[job.id] = (job, entry, False)
+        return True

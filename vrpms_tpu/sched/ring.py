@@ -1,0 +1,114 @@
+"""Consistent-hash ring over tier keys: which replica owns which tiers.
+
+The scale-out design routes jobs to replicas BY TIER (the padded-shape
+key service.jobs coarsens requests to), because compile-cache locality
+is the scarce resource: a replica that has compiled tier 16x4's
+programs serves every 16x4 job at steady-state latency, while an
+unrouted claim spreads every tier across every replica and each one
+pays the whole ladder's cold compiles. Consistent hashing gives that
+routing two properties FIFO sharding would not:
+
+  * determinism without coordination — every replica derives the same
+    owner for a tier key from nothing but the live membership list (the
+    store's heartbeat registry), so there is no leader and no
+    assignment table to keep consistent;
+  * minimal movement — a replica joining or dying remaps only the arcs
+    it gains or loses (~1/N of the ring), so a scale-out event does not
+    cold-start every replica's compile cache from scratch.
+
+Slots are sha256-derived (stable across processes and Python runs —
+`hash()` is salted per process and would give every replica a different
+ring). `vnodes` virtual nodes per member smooth the arc distribution.
+
+Stdlib-only by design, like the rest of vrpms_tpu.sched.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+#: ring positions (slot space). 2^16 keeps slots small ints that index
+#: cleanly into SQL range predicates (store/schema.sql `slot integer`).
+SLOTS = 1 << 16
+
+
+def slot(token: str) -> int:
+    """Stable ring position of a routing token (tier key, member#vnode)."""
+    digest = hashlib.sha256(token.encode("utf-8")).digest()
+    return int.from_bytes(digest[:4], "big") % SLOTS
+
+
+class HashRing:
+    """Immutable ring over a membership snapshot.
+
+    Ownership rule: slot `s` belongs to the member whose vnode point is
+    the clockwise successor of `s` (first point with position >= s,
+    wrapping). `arcs(member)` returns the same ownership as half-open
+    [lo, hi) slot ranges — the form both the in-memory claim filter and
+    the SQL range predicates consume — so `owner(s) == m` iff `s` falls
+    in one of `arcs(m)`.
+    """
+
+    def __init__(self, members: list[str], vnodes: int = 64):
+        self.members = sorted(set(members))
+        self.vnodes = max(1, int(vnodes))
+        points: list[tuple[int, str]] = []
+        for m in self.members:
+            for i in range(self.vnodes):
+                points.append((slot(f"{m}#{i}"), m))
+        # sort by (slot, member): equal-slot collisions resolve to the
+        # lexicographically first member, identically everywhere
+        points.sort()
+        self._points = points
+        self._positions = [p for p, _ in points]
+
+    def owner(self, s: int) -> str | None:
+        """The member owning slot `s` (None on an empty ring)."""
+        if not self._points:
+            return None
+        idx = bisect.bisect_left(self._positions, s % SLOTS)
+        if idx == len(self._points):
+            idx = 0  # wrap: successor of the last gap is the first point
+        return self._points[idx][1]
+
+    def arcs(self, member: str) -> list[tuple[int, int]]:
+        """Half-open [lo, hi) slot ranges owned by `member`.
+
+        A single-member ring owns everything; an unknown member owns
+        nothing. Wraparound arcs split into a tail and a head range.
+        """
+        if member not in self.members:
+            return []
+        if len(self.members) == 1:
+            return [(0, SLOTS)]
+        out: list[tuple[int, int]] = []
+        pts = self._points
+        for i, (pos, m) in enumerate(pts):
+            if m != member:
+                continue
+            prev = pts[i - 1][0]  # i == 0 wraps to the last point
+            # this point owns (prev, pos] == [prev + 1, pos + 1)
+            lo, hi = prev + 1, pos + 1
+            if lo == hi:
+                continue  # duplicate-slot point: empty arc
+            if lo < hi:
+                out.append((lo, hi))
+            else:  # wraparound
+                if lo < SLOTS:
+                    out.append((lo, SLOTS))
+                if hi > 0:
+                    out.append((0, hi))
+        out.sort()
+        # merge adjacent/overlapping ranges: fewer predicates downstream
+        merged: list[tuple[int, int]] = []
+        for lo, hi in out:
+            if merged and lo <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(hi, merged[-1][1]))
+            else:
+                merged.append((lo, hi))
+        return merged
+
+    def share(self, member: str) -> float:
+        """Fraction of the slot space `member` owns (readiness surface)."""
+        return sum(hi - lo for lo, hi in self.arcs(member)) / SLOTS
