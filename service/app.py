@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from service import obs
 from service.api.index import handler as health_handler
+from vrpms_tpu import config
 from service.debug import TraceDetailHandler, TracesHandler
 from service.jobs import (
     JobResolveHandler,
@@ -136,7 +137,7 @@ def main():
     parser.add_argument("--store", choices=["memory", "supabase"])
     parser.add_argument(
         "--warmup",
-        default=os.environ.get("VRPMS_WARMUP", ""),
+        default=config.get("VRPMS_WARMUP"),
         help="pre-trace solver programs before serving: 'tiers' (or "
         "'auto') warms the shape-tier ladder in the BACKGROUND while "
         "the port serves (core.tiers), or give explicit shapes "
@@ -204,13 +205,13 @@ def main():
     log_event(
         "service.start",
         port=args.port,
-        store=os.environ.get("VRPMS_STORE", "auto"),
+        store=config.raw("VRPMS_STORE") or "auto",
         compileCache=cache_dir or "off",
         tiers="off" if lad is None else f"n<= {lad.n[-1] if lad.n else 0}",
     )
     print(
         f"vrpms_tpu service on :{args.port} "
-        f"(store={os.environ.get('VRPMS_STORE', 'auto')}, "
+        f"(store={config.raw('VRPMS_STORE') or 'auto'}, "
         f"compile_cache={cache_dir or 'off'})"
     )
     # SIGTERM (the orchestrator's stop signal) must reach the drain

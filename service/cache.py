@@ -46,14 +46,14 @@ from __future__ import annotations
 import copy
 import hashlib
 import json
-import os
 
 import numpy as np
 
 from service import obs
 from store.base import cache_enabled
+from vrpms_tpu import config
 from vrpms_tpu.core import tiers
-from vrpms_tpu.core.delta import repair_perm, strip_order  # noqa: F401
+from vrpms_tpu.core.delta import repair_perm, strip_order  # noqa: F401 (re-exported: service.solve consumes solution_cache.strip_order)
 from vrpms_tpu.obs import log_event, spans
 
 #: request options that parameterize the solver program or its result —
@@ -74,10 +74,7 @@ _VOLATILE_KEYS = ("stats", "degraded", "cacheHit")
 def near_limit() -> int:
     """Max Hamming distance (|A symmetric-difference B| over customer-id
     sets) an implicit near hit may bridge; 0 disables near seeding."""
-    try:
-        return max(0, int(os.environ.get("VRPMS_CACHE_NEAR", "4")))
-    except (TypeError, ValueError):
-        return 4
+    return max(0, config.get("VRPMS_CACHE_NEAR"))
 
 
 def _warm_supported(prep) -> bool:

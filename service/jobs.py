@@ -30,7 +30,6 @@ VRPMS_SCHED_QUEUE (admission bound, default 64), VRPMS_SCHED_WINDOW_MS
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 import traceback
@@ -38,6 +37,7 @@ from http.server import BaseHTTPRequestHandler
 
 import store
 from service import obs
+from vrpms_tpu import config
 from service import cache as solution_cache
 from service.helpers import (
     fail,
@@ -97,9 +97,7 @@ _PARSERS = {
 
 
 def scheduler_enabled() -> bool:
-    return os.environ.get("VRPMS_SCHED", "on").lower() not in (
-        "off", "0", "false", "no",
-    )
+    return config.enabled("VRPMS_SCHED")
 
 
 # ---------------------------------------------------------------------------
@@ -704,18 +702,12 @@ def get_scheduler() -> Scheduler:
             _drained = False
             _scheduler = Scheduler(
                 _runner,
-                queue_limit=int(os.environ.get("VRPMS_SCHED_QUEUE", "64")),
-                window_s=float(
-                    os.environ.get("VRPMS_SCHED_WINDOW_MS", "10")
-                ) / 1e3,
-                max_batch=int(os.environ.get("VRPMS_SCHED_MAX_BATCH", "16")),
+                queue_limit=config.get("VRPMS_SCHED_QUEUE"),
+                window_s=config.get("VRPMS_SCHED_WINDOW_MS") / 1e3,
+                max_batch=config.get("VRPMS_SCHED_MAX_BATCH"),
                 on_event=_on_event,
-                watchdog_s=float(
-                    os.environ.get("VRPMS_SCHED_WATCHDOG_MS", "500")
-                ) / 1e3,
-                wedge_grace_s=float(
-                    os.environ.get("VRPMS_SCHED_WEDGE_GRACE_S", "10")
-                ),
+                watchdog_s=config.get("VRPMS_SCHED_WATCHDOG_MS") / 1e3,
+                wedge_grace_s=config.get("VRPMS_SCHED_WEDGE_GRACE_S"),
                 on_worker_event=_on_worker_event,
             )
             obs.set_queue_depth_provider(_queue_depths)
@@ -733,7 +725,7 @@ def shutdown_scheduler() -> int:
     with _replica_lock:
         r, _replica = _replica, None
     if r is not None:
-        r.stop(drain_s=_env_float("VRPMS_REPLICA_DRAIN_S", 5.0))
+        r.stop(drain_s=config.get("VRPMS_REPLICA_DRAIN_S"))
     global _replica_id_cached
     _replica_id_cached = None  # a rebuilt service re-reads the env
     with _sched_lock:
@@ -767,23 +759,9 @@ def shutdown_scheduler() -> int:
 
 
 def dist_queue_enabled() -> bool:
-    return os.environ.get("VRPMS_QUEUE", "local").strip().lower() in (
+    return config.get("VRPMS_QUEUE").strip().lower() in (
         "store", "shared", "dist",
     )
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
 
 
 _replica = None
@@ -800,7 +778,7 @@ def replica_id() -> str:
         import uuid
 
         _replica_id_cached = (
-            os.environ.get("VRPMS_REPLICA_ID")
+            config.get("VRPMS_REPLICA_ID")
             or f"replica-{uuid.uuid4().hex[:8]}"
         )
     return _replica_id_cached
@@ -1068,14 +1046,13 @@ def build_replica(rid: str, scheduler=None, **kw):
             raise
 
     defaults = dict(
-        lease_s=_env_float("VRPMS_LEASE_S", 15.0),
-        poll_s=_env_float("VRPMS_QUEUE_POLL_MS", 50.0) / 1e3,
-        heartbeat_s=_env_float("VRPMS_HEARTBEAT_S", 5.0),
-        reclaim_s=_env_float("VRPMS_RECLAIM_S", 1.0),
-        max_inflight=_env_int("VRPMS_QUEUE_MAX_INFLIGHT", 16),
-        steal=os.environ.get("VRPMS_QUEUE_STEAL", "on").lower()
-        not in ("off", "0", "false", "no"),
-        vnodes=_env_int("VRPMS_RING_VNODES", 64),
+        lease_s=config.get("VRPMS_LEASE_S"),
+        poll_s=config.get("VRPMS_QUEUE_POLL_MS") / 1e3,
+        heartbeat_s=config.get("VRPMS_HEARTBEAT_S"),
+        reclaim_s=config.get("VRPMS_RECLAIM_S"),
+        max_inflight=config.get("VRPMS_QUEUE_MAX_INFLIGHT"),
+        steal=config.enabled("VRPMS_QUEUE_STEAL"),
+        vnodes=config.get("VRPMS_RING_VNODES"),
     )
     defaults.update(kw)
     return Replica(
@@ -1111,7 +1088,7 @@ def _submit_distributed(handler, ctx, job: Job, prep, resolve_from=None):
     self = handler
     replica = get_replica()
     qs = replica.store
-    limit = _env_int("VRPMS_SCHED_QUEUE", 64)
+    limit = config.get("VRPMS_SCHED_QUEUE")
     # membership from the replica's cached ring (refreshed every
     # heartbeat) — the admission hot path pays ONE store read (depth),
     # not two
@@ -1678,7 +1655,7 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
         until it turns terminal, emitting its incumbent snapshots as
         they land. A non-terminal record must NEVER be reported as
         `failed`: the job is healthy, just not ours."""
-        timeout_s = float(os.environ.get("VRPMS_STREAM_TIMEOUT_S", "600"))
+        timeout_s = config.get("VRPMS_STREAM_TIMEOUT_S")
         deadline = time.monotonic() + timeout_s
         last_block = None
         while True:
@@ -1706,7 +1683,7 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
                 record = fresh
 
     def _follow(self, job: Job) -> None:
-        timeout_s = float(os.environ.get("VRPMS_STREAM_TIMEOUT_S", "600"))
+        timeout_s = config.get("VRPMS_STREAM_TIMEOUT_S")
         deadline = time.monotonic() + timeout_s
         sink = job.sink
         if sink is None:
@@ -1809,7 +1786,7 @@ class JobResolveHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
                     "job.cancel_requested", jobId=job_id,
                     status=live.status, resolve=True,
                 )
-            wait_s = float(os.environ.get("VRPMS_RESOLVE_WAIT_S", "30"))
+            wait_s = config.get("VRPMS_RESOLVE_WAIT_S")
             if not live.wait(timeout=wait_s):
                 self._obs_errors = ["Conflict"]
                 _respond(self, 409, {
@@ -1889,7 +1866,7 @@ def readiness() -> tuple[int, dict]:
     s = _scheduler
     workers = s.worker_health() if s is not None else {}
     restarts = dict(s.restarts) if s is not None else {}
-    window_s = float(os.environ.get("VRPMS_READY_RESTART_WINDOW_S", "60"))
+    window_s = config.get("VRPMS_READY_RESTART_WINDOW_S")
     recent_restart = (
         s is not None
         and s.last_restart_mono is not None
@@ -1903,9 +1880,7 @@ def readiness() -> tuple[int, dict]:
         or recent_restart
     ):
         status = "degraded"
-    watchdog_on = float(
-        os.environ.get("VRPMS_SCHED_WATCHDOG_MS", "500")
-    ) > 0
+    watchdog_on = config.get("VRPMS_SCHED_WATCHDOG_MS") > 0
     if (
         (s is None and _drained)  # drained, no rebuild yet
         or (s is not None and s.is_shutdown)

@@ -26,8 +26,7 @@ thread hop per call).
 
 from __future__ import annotations
 
-import os
-
+from vrpms_tpu import config
 from vrpms_tpu.utils import load_dotenv
 
 # The reference loads `.env` at package import (src/__init__.py:1-2) so
@@ -38,7 +37,7 @@ load_dotenv()
 
 
 def _resilience_wraps(kind: str) -> bool:
-    mode = os.environ.get("VRPMS_RESILIENCE", "auto").lower()
+    mode = config.get("VRPMS_RESILIENCE").lower()
     if mode in ("off", "0", "false", "no"):
         return False
     if mode in ("on", "1", "true", "yes"):
@@ -48,9 +47,9 @@ def _resilience_wraps(kind: str) -> bool:
 
 def get_database(problem: str, auth=None):
     """Factory: problem is 'vrp' or 'tsp'; returns the configured store."""
-    kind = os.environ.get("VRPMS_STORE")
+    kind = config.raw("VRPMS_STORE")
     if kind is None:
-        kind = "supabase" if os.environ.get("SUPABASE_URL") else "memory"
+        kind = "supabase" if config.get("SUPABASE_URL") else "memory"
     plan = ""
     if kind.startswith("faulty"):
         kind, _, plan = kind.partition(":")
@@ -90,9 +89,9 @@ def get_queue_store():
     double-claim after a commit-then-timeout), and a queue outage
     degrades to "this replica claims nothing for a while", never to a
     failed request — the resilience policy is the loop itself."""
-    kind = os.environ.get("VRPMS_STORE")
+    kind = config.raw("VRPMS_STORE")
     if kind is None:
-        kind = "supabase" if os.environ.get("SUPABASE_URL") else "memory"
+        kind = "supabase" if config.get("SUPABASE_URL") else "memory"
     plan = ""
     if kind.startswith("faulty"):
         kind, _, plan = kind.partition(":")

@@ -18,8 +18,9 @@ Errors are accumulated into the caller's mutable list as
 
 from __future__ import annotations
 
-import os
 import sys
+
+from vrpms_tpu import config
 
 
 # --- solution-cache configuration (the VRPMS_CACHE knob) -------------------
@@ -33,7 +34,7 @@ DEFAULT_CACHE_CAP = 512
 
 
 def cache_mode() -> str:
-    return os.environ.get("VRPMS_CACHE", "").strip().lower()
+    return config.get("VRPMS_CACHE").strip().lower()
 
 
 def cache_enabled() -> bool:

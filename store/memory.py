@@ -17,11 +17,11 @@ or from a JSON fixture file via VRPMS_FIXTURES:
 from __future__ import annotations
 
 import json
-import os
 import threading
 
 import time
 
+from vrpms_tpu import config
 from store.base import (
     Database,
     DatabaseTSP,
@@ -34,7 +34,7 @@ from store.base import (
 )
 
 _lock = threading.Lock()
-_tables: dict = {
+_tables: dict = {  # guarded-by: _lock
     "locations": {},
     "durations": {},
     "solutions": [],
@@ -44,8 +44,8 @@ _tables: dict = {
     "job_queue": {},
     "replicas": {},
 }
-_tokens: dict = {}
-_fixtures_loaded = False
+_tokens: dict = {}  # guarded-by: _lock
+_fixtures_loaded = False  # guarded-by: _fixtures_lock
 
 
 def reset():
@@ -59,7 +59,8 @@ def reset():
         _tables["job_queue"].clear()
         _tables["replicas"].clear()
         _tokens.clear()
-        global _fixtures_loaded
+    global _fixtures_loaded
+    with _fixtures_lock:
         _fixtures_loaded = False
 
 
@@ -79,7 +80,8 @@ def register_token(token: str, email: str):
 
 
 def saved_solutions() -> list:
-    return list(_tables["solutions"])
+    with _lock:
+        return list(_tables["solutions"])
 
 
 _fixtures_lock = threading.Lock()
@@ -87,12 +89,12 @@ _fixtures_lock = threading.Lock()
 
 def _ensure_fixtures():
     global _fixtures_loaded
-    if _fixtures_loaded:
+    if _fixtures_loaded:  # vrpms-lint: disable=lock-discipline (double-checked fast path; the locked re-check below arbitrates, and the flag only ever flips under _fixtures_lock)
         return
     with _fixtures_lock:  # serialize first loads; flag only set on success
         if _fixtures_loaded:
             return
-        path = os.environ.get("VRPMS_FIXTURES")
+        path = config.get("VRPMS_FIXTURES")
         if path:
             with open(path) as f:
                 fx = json.load(f)
@@ -108,7 +110,8 @@ def _ensure_fixtures():
 class _InMemoryMixin(Database):
     def _fetch_row(self, table: str, row_id):
         _ensure_fixtures()
-        return _tables[table].get(str(row_id))
+        with _lock:
+            return _tables[table].get(str(row_id))
 
     def _insert_solution(self, data: dict):
         with _lock:
@@ -117,10 +120,12 @@ class _InMemoryMixin(Database):
 
     def _owner_email(self):
         _ensure_fixtures()
-        return _tokens.get(self.auth) if self.auth else None
+        with _lock:
+            return _tokens.get(self.auth) if self.auth else None
 
     def _fetch_warmstart(self, owner, name):
-        return _tables["warmstarts"].get((owner, str(name)))
+        with _lock:
+            return _tables["warmstarts"].get((owner, str(name)))
 
     # retained job records: dicts preserve insertion order, so eviction
     # below drops the OLDEST job first. Bounds the jobs table for a
@@ -129,7 +134,8 @@ class _InMemoryMixin(Database):
     MAX_JOBS = 10_000
 
     def _fetch_job(self, job_id):
-        return _tables["jobs"].get(str(job_id))
+        with _lock:
+            return _tables["jobs"].get(str(job_id))
 
     def _upsert_job(self, job_id, record: dict):
         with _lock:
@@ -222,7 +228,7 @@ class InMemoryJobQueue(JobQueueStore):
     conditional UPDATEs must match. Dicts preserve insertion order, so
     FIFO claim order falls out of iteration."""
 
-    def _rows(self) -> dict:
+    def _rows_locked(self) -> dict:
         return _tables["job_queue"]
 
     @staticmethod
@@ -240,12 +246,12 @@ class InMemoryJobQueue(JobQueueStore):
         row["lease_owner"] = None
         row["lease_expires_at"] = None
         with _lock:
-            self._rows()[str(row["id"])] = row
+            self._rows_locked()[str(row["id"])] = row
 
     def claim(self, owner: str, lease_s: float, slots=None) -> dict | None:
         now = time.time()
         with _lock:
-            for row in self._rows().values():
+            for row in self._rows_locked().values():
                 if row["state"] != Q_QUEUED:
                     continue
                 if not self._in_slots(row.get("slot", 0), slots):
@@ -256,8 +262,8 @@ class InMemoryJobQueue(JobQueueStore):
                 return dict(row)
         return None
 
-    def _owned(self, owner: str, job_id: str):
-        row = self._rows().get(str(job_id))
+    def _owned_locked(self, owner: str, job_id: str):
+        row = self._rows_locked().get(str(job_id))
         if row is None or row["state"] != Q_LEASED:
             return None
         if row["lease_owner"] != owner:
@@ -266,7 +272,7 @@ class InMemoryJobQueue(JobQueueStore):
 
     def renew(self, owner: str, job_id: str, lease_s: float) -> bool:
         with _lock:
-            row = self._owned(owner, job_id)
+            row = self._owned_locked(owner, job_id)
             if row is None:
                 return False
             row["lease_expires_at"] = time.time() + lease_s
@@ -274,15 +280,15 @@ class InMemoryJobQueue(JobQueueStore):
 
     def ack(self, owner: str, job_id: str) -> bool:
         with _lock:
-            row = self._owned(owner, job_id)
+            row = self._owned_locked(owner, job_id)
             if row is None:
                 return False
-            del self._rows()[str(job_id)]
+            del self._rows_locked()[str(job_id)]
             return True
 
     def nack(self, owner: str, job_id: str) -> bool:
         with _lock:
-            row = self._owned(owner, job_id)
+            row = self._owned_locked(owner, job_id)
             if row is None:
                 return False
             row["state"] = Q_QUEUED
@@ -296,7 +302,7 @@ class InMemoryJobQueue(JobQueueStore):
         now = time.time()
         requeued, dead = [], []
         with _lock:
-            rows = self._rows()
+            rows = self._rows_locked()
             for job_id in list(rows):
                 row = rows[job_id]
                 if row["state"] != Q_LEASED:
@@ -318,7 +324,7 @@ class InMemoryJobQueue(JobQueueStore):
     def depth(self) -> int:
         with _lock:
             return sum(
-                1 for r in self._rows().values() if r["state"] == Q_QUEUED
+                1 for r in self._rows_locked().values() if r["state"] == Q_QUEUED
             )
 
     def register_replica(self, replica_id: str, ttl_s: float) -> None:

@@ -51,12 +51,12 @@ stays importable standalone) and `/metrics` scrapes `circuit_states()`.
 from __future__ import annotations
 
 import concurrent.futures
-import os
 import random
 import threading
 import time
 
 from store.base import Database, DatabaseTSP, DatabaseVRP
+from vrpms_tpu import config
 from vrpms_tpu.obs import log_event, spans
 
 CLOSED, HALF_OPEN, OPEN = "closed", "half-open", "open"
@@ -72,20 +72,6 @@ class StoreUnavailable(Exception):
 
 class StoreTimeout(Exception):
     """A backend call exceeded the per-call deadline."""
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
 
 
 def _obs():
@@ -120,10 +106,10 @@ class CircuitBreaker:
         self.reset_s = reset_s
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._failures = 0
-        self._opened_at = 0.0
-        self._probing = False
+        self._state = CLOSED  # guarded-by: _lock
+        self._failures = 0  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        self._probing = False  # guarded-by: _lock
 
     def _tick_locked(self) -> None:
         if (
@@ -182,7 +168,7 @@ class FallbackStore:
     def __init__(self, limit: int = 256):
         self.limit = max(1, limit)
         self._lock = threading.Lock()
-        self._rows: dict = {}
+        self._rows: dict = {}  # guarded-by: _lock
 
     def get(self, key):
         with self._lock:
@@ -231,9 +217,9 @@ class WriteJournal:
     def __init__(self, limit: int = 512):
         self.limit = max(1, limit)
         self._lock = threading.Lock()
-        self._entries: list = []
-        self._tombstones: set = set()
-        self.dropped = 0
+        self._entries: list = []  # guarded-by: _lock
+        self._tombstones: set = set()  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
 
     def append(self, method: str, args: tuple, key=None, target=None) -> None:
         with self._lock:
@@ -279,17 +265,17 @@ class _Resilience:
 
     def __init__(self):
         self.breaker = CircuitBreaker(
-            threshold=_env_int("VRPMS_CB_FAILURES", 5),
-            reset_s=_env_float("VRPMS_CB_RESET_S", 30.0),
+            threshold=config.get("VRPMS_CB_FAILURES"),
+            reset_s=config.get("VRPMS_CB_RESET_S"),
         )
-        self.fallback = FallbackStore(_env_int("VRPMS_STORE_CACHE", 256))
-        self.journal = WriteJournal(_env_int("VRPMS_STORE_JOURNAL", 512))
+        self.fallback = FallbackStore(config.get("VRPMS_STORE_CACHE"))
+        self.journal = WriteJournal(config.get("VRPMS_STORE_JOURNAL"))
         self.replay_lock = threading.Lock()
 
 
 _state_lock = threading.Lock()
-_states: dict[str, _Resilience] = {}
-_executor: concurrent.futures.ThreadPoolExecutor | None = None
+_states: dict[str, _Resilience] = {}  # guarded-by: _state_lock
+_executor: concurrent.futures.ThreadPoolExecutor | None = None  # guarded-by: _state_lock
 
 
 def _resilience_for(kind: str) -> _Resilience:
@@ -324,7 +310,7 @@ def _get_executor() -> concurrent.futures.ThreadPoolExecutor:
     with _state_lock:
         if _executor is None:
             _executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=_env_int("VRPMS_STORE_POOL", 8),
+                max_workers=config.get("VRPMS_STORE_POOL"),
                 thread_name_prefix="vrpms-store",
             )
         return _executor
@@ -339,9 +325,9 @@ class _ResilientMixin(Database):
         self._res = _resilience_for(kind)
         # per-instance (= per-request) knobs, re-read so tests and live
         # tuning apply without clearing the shared breaker state
-        self.deadline_s = _env_float("VRPMS_STORE_DEADLINE_S", 5.0)
-        self.retries = _env_int("VRPMS_STORE_RETRIES", 2)
-        self.backoff_base_s = _env_float("VRPMS_STORE_BACKOFF_S", 0.05)
+        self.deadline_s = config.get("VRPMS_STORE_DEADLINE_S")
+        self.retries = config.get("VRPMS_STORE_RETRIES")
+        self.backoff_base_s = config.get("VRPMS_STORE_BACKOFF_S")
 
     # -- call plumbing ------------------------------------------------------
     def _attempt(self, method: str, args: tuple, timeout=None,

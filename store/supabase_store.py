@@ -11,8 +11,6 @@ a clear error.
 
 from __future__ import annotations
 
-import os
-
 from store.base import (
     Database,
     DatabaseTSP,
@@ -22,6 +20,7 @@ from store.base import (
     Q_QUEUED,
     notify_queue_event,
 )
+from vrpms_tpu import config
 from vrpms_tpu.obs import log_event
 
 
@@ -36,8 +35,8 @@ class _SupabaseMixin(Database):
                 "supabase SDK not installed; set VRPMS_STORE=memory or "
                 "install supabase to use the hosted store"
             ) from e
-        url = os.environ.get("SUPABASE_URL") or ""
-        key = os.environ.get("SUPABASE_KEY") or ""
+        url = config.get("SUPABASE_URL")
+        key = config.get("SUPABASE_KEY")
         self.client = create_client(
             url, key, options=ClientOptions(persist_session=False)
         )
@@ -202,8 +201,8 @@ class SupabaseJobQueue(JobQueueStore):
                 "supabase SDK not installed; set VRPMS_STORE=memory or "
                 "install supabase to use the hosted job queue"
             ) from e
-        url = os.environ.get("SUPABASE_URL") or ""
-        key = os.environ.get("SUPABASE_KEY") or ""
+        url = config.get("SUPABASE_URL")
+        key = config.get("SUPABASE_KEY")
         self.client = create_client(
             url, key, options=ClientOptions(persist_session=False)
         )

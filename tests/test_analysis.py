@@ -1,0 +1,756 @@
+"""vrpms-lint (vrpms_tpu.analysis) — the static-analysis gate's own tests.
+
+Three layers:
+
+  * fixture snippets per rule family — each checker catches a seeded
+    violation, stays quiet on the clean twin, and honors an inline
+    suppression (the catalogue test the acceptance criteria name);
+  * the repo-wide run — zero unsuppressed findings, plus the
+    suppression-count regression guard (a new suppression is a
+    reviewed, deliberate act: bump the pin WITH the reason);
+  * the config registry's runtime accessor contract, and targeted
+    concurrency tests for the unsynchronized accesses the
+    lock-discipline sweep found and fixed (memory-store reads,
+    Scheduler.depth).
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+
+import pytest
+
+from vrpms_tpu import analysis, config
+from vrpms_tpu.analysis.base import run_rules
+from vrpms_tpu.analysis.config_rules import (
+    DocSyncRule,
+    EnvReadRule,
+    UnknownVarRule,
+)
+from vrpms_tpu.analysis.contracts import (
+    EnvelopeRule,
+    MetricContractRule,
+    SpanNameRule,
+)
+from vrpms_tpu.analysis.deadcode import DeadImportRule, DeadPrivateSymbolRule
+from vrpms_tpu.analysis.locks import LockDisciplineRule
+from vrpms_tpu.analysis.tracing import TraceHygieneRule
+
+
+def lint(tmp_path, source, rules, filename="mod.py", reference=None):
+    """Write one fixture module (+ optional reference-only module) and
+    run `rules` over it."""
+    path = tmp_path / filename
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    refs = []
+    if reference is not None:
+        ref = tmp_path / "refmod.py"
+        ref.write_text(textwrap.dedent(reference))
+        refs = [ref]
+    return run_rules(rules, [path], tmp_path, reference_paths=refs)
+
+
+def rules_of(report):
+    return [f.rule for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# Lock discipline
+# ---------------------------------------------------------------------------
+
+
+class TestLockDiscipline:
+    def test_instance_attr_violation_and_clean(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: _lock
+
+                def good(self):
+                    with self._lock:
+                        return len(self._items)
+
+                def bad(self):
+                    return self._items.pop()
+            """, [LockDisciplineRule()])
+        assert rules_of(report) == ["lock-discipline"]
+        assert report.findings[0].message.startswith("access to self._items")
+
+    def test_module_global_violation(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            _lock = threading.Lock()
+            _table = {}  # guarded-by: _lock
+
+            def good():
+                with _lock:
+                    _table["k"] = 1
+
+            def bad():
+                return _table.get("k")
+            """, [LockDisciplineRule()])
+        assert rules_of(report) == ["lock-discipline"]
+
+    def test_condition_alias_counts_as_lock(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._new = threading.Condition(self._lock)
+                    self._latest = None  # guarded-by: _lock
+
+                def publish(self, snap):
+                    with self._new:
+                        self._latest = snap
+                        self._new.notify_all()
+            """, [LockDisciplineRule()])
+        assert report.findings == []
+
+    def test_locked_suffix_helper_is_trusted(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = "closed"  # guarded-by: _lock
+
+                def _tick_locked(self):
+                    self._state = "open"
+
+                def tick(self):
+                    with self._lock:
+                        self._tick_locked()
+            """, [LockDisciplineRule()])
+        assert report.findings == []
+
+    def test_nested_function_does_not_inherit_lock(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: _lock
+
+                def leak(self):
+                    with self._lock:
+                        def later(self=self):
+                            return self._items
+                        return later
+            """, [LockDisciplineRule()])
+        # the closure body is skipped (conservative), but crucially the
+        # with-block's lock must NOT extend into it producing a silent
+        # pass for direct accesses after this pattern
+        assert report.findings == []
+
+    def test_suppression_with_reason(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: _lock
+
+                def fast(self):
+                    return self._items  # vrpms-lint: disable=lock-discipline (benign racy read, bounded staleness)
+            """, [LockDisciplineRule()])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+    def test_standalone_suppression_skips_blank_lines(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            _lock = threading.Lock()
+            _table = {}  # guarded-by: _lock
+
+            def fast():
+                # vrpms-lint: disable=lock-discipline (snapshot read; bounded staleness)
+
+                return _table
+            """, [LockDisciplineRule()])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+    def test_nested_class_annotations_stay_scoped(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            class Outer:
+                class Inner:
+                    def __init__(self):
+                        self._ilock = threading.Lock()
+                        self._data = {}  # guarded-by: _ilock
+
+                    def bad_inner(self):
+                        return self._data
+
+                def touch(self):
+                    # Outer._data is unrelated to Inner's annotation
+                    return self._data
+            """, [LockDisciplineRule()])
+        # exactly ONE finding: Inner's own unlocked read — Outer.touch
+        # must not inherit Inner's guard
+        assert rules_of(report) == ["lock-discipline"]
+        assert report.findings[0].message.startswith("access to self._data")
+        assert report.findings[0].line == 11  # Inner.bad_inner's return
+
+    def test_suppression_without_reason_is_a_finding(self, tmp_path):
+        report = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: _lock
+
+                def fast(self):
+                    return self._items  # vrpms-lint: disable=lock-discipline
+            """, [LockDisciplineRule()])
+        assert sorted(rules_of(report)) == [
+            "lock-discipline", "suppression-no-reason",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# JAX tracing hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestTracingHygiene:
+    def test_host_coercion_in_jitted_function(self, tmp_path):
+        report = lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def kernel(x):
+                y = float(x)
+                z = np.asarray(x)
+                return x.sum().item()
+            """, [TraceHygieneRule()])
+        assert rules_of(report).count("trace-host-coercion") == 3
+
+    def test_clean_jitted_function(self, tmp_path):
+        report = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def kernel(x):
+                n = int(x.shape[0])
+                return jnp.sum(x) / n
+            """, [TraceHygieneRule()])
+        assert report.findings == []
+
+    def test_python_random_in_scan_body(self, tmp_path):
+        report = lint(tmp_path, """
+            import random
+            from jax import lax
+
+            def body(carry, x):
+                r = random.random()
+                return carry + r, x
+
+            def driver(xs):
+                return lax.scan(body, 0.0, xs)
+            """, [TraceHygieneRule()])
+        assert "trace-python-random" in rules_of(report)
+
+    def test_branch_on_scan_body_param(self, tmp_path):
+        report = lint(tmp_path, """
+            from jax import lax
+
+            def body(carry, x):
+                if x > 0:
+                    carry = carry + x
+                return carry, x
+
+            def driver(xs):
+                return lax.scan(body, 0.0, xs)
+            """, [TraceHygieneRule()])
+        assert "trace-traced-branch" in rules_of(report)
+
+    def test_transitive_callee_is_traced(self, tmp_path):
+        report = lint(tmp_path, """
+            import jax
+
+            def helper(v):
+                return v.item()
+
+            @jax.jit
+            def kernel(x):
+                return helper(x)
+            """, [TraceHygieneRule()])
+        assert "trace-host-coercion" in rules_of(report)
+
+    def test_jit_in_loop(self, tmp_path):
+        report = lint(tmp_path, """
+            import jax
+
+            def f(x):
+                return x
+
+            def run(xs):
+                out = []
+                for x in xs:
+                    out.append(jax.jit(f)(x))
+                return out
+            """, [TraceHygieneRule()])
+        assert "trace-jit-in-loop" in rules_of(report)
+
+    def test_lru_cached_factory_may_jit_in_loop(self, tmp_path):
+        report = lint(tmp_path, """
+            import functools
+            import jax
+
+            def f(x):
+                return x
+
+            @functools.lru_cache
+            def factory(n):
+                for _ in range(n):
+                    g = jax.jit(f)
+                return g
+            """, [TraceHygieneRule()])
+        assert report.findings == []
+
+    def test_unhashable_static_arg(self, tmp_path):
+        report = lint(tmp_path, """
+            import jax
+
+            def f(x, opts):
+                return x
+
+            g = jax.jit(f, static_argnums=(1,))
+
+            def call(x):
+                return g(x, [1, 2, 3])
+            """, [TraceHygieneRule()])
+        assert "trace-unhashable-static" in rules_of(report)
+
+
+# ---------------------------------------------------------------------------
+# Service contracts
+# ---------------------------------------------------------------------------
+
+
+class TestServiceContracts:
+    def test_envelope_without_attach_ids(self, tmp_path):
+        report = lint(tmp_path, """
+            import json
+
+            def write_bad(handler):
+                handler.wfile.write(
+                    json.dumps({"success": False}).encode("utf-8")
+                )
+
+            def write_good(handler):
+                resp = attach_ids(handler, {"success": True})
+                handler.wfile.write(json.dumps(resp).encode("utf-8"))
+
+            def write_sse(handler):
+                handler.wfile.write(b": keep-alive\\n\\n")
+            """, [EnvelopeRule()], filename="service/handlers.py")
+        assert rules_of(report) == ["contract-envelope"]
+
+    def test_metric_registered_twice(self, tmp_path):
+        report = lint(tmp_path, """
+            A = REGISTRY.counter("vrpms_requests_total", "requests")
+            B = REGISTRY.counter("vrpms_requests_total", "requests again")
+            """, [MetricContractRule()])
+        assert "contract-metric-once" in rules_of(report)
+
+    def test_metric_label_mismatch(self, tmp_path):
+        report = lint(tmp_path, """
+            FAILS = REGISTRY.counter(
+                "vrpms_store_failures_total", "failures",
+                labels=("kind", "reason"),
+            )
+
+            def record():
+                FAILS.labels(kind="supabase").inc()
+            """, [MetricContractRule()])
+        assert "contract-metric-labels" in rules_of(report)
+
+    def test_metric_consistent_usage_clean(self, tmp_path):
+        report = lint(tmp_path, """
+            FAILS = REGISTRY.counter(
+                "vrpms_store_failures_total", "failures",
+                labels=("kind", "reason"),
+            )
+
+            def record():
+                FAILS.labels(kind="supabase", reason="timeout").inc()
+            """, [MetricContractRule()])
+        assert report.findings == []
+
+    def test_unregistered_span_name(self, tmp_path):
+        rule = SpanNameRule(registry=frozenset({"solve"}))
+        report = lint(tmp_path, """
+            from vrpms_tpu.obs import spans
+
+            def work():
+                with spans.span("solve"):
+                    pass
+                with spans.span("mystery.step"):
+                    pass
+            """, [rule])
+        assert rules_of(report) == ["contract-span-name"]
+
+    def test_real_span_registry_importable(self):
+        from vrpms_tpu.obs.spans import KNOWN_SPAN_NAMES
+
+        assert "solve" in KNOWN_SPAN_NAMES
+        assert "store.resilient" in KNOWN_SPAN_NAMES
+
+
+# ---------------------------------------------------------------------------
+# Config discipline
+# ---------------------------------------------------------------------------
+
+
+class TestConfigDiscipline:
+    def test_direct_env_read_flagged(self, tmp_path):
+        report = lint(tmp_path, """
+            import os
+
+            A = os.environ.get("VRPMS_TIERS")
+            B = os.getenv("VRPMS_TIERS")
+            C = os.environ["HOME"]
+            os.environ["VRPMS_STORE"] = "memory"  # writes stay legal
+            """, [EnvReadRule()])
+        assert rules_of(report) == ["config-env-read"] * 3
+
+    def test_config_module_itself_exempt(self, tmp_path):
+        report = lint(tmp_path, """
+            import os
+
+            def get(name):
+                return os.environ.get(name)
+            """, [EnvReadRule()], filename="vrpms_tpu/config.py")
+        assert report.findings == []
+
+    def test_unknown_var_literal(self, tmp_path):
+        rule = UnknownVarRule(registry=frozenset({"VRPMS_TIERS"}))
+        report = lint(tmp_path, """
+            GOOD = "VRPMS_TIERS"
+            TYPO = "VRPMS_TEIRS"
+            """, [rule])
+        assert rules_of(report) == ["config-unknown-var"]
+
+    def test_doc_sync_missing_var(self, tmp_path):
+        (tmp_path / "README.md").write_text("docs mention VRPMS_ALPHA only")
+        report = lint(tmp_path, """
+            REGISTRY = {"VRPMS_ALPHA": 1, "VRPMS_BETA": 2}
+            """, [DocSyncRule()], filename="vrpms_tpu/config.py")
+        assert rules_of(report) == ["config-doc-sync"]
+        assert "VRPMS_BETA" in report.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# Dead code
+# ---------------------------------------------------------------------------
+
+
+class TestDeadCode:
+    def test_unused_import(self, tmp_path):
+        report = lint(tmp_path, """
+            import json
+            import math
+
+            def area(r):
+                return math.pi * r * r
+            """, [DeadImportRule()])
+        assert rules_of(report) == ["dead-import"]
+        assert "json" in report.findings[0].message
+
+    def test_noqa_reexport_exempt(self, tmp_path):
+        report = lint(tmp_path, """
+            from math import pi  # noqa: F401 (re-exported)
+            """, [DeadImportRule()])
+        assert report.findings == []
+
+    def test_dead_private_symbol(self, tmp_path):
+        report = lint(tmp_path, """
+            def _used():
+                return 1
+
+            def _dead():
+                return 2
+
+            def entry():
+                return _used()
+            """, [DeadPrivateSymbolRule()])
+        assert rules_of(report) == ["dead-private-symbol"]
+        assert "_dead" in report.findings[0].message
+
+    def test_reference_tree_keeps_symbol_alive(self, tmp_path):
+        report = lint(tmp_path, """
+            def _poked_by_tests():
+                return 1
+            """, [DeadPrivateSymbolRule()], reference="""
+            import mod
+
+            def test_it():
+                assert mod._poked_by_tests() == 1
+            """)
+        assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# The repo itself
+# ---------------------------------------------------------------------------
+
+
+#: the reviewed suppression budget: every entry documents a deliberate
+#: exception (fast-path reads under double-checked locking). If you add
+#: a suppression, justify it in the review and bump this pin.
+EXPECTED_SUPPRESSIONS = 3
+
+
+class TestRepoClean:
+    @pytest.fixture(scope="class")
+    def repo_report(self):
+        return analysis.run()
+
+    def test_zero_unsuppressed_findings(self, repo_report):
+        assert repo_report.parse_errors == []
+        assert repo_report.findings == [], (
+            "vrpms-lint found violations:\n"
+            + "\n".join(f.render() for f in repo_report.findings)
+        )
+
+    def test_suppression_count_regression_guard(self, repo_report):
+        assert len(repo_report.suppressed) == EXPECTED_SUPPRESSIONS, (
+            f"suppression count changed "
+            f"({len(repo_report.suppressed)} != {EXPECTED_SUPPRESSIONS}); "
+            "suppressions are a reviewed budget — update "
+            "EXPECTED_SUPPRESSIONS with a justification"
+        )
+
+    def test_every_suppression_is_lock_fast_path(self, repo_report):
+        # today's budget is exactly the GIL-safe double-checked
+        # fast-path reads; anything else deserves its own review
+        assert all(
+            f.rule == "lock-discipline" for f in repo_report.suppressed
+        )
+
+    def test_rule_instances_are_reusable_across_runs(self, repo_report):
+        # project rules must reset collect() state per run: a reused
+        # rule list (the documented programmatic entry point) must not
+        # accumulate duplicate registrations into spurious findings
+        rules = analysis.default_rules()
+        first = analysis.run(rules=rules)
+        second = analysis.run(rules=rules)
+        assert first.findings == []
+        assert second.findings == []
+
+    def test_list_rules_names_match_finding_ids(self):
+        # every id a finding can carry (and a suppression must name)
+        # appears in --list-rules output — umbrella class names alone
+        # would make disables unguessable
+        import io
+        from contextlib import redirect_stdout
+
+        from vrpms_tpu.analysis.__main__ import main
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert main(["--list-rules"]) == 0
+        listed = buf.getvalue()
+        for rule_id in (
+            "lock-discipline", "trace-host-coercion", "trace-python-random",
+            "trace-traced-branch", "trace-jit-in-loop",
+            "trace-unhashable-static", "contract-envelope",
+            "contract-metric-once", "contract-metric-labels",
+            "contract-span-name", "config-env-read", "config-unknown-var",
+            "config-doc-sync", "dead-import", "dead-private-symbol",
+        ):
+            assert rule_id in listed, f"{rule_id} missing from --list-rules"
+
+    def test_cli_gate_fails_injected_violation(self, tmp_path):
+        import subprocess
+        import sys
+
+        bad = tmp_path / "injected.py"
+        bad.write_text('import os\nX = os.environ.get("VRPMS_TIERS")\n')
+        proc = subprocess.run(
+            [sys.executable, "-m", "vrpms_tpu.analysis", str(bad),
+             "--root", str(tmp_path)],
+            capture_output=True, text=True,
+            cwd=str(analysis.REPO_ROOT),
+        )
+        assert proc.returncode == 1
+        assert "config-env-read" in proc.stdout
+
+    def test_cli_clean_tree_exits_zero(self, tmp_path):
+        import subprocess
+        import sys
+
+        ok = tmp_path / "clean.py"
+        ok.write_text("VALUE = 1\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "vrpms_tpu.analysis", str(ok),
+             "--root", str(tmp_path)],
+            capture_output=True, text=True,
+            cwd=str(analysis.REPO_ROOT),
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Config registry runtime accessor
+# ---------------------------------------------------------------------------
+
+
+class TestConfigRegistry:
+    def test_typed_get_and_defaults(self, monkeypatch):
+        monkeypatch.delenv("VRPMS_SCHED_QUEUE", raising=False)
+        assert config.get("VRPMS_SCHED_QUEUE") == 64
+        monkeypatch.setenv("VRPMS_SCHED_QUEUE", "8")
+        assert config.get("VRPMS_SCHED_QUEUE") == 8
+        monkeypatch.setenv("VRPMS_SCHED_QUEUE", "junk")
+        assert config.get("VRPMS_SCHED_QUEUE") == 64  # forgiving parse
+
+    def test_switch_spellings(self, monkeypatch):
+        for off in ("off", "0", "FALSE", " no "):
+            monkeypatch.setenv("VRPMS_PROGRESS", off)
+            assert config.enabled("VRPMS_PROGRESS") is False
+        monkeypatch.setenv("VRPMS_PROGRESS", "on")
+        assert config.enabled("VRPMS_PROGRESS") is True
+        monkeypatch.delenv("VRPMS_PROGRESS", raising=False)
+        assert config.enabled("VRPMS_PROGRESS") is True  # default on
+
+    def test_unregistered_name_fails_loudly(self):
+        with pytest.raises(KeyError):
+            config.get("VRPMS_NOT_A_KNOB")
+        with pytest.raises(KeyError):
+            config.raw("VRPMS_NOT_A_KNOB")
+
+    def test_enabled_rejects_non_switch(self):
+        with pytest.raises(TypeError):
+            config.enabled("VRPMS_TIERS")
+
+    def test_markdown_table_covers_registry(self):
+        table = config.markdown_table()
+        for var in config.iter_vars():
+            assert f"`{var.name}`" in table
+
+    def test_raw_returns_uninterpreted(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        assert config.raw("VRPMS_STORE") == "faulty:down"
+        monkeypatch.delenv("VRPMS_STORE", raising=False)
+        assert config.raw("VRPMS_STORE") is None
+
+
+# ---------------------------------------------------------------------------
+# Concurrency regressions for the lock-discipline fixes
+# ---------------------------------------------------------------------------
+
+
+class TestLockFixConcurrency:
+    """Stress the paths the sweep locked: unguarded reads of the
+    memory-store tables and Scheduler's worker map were benign only by
+    CPython-GIL accident; these pin the now-locked behavior under real
+    thread interleaving."""
+
+    def test_memory_store_concurrent_read_write(self):
+        from store import memory
+
+        memory.reset()
+        db = memory.InMemoryDatabaseVRP(None)
+        errors: list = []
+        stop = threading.Event()
+
+        def writer(i):
+            n = 0
+            while not stop.is_set():
+                db.save_job(f"job-{i}-{n % 50}", {"status": "done", "n": n})
+                n += 1
+
+        def reader(i):
+            while not stop.is_set():
+                try:
+                    db._fetch_job(f"job-{i}-0")
+                    memory.saved_solutions()
+                except Exception as e:  # pragma: no cover - the regression
+                    errors.append(e)
+                    return
+
+        threads = [
+            *(threading.Thread(target=writer, args=(i,)) for i in range(3)),
+            *(threading.Thread(target=reader, args=(i,)) for i in range(3)),
+        ]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        memory.reset()
+        assert errors == []
+
+    def test_scheduler_depth_during_submits_and_restarts(self):
+        from vrpms_tpu.sched.queue import Job, QueueFull
+        from vrpms_tpu.sched.worker import Scheduler
+
+        def runner(jobs):
+            for job in jobs:
+                job.result = {"ok": True}
+
+        sched = Scheduler(runner, queue_limit=256, window_s=0.0,
+                          watchdog_s=0.0)
+        errors: list = []
+        stop = threading.Event()
+        backends = [f"b{i}" for i in range(4)]
+
+        def submitter(backend):
+            while not stop.is_set():
+                try:
+                    sched.submit(Job(payload={}), backend=backend)
+                except QueueFull:
+                    pass  # backpressure is expected under the hammer
+                except Exception as e:
+                    errors.append(e)
+                    return
+
+        def prober():
+            while not stop.is_set():
+                try:
+                    for b in backends:
+                        sched.depth(b)
+                    sched.queues()
+                    sched.worker_health()
+                except Exception as e:  # pragma: no cover - the regression
+                    errors.append(e)
+                    return
+
+        threads = [
+            *(threading.Thread(target=submitter, args=(b,))
+              for b in backends),
+            threading.Thread(target=prober),
+            threading.Thread(target=prober),
+        ]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        sched.shutdown()
+        assert errors == []

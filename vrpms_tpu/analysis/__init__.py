@@ -1,0 +1,87 @@
+"""vrpms-lint: project-native static analysis for vrpms-tpu.
+
+One AST pass per file, checkers as pluggable rules, findings as
+structured records, inline ``# vrpms-lint: disable=<rule> (<reason>)``
+suppressions. Run it as ``python -m vrpms_tpu.analysis`` (the tier-1 CI
+gate) or programmatically via :func:`run`.
+
+Rule families (see each module's docstring for the full contract):
+
+  * lock discipline  — ``# guarded-by:`` annotations (analysis.locks)
+  * tracing hygiene  — jit/scan-body purity hazards (analysis.tracing)
+  * service contracts — envelopes, metrics, spans (analysis.contracts)
+  * config discipline — env reads via vrpms_tpu.config
+    (analysis.config_rules)
+  * dead code — unused imports / private symbols (analysis.deadcode)
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from vrpms_tpu.analysis.base import (
+    Finding,
+    Report,
+    Rule,
+    run_rules,
+)
+from vrpms_tpu.analysis.config_rules import (
+    DocSyncRule,
+    EnvReadRule,
+    UnknownVarRule,
+)
+from vrpms_tpu.analysis.contracts import (
+    EnvelopeRule,
+    MetricContractRule,
+    SpanNameRule,
+)
+from vrpms_tpu.analysis.deadcode import DeadImportRule, DeadPrivateSymbolRule
+from vrpms_tpu.analysis.locks import LockDisciplineRule
+from vrpms_tpu.analysis.tracing import TraceHygieneRule
+
+#: repo root = the directory holding the vrpms_tpu package
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+#: what `python -m vrpms_tpu.analysis` scans by default. tests/ and
+#: benchmarks/ are in scope for dead-private-symbol aliveness (a test
+#: poking mod._helper keeps it alive) but rules that encode production
+#: contracts scope themselves (e.g. contract-envelope to service/).
+DEFAULT_PATHS = ("vrpms_tpu", "service", "store", "main.py")
+#: scanned for symbol references only (keeps dead-code honest) — not
+#: for production-contract rules
+REFERENCE_PATHS = ("tests", "benchmarks")
+
+
+def default_rules() -> list:
+    return [
+        LockDisciplineRule(),
+        TraceHygieneRule(),
+        EnvelopeRule(),
+        MetricContractRule(),
+        SpanNameRule(),
+        EnvReadRule(),
+        UnknownVarRule(),
+        DocSyncRule(),
+        DeadImportRule(),
+        DeadPrivateSymbolRule(),
+    ]
+
+
+def run(paths=None, root: Path | None = None, rules=None,
+        reference_paths=None) -> Report:
+    """Run the analyzer. `paths` defaults to the production tree;
+    tests/ and benchmarks/ are parsed as reference-only (they feed
+    symbol-aliveness to project rules but are not themselves checked)."""
+    root = Path(root) if root is not None else REPO_ROOT
+    if paths is None:
+        paths = [p for p in (root / d for d in DEFAULT_PATHS) if p.exists()]
+    else:
+        paths = [Path(p) for p in paths]
+    if reference_paths is None:
+        reference_paths = [
+            p for p in (root / d for d in REFERENCE_PATHS) if p.exists()
+        ]
+    else:
+        reference_paths = [Path(p) for p in reference_paths]
+    return run_rules(rules if rules is not None else default_rules(),
+                     paths, root, reference_paths=reference_paths)

@@ -1,0 +1,78 @@
+"""CLI: ``python -m vrpms_tpu.analysis [paths...]``.
+
+Exits 0 when the tree is clean, 1 on any unsuppressed finding (or a
+file that fails to parse) — the tier-1 CI gate contract. ``--json``
+emits the structured findings for tooling; ``--list-rules`` documents
+the rule catalogue.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from vrpms_tpu import analysis
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m vrpms_tpu.analysis",
+        description="vrpms-lint: project-native static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to scan (default: the production tree)",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root for relative paths + README lookup "
+        "(default: the checkout containing vrpms_tpu)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON records",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in analysis.default_rules():
+            doc = (sys.modules[type(rule).__module__].__doc__ or "")
+            first = doc.strip().splitlines()[0] if doc.strip() else ""
+            # list the CONCRETE finding ids — the names findings carry
+            # and a `# vrpms-lint: disable=<id>` must use
+            for name in rule.finding_names or (rule.name,):
+                print(f"{name:26s} {first}")
+        return 0
+
+    report = analysis.run(
+        paths=args.paths or None,
+        root=args.root,
+    )
+    if args.as_json:
+        print(json.dumps(
+            {
+                "findings": [
+                    dataclasses.asdict(f) for f in report.findings
+                ],
+                "suppressed": [
+                    dataclasses.asdict(f) for f in report.suppressed
+                ],
+                "parseErrors": [
+                    {"file": p, "error": e} for p, e in report.parse_errors
+                ],
+            },
+            indent=2,
+        ))
+    else:
+        print(report.render())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

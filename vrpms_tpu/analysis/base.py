@@ -1,0 +1,283 @@
+"""vrpms-lint core: file model, rule protocol, suppressions, runner.
+
+The analyzer is one AST + tokenize pass per file (a `FileContext`),
+with checkers as pluggable rules. Two rule shapes:
+
+  * **file rules** — `check_file(ctx) -> list[Finding]`, purely local;
+  * **project rules** — `collect(ctx)` per file, then
+    `finalize(project) -> list[Finding]` once every file has been seen
+    (cross-file contracts: metric registered exactly once, span names
+    in the registry, every registered config var documented).
+
+Findings are structured `{rule, file, line, message}` records. Inline
+suppressions:
+
+    some_code()  # vrpms-lint: disable=rule-name (why this is OK)
+
+apply to their own line or, as a standalone comment, to the next
+code line. A reason in parentheses is REQUIRED — a bare disable is
+itself reported (rule `suppression-no-reason`), so every exception in
+the tree documents why it exists. The runner counts suppressions and
+reports them next to the findings; tests/test_analysis.py pins the
+repo-wide count so new suppressions are a reviewed, deliberate act.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+
+#: directories never scanned (caches, VCS internals)
+SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*vrpms-lint:\s*disable=([a-z0-9_,-]+)\s*(?:\(([^)]*)\))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured analyzer finding."""
+
+    rule: str
+    file: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    rule: str
+    line: int
+    reason: str
+
+
+class FileContext:
+    """One parsed file: source, AST, per-line comments, suppressions."""
+
+    def __init__(self, path: Path, root: Path, reference_only: bool = False):
+        self.path = path
+        #: reference-only files (tests/benchmarks) contribute symbol
+        #: references to project rules but are not themselves checked
+        self.reference_only = reference_only
+        self.rel = str(path.relative_to(root)) if root in path.parents or \
+            path == root else str(path)
+        self.root = root
+        self.source = path.read_text(encoding="utf-8")
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.lines = self.source.splitlines()
+        #: {line -> comment text} (one comment token per line max)
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:  # pragma: no cover - parse caught it
+            pass
+        #: {line -> [Suppression]}: a suppression governs its own line;
+        #: a comment-only line also governs the next code line
+        self.suppressions: dict[int, list[Suppression]] = {}
+        self.bad_suppressions: list[Finding] = []
+        for line_no, comment in self.comments.items():
+            m = _SUPPRESS_RE.search(comment)
+            if not m:
+                continue
+            rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.bad_suppressions.append(Finding(
+                    rule="suppression-no-reason",
+                    file=self.rel,
+                    line=line_no,
+                    message=(
+                        "vrpms-lint suppression without a (reason); every "
+                        "disable must say why"
+                    ),
+                ))
+                continue
+            targets = [line_no]
+            stripped = self.lines[line_no - 1].strip()
+            if stripped.startswith("#"):
+                # a standalone suppression comment governs the NEXT code
+                # line — skipping blank and further comment lines, so a
+                # wrapped reason or spacing can't silently void it
+                for nxt in range(line_no + 1, len(self.lines) + 1):
+                    text = self.lines[nxt - 1].strip()
+                    if text and not text.startswith("#"):
+                        targets.append(nxt)
+                        break
+            for rule in rules:
+                for target in targets:
+                    self.suppressions.setdefault(target, []).append(
+                        Suppression(rule=rule, line=line_no, reason=reason)
+                    )
+
+    def comment_on(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return any(
+            s.rule in (rule, "all")
+            for s in self.suppressions.get(line, ())
+        )
+
+
+class Rule:
+    """Base rule: subclass and implement check_file and/or
+    collect+finalize. `name` is the id used in findings and
+    suppressions; rules that emit several finding kinds list every
+    concrete id in `finding_names` (what --list-rules shows and what a
+    suppression must name)."""
+
+    name = "rule"
+    #: every finding id this rule can emit (suppressions name these)
+    finding_names: tuple = ()
+    #: glob-ish path prefixes this rule applies to; empty = everywhere
+    scopes: tuple = ()
+
+    def reset(self) -> None:
+        """Drop per-run collect() state. Called by run_rules before the
+        first file, so a rule instance can be reused across runs."""
+
+    def applies(self, ctx: FileContext) -> bool:
+        if not self.scopes:
+            return True
+        return any(
+            ctx.rel == s or ctx.rel.startswith(s.rstrip("/") + "/")
+            for s in self.scopes
+        )
+
+    def check_file(self, ctx: FileContext):
+        return []
+
+    def collect(self, ctx: FileContext) -> None:
+        return None
+
+    def finalize(self, project: "Project"):
+        return []
+
+
+class Project:
+    """What project rules see at finalize time."""
+
+    def __init__(self, root: Path, contexts: list[FileContext]):
+        self.root = root
+        self.contexts = contexts
+
+
+@dataclasses.dataclass
+class Report:
+    """One analyzer run: kept findings, suppressed findings, errors."""
+
+    findings: list
+    suppressed: list  # (Finding, Suppression reason line)
+    parse_errors: list
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.parse_errors) else 0
+
+    def render(self) -> str:
+        out = []
+        for f in self.findings:
+            out.append(f.render())
+        for path, err in self.parse_errors:
+            out.append(f"{path}:0: [parse-error] {err}")
+        out.append(
+            f"vrpms-lint: {len(self.findings)} finding(s), "
+            f"{len(self.suppressed)} suppressed"
+        )
+        return "\n".join(out)
+
+
+def iter_python_files(paths: list[Path]):
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if not any(part in SKIP_DIRS for part in sub.parts):
+                    yield sub
+
+
+def run_rules(rules: list, paths: list[Path], root: Path,
+              reference_paths: list[Path] = ()) -> Report:
+    """Parse every file once, run every rule, partition findings by the
+    suppression table. Suppressions apply to file-rule AND project-rule
+    findings (matched by file+line). `reference_paths` are parsed and
+    fed to project rules that opt in (``collects_references = True``)
+    but produce no findings of their own."""
+    contexts: list[FileContext] = []
+    parse_errors: list = []
+    raw: list = []
+    for rule in rules:
+        rule.reset()
+    for path in iter_python_files(paths):
+        try:
+            ctx = FileContext(path, root)
+        except SyntaxError as e:
+            parse_errors.append((str(path), f"SyntaxError: {e.msg}"))
+            continue
+        contexts.append(ctx)
+        raw.extend(ctx.bad_suppressions)
+        for rule in rules:
+            if not rule.applies(ctx):
+                continue
+            raw.extend(rule.check_file(ctx))
+            rule.collect(ctx)
+    for path in iter_python_files(list(reference_paths)):
+        try:
+            ctx = FileContext(path, root, reference_only=True)
+        except SyntaxError as e:
+            parse_errors.append((str(path), f"SyntaxError: {e.msg}"))
+            continue
+        for rule in rules:
+            if getattr(rule, "collects_references", False):
+                rule.collect(ctx)
+    project = Project(root, contexts)
+    for rule in rules:
+        raw.extend(rule.finalize(project))
+    by_rel = {ctx.rel: ctx for ctx in contexts}
+    findings: list = []
+    suppressed: list = []
+    for f in sorted(raw, key=lambda f: (f.file, f.line, f.rule)):
+        ctx = by_rel.get(f.file)
+        if ctx is not None and ctx.suppressed(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    return Report(
+        findings=findings, suppressed=suppressed, parse_errors=parse_errors
+    )
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call target: `a.b.c(...)` -> "a.b.c"; "" when
+    the callee is not a plain name/attribute chain."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def first_str_arg(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None

@@ -1,0 +1,144 @@
+"""Config-discipline checkers: env reads, registry coverage, doc sync.
+
+Every knob goes through :mod:`vrpms_tpu.config` — the typed registry is
+the single parse-and-default point and the README table's source of
+truth. Three rules keep that closed:
+
+  * ``config-env-read`` — any direct environment READ
+    (``os.environ.get`` / ``os.getenv`` / ``os.environ[...]`` as a
+    value) outside ``vrpms_tpu/config.py``. Writes
+    (``os.environ[k] = v``, setdefault, membership tests) stay legal —
+    the CLI and tests stage env state; it's the scattered
+    parse-and-default reads that drift.
+  * ``config-unknown-var`` — a ``VRPMS_*`` string literal that is not a
+    registered variable name (typo'd knobs read as "unset" forever and
+    are unfindable at runtime).
+  * ``config-doc-sync`` — every registered variable appears in
+    README.md (project rule; anchored to the registry entry).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from vrpms_tpu.analysis.base import Finding, Rule, call_name
+
+_VRPMS_LITERAL = re.compile(r"^VRPMS_[A-Z0-9_]+$")
+
+
+def _registry_names() -> frozenset:
+    from vrpms_tpu import config
+
+    return frozenset(config.REGISTRY)
+
+
+class EnvReadRule(Rule):
+    name = "config-env-read"
+
+    def check_file(self, ctx):
+        if ctx.rel.endswith("vrpms_tpu/config.py") or \
+                ctx.rel == "vrpms_tpu/config.py":
+            return []
+        findings: list = []
+        for node in ast.walk(ctx.tree):
+            line = None
+            what = None
+            if isinstance(node, ast.Call):
+                callee = call_name(node.func)
+                if callee in ("os.environ.get", "environ.get", "os.getenv",
+                              "getenv"):
+                    line, what = node.lineno, callee
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    call_name(node.value) in ("os.environ", "environ"):
+                line, what = node.lineno, "os.environ[...]"
+            if line is not None:
+                findings.append(Finding(
+                    rule=self.name,
+                    file=ctx.rel,
+                    line=line,
+                    message=(
+                        f"direct env read {what} — go through "
+                        "vrpms_tpu.config (get/raw/enabled) so the knob "
+                        "is registered, typed, and documented"
+                    ),
+                ))
+        return findings
+
+
+class UnknownVarRule(Rule):
+    name = "config-unknown-var"
+
+    def __init__(self, registry=None):
+        self._registry = registry
+
+    @property
+    def registry(self):
+        if self._registry is None:
+            self._registry = _registry_names()
+        return self._registry
+
+    def check_file(self, ctx):
+        findings: list = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _VRPMS_LITERAL.match(node.value) and \
+                    node.value not in self.registry:
+                findings.append(Finding(
+                    rule=self.name,
+                    file=ctx.rel,
+                    line=node.lineno,
+                    message=(
+                        f"{node.value!r} is not in the "
+                        "vrpms_tpu.config registry — typo, or a new knob "
+                        "that needs registering (and documenting)"
+                    ),
+                ))
+        return findings
+
+
+class DocSyncRule(Rule):
+    """Every registered var documented in README.md (project rule)."""
+
+    name = "config-doc-sync"
+
+    def __init__(self, readme_name: str = "README.md"):
+        self.readme_name = readme_name
+
+    def finalize(self, project):
+        config_ctx = None
+        for ctx in project.contexts:
+            if ctx.rel.replace("\\", "/").endswith("vrpms_tpu/config.py"):
+                config_ctx = ctx
+                break
+        if config_ctx is None:
+            return []  # registry not in scope for this run
+        readme = project.root / self.readme_name
+        try:
+            text = readme.read_text(encoding="utf-8")
+        except OSError:
+            return [Finding(
+                rule=self.name,
+                file=config_ctx.rel,
+                line=1,
+                message=f"{self.readme_name} not found next to the "
+                "registry — the config table has nowhere to live",
+            )]
+        findings: list = []
+        for node in ast.walk(config_ctx.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _VRPMS_LITERAL.match(node.value) and \
+                    node.value not in text:
+                findings.append(Finding(
+                    rule=self.name,
+                    file=config_ctx.rel,
+                    line=node.lineno,
+                    message=(
+                        f"registered variable {node.value!r} is not "
+                        f"documented in {self.readme_name}"
+                    ),
+                ))
+        return findings

@@ -1,0 +1,262 @@
+"""Service-contract checkers: envelopes, metric names, span names.
+
+Three contracts every surface must honor, today enforced only by
+review:
+
+  * ``contract-envelope`` — every JSON envelope a handler writes goes
+    through :func:`service.helpers.attach_ids` (directly or via the
+    responder helpers), so `requestId` + `traceId` ride EVERY response,
+    429s and 503s included. The rule flags any
+    ``wfile.write(json.dumps(X))`` in ``service/`` where X is neither
+    an ``attach_ids(...)`` call nor a name assigned from one in the
+    same function.
+  * ``contract-metric-once`` / ``contract-metric-labels`` — every
+    ``vrpms_*`` metric name is registered exactly once project-wide,
+    and every ``.labels(...)`` call site uses exactly the label set the
+    registration declared (a mismatched call raises at runtime — on
+    whatever rare path reaches it; this finds it before a request
+    does).
+  * ``contract-span-name`` — every literal span name appears in
+    ``vrpms_tpu.obs.spans.KNOWN_SPAN_NAMES``, the span registry the
+    dashboards and tests key on. Dynamic names (the HTTP root span) are
+    out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from vrpms_tpu.analysis.base import Finding, Rule, call_name, first_str_arg
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_RESPONDERS = {"attach_ids"}
+
+
+def _span_registry() -> frozenset:
+    from vrpms_tpu.obs.spans import KNOWN_SPAN_NAMES
+
+    return KNOWN_SPAN_NAMES
+
+
+class EnvelopeRule(Rule):
+    name = "contract-envelope"
+    scopes = ("service/",)
+
+    def check_file(self, ctx):
+        findings: list = []
+        for fn in [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            attached: set = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        call_name(node.value.func).split(".")[-1] in \
+                        _RESPONDERS:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            attached.add(tgt.id)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = call_name(node.func)
+                if not callee.endswith("wfile.write") or not node.args:
+                    continue
+                payload = self._json_dumps_arg(node.args[0])
+                if payload is None:
+                    continue  # not a JSON envelope write (SSE, bytes)
+                if isinstance(payload, ast.Call) and \
+                        call_name(payload.func).split(".")[-1] in \
+                        _RESPONDERS:
+                    continue
+                if isinstance(payload, ast.Name) and payload.id in attached:
+                    continue
+                findings.append(Finding(
+                    rule=self.name,
+                    file=ctx.rel,
+                    line=node.lineno,
+                    message=(
+                        "JSON envelope written without attach_ids(): the "
+                        "response will miss requestId/traceId correlation"
+                    ),
+                ))
+        return findings
+
+    @staticmethod
+    def _json_dumps_arg(node):
+        """X from `json.dumps(X)[.encode(...)]`, else None."""
+        cur = node
+        if isinstance(cur, ast.Call) and \
+                isinstance(cur.func, ast.Attribute) and \
+                cur.func.attr == "encode":
+            cur = cur.func.value
+        if isinstance(cur, ast.Call) and \
+                call_name(cur.func) in ("json.dumps", "dumps") and cur.args:
+            return cur.args[0]
+        return None
+
+
+class MetricContractRule(Rule):
+    """Project rule: registrations + label-call sites, checked at
+    finalize."""
+
+    name = "contract-metric"
+    finding_names = ("contract-metric-once", "contract-metric-labels")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        #: metric name -> [(file, line, labels tuple)]
+        self.registrations: dict = {}
+        #: instrument var name -> (metric name, labels, file, line)
+        self.instruments: dict = {}
+        #: [(var name, kwargs frozenset, file, line)]
+        self.label_calls: list = []
+
+    def collect(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node.func)
+            leaf = callee.split(".")[-1]
+            if leaf in _REG_METHODS and callee.split(".")[0] in (
+                "REGISTRY", "registry",
+            ):
+                name = first_str_arg(node)
+                if name is None or not name.startswith("vrpms_"):
+                    continue
+                labels: tuple = ()
+                for kw in node.keywords:
+                    if kw.arg == "labels" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)
+                    ):
+                        labels = tuple(
+                            el.value for el in kw.value.elts
+                            if isinstance(el, ast.Constant)
+                        )
+                if len(node.args) > 2 and isinstance(
+                    node.args[2], (ast.Tuple, ast.List)
+                ):
+                    labels = tuple(
+                        el.value for el in node.args[2].elts
+                        if isinstance(el, ast.Constant)
+                    )
+                self.registrations.setdefault(name, []).append(
+                    (ctx.rel, node.lineno, labels)
+                )
+            elif leaf == "labels":
+                base = callee.rsplit(".", 1)[0]
+                var = base.split(".")[-1]
+                if not var.isupper():
+                    continue
+                kwargs = frozenset(
+                    kw.arg for kw in node.keywords if kw.arg is not None
+                )
+                self.label_calls.append(
+                    (var, kwargs, ctx.rel, node.lineno)
+                )
+        # map instrument variable names to registrations
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                callee = call_name(node.value.func)
+                if callee.split(".")[-1] in _REG_METHODS and \
+                        callee.split(".")[0] in ("REGISTRY", "registry"):
+                    name = first_str_arg(node.value)
+                    if name is None:
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            regs = self.registrations.get(name, ())
+                            labels = regs[-1][2] if regs else ()
+                            self.instruments[tgt.id] = (
+                                name, labels, ctx.rel, node.lineno
+                            )
+
+    def finalize(self, project):
+        findings: list = []
+        for name, regs in sorted(self.registrations.items()):
+            if len(regs) > 1:
+                first = regs[0]
+                for rel, line, _labels in regs[1:]:
+                    findings.append(Finding(
+                        rule="contract-metric-once",
+                        file=rel,
+                        line=line,
+                        message=(
+                            f"metric {name!r} registered more than once "
+                            f"(first at {first[0]}:{first[1]}) — the "
+                            "registry raises on the second registration"
+                        ),
+                    ))
+            label_sets = {labels for _f, _l, labels in regs}
+            if len(label_sets) > 1:
+                rel, line, _labels = regs[-1]
+                findings.append(Finding(
+                    rule="contract-metric-labels",
+                    file=rel,
+                    line=line,
+                    message=(
+                        f"metric {name!r} registered with inconsistent "
+                        f"label sets {sorted(map(list, label_sets))}"
+                    ),
+                ))
+        for var, kwargs, rel, line in self.label_calls:
+            inst = self.instruments.get(var)
+            if inst is None:
+                continue  # not one of ours (or dynamically built)
+            name, labels, _f, _l = inst
+            if kwargs != frozenset(labels):
+                findings.append(Finding(
+                    rule="contract-metric-labels",
+                    file=rel,
+                    line=line,
+                    message=(
+                        f"{var}.labels({', '.join(sorted(kwargs))}) does "
+                        f"not match {name!r}'s declared labels "
+                        f"({', '.join(labels)}) — this raises at runtime"
+                    ),
+                ))
+        return findings
+
+
+class SpanNameRule(Rule):
+    name = "contract-span-name"
+
+    def __init__(self, registry=None):
+        self._registry = registry
+
+    @property
+    def registry(self):
+        if self._registry is None:
+            self._registry = _span_registry()
+        return self._registry
+
+    def check_file(self, ctx):
+        findings: list = []
+        if ctx.rel.endswith("obs/spans.py"):
+            return findings  # the registry + collector itself
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node.func)
+            leaf = callee.split(".")[-1]
+            if leaf not in ("span", "span_at"):
+                continue
+            name = first_str_arg(node)
+            if name is None:
+                continue  # dynamic span names are out of scope
+            if name not in self.registry:
+                findings.append(Finding(
+                    rule=self.name,
+                    file=ctx.rel,
+                    line=node.lineno,
+                    message=(
+                        f"span name {name!r} is not in "
+                        "obs.spans.KNOWN_SPAN_NAMES — register it so "
+                        "dashboards and waterfall tests see it"
+                    ),
+                ))
+        return findings

@@ -1,0 +1,193 @@
+"""Dead-code checkers: unused imports, unreferenced private symbols.
+
+The drift class ADVICE rounds keep finding by hand (the sa_delta_td
+unused-import round): imports that outlive a refactor and private
+module-level helpers nothing calls anymore.
+
+  * ``dead-import`` — a name imported but never referenced in its
+    module. ``__init__.py`` files are exempt (imports ARE their export
+    surface), as are ``__future__`` imports, underscore-renamed
+    imports (``import x as _x`` — an explicit "for side effects"
+    idiom), names in ``__all__``, and import lines carrying a ``noqa``
+    comment (the conventional deliberate-re-export marker — a consumer
+    may reach the name as an attribute from another module, which a
+    per-module pass cannot see).
+  * ``dead-private-symbol`` — a module-level ``_name`` function /
+    class / constant referenced nowhere in the ENTIRE scanned project
+    (including as an attribute, so ``mod._helper`` from a test keeps it
+    alive when tests are in scope). Project rule: collected per file,
+    decided once every file — including tests, which the CLI scans for
+    exactly this reason — has been seen.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from vrpms_tpu.analysis.base import Finding, Rule
+
+_EXEMPT_MODULES = {"__future__"}
+
+
+def _module_all(tree: ast.Module) -> set:
+    names: set = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__" and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    names.update(
+                        el.value for el in node.value.elts
+                        if isinstance(el, ast.Constant)
+                    )
+    return names
+
+
+def _used_names(tree: ast.Module) -> set:
+    """Every identifier referenced anywhere (names, attributes, and
+    bare strings — a name quoted in __all__ or a dispatch table counts
+    as a use)."""
+    used: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            used.add(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value.isidentifier():
+                used.add(node.value)
+    return used
+
+
+class DeadImportRule(Rule):
+    name = "dead-import"
+
+    def check_file(self, ctx):
+        if ctx.rel.endswith("__init__.py"):
+            return []
+        imported: list = []  # (bound name, line, shown as)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    shown = alias.name + (
+                        f" as {alias.asname}" if alias.asname else ""
+                    )
+                    imported.append((bound, node.lineno, shown))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module in _EXEMPT_MODULES:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    shown = alias.name + (
+                        f" as {alias.asname}" if alias.asname else ""
+                    )
+                    imported.append((bound, node.lineno, shown))
+        if not imported:
+            return []
+        used = _used_names(ctx.tree)
+        exported = _module_all(ctx.tree)
+        findings: list = []
+        seen_lines: set = set()
+        for bound, line, shown in imported:
+            if bound.startswith("_"):
+                continue  # explicit side-effect / re-export idiom
+            if "noqa" in ctx.comment_on(line):
+                continue  # marked deliberate (re-export surface)
+            # a used import's own binding line also counts one Name use
+            # (the alias node isn't a Name) — so plain membership works
+            if bound in used or bound in exported:
+                continue
+            key = (line, bound)
+            if key in seen_lines:
+                continue
+            seen_lines.add(key)
+            findings.append(Finding(
+                rule=self.name,
+                file=ctx.rel,
+                line=line,
+                message=f"import {shown!r} is never used in this module",
+            ))
+        return findings
+
+
+class DeadPrivateSymbolRule(Rule):
+    name = "dead-private-symbol"
+    collects_references = True
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        #: symbol -> (file, line)
+        self.defined: dict = {}
+        #: every identifier referenced anywhere in the project,
+        #: excluding each symbol's own definition line
+        self.used: dict = {}
+
+    @staticmethod
+    def _definitions(tree: ast.Module):
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                yield node.name, node
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        yield tgt.id, node
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                yield node.target.id, node
+
+    def collect(self, ctx):
+        own_defs: dict = {}
+        if not ctx.reference_only:
+            for name, node in self._definitions(ctx.tree):
+                if not name.startswith("_") or name.startswith("__"):
+                    continue
+                own_defs[name] = node
+                self.defined[(ctx.rel, name)] = node.lineno
+        for node in ast.walk(ctx.tree):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    node.value.isidentifier():
+                name = node.value
+            if name is None:
+                continue
+            defn = own_defs.get(name)
+            if defn is not None and self._is_definition_ref(node, defn):
+                continue
+            self.used[name] = self.used.get(name, 0) + 1
+
+    @staticmethod
+    def _is_definition_ref(node, defn) -> bool:
+        """The definition's own binding occurrence (def/class name isn't
+        an ast.Name; assignment targets are — skip Store-context names
+        on the definition node's line)."""
+        return (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, (ast.Store, ast.Del))
+            and node.lineno == defn.lineno
+        )
+
+    def finalize(self, project):
+        findings: list = []
+        for (rel, name), line in sorted(self.defined.items()):
+            if self.used.get(name, 0) == 0:
+                findings.append(Finding(
+                    rule=self.name,
+                    file=rel,
+                    line=line,
+                    message=(
+                        f"private module-level symbol {name!r} is "
+                        "referenced nowhere in the scanned tree"
+                    ),
+                ))
+        return findings

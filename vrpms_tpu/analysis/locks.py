@@ -1,0 +1,280 @@
+"""Lock-discipline checker: `# guarded-by: <lock>` annotations.
+
+The concurrency conventions of the scheduler/obs/store layers are
+invisible to the runtime — a read of `self._items` outside
+`with self._lock` works fine until the one interleaving where it
+doesn't. This rule makes the convention machine-checked:
+
+  * annotate the attribute's initialisation line (in ``__init__`` or at
+    module scope) with ``# guarded-by: _lock`` — the named lock is
+    ``self._lock`` for instance attributes, a module global for
+    module-level state;
+  * every later read/write of that attribute inside a method/function
+    must happen lexically inside ``with self._lock:`` (or ``with
+    _lock:`` for globals), else it is a finding.
+
+What counts as holding the lock:
+
+  * a ``with`` statement on the guarding lock (any position in a
+    multi-item ``with``);
+  * a ``with`` on a ``threading.Condition`` constructed FROM the
+    guarding lock (``self._new = threading.Condition(self._lock)`` —
+    entering the condition acquires the lock);
+  * the body of ``__init__``/``__new__`` (construction happens before
+    the object is shared) and module top-level code (import is
+    effectively single-threaded);
+  * methods whose name ends in ``_locked`` or whose ``def`` line
+    carries ``# holds-lock: <lock>`` — the documented "caller holds
+    the lock" convention (the checker trusts the suffix; the call
+    sites of such helpers are themselves checked).
+
+A function DEFINED inside a locked region does not inherit the lock —
+closures outlive the ``with`` block that created them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from vrpms_tpu.analysis.base import Finding, Rule, call_name
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _guard_annotation(ctx, line: int) -> str | None:
+    m = _GUARD_RE.search(ctx.comment_on(line))
+    return m.group(1) if m else None
+
+
+class _Scope:
+    """One class (or the module itself): guarded names + lock aliases."""
+
+    def __init__(self):
+        self.guards: dict[str, tuple[str, int]] = {}  # attr -> (lock, line)
+        self.aliases: dict[str, str] = {}  # condition name -> lock name
+
+
+def _lock_exprs_held(items, is_self: bool, scope: _Scope) -> set:
+    """Lock names a `with` statement's items acquire for this scope."""
+    held = set()
+    for item in items:
+        expr = item.context_expr
+        name = None
+        if is_self:
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+            ):
+                name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        if name is not None:
+            held.add(name)
+            alias = scope.aliases.get(name)
+            if alias is not None:
+                held.add(alias)
+    return held
+
+
+class _BodyChecker(ast.NodeVisitor):
+    """Walk one function body tracking which locks are lexically held."""
+
+    def __init__(self, rule, ctx, scope: _Scope, is_self: bool,
+                 held: set, findings: list):
+        self.rule = rule
+        self.ctx = ctx
+        self.scope = scope
+        self.is_self = is_self
+        self.held = set(held)
+        self.findings = findings
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = _lock_exprs_held(node.items, self.is_self, self.scope)
+        for item in node.items:
+            self.visit(item.context_expr)
+        before = set(self.held)
+        self.held |= acquired
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = before
+
+    # a nested def/lambda runs later: it does NOT inherit held locks,
+    # and its body is checked in its own pass by the rule driver
+    def visit_FunctionDef(self, node) -> None:
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node) -> None:
+        return
+
+    def _check(self, attr: str, line: int) -> None:
+        guard = self.scope.guards.get(attr)
+        if guard is None:
+            return
+        lock, _decl_line = guard
+        if lock in self.held:
+            return
+        owner = "self." if self.is_self else ""
+        self.findings.append(Finding(
+            rule=self.rule.name,
+            file=self.ctx.rel,
+            line=line,
+            message=(
+                f"access to {owner}{attr} (guarded-by {owner}{lock}) "
+                f"outside `with {owner}{lock}`"
+            ),
+        ))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            self.is_self
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            self._check(node.attr, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if not self.is_self:
+            self._check(node.id, node.lineno)
+        self.generic_visit(node)
+
+
+def _class_own_nodes(cls: ast.ClassDef) -> list:
+    """Every node of `cls` excluding nested class subtrees (a nested
+    class's annotations belong to ITS scope, checked in its own pass)."""
+    nodes: list = []
+
+    def gather(node) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            nodes.append(child)
+            gather(child)
+
+    gather(cls)
+    return nodes
+
+
+def _collect_class_scope(ctx, cls: ast.ClassDef) -> _Scope:
+    scope = _Scope()
+    for node in _class_own_nodes(cls):
+        # self.<attr> = ...  # guarded-by: <lock>
+        if isinstance(node, ast.Assign):
+            guard = _guard_annotation(ctx, node.lineno)
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    if guard:
+                        scope.guards[tgt.attr] = (guard, node.lineno)
+                    _note_condition_alias(scope, tgt.attr, node.value)
+        elif isinstance(node, ast.AnnAssign):
+            guard = _guard_annotation(ctx, node.lineno)
+            tgt = node.target
+            if (
+                guard
+                and isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                scope.guards[tgt.attr] = (guard, node.lineno)
+    return scope
+
+
+def _note_condition_alias(scope: _Scope, attr: str, value) -> None:
+    """`self._new = threading.Condition(self._lock)` -> _new aliases
+    _lock (same for module-level conditions over module locks)."""
+    if not isinstance(value, ast.Call):
+        return
+    callee = call_name(value.func)
+    if callee.split(".")[-1] != "Condition" or not value.args:
+        return
+    arg = value.args[0]
+    if isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name) \
+            and arg.value.id == "self":
+        scope.aliases[attr] = arg.attr
+    elif isinstance(arg, ast.Name):
+        scope.aliases[attr] = arg.id
+
+
+def _collect_module_scope(ctx, module: ast.Module) -> _Scope:
+    scope = _Scope()
+    for node in module.body:
+        if isinstance(node, ast.Assign):
+            guard = _guard_annotation(ctx, node.lineno)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if guard:
+                        scope.guards[tgt.id] = (guard, node.lineno)
+                    _note_condition_alias(scope, tgt.id, node.value)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            guard = _guard_annotation(ctx, node.lineno)
+            if guard:
+                scope.guards[node.target.id] = (guard, node.lineno)
+    return scope
+
+
+def _held_at_entry(ctx, fn, scope: _Scope) -> set | None:
+    """Locks a function may assume held, or None -> skip the body."""
+    if fn.name in ("__init__", "__new__"):
+        return None
+    held = set()
+    if fn.name.endswith("_locked"):
+        held.update(lock for lock, _ in scope.guards.values())
+        held.update(scope.aliases.values())
+    for line in range(fn.lineno, fn.body[0].lineno):
+        m = _HOLDS_RE.search(ctx.comment_on(line))
+        if m:
+            held.add(m.group(1))
+    return held
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+
+    def check_file(self, ctx):
+        findings: list = []
+        module_scope = _collect_module_scope(ctx, ctx.tree)
+        # module-level guarded globals: check every function in the file
+        if module_scope.guards:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    held = _held_at_entry(ctx, node, module_scope)
+                    if held is None:
+                        continue
+                    checker = _BodyChecker(
+                        self, ctx, module_scope, is_self=False,
+                        held=held, findings=findings,
+                    )
+                    for stmt in node.body:
+                        checker.visit(stmt)
+        # class-level guarded attributes
+        for cls in [
+            n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)
+        ]:
+            scope = _collect_class_scope(ctx, cls)
+            if not scope.guards:
+                continue
+            for node in cls.body:
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                held = _held_at_entry(ctx, node, scope)
+                if held is None:
+                    continue
+                checker = _BodyChecker(
+                    self, ctx, scope, is_self=True,
+                    held=held, findings=findings,
+                )
+                for stmt in node.body:
+                    checker.visit(stmt)
+        return findings

@@ -1,0 +1,348 @@
+"""JAX tracing-hygiene checkers.
+
+The tier cache and bit-identical-replay guarantees (PRs 4-7) hold only
+if traced code stays pure and shape-stable: a `float()` on a traced
+value concretizes (TracerError at best, silent recompile pinning at
+worst), Python `random` inside a trace freezes one sample into the
+compiled program, a `jax.jit` constructed per call throws away the
+compile cache the tiers exist to protect. These rules flag the hazard
+patterns statically.
+
+Traced-context discovery (per file, intentionally local — the kernels
+keep their helpers in-module):
+
+  * functions decorated `@jax.jit` / `@jit` / `@partial(jax.jit, ...)`;
+  * functions passed BY NAME to jit/vmap/pmap/grad/checkpoint or as
+    `lax.scan` / `while_loop` / `fori_loop` / `cond` / `switch` / `map`
+    bodies (their lambdas too);
+  * transitively, same-module functions CALLED from a traced body, and
+    functions defined inside one.
+
+Rules:
+
+  * ``trace-host-coercion`` — `.item()`, `np.asarray(...)` /
+    `np.array(...)`, and `float()/int()/bool()` applied directly to a
+    parameter of a traced function (shape reads like `x.shape[0]` and
+    `len(x)` are trace-time constants and stay legal);
+  * ``trace-python-random`` — `random.*` / `np.random.*` calls inside a
+    traced body (host RNG freezes into the trace; use `jax.random`);
+  * ``trace-traced-branch`` — `if`/`while` on a parameter of a
+    definitely-traced control-flow body (scan/while/fori/cond callees:
+    every parameter is a tracer, so the branch concretizes);
+  * ``trace-jit-in-loop`` — `jax.jit(...)` constructed inside a
+    `for`/`while` body (a fresh jit per iteration compiles every time)
+    unless the enclosing function is `lru_cache`d;
+  * ``trace-unhashable-static`` — calling an in-module jitted function
+    with a list/dict/set/lambda literal in a declared static position
+    (unhashable or fresh-per-call statics miss the compile cache on
+    every call).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from vrpms_tpu.analysis.base import Finding, Rule, call_name
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pmap", "pmap"}
+_WRAPPER_ARG0 = {
+    "jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.grad", "jax.value_and_grad", "jax.checkpoint", "jax.remat",
+    "jax.lax.map", "lax.map",
+}
+#: callee -> indices of function-valued args whose params are tracers
+_BODY_ARGS = {
+    "lax.scan": (0,), "jax.lax.scan": (0,),
+    "lax.while_loop": (0, 1), "jax.lax.while_loop": (0, 1),
+    "lax.fori_loop": (2,), "jax.lax.fori_loop": (2,),
+    "lax.cond": (1, 2), "jax.lax.cond": (1, 2),
+    "lax.switch": (1,), "jax.lax.switch": (1,),
+    "lax.map": (0,), "jax.lax.map": (0,),
+}
+_NP_MODULES = {"np", "numpy", "onp"}
+_CACHE_DECORATORS = {
+    "lru_cache", "functools.lru_cache", "cache", "functools.cache",
+}
+
+
+def _decorator_names(fn) -> list:
+    names = []
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            names.append(call_name(dec.func))
+            # @partial(jax.jit, ...) -> also record the wrapped callee
+            if call_name(dec.func).split(".")[-1] == "partial" and dec.args:
+                names.append(call_name(dec.args[0]))
+        else:
+            names.append(call_name(dec))
+    return names
+
+
+class _Module:
+    """Per-file function table + traced-set computation."""
+
+    def __init__(self, tree: ast.Module):
+        #: every (Async)FunctionDef/Lambda node -> enclosing function
+        self.parent: dict = {}
+        #: name -> [function nodes] (module + nested + methods, by name)
+        self.by_name: dict = {}
+        self.functions: list = []
+        self._index(tree, None)
+        self.traced: set = set()       # function nodes considered traced
+        self.body_traced: set = set()  # subset: control-flow bodies
+        self._discover()
+
+    def _index(self, node, enclosing) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                self.parent[child] = enclosing
+                self.functions.append(child)
+                if not isinstance(child, ast.Lambda):
+                    self.by_name.setdefault(child.name, []).append(child)
+                self._index(child, child)
+            else:
+                self._index(child, enclosing)
+
+    def _mark(self, fn, body: bool = False) -> None:
+        if fn in self.traced:
+            if body:
+                self.body_traced.add(fn)
+            return
+        self.traced.add(fn)
+        if body:
+            self.body_traced.add(fn)
+        # everything defined inside a traced function is traced too
+        for other, parent in self.parent.items():
+            if parent is fn:
+                self._mark(other)
+        # and every same-module function it calls by name
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node.func)
+                for callee in self.by_name.get(name, ()):
+                    self._mark(callee)
+
+    def _mark_arg(self, arg, body: bool = False) -> None:
+        if isinstance(arg, ast.Lambda):
+            self._mark(arg, body)
+        elif isinstance(arg, (ast.Name, ast.Attribute)):
+            name = call_name(arg)
+            for fn in self.by_name.get(name.split(".")[-1], ()):
+                self._mark(fn, body)
+
+    def _discover(self) -> None:
+        for fn in list(self.functions):
+            if isinstance(fn, ast.Lambda):
+                continue
+            decs = _decorator_names(fn)
+            if any(d in _JIT_NAMES for d in decs):
+                self._mark(fn)
+
+    def discover_calls(self, tree) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node.func)
+            if callee in _WRAPPER_ARG0 and node.args:
+                self._mark_arg(node.args[0])
+            indices = _BODY_ARGS.get(callee)
+            if indices:
+                for i in indices:
+                    if i < len(node.args):
+                        self._mark_arg(node.args[i], body=True)
+
+    def enclosing_traced(self, fn) -> bool:
+        return fn in self.traced
+
+
+def _param_names(fn) -> set:
+    args = fn.args
+    names = [a.arg for a in args.args + args.posonlyargs + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def _mentions_any(node, names: set) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in names for n in ast.walk(node)
+    )
+
+
+class TraceHygieneRule(Rule):
+    name = "trace-hygiene"  # umbrella; concrete findings carry sub-rules
+    finding_names = (
+        "trace-host-coercion", "trace-python-random",
+        "trace-traced-branch", "trace-jit-in-loop",
+        "trace-unhashable-static",
+    )
+
+    def check_file(self, ctx):
+        findings: list = []
+        mod = _Module(ctx.tree)
+        mod.discover_calls(ctx.tree)
+        for fn in mod.functions:
+            if fn in mod.traced:
+                findings.extend(self._check_traced(ctx, mod, fn))
+        findings.extend(self._check_jit_construction(ctx, mod))
+        findings.extend(self._check_static_args(ctx, mod))
+        return findings
+
+    def _find(self, ctx, rule, node, message) -> Finding:
+        return Finding(
+            rule=rule, file=ctx.rel, line=node.lineno, message=message
+        )
+
+    def _check_traced(self, ctx, mod, fn):
+        findings = []
+        params = _param_names(fn)
+        # nodes of fn's own body, excluding nested function bodies
+        # (those are traced functions in their own right when reachable)
+        own_nodes: list = []
+
+        def gather(node) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                own_nodes.append(child)
+                gather(child)
+
+        gather(fn)
+        for node in own_nodes:
+            if isinstance(node, ast.Call):
+                callee = call_name(node.func)
+                # .item() on anything inside a trace
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item" and not node.args:
+                    findings.append(self._find(
+                        ctx, "trace-host-coercion", node,
+                        ".item() inside a traced function forces a host "
+                        "sync / concretization",
+                    ))
+                # np.asarray / np.array on traced data
+                elif callee.split(".")[0] in _NP_MODULES and \
+                        callee.split(".")[-1] in ("asarray", "array"):
+                    findings.append(self._find(
+                        ctx, "trace-host-coercion", node,
+                        f"{callee}() inside a traced function pulls the "
+                        "value to host (use jnp)",
+                    ))
+                elif callee.split(".")[0] == "random" or \
+                        callee.startswith("np.random.") or \
+                        callee.startswith("numpy.random."):
+                    findings.append(self._find(
+                        ctx, "trace-python-random", node,
+                        f"host RNG {callee}() inside a traced function "
+                        "freezes one sample into the compiled program "
+                        "(use jax.random)",
+                    ))
+                elif callee in ("float", "int", "bool") and \
+                        len(node.args) == 1 and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in params:
+                    findings.append(self._find(
+                        ctx, "trace-host-coercion", node,
+                        f"{callee}() applied directly to traced parameter "
+                        f"{node.args[0].id!r} concretizes it",
+                    ))
+        if fn in mod.body_traced and params:
+            for node in own_nodes:
+                if isinstance(node, (ast.If, ast.While)) and \
+                        _mentions_any(node.test, params):
+                    findings.append(self._find(
+                        ctx, "trace-traced-branch", node,
+                        "Python branch on a traced control-flow-body "
+                        "parameter (use lax.cond/select)",
+                    ))
+        return findings
+
+    def _check_jit_construction(self, ctx, mod):
+        """jax.jit(...) built inside a for/while loop body."""
+        findings = []
+
+        def cached(fn) -> bool:
+            return not isinstance(fn, ast.Lambda) and any(
+                d in _CACHE_DECORATORS for d in _decorator_names(fn)
+            )
+
+        def walk(node, in_loop: bool, fn) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_fn = fn
+                child_loop = in_loop
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    child_fn = child
+                    child_loop = False
+                elif isinstance(child, (ast.For, ast.While)):
+                    child_loop = True
+                elif isinstance(child, ast.Call) and in_loop:
+                    if call_name(child.func) in _JIT_NAMES and \
+                            not (fn is not None and cached(fn)):
+                        findings.append(self._find(
+                            ctx, "trace-jit-in-loop", child,
+                            "jax.jit constructed inside a loop compiles "
+                            "fresh every iteration (hoist it or lru_cache "
+                            "the factory)",
+                        ))
+                walk(child, child_loop, child_fn)
+
+        walk(ctx.tree, False, None)
+        return findings
+
+    def _check_static_args(self, ctx, mod):
+        """g = jax.jit(f, static_argnums=(k,)); g(..., [unhashable] @ k)."""
+        findings = []
+        static_of: dict = {}  # jitted-name -> set of static positions
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if call_name(call.func) not in _JIT_NAMES:
+                continue
+            positions: set = set()
+            for kw in call.keywords:
+                if kw.arg == "static_argnums" and \
+                        isinstance(kw.value, (ast.Tuple, ast.List)):
+                    for el in kw.value.elts:
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, int):
+                            positions.add(el.value)
+                elif kw.arg == "static_argnums" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, int):
+                    positions.add(kw.value.value)
+            if not positions:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    static_of[tgt.id] = positions
+        if not static_of:
+            return findings
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Name):
+                continue
+            positions = static_of.get(node.func.id)
+            if not positions:
+                continue
+            for i, arg in enumerate(node.args):
+                if i in positions and isinstance(
+                    arg, (ast.List, ast.Dict, ast.Set, ast.Lambda)
+                ):
+                    findings.append(self._find(
+                        ctx, "trace-unhashable-static", arg,
+                        f"unhashable/fresh literal passed in static "
+                        f"position {i} of jitted {node.func.id!r} — every "
+                        "call misses the compile cache",
+                    ))
+        return findings

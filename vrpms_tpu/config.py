@@ -1,0 +1,258 @@
+"""Typed registry of every VRPMS_* environment variable.
+
+Nine PRs grew ~49 scattered ``os.environ.get`` sites, each re-deriving
+its own parse-and-default logic (three private ``_env_float`` copies,
+four spellings of the on/off switch). This module is the one place a
+knob is declared — name, type, default, doc — and the one place it is
+read. The static analyzer (vrpms_tpu.analysis, rule ``config-env-read``)
+flags any direct environ read outside this file, and rule
+``config-doc-sync`` checks every registered name is documented in
+README.md, so the registry, the code, and the docs cannot drift.
+
+Reads go through :func:`get` (typed), :func:`raw` (the uninterpreted
+string, for knobs with bespoke grammars like VRPMS_TIERS or
+VRPMS_STORE=faulty:<plan>), and :func:`enabled` (switches). All are
+read per call — tests and embedders toggle env vars at runtime and the
+service re-reads most knobs per request, so nothing is cached here.
+
+Parsing is forgiving by policy: a junk value for an int/float knob
+falls back to the declared default (the behavior the resilience layer's
+``_env_*`` helpers already had — a typo'd knob must degrade, not crash
+a request). Validation with real failure semantics (a malformed
+VRPMS_TIERS is a boot error) stays with the owning parser; those knobs
+are registered as kind="str" and parsed at the call site.
+
+Switches accept one spelling everywhere: any of ``off``, ``0``,
+``false``, ``no`` (case-insensitive, surrounding whitespace ignored)
+disables; anything else — including unset, for default-on switches —
+enables.
+
+Stdlib-only and import-light on purpose: everything (stores, solvers,
+the obs layer, the analyzer itself) imports this module, so it must
+never import jax, the service, or any sibling package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+_OFF_VALUES = ("off", "0", "false", "no")
+
+
+@dataclasses.dataclass(frozen=True)
+class Var:
+    """One registered environment variable."""
+
+    name: str
+    kind: str  # "str" | "int" | "float" | "switch"
+    default: object
+    doc: str
+
+
+def _v(name: str, kind: str, default, doc: str) -> Var:
+    return Var(name=name, kind=kind, default=default, doc=doc)
+
+
+#: Every environment variable the system reads, by name. Order is the
+#: order the README table documents them in.
+REGISTRY: dict[str, Var] = {
+    v.name: v
+    for v in (
+        # -- store selection + resilience ------------------------------
+        _v("VRPMS_STORE", "str", None,
+           "Backend: memory | supabase | faulty:<plan>. Unset: supabase "
+           "when SUPABASE_URL is set, else memory."),
+        _v("VRPMS_FIXTURES", "str", None,
+           "JSON fixture file seeding the memory store on first read."),
+        _v("VRPMS_RESILIENCE", "str", "auto",
+           "Wrap the store in the resilience layer: on | off | auto "
+           "(auto wraps supabase and faulty)."),
+        _v("VRPMS_STORE_DEADLINE_S", "float", 5.0,
+           "Per-store-call deadline in seconds (0 = unbounded)."),
+        _v("VRPMS_STORE_RETRIES", "int", 2,
+           "Read retries after the first attempt."),
+        _v("VRPMS_STORE_BACKOFF_S", "float", 0.05,
+           "Base of the jittered exponential retry backoff."),
+        _v("VRPMS_STORE_POOL", "int", 8,
+           "Shared store-call thread-pool size."),
+        _v("VRPMS_STORE_CACHE", "int", 256,
+           "Degraded-mode last-known-rows cache entry cap."),
+        _v("VRPMS_STORE_JOURNAL", "int", 512,
+           "Degraded-mode write-replay journal entry cap."),
+        _v("VRPMS_CB_FAILURES", "int", 5,
+           "Consecutive failures that open the store circuit breaker."),
+        _v("VRPMS_CB_RESET_S", "float", 30.0,
+           "Open-circuit seconds before one half-open probe is let in."),
+        _v("SUPABASE_URL", "str", "",
+           "Supabase project URL (also selects the supabase store when "
+           "VRPMS_STORE is unset)."),
+        _v("SUPABASE_KEY", "str", "",
+           "Supabase anon/service key for the hosted store."),
+        # -- solution cache --------------------------------------------
+        _v("VRPMS_CACHE", "str", "",
+           "Content-addressed solution cache: off disables, an integer "
+           "sets the in-memory LRU entry cap, unset/other = on with the "
+           "default cap (512)."),
+        _v("VRPMS_CACHE_NEAR", "int", 4,
+           "Max Hamming distance a near-hit warm seed may bridge "
+           "(0 disables near seeding)."),
+        # -- scheduler + async jobs ------------------------------------
+        _v("VRPMS_SCHED", "switch", True,
+           "Async solve scheduler (off = solve inline on the HTTP "
+           "thread)."),
+        _v("VRPMS_SCHED_QUEUE", "int", 64,
+           "Bounded admission queue depth per backend."),
+        _v("VRPMS_SCHED_WINDOW_MS", "float", 10.0,
+           "Micro-batch gather window in milliseconds."),
+        _v("VRPMS_SCHED_MAX_BATCH", "int", 16,
+           "Max same-bucket jobs merged into one batched launch."),
+        _v("VRPMS_SCHED_WATCHDOG_MS", "float", 500.0,
+           "Worker watchdog check interval (0 disables supervision)."),
+        _v("VRPMS_SCHED_WEDGE_GRACE_S", "float", 10.0,
+           "Grace past a batch's summed budget before a worker counts "
+           "as wedged; size above the slowest legitimate cold compile."),
+        _v("VRPMS_READY_RESTART_WINDOW_S", "float", 60.0,
+           "How long after a worker restart /api/ready stays degraded."),
+        _v("VRPMS_STREAM_TIMEOUT_S", "float", 600.0,
+           "Max lifetime of one GET /api/jobs/{id}/stream connection."),
+        _v("VRPMS_RESOLVE_WAIT_S", "float", 30.0,
+           "How long POST /api/jobs/{id}/resolve waits for the "
+           "predecessor's terminal record before answering 409."),
+        # -- distributed queue + replicas ------------------------------
+        _v("VRPMS_QUEUE", "str", "local",
+           "Job queue: local (in-process) or store|shared|dist (the "
+           "store-backed distributed queue)."),
+        _v("VRPMS_QUEUE_STEAL", "switch", True,
+           "Steal off-arc work when this replica's own arcs are empty."),
+        _v("VRPMS_QUEUE_POLL_MS", "float", 50.0,
+           "Replica claim-loop poll interval in milliseconds."),
+        _v("VRPMS_QUEUE_MAX_INFLIGHT", "int", 16,
+           "Max leases one replica holds at once."),
+        _v("VRPMS_REPLICA_ID", "str", None,
+           "Stable replica identity (set to the pod/host name so "
+           "restarts keep their ring arcs); unset generates one."),
+        _v("VRPMS_REPLICA_DRAIN_S", "float", 5.0,
+           "Graceful-stop window for in-flight leases at shutdown."),
+        _v("VRPMS_RING_VNODES", "int", 64,
+           "Virtual nodes per replica on the consistent-hash ring."),
+        _v("VRPMS_LEASE_S", "float", 15.0,
+           "Queue lease duration; renewed at half-life while solving."),
+        _v("VRPMS_HEARTBEAT_S", "float", 5.0,
+           "Replica membership heartbeat interval (TTL is 3 beats)."),
+        _v("VRPMS_RECLAIM_S", "float", 1.0,
+           "Expired-lease reclaim scan interval."),
+        # -- observability ---------------------------------------------
+        _v("VRPMS_LOG", "switch", True,
+           "Structured JSON event log (off silences it)."),
+        _v("VRPMS_TRACING", "switch", True,
+           "Dapper-style request tracing + traceparent propagation."),
+        _v("VRPMS_TRACE_RING", "int", 128,
+           "Completed-trace debug ring capacity (/api/debug/traces)."),
+        _v("VRPMS_TRACE_SLOW_MS", "float", 5000.0,
+           "Traces at least this slow auto-log their full waterfall."),
+        _v("VRPMS_PROGRESS", "switch", True,
+           "Live incumbent progress + cooperative cancellation."),
+        _v("VRPMS_ILS_TRACE", "str", None,
+           "Truthy: print ILS round-by-round trace lines to stderr."),
+        # -- solver + compile knobs ------------------------------------
+        _v("VRPMS_TIERS", "str", "",
+           "Shape-tier ladder spec (see core.tiers.parse_tiers; 'off' "
+           "disables padding; malformed values are a boot error)."),
+        _v("VRPMS_WARMUP", "str", "",
+           "Startup warmup: 'tiers'/'auto' warms the owned tier ladder "
+           "in the background, or explicit 'NxV[xT]' shapes."),
+        _v("VRPMS_COMPILE_CACHE", "str", None,
+           "Persistent XLA compile cache dir; off|0|none disables; "
+           "unset uses ~/.cache/vrpms_tpu/xla."),
+        _v("VRPMS_CERT_CACHE", "str", "",
+           "B&B certificate cache dir; 0 disables; unset uses "
+           "~/.cache/vrpms_tpu_certs."),
+        _v("VRPMS_RATE_CACHE", "str", None,
+           "Sweep-rate calibration cache file; unset uses "
+           "~/.cache/vrpms_tpu_sweep_rates.json."),
+        _v("VRPMS_DELTA_INTERPRET", "str", None,
+           "Truthy (any non-empty value): run Pallas delta kernels in "
+           "interpret mode (tests)."),
+    )
+}
+
+
+def _var(name: str) -> Var:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name!r} is not a registered environment variable; add it "
+            "to vrpms_tpu.config.REGISTRY (and README.md) first"
+        ) from None
+
+
+def raw(name: str) -> str | None:
+    """The uninterpreted environment value (None when unset), for knobs
+    whose grammar lives with their owning parser. The name must still
+    be registered — typos fail loudly."""
+    return os.environ.get(_var(name).name)
+
+
+def _as_switch(value: str | None, default) -> bool:
+    if value is None:
+        return bool(default)
+    return value.strip().lower() not in _OFF_VALUES
+
+
+def get(name: str):
+    """The typed value of `name`: str/int/float per the registry, bool
+    for switches. Junk int/float values fall back to the default."""
+    var = _var(name)
+    value = os.environ.get(var.name)
+    if var.kind == "switch":
+        return _as_switch(value, var.default)
+    if value is None:
+        return var.default
+    if var.kind == "int":
+        try:
+            return int(value)
+        except ValueError:
+            return var.default
+    if var.kind == "float":
+        try:
+            return float(value)
+        except ValueError:
+            return var.default
+    return value
+
+
+def enabled(name: str) -> bool:
+    """Switch read, asserting the registry agrees `name` IS a switch."""
+    var = _var(name)
+    if var.kind != "switch":
+        raise TypeError(f"{name} is kind={var.kind!r}, not a switch")
+    return _as_switch(os.environ.get(var.name), var.default)
+
+
+def iter_vars():
+    """Registered vars in documentation order (the README table)."""
+    return list(REGISTRY.values())
+
+
+def markdown_table() -> str:
+    """The generated README config table (kept in sync by the
+    ``config-doc-sync`` analyzer rule + tests/test_analysis.py)."""
+    lines = [
+        "| Variable | Type | Default | Description |",
+        "| --- | --- | --- | --- |",
+    ]
+    for var in iter_vars():
+        if var.kind == "switch":
+            default = "on" if var.default else "off"
+        elif var.default is None:
+            default = "(unset)"
+        elif var.default == "":
+            default = '""'
+        else:
+            default = f"`{var.default}`"
+        lines.append(
+            f"| `{var.name}` | {var.kind} | {default} | {var.doc} |"
+        )
+    return "\n".join(lines)

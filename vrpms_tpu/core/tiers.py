@@ -54,12 +54,12 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import os
 import threading
 
 import jax.numpy as jnp
 import numpy as np
 
+from vrpms_tpu import config
 from vrpms_tpu.core.instance import Instance
 
 DEFAULT_N_TIERS = (8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512, 768, 1024)
@@ -102,7 +102,7 @@ def parse_tiers(spec: str) -> TierLadder | None:
 def ladder() -> TierLadder | None:
     """The process ladder from $VRPMS_TIERS (read per call: tests and
     embedders toggle the env var; parsing a short string is free)."""
-    return parse_tiers(os.environ.get("VRPMS_TIERS", ""))
+    return parse_tiers(config.get("VRPMS_TIERS"))
 
 
 def tier_up(value: int, tiers: tuple) -> int:

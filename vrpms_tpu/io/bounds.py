@@ -440,7 +440,9 @@ def _lam_cache_path(inst: Instance):
     import hashlib
     import os
 
-    root = os.environ.get("VRPMS_CERT_CACHE", "")
+    from vrpms_tpu import config
+
+    root = config.get("VRPMS_CERT_CACHE")
     if root == "0":
         return None
     if not root:

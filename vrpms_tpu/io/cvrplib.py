@@ -14,7 +14,7 @@ import re
 
 import numpy as np
 
-from vrpms_tpu.core.instance import Instance, make_instance
+from vrpms_tpu.core.instance import make_instance
 
 
 def _euc2d(coords: np.ndarray, round_nint: bool) -> np.ndarray:

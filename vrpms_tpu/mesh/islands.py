@@ -39,7 +39,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 from vrpms_tpu.core.cost import (
     CostWeights,
     exact_cost,
-    objective_batch_mode,
     resolve_eval_mode,
 )
 from vrpms_tpu.core.instance import Instance

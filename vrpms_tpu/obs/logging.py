@@ -18,11 +18,12 @@ from __future__ import annotations
 
 import contextvars
 import json
-import os
 import sys
 import threading
 import time
 import uuid
+
+from vrpms_tpu import config
 
 _write_lock = threading.Lock()
 _stream = None  # None -> sys.stderr at call time (tests may rebind stderr)
@@ -63,7 +64,7 @@ def set_log_stream(stream):
 def log_event(event: str, **fields) -> None:
     """Emit one structured line. None-valued fields are dropped; the
     active request id is attached unless the caller passes its own."""
-    if os.environ.get("VRPMS_LOG") == "off":
+    if not config.enabled("VRPMS_LOG"):
         return
     record = {"ts": round(time.time(), 3), "event": event}
     rid = fields.pop("requestId", None) or _request_id.get()

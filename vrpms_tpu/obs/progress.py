@@ -38,10 +38,10 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-import os
 import threading
 import time
 
+from vrpms_tpu import config
 from vrpms_tpu.obs import spans
 
 #: published (improving) snapshots kept for the terminal convergence
@@ -52,9 +52,7 @@ MAX_PROFILE_SNAPSHOTS = 256
 def enabled() -> bool:
     """The VRPMS_PROGRESS master switch (default on). Read per call so
     tests and embedders can toggle at runtime."""
-    return os.environ.get("VRPMS_PROGRESS", "on").strip().lower() not in (
-        "off", "0", "false", "no",
-    )
+    return config.enabled("VRPMS_PROGRESS")
 
 
 # observer seam: service.obs wires the Prometheus instruments in;
@@ -90,13 +88,13 @@ class ProgressSink:
         self._lock = threading.Lock()
         self._new = threading.Condition(self._lock)
         self._t0 = time.perf_counter()
-        self._evals = 0.0
-        self._block = 0
-        self._latest: dict | None = None
-        self._profile: list[dict] = []
-        self._profile_truncated = False
-        self.seq = 0          # bumped per published snapshot + on close
-        self.closed = False
+        self._evals = 0.0  # guarded-by: _lock
+        self._block = 0  # guarded-by: _lock
+        self._latest: dict | None = None  # guarded-by: _lock
+        self._profile: list[dict] = []  # guarded-by: _lock
+        self._profile_truncated = False  # guarded-by: _lock
+        self.seq = 0  # guarded-by: _lock (bumped per published snapshot + close)
+        self.closed = False  # guarded-by: _lock
         self.status: str | None = None   # terminal: done|failed|...
         self._cancel = False
         self._ack = False  # a driver stopped FOR the cancel

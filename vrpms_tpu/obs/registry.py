@@ -76,7 +76,7 @@ class _Instrument:
         self.help = help
         self.label_names = tuple(labels)
         self._lock = threading.Lock()
-        self._children: dict = {}
+        self._children: dict = {}  # guarded-by: _lock
         if not self.label_names:
             # the unlabeled instrument IS its own single child
             self._children[()] = self._make_child()
@@ -88,14 +88,18 @@ class _Instrument:
                 f"{tuple(kv)}"
             )
         key = tuple(str(kv[n]) for n in self.label_names)
-        child = self._children.get(key)
+        # lock-free fast path: dict read is atomic under the GIL and a
+        # miss falls through to the locked setdefault
+        child = self._children.get(key)  # vrpms-lint: disable=lock-discipline (double-checked fast path; locked setdefault below arbitrates)
         if child is None:
             with self._lock:
                 child = self._children.setdefault(key, self._make_child())
         return child
 
     def _default_child(self):
-        return self._children[()]
+        # the () child is created in __init__ and never replaced, so the
+        # unlabeled hot path skips the lock entirely
+        return self._children[()]  # vrpms-lint: disable=lock-discipline (immutable after __init__; hot-path read)
 
     def _snapshot(self) -> list:
         with self._lock:
@@ -125,7 +129,7 @@ class _CounterChild:
 
     def __init__(self, enabled_ref):
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
         self._enabled = enabled_ref
 
     def inc(self, amount: float = 1.0):
@@ -165,7 +169,7 @@ class _GaugeChild:
 
     def __init__(self, enabled_ref):
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
         self._enabled = enabled_ref
 
     def set(self, value: float):
@@ -220,13 +224,13 @@ class _HistogramChild:
     def __init__(self, buckets: tuple, enabled_ref):
         self._lock = threading.Lock()
         self._buckets = buckets
-        self._counts = [0] * len(buckets)
-        self._sum = 0.0
-        self._count = 0
+        self._counts = [0] * len(buckets)  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
         self._enabled = enabled_ref
         # per-bucket (trace_id, value): the worst observation that
         # landed in the bucket since the last render (scrape) drained it
-        self._exemplars: dict = {}
+        self._exemplars: dict = {}  # guarded-by: _lock
 
     def observe(self, value: float, trace_id: str | None = None):
         if not self._enabled():
@@ -305,7 +309,7 @@ class Registry:
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._instruments: dict = {}
+        self._instruments: dict = {}  # guarded-by: _lock
 
     def _register(self, instrument):
         with self._lock:

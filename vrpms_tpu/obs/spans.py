@@ -37,11 +37,11 @@ from __future__ import annotations
 import collections
 import contextlib
 import contextvars
-import os
 import threading
 import time
 import uuid
 
+from vrpms_tpu import config
 from vrpms_tpu.obs.logging import log_event
 
 #: hard caps so a runaway request can never grow an unbounded trace
@@ -51,21 +51,37 @@ MAX_EVENTS_PER_SPAN = 64
 #: rejected outright — never parsed, never echoed
 MAX_TRACEPARENT_LEN = 128
 
-_DEF_RING = 128
-_DEF_SLOW_MS = 5000.0
+#: The span-name registry: every LITERAL span name the codebase starts.
+#: Dashboards, the waterfall tests, and trace tooling key on these; the
+#: static analyzer (rule `contract-span-name`) flags any spans.span()/
+#: trace.span()/span_at() literal that is missing here, so a new span
+#: is a deliberate, greppable addition instead of silent cardinality.
+#: (Dynamic names — the per-route HTTP root span — are out of scope.)
+KNOWN_SPAN_NAMES = frozenset({
+    "parse",            # request-body parse + validation
+    "prepare",          # instance build / tier pad / cache lookup
+    "resolve",          # warm-start seed resolution (service.cache)
+    "resolve.delta",    # request-delta application (core.delta)
+    "queue.wait",       # retroactive admission-queue wait
+    "solve",            # one job's solver run (worker side)
+    "solver.solve",     # the device solve inside a request
+    "solver.polish",    # post-solve local-search polish
+    "finish",           # decode + response assembly
+    "dist.execute",     # distributed-queue claim-side execution
+    "store.read",       # table reads on the request path
+    "store.persist",    # solution/warm-start persistence
+    "store.persist_job",  # terminal job-record persistence
+    "store.cache",      # solution-cache lookup/store
+    "store.resilient",  # one guarded (retry/breaker) store call
+})
 
 
 def tracing_enabled() -> bool:
-    return os.environ.get("VRPMS_TRACING", "on").lower() not in (
-        "off", "0", "false", "no",
-    )
+    return config.enabled("VRPMS_TRACING")
 
 
 def slow_threshold_ms() -> float:
-    try:
-        return float(os.environ.get("VRPMS_TRACE_SLOW_MS", _DEF_SLOW_MS))
-    except (TypeError, ValueError):
-        return _DEF_SLOW_MS
+    return config.get("VRPMS_TRACE_SLOW_MS")
 
 
 def new_trace_id() -> str:
@@ -217,11 +233,11 @@ class Trace:
         self.remote_parent_id = remote_parent_id
         self.start_mono = time.monotonic()
         self.start_ts = time.time()
-        self.spans: list[Span] = []
+        self.spans: list[Span] = []  # guarded-by: _lock
         self.truncated = False
         self.status = "ok"
         self.deferred = False
-        self._finished = False
+        self._finished = False  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- span creation ------------------------------------------------------
@@ -318,13 +334,15 @@ class Trace:
 
     def summary(self) -> dict:
         root = self.root()
+        with self._lock:
+            n_spans = len(self.spans)
         return {
             "traceId": self.trace_id,
             "startedAt": self.start_ts,
             "durationMs": self.duration_ms(),
             "status": self.status,
             "root": root.name if root is not None else None,
-            "spans": len(self.spans),
+            "spans": n_spans,
         }
 
 
@@ -422,14 +440,13 @@ def add_event(name: str, **attrs) -> None:
 def _ring_capacity_env() -> int:
     """VRPMS_TRACE_RING, defaulting (not crashing) on junk — a typo'd
     knob must degrade to the default, same as slow_threshold_ms."""
-    try:
-        return max(1, int(os.environ.get("VRPMS_TRACE_RING", _DEF_RING)))
-    except (TypeError, ValueError):
-        return _DEF_RING
+    return max(1, config.get("VRPMS_TRACE_RING"))
 
 
 _ring_lock = threading.Lock()
-_ring: collections.deque = collections.deque(maxlen=_ring_capacity_env())
+_ring: collections.deque = collections.deque(  # guarded-by: _ring_lock
+    maxlen=_ring_capacity_env()
+)
 
 
 def _ring_push(trace: Trace) -> None:

@@ -156,14 +156,14 @@ class JobQueue:
         if limit < 1:
             raise ValueError(f"queue limit must be >= 1, got {limit}")
         self.limit = limit
-        self._items: list[Job] = []
+        self._items: list[Job] = []  # guarded-by: _lock
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
-        self._closed = False
-        self._pushes = 0  # wait_for_more watches this, not emptiness
+        self._closed = False  # guarded-by: _lock
+        self._pushes = 0  # guarded-by: _lock (wait_for_more watches this)
         # EWMA of per-job service seconds, maintained by the worker via
         # note_job_seconds — the Retry-After estimate's rate term.
-        self._job_seconds = 1.0
+        self._job_seconds = 1.0  # guarded-by: _lock
 
     def __len__(self) -> int:
         with self._lock:

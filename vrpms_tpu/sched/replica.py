@@ -87,12 +87,12 @@ class Replica:
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
         # {job_id: (job, entry, lost)} — claimed, not yet acked
-        self._inflight: dict[str, tuple[Job, dict, bool]] = {}
+        self._inflight: dict[str, tuple[Job, dict, bool]] = {}  # guarded-by: _lock
         self._next_heartbeat = 0.0
         self._next_reclaim = 0.0
-        self._ring: HashRing | None = None
+        self._ring: HashRing | None = None  # guarded-by: _lock
         # EWMA of per-job service seconds (shared-depth Retry-After)
-        self._job_seconds = 1.0
+        self._job_seconds = 1.0  # guarded-by: _lock
         self._backoff_until = 0.0
 
     # -- lifecycle ----------------------------------------------------------

@@ -98,9 +98,9 @@ class Worker(threading.Thread):
         # supervision surface: what is in flight and for how long it
         # may legitimately run (None budget = unbounded, never wedged)
         self._inflight_lock = threading.Lock()
-        self._inflight: list[Job] = []
-        self._inflight_since: float | None = None
-        self._inflight_budget: float | None = None
+        self._inflight: list[Job] = []  # guarded-by: _inflight_lock
+        self._inflight_since: float | None = None  # guarded-by: _inflight_lock
+        self._inflight_budget: float | None = None  # guarded-by: _inflight_lock
 
     def stop(self) -> None:
         self._halt.set()
@@ -264,12 +264,12 @@ class Scheduler:
         self._watchdog_s = watchdog_s
         self._wedge_grace_s = wedge_grace_s
         self._on_worker_event = on_worker_event
-        self._workers: dict[str, Worker] = {}
+        self._workers: dict[str, Worker] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._shutdown = False
-        self._watchdog: threading.Thread | None = None
-        self.restarts: dict[str, int] = {}
-        self.last_restart_mono: float | None = None
+        self._shutdown = False  # guarded-by: _lock
+        self._watchdog: threading.Thread | None = None  # guarded-by: _lock
+        self.restarts: dict[str, int] = {}  # guarded-by: _lock
+        self.last_restart_mono: float | None = None  # guarded-by: _lock
 
     @property
     def is_shutdown(self) -> bool:
@@ -315,7 +315,8 @@ class Scheduler:
         return job
 
     def depth(self, backend: str = "default") -> int:
-        w = self._workers.get(backend or "default")
+        with self._lock:
+            w = self._workers.get(backend or "default")
         return 0 if w is None else len(w.queue)
 
     def queues(self) -> dict[str, int]:

@@ -42,12 +42,10 @@ import jax.numpy as jnp
 
 from vrpms_tpu.core.cost import (
     CostWeights,
-    evaluate_giant,
     exact_cost,
     objective_batch_mode,
     onehot_dtype,
     resolve_eval_mode,
-    total_cost,
     _onehot,
     _rid_batch,
 )

@@ -186,10 +186,11 @@ def ils_loop(
         )
     t_start = time.monotonic()
 
-    import os
     import sys
 
-    trace = os.environ.get("VRPMS_ILS_TRACE")
+    from vrpms_tpu import config
+
+    trace = config.get("VRPMS_ILS_TRACE")
 
     def tlog(msg):
         if trace:

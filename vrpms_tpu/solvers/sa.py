@@ -47,11 +47,10 @@ _RATE_LOADED = False
 def _rate_cache_path():
     import os
 
-    return os.environ.get(
-        "VRPMS_RATE_CACHE",
-        os.path.join(
-            os.path.expanduser("~"), ".cache", "vrpms_tpu_sweep_rates.json"
-        ),
+    from vrpms_tpu import config
+
+    return config.get("VRPMS_RATE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "vrpms_tpu_sweep_rates.json"
     )
 
 
@@ -1149,9 +1148,9 @@ def _delta_common_setup(inst, params, knn):
     """The device inputs both delta drivers share: padded bf16 d-table,
     padded knn table, demand gcd scale, uniform capacity, interpret
     flag (ONE construction so the TW and untimed paths cannot drift)."""
-    import os as _os
-
     import numpy as np
+
+    from vrpms_tpu import config
 
     from vrpms_tpu.kernels.sa_eval import demand_scale
 
@@ -1175,7 +1174,7 @@ def _delta_common_setup(inst, params, knn):
     else:
         knn_f = jnp.zeros((nhat, 8), jnp.float32)
     cap0 = float(np.asarray(inst.capacities)[0])
-    interpret = bool(_os.environ.get("VRPMS_DELTA_INTERPRET"))
+    interpret = bool(config.raw("VRPMS_DELTA_INTERPRET"))
     return nhat, dem_g, d_bf16, knn_f, has_knn, cap0, interpret
 
 

@@ -13,6 +13,8 @@ from __future__ import annotations
 import os
 from datetime import datetime
 
+from vrpms_tpu import config
+
 
 def current_date() -> str:
     """Today as 'DD-MM-YYYY' (reference src/utilities/helper.py:4-6)."""
@@ -36,7 +38,7 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     cache dir must not take down a solve — caching is an optimization).
     """
     if path is None:
-        path = os.environ.get("VRPMS_COMPILE_CACHE")
+        path = config.raw("VRPMS_COMPILE_CACHE")
         if path is not None and str(path).lower() in ("off", "0", "none", ""):
             return None  # explicitly disabled (incl. VRPMS_COMPILE_CACHE=)
         path = path or os.path.join(
