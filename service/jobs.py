@@ -728,6 +728,9 @@ def shutdown_scheduler() -> int:
         r.stop(drain_s=config.get("VRPMS_REPLICA_DRAIN_S"))
     global _replica_id_cached
     _replica_id_cached = None  # a rebuilt service re-reads the env
+    global _depth_memo
+    with _depth_lock:
+        _depth_memo = None  # a rebuilt service re-reads its own queue
     with _sched_lock:
         s, _scheduler = _scheduler, None
         if s is not None:
@@ -805,13 +808,51 @@ def _dist_depth_provider() -> int:
     return r.store.depth() if r is not None else 0
 
 
+# Shared-depth memo: the 429 bound (every distributed POST /api/jobs)
+# and GET /api/ready both read the shared queue's depth, which on the
+# hosted store is a network round trip PER REQUEST. A sub-second memo
+# caps that at ~1/TTL store reads per replica under any load — bounded
+# staleness on a signal that is only ever a load-shedding heuristic.
+_depth_lock = threading.Lock()
+_depth_memo: tuple[float, int] | None = None  # guarded-by: _depth_lock
+
+
+def _shared_depth(qs) -> int | None:
+    """The shared queue's depth through the short-TTL memo
+    (VRPMS_DEPTH_MEMO_MS; 0 = read through). None when the store is
+    unreadable AND no fresh memo exists — callers choose their fallback
+    (admission: don't block; readiness: omit the field)."""
+    global _depth_memo
+    ttl = config.get("VRPMS_DEPTH_MEMO_MS") / 1e3
+    now = time.monotonic()
+    if ttl > 0:
+        with _depth_lock:
+            memo = _depth_memo
+        if memo is not None and now - memo[0] < ttl:
+            return memo[1]
+    try:
+        depth = qs.depth()
+    except Exception:
+        return None
+    with _depth_lock:
+        _depth_memo = (now, depth)
+    return depth
+
+
 def _dist_event(name: str, replicaId: str | None = None, **kw) -> None:
     """Replica observer: lease/steal/claim telemetry -> Prometheus +
     structured log (claim-CONFLICT counts arrive separately, via the
     store.base queue-observer seam — conflicts happen inside backend
     conditional updates, not in the replica loop)."""
     if name == "claim":
-        obs.DIST_CLAIMS.labels(kind=kw.get("kind") or "own").inc()
+        obs.DIST_CLAIMS.labels(
+            kind=kw.get("kind") or "own",
+            batch="multi" if (kw.get("batch") or 1) > 1 else "solo",
+        ).inc()
+    elif name == "claim_batch":
+        # one observation per claim ROUND (not per entry): the
+        # histogram answers "how full are the batches we assemble"
+        obs.DIST_CLAIM_BATCH.observe(float(kw.get("size") or 1))
     elif name == "lease_renewed":
         obs.DIST_LEASES.labels(event="renewed").inc()
         return  # heartbeat cadence: counter only, no log line
@@ -886,6 +927,18 @@ def _materialize_entry(entry: dict, rid: str | None = None) -> Job:
                 replicaId=rid or replica_id(),
                 attempt=attempt,
             )
+            if entry.get("_claim_batch"):
+                # how this job was claimed: the waterfall shows whether
+                # the fleet assembled it into a claim-K batch (and how
+                # full) without cross-referencing replica logs
+                s = trace.span(
+                    "dist.claim_batch", parent_id=root.span_id
+                )
+                s.set(
+                    size=entry["_claim_batch"],
+                    kind=entry.get("_claim_kind"),
+                )
+                s.end()
             trace.deferred = True
             job.trace, job.span = trace, root
     token = set_request_id(job.request_id)
@@ -1053,6 +1106,7 @@ def build_replica(rid: str, scheduler=None, **kw):
         max_inflight=config.get("VRPMS_QUEUE_MAX_INFLIGHT"),
         steal=config.enabled("VRPMS_QUEUE_STEAL"),
         vnodes=config.get("VRPMS_RING_VNODES"),
+        claim_batch=config.get("VRPMS_CLAIM_BATCH"),
     )
     defaults.update(kw)
     return Replica(
@@ -1094,9 +1148,8 @@ def _submit_distributed(handler, ctx, job: Job, prep, resolve_from=None):
     # not two
     ring = replica.ring()
     members = max(1, len(ring.members)) if ring is not None else 1
-    try:
-        depth = qs.depth()
-    except Exception:
+    depth = _shared_depth(qs)
+    if depth is None:
         depth = 0  # unreadable depth must not block admits
     if depth >= limit * members:
         retry_after = min(
@@ -1907,10 +1960,12 @@ def readiness() -> tuple[int, dict]:
                 info["ringArcs"] = len(ring.arcs(rep.replica_id))
                 info["arcShare"] = round(ring.share(rep.replica_id), 4)
             info["inflight"] = rep.inflight()
-            try:
-                info["sharedDepth"] = rep.store.depth()
-            except Exception:
-                pass  # a queue-store blip must not fail readiness
+            # memoized: readiness probes at LB cadence must not add a
+            # store round trip each (a queue-store blip omits the field
+            # rather than failing readiness)
+            depth = _shared_depth(rep.store)
+            if depth is not None:
+                info["sharedDepth"] = depth
         try:
             from service import warmup as warmup_mod
 

@@ -130,8 +130,16 @@ DIST_CLAIMS = REGISTRY.counter(
     "vrpms_dist_claims_total",
     "Distributed-queue claims by this replica, by kind (own = the job's "
     "tier hashed into this replica's ring arc — the compile-affinity "
-    "path; steal = off-arc work taken because the own arc was empty)",
-    labels=("kind",),
+    "path; steal = off-arc work taken because the own arc was empty) "
+    "and batch (multi = leased as part of a claim-K batch, solo = a "
+    "single-entry claim)",
+    labels=("kind", "batch"),
+)
+DIST_CLAIM_BATCH = REGISTRY.histogram(
+    "vrpms_dist_claim_batch_size",
+    "Entries leased per store claim (claim-K micro-batching; 1 = the "
+    "shared queue held no same-token batch-mate)",
+    buckets=(1, 2, 4, 8, 16, 32),
 )
 DIST_CLAIM_CONFLICTS = REGISTRY.counter(
     "vrpms_dist_claim_conflicts_total",

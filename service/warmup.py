@@ -78,6 +78,39 @@ def _owns_shape(inst, problem: str = "vrp") -> bool:
         return True  # warmup must never be blocked by ring plumbing
 
 
+def _hot_first(prepared: list, problem: str = "vrp") -> list:
+    """Arc-weighted warmup order: sort padded warmup instances by the
+    replica's observed claim mix (Replica.claim_mix — a decayed counter
+    of the ring tokens actually leased here), hottest tier first, so
+    background warmup compiles the tiers the ring routes to THIS
+    replica before the ladder's cold tail. Stable: unclaimed tiers and
+    ties keep ladder order; local-queue mode (no claim mix to observe)
+    is untouched."""
+    try:
+        from service import jobs as jobs_mod
+
+        if not jobs_mod.dist_queue_enabled():
+            return prepared
+        # PEEK the replica singleton (the _dist_depth_provider pattern):
+        # computing a read-only ordering must not lazily construct and
+        # START the claim loop — a warmup on a cold process would begin
+        # leasing shared-queue jobs before any tier is compiled
+        rep = jobs_mod._replica
+        if rep is None:
+            return prepared
+        mix = rep.claim_mix()
+        if not mix:
+            return prepared
+
+        def heat(item) -> float:
+            token = jobs_mod.ring_token(problem, item[-1])
+            return mix.get(token, 0.0)
+
+        return sorted(prepared, key=heat, reverse=True)
+    except Exception:
+        return prepared  # warmup must never be blocked by mix plumbing
+
+
 def parse_shapes(spec: str) -> list[tuple[int, int, int | None]]:
     """'200x36,100x12x1024' -> [(200, 36, None), (100, 12, 1024)]."""
     shapes = []
@@ -113,13 +146,17 @@ def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True,
 
     load_bnb()
     load_ngroute()
-    for n, v, pop in parse_shapes(spec):
-        # pad through the request path's canonicalization (identity when
-        # tiering is off): the warmed traces must be the PADDED ones the
-        # prepared requests actually run
-        from vrpms_tpu.core import tiers
+    from vrpms_tpu.core import tiers
 
-        inst = tiers.maybe_pad(synth_cvrp(n, v, seed=0))
+    # pad through the request path's canonicalization (identity when
+    # tiering is off): the warmed traces must be the PADDED ones the
+    # prepared requests actually run — padded up front so the claim-mix
+    # ordering below can key on the same ring tokens requests route by
+    prepared = [
+        (n, v, pop, tiers.maybe_pad(synth_cvrp(n, v, seed=0)))
+        for n, v, pop in parse_shapes(spec)
+    ]
+    for n, v, pop, inst in _hot_first(prepared):
         if owned_only and not _owns_shape(inst):
             if log:
                 print(f"[warmup] {n}x{v}: tier owned by a peer replica; "
@@ -211,7 +248,9 @@ def warmup_tiers(max_locations: int = 64, log=True) -> float:
     request whose padded shape lands on a warmed tier then solves at
     steady-state latency from the first hit. Instances are padded
     through the SAME tiers.maybe_pad path requests take, so the warmed
-    traces are exactly the ones traffic reuses."""
+    traces are exactly the ones traffic reuses. With the distributed
+    queue active the ladder is arc-weighted (_hot_first): tiers this
+    replica's claim mix shows as hot compile before the cold tail."""
     spec = tier_warm_shapes(max_locations)
     if not spec:
         if log:

@@ -157,6 +157,29 @@ class JobQueueStore:
         half-open) for `owner`; None when nothing matches."""
         raise NotImplementedError
 
+    def claim_batch(self, owner: str, lease_s: float, k: int,
+                    slots=None) -> list:
+        """Claim-K-matching: lease the oldest QUEUED entry (same slot
+        filter as `claim`) PLUS up to k-1 younger QUEUED entries sharing
+        its `bucket` (the ring token — equal buckets are what one
+        replica can assemble into one batched launch), oldest-first, in
+        ONE conditional update. Entries whose bucket is None never
+        batch (the leader claims alone).
+
+        Per-entry semantics are IDENTICAL to k sequential `claim`s —
+        each returned entry carries its own lease/owner/attempt and is
+        renewed/acked/nacked/reclaimed individually, so a crash
+        mid-batch re-queues exactly the unfinished members at attempt+1
+        — but the leasing itself is one atomic update: racing replicas
+        can split a token's backlog, never share an entry. Returns []
+        when nothing matches.
+
+        Default: degrade to a single `claim` (a backend that has not
+        implemented batched leasing still honors the contract at k=1).
+        """
+        entry = self.claim(owner, lease_s, slots)
+        return [] if entry is None else [entry]
+
     def renew(self, owner: str, job_id: str, lease_s: float) -> bool:
         """Heartbeat: extend `owner`'s lease; False if the lease is no
         longer theirs (expired and reclaimed)."""

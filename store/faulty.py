@@ -115,6 +115,13 @@ class FaultyJobQueue(InMemoryJobQueue):
         self._injector.apply("read")
         return super().claim(owner, lease_s, slots)
 
+    def claim_batch(self, owner, lease_s, k, slots=None):
+        # one injection per batched claim (it is ONE conditional update
+        # on the real backends), so a fault plan fails the whole batch
+        # or none of it — never a half-leased set
+        self._injector.apply("read")
+        return super().claim_batch(owner, lease_s, k, slots)
+
     def renew(self, owner, job_id, lease_s):
         self._injector.apply("read")
         return super().renew(owner, job_id, lease_s)

@@ -262,6 +262,39 @@ class InMemoryJobQueue(JobQueueStore):
                 return dict(row)
         return None
 
+    def claim_batch(self, owner: str, lease_s: float, k: int,
+                    slots=None) -> list:
+        """Claim-K-matching under the one table lock: find the oldest
+        QUEUED entry in `slots`, then sweep the remaining iteration
+        order (dict order = FIFO) for up to k-1 more QUEUED entries
+        with the SAME bucket — all leased in this one critical section,
+        which is exactly the atomicity the Supabase backend's single
+        conditional UPDATE provides."""
+        if k <= 0:
+            return []
+        now = time.time()
+        taken: list = []
+        with _lock:
+            leader_bucket = None
+            for row in self._rows_locked().values():
+                if row["state"] != Q_QUEUED:
+                    continue
+                if not taken:
+                    if not self._in_slots(row.get("slot", 0), slots):
+                        continue
+                    leader_bucket = row.get("bucket")
+                elif leader_bucket is None or row.get("bucket") != leader_bucket:
+                    # batch-mates must share the leader's ring token; a
+                    # None token never batches (the leader goes alone)
+                    continue
+                row["state"] = Q_LEASED
+                row["lease_owner"] = owner
+                row["lease_expires_at"] = now + lease_s
+                taken.append(dict(row))
+                if len(taken) >= k or leader_bucket is None:
+                    break
+        return taken
+
     def _owned_locked(self, owner: str, job_id: str):
         row = self._rows_locked().get(str(job_id))
         if row is None or row["state"] != Q_LEASED:

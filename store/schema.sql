@@ -86,9 +86,21 @@ create index if not exists solution_cache_family
 --          lease_expires_at=now() + $lease
 --    where id=$candidate and queue_state='queued';
 -- (zero rows updated = another replica won the race), heartbeat-renew
--- while solving, and clear the queue columns on ack. A crashed
--- replica's lease expires and any peer's reclaim scan re-queues the
--- entry exactly once (conditional on the observed lease_owner),
+-- while solving, and clear the queue columns on ack. Claim-K-matching
+-- (fleet-wide micro-batching) is the same statement over a SET: the
+-- claimant scans the oldest queued candidates, keeps those sharing the
+-- leader's ring token (queue_entry->>'bucket'), and leases them all in
+-- one conditional update against the jobs_queue_claim index
+--   update jobs set queue_state='leased', lease_owner=$me,
+--          lease_expires_at=now() + $lease
+--    where id in ($leader, $mates...) and queue_state='queued'
+--    returning *;
+-- rows a racing replica already leased simply do not match, so two
+-- fleets SPLIT a token's backlog but never share an entry. Leases stay
+-- strictly per-row: each claimed entry renews/acks/reclaims on its own,
+-- so a crash mid-batch re-queues exactly the unfinished members. A
+-- crashed replica's lease expires and any peer's reclaim scan re-queues
+-- the entry exactly once (conditional on the observed lease_owner),
 -- bumping attempt; attempt >= 2 fails the job clean instead of
 -- crash-looping. Replicas must run NTP-sane clocks (skew well under
 -- the lease, 15 s default).

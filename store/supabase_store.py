@@ -255,19 +255,23 @@ class SupabaseJobQueue(JobQueueStore):
             on_conflict="id",
         ).execute()
 
-    def _candidates(self, slots, states, expired_before=None) -> list:
+    def _candidates(self, slots, states, expired_before=None,
+                    limit=None) -> list:
         # slim scan (the PR-6 family-scan precedent): candidate rows
-        # carry only the lease/ordering columns — at most ONE candidate
-        # wins, and the winner's full row (queue_entry payload
-        # included) comes back on the conditional UPDATE's returning
-        # representation, so polling replicas never transfer payloads
-        # they will not run
+        # carry only the lease/ordering columns plus the ring token
+        # (claim-K batch assembly keys on it) — winners' full rows
+        # (queue_entry payload included) come back on the conditional
+        # UPDATE's returning representation, so polling replicas never
+        # transfer payloads they will not run
         q = (
             self.client.table("jobs")
-            .select("id,slot,queue_state,lease_owner,lease_expires_at,attempt")
+            .select(
+                "id,slot,queue_state,lease_owner,lease_expires_at,"
+                "attempt,bucket:queue_entry->>bucket"
+            )
             .in_("queue_state", list(states))
             .order("updated_at", desc=False)
-            .limit(self.CLAIM_CANDIDATES)
+            .limit(limit or self.CLAIM_CANDIDATES)
         )
         if expired_before is not None:
             q = q.lt("lease_expires_at", self._iso(expired_before))
@@ -304,6 +308,71 @@ class SupabaseJobQueue(JobQueueStore):
                 return self._entry(dict(row, **upd.data[0]))
             notify_queue_event("claim_conflict")
         return None
+
+    def claim_batch(self, owner: str, lease_s: float, k: int,
+                    slots=None) -> list:
+        """Claim-K-matching as ONE conditional UPDATE against the
+        jobs_queue_claim index: pick the oldest queued candidate, gather
+        the younger candidates sharing its ring token (queue_entry->>
+        bucket), then
+
+            update jobs set queue_state='leased', lease_owner=$me, ...
+             where id in ($leader, $mates...) and queue_state='queued'
+             returning *;
+
+        Rows a racing replica leased between the scan and the update
+        simply do not match — the two fleets split the token's backlog,
+        never share an entry (the per-row atomicity of a Postgres
+        UPDATE, exactly the single-claim rule applied to a set). Each
+        returned entry carries its own lease and is renewed / acked /
+        reclaimed individually."""
+        import time as _time
+
+        if k <= 0 or (slots is not None and not slots):
+            return []
+        rows = self._candidates(
+            slots, (Q_QUEUED,), limit=max(self.CLAIM_CANDIDATES, k)
+        )
+        while rows:
+            leader = rows[0]
+            bucket = leader.get("bucket")
+            batch = [leader]
+            if bucket is not None:
+                batch += [
+                    r for r in rows[1:] if r.get("bucket") == bucket
+                ][: k - 1]
+            by_id = {r["id"]: r for r in batch}
+            upd = (
+                self.client.table("jobs")
+                .update(
+                    {
+                        "queue_state": Q_LEASED,
+                        "lease_owner": owner,
+                        "lease_expires_at": self._iso(
+                            _time.time() + lease_s
+                        ),
+                    }
+                )
+                .in_("id", list(by_id))
+                .eq("queue_state", Q_QUEUED)
+                .execute()
+            )
+            if upd.data:
+                if len(upd.data) < len(by_id):
+                    # the race cost us some mates, not the batch
+                    notify_queue_event(
+                        "claim_conflict", len(by_id) - len(upd.data)
+                    )
+                won = sorted(
+                    (self._entry(dict(by_id[r["id"]], **r)) for r in upd.data),
+                    key=lambda e: list(by_id).index(e["id"]),
+                )
+                return won
+            notify_queue_event("claim_conflict", len(by_id))
+            # the whole batch was raced away: drop it and retry on the
+            # remaining candidates (the single-claim retry rule)
+            rows = [r for r in rows if r["id"] not in by_id]
+        return []
 
     def _owned_update(self, owner: str, job_id: str, patch: dict) -> bool:
         upd = (

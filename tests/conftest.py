@@ -96,6 +96,7 @@ _SLOW_PATTERNS = (
     # the HTTP surface (ring/lease/replica units stay quick; tier1.yml
     # runs the file in full)
     "test_distqueue.py::TestCrossReplicaChaos",
+    "test_distqueue.py::TestClaimKCrossReplica",
     "test_distqueue.py::TestServiceDistHTTP",
     # dynamic re-solve end-to-end solves (unit/envelope layers stay
     # quick; tier1.yml runs the file in full)

@@ -455,6 +455,553 @@ class TestReplicaChaos:
 
 
 # ---------------------------------------------------------------------------
+# Claim-K-matching: the batched-claims store contract
+# ---------------------------------------------------------------------------
+
+
+class TestClaimBatch:
+    def test_leases_same_bucket_oldest_first(self):
+        qs = store.get_queue_store()
+        for i in range(3):
+            qs.enqueue(_entry(f"a{i}", 5))  # bucket tier-5
+        qs.enqueue(_entry("b0", 9))  # bucket tier-9
+        got = qs.claim_batch("r1", 5.0, 8)
+        assert [e["id"] for e in got] == ["a0", "a1", "a2"]
+        assert all(e["lease_owner"] == "r1" for e in got)
+        assert all(e["state"] == "leased" for e in got)
+        assert qs.depth() == 1  # the other token's entry stays queued
+        assert qs.claim_batch("r2", 5.0, 8)[0]["id"] == "b0"
+
+    def test_k_caps_the_batch(self):
+        qs = store.get_queue_store()
+        for i in range(5):
+            qs.enqueue(_entry(f"j{i}", 7))
+        got = qs.claim_batch("r1", 5.0, 2)
+        assert [e["id"] for e in got] == ["j0", "j1"]
+        assert qs.depth() == 3
+
+    def test_slots_filter_the_leader(self):
+        qs = store.get_queue_store()
+        qs.enqueue(_entry("low", 10))
+        qs.enqueue(_entry("low2", 10))
+        qs.enqueue(_entry("high", 60000))
+        got = qs.claim_batch("r1", 5.0, 8, [(0, 100)])
+        assert [e["id"] for e in got] == ["low", "low2"]
+        assert qs.claim_batch("r1", 5.0, 8, [(0, 100)]) == []
+
+    def test_none_bucket_claims_alone(self):
+        qs = store.get_queue_store()
+        qs.enqueue({"id": "n1", "slot": 3, "bucket": None, "payload": {}})
+        qs.enqueue({"id": "n2", "slot": 3, "bucket": None, "payload": {}})
+        got = qs.claim_batch("r1", 5.0, 8)
+        assert [e["id"] for e in got] == ["n1"]
+        assert qs.depth() == 1
+
+    def test_zero_k_claims_nothing(self):
+        qs = store.get_queue_store()
+        qs.enqueue(_entry("j1"))
+        assert qs.claim_batch("r1", 5.0, 0) == []
+        assert qs.depth() == 1
+
+    def test_racing_replicas_split_token_never_share(self):
+        qs = store.get_queue_store()
+        n = 24
+        for i in range(n):
+            qs.enqueue(_entry(f"j{i}", 5))
+        wins: dict = {}
+        lock = threading.Lock()
+
+        def racer(rid):
+            while True:
+                got = qs.claim_batch(rid, 5.0, 4)
+                if not got:
+                    return
+                with lock:
+                    for e in got:
+                        wins.setdefault(e["id"], []).append(rid)
+
+        threads = [
+            threading.Thread(target=racer, args=(f"r{i}",))
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # every entry leased EXACTLY once across the racing fleet
+        assert sorted(wins) == sorted(f"j{i}" for i in range(n))
+        assert all(len(owners) == 1 for owners in wins.values()), wins
+
+    def test_batch_leases_are_per_entry(self):
+        # ack one member, let the rest expire: only the unfinished
+        # members re-queue, each at attempt+1 — a crash mid-batch
+        # reclaims exactly the work that was not done
+        qs = store.get_queue_store()
+        for i in range(3):
+            qs.enqueue(_entry(f"j{i}", 5))
+        got = qs.claim_batch("r1", 0.05, 8)
+        assert len(got) == 3
+        assert qs.ack("r1", "j0")
+        time.sleep(0.08)
+        req, dead = qs.reclaim_expired()
+        assert sorted(e["id"] for e in req) == ["j1", "j2"]
+        assert all(e["attempt"] == 1 for e in req)
+        assert not dead
+        # the acked member is gone for good
+        assert all(
+            e["id"] != "j0" for e in qs.claim_batch("r2", 5.0, 8)
+        )
+
+    def test_base_fallback_serves_single_claims(self):
+        # a backend that predates claim_batch still honors the seam at
+        # k=1 through the JobQueueStore default
+        from store.base import JobQueueStore
+
+        class OneShot(JobQueueStore):
+            def __init__(self):
+                self.entries = [{"id": "solo"}]
+
+            def claim(self, owner, lease_s, slots=None):
+                return self.entries.pop() if self.entries else None
+
+        qs = OneShot()
+        assert [e["id"] for e in qs.claim_batch("r", 5.0, 8)] == ["solo"]
+        assert qs.claim_batch("r", 5.0, 8) == []
+
+    def test_faulty_plan_injects_into_claim_batch(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        qs = store.get_queue_store()
+        with pytest.raises(Exception):
+            qs.claim_batch("r1", 5.0, 4)
+
+
+# ---------------------------------------------------------------------------
+# Assembled-batch gather: the worker side of claim-K
+# ---------------------------------------------------------------------------
+
+
+class TestGatherHint:
+    def test_hint_satisfied_skips_the_window(self):
+        from vrpms_tpu.sched.batcher import gather_batch
+        from vrpms_tpu.sched.queue import JobQueue
+
+        q = JobQueue(8)
+        first = Job(payload=None, bucket="b", batch_hint=2)
+        mate = Job(payload=None, bucket="b", batch_hint=2)
+        q.push(mate)
+        t0 = time.monotonic()
+        batch = gather_batch(q, first, window_s=5.0, max_batch=8)
+        assert len(batch) == 2
+        assert time.monotonic() - t0 < 1.0  # never slept out the window
+
+    def test_hint_one_returns_immediately(self):
+        from vrpms_tpu.sched.batcher import gather_batch
+        from vrpms_tpu.sched.queue import JobQueue
+
+        q = JobQueue(8)
+        first = Job(payload=None, bucket="b", batch_hint=1)
+        t0 = time.monotonic()
+        batch = gather_batch(q, first, window_s=5.0, max_batch=8)
+        assert batch == [first]
+        assert time.monotonic() - t0 < 1.0
+
+    def test_hint_waits_for_late_mates(self):
+        # the hinted mate lands AFTER the leader pops: the gather must
+        # pick it up (the window still bounds the wait)
+        from vrpms_tpu.sched.batcher import gather_batch
+        from vrpms_tpu.sched.queue import JobQueue
+
+        q = JobQueue(8)
+        first = Job(payload=None, bucket="b", batch_hint=2)
+        mate = Job(payload=None, bucket="b", batch_hint=2)
+
+        def push_late():
+            time.sleep(0.05)
+            q.push(mate)
+
+        t = threading.Thread(target=push_late)
+        t.start()
+        batch = gather_batch(q, first, window_s=2.0, max_batch=8)
+        t.join()
+        assert len(batch) == 2
+
+    def test_leftover_group_never_waits_for_launched_elders(self):
+        # a claim of 4 capped by max_batch=3: the first launch takes 3,
+        # the leftover (descending hint 1) must launch immediately —
+        # not sleep out the window waiting for members already gone
+        from vrpms_tpu.sched.batcher import gather_batch
+        from vrpms_tpu.sched.queue import JobQueue
+
+        q = JobQueue(8)
+        group = [
+            Job(payload=None, bucket="b", batch_hint=h)
+            for h in (4, 3, 2, 1)
+        ]
+        for job in group[1:]:
+            q.push(job)
+        first = gather_batch(q, group[0], window_s=5.0, max_batch=3)
+        assert len(first) == 3
+        leftover = q.pop(timeout=1.0)
+        assert leftover is group[3] and leftover.batch_hint == 1
+        t0 = time.monotonic()
+        batch = gather_batch(q, leftover, window_s=5.0, max_batch=3)
+        assert batch == [leftover]
+        assert time.monotonic() - t0 < 1.0
+
+    def test_no_hint_keeps_the_window_contract(self):
+        from vrpms_tpu.sched.batcher import gather_batch
+        from vrpms_tpu.sched.queue import JobQueue
+
+        q = JobQueue(8)
+        first = Job(payload=None, bucket="b")
+        t0 = time.monotonic()
+        batch = gather_batch(q, first, window_s=0.15, max_batch=8)
+        assert batch == [first]
+        assert time.monotonic() - t0 >= 0.14  # a local job still waits
+
+
+# ---------------------------------------------------------------------------
+# Replica batched claiming (stub runners; no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaClaimBatching:
+    def _materialize(self, entry):
+        job = Job(payload={"entry": entry})
+        job.id = str(entry["id"])
+        job.bucket = entry.get("bucket")
+        return job
+
+    def test_replica_claims_batch_and_sets_hints(self):
+        qs = store.get_queue_store()
+        sizes: list = []
+        hints: dict = {}
+        done = threading.Event()
+        lock = threading.Lock()
+
+        def submit(job):
+            with lock:
+                hints[job.id] = job.batch_hint
+                if len(hints) == 4:
+                    done.set()
+            job.result = {"ok": True}
+            job.finish("done")
+
+        def on_event(name, **kw):
+            if name == "claim_batch":
+                sizes.append(kw.get("size"))
+
+        for i in range(4):
+            qs.enqueue(_entry(f"j{i}", 5))  # one token, one batch
+        rep = Replica(
+            qs, "batcher", self._materialize, submit, on_event=on_event,
+            lease_s=2.0, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.5,
+        )
+        rep.start()
+        assert done.wait(timeout=10)
+        rep.stop()
+        assert sizes and sizes[0] == 4, sizes
+        # hints DESCEND through the claim group (4, 3, 2, 1): each
+        # member counts itself plus the mates submitted after it, so a
+        # leftover gather leader never waits for already-launched elders
+        assert sorted(hints) == [f"j{i}" for i in range(4)]
+        assert sorted(hints.values(), reverse=True) == [4, 3, 2, 1], hints
+
+    def test_claim_batch_one_restores_single_claims(self):
+        qs = store.get_queue_store()
+        sizes: list = []
+        count = threading.Event()
+        seen: list = []
+
+        def submit(job):
+            seen.append(job.id)
+            if len(seen) == 3:
+                count.set()
+            job.result = {"ok": True}
+            job.finish("done")
+
+        def on_event(name, **kw):
+            if name == "claim_batch":
+                sizes.append(kw.get("size"))
+
+        for i in range(3):
+            qs.enqueue(_entry(f"j{i}", 5))
+        rep = Replica(
+            qs, "solo", self._materialize, submit, on_event=on_event,
+            lease_s=2.0, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.5,
+            claim_batch=1,
+        )
+        rep.start()
+        assert count.wait(timeout=10)
+        rep.stop()
+        assert sizes and all(s == 1 for s in sizes), sizes
+
+    def test_headroom_clamps_the_claim(self):
+        # max_inflight 2 with a submit that never completes: the first
+        # claim may lease at most 2 of the 4 queued entries
+        qs = store.get_queue_store()
+        for i in range(4):
+            qs.enqueue(_entry(f"j{i}", 5))
+        rep = Replica(
+            qs, "narrow", self._materialize, lambda job: None,
+            lease_s=5.0, poll_s=0.005, heartbeat_s=0.05, reclaim_s=5.0,
+            max_inflight=2,
+        )
+        rep.start()
+        assert _wait(lambda: rep.inflight() == 2, timeout=5)
+        time.sleep(0.1)  # more claim rounds run; headroom stays 0
+        assert rep.inflight() == 2
+        assert qs.depth() == 2  # the rest stay claimable by peers
+        rep.kill()
+
+    def test_crash_mid_batch_requeues_only_unfinished(self):
+        # the victim claims 4 entries in ONE batch, finishes (and acks)
+        # 2, then dies: peers must reclaim exactly the 2 unfinished
+        # members at attempt+1 — the finished members never run again
+        qs = store.get_queue_store()
+        finish_now = {"j0", "j2"}
+        completions: dict = {}
+        lock = threading.Lock()
+
+        def victim_submit(job):
+            if job.id in finish_now:
+                job.result = {"ok": True}
+                job.finish("done")
+            # others: claimed, never completed (a wedged box)
+
+        def complete(job, entry, acked):
+            with lock:
+                completions.setdefault(job.id, []).append(
+                    (entry.get("attempt"), acked)
+                )
+
+        for i in range(4):
+            qs.enqueue(_entry(f"j{i}", 5))
+        victim = Replica(
+            qs, "victim", self._materialize, victim_submit,
+            complete=complete,
+            lease_s=0.3, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.05,
+        )
+        victim.start()
+        # wait until the finished members were ACKED (completions fire
+        # post-ack) and the wedged members hold leases
+        assert _wait(
+            lambda: len(completions) == 2 and victim.inflight() == 2,
+            timeout=10,
+        ), completions
+        victim.kill()
+
+        def rescue_submit(job):
+            job.result = {"ok": True}
+            job.finish("done")
+
+        rescuer = Replica(
+            qs, "rescuer", self._materialize, rescue_submit,
+            complete=complete,
+            lease_s=0.3, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.05,
+        )
+        rescuer.start()
+        assert _wait(lambda: len(completions) == 4, timeout=10), completions
+        time.sleep(0.3)  # let any stray duplicate completion land
+        rescuer.stop()
+        for job_id, comps in completions.items():
+            want_attempt = 0 if job_id in finish_now else 1
+            assert comps == [(want_attempt, True)], (job_id, comps)
+        assert qs.depth() == 0
+
+    def test_exactly_once_under_faulty_store_with_batches(
+        self, monkeypatch
+    ):
+        # the chaos plan now injects into claim_batch too: a same-token
+        # backlog under a 25% fault rate must still complete exactly
+        # once each, whatever mix of batch sizes the retries produce
+        monkeypatch.setenv("VRPMS_STORE", "faulty:rate=0.25;seed=7")
+        qs = store.get_queue_store()
+        done: dict = {}
+        lock = threading.Lock()
+
+        def submit(job):
+            job.result = {"ok": True}
+            job.finish("done")
+
+        def complete(job, entry, acked):
+            with lock:
+                done.setdefault(job.id, []).append(acked)
+
+        rep = Replica(
+            qs, "survivor", self._materialize, submit, complete=complete,
+            lease_s=1.0, poll_s=0.005, heartbeat_s=0.05, reclaim_s=0.1,
+        )
+        rep.start()
+        for i in range(6):
+            for _ in range(50):
+                try:
+                    qs.enqueue(_entry(f"j{i}", 5))
+                    break
+                except Exception:
+                    continue
+            else:
+                raise AssertionError("enqueue never succeeded")
+        assert _wait(lambda: len(done) == 6, timeout=20), done
+        time.sleep(0.3)
+        rep.stop()
+        assert all(acks == [True] for acks in done.values()), done
+
+    def test_claim_mix_tracks_hot_tokens(self):
+        # the decayed claim-mix counter: recent tokens dominate, the
+        # key set stays bounded — what arc-weighted warmup orders by
+        qs = store.get_queue_store()
+        rep = Replica(qs, "mixer", lambda e: None, lambda j: None)
+        rep._note_claims([{"bucket": "cold"}])
+        for _ in range(5):
+            rep._note_claims([{"bucket": "hot"}, {"bucket": "hot"}])
+        mix = rep.claim_mix()
+        assert list(mix)[0] == "hot"
+        assert mix["hot"] > mix["cold"]
+        # bounded: flooding with distinct tokens evicts the coldest
+        for i in range(2 * rep.MIX_KEYS):
+            rep._note_claims([{"bucket": f"t{i}"}])
+        assert len(rep.claim_mix()) <= rep.MIX_KEYS
+        # None tokens never enter the mix
+        rep._note_claims([{"bucket": None}])
+        assert None not in rep.claim_mix()
+
+
+# ---------------------------------------------------------------------------
+# Shared-depth memo (the 429/readiness store-read cap)
+# ---------------------------------------------------------------------------
+
+
+class TestDepthMemo:
+    class _CountingQueue:
+        def __init__(self, depth=3):
+            self.calls = 0
+            self._depth = depth
+
+        def depth(self):
+            self.calls += 1
+            return self._depth
+
+    def test_memo_caps_store_reads(self, monkeypatch):
+        from service import jobs as jobs_mod
+
+        monkeypatch.setenv("VRPMS_DEPTH_MEMO_MS", "60000")
+        jobs_mod._depth_memo = None
+        qs = self._CountingQueue()
+        assert jobs_mod._shared_depth(qs) == 3
+        for _ in range(20):
+            assert jobs_mod._shared_depth(qs) == 3
+        assert qs.calls == 1  # 21 requests, ONE store round trip
+        jobs_mod._depth_memo = None
+
+    def test_ttl_zero_reads_through(self, monkeypatch):
+        from service import jobs as jobs_mod
+
+        monkeypatch.setenv("VRPMS_DEPTH_MEMO_MS", "0")
+        jobs_mod._depth_memo = None
+        qs = self._CountingQueue()
+        for _ in range(3):
+            jobs_mod._shared_depth(qs)
+        assert qs.calls == 3
+        jobs_mod._depth_memo = None
+
+    def test_unreadable_depth_returns_none(self, monkeypatch):
+        from service import jobs as jobs_mod
+
+        monkeypatch.setenv("VRPMS_DEPTH_MEMO_MS", "0")
+        jobs_mod._depth_memo = None
+
+        class Down:
+            def depth(self):
+                raise RuntimeError("store down")
+
+        assert jobs_mod._shared_depth(Down()) is None
+
+
+# ---------------------------------------------------------------------------
+# Arc-weighted warmup ordering
+# ---------------------------------------------------------------------------
+
+
+class TestArcWeightedWarmup:
+    class _FakeInst:
+        def __init__(self, n):
+            self.durations = np.zeros((n, n))
+            self.n_vehicles = 3
+            self.has_tw = False
+            self.het_fleet = False
+            self.td_rank = 0
+
+    def test_hot_tiers_order_first(self, monkeypatch):
+        from service import jobs as jobs_mod
+        from service import warmup as warmup_mod
+
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        prepared = [
+            (8, 3, None, self._FakeInst(8)),
+            (16, 3, None, self._FakeInst(16)),
+            (24, 3, None, self._FakeInst(24)),
+        ]
+        hot = jobs_mod.ring_token("vrp", prepared[1][-1])
+
+        class _Rep:
+            def claim_mix(self):
+                return {hot: 5.0}
+
+        # _hot_first PEEKS the singleton — it must never construct one
+        monkeypatch.setattr(jobs_mod, "_replica", _Rep())
+        ordered = warmup_mod._hot_first(prepared)
+        assert [x[0] for x in ordered] == [16, 8, 24]  # hot first,
+        # ladder order preserved for the unclaimed tail
+
+    def test_local_queue_keeps_ladder_order(self, monkeypatch):
+        from service import warmup as warmup_mod
+
+        monkeypatch.delenv("VRPMS_QUEUE", raising=False)
+        prepared = [
+            (8, 3, None, self._FakeInst(8)),
+            (16, 3, None, self._FakeInst(16)),
+        ]
+        assert warmup_mod._hot_first(prepared) == prepared
+
+    def test_empty_mix_keeps_ladder_order(self, monkeypatch):
+        from service import jobs as jobs_mod
+        from service import warmup as warmup_mod
+
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+
+        class _Rep:
+            def claim_mix(self):
+                return {}
+
+        monkeypatch.setattr(jobs_mod, "_replica", _Rep())
+        prepared = [
+            (8, 3, None, self._FakeInst(8)),
+            (16, 3, None, self._FakeInst(16)),
+        ]
+        assert warmup_mod._hot_first(prepared) == prepared
+
+    def test_no_replica_means_no_construction(self, monkeypatch):
+        # VRPMS_QUEUE=store but the claim loop has not started: the
+        # ordering helper must return ladder order WITHOUT building
+        # (and starting) a replica as a side effect
+        from service import jobs as jobs_mod
+        from service import warmup as warmup_mod
+
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setattr(jobs_mod, "_replica", None)
+        constructed: list = []
+        # a flag, not a raise: _hot_first swallows exceptions by design,
+        # so a raising sentinel could never fail this test
+        monkeypatch.setattr(
+            jobs_mod, "get_replica", lambda: constructed.append(1)
+        )
+        prepared = [(8, 3, None, self._FakeInst(8))]
+        assert warmup_mod._hot_first(prepared) == prepared
+        assert not constructed
+
+
+# ---------------------------------------------------------------------------
 # Cross-replica chaos with REAL solves (the ISSUE-9 acceptance gate)
 # ---------------------------------------------------------------------------
 
@@ -631,6 +1178,142 @@ class TestCrossReplicaChaos:
                 assert rec["attempt"] == 1, rec
         assert reclaimed == 3
         assert qs.depth() == 0  # nothing left behind
+
+
+class TestClaimKCrossReplica:
+    def test_kill_mid_batch_requeues_only_unfinished_members(
+        self, monkeypatch
+    ):
+        """The claim-K acceptance gate with REAL solves: one claim
+        leases 4 same-token entries (2 launch buckets); the victim
+        solves and acks the first bucket's pair, wedges on the second,
+        and dies. Only the unfinished pair may requeue — at attempt=2,
+        under their ORIGINAL trace ids — while the finished pair keeps
+        its attempt=1 records untouched."""
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        _seed_dataset("dqk9", 9)
+        qs = store.get_queue_store()
+
+        block = threading.Event()
+        BLOCK_ITERS = 250  # bucket B: wedges the victim
+        DONE_ITERS = 200   # bucket A: solves normally
+
+        from service import jobs as jobs_mod
+
+        def selective_runner(jobs):
+            iters = {
+                int(j.payload["prep"].opts.get("iteration_count") or 0)
+                for j in jobs
+            }
+            if BLOCK_ITERS in iters:
+                block.wait(timeout=600)  # a wedged box
+                return
+            jobs_mod._runner(jobs)
+
+        sizes: list = []
+
+        def victim_events(name, **kw):
+            if name == "claim_batch":
+                sizes.append(kw.get("size"))
+
+        try:
+            victim = _service_replica(
+                "victim", runner=selective_runner, lease_s=0.8,
+                steal=False, on_event=victim_events,
+            )
+            rescuer = _service_replica("rescuer", lease_s=0.8, steal=False)
+            qs.register_replica("victim", 60.0)
+            qs.register_replica("rescuer", 60.0)
+            ring = HashRing(["victim", "rescuer"], vnodes=16)
+            # every entry shares ONE ring token, pinned to the victim's
+            # arc — claimed together in one batch
+            s = next(
+                x for x in range(0, SLOTS, 191)
+                if ring.owner(x) == "victim"
+            )
+            entries, traces = [], {}
+            specs = [DONE_ITERS, DONE_ITERS, BLOCK_ITERS, BLOCK_ITERS]
+            for i, iters in enumerate(specs):
+                content = dict(
+                    _solve_content("dqk9", 9, seed=70 + i),
+                    iterationCount=iters,
+                )
+                tid = uuid.uuid4().hex
+                sid = uuid.uuid4().hex[:16]
+                job_id = uuid.uuid4().hex[:16]
+                traces[job_id] = (tid, iters)
+                entries.append({
+                    "id": job_id,
+                    "slot": s,
+                    "bucket": "dqk9-token",
+                    "time_limit": None,
+                    "submitted_at": time.time(),
+                    "payload": {
+                        "content": content,
+                        "requestId": f"req-k{i}",
+                        "problem": "vrp",
+                        "algorithm": "sa",
+                        "traceparent": TRACEPARENT.format(tid=tid, sid=sid),
+                    },
+                })
+            for e in entries:
+                qs.enqueue(e)
+            victim.start()
+            rescuer.start()
+
+            db = store.get_database("vrp", None)
+            done_ids = [
+                jid for jid, (_, iters) in traces.items()
+                if iters == DONE_ITERS
+            ]
+            wedged_ids = [
+                jid for jid, (_, iters) in traces.items()
+                if iters == BLOCK_ITERS
+            ]
+
+            def group_done(ids):
+                def check():
+                    for jid in ids:
+                        rec = db.get_job_seed(jid)
+                        if rec is None or rec.get("status") != "done":
+                            return False
+                    return True
+                return check
+
+            # bucket A solved + acked on the victim BEFORE the crash
+            assert _wait(group_done(done_ids), timeout=120), {
+                jid: db.get_job_seed(jid) for jid in done_ids
+            }
+            assert sizes and sizes[0] == 4, sizes  # ONE claim, all 4
+            victim.kill()
+            # bucket B reclaimed and completed by the rescuer
+            assert _wait(group_done(wedged_ids), timeout=120), {
+                jid: db.get_job_seed(jid) for jid in wedged_ids
+            }
+            time.sleep(0.5)  # let any stray duplicate publication land
+        finally:
+            block.set()  # release the wedged worker
+            victim.kill()
+            rescuer.stop()
+            victim._test_scheduler.shutdown(timeout=0.2)
+            rescuer._test_scheduler.shutdown(timeout=5.0)
+
+        for jid, (tid, iters) in traces.items():
+            rec = db.get_job_seed(jid)
+            assert rec["status"] == "done", rec
+            assert rec["traceId"] == tid, (rec["traceId"], tid)
+            visited = sorted(
+                c for v in rec["message"]["vehicles"]
+                for c in v["tour"][1:-1]
+            )
+            assert visited == list(range(1, 9)), rec
+            if iters == DONE_ITERS:
+                # finished mid-batch members: never reclaimed
+                assert rec["attempt"] == 1, rec
+            else:
+                # unfinished members: exactly one reclaim generation
+                assert rec["attempt"] == 2, rec
+        assert qs.depth() == 0
 
 
 # ---------------------------------------------------------------------------

@@ -129,6 +129,14 @@ REGISTRY: dict[str, Var] = {
            "Replica claim-loop poll interval in milliseconds."),
         _v("VRPMS_QUEUE_MAX_INFLIGHT", "int", 16,
            "Max leases one replica holds at once."),
+        _v("VRPMS_CLAIM_BATCH", "int", 0,
+           "Max same-ring-token entries one store claim may lease "
+           "together (claim-K micro-batching); 0 = auto-size each "
+           "claim to local admission headroom, 1 = single-claim."),
+        _v("VRPMS_DEPTH_MEMO_MS", "float", 250.0,
+           "Shared-queue depth memo TTL for the 429/readiness paths "
+           "(bounded staleness instead of a store round trip per "
+           "request); 0 reads the store every time."),
         _v("VRPMS_REPLICA_ID", "str", None,
            "Stable replica identity (set to the pod/host name so "
            "restarts keep their ring arcs); unset generates one."),

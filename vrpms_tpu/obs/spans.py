@@ -68,6 +68,7 @@ KNOWN_SPAN_NAMES = frozenset({
     "solver.polish",    # post-solve local-search polish
     "finish",           # decode + response assembly
     "dist.execute",     # distributed-queue claim-side execution
+    "dist.claim_batch",  # how this job's store claim was assembled
     "store.read",       # table reads on the request path
     "store.persist",    # solution/warm-start persistence
     "store.persist_job",  # terminal job-record persistence

@@ -45,6 +45,19 @@ def gather_batch(
     batch = [first]
     if first.bucket is None or max_batch <= 1:
         return batch
+    # a job from an already-assembled store claim (sched.replica) knows
+    # how many same-bucket mates were submitted at or after it (hints
+    # descend through the claim group): once they are all here there is
+    # nothing left of ITS assembly to wait for, so sleeping out the
+    # window would be dead latency (it still bounds the wait when a
+    # hinted mate is late or died before reaching the queue). A
+    # leftover group left behind by a max_batch-capped launch leads
+    # with its own remaining count, so it never waits for members that
+    # already launched. Deliberate tradeoff: a later claim ROUND could
+    # still deliver same-bucket work inside the window, but coalescing
+    # across rounds is claim-K's job at the store — the fleet contract
+    # (ISSUE 11) prices per-job window latency above that long shot.
+    hint = getattr(first, "batch_hint", 0) or 0
     deadline = time.monotonic() + max(window_s, 0.0)
     while len(batch) < max_batch:
         taken = queue.take_matching(first.bucket, max_batch - len(batch))
@@ -54,6 +67,8 @@ def gather_batch(
                 on_take(batch)
         if len(batch) >= max_batch:
             break
+        if hint and len(batch) >= hint:
+            break  # the assembled set is complete
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break

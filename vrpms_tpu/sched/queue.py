@@ -83,6 +83,16 @@ class Job:
     sink: typing.Any = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # fleet-wide micro-batching: this job plus the number of same-bucket
+    # mates submitted AFTER it from ONE already-assembled store claim
+    # (sched.replica assigns G, G-1, ..., 1 through the group). The
+    # gather window treats the set as pre-assembled: whichever member
+    # leads a gather stops waiting the moment its hint is satisfied —
+    # including the first leftover after a max_batch-capped launch
+    # consumed its elders — and a hint of 1 means no batch-mate can
+    # arrive, so the window is skipped entirely. 0 = a normal local
+    # submit (window applies).
+    batch_hint: int = 0
     # supervision: True once the watchdog re-admitted this job after a
     # worker crash — the SECOND crash fails it instead (at-most-one
     # requeue keeps a poison job from crash-looping the worker forever)

@@ -14,6 +14,12 @@ schedulers into one deployment:
     its arc is empty does it steal off-arc work, so a hot replica never
     idles while peers drown, but routing holds whenever there is a
     choice;
+  * **claim-K batching** — each claim leases up to K same-ring-token
+    entries in ONE conditional update (store.claim_batch; K clamps to
+    local admission headroom) and submits the set together with batch
+    hints, so the worker's gather window assembles it into one vmapped
+    launch instead of a single-claim fleet's K sequential round trips;
+    leases stay per entry, so crash semantics are unchanged;
   * **lease lifecycle** — every claimed job is executed under a
     heartbeat-renewed lease; completion acks conditionally (a replica
     that lost its lease must NOT publish the job's terminal record —
@@ -66,6 +72,7 @@ class Replica:
         max_attempts: int = 2,
         steal: bool = True,
         vnodes: int = 64,
+        claim_batch: int = 0,
     ):
         self.store = store
         self.replica_id = replica_id
@@ -82,6 +89,12 @@ class Replica:
         self.max_attempts = max(1, int(max_attempts))
         self.steal = steal
         self.vnodes = vnodes
+        # claim-K ceiling: how many same-ring-token entries one claim
+        # may lease together (store.base.JobQueueStore.claim_batch).
+        # <= 0 = auto: size each claim to the local admission headroom
+        # (max_inflight minus current leases), so a claim can never
+        # overfill this box; 1 = the pre-batching single-claim loop.
+        self.claim_batch = int(claim_batch)
         self._halt = threading.Event()
         self._stopping = False  # drain mode: ack/renew, claim nothing
         self._thread: threading.Thread | None = None
@@ -93,6 +106,10 @@ class Replica:
         self._ring: HashRing | None = None  # guarded-by: _lock
         # EWMA of per-job service seconds (shared-depth Retry-After)
         self._job_seconds = 1.0  # guarded-by: _lock
+        # decayed per-ring-token claim counter: which tiers the ring
+        # actually routes here, hottest first — the arc-weighted warmup
+        # order (service.warmup) reads it via claim_mix()
+        self._claim_mix: dict[str, float] = {}  # guarded-by: _lock
         self._backoff_until = 0.0
 
     # -- lifecycle ----------------------------------------------------------
@@ -143,6 +160,40 @@ class Replica:
     def job_seconds_ewma(self) -> float:
         with self._lock:
             return self._job_seconds
+
+    #: claim-mix decay per claim round and the key-count bound: recent
+    #: traffic dominates (≈ the last ~50 claims) and the counter can
+    #: never grow with tier-space cardinality
+    MIX_DECAY = 0.98
+    MIX_KEYS = 32
+
+    def claim_mix(self) -> dict[str, float]:
+        """Decayed claim counts by ring token, hot tiers first — what
+        this replica has actually been leased lately (arc-weighted
+        warmup orders the tier ladder by it)."""
+        with self._lock:
+            return dict(
+                sorted(
+                    self._claim_mix.items(),
+                    key=lambda kv: kv[1],
+                    reverse=True,
+                )
+            )
+
+    def _note_claims(self, entries: list) -> None:
+        with self._lock:
+            for key in self._claim_mix:
+                self._claim_mix[key] *= self.MIX_DECAY
+            for entry in entries:
+                token = entry.get("bucket")
+                if not token:
+                    continue
+                self._claim_mix[token] = (
+                    self._claim_mix.get(token, 0.0) + 1.0
+                )
+            while len(self._claim_mix) > self.MIX_KEYS:
+                coldest = min(self._claim_mix, key=self._claim_mix.get)
+                del self._claim_mix[coldest]
 
     def ring(self) -> HashRing | None:
         """Latest membership snapshot this replica derived (readiness)."""
@@ -301,75 +352,125 @@ class Replica:
             pass
 
     def _claim_one(self) -> bool:
+        """Claim up to K same-token entries in one conditional update,
+        materialize them all, then submit the set together with batch
+        hints so the worker's gather treats it as an already-assembled
+        batch — one vmapped launch where a single-claim fleet would pay
+        K device round trips. K is the claim-K ceiling clamped to local
+        admission headroom (a claim can never overfill this box); the
+        per-entry lease lifecycle is untouched, so a crash mid-batch
+        re-queues exactly the unfinished members."""
         if self._stopping:
             return False
         with self._lock:
-            room = len(self._inflight) < self.max_inflight
+            room = self.max_inflight - len(self._inflight)
             ring = self._ring
-        if not room:
+        if room <= 0:
             return False
+        k = room if self.claim_batch <= 0 else min(self.claim_batch, room)
         if ring is None:
             ring = self._refresh_ring()
             if ring is None:
                 return False
         arcs = ring.arcs(self.replica_id)
-        entry = None
+        entries: list = []
         stolen = False
         try:
-            entry = self.store.claim(self.replica_id, self.lease_s, arcs)
-            if entry is None and self.steal:
+            entries = self.store.claim_batch(
+                self.replica_id, self.lease_s, k, arcs
+            )
+            if not entries and self.steal:
                 # own arc empty: steal ANY queued work — affinity is a
                 # preference, idle capacity is waste
-                entry = self.store.claim(self.replica_id, self.lease_s, None)
-                stolen = entry is not None
+                entries = self.store.claim_batch(
+                    self.replica_id, self.lease_s, k, None
+                )
+                stolen = bool(entries)
         except Exception as exc:
             self._store_error("claim", exc)
             return False
-        if entry is None:
+        if not entries:
             return False
-        entry["_renew_mono"] = time.monotonic() + self.lease_s / 2.0
-        self._emit(
-            "claim",
-            jobId=entry.get("id"),
-            kind="steal" if stolen else "own",
-            attempt=entry.get("attempt"),
-            slot=entry.get("slot"),
-        )
-        try:
-            job = self._materialize(entry)
-        except Exception as exc:
-            # materialize must not raise; if it does, fail the entry
-            # clean rather than leave the lease to expire into a
-            # pointless second attempt of a job that cannot build
-            job = Job(payload={})
-            job.id = str(entry.get("id"))
-            job.errors = [{
-                "what": "Scheduler error",
-                "reason": f"materialize failed: {type(exc).__name__}: {exc}",
-            }]
-            job.finish(FAILED)
-        if job.done_event.is_set():
-            # born terminal (cache hit, trivial, or failed to build):
-            # nothing to schedule — ack and publish right here
-            acked = False
+        kind = "steal" if stolen else "own"
+        self._note_claims(entries)
+        self._emit("claim_batch", size=len(entries), kind=kind)
+        now = time.monotonic()
+        jobs: list[tuple[Job, dict]] = []
+        for entry in entries:
+            entry["_renew_mono"] = now + self.lease_s / 2.0
+            # the materialized job's trace records how it was claimed
+            entry["_claim_batch"] = len(entries)
+            entry["_claim_kind"] = kind
+            self._emit(
+                "claim",
+                jobId=entry.get("id"),
+                kind=kind,
+                attempt=entry.get("attempt"),
+                slot=entry.get("slot"),
+                batch=len(entries),
+            )
             try:
-                acked = self.store.ack(self.replica_id, job.id)
+                job = self._materialize(entry)
             except Exception as exc:
-                self._store_error("ack", exc)
-            self._finish(job, entry, acked)
-            return True
-        try:
-            self._submit(job)
-        except QueueFull:
-            # local admission full: hand the entry back untouched (no
-            # attempt burned) and back off — a peer with room takes it
+                # materialize must not raise; if it does, fail the
+                # entry clean rather than leave the lease to expire
+                # into a pointless second attempt of a job that cannot
+                # build
+                job = Job(payload={})
+                job.id = str(entry.get("id"))
+                job.errors = [{
+                    "what": "Scheduler error",
+                    "reason": (
+                        f"materialize failed: {type(exc).__name__}: {exc}"
+                    ),
+                }]
+                job.finish(FAILED)
+            jobs.append((job, entry))
+        # pre-assembly hints by LOCAL bucket: same-claim entries share a
+        # ring token but may split into different launch buckets (budget
+        # variants). Hints DESCEND through each group (G, G-1, ..., 1):
+        # a member's hint counts itself plus the mates submitted AFTER
+        # it, so whichever member leads a gather — the group's first, or
+        # the first leftover after a max_batch-capped launch consumed
+        # the rest — knows exactly how many same-claim jobs can still
+        # arrive and never sleeps out the window waiting for members
+        # that already launched.
+        counts: dict = {}
+        for job, _ in jobs:
+            if not job.done_event.is_set() and job.bucket is not None:
+                counts[job.bucket] = counts.get(job.bucket, 0) + 1
+        progressed = False
+        for job, entry in jobs:
+            if job.done_event.is_set():
+                # born terminal (cache hit, trivial, or failed to
+                # build): nothing to schedule — ack and publish here
+                acked = False
+                try:
+                    acked = self.store.ack(self.replica_id, job.id)
+                except Exception as exc:
+                    self._store_error("ack", exc)
+                self._finish(job, entry, acked)
+                progressed = True
+                continue
+            job.batch_hint = counts.get(job.bucket, 0)
+            if job.bucket is not None:
+                counts[job.bucket] -= 1
             try:
-                self.store.nack(self.replica_id, job.id)
-            except Exception as exc:
-                self._store_error("nack", exc)
-            self._emit("nack", jobId=job.id)
-            self._backoff_until = time.monotonic() + 5 * self.poll_s
-            return False
-        with self._lock:
-            self._inflight[job.id] = (job, entry, False)
-        return True
+                self._submit(job)
+            except QueueFull:
+                # local admission full: hand the entry back untouched
+                # (no attempt burned) and back off — a peer with room
+                # takes it. Batch-mates already submitted keep running;
+                # their gather hint is bounded by the window, so a
+                # nacked mate costs latency, never a hang.
+                try:
+                    self.store.nack(self.replica_id, job.id)
+                except Exception as exc:
+                    self._store_error("nack", exc)
+                self._emit("nack", jobId=job.id)
+                self._backoff_until = time.monotonic() + 5 * self.poll_s
+                continue
+            with self._lock:
+                self._inflight[job.id] = (job, entry, False)
+            progressed = True
+        return progressed
