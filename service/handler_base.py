@@ -22,7 +22,7 @@ from service.helpers import (
     success,
     too_busy,
 )
-from service.jobs import scheduler_solve
+from service.jobs import job_qos_class, note_shed, scheduler_solve
 from service.obs import (
     SCHED_REJECTS,
     RequestObsMixin,
@@ -123,8 +123,18 @@ class SolveHandler(RequestObsMixin, BaseHTTPRequestHandler):
                 locations, durations, errors, database,
             )
         except QueueFull as e:
-            SCHED_REJECTS.labels(reason="queue_full").inc()
-            too_busy(self, e.retry_after_s)
+            # QuotaExceeded subclasses QueueFull: a tenant-quota shed
+            # rides the same 429 surface, with its own reason text and
+            # shed-counter label
+            reason = getattr(e, "reason", None)
+            SCHED_REJECTS.labels(
+                reason="tenant_quota" if reason else "queue_full"
+            ).inc()
+            note_shed(
+                "tenant_quota" if reason else "queue_full",
+                job_qos_class(opts),
+            )
+            too_busy(self, e.retry_after_s, reason=reason)
             return
         if result is None or len(errors) > 0:
             fail(self, errors)

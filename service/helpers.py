@@ -113,8 +113,10 @@ def fail(handler: BaseHTTPRequestHandler, errors):
     handler.wfile.write(json.dumps(response).encode("utf-8"))
 
 
-def too_busy(handler: BaseHTTPRequestHandler, retry_after_s: float):
-    """Backpressure response: 429 + Retry-After (admission queue full).
+def too_busy(handler: BaseHTTPRequestHandler, retry_after_s: float,
+             reason: str | None = None):
+    """Backpressure response: 429 + Retry-After (admission queue full,
+    or — `reason` given — another QoS shed such as a per-tenant quota).
 
     The scheduler's whole point is that overload sheds IMMEDIATELY with
     a machine-readable retry hint instead of accepting work that would
@@ -135,7 +137,8 @@ def too_busy(handler: BaseHTTPRequestHandler, retry_after_s: float):
         "errors": [
             {
                 "what": "Too busy",
-                "reason": "solver admission queue is full; retry after the "
+                "reason": reason
+                or "solver admission queue is full; retry after the "
                 "Retry-After interval",
             }
         ],

@@ -82,6 +82,7 @@ from vrpms_tpu.sched import (
     Job,
     QueueFull,
     Scheduler,
+    qos as qos_mod,
 )
 
 _PARSERS = {
@@ -98,6 +99,131 @@ _PARSERS = {
 
 def scheduler_enabled() -> bool:
     return config.enabled("VRPMS_SCHED")
+
+
+# ---------------------------------------------------------------------------
+# QoS: priority classes, EDF deadlines, selective shed, tenant quotas
+# ---------------------------------------------------------------------------
+# The policy mechanics live in vrpms_tpu.sched.qos; this block is the
+# service-side wiring — stamping parsed requests onto Jobs/queue
+# entries, the shared policy singleton (per-class drain EWMAs price
+# every 429's Retry-After), the shed telemetry, and the in-process half
+# of tenant accounting (the store-backed half lives with the
+# distributed submit below). VRPMS_QOS=off short-circuits all of it:
+# no policy object is built, no request field is read, and every queue
+# stays the pre-QoS FIFO.
+
+
+def qos_enabled() -> bool:
+    return qos_mod.enabled()  # the ONE switch spelling (sched.qos)
+
+
+class QuotaExceeded(QueueFull):
+    """Per-tenant fairness shed: the tenant already holds its quota of
+    active jobs across the fleet (429; subclassing QueueFull keeps the
+    sync endpoints' existing backpressure catch working unchanged)."""
+
+    reason = (
+        "per-tenant concurrency quota reached; retry after the "
+        "Retry-After interval"
+    )
+
+
+def job_qos_class(opts) -> str:
+    """The request's (already-validated) priority class; standard when
+    QoS is off or the value is junk (junk was 400'd at parse — this is
+    only the belt for internal callers)."""
+    if not qos_enabled():
+        return qos_mod.DEFAULT_CLASS
+    try:
+        return qos_mod.parse_class(opts.get("qos"))
+    except ValueError:
+        return qos_mod.DEFAULT_CLASS
+
+
+def _apply_qos(job: Job, opts: dict, params: dict) -> None:
+    """Stamp a Job with its QoS fields from the parsed request: class,
+    absolute EDF deadline (submit + timeLimit budget), auth-scoped
+    tenant. No-op with QoS off — the Job defaults are the FIFO-neutral
+    values, so nothing downstream can tell QoS exists."""
+    if not qos_enabled():
+        return
+    job.qos = job_qos_class(opts)
+    job.deadline_at = qos_mod.deadline_at(job.submitted_at, job.time_limit)
+    job.tenant = qos_mod.tenant_id(params.get("auth"))
+
+
+_qos_policy_lock = threading.Lock()
+_qos_policy = None  # guarded-by: _qos_policy_lock
+
+
+def get_qos_policy():
+    """The process QoS policy singleton: attached to every local
+    JobQueue (priority pop / selective shed / free-rider gather) and
+    consulted by the admission paths for per-class Retry-After."""
+    global _qos_policy
+    with _qos_policy_lock:
+        if _qos_policy is None:
+            _qos_policy = qos_mod.QosPolicy()
+        return _qos_policy
+
+
+def note_shed(reason: str, qos_class: str) -> None:
+    """One shed, counted and traced: the vrpms_jobs_shed_total counter
+    plus a zero-width qos.shed span on the request's trace (when one is
+    active) so a 429 is visible in the waterfall, not just the
+    counter."""
+    obs.SHED_TOTAL.labels(reason=reason, qos=qos_class).inc()
+    if spans.current_trace() is not None:
+        with spans.span("qos.shed", reason=reason, qos=qos_class):
+            pass
+
+
+def _quota_retry_after(qos_class: str) -> float:
+    """Retry hint for a quota shed: roughly one of this class's own
+    jobs draining (the soonest the tenant could free a slot)."""
+    return min(max(1.0, get_qos_policy().class_seconds(qos_class)), 60.0)
+
+
+# in-process tenant accounting (the local-queue half of fairness; the
+# store-backed queue counts active entries fleet-wide instead)
+_tenant_lock = threading.Lock()
+_tenant_active: dict[str, int] = {}  # guarded-by: _tenant_lock
+
+
+def _tenant_admit(job: Job) -> bool:
+    """Atomically claim a quota slot for the job's tenant; False means
+    the quota is spent and the submit must shed. Anonymous jobs (and
+    QoS off / quota 0) always admit."""
+    quota = qos_mod.tenant_quota() if qos_enabled() else 0
+    if quota <= 0 or job.tenant is None:
+        return True
+    with _tenant_lock:
+        if _tenant_active.get(job.tenant, 0) >= quota:
+            return False
+        _tenant_active[job.tenant] = _tenant_active.get(job.tenant, 0) + 1
+        job._tenant_counted = True
+    return True
+
+
+def _tenant_release(job: Job) -> None:
+    """Return the job's quota slot (idempotent: terminal-event and
+    submit-failure paths may both call it)."""
+    with _tenant_lock:
+        if not getattr(job, "_tenant_counted", False):
+            return
+        job._tenant_counted = False
+        tenant = job.tenant
+        n = _tenant_active.get(tenant, 0) - 1
+        if n > 0:
+            _tenant_active[tenant] = n
+        else:
+            _tenant_active.pop(tenant, None)
+
+
+def _tenant_map() -> dict:
+    with _tenant_lock:
+        return dict(_tenant_active)
 
 
 # ---------------------------------------------------------------------------
@@ -603,9 +729,15 @@ def _on_event(name: str, job: Job) -> None:
                 job.queue_wait_s,
                 trace_id=job.trace.trace_id if job.trace else None,
             )
+            # the per-class view: with QoS off every job is standard,
+            # so the series stays one-dimensional
+            obs.QOS_QUEUE_WAIT.labels(qos=job.qos).observe(
+                job.queue_wait_s
+            )
         obs.SCHED_BATCH_SIZE.observe(job.batch_size or 1)
     elif name == "expired":
         obs.SCHED_REJECTS.labels(reason="deadline_spent").inc()
+        note_shed("deadline_exhausted", job.qos)
         obs.JOBS_TOTAL.labels(outcome="failed").inc()
     elif name == "drained":
         obs.SCHED_REJECTS.labels(reason="shutdown").inc()
@@ -639,6 +771,10 @@ def _on_event(name: str, job: Job) -> None:
         ),
     )
     terminal = name in ("done", "failed", "expired", "crashed", "drained")
+    if terminal:
+        # fairness bookkeeping: the tenant's quota slot frees the
+        # moment the job is terminal, whatever path got it there
+        _tenant_release(job)
     if terminal and job.trace is not None and job.trace.deferred:
         # finish BEFORE the terminal persist: once a poll can read the
         # job as done, GET /api/debug/traces/{traceId} must find the
@@ -709,6 +845,9 @@ def get_scheduler() -> Scheduler:
                 watchdog_s=config.get("VRPMS_SCHED_WATCHDOG_MS") / 1e3,
                 wedge_grace_s=config.get("VRPMS_SCHED_WEDGE_GRACE_S"),
                 on_worker_event=_on_worker_event,
+                # QoS: priority pop + selective shed + free-rider
+                # gather on every backend queue; off = plain FIFO
+                queue_policy=get_qos_policy() if qos_enabled() else None,
             )
             obs.set_queue_depth_provider(_queue_depths)
         return _scheduler
@@ -728,9 +867,13 @@ def shutdown_scheduler() -> int:
         r.stop(drain_s=config.get("VRPMS_REPLICA_DRAIN_S"))
     global _replica_id_cached
     _replica_id_cached = None  # a rebuilt service re-reads the env
-    global _depth_memo
     with _depth_lock:
-        _depth_memo = None  # a rebuilt service re-reads its own queue
+        _memos.clear()  # a rebuilt service re-reads its own queue
+    global _qos_policy
+    with _qos_policy_lock:
+        _qos_policy = None  # fresh per-class drain EWMAs on rebuild
+    with _tenant_lock:
+        _tenant_active.clear()
     with _sched_lock:
         s, _scheduler = _scheduler, None
         if s is not None:
@@ -814,29 +957,64 @@ def _dist_depth_provider() -> int:
 # caps that at ~1/TTL store reads per replica under any load — bounded
 # staleness on a signal that is only ever a load-shedding heuristic.
 _depth_lock = threading.Lock()
-_depth_memo: tuple[float, int] | None = None  # guarded-by: _depth_lock
+# one memo slot per store signal: "depth" (the 429 bound + readiness),
+# "tenants" (quota accounting + readiness — the full map is one scan,
+# so memoizing it caps cost regardless of tenant count), "classes"
+# (readiness' per-class view). All share the VRPMS_DEPTH_MEMO_MS TTL.
+_memos: dict[str, tuple[float, object]] = {}  # guarded-by: _depth_lock
 
 
-def _shared_depth(qs) -> int | None:
-    """The shared queue's depth through the short-TTL memo
-    (VRPMS_DEPTH_MEMO_MS; 0 = read through). None when the store is
-    unreadable AND no fresh memo exists — callers choose their fallback
-    (admission: don't block; readiness: omit the field)."""
-    global _depth_memo
+def _memo_read(name: str, fetch):
+    """Short-TTL memoized store read (VRPMS_DEPTH_MEMO_MS; 0 = read
+    through). `fetch()` may raise or return None — both mean unknown,
+    are NOT memoized, and return None so callers fail open."""
     ttl = config.get("VRPMS_DEPTH_MEMO_MS") / 1e3
     now = time.monotonic()
     if ttl > 0:
         with _depth_lock:
-            memo = _depth_memo
+            memo = _memos.get(name)
         if memo is not None and now - memo[0] < ttl:
             return memo[1]
     try:
-        depth = qs.depth()
+        value = fetch()
     except Exception:
         return None
+    if value is None:
+        return None
     with _depth_lock:
-        _depth_memo = (now, depth)
-    return depth
+        _memos[name] = (now, value)
+    return value
+
+
+def _shared_depth(qs) -> int | None:
+    """The shared queue's depth through the short-TTL memo. None when
+    the store is unreadable AND no fresh memo exists — callers choose
+    their fallback (admission: don't block; readiness: omit the
+    field)."""
+    return _memo_read("depth", qs.depth)
+
+
+def _tenant_shared_map(qs) -> dict | None:
+    """The shared queue's {tenant: active entries} map (quota checks
+    AND the readiness probe read it). None = unknown (store
+    unreadable, or a backend predating tenant fields) — callers must
+    fail open."""
+    return _memo_read("tenants", qs.tenant_depths)
+
+
+def _tenant_shared_depth(qs, tenant: str) -> int | None:
+    """This tenant's ACTIVE (queued + leased) entries in the shared
+    queue; None = unknown (quota checks fail open)."""
+    depths = _tenant_shared_map(qs)
+    return None if depths is None else depths.get(tenant, 0)
+
+
+def _shared_class_depths(qs) -> dict | None:
+    """The shared queue's {class: queued} map (readiness-only; on the
+    hosted store each refresh costs one count query per class). None =
+    unreadable or predates the QoS columns — the probe omits the
+    field."""
+    return _memo_read("classes", qs.depth_by_class)
 
 
 def _dist_event(name: str, replicaId: str | None = None, **kw) -> None:
@@ -904,6 +1082,18 @@ def _materialize_entry(entry: dict, rid: str | None = None) -> Job:
         request_id=payload.get("requestId"),
     )
     job.id = str(entry.get("id") or job.id)
+    # claimed entries already passed the SHARED admission bound at
+    # submit: the local class-fraction shed must not bounce them back
+    # to the store (claim/nack livelock); only the hard bound applies
+    job.preadmitted = True
+    if qos_enabled():
+        # the entry's claim-ordering fields become the local job's:
+        # the leasing replica's queue applies the same class/EDF rule
+        # the store claim just did
+        cls = entry.get("qos")
+        job.qos = cls if cls in qos_mod.RANK else qos_mod.DEFAULT_CLASS
+        job.deadline_at = entry.get("deadline_at")
+        job.tenant = entry.get("tenant")
     if payload.get("resolvedFrom"):
         job.payload["resolved_from"] = payload["resolvedFrom"]
     if entry.get("submitted_at"):
@@ -937,10 +1127,43 @@ def _materialize_entry(entry: dict, rid: str | None = None) -> Job:
                 s.set(
                     size=entry["_claim_batch"],
                     kind=entry.get("_claim_kind"),
+                    qos=job.qos,
+                    deadlineAt=job.deadline_at,
                 )
                 s.end()
             trace.deferred = True
             job.trace, job.span = trace, root
+    if (
+        qos_enabled()
+        and job.time_limit
+        and job.time_limit > 0
+        and entry.get("submitted_at")
+    ):
+        # stale-deadline fast-fail: a claimed job whose whole budget
+        # was spent waiting in the shared queue dies HERE, with the
+        # clean envelope — before parse/prepare would burn an instance
+        # build and a compiled launch on a solve doomed to time out
+        # (the local worker's expiry check fires after those). The
+        # replica acks it as born-terminal and publishes the record.
+        waited = time.time() - float(entry["submitted_at"])
+        if waited >= float(job.time_limit):
+            note_shed("deadline_exhausted", job.qos)
+            log_event(
+                "dist.deadline_exhausted",
+                jobId=job.id,
+                waitedMs=round(waited * 1e3, 2),
+                timeLimit=job.time_limit,
+            )
+            job.errors = [{
+                "what": "Deadline exceeded",
+                "reason": (
+                    f"deadline exhausted: job waited {waited:.3f}s in "
+                    f"the shared queue, past its timeLimit of "
+                    f"{job.time_limit}s — not launching a doomed solve"
+                ),
+            }]
+            job.finish(FAILED)
+            return job
     token = set_request_id(job.request_id)
     span_tokens = (
         spans.activate(job.trace, job.span)
@@ -1151,11 +1374,28 @@ def _submit_distributed(handler, ctx, job: Job, prep, resolve_from=None):
     depth = _shared_depth(qs)
     if depth is None:
         depth = 0  # unreadable depth must not block admits
-    if depth >= limit * members:
-        retry_after = min(
-            max(1.0, depth * replica.job_seconds_ewma() / members), 60.0
-        )
+    # selective shed: each class admits up to ITS fraction of the
+    # fleet bound, so as the shared backlog grows batch 429s first,
+    # then standard, and interactive keeps the full bound — with
+    # Retry-After priced from the shed class's OWN observed drain.
+    # A POSITIVE bound floors each class at 1 (a tiny bound must not
+    # lock a class out entirely); a ZERO bound keeps its pre-QoS
+    # shed-everything meaning.
+    bound = limit * members
+    if qos_enabled() and bound > 0:
+        bound = max(1, int(bound * qos_mod.shed_fraction(job.qos)))
+    if depth >= bound:
+        if qos_enabled():
+            retry_after = get_qos_policy().retry_after(
+                job.qos, depth, drains=members
+            )
+        else:
+            retry_after = min(
+                max(1.0, depth * replica.job_seconds_ewma() / members),
+                60.0,
+            )
         obs.SCHED_REJECTS.labels(reason="queue_full").inc()
+        note_shed("queue_full", job.qos)
         obs.JOBS_TOTAL.labels(outcome="failed").inc()
         job.errors = [{
             "what": "Too busy",
@@ -1165,6 +1405,30 @@ def _submit_distributed(handler, ctx, job: Job, prep, resolve_from=None):
         _persist(job)
         too_busy(self, retry_after)
         return
+    if qos_enabled() and job.tenant is not None:
+        # fleet-wide fairness: count the tenant's ACTIVE (queued +
+        # leased) entries in the shared queue — accounting every
+        # replica's work, not just ours. Unreadable counts fail open:
+        # a store blip must not lock tenants out.
+        quota = qos_mod.tenant_quota()
+        active = (
+            _tenant_shared_depth(qs, job.tenant) if quota > 0 else None
+        )
+        if active is not None and active >= quota:
+            obs.SCHED_REJECTS.labels(reason="tenant_quota").inc()
+            note_shed("tenant_quota", job.qos)
+            obs.JOBS_TOTAL.labels(outcome="failed").inc()
+            job.errors = [{
+                "what": "Too busy",
+                "reason": QuotaExceeded.reason,
+            }]
+            job.finish(FAILED)
+            _persist(job)
+            too_busy(
+                self, _quota_retry_after(job.qos),
+                reason=QuotaExceeded.reason,
+            )
+            return
     token = ring_token(ctx["problem"], prep.inst)
     payload = {
         "content": ctx["content"],
@@ -1188,6 +1452,14 @@ def _submit_distributed(handler, ctx, job: Job, prep, resolve_from=None):
         "submitted_at": job.submitted_at,
         "payload": payload,
     }
+    if qos_enabled():
+        # claim-ordering fields (store.base contract): class + EDF
+        # deadline sort claims, tenant feeds fleet-wide quota
+        # accounting. Written ONLY with QoS on, so off-path entries
+        # stay byte-identical to pre-QoS ones.
+        entry["qos"] = job.qos
+        entry["deadline_at"] = job.deadline_at
+        entry["tenant"] = job.tenant
     _persist(job)  # queued record first: a poll can never 404 a jobId
     # this 202 is about to hand out
     try:
@@ -1253,8 +1525,18 @@ def scheduler_solve(problem, algorithm, params, opts, algo_params,
         trace=spans.current_trace(),
         span=spans.current_span(),
     )
-    get_scheduler().submit(job, backend=_backend_label(opts))
-    job.wait()
+    _apply_qos(job, opts, params)
+    if not _tenant_admit(job):
+        # fairness shed: the handler's QueueFull catch answers 429
+        # with the quota reason + this class's drain-rate retry hint
+        raise QuotaExceeded(0, _quota_retry_after(job.qos))
+    try:
+        get_scheduler().submit(job, backend=_backend_label(opts))
+        job.wait()
+    finally:
+        # terminal events release too; this covers submit-time
+        # QueueFull (the job never reached the scheduler) idempotently
+        _tenant_release(job)
     if job.status == FAILED or job.result is None:
         errors += job.errors or [
             {"what": "Solver error", "reason": "job failed without detail"}
@@ -1439,6 +1721,7 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
         trace=self._trace,
         span=self._trace_root,
     )
+    _apply_qos(job, opts, params)
     if prep.trivial is not None or prep.cached is not None:
         # nothing to schedule: the job is born done (a trivial
         # zero-customer request, or an exact cache hit — the cached
@@ -1461,8 +1744,24 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
         # store-backed shared queue: enqueue the REQUEST (not the
         # prepared instance) so any replica can lease, rebuild, and
         # solve it — the claim path re-runs this exact parse/prepare
-        # on the leasing replica (_materialize_entry)
+        # on the leasing replica (_materialize_entry). Fairness there
+        # is store-accounted (every replica's active entries count),
+        # so the in-process quota ledger below is not consulted.
         _submit_distributed(self, ctx, job, prep, resolve_from)
+        return
+    if not _tenant_admit(job):
+        # per-tenant fairness shed (local fleet = this process):
+        # answered like a queue-full 429, but with the quota reason
+        # and this class's own drain-rate retry hint
+        obs.SCHED_REJECTS.labels(reason="tenant_quota").inc()
+        note_shed("tenant_quota", job.qos)
+        obs.JOBS_TOTAL.labels(outcome="failed").inc()
+        job.errors = [{"what": "Too busy", "reason": QuotaExceeded.reason}]
+        job.finish(FAILED)
+        _persist(job)
+        too_busy(
+            self, _quota_retry_after(job.qos), reason=QuotaExceeded.reason
+        )
         return
     # live-progress mailbox + registry entry BEFORE the submit: the
     # worker may pop the job the instant it lands, and the runner
@@ -1483,7 +1782,9 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
         if job.sink is not None:
             job.sink.close("failed")
         _drop_live(job.id)
+        _tenant_release(job)  # never scheduled: free the quota slot
         obs.SCHED_REJECTS.labels(reason="queue_full").inc()
+        note_shed("queue_full", job.qos)
         obs.JOBS_TOTAL.labels(outcome="failed").inc()
         job.errors = [{
             "what": "Too busy",
@@ -1502,6 +1803,7 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
         if job.sink is not None:
             job.sink.close("failed")
         _drop_live(job.id)
+        _tenant_release(job)
         raise
     resp = {"success": True, "jobId": job.id, "status": job.status}
     if resolve_from:
@@ -1973,6 +2275,35 @@ def readiness() -> tuple[int, dict]:
         except Exception:
             info["tiersWarmed"] = []
         body["replica"] = info
+    if qos_enabled():
+        # the QoS operator view alongside the replica block: who is
+        # queued by class (local admission queues; plus the SHARED
+        # queue's per-class depth on the store path) and which tenants
+        # hold how much in-flight work — i.e. who is being shed and why
+        classes = {name: 0 for name in qos_mod.CLASSES}
+        if s is not None:
+            for depths in s.queues_by_class().values():
+                for cls, n in depths.items():
+                    classes[cls] = classes.get(cls, 0) + n
+        qinfo: dict = {"queued": classes}
+        tenants = _tenant_map()
+        if dist_queue_enabled():
+            rep = _replica
+            if rep is not None:
+                # memoized (VRPMS_DEPTH_MEMO_MS): probes at LB cadence
+                # must not add store round trips each; a store blip
+                # omits the fields rather than failing readiness
+                shared = _shared_class_depths(rep.store)
+                if shared is not None:
+                    qinfo["sharedQueued"] = shared
+                fleet_tenants = _tenant_shared_map(rep.store)
+                if fleet_tenants is not None:
+                    # the fleet-wide map (what quotas actually divide
+                    # by) supersedes the process-local ledger
+                    tenants = fleet_tenants
+        qinfo["tenants"] = tenants
+        qinfo["tenantQuota"] = qos_mod.tenant_quota() or None
+        body["qos"] = qinfo
     return (503 if status == "down" else 200), body
 
 

@@ -85,10 +85,27 @@ SCHED_BATCH_SIZE = REGISTRY.histogram(
     "Jobs merged into one scheduler launch (1 = solo)",
     buckets=(1, 2, 4, 8, 16, 32, 64),
 )
+QOS_QUEUE_WAIT = REGISTRY.histogram(
+    "vrpms_qos_queue_wait_seconds",
+    "Time jobs spent queued before their solve started, by QoS class "
+    "(the per-class view of vrpms_sched_queue_wait_seconds — under "
+    "overload interactive should stay in the low buckets while batch "
+    "absorbs the wait)",
+    labels=("qos",),
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0),
+)
+SHED_TOTAL = REGISTRY.counter(
+    "vrpms_jobs_shed_total",
+    "Requests shed without solving, by reason (queue_full = admission "
+    "bound / class shed fraction reached, tenant_quota = per-tenant "
+    "fairness quota, deadline_exhausted = the deadline budget was "
+    "already fully spent in queue wait) and QoS class",
+    labels=("reason", "qos"),
+)
 SCHED_REJECTS = REGISTRY.counter(
     "vrpms_sched_rejected_total",
     "Jobs the scheduler refused or failed without solving, by reason "
-    "(queue_full|deadline_spent|shutdown)",
+    "(queue_full|deadline_spent|shutdown|tenant_quota)",
     labels=("reason",),
 )
 JOBS_TOTAL = REGISTRY.counter(

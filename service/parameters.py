@@ -10,6 +10,7 @@ selector, all defaulted so omitting them reproduces reference behavior.
 from __future__ import annotations
 
 from service.helpers import get_parameter
+from vrpms_tpu.sched import qos as qos_mod
 
 
 def parse_common_vrp_parameters(content: dict, errors):
@@ -155,8 +156,26 @@ def parse_solver_options(content: dict, errors):
     migrants:           elites sent to the ring neighbor (default 4;
                         SA/GA only — ACO islands always exchange
                         exactly their one incumbent genome)
+    qos:                request priority class for the deadline-aware
+                        scheduler: "interactive" | "standard" (the
+                        default) | "batch". Higher classes pop first,
+                        earliest-deadline-first within a class (the
+                        deadline is timeLimit's budget measured from
+                        submit), and under overload the lowest class
+                        sheds (429) first. Ignored (any value) when
+                        VRPMS_QOS=off
     """
+    qos_value = get_parameter("qos", content, errors, optional=True)
+    if qos_mod.enabled() and qos_value is not None:
+        # junk classes are 400 Data errors — but only with QoS on:
+        # the off switch must treat 'qos' like any other unknown key
+        # (ignored), keeping pre-QoS responses byte-identical
+        try:
+            qos_value = qos_mod.parse_class(qos_value)
+        except ValueError as e:
+            errors += [{"what": "Data error", "reason": str(e)}]
     return {
+        "qos": qos_value,
         "backend": get_parameter("backend", content, errors, optional=True),
         "seed": get_parameter("seed", content, errors, optional=True),
         "iteration_count": get_parameter("iterationCount", content, errors, optional=True),

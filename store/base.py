@@ -129,7 +129,9 @@ class JobQueueStore:
     Entries are plain JSON-able dicts:
 
         {"id", "slot", "bucket", "state", "attempt", "lease_owner",
-         "lease_expires_at", "submitted_at", "time_limit", "payload"}
+         "lease_expires_at", "submitted_at", "time_limit", "payload",
+         # QoS claim-ordering fields (all optional; absent = FIFO):
+         "qos", "deadline_at", "tenant"}
 
     `slot` is the consistent-hash ring position of the job's tier key
     (vrpms_tpu.sched.ring.slot) — precomputed at enqueue so backends
@@ -138,6 +140,20 @@ class JobQueueStore:
     the original request content + trace context so ANY replica can
     rebuild and solve the job). Clocks are epoch seconds (time.time) —
     comparable across processes, unlike monotonic clocks.
+
+    **Claim ordering (QoS extension).** When entries carry the
+    claim-ordering fields (`qos` = interactive|standard|batch,
+    `deadline_at` = absolute epoch deadline), `claim`/`claim_batch`
+    MUST serve the highest class first and earliest-deadline-first
+    within a class (vrpms_tpu.sched.qos.entry_order_key; no deadline
+    sorts last in its class, ties stay FIFO), and `claim_batch`'s
+    mates follow the free-rider rule: same-class mates fill first,
+    lower classes top off a launch, a same-class mate is never
+    displaced (sched.qos.select_mates). Entries without the fields —
+    including everything written with VRPMS_QOS=off — order exactly
+    as before: pure FIFO. Backends that predate the ordering columns
+    keep working through the base-class fallbacks below (FIFO claims,
+    None depth maps), mirroring the `claim_batch` fallback.
     """
 
     #: default ceiling on completed-claim generations: attempt 0 is the
@@ -207,6 +223,20 @@ class JobQueueStore:
     def depth(self) -> int:
         """QUEUED (unleased) entries — the shared backpressure signal."""
         raise NotImplementedError
+
+    def depth_by_class(self) -> dict | None:
+        """{qos class: queued count} for the readiness probe's
+        per-class view. Default None = backend predates the QoS
+        columns (callers omit the field, never fail)."""
+        return None
+
+    def tenant_depths(self) -> dict | None:
+        """{tenant: active (queued + leased) entries} — the fleet-wide
+        accounting per-tenant fairness quotas divide by. Anonymous
+        entries (no tenant) are excluded: quotas only apply to
+        identified tenants. Default None = unknown (admission must not
+        block on it)."""
+        return None
 
     def register_replica(self, replica_id: str, ttl_s: float) -> None:
         """Heartbeat this replica into the ring membership."""

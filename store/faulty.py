@@ -142,6 +142,17 @@ class FaultyJobQueue(InMemoryJobQueue):
         self._injector.apply("read")
         return super().depth()
 
+    def depth_by_class(self):
+        self._injector.apply("read")
+        return super().depth_by_class()
+
+    def tenant_depths(self):
+        # quota accounting is a read; a plan that downs reads must make
+        # admission fail OPEN (the service treats None/raise as
+        # unknown), which this injection exercises
+        self._injector.apply("read")
+        return super().tenant_depths()
+
     def register_replica(self, replica_id, ttl_s):
         self._injector.apply("read")
         return super().register_replica(replica_id, ttl_s)

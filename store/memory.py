@@ -226,7 +226,11 @@ class InMemoryJobQueue(JobQueueStore):
     the one table lock makes each claim/reclaim a single atomic
     conditional update — the reference semantics the Supabase backend's
     conditional UPDATEs must match. Dicts preserve insertion order, so
-    FIFO claim order falls out of iteration."""
+    FIFO claim order falls out of iteration — and QoS claim order
+    (class rank, then EDF deadline, then arrival) falls out of a
+    stable sort over it using the entries' own ordering fields, which
+    all default to the FIFO-neutral values when absent (VRPMS_QOS=off
+    writes none, so off-path claims are bit-identical to pre-QoS)."""
 
     def _rows_locked(self) -> dict:
         return _tables["job_queue"]
@@ -236,6 +240,24 @@ class InMemoryJobQueue(JobQueueStore):
         if slots is None:
             return True
         return any(lo <= slot < hi for lo, hi in slots)
+
+    def _queued_ordered_locked(self, slots=None) -> list:
+        """QUEUED rows in claim order: class rank first, EDF within
+        class, arrival-stable (qos.entry_order_key over the insertion
+        order — all-default entries come back in pure FIFO order)."""
+        from vrpms_tpu.sched import qos
+
+        rows = [
+            row
+            for row in self._rows_locked().values()
+            if row["state"] == Q_QUEUED
+            and self._in_slots(row.get("slot", 0), slots)
+        ]
+        order = sorted(
+            range(len(rows)),
+            key=lambda i: (qos.entry_order_key(rows[i]), i),
+        )
+        return [rows[i] for i in order]
 
     def enqueue(self, entry: dict) -> None:
         row = dict(entry)
@@ -249,51 +271,104 @@ class InMemoryJobQueue(JobQueueStore):
             self._rows_locked()[str(row["id"])] = row
 
     def claim(self, owner: str, lease_s: float, slots=None) -> dict | None:
+        from vrpms_tpu.sched import qos
+
         now = time.time()
         with _lock:
-            for row in self._rows_locked().values():
-                if row["state"] != Q_QUEUED:
-                    continue
-                if not self._in_slots(row.get("slot", 0), slots):
-                    continue
-                row["state"] = Q_LEASED
-                row["lease_owner"] = owner
-                row["lease_expires_at"] = now + lease_s
-                return dict(row)
-        return None
+            # single-row claim: a stable min (arrival tie-break) gives
+            # the same winner as the full claim-order sort at O(n) —
+            # claim polls run per VRPMS_QUEUE_POLL_MS tick under the
+            # one table lock, so no whole-backlog sort here
+            rows = [
+                row
+                for row in self._rows_locked().values()
+                if row["state"] == Q_QUEUED
+                and self._in_slots(row.get("slot", 0), slots)
+            ]
+            if not rows:
+                return None
+            best = min(
+                range(len(rows)),
+                key=lambda i: (qos.entry_order_key(rows[i]), i),
+            )
+            row = rows[best]
+            row["state"] = Q_LEASED
+            row["lease_owner"] = owner
+            row["lease_expires_at"] = now + lease_s
+            return dict(row)
 
     def claim_batch(self, owner: str, lease_s: float, k: int,
                     slots=None) -> list:
-        """Claim-K-matching under the one table lock: find the oldest
-        QUEUED entry in `slots`, then sweep the remaining iteration
-        order (dict order = FIFO) for up to k-1 more QUEUED entries
-        with the SAME bucket — all leased in this one critical section,
-        which is exactly the atomicity the Supabase backend's single
-        conditional UPDATE provides."""
+        """Claim-K-matching under the one table lock: take the FIRST
+        QUEUED entry in claim order (class rank, EDF, arrival) within
+        `slots`, then fill up to k-1 more QUEUED entries sharing its
+        bucket — same-class mates first (their claim order), lower
+        classes as free riders (sched.qos.select_mates; entries
+        without QoS fields reduce to the old oldest-first sweep) — all
+        leased in this one critical section, which is exactly the
+        atomicity the Supabase backend's single conditional UPDATE
+        provides."""
+        from vrpms_tpu.sched import qos
+
         if k <= 0:
             return []
         now = time.time()
         taken: list = []
         with _lock:
-            leader_bucket = None
-            for row in self._rows_locked().values():
-                if row["state"] != Q_QUEUED:
-                    continue
-                if not taken:
-                    if not self._in_slots(row.get("slot", 0), slots):
-                        continue
-                    leader_bucket = row.get("bucket")
-                elif leader_bucket is None or row.get("bucket") != leader_bucket:
-                    # batch-mates must share the leader's ring token; a
-                    # None token never batches (the leader goes alone)
-                    continue
+            # ONE ordered sweep: the leader is the first row passing
+            # the slot filter (slots filter the leader only — the
+            # original contract), mates are same-bucket rows from the
+            # whole queue, already in claim order so select_mates'
+            # stable preference applies
+            ordered = self._queued_ordered_locked(None)
+            leader = next(
+                (
+                    row for row in ordered
+                    if self._in_slots(row.get("slot", 0), slots)
+                ),
+                None,
+            )
+            if leader is None:
+                return []
+            batch = [leader]
+            leader_bucket = leader.get("bucket")
+            if leader_bucket is not None and k > 1:
+                mates = [
+                    row
+                    for row in ordered
+                    if row is not leader
+                    and row.get("bucket") == leader_bucket
+                ]
+                batch += qos.select_mates(
+                    leader, mates, k - 1, key=qos.entry_order_key
+                )
+            for row in batch:
                 row["state"] = Q_LEASED
                 row["lease_owner"] = owner
                 row["lease_expires_at"] = now + lease_s
                 taken.append(dict(row))
-                if len(taken) >= k or leader_bucket is None:
-                    break
         return taken
+
+    def depth_by_class(self) -> dict:
+        from vrpms_tpu.sched import qos
+
+        out = {name: 0 for name in qos.CLASSES}
+        with _lock:
+            for row in self._rows_locked().values():
+                if row["state"] != Q_QUEUED:
+                    continue
+                cls = row.get("qos")
+                out[cls if cls in qos.RANK else qos.DEFAULT_CLASS] += 1
+        return out
+
+    def tenant_depths(self) -> dict:
+        depths: dict = {}
+        with _lock:
+            for row in self._rows_locked().values():
+                tenant = row.get("tenant")
+                if tenant:
+                    depths[tenant] = depths.get(tenant, 0) + 1
+        return depths
 
     def _owned_locked(self, owner: str, job_id: str):
         row = self._rows_locked().get(str(job_id))

@@ -116,6 +116,28 @@ create index if not exists jobs_queue_claim
   on jobs (queue_state, slot, updated_at)
   where queue_state is not null;
 
+-- QoS claim ordering (deadline-aware scheduling): qos_rank is the
+-- request's priority class as an integer (0=interactive, 1=standard,
+-- 2=batch; NOT NULL DEFAULT 1 so rows enqueued by pre-QoS builds or
+-- VRPMS_QOS=off peers — which write no qos columns at all — order as
+-- standard, matching the in-memory backend's reference semantics; the
+-- ALTER backfills pre-migration rows to 1 as well), deadline_at the
+-- absolute EDF deadline
+-- (submit time + the request's timeLimit budget; null = no deadline,
+-- sorts LAST within its class). Claim candidate scans order by
+--   (qos_rank asc, deadline_at asc nulls last, updated_at asc)
+-- — higher class first, earliest deadline first within class, FIFO on
+-- ties — which is exactly what the in-memory backend's sorted sweep
+-- computes under its table lock. The claimant (store/supabase_store.py
+-- SupabaseJobQueue) detects a table that predates these columns at the
+-- first failed write/scan and degrades claim order to plain FIFO, so
+-- the migration can roll out after the code.
+alter table jobs add column if not exists qos_rank integer not null default 1;
+alter table jobs add column if not exists deadline_at timestamptz;
+create index if not exists jobs_queue_claim_qos
+  on jobs (queue_state, qos_rank, deadline_at, updated_at)
+  where queue_state is not null;
+
 -- Ring membership: one heartbeat row per live replica; consistent-hash
 -- arcs are derived client-side from the live id set (sched/ring.py).
 create table if not exists replicas (

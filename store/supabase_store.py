@@ -192,6 +192,31 @@ class SupabaseJobQueue(JobQueueStore):
 
     CLAIM_CANDIDATES = 8
 
+    #: class-level latch: False once a qos_rank/deadline_at write or
+    #: ordered scan failed WITH an undefined-column error (a hosted
+    #: table predating the QoS columns in store/schema.sql) — from then
+    #: on this process enqueues and scans without them, degrading claim
+    #: order to plain FIFO instead of failing every queue op (the
+    #: claim_batch base-fallback rule applied to columns). Only a
+    #: missing-column error latches: transient failures (timeouts,
+    #: 5xx) re-raise to the caller's existing retry/backoff and must
+    #: NOT silently disable QoS for the process lifetime. Process-wide
+    #: by design: every request builds a fresh store instance, and
+    #: rediscovering the missing columns once per request would double
+    #: every op's round trips.
+    _qos_cols = True
+
+    @staticmethod
+    def _missing_qos_columns(exc: Exception) -> bool:
+        """Does this error say the QoS columns are absent? PostgREST
+        surfaces Postgres's undefined-column as code 42703 with the
+        column name in the message."""
+        text = str(exc)
+        return "42703" in text or (
+            "column" in text.lower()
+            and ("qos_rank" in text or "deadline_at" in text)
+        )
+
     def __init__(self):
         try:
             from supabase.client import create_client
@@ -241,19 +266,50 @@ class SupabaseJobQueue(JobQueueStore):
             not in ("id", "slot", "state", "attempt", "lease_owner",
                     "lease_expires_at")
         }
-        self.client.table("jobs").upsert(
-            {
-                "id": entry["id"],
-                "queue_state": Q_QUEUED,
-                "slot": int(entry.get("slot") or 0),
-                "attempt": int(entry.get("attempt") or 0),
-                "lease_owner": None,
-                "lease_expires_at": None,
-                "queue_entry": doc,
-                "updated_at": self._iso(_time.time()),
-            },
-            on_conflict="id",
-        ).execute()
+        row = {
+            "id": entry["id"],
+            "queue_state": Q_QUEUED,
+            "slot": int(entry.get("slot") or 0),
+            "attempt": int(entry.get("attempt") or 0),
+            "lease_owner": None,
+            "lease_expires_at": None,
+            "queue_entry": doc,
+            "updated_at": self._iso(_time.time()),
+        }
+        if type(self)._qos_cols and (
+            entry.get("qos") is not None
+            or entry.get("deadline_at") is not None
+        ):
+            from vrpms_tpu.sched import qos as qos_mod
+
+            row["qos_rank"] = qos_mod.rank(entry.get("qos"))
+            row["deadline_at"] = (
+                None
+                if entry.get("deadline_at") is None
+                else self._iso(float(entry["deadline_at"]))
+            )
+            try:
+                self.client.table("jobs").upsert(
+                    row, on_conflict="id"
+                ).execute()
+                return
+            except Exception as exc:
+                if not self._missing_qos_columns(exc):
+                    raise  # transient failure: the caller's problem
+                # table predates the QoS columns: latch off and fall
+                # through to the column-free upsert (FIFO ordering) —
+                # the entry's own qos/deadline_at stay readable in the
+                # queue_entry doc for when the schema catches up
+                type(self)._qos_cols = False
+                log_event(
+                    "store.qos_columns_missing",
+                    level="warn",
+                    hint="apply the qos_rank/deadline_at migration in "
+                    "store/schema.sql; claim order degrades to FIFO",
+                )
+                row.pop("qos_rank", None)
+                row.pop("deadline_at", None)
+        self.client.table("jobs").upsert(row, on_conflict="id").execute()
 
     def _candidates(self, slots, states, expired_before=None,
                     limit=None) -> list:
@@ -263,15 +319,26 @@ class SupabaseJobQueue(JobQueueStore):
         # (queue_entry payload included) come back on the conditional
         # UPDATE's returning representation, so polling replicas never
         # transfer payloads they will not run
-        q = (
-            self.client.table("jobs")
-            .select(
-                "id,slot,queue_state,lease_owner,lease_expires_at,"
-                "attempt,bucket:queue_entry->>bucket"
+        ordered = type(self)._qos_cols and expired_before is None
+        cols = (
+            "id,slot,queue_state,lease_owner,lease_expires_at,"
+            "attempt,bucket:queue_entry->>bucket"
+        )
+        if ordered:
+            # claim order rides the index: class rank first, EDF within
+            # class (nulls — no deadline — last), then age. Reclaim
+            # scans (expired_before) keep the plain age order: expiry
+            # is not a scheduling decision.
+            cols += ",qos:queue_entry->>qos"
+        q = self.client.table("jobs").select(cols).in_(
+            "queue_state", list(states)
+        )
+        if ordered:
+            q = q.order("qos_rank", desc=False).order(
+                "deadline_at", desc=False, nullsfirst=False
             )
-            .in_("queue_state", list(states))
-            .order("updated_at", desc=False)
-            .limit(limit or self.CLAIM_CANDIDATES)
+        q = q.order("updated_at", desc=False).limit(
+            limit or self.CLAIM_CANDIDATES
         )
         if expired_before is not None:
             q = q.lt("lease_expires_at", self._iso(expired_before))
@@ -281,7 +348,24 @@ class SupabaseJobQueue(JobQueueStore):
                     f"and(slot.gte.{lo},slot.lt.{hi})" for lo, hi in slots
                 )
             )
-        return list(q.execute().data)
+        try:
+            return list(q.execute().data)
+        except Exception as exc:
+            if not ordered or not self._missing_qos_columns(exc):
+                raise  # transient failure: the claim loop backs off
+            # the ordered scan failed on the missing columns: latch off
+            # and retry this one scan FIFO so the claim loop never sees
+            # the schema gap
+            type(self)._qos_cols = False
+            log_event(
+                "store.qos_columns_missing",
+                level="warn",
+                hint="apply the qos_rank/deadline_at migration in "
+                "store/schema.sql; claim order degrades to FIFO",
+            )
+            return self._candidates(
+                slots, states, expired_before=expired_before, limit=limit
+            )
 
     def claim(self, owner: str, lease_s: float, slots=None) -> dict | None:
         import time as _time
@@ -338,9 +422,19 @@ class SupabaseJobQueue(JobQueueStore):
             bucket = leader.get("bucket")
             batch = [leader]
             if bucket is not None:
-                batch += [
+                from vrpms_tpu.sched import qos as qos_mod
+
+                # free-rider fill over the scan (which already arrives
+                # in claim order, so EDF/FIFO within each preference
+                # tier is preserved): same-class mates first, lower
+                # classes top off, same-class never displaced
+                mates = [
                     r for r in rows[1:] if r.get("bucket") == bucket
-                ][: k - 1]
+                ]
+                batch += qos_mod.select_mates(
+                    leader, mates, k - 1,
+                    key=lambda r: qos_mod.order_key(r.get("qos"), None),
+                )
             by_id = {r["id"]: r for r in batch}
             upd = (
                 self.client.table("jobs")
@@ -468,6 +562,64 @@ class SupabaseJobQueue(JobQueueStore):
             .execute()
         )
         return int(result.count or 0)
+
+    def depth_by_class(self) -> dict | None:
+        if not type(self)._qos_cols:
+            return None  # schema predates the columns: omit the view
+        from vrpms_tpu.sched import qos as qos_mod
+
+        out = {}
+        for name in qos_mod.CLASSES:
+            q = (
+                self.client.table("jobs")
+                .select("id", count="exact")
+                .eq("queue_state", Q_QUEUED)
+                .limit(1)
+            )
+            if name == qos_mod.DEFAULT_CLASS:
+                # rows enqueued without a class (pre-QoS builds,
+                # VRPMS_QOS=off peers) count as standard
+                q = q.or_(
+                    f"qos_rank.eq.{qos_mod.rank(name)},qos_rank.is.null"
+                )
+            else:
+                q = q.eq("qos_rank", qos_mod.rank(name))
+            try:
+                out[name] = int(q.execute().count or 0)
+            except Exception as exc:
+                if self._missing_qos_columns(exc):
+                    type(self)._qos_cols = False
+                return None  # omit the view; never fail readiness
+        return out
+
+    #: bounded tenant scan: the fairness map is a heuristic, and an
+    #: unbounded select of every active row would grow with backlog
+    TENANT_SCAN_LIMIT = 512
+
+    def tenant_depths(self) -> dict | None:
+        try:
+            result = (
+                self.client.table("jobs")
+                .select("tenant:queue_entry->>tenant")
+                .in_("queue_state", (Q_QUEUED, Q_LEASED))
+                # server-side tenant filter: the bounded sample must
+                # contain only quota-relevant rows, or a deep mostly-
+                # anonymous backlog could fill the limit with null
+                # tenants and report an over-quota tenant as 0 —
+                # quotas failing open exactly under the overload they
+                # exist for
+                .filter("queue_entry->>tenant", "not.is", "null")
+                .limit(self.TENANT_SCAN_LIMIT)
+                .execute()
+            )
+        except Exception:
+            return None  # unknown — admission fails open
+        depths: dict = {}
+        for row in result.data:
+            tenant = row.get("tenant")
+            if tenant:
+                depths[tenant] = depths.get(tenant, 0) + 1
+        return depths
 
     def register_replica(self, replica_id: str, ttl_s: float) -> None:
         import time as _time

@@ -98,6 +98,12 @@ _SLOW_PATTERNS = (
     "test_distqueue.py::TestCrossReplicaChaos",
     "test_distqueue.py::TestClaimKCrossReplica",
     "test_distqueue.py::TestServiceDistHTTP",
+    # QoS end-to-end HTTP layers: real solves behind blockers (the
+    # unit/store/fast-fail layers stay quick; tier1.yml runs the file
+    # in full)
+    "test_qos.py::TestQosHTTP",
+    "test_qos.py::TestQosDistHTTP",
+    "test_qos.py::TestQosOffGuard",
     # dynamic re-solve end-to-end solves (unit/envelope layers stay
     # quick; tier1.yml runs the file in full)
     "test_resolve.py::TestDeltaHTTP",

@@ -1,0 +1,903 @@
+"""Deadline-aware QoS scheduling tests (ISSUE 12).
+
+Layers:
+
+  * TestQosUnits — class parsing/ranking, EDF order keys, tenant
+    identity, the free-rider mate-selection rule, shed fractions;
+  * TestLocalQueueQos — JobQueue with a QosPolicy attached: priority
+    pop (class first, EDF within class, FIFO-stable ties — a
+    randomized ordering property), selective-shed admission with
+    per-class Retry-After from observed drain, the free-rider gather
+    (same-class members never displaced), per-class depth;
+  * TestStoreClaimQos — the shared in-memory queue store: claim and
+    claim_batch honor the same ordering contract (randomized property
+    against a reference sort), the batch fill prefers same-class mates
+    with lower classes as free riders, qos-less entries stay pure
+    FIFO, per-class/per-tenant depth maps, fleet-wide tenant
+    accounting across two claiming owners;
+  * TestStaleDeadlineFastFail — a claimed entry whose deadline budget
+    was fully spent in queue wait dies at materialize with the clean
+    "deadline exhausted" envelope (before any prepare/compile) and is
+    counted in vrpms_jobs_shed_total{reason="deadline_exhausted"};
+  * TestQosHTTP (slow) — the HTTP surface: selective shed (batch 429s
+    while interactive still admits) with per-class Retry-After,
+    per-tenant quota 429s (anonymous exempt), and the /api/ready qos
+    block (per-class depth + tenant inflight map);
+  * TestQosDistHTTP (slow) — the store-backed path: per-tenant quota
+    enforced fleet-wide across two in-process replicas via shared
+    store accounting;
+  * TestQosOffGuard (slow) — VRPMS_QOS=off builds no policy, treats
+    'qos' like any unknown key (junk does not 400), writes no
+    claim-ordering fields, and serves fixed-seed responses identical
+    to a qos-less request.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import store
+import store.memory as mem
+from service import jobs as jobs_mod
+from service import obs as service_obs
+from vrpms_tpu.sched import Job, JobQueue, Scheduler, qos
+from vrpms_tpu.sched.batcher import gather_batch
+
+
+@pytest.fixture(autouse=True)
+def clean_store(monkeypatch):
+    monkeypatch.setenv("VRPMS_STORE", "memory")
+    monkeypatch.delenv("VRPMS_QUEUE", raising=False)
+    monkeypatch.delenv("VRPMS_QOS", raising=False)  # default: on
+    mem.reset()
+    yield
+    mem.reset()
+
+
+def _job(cls="standard", deadline=None, bucket=None, tl=None):
+    j = Job(payload={}, bucket=bucket, time_limit=tl)
+    j.qos = cls
+    j.deadline_at = deadline
+    return j
+
+
+# ---------------------------------------------------------------------------
+# Units
+# ---------------------------------------------------------------------------
+
+
+class TestQosUnits:
+    def test_parse_class(self):
+        assert qos.parse_class(None) == "standard"
+        assert qos.parse_class("Interactive") == "interactive"
+        assert qos.parse_class(" batch ") == "batch"
+        for junk in ("gold", 3, [], "inter active"):
+            with pytest.raises(ValueError):
+                qos.parse_class(junk)
+
+    def test_rank_order(self):
+        assert qos.rank("interactive") < qos.rank("standard") < qos.rank("batch")
+        # unknown ranks standard: entries from builds predating a class
+        assert qos.rank("???") == qos.rank("standard")
+        assert qos.class_of_rank(0) == "interactive"
+        assert qos.class_of_rank("junk") == "standard"
+
+    def test_order_key_edf(self):
+        # class dominates deadline; no deadline sorts last in class
+        assert qos.order_key("interactive", None) < qos.order_key(
+            "standard", 1.0
+        )
+        assert qos.order_key("standard", 5.0) < qos.order_key(
+            "standard", 9.0
+        )
+        assert qos.order_key("standard", 9.0) < qos.order_key(
+            "standard", None
+        )
+
+    def test_deadline_at(self):
+        assert qos.deadline_at(100.0, 30) == 130.0
+        assert qos.deadline_at(100.0, None) is None
+        assert qos.deadline_at(100.0, 0) is None  # stop-ASAP, not EDF
+        assert qos.deadline_at(100.0, "junk") is None
+
+    def test_tenant_id(self):
+        assert qos.tenant_id(None) is None
+        assert qos.tenant_id("") is None  # anonymous: quota-exempt
+        a, b = qos.tenant_id("tok-a"), qos.tenant_id("tok-b")
+        assert a and b and a != b
+        assert qos.tenant_id("tok-a") == a  # stable
+        assert "tok-a" not in a  # raw credential never leaks
+
+    def test_select_mates_prefers_leader_class(self):
+        leader = _job("standard")
+        mates = [_job("batch"), _job("standard"), _job("batch")]
+        chosen = qos.select_mates(leader, mates, 2)
+        assert [m.qos for m in chosen] == ["standard", "batch"]
+
+    def test_select_mates_never_displaces_same_class(self):
+        leader = _job("standard")
+        mates = [_job("batch"), _job("batch"), _job("standard")]
+        # one slot: the same-class mate wins it even though two batch
+        # jobs are ahead of it in FIFO order
+        chosen = qos.select_mates(leader, mates, 1)
+        assert [m.qos for m in chosen] == ["standard"]
+
+    def test_shed_fractions_default(self, monkeypatch):
+        assert qos.shed_fraction("interactive") == 1.0
+        assert qos.shed_fraction("standard") == 1.0  # pre-QoS parity
+        assert qos.shed_fraction("batch") == 0.5
+        monkeypatch.setenv("VRPMS_QOS_SHED_STANDARD", "0.75")
+        assert qos.shed_fraction("standard") == 0.75
+
+
+# ---------------------------------------------------------------------------
+# Local queue
+# ---------------------------------------------------------------------------
+
+
+class TestLocalQueueQos:
+    def test_pop_priority_order_property(self):
+        rng = np.random.default_rng(7)
+        q = JobQueue(limit=256, policy=qos.QosPolicy())
+        jobs = []
+        for i in range(60):
+            cls = qos.CLASSES[int(rng.integers(0, 3))]
+            deadline = (
+                None if rng.random() < 0.3 else float(rng.uniform(0, 100))
+            )
+            j = _job(cls, deadline)
+            jobs.append(j)
+            q.push(j)
+        popped = [q.pop(timeout=0) for _ in range(len(jobs))]
+        # reference: stable sort of the submit order by (rank, EDF)
+        expect = sorted(
+            range(len(jobs)),
+            key=lambda i: (qos.job_order_key(jobs[i]), i),
+        )
+        assert [id(p) for p in popped] == [id(jobs[i]) for i in expect]
+
+    def test_pop_fifo_on_equal_keys(self):
+        q = JobQueue(limit=8, policy=qos.QosPolicy())
+        jobs = [_job() for _ in range(5)]
+        for j in jobs:
+            q.push(j)
+        assert [q.pop(timeout=0) for _ in range(5)] == jobs
+
+    def test_no_policy_is_fifo_regardless_of_fields(self):
+        q = JobQueue(limit=8)  # VRPMS_QOS=off: no policy attached
+        jobs = [
+            _job("batch"), _job("interactive", 1.0), _job("standard"),
+        ]
+        for j in jobs:
+            q.push(j)
+        assert [q.pop(timeout=0) for _ in range(3)] == jobs
+
+    def test_admit_sheds_batch_first(self):
+        q = JobQueue(limit=4, policy=qos.QosPolicy())
+        q.push(_job())
+        q.push(_job())
+        # depth 2 = batch's bound (0.5 * 4): batch sheds...
+        from vrpms_tpu.sched.queue import QueueFull
+
+        with pytest.raises(QueueFull):
+            q.push(_job("batch"))
+        # ...while standard and interactive still admit to the bound
+        q.push(_job("interactive"))
+        q.push(_job())
+        with pytest.raises(QueueFull):
+            q.push(_job("interactive"))  # hard bound: everyone sheds
+
+    def test_preadmitted_jobs_skip_class_shed(self):
+        # a store-claimed entry re-entering the local queue already
+        # passed the SHARED admission bound: the class-fraction shed
+        # must not bounce it back to the store (claim/nack livelock) —
+        # only the hard bound applies (the replica's nack flow control)
+        from vrpms_tpu.sched.queue import QueueFull
+
+        q = JobQueue(limit=4, policy=qos.QosPolicy())
+        q.push(_job())
+        q.push(_job())
+        claimed = _job("batch")
+        claimed.preadmitted = True
+        q.push(claimed)  # depth 2 >= batch's bound, but preadmitted
+        q.push(_job("interactive"))
+        with pytest.raises(QueueFull):
+            over = _job("batch")
+            over.preadmitted = True
+            q.push(over)  # the hard bound still sheds
+
+    def test_retry_after_uses_class_drain_rate(self):
+        from vrpms_tpu.sched.queue import QueueFull
+
+        policy = qos.QosPolicy()
+        for _ in range(40):  # converge the EWMAs
+            policy.note_done("batch", 10.0)
+            policy.note_done("interactive", 10.0)
+        q = JobQueue(limit=4, policy=policy)
+        q.push(_job())
+        q.push(_job())
+        with pytest.raises(QueueFull) as shed:
+            q.push(_job("batch"))
+        # 3 jobs at-or-above batch priority (2 queued + itself floor 1
+        # -> ahead counts the 2 queued) x ~10s/job, clamped <= 60
+        assert shed.value.retry_after_s > 10.0
+        # the batch shed's hint reflects BATCH's drain, not the global
+        # EWMA default of ~1s/job
+        assert shed.value.retry_after_s == policy.retry_after("batch", 2)
+
+    def test_gather_free_rider_fill(self):
+        policy = qos.QosPolicy()
+        q = JobQueue(limit=16, policy=policy)
+        lead = _job("standard", bucket="b")
+        free_rider = _job("batch", bucket="b")
+        member = _job("standard", bucket="b")
+        other_bucket = _job("batch", bucket="c")
+        for j in (free_rider, member, other_bucket):
+            q.push(j)
+        batch = gather_batch(q, lead, window_s=0.0, max_batch=3)
+        # 2 open slots: the same-class member takes one, the batch
+        # free rider rides the other; the other bucket stays queued
+        assert batch[0] is lead
+        assert batch[1] is member and batch[2] is free_rider
+        assert q.pop(timeout=0) is other_bucket
+
+    def test_gather_same_class_never_displaced(self):
+        policy = qos.QosPolicy()
+        q = JobQueue(limit=16, policy=policy)
+        lead = _job("standard", bucket="b")
+        riders = [_job("batch", bucket="b") for _ in range(2)]
+        members = [_job("standard", bucket="b") for _ in range(2)]
+        for j in riders + members:  # riders arrive FIRST
+            q.push(j)
+        batch = gather_batch(q, lead, window_s=0.0, max_batch=3)
+        # 2 slots, 2 same-class members: no free rider displaces them
+        assert batch == [lead] + members
+
+    def test_depth_by_class(self):
+        q = JobQueue(limit=16, policy=qos.QosPolicy())
+        for cls in ("interactive", "batch", "batch", "standard"):
+            q.push(_job(cls))
+        assert q.depth_by_class() == {
+            "interactive": 1, "standard": 1, "batch": 2,
+        }
+        assert JobQueue(limit=4).depth_by_class() == {}
+
+    def test_scheduler_builds_policy_only_when_enabled(self, monkeypatch):
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.setenv("VRPMS_QOS", "off")
+        assert jobs_mod.get_scheduler()._queue_policy is None
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.delenv("VRPMS_QOS")
+        assert isinstance(
+            jobs_mod.get_scheduler()._queue_policy, qos.QosPolicy
+        )
+        jobs_mod.shutdown_scheduler()
+
+
+# ---------------------------------------------------------------------------
+# Store-backed claims
+# ---------------------------------------------------------------------------
+
+
+def _entry(i, cls=None, deadline=None, bucket="t", tenant=None, slot=0):
+    e = {"id": f"e{i}", "slot": slot, "bucket": bucket}
+    if cls is not None:
+        e["qos"] = cls
+    if deadline is not None:
+        e["deadline_at"] = deadline
+    if tenant is not None:
+        e["tenant"] = tenant
+    return e
+
+
+class TestStoreClaimQos:
+    def _queue(self):
+        from store.memory import InMemoryJobQueue
+
+        return InMemoryJobQueue()
+
+    def test_claim_order_property(self):
+        rng = np.random.default_rng(3)
+        q = self._queue()
+        entries = []
+        for i in range(40):
+            cls = qos.CLASSES[int(rng.integers(0, 3))]
+            deadline = (
+                None if rng.random() < 0.3 else float(rng.uniform(0, 100))
+            )
+            e = _entry(i, cls, deadline, bucket=None)
+            entries.append(e)
+            q.enqueue(e)
+        got = [q.claim("me", 30.0)["id"] for _ in range(len(entries))]
+        expect = [
+            entries[i]["id"]
+            for i in sorted(
+                range(len(entries)),
+                key=lambda i: (qos.entry_order_key(entries[i]), i),
+            )
+        ]
+        assert got == expect
+
+    def test_claim_fifo_without_fields(self):
+        q = self._queue()
+        for i in range(5):
+            q.enqueue(_entry(i))
+        got = [q.claim("me", 30.0)["id"] for _ in range(5)]
+        assert got == [f"e{i}" for i in range(5)]
+
+    def test_claim_batch_leader_is_highest_priority(self):
+        q = self._queue()
+        q.enqueue(_entry(0, "batch"))
+        q.enqueue(_entry(1, "interactive"))
+        got = q.claim_batch("me", 30.0, 4)
+        assert [e["id"] for e in got] == ["e1", "e0"]
+
+    def test_claim_batch_free_rider_fill(self):
+        q = self._queue()
+        q.enqueue(_entry(0, "standard"))       # leader
+        q.enqueue(_entry(1, "batch"))          # free rider (FIFO-first)
+        q.enqueue(_entry(2, "standard"))       # same-class mate
+        q.enqueue(_entry(3, "standard", bucket="other"))
+        # k=2: one mate slot — the same-class mate wins it
+        got = q.claim_batch("me", 30.0, 2)
+        assert [e["id"] for e in got] == ["e0", "e2"]
+        # next rounds: the other-bucket standard job outranks the
+        # leftover batch rider, which then leads alone
+        got = q.claim_batch("me", 30.0, 2)
+        assert [e["id"] for e in got] == ["e3"]
+        got = q.claim_batch("me", 30.0, 2)
+        assert [e["id"] for e in got] == ["e1"]
+
+    def test_claim_batch_edf_within_class(self):
+        q = self._queue()
+        q.enqueue(_entry(0, "standard", deadline=50.0))
+        q.enqueue(_entry(1, "standard", deadline=10.0))
+        q.enqueue(_entry(2, "standard"))
+        got = q.claim_batch("me", 30.0, 3)
+        assert [e["id"] for e in got] == ["e1", "e0", "e2"]
+
+    def test_depth_maps(self):
+        q = self._queue()
+        q.enqueue(_entry(0, "interactive", tenant="tA"))
+        q.enqueue(_entry(1, "batch", tenant="tA"))
+        q.enqueue(_entry(2, tenant="tB"))
+        q.enqueue(_entry(3))
+        assert q.depth_by_class() == {
+            "interactive": 1, "standard": 2, "batch": 1,
+        }
+        assert q.tenant_depths() == {"tA": 2, "tB": 1}
+
+    def test_tenant_accounting_is_fleet_wide(self):
+        # entries stay counted while LEASED (another replica is
+        # running them) — the property per-tenant quotas divide by
+        q = self._queue()
+        q.enqueue(_entry(0, tenant="tA"))
+        q.enqueue(_entry(1, tenant="tA"))
+        claimed = q.claim("replica-1", 30.0)
+        assert claimed["tenant"] == "tA"
+        assert q.tenant_depths() == {"tA": 2}  # 1 leased + 1 queued
+        assert q.ack("replica-1", claimed["id"])
+        assert q.tenant_depths() == {"tA": 1}
+
+
+# ---------------------------------------------------------------------------
+# Stale-deadline fast-fail
+# ---------------------------------------------------------------------------
+
+
+class TestStaleDeadlineFastFail:
+    def test_spent_budget_dies_before_prepare(self):
+        # a claimed entry whose whole timeLimit was spent in queue
+        # wait: materialize fails it clean WITHOUT parsing/preparing
+        # (the payload here would not even parse — proof the parse
+        # never ran)
+        entry = {
+            "id": "stale-1",
+            "slot": 0,
+            "bucket": "t",
+            "qos": "standard",
+            "time_limit": 2.0,
+            "submitted_at": time.time() - 10.0,
+            "payload": {"content": {"not": "parseable"}},
+        }
+        before = _shed_count("deadline_exhausted", "standard")
+        job = jobs_mod._materialize_entry(entry, "r-test")
+        assert job.status == "failed"
+        assert job.errors[0]["what"] == "Deadline exceeded"
+        assert "deadline exhausted" in job.errors[0]["reason"]
+        assert _shed_count("deadline_exhausted", "standard") == before + 1
+
+    def test_fresh_budget_is_not_fast_failed(self):
+        entry = {
+            "id": "fresh-1",
+            "slot": 0,
+            "bucket": "t",
+            "qos": "standard",
+            "time_limit": 300.0,
+            "submitted_at": time.time(),
+            "payload": {"content": {}},
+        }
+        job = jobs_mod._materialize_entry(entry, "r-test")
+        # it fails — the payload is unparseable — but through the
+        # parse path, not the deadline fast-fail
+        assert job.status == "failed"
+        assert job.errors[0]["what"] != "Deadline exceeded"
+
+    def test_off_switch_skips_fast_fail(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QOS", "off")
+        entry = {
+            "id": "stale-2",
+            "slot": 0,
+            "bucket": "t",
+            "time_limit": 2.0,
+            "submitted_at": time.time() - 10.0,
+            "payload": {"content": {}},
+        }
+        job = jobs_mod._materialize_entry(entry, "r-test")
+        assert job.errors[0]["what"] != "Deadline exceeded"
+
+
+def _shed_count(reason, cls) -> float:
+    """Read vrpms_jobs_shed_total{reason,qos} back out of the rendered
+    exposition (the public surface, so the test also guards the label
+    names)."""
+    text = service_obs.REGISTRY.render()
+    for line in text.splitlines():
+        if (
+            line.startswith("vrpms_jobs_shed_total{")
+            and f'reason="{reason}"' in line
+            and f'qos="{cls}"' in line
+        ):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    from service.app import serve
+
+    jobs_mod.shutdown_scheduler()
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    jobs_mod.shutdown_scheduler()
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _seed_dataset(key="qos7", n=7, seed=11):
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        key, [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+    )
+    mem.seed_durations(key, d.tolist())
+
+
+def _body(key="qos7", n=7, **over):
+    body = {
+        "problem": "vrp",
+        "algorithm": "sa",
+        "solutionName": f"qos-{key}",
+        "solutionDescription": "t",
+        "locationsKey": key,
+        "durationsKey": key,
+        "capacities": [2 * n] * 3,
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": 1,
+        "iterationCount": 200,
+        "populationSize": 8,
+    }
+    body.update(over)
+    return body
+
+
+def _poll(base, job_id, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, resp = _get(base, f"/api/jobs/{job_id}")
+        assert status == 200, resp
+        if resp["job"]["status"] in ("done", "failed"):
+            return resp["job"]
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never finished")
+
+
+def _blocker_body(**over):
+    """A job that occupies the worker for ~its timeLimit."""
+    return _body(
+        iterationCount=500_000, populationSize=64, timeLimit=3, **over
+    )
+
+
+class TestQosHTTP:
+    @pytest.fixture(autouse=True)
+    def env(self, server, monkeypatch):
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.setenv("VRPMS_SCHED_QUEUE", "4")
+        _seed_dataset()
+        yield
+        jobs_mod.shutdown_scheduler()
+
+    def test_selective_shed_batch_first_with_per_class_retry(self, server):
+        # seed distinct per-class drain EWMAs so the Retry-After
+        # hints are visibly per class
+        policy = jobs_mod.get_qos_policy()
+        for _ in range(40):
+            policy.note_done("batch", 30.0)
+            policy.note_done("interactive", 1.0)
+        # occupy the worker, then fill the queue to batch's bound
+        # (0.5 x 4 = 2)
+        status, resp, _ = _post(server, "/api/jobs",
+                                _blocker_body(seed=50))
+        assert status == 202, resp
+        blocker = resp["jobId"]
+        time.sleep(0.3)  # blocker picked up; queue empty again
+        for i in (1, 2):
+            status, resp, _ = _post(
+                server, "/api/jobs", _body(seed=50 + i)
+            )
+            assert status == 202, resp
+        # batch sheds at depth 2...
+        status, resp, batch_headers = _post(
+            server, "/api/jobs", _body(seed=60, qos="batch")
+        )
+        assert status == 429, resp
+        assert resp["errors"][0]["what"] == "Too busy"
+        batch_retry = int(batch_headers["Retry-After"])
+        assert batch_retry >= 20  # ~2 jobs ahead x ~30s batch drain
+        # ...while interactive still admits past it...
+        status, resp, _ = _post(
+            server, "/api/jobs", _body(seed=61, qos="interactive")
+        )
+        assert status == 202, resp
+        # ...until the hard bound, where ITS Retry-After reflects the
+        # interactive drain rate, not batch's
+        status, resp, _ = _post(server, "/api/jobs", _body(seed=62))
+        assert status == 202, resp
+        status, resp, headers = _post(
+            server, "/api/jobs", _body(seed=63, qos="interactive")
+        )
+        assert status == 429, resp
+        assert int(headers["Retry-After"]) < batch_retry
+        _poll(server, blocker, timeout=60)
+
+    def test_interactive_pops_before_earlier_batch(self, server):
+        # with the worker busy, a later interactive submit must start
+        # before an earlier batch submit (priority pop)
+        status, resp, _ = _post(server, "/api/jobs",
+                                _blocker_body(seed=70))
+        assert status == 202, resp
+        blocker = resp["jobId"]
+        time.sleep(0.3)
+        status, resp, _ = _post(
+            server, "/api/jobs",
+            _body(seed=71, qos="batch", iterationCount=100,
+                  populationSize=4),
+        )
+        assert status == 202, resp
+        batch_id = resp["jobId"]
+        status, resp, _ = _post(
+            server, "/api/jobs",
+            _body(seed=72, qos="interactive", iterationCount=120,
+                  populationSize=4),
+        )
+        assert status == 202, resp
+        inter_id = resp["jobId"]
+        inter = _poll(server, inter_id, timeout=60)
+        batch = _poll(server, batch_id, timeout=60)
+        assert inter["status"] == "done" and batch["status"] == "done"
+        # different iteration counts = different buckets: no merge, so
+        # start order is pop order
+        assert inter["startedAt"] < batch["startedAt"], (inter, batch)
+        _poll(server, blocker, timeout=60)
+
+    def test_tenant_quota_sheds_only_that_tenant(self, server, monkeypatch):
+        monkeypatch.setenv("VRPMS_QOS_TENANT_QUOTA", "1")
+        mem.register_token("tok-a", "a@example.com")
+        mem.register_token("tok-b", "b@example.com")
+        status, resp, _ = _post(
+            server, "/api/jobs", _blocker_body(seed=80, auth="tok-a")
+        )
+        assert status == 202, resp
+        blocker = resp["jobId"]
+        time.sleep(0.3)
+        # tenant A is at quota while its job runs
+        status, resp, headers = _post(
+            server, "/api/jobs", _body(seed=81, auth="tok-a")
+        )
+        assert status == 429, resp
+        assert "tenant" in resp["errors"][0]["reason"]
+        assert int(headers["Retry-After"]) >= 1
+        # other tenants and anonymous callers are unaffected
+        status, resp, _ = _post(
+            server, "/api/jobs", _body(seed=82, auth="tok-b")
+        )
+        assert status == 202, resp
+        status, resp, _ = _post(server, "/api/jobs", _body(seed=83))
+        assert status == 202, resp
+        # the quota slot frees at the terminal transition
+        _poll(server, blocker, timeout=60)
+        status, resp, _ = _post(
+            server, "/api/jobs", _body(seed=84, auth="tok-a")
+        )
+        assert status == 202, resp
+        _poll(server, resp["jobId"], timeout=60)
+
+    def test_sync_endpoint_quota_shed(self, server, monkeypatch):
+        monkeypatch.setenv("VRPMS_QOS_TENANT_QUOTA", "1")
+        mem.register_token("tok-c", "c@example.com")
+        status, resp, _ = _post(
+            server, "/api/jobs", _blocker_body(seed=90, auth="tok-c")
+        )
+        assert status == 202, resp
+        blocker = resp["jobId"]
+        time.sleep(0.3)
+        body = _body(seed=91, auth="tok-c")
+        del body["problem"], body["algorithm"]
+        status, resp, headers = _post(server, "/api/vrp/sa", body)
+        assert status == 429, resp
+        assert "tenant" in resp["errors"][0]["reason"]
+        assert "Retry-After" in headers
+        _poll(server, blocker, timeout=60)
+
+    def test_ready_reports_class_depths_and_tenants(self, server,
+                                                    monkeypatch):
+        monkeypatch.setenv("VRPMS_QOS_TENANT_QUOTA", "4")
+        mem.register_token("tok-d", "d@example.com")
+        status, resp, _ = _post(server, "/api/jobs",
+                                _blocker_body(seed=95, auth="tok-d"))
+        assert status == 202, resp
+        blocker = resp["jobId"]
+        time.sleep(0.3)
+        status, resp, _ = _post(
+            server, "/api/jobs", _body(seed=96, qos="batch", auth="tok-d")
+        )
+        assert status == 202, resp
+        status, ready = _get(server, "/api/ready")
+        assert status == 200, ready
+        qinfo = ready["qos"]
+        assert set(qinfo["queued"]) == set(qos.CLASSES)
+        assert qinfo["queued"]["batch"] >= 1
+        assert qinfo["tenantQuota"] == 4
+        tenant = qos.tenant_id("tok-d")
+        assert qinfo["tenants"].get(tenant, 0) >= 1
+        _poll(server, blocker, timeout=60)
+
+    def test_junk_qos_is_400_when_enabled(self, server):
+        status, resp, _ = _post(
+            server, "/api/jobs", _body(seed=97, qos="gold-tier")
+        )
+        assert status == 400, resp
+        assert any(
+            "qos" in e["reason"] for e in resp["errors"]
+        ), resp
+
+
+class TestQosDistHTTP:
+    """Per-tenant quota across two in-process replicas on the shared
+    store queue: the accounting is store-backed, so tenant A's job
+    RUNNING ON THE PEER still counts against A at this replica's
+    admission."""
+
+    @pytest.fixture(autouse=True)
+    def dist_env(self, server, monkeypatch):
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_LEASE_S", "5")
+        monkeypatch.setenv("VRPMS_QUEUE_POLL_MS", "10")
+        monkeypatch.setenv("VRPMS_RECLAIM_S", "0.1")
+        monkeypatch.setenv("VRPMS_DEPTH_MEMO_MS", "0")  # read-through
+        monkeypatch.setenv("VRPMS_QOS_TENANT_QUOTA", "1")
+        _seed_dataset()
+        mem.register_token("tok-x", "x@example.com")
+        mem.register_token("tok-y", "y@example.com")
+        yield
+        jobs_mod.shutdown_scheduler()
+
+    def _peer(self):
+        sched = Scheduler(
+            jobs_mod._runner,
+            queue_limit=64,
+            window_s=0.005,
+            max_batch=8,
+            on_event=jobs_mod._on_event,
+            watchdog_s=0,
+            queue_policy=jobs_mod.get_qos_policy(),
+        )
+        from vrpms_tpu.sched import Replica
+
+        rep = Replica(
+            store.get_queue_store(),
+            "qos-peer",
+            materialize=lambda e: jobs_mod._materialize_entry(
+                e, "qos-peer"
+            ),
+            submit=lambda job: sched.submit(
+                job, backend=job.payload.get("backend") or "default"
+            ),
+            complete=jobs_mod._dist_complete,
+            dead=jobs_mod._dist_dead,
+            lease_s=5.0, poll_s=0.01, heartbeat_s=0.1, reclaim_s=0.1,
+            vnodes=16,
+        )
+        rep._test_scheduler = sched
+        return rep
+
+    def test_quota_counts_peer_replica_work(self, server):
+        peer = self._peer().start()
+        try:
+            status, resp, _ = _post(
+                server, "/api/jobs",
+                _blocker_body(seed=30, auth="tok-x"),
+            )
+            assert status == 202, resp
+            blocker = resp["jobId"]
+            # wait until SOME replica leased it (still active in the
+            # store either way — queued or leased both count)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if mem._tables["job_queue"]:
+                    break
+                time.sleep(0.01)
+            status, resp, _ = _post(
+                server, "/api/jobs", _body(seed=31, auth="tok-x")
+            )
+            assert status == 429, resp
+            assert "tenant" in resp["errors"][0]["reason"]
+            # tenant Y rides through the same admission untouched
+            status, resp, _ = _post(
+                server, "/api/jobs", _body(seed=32, auth="tok-y")
+            )
+            assert status == 202, resp
+            assert _poll(server, resp["jobId"])["status"] == "done"
+            assert _poll(server, blocker)["status"] == "done"
+            # quota frees once the entry is acked out of the store
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if not mem._tables["job_queue"]:
+                    break
+                time.sleep(0.01)
+            status, resp, _ = _post(
+                server, "/api/jobs", _body(seed=33, auth="tok-x")
+            )
+            assert status == 202, resp
+            assert _poll(server, resp["jobId"])["status"] == "done"
+        finally:
+            peer.stop(drain_s=5.0)
+            peer._test_scheduler.shutdown(timeout=2.0)
+
+    def test_store_entries_carry_ordering_fields(self, server,
+                                                 monkeypatch):
+        # pause claiming so the entry is inspectable in the store
+        monkeypatch.setenv("VRPMS_QUEUE_POLL_MS", "60000")
+        jobs_mod.shutdown_scheduler()
+        status, resp, _ = _post(
+            server, "/api/jobs",
+            _body(seed=40, qos="interactive", timeLimit=120,
+                  auth="tok-x"),
+        )
+        assert status == 202, resp
+        rows = [
+            r for r in mem._tables["job_queue"].values()
+            if r["id"] == resp["jobId"]
+        ]
+        if rows:  # not yet claimed (poll is paused after the rebuild)
+            row = rows[0]
+            assert row["qos"] == "interactive"
+            assert row["deadline_at"] is not None
+            assert row["tenant"] == qos.tenant_id("tok-x")
+        # un-pause: a fresh replica (built by the next submit) claims
+        # and drains both jobs
+        monkeypatch.setenv("VRPMS_QUEUE_POLL_MS", "10")
+        jobs_mod.shutdown_scheduler()
+        status, kick, _ = _post(server, "/api/jobs", _body(seed=41))
+        assert status == 202, kick
+        assert _poll(server, kick["jobId"])["status"] == "done"
+        assert _poll(server, resp["jobId"])["status"] == "done"
+
+
+class TestQosOffGuard:
+    """VRPMS_QOS=off must restore the pre-QoS contract byte for byte:
+    no policy, no validation of 'qos', no entry fields, identical
+    fixed-seed responses."""
+
+    @pytest.fixture(autouse=True)
+    def off_env(self, server, monkeypatch):
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.setenv("VRPMS_QOS", "off")
+        # cache off: the second identical request must SOLVE again or
+        # cacheHit would (legitimately) differ between the responses
+        monkeypatch.setenv("VRPMS_CACHE", "off")
+        _seed_dataset()
+        yield
+        jobs_mod.shutdown_scheduler()
+
+    def test_junk_qos_ignored(self, server):
+        status, resp, _ = _post(
+            server, "/api/jobs", _body(seed=1, qos="gold-tier")
+        )
+        assert status == 202, resp
+        assert _poll(server, resp["jobId"])["status"] == "done"
+
+    def test_responses_byte_identical_with_and_without_qos(self, server):
+        body = _body(seed=7)
+        del body["problem"], body["algorithm"]
+        status, plain, _ = _post(server, "/api/vrp/sa", body)
+        assert status == 200, plain
+        status, with_qos, _ = _post(
+            server, "/api/vrp/sa", dict(body, qos="interactive")
+        )
+        assert status == 200, with_qos
+        status, with_junk, _ = _post(
+            server, "/api/vrp/sa", dict(body, qos=12345)
+        )
+        assert status == 200, with_junk
+        assert plain["message"] == with_qos["message"]
+        assert plain["message"] == with_junk["message"]
+
+    def test_tenant_quota_not_enforced_when_off(self, server,
+                                                monkeypatch):
+        monkeypatch.setenv("VRPMS_QOS_TENANT_QUOTA", "1")
+        mem.register_token("tok-off", "off@example.com")
+        status, resp, _ = _post(
+            server, "/api/jobs", _blocker_body(seed=8, auth="tok-off")
+        )
+        assert status == 202, resp
+        blocker = resp["jobId"]
+        time.sleep(0.3)
+        status, resp, _ = _post(
+            server, "/api/jobs", _body(seed=9, auth="tok-off")
+        )
+        assert status == 202, resp  # off: quotas build nothing
+        _poll(server, blocker, timeout=60)
+        _poll(server, resp["jobId"], timeout=60)
+
+    def test_ready_has_no_qos_block(self, server):
+        # rebuild the scheduler first (the fixture drained it, which
+        # readiness honestly reports as down)
+        status, resp, _ = _post(server, "/api/jobs", _body(seed=10))
+        assert status == 202, resp
+        _poll(server, resp["jobId"])
+        status, ready = _get(server, "/api/ready")
+        assert status == 200
+        assert "qos" not in ready

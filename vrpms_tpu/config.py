@@ -119,6 +119,26 @@ REGISTRY: dict[str, Var] = {
         _v("VRPMS_RESOLVE_WAIT_S", "float", 30.0,
            "How long POST /api/jobs/{id}/resolve waits for the "
            "predecessor's terminal record before answering 409."),
+        # -- QoS scheduling + fairness ---------------------------------
+        _v("VRPMS_QOS", "switch", True,
+           "Deadline/class-aware QoS scheduling (priority classes, EDF "
+           "claim ordering, selective shed, tenant quotas); off "
+           "restores plain FIFO queues and pre-QoS responses."),
+        _v("VRPMS_QOS_SHED_STANDARD", "float", 1.0,
+           "Fraction of the admission bound standard-class submits may "
+           "fill before they shed with 429; the default (1.0, the full "
+           "bound) keeps default-class admission identical to the "
+           "pre-QoS contract — lower it to reserve headroom for "
+           "interactive traffic (interactive always gets the full "
+           "bound)."),
+        _v("VRPMS_QOS_SHED_BATCH", "float", 0.5,
+           "Fraction of the admission bound batch-class submits may "
+           "fill before they shed — the class that absorbs overload "
+           "first."),
+        _v("VRPMS_QOS_TENANT_QUOTA", "int", 0,
+           "Max active jobs one authenticated tenant may hold across "
+           "the replica fleet (auth-scoped; anonymous requests are "
+           "exempt); 0 disables quotas."),
         # -- distributed queue + replicas ------------------------------
         _v("VRPMS_QUEUE", "str", "local",
            "Job queue: local (in-process) or store|shared|dist (the "

@@ -69,6 +69,7 @@ KNOWN_SPAN_NAMES = frozenset({
     "finish",           # decode + response assembly
     "dist.execute",     # distributed-queue claim-side execution
     "dist.claim_batch",  # how this job's store claim was assembled
+    "qos.shed",         # a request shed by QoS policy (class + reason)
     "store.read",       # table reads on the request path
     "store.persist",    # solution/warm-start persistence
     "store.persist_job",  # terminal job-record persistence

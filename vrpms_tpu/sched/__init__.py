@@ -6,11 +6,14 @@ The subsystem between the HTTP layer and the jit-compiled solvers
 queue; one worker per backend drains it, merging same-shape jobs into
 one batched/vmapped launch (sched.batch.solve_sa_batch) within a small
 gather window. A watchdog restarts dead/wedged workers and re-admits
-their in-flight batch exactly once (sched.worker). Generic pieces here
-are stdlib-only; the service wires the runner, the jobs HTTP surface,
-and persistence (service.jobs).
+their in-flight batch exactly once (sched.worker). With a QoS policy
+attached (sched.qos) the queues become deadline- and class-aware:
+priority pop, EDF within class, selective shed, free-rider batch
+fill. Generic pieces here are stdlib-only; the service wires the
+runner, the jobs HTTP surface, and persistence (service.jobs).
 """
 
+from vrpms_tpu.sched import qos
 from vrpms_tpu.sched.batcher import gather_batch
 from vrpms_tpu.sched.queue import (
     DONE,
@@ -40,5 +43,6 @@ __all__ = [
     "Worker",
     "expired",
     "gather_batch",
+    "qos",
     "slot",
 ]

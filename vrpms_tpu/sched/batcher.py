@@ -60,7 +60,13 @@ def gather_batch(
     hint = getattr(first, "batch_hint", 0) or 0
     deadline = time.monotonic() + max(window_s, 0.0)
     while len(batch) < max_batch:
-        taken = queue.take_matching(first.bucket, max_batch - len(batch))
+        # the leader rides along so a QoS policy can apply the
+        # free-rider fill rule (same-class mates first, lower classes
+        # top off, same-class members never displaced) — with no
+        # policy attached the extra argument changes nothing
+        taken = queue.take_matching(
+            first.bucket, max_batch - len(batch), leader=first
+        )
         if taken:
             batch.extend(taken)
             if on_take is not None:

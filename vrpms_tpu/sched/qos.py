@@ -1,0 +1,271 @@
+"""Deadline-aware QoS: priority classes, EDF ordering, selective shed.
+
+The scheduling policy layer ISSUE 12 adds on top of the flat FIFO
+queues. Three request classes — ``interactive`` > ``standard`` >
+``batch`` — plus the deadline budget every request already carries
+(PR 2's ``timeLimit``) turn into ONE ordering rule used everywhere a
+job is picked:
+
+    (class rank, deadline, arrival)      — "EDF within class, higher
+                                            class first across classes"
+
+With every field at its default (class ``standard``, no deadline) the
+rule degrades to pure FIFO, which is what keeps the ``VRPMS_QOS=off``
+byte-identity guard cheap: the off switch simply builds no policy at
+all and nothing here runs.
+
+Pieces:
+
+  * class parsing/ranking + the shared order keys (local ``Job``s and
+    store queue entries use the same tuple, so the local pop, the
+    store ``claim``/``claim_batch``, and tests all agree);
+  * :class:`QosPolicy` — the object ``sched.queue.JobQueue`` consults
+    when one is attached: priority pop order, class-fraction admission
+    shed with per-class Retry-After from observed per-class drain, and
+    the free-rider micro-batch fill rule (same-class mates first,
+    lower classes ride along, a same-class member is never displaced);
+  * tenant identity (auth-scoped, the PR-3 degraded-cache-key rule:
+    the raw token never leaves the process) for per-tenant fairness
+    quotas.
+
+Stdlib-only besides :mod:`vrpms_tpu.config` (itself stdlib-only) — no
+jax, no service imports — like the rest of the sched package.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import threading
+
+from vrpms_tpu import config
+
+#: priority classes, highest first; rank = index (lower = sooner)
+CLASSES = ("interactive", "standard", "batch")
+DEFAULT_CLASS = "standard"
+RANK = {name: i for i, name in enumerate(CLASSES)}
+
+#: the class that absorbs sheds first is the LAST one — shedding walks
+#: the tuple back to front as depth crosses each class's fraction of
+#: the admission bound (shed_fraction)
+_INF = math.inf
+
+
+def enabled() -> bool:
+    """The one QoS switch (``VRPMS_QOS``): off builds no policy, adds
+    no request fields, and restores plain-FIFO behavior everywhere."""
+    return config.enabled("VRPMS_QOS")
+
+
+def parse_class(value) -> str:
+    """Normalize a request's ``qos`` value to a class name.
+
+    None/absent means :data:`DEFAULT_CLASS`; anything else must be one
+    of :data:`CLASSES` (case-insensitive) — junk raises ValueError so
+    the request parser can reject it with a 400 envelope instead of
+    silently scheduling it into the wrong class.
+    """
+    if value is None:
+        return DEFAULT_CLASS
+    if isinstance(value, str) and value.strip().lower() in RANK:
+        return value.strip().lower()
+    raise ValueError(
+        f"'qos' must be one of {'|'.join(CLASSES)}, got {value!r}"
+    )
+
+
+def rank(qos_class) -> int:
+    """Class rank (0 = highest priority); unknown/None ranks standard,
+    so entries written by builds that predate a class still order
+    sanely instead of raising mid-claim."""
+    return RANK.get(qos_class, RANK[DEFAULT_CLASS])
+
+
+def class_of_rank(r) -> str:
+    try:
+        return CLASSES[int(r)]
+    except (TypeError, ValueError, IndexError):
+        return DEFAULT_CLASS
+
+
+def deadline_at(submitted_at, time_limit) -> float | None:
+    """Absolute EDF deadline (epoch seconds): submit + budget. Only a
+    POSITIVE budget makes a deadline — explicit 0 keeps its stop-ASAP
+    meaning and None is unbounded (both sort after every real
+    deadline, FIFO among themselves)."""
+    try:
+        if submitted_at is None or time_limit is None:
+            return None
+        tl = float(time_limit)
+        if tl <= 0:
+            return None
+        return float(submitted_at) + tl
+    except (TypeError, ValueError):
+        return None
+
+
+def order_key(qos_class, deadline) -> tuple:
+    """The claim-ordering tuple: class first, then EDF (no deadline
+    sorts last within its class). Callers tie-break by arrival order —
+    every consumer picks the MIN over a FIFO-ordered sequence with a
+    stable selection, so equal keys preserve FIFO."""
+    return (rank(qos_class), _INF if deadline is None else float(deadline))
+
+
+def job_order_key(job) -> tuple:
+    """order_key over a sched.queue.Job (duck-typed: anything with
+    .qos/.deadline_at works, so tests can use stubs)."""
+    return order_key(
+        getattr(job, "qos", None), getattr(job, "deadline_at", None)
+    )
+
+
+def entry_order_key(entry: dict) -> tuple:
+    """order_key over a store queue entry dict (the claim-ordering
+    columns: ``qos`` + ``deadline_at``; both absent = FIFO)."""
+    return order_key(entry.get("qos"), entry.get("deadline_at"))
+
+
+def select_mates(leader, candidates: list, max_n: int, key=None) -> list:
+    """The free-rider micro-batch fill rule, shared by the local
+    gather (JobQueue.take_matching) and the store's claim_batch: from
+    same-bucket `candidates`, prefer mates of the LEADER's class (in
+    their existing EDF/FIFO order), then fill remaining slots with
+    other classes highest-first — lower classes ride a launch that was
+    happening anyway, but when slots run out a same-class member is
+    never displaced by a free rider. Stable: within each preference
+    tier the input (FIFO) order is kept."""
+    if max_n <= 0:
+        return []
+    key = key or job_order_key
+    lead_rank = key(leader)[0]
+    ordered = sorted(
+        range(len(candidates)),
+        key=lambda i: (
+            0 if key(candidates[i])[0] == lead_rank else 1,
+            key(candidates[i]),
+            i,
+        ),
+    )
+    return [candidates[i] for i in ordered[:max_n]]
+
+
+def tenant_id(auth) -> str | None:
+    """Auth-scoped tenant identity for fairness quotas: a stable hash
+    of the token (the PR-3 rule — the raw credential is never used as
+    a key), or None for anonymous requests. Quotas apply only to
+    identified tenants: every anonymous caller would otherwise share
+    ONE bucket and a single hot anonymous client could lock out all
+    the others while looking like 'fairness'."""
+    if not auth:
+        return None
+    return hashlib.sha256(repr(auth).encode()).hexdigest()[:12]
+
+
+def shed_fraction(qos_class: str) -> float:
+    """What fraction of the admission bound this class may fill before
+    its submits shed. Interactive always gets the full bound; standard
+    and batch shed earlier (VRPMS_QOS_SHED_STANDARD / _BATCH), which
+    is exactly what makes overload selective: as depth grows, batch
+    429s first, then standard, and interactive only at the hard
+    bound."""
+    r = rank(qos_class)
+    if r <= RANK["interactive"]:
+        return 1.0
+    if r == RANK["standard"]:
+        frac = config.get("VRPMS_QOS_SHED_STANDARD")
+    else:
+        frac = config.get("VRPMS_QOS_SHED_BATCH")
+    return min(1.0, max(0.0, float(frac)))
+
+
+def tenant_quota() -> int:
+    """Max jobs one tenant may have active across the fleet (0 = no
+    quota)."""
+    return max(0, int(config.get("VRPMS_QOS_TENANT_QUOTA")))
+
+
+class QosPolicy:
+    """The pluggable policy a QoS-enabled JobQueue (and the service's
+    admission paths) consult. Holds the per-class drain-rate EWMAs
+    that price each class's Retry-After; everything else is stateless
+    delegation to the module functions above so the ordering rule has
+    exactly one definition."""
+
+    #: EWMA weight for per-class service seconds (the JobQueue
+    #: _job_seconds constant)
+    ALPHA = 0.2
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # per-class EWMA of observed per-job service seconds — the
+        # denominator of each class's Retry-After estimate
+        self._class_seconds: dict = {}  # guarded-by: _lock
+
+    # -- ordering -----------------------------------------------------------
+    def job_key(self, job) -> tuple:
+        return job_order_key(job)
+
+    def select_mates(self, leader, candidates: list, max_n: int) -> list:
+        return select_mates(leader, candidates, max_n)
+
+    # -- drain accounting ---------------------------------------------------
+    def note_done(self, qos_class, seconds: float) -> None:
+        cls = qos_class if qos_class in RANK else DEFAULT_CLASS
+        with self._lock:
+            prev = self._class_seconds.get(cls, 1.0)
+            self._class_seconds[cls] = (
+                (1 - self.ALPHA) * prev + self.ALPHA * max(seconds, 1e-3)
+            )
+
+    def class_seconds(self, qos_class) -> float:
+        cls = qos_class if qos_class in RANK else DEFAULT_CLASS
+        with self._lock:
+            return self._class_seconds.get(cls, 1.0)
+
+    def retry_after(self, qos_class, backlog: int, drains: int = 1) -> float:
+        """When should a shed request of this class retry: the work
+        ahead of it divided by this CLASS's observed drain rate (its
+        EWMA per-job seconds), spread over `drains` parallel drains
+        (fleet members). Bounded to [1, 60] like the queue's own
+        estimate."""
+        per_job = self.class_seconds(qos_class)
+        return min(
+            max(1.0, backlog * per_job / max(1, drains)), 60.0
+        )
+
+    # -- admission ----------------------------------------------------------
+    def admit(self, job, items: list, limit: int) -> float | None:
+        """Selective-shed check, called by JobQueue.push UNDER the
+        queue lock (must not call back into the queue): None admits;
+        a float sheds the job and is the 429's Retry-After. The
+        effective bound for a class is its shed fraction of the hard
+        limit — jobs of a class shed once TOTAL depth reaches it, so
+        the headroom between a lower class's bound and the hard limit
+        is reserved for the classes above it."""
+        if getattr(job, "preadmitted", False):
+            # already admitted elsewhere (a store-claimed entry):
+            # shedding it here would bounce it between the shared
+            # queue and this box forever — only the hard bound applies
+            return None
+        cls = getattr(job, "qos", None) or DEFAULT_CLASS
+        effective = int(limit * shed_fraction(cls))
+        depth = len(items)
+        if depth < max(1, effective):
+            return None
+        # work that must drain before a retry of this class gets in:
+        # everything at-or-above its priority, plus itself
+        my_rank = rank(cls)
+        ahead = sum(
+            1 for j in items if job_order_key(j)[0] <= my_rank
+        )
+        return self.retry_after(cls, max(1, ahead))
+
+    def depth_by_class(self, items: list) -> dict:
+        """{class: count} over a job list (the readiness probe's
+        per-class queue view; zero-filled so the map's shape is
+        stable)."""
+        out = {name: 0 for name in CLASSES}
+        for j in items:
+            out[class_of_rank(job_order_key(j)[0])] += 1
+        return out

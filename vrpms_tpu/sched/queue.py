@@ -93,6 +93,23 @@ class Job:
     # arrive, so the window is skipped entirely. 0 = a normal local
     # submit (window applies).
     batch_hint: int = 0
+    # QoS (sched.qos): the request's priority class, its absolute EDF
+    # deadline (epoch seconds; None = no deadline, sorts last within
+    # its class), and the auth-scoped tenant identity fairness quotas
+    # count against. All defaulted so a QoS-less submit (or
+    # VRPMS_QOS=off, which attaches no policy at all) schedules
+    # exactly like the pre-QoS FIFO contract.
+    qos: str = "standard"
+    deadline_at: float | None = None
+    tenant: str | None = None
+    # True for jobs that already passed an admission decision elsewhere
+    # (store-claimed entries re-entering a local queue: they were
+    # admitted at the SHARED bound when first submitted). The QoS
+    # class-fraction shed skips them — shedding a claimed entry back
+    # to the store would nack/re-claim it in a livelock, never solving
+    # and never 429ing. The hard queue bound still applies (QueueFull
+    # -> the replica's nack flow control, as before).
+    preadmitted: bool = False
     # supervision: True once the watchdog re-admitted this job after a
     # worker crash — the SECOND crash fails it instead (at-most-one
     # requeue keeps a poison job from crash-looping the worker forever)
@@ -154,18 +171,27 @@ class Job:
 
 
 class JobQueue:
-    """Bounded FIFO with bucket-aware extraction.
+    """Bounded FIFO with bucket-aware extraction — and, with a QoS
+    `policy` attached (sched.qos.QosPolicy), a priority queue.
 
-    `pop` hands the worker the oldest job; `take_matching` then pulls
-    additional same-bucket jobs out of FIFO order (the micro-batcher's
-    gather — skipped jobs keep their relative order). All operations are
-    O(depth) under one lock; depth is bounded, so that is bounded too.
+    `pop` hands the worker the oldest job (policy attached: the
+    highest-priority one — class rank then EDF, FIFO-stable on ties);
+    `take_matching` then pulls additional same-bucket jobs out of FIFO
+    order (the micro-batcher's gather — skipped jobs keep their
+    relative order; policy attached: same-class mates fill first,
+    lower classes ride as free riders). The policy also makes
+    admission selective: `push` sheds lower classes before the hard
+    bound (policy.admit). All operations are O(depth) under one lock;
+    depth is bounded, so that is bounded too. No policy = the exact
+    pre-QoS FIFO behavior.
     """
 
-    def __init__(self, limit: int = 64):
+    def __init__(self, limit: int = 64, policy=None):
         if limit < 1:
             raise ValueError(f"queue limit must be >= 1, got {limit}")
         self.limit = limit
+        #: sched.qos.QosPolicy or None; read-only after construction
+        self.policy = policy
         self._items: list[Job] = []  # guarded-by: _lock
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -185,6 +211,14 @@ class JobQueue:
                 seconds, 1e-3
             )
 
+    def depth_by_class(self) -> dict:
+        """{class: queued count} — the readiness probe's per-class
+        view; empty when no policy is attached (QoS off)."""
+        if self.policy is None:
+            return {}
+        with self._lock:
+            return self.policy.depth_by_class(self._items)
+
     def _retry_after_locked(self) -> float:
         return min(max(1.0, len(self._items) * self._job_seconds), 60.0)
 
@@ -193,7 +227,11 @@ class JobQueue:
             return self._retry_after_locked()
 
     def push(self, job: Job) -> None:
-        """Admit a job or raise QueueFull; never blocks."""
+        """Admit a job or raise QueueFull; never blocks. With a QoS
+        policy attached the hard bound stays, but the policy may shed
+        FIRST — lower classes stop admitting at their fraction of the
+        bound, and the QueueFull carries that class's own Retry-After
+        (policy.admit runs under the queue lock; it only reads)."""
         with self._lock:
             if self._closed:
                 raise QueueFull(len(self._items), 1.0)
@@ -201,12 +239,19 @@ class JobQueue:
                 raise QueueFull(
                     len(self._items), self._retry_after_locked()
                 )
+            if self.policy is not None:
+                retry_after = self.policy.admit(
+                    job, self._items, self.limit
+                )
+                if retry_after is not None:
+                    raise QueueFull(len(self._items), retry_after)
             self._items.append(job)
             self._pushes += 1
             self._not_empty.notify_all()
 
     def pop(self, timeout: float | None = None) -> Job | None:
-        """Oldest job, or None on timeout/close."""
+        """Oldest job (policy attached: min by class rank then EDF,
+        arrival-stable on ties), or None on timeout/close."""
         with self._lock:
             deadline = None if timeout is None else time.monotonic() + timeout
             while not self._items:
@@ -218,22 +263,35 @@ class JobQueue:
                 if remaining is not None and remaining <= 0:
                     return None
                 self._not_empty.wait(remaining)
-            return self._items.pop(0)
+            if self.policy is None:
+                return self._items.pop(0)
+            # stable min over insertion order: equal keys = FIFO
+            best = min(
+                range(len(self._items)),
+                key=lambda i: (self.policy.job_key(self._items[i]), i),
+            )
+            return self._items.pop(best)
 
-    def take_matching(self, bucket, max_n: int) -> list[Job]:
+    def take_matching(self, bucket, max_n: int, leader: Job | None = None) -> list[Job]:
         """Remove and return up to max_n jobs whose bucket equals
-        `bucket` (None never matches); remaining jobs keep FIFO order."""
+        `bucket` (None never matches); remaining jobs keep FIFO order.
+        With a policy AND a `leader` (the job the gather is assembling
+        around), slot assignment follows the free-rider rule: mates of
+        the leader's class first, lower classes fill what is left — a
+        capped batch never displaces a same-class member for a free
+        rider."""
         if bucket is None or max_n <= 0:
             return []
-        taken: list[Job] = []
         with self._lock:
-            kept = []
-            for job in self._items:
-                if len(taken) < max_n and job.bucket == bucket:
-                    taken.append(job)
-                else:
-                    kept.append(job)
-            self._items = kept
+            matching = [j for j in self._items if j.bucket == bucket]
+            if self.policy is not None and leader is not None:
+                taken = self.policy.select_mates(leader, matching, max_n)
+            else:
+                taken = matching[:max_n]
+            chosen = {id(j) for j in taken}
+            self._items = [
+                j for j in self._items if id(j) not in chosen
+            ]
         return taken
 
     def wait_for_more(self, timeout: float) -> None:

@@ -218,6 +218,12 @@ class Worker(threading.Thread):
                     self._emit("runner_error", job)
         elapsed = time.monotonic() - t0
         self.queue.note_job_seconds(elapsed / len(live))
+        if self.queue.policy is not None:
+            # per-class drain rate: each member's class observed at the
+            # batch's per-job cost — the policy prices that class's
+            # Retry-After from it (sched.qos.QosPolicy.retry_after)
+            for job in live:
+                self.queue.policy.note_done(job.qos, elapsed / len(live))
         for job in live:
             if job.done_event.is_set():
                 continue
@@ -255,12 +261,17 @@ class Scheduler:
         watchdog_s: float = 0.5,
         wedge_grace_s: float = 10.0,
         on_worker_event=None,
+        queue_policy=None,
     ):
         self._runner = runner
         self._queue_limit = queue_limit
         self._window_s = window_s
         self._max_batch = max_batch
         self._on_event = on_event
+        # QoS policy (sched.qos.QosPolicy) shared by every backend's
+        # queue: priority pop / selective shed / free-rider gather.
+        # None (the default, and VRPMS_QOS=off) = plain FIFO queues.
+        self._queue_policy = queue_policy
         self._watchdog_s = watchdog_s
         self._wedge_grace_s = wedge_grace_s
         self._on_worker_event = on_worker_event
@@ -279,7 +290,7 @@ class Scheduler:
     def _make_worker(self, backend: str) -> Worker:
         return Worker(
             backend,
-            JobQueue(self._queue_limit),
+            JobQueue(self._queue_limit, policy=self._queue_policy),
             self._runner,
             self._window_s,
             self._max_batch,
@@ -322,6 +333,13 @@ class Scheduler:
     def queues(self) -> dict[str, int]:
         with self._lock:
             return {b: len(w.queue) for b, w in self._workers.items()}
+
+    def queues_by_class(self) -> dict[str, dict]:
+        """{backend: {class: depth}} — per-class admission-queue view
+        for the readiness probe; empty maps with no QoS policy."""
+        with self._lock:
+            pairs = list(self._workers.items())
+        return {b: w.queue.depth_by_class() for b, w in pairs}
 
     # -- supervision --------------------------------------------------------
     def worker_health(self) -> dict[str, str]:
