@@ -1,0 +1,258 @@
+"""Giant-instance decomposition benchmark (ISSUE 13).
+
+Two claims, one record (records/decompose_r17.json):
+
+  1. **End-to-end above the ladder.** A clustered CVRP with n >= 5000
+     customers — far beyond the tier ladder's top (n=1024), where the
+     monolithic path has no canonical shape to pad to and is not
+     attempted — solves through the full service path (run_vrp ->
+     decompose -> batched shard solves -> stitch) to a bounded gap vs
+     the shard-sum lower bound, with every customer served exactly once
+     and capacities respected.
+  2. **Batched shard dispatch.** The K same-tier shards dispatch as
+     ceil(K / max_batch) vmapped launches; on this overhead-bound trace
+     (small per-shard budgets, fixed per-launch costs dominating) the
+     batched dispatch beats a forced shard-by-shard loop by >= 1.3x
+     wall-clock at equal solver budget. Timed WARM (both program shapes
+     compiled first) so the comparison is dispatch economics, not
+     compile luck.
+
+Run: JAX_PLATFORMS=cpu python -m benchmarks.decompose \
+        --record benchmarks/records/decompose_r17.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import statistics
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+GAP_MAX = 1.5        # durationSum <= (1 + GAP_MAX) * shard-sum LB
+SPEEDUP_MIN = 1.3    # batched vs forced-solo wall clock
+
+
+def build_instance(n_nodes: int, n_vehicles: int, seed: int):
+    from vrpms_tpu.io.synth import synth_clustered_coords
+
+    coords, demands = synth_clustered_coords(
+        n_nodes, max(8, n_nodes // 125), seed=seed
+    )
+    d = np.linalg.norm(
+        coords[:, None] - coords[None, :], axis=-1
+    ).astype(np.float64)
+    cap = float(np.ceil(demands.sum() * 1.25 / n_vehicles))
+    locations = [
+        {"id": i, "demand": float(demands[i])} for i in range(n_nodes)
+    ]
+    params = {
+        "name": "decompose-bench",
+        "capacities": [cap] * n_vehicles,
+        "start_times": [0.0] * n_vehicles,
+        "ignored_customers": [],
+        "completed_customers": [],
+    }
+    return locations, d, params, demands
+
+
+def end_to_end(locations, d, params, opts):
+    from service.solve import run_vrp
+
+    errors: list = []
+    t0 = time.perf_counter()
+    res = run_vrp("sa", params, dict(opts), {}, locations, d, errors)
+    wall = time.perf_counter() - t0
+    assert res is not None, errors
+    served = sorted(c for v in res["vehicles"] for c in v["tour"][1:-1])
+    valid = served == list(range(1, len(locations)))
+    feasible = all(
+        v["load"] <= v["capacity"] + 1e-6 for v in res["vehicles"]
+    )
+    dec = res["decomposition"]
+    gap = (res["durationSum"] - dec["lowerBound"]) / dec["lowerBound"]
+    return {
+        "wallSeconds": round(wall, 2),
+        "durationSum": res["durationSum"],
+        "lowerBound": dec["lowerBound"],
+        "gap": round(gap, 4),
+        "shards": dec["shards"],
+        "launches": dec["launches"],
+        "maxBatch": dec["maxBatch"],
+        "tier": dec["tier"],
+        "boundary": dec["boundary"],
+        "reoptimized": dec["reoptimized"],
+        "rebalanced": dec["rebalanced"],
+        "allServedOnce": valid,
+        "capacityFeasible": feasible,
+    }
+
+
+def dispatch_trial(plan, params_sa, weights, seed, max_batch):
+    """One warm solve_shards pass; returns (wall, launches, cost sum)."""
+    from vrpms_tpu.core import decompose
+
+    insts = decompose.shard_instances(plan)
+    seeds = [seed + i for i in range(len(insts))]
+    t0 = time.perf_counter()
+    results, launches = decompose.solve_shards(
+        insts, seeds, params_sa, weights=weights, max_batch=max_batch
+    )
+    wall = time.perf_counter() - t0
+    cost = float(sum(float(r.cost) for r in results))
+    return wall, launches, cost
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=5001,
+                    help="node count incl. depot (default 5001)")
+    ap.add_argument("--vehicles", type=int, default=96)
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--iters", type=int, default=256,
+                    help="per-shard SA iterations of the END-TO-END run")
+    ap.add_argument("--chains", type=int, default=16)
+    ap.add_argument("--dispatch-iters", type=int, default=64,
+                    help="per-shard iterations of the timed dispatch "
+                    "trials (small on purpose: the overhead-bound "
+                    "regime where per-launch fixed costs dominate)")
+    ap.add_argument("--dispatch-chains", type=int, default=4)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--tier", type=int, default=128,
+                    help="shard node tier (VRPMS_DECOMP_TIER)")
+    ap.add_argument("--record", type=str, default=None)
+    ap.add_argument("--note", type=str, default=None)
+    args = ap.parse_args()
+
+    os.environ["VRPMS_DECOMP"] = "auto"
+    os.environ["VRPMS_DECOMP_TIER"] = str(args.tier)
+
+    import jax
+
+    from vrpms_tpu.core import decompose
+    from vrpms_tpu.core.cost import CostWeights
+    from vrpms_tpu.solvers import SAParams
+
+    locations, d, params, demands = build_instance(
+        args.n, args.vehicles, args.seed
+    )
+    opts = {
+        "seed": args.seed,
+        "iteration_count": args.iters,
+        "population_size": args.chains,
+    }
+
+    print(f"[1/3] end-to-end run_vrp: n={args.n - 1} customers, "
+          f"{args.vehicles} vehicles, tier {args.tier}", flush=True)
+    e2e = end_to_end(locations, d, params, opts)
+    print(json.dumps(e2e, indent=2), flush=True)
+
+    print("[2/3] dispatch trials (warmup + timed)", flush=True)
+    plan = decompose.build_plan(
+        d, [loc["demand"] for loc in locations],
+        [0.0] * len(locations), params["capacities"],
+        params["start_times"], seed=args.seed,
+    )
+    w = CostWeights.make()
+    params_sa = SAParams(
+        n_chains=args.dispatch_chains, n_iters=args.dispatch_iters
+    )
+    k = plan.n_shards
+    # warm both program families (batched chunk shapes + solo) so the
+    # timed comparison is dispatch economics, not compile order
+    dispatch_trial(plan, params_sa, w, args.seed, 16)
+    dispatch_trial(plan, params_sa, w, args.seed, 1)
+
+    print("[3/3] timed batched vs forced-solo "
+          f"(median of {args.trials})", flush=True)
+    b_walls, s_walls = [], []
+    for _ in range(args.trials):
+        wall, b_launches, b_cost = dispatch_trial(
+            plan, params_sa, w, args.seed, 16
+        )
+        b_walls.append(wall)
+        wall, s_launches, s_cost = dispatch_trial(
+            plan, params_sa, w, args.seed, 1
+        )
+        s_walls.append(wall)
+    b_wall = statistics.median(b_walls)
+    s_wall = statistics.median(s_walls)
+    speedup = s_wall / b_wall if b_wall > 0 else float("inf")
+
+    gate = {
+        "pass": bool(
+            e2e["allServedOnce"]
+            and e2e["capacityFeasible"]
+            and e2e["gap"] <= GAP_MAX
+            and e2e["launches"] == math.ceil(e2e["shards"] / e2e["maxBatch"])
+            and b_launches == math.ceil(k / 16)
+            and speedup >= SPEEDUP_MIN
+        ),
+        "gap": e2e["gap"],
+        "gapMax": GAP_MAX,
+        "launches": b_launches,
+        "launchesMax": math.ceil(k / 16),
+        "speedup": round(speedup, 2),
+        "speedupMin": SPEEDUP_MIN,
+    }
+    record = {
+        "benchmark": "decompose",
+        "backend": jax.default_backend(),
+        "note": args.note,
+        "config": {
+            "n": args.n,
+            "vehicles": args.vehicles,
+            "seed": args.seed,
+            "iterationCount": args.iters,
+            "populationSize": args.chains,
+            "dispatchIters": args.dispatch_iters,
+            "dispatchChains": args.dispatch_chains,
+            "trials": args.trials,
+            "shardTier": args.tier,
+            "ladderTop": decompose.ceiling(),
+        },
+        "monolithic": {
+            "attempted": False,
+            "reason": (
+                "above the tier ladder top (n=1024): no canonical tier "
+                "to pad to, the TD delta kernel gates at n<=512, and a "
+                "one-off n=5001 SA program would compile multi-GB state "
+                "no other request shares — the exact ceiling the "
+                "decomposition converts into a throughput knob"
+            ),
+        },
+        "endToEnd": e2e,
+        "dispatch": {
+            "shards": k,
+            "batched": {
+                "wallSeconds": round(b_wall, 3),
+                "walls": [round(x, 3) for x in b_walls],
+                "launches": b_launches,
+                "costSum": round(b_cost, 1),
+            },
+            "solo": {
+                "wallSeconds": round(s_wall, 3),
+                "walls": [round(x, 3) for x in s_walls],
+                "launches": s_launches,
+                "costSum": round(s_cost, 1),
+            },
+            "speedup": round(speedup, 2),
+        },
+        "gate": gate,
+    }
+    print(json.dumps(record["dispatch"], indent=2))
+    print("gate:", json.dumps(gate))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        print(f"record written to {args.record}")
+
+
+if __name__ == "__main__":
+    main()

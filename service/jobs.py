@@ -352,7 +352,21 @@ def _attach_sink(job: Job, prep: Prepared) -> None:
     every snapshot can carry a gap. With progress off the job carries
     no sink and the solve path is byte-identical to the pre-progress
     contract."""
-    if not progress.enabled() or prep is None or prep.inst is None:
+    if not progress.enabled() or prep is None:
+        return
+    if prep.inst is None:
+        # decomposed giant requests carry no monolithic Instance; the
+        # plan's shard-sum bound (per-shard MST, summed at plan build —
+        # ms-scale where the monolithic quick bound is quadratic in n)
+        # is the gap reference the rollup stream reports against
+        if prep.decomp is None:
+            return
+        job.sink = progress.ProgressSink(
+            job_id=job.id,
+            problem=prep.problem,
+            algorithm=prep.algorithm,
+            lower_bound=prep.decomp.lower_bound,
+        )
         return
     from vrpms_tpu.io.bounds import quick_lower_bound
 

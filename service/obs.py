@@ -85,6 +85,26 @@ SCHED_BATCH_SIZE = REGISTRY.histogram(
     "Jobs merged into one scheduler launch (1 = solo)",
     buckets=(1, 2, 4, 8, 16, 32, 64),
 )
+DECOMP_SHARDS = REGISTRY.histogram(
+    "vrpms_decomp_shards",
+    "Shards one giant-instance decomposed solve was partitioned into "
+    "(core.decompose; recorded once per decomposed request)",
+    buckets=(2, 4, 8, 16, 32, 64, 128),
+)
+DECOMP_LAUNCHES = REGISTRY.histogram(
+    "vrpms_decomp_launches",
+    "Vmapped batched launches one decomposed solve dispatched its "
+    "shards as (ceil(shards / VRPMS_SCHED_MAX_BATCH) when healthy — "
+    "a value near the shard count means batching degraded to solo "
+    "solves)",
+    buckets=(1, 2, 4, 8, 16, 32),
+)
+DECOMP_BOUNDARY = REGISTRY.histogram(
+    "vrpms_decomp_boundary_customers",
+    "Customers in the cross-shard boundary band repaired by the "
+    "stitch pass (re-opt solve or capacity-aware reinsertion)",
+    buckets=(0, 8, 16, 32, 64, 128, 256, 512),
+)
 QOS_QUEUE_WAIT = REGISTRY.histogram(
     "vrpms_qos_queue_wait_seconds",
     "Time jobs spent queued before their solve started, by QoS class "

@@ -40,8 +40,11 @@ from service import obs
 from service import cache as solution_cache
 from vrpms_tpu.obs import collect_blocks, convergence_summary, log_event, spans
 
+from vrpms_tpu import config
+from vrpms_tpu.core import decompose
 from vrpms_tpu.core import make_instance
 from vrpms_tpu.core import tiers
+from vrpms_tpu.obs import progress
 from vrpms_tpu.core.encoding import routes_from_giant
 from vrpms_tpu.core.split import greedy_split_giant
 from vrpms_tpu.solvers import (
@@ -544,6 +547,10 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
                 deadline_s=_deadline(opts),
                 init_perm=warm,
                 pool=pool,
+                # explicit re-solve seeds pre-deposit the seed tour's
+                # pheromone hard (aco.CONTINUATION_DEPOSIT) so the
+                # colony refines instead of re-exploring
+                continuation=continuation,
             )
         if algorithm == "ga":
             population = int(pop or (ga_params or {}).get("random_permutationCount") or 128)
@@ -588,11 +595,22 @@ def _solve_instance(inst, algorithm, opts, ga_params, errors, problem, warm=None
             init = None
             if warm is not None:
                 # Whole population seeded from the checkpointed order
-                # (see the SA warm branch above for the rationale).
+                # (see the SA warm branch above for the rationale). A
+                # CONTINUATION seed (explicit re-solve source) uses the
+                # graded ramp instead: most of the population stays in
+                # the seed's basin, a heavy tail keeps diversity.
                 from vrpms_tpu.core.cost import resolve_eval_mode
-                from vrpms_tpu.solvers.ga import perturbed_perm_clones
+                from vrpms_tpu.solvers.ga import (
+                    continuation_perm_ramp,
+                    perturbed_perm_clones,
+                )
 
-                init = perturbed_perm_clones(
+                seed_pop = (
+                    continuation_perm_ramp
+                    if continuation
+                    else perturbed_perm_clones
+                )
+                init = seed_pop(
                     jax.random.key(seed + 1),
                     p.population,
                     warm,
@@ -855,9 +873,14 @@ class Prepared:
     cached: dict | None = None
     # dynamic re-solve context (service.cache._attach_resolve): how an
     # explicit warmStart spec resolved — {seedSource, seeded, jobId?}.
-    # A seeded resolve drives SA's continuation schedule and is
+    # A seeded resolve drives the solver continuation schedules and is
     # disclosed under stats.resolve
     resolve: dict | None = None
+    # giant-instance decomposition (core.decompose): the cluster plan a
+    # request above the tier ladder top solves through instead of a
+    # monolithic Instance (prep.inst stays None — the whole point is
+    # never materializing the giant padded tensors)
+    decomp: object = None
 
 
 def prepare_vrp(algorithm, params, opts, ga_params, locations, matrix,
@@ -907,6 +930,43 @@ def prepare_vrp(algorithm, params, opts, ga_params, locations, matrix,
     if n_customers == 0:
         prep.trivial = {"durationMax": 0, "durationSum": 0, "vehicles": []}
         return prep
+
+    # Giant-instance decomposition (core.decompose): above the tier
+    # ladder top there is no canonical shape to pad to, so the request
+    # clusters into same-tier shards instead of building a monolithic
+    # Instance. Strictly a superset gate: any instance that fits one
+    # tier falls through to the exact path below, byte-identically.
+    if (
+        decompose.engaged(
+            "vrp", algorithm, len(active_pos), opts
+        )
+        and arrays["ready"] is None
+        and np.asarray(arrays["durations"]).ndim == 2
+    ):
+        try:
+            with spans.span("decompose", phase="plan"):
+                prep.decomp = decompose.build_plan(
+                    arrays["durations"],
+                    arrays["demands"],
+                    arrays["service"],
+                    prep.capacities,
+                    [float(t) for t in start_times],
+                    slice_minutes=slice_minutes,
+                    seed=int(opts.get("seed") or 0),
+                )
+        except ValueError as e:
+            # an unplannable instance (e.g. fewer vehicles than tier
+            # shards) falls THROUGH to the monolithic path below — it
+            # solved there before decomposition existed, and a
+            # default-on optimization must never turn a solvable
+            # request into an error
+            log_event("decompose.fallback", reason=str(e))
+            prep.decomp = None
+        if prep.decomp is not None:
+            prep.orig_ids = [locations[i]["id"] for i in active_pos]
+            # no cache attach: fingerprinting would materialize exactly
+            # the giant padded tensors this path exists to avoid
+            return prep
 
     prep.inst = make_instance(
         arrays["durations"],
@@ -999,12 +1059,151 @@ def _mark_degraded(prep: Prepared, result: dict) -> dict:
     return result
 
 
+def _solve_decomposed(prep: Prepared, errors) -> dict | None:
+    """The giant-instance path: cluster plan -> batched same-tier shard
+    solves -> stitch + boundary repair -> contract-shaped result.
+
+    Shards dispatch through sched.batch.solve_sa_batch in chunks of
+    VRPMS_SCHED_MAX_BATCH (ceil(K / max_batch) vmapped launches), with
+    per-shard incumbents rolled up into the job's single progress sink;
+    the request deadline splits 80/20 between the shard solves and the
+    boundary re-opt. The response gains a `decomposition` block —
+    additive only above the ladder ceiling, where no pre-decomposition
+    response existed to stay byte-identical to.
+    """
+    from vrpms_tpu.solvers import SAParams
+
+    plan = prep.decomp
+    opts = prep.opts
+    t0 = time.perf_counter()
+    w = _request_weights(opts)
+    seed = int(opts.get("seed") or 0)
+    params = SAParams(
+        n_chains=int(opts.get("population_size") or 128),
+        n_iters=int(opts.get("iteration_count") or 5000),
+    )
+    deadline = _deadline(opts)
+    max_batch = max(1, int(config.get("VRPMS_SCHED_MAX_BATCH")))
+    sink = progress.active_sink()
+    rollup = decompose.ShardRollup(sink, plan.n_shards)
+    with _device_ctx(opts.get("backend")):
+        with spans.span("decompose", shards=plan.n_shards, tier=plan.tier_n):
+            insts = decompose.shard_instances(plan)
+        seeds = [seed + i for i in range(len(insts))]
+        with spans.span(
+            "solver.solve", algorithm=prep.algorithm, problem=prep.problem
+        ):
+            results, launches = decompose.solve_shards(
+                insts,
+                seeds,
+                params,
+                weights=w,
+                deadline_s=None if deadline is None else 0.8 * deadline,
+                max_batch=max_batch,
+                rollup=rollup,
+            )
+        with spans.span("stitch", boundary=int(plan.boundary.size)):
+            routes = decompose.stitch(plan, results)
+            # keep-best guard: the rolled-up shard solution the progress
+            # stream already published IS a feasible full solution; the
+            # boundary repair must never ship anything worse (and the
+            # final publish_total then always respects the stream's
+            # monotone non-increasing contract)
+            baseline = [list(r) for r in routes]
+            ev0 = decompose.evaluate_routes(plan, baseline)
+            remaining = (
+                None
+                if deadline is None
+                else max(0.0, deadline - (time.perf_counter() - t0))
+            )
+            report = decompose.repair_boundary(
+                plan, routes, seed=seed, weights=w, deadline_s=remaining,
+                n_chains=params.n_chains,
+            )
+            report["rebalanced"] = decompose.rebalance_capacity(plan, routes)
+    ev = decompose.evaluate_routes(plan, routes)
+    # the untimed penalized objective, exactly total_cost's terms (the
+    # engagement gate excludes TW/TD/makespan so the other terms are 0)
+    cap_w = float(np.asarray(w.cap))
+    chk_cost = ev["distance"] + cap_w * ev["cap_excess"]
+    cost0 = ev0["distance"] + cap_w * ev0["cap_excess"]
+    if chk_cost > cost0 + 1e-6:
+        routes, ev, chk_cost = baseline, ev0, cost0
+        report["reverted"] = True
+    rollup.publish_total(chk_cost)
+    wall_s = time.perf_counter() - t0
+    evals = sum(int(r.evals) for r in results) + report.get("reoptEvals", 0)
+    trace_id = spans.current_trace_id()
+    obs.SOLVE_SECONDS.labels(
+        problem=prep.problem, algorithm=prep.algorithm
+    ).observe(wall_s, trace_id=trace_id)
+    obs.SOLVE_EVALS.observe(float(evals))
+    obs.DECOMP_SHARDS.observe(float(plan.n_shards))
+    obs.DECOMP_LAUNCHES.observe(float(launches))
+    obs.DECOMP_BOUNDARY.observe(float(report.get("boundary", 0)))
+
+    depot_id = prep.anchor_id
+    vehicles = []
+    for v, route in enumerate(routes):
+        if not route:
+            continue
+        vehicles.append(
+            {
+                "id": v,
+                "capacity": float(prep.capacities[v]),
+                "tour": [depot_id]
+                + [prep.orig_ids[c] for c in route]
+                + [depot_id],
+                "duration": float(ev["route_durations"][v]),
+                "load": float(ev["route_loads"][v]),
+            }
+        )
+    result = {
+        "durationMax": ev["duration_max"],
+        "durationSum": ev["duration_sum"],
+        "vehicles": vehicles,
+        "decomposition": {
+            "shards": plan.n_shards,
+            "launches": launches,
+            "maxBatch": max_batch,
+            "tier": plan.tier_n,
+            "boundary": report.get("boundary", 0),
+            "reoptimized": bool(report.get("reoptimized")),
+            "rebalanced": report.get("rebalanced", 0),
+            "lowerBound": plan.lower_bound,
+        },
+    }
+    if opts.get("include_stats"):
+        result["stats"] = {
+            "algorithm": prep.algorithm,
+            "evals": evals,
+            "wallMs": round(wall_s * 1e3, 1),
+            "backend": jax.default_backend(),
+            "warmStart": False,
+            "localSearch": False,
+        }
+    routes_ids = [v["tour"][1:-1] for v in vehicles]
+    if prep.database is not None:
+        with spans.span("store.persist", table="warmstarts"):
+            prep.database.save_warmstart(
+                prep.params["name"],
+                {"problem": "vrp", "routes": routes_ids, "cost": chk_cost},
+                better_than=lambda prev: _better_checkpoint(
+                    prev, "vrp", routes_ids, chk_cost
+                ),
+            )
+    return _mark_degraded(prep, result)
+
+
 def solve_prepared(prep: Prepared, errors) -> dict | None:
     """Run a Prepared request end to end on the calling thread: device
     dispatch + decode + checkpoint save. The scheduler worker's solo
     path, and (composed under _enveloped) run_vrp/run_tsp's tail."""
     if prep.trivial is not None:
         return _mark_degraded(prep, solution_cache.mark_trivial(prep))
+    if prep.decomp is not None:
+        # giant-instance path: cluster -> batched shard solves -> stitch
+        return _solve_decomposed(prep, errors)
     if prep.cached is not None:
         # exact cache hit that reached the inline path (VRPMS_SCHED=off
         # or a direct run_vrp/run_tsp call): serve without solving
@@ -1022,9 +1221,22 @@ def solve_prepared(prep: Prepared, errors) -> dict | None:
     if res is None:
         return None
     if stats is not None and prep.resolve is not None:
+        # every metaheuristic now has a real continuation schedule: SA
+        # re-enters at the seed-estimated temperature, GA ramps the
+        # seeded population, ACO pre-deposits the seed tour's pheromone.
+        # The GA/ACO ISLAND paths still consume seeds through the plain
+        # warm handling, so the flag stays honest there (SA applies
+        # continuation_params before its islands split)
         stats["resolve"] = dict(
             prep.resolve,
-            continuation=continuation and prep.algorithm == "sa",
+            continuation=continuation
+            and (
+                prep.algorithm == "sa"
+                or (
+                    prep.algorithm in ("ga", "aco")
+                    and not prep.opts.get("islands")
+                )
+            ),
         )
     if prep.problem == "vrp":
         return finish_vrp(prep, res, stats, extras, errors)

@@ -1,0 +1,518 @@
+"""Giant-instance decomposition (cluster -> batched tier solves ->
+stitch): the oracle-equivalence, stitch-validity, and batched-launch
+contracts of vrpms_tpu.core.decompose + service wiring (VRPMS_DECOMP),
+plus the satellites that ride with it — GA/ACO continuation schedules,
+the shard-sum lower bound, and the streamed CVRPLIB parse.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from vrpms_tpu.core import decompose
+from vrpms_tpu.io.synth import synth_clustered_coords
+
+#: a deliberately tiny ladder so decomposition engages at test sizes
+#: (ceiling 32 nodes) without paying giant compiles
+SMALL_LADDER = "n=8,16,32;v=1,2,4,8;t=1"
+
+
+def _euclid(coords):
+    return np.linalg.norm(coords[:, None] - coords[None, :], axis=-1)
+
+
+def _giant_request(n_nodes=61, n_vehicles=6, seed=3, slack=1.3):
+    coords, demands = synth_clustered_coords(n_nodes, 4, seed=seed)
+    d = _euclid(coords)
+    locations = [
+        {"id": i, "demand": float(demands[i])} for i in range(n_nodes)
+    ]
+    cap = float(np.ceil(demands.sum() * slack / n_vehicles))
+    params = {
+        "name": "giant",
+        "capacities": [cap] * n_vehicles,
+        "start_times": [0.0] * n_vehicles,
+        "ignored_customers": [],
+        "completed_customers": [],
+    }
+    opts = {"seed": 7, "iteration_count": 300, "population_size": 16}
+    return locations, d, params, opts
+
+
+def _run(params, opts, locations, matrix):
+    from service.solve import run_vrp
+
+    errors: list = []
+    res = run_vrp("sa", params, opts, {}, locations, matrix, errors)
+    assert res is not None, errors
+    assert not errors, errors
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Partitioning + plan invariants
+# ---------------------------------------------------------------------------
+
+
+class TestPartition:
+    def test_matrix_partition_covers_every_customer_once(self):
+        coords, _ = synth_clustered_coords(80, 5, seed=1)
+        labels, dist = decompose.partition_matrix(_euclid(coords), 4, 25)
+        assert labels.shape == (79,) and dist.shape == (79, 4)
+        counts = np.bincount(labels, minlength=4)
+        assert counts.sum() == 79 and counts.max() <= 25
+
+    def test_coords_partition_covers_every_customer_once(self):
+        coords, _ = synth_clustered_coords(80, 5, seed=2)
+        labels, dist = decompose.partition_coords(coords, 4, 25, seed=0)
+        counts = np.bincount(labels, minlength=4)
+        assert counts.sum() == 79 and counts.max() <= 25
+
+    def test_partitions_are_deterministic(self):
+        coords, _ = synth_clustered_coords(60, 4, seed=5)
+        d = _euclid(coords)
+        a = decompose.partition_matrix(d, 3, 25)[0]
+        b = decompose.partition_matrix(d, 3, 25)[0]
+        assert np.array_equal(a, b)
+
+    def test_boundary_band_is_frontier_subset_and_capped(self):
+        coords, _ = synth_clustered_coords(80, 5, seed=1)
+        labels, dist = decompose.partition_matrix(_euclid(coords), 4, 25)
+        band = decompose.boundary_band(labels, dist, ratio=1.5, cap=10)
+        assert band.size <= 10
+        assert band.size == np.unique(band).size
+        assert band.size == 0 or (band.min() >= 1 and band.max() <= 79)
+
+
+class TestPlan:
+    def test_fleet_slices_disjoint_and_cover(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        locations, d, params, _ = _giant_request()
+        demands = [loc["demand"] for loc in locations]
+        plan = decompose.build_plan(
+            d, demands, [0.0] * len(locations), params["capacities"],
+            params["start_times"],
+        )
+        all_members = np.concatenate(plan.members)
+        assert np.array_equal(
+            np.sort(all_members), np.arange(1, len(locations))
+        )
+        used = np.concatenate(
+            list(plan.vehicles) + [plan.boundary_vehicles]
+        )
+        assert used.size == np.unique(used).size
+        assert used.size <= len(params["capacities"])
+        assert set(plan.boundary) <= set(all_members.tolist())
+        assert plan.tier_n == 32  # shards fit one canonical tier
+        assert plan.lower_bound is not None and plan.lower_bound > 0
+
+    def test_too_few_vehicles_raises_in_core(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        locations, d, params, _ = _giant_request(n_vehicles=1)
+        demands = [loc["demand"] for loc in locations]
+        with pytest.raises(ValueError, match="vehicles"):
+            decompose.build_plan(
+                d, demands, [0.0] * len(locations),
+                params["capacities"], params["start_times"],
+            )
+
+    def test_unplannable_fleet_falls_back_monolithic(self, monkeypatch):
+        """A default-on optimization must never turn a solvable request
+        into an error: too few vehicles for the shard count keeps the
+        pre-decomposition monolithic path."""
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        locations, d, params, opts = _giant_request(n_vehicles=1)
+        # one huge vehicle: monolithically solvable, never decomposable
+        params["capacities"] = [1e9]
+        opts = dict(opts, iteration_count=100)
+        res = _run(params, opts, locations, d)
+        assert "decomposition" not in res
+        served = sorted(
+            c for v in res["vehicles"] for c in v["tour"][1:-1]
+        )
+        assert served == list(range(1, len(locations)))
+
+    def test_shard_sum_bound_floors_shard_respecting_routes(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        locations, d, params, _ = _giant_request()
+        demands = [loc["demand"] for loc in locations]
+        plan = decompose.build_plan(
+            d, demands, [0.0] * len(locations), params["capacities"],
+            params["start_times"],
+        )
+        # one round trip per shard (a valid shard-respecting route set)
+        total = sum(
+            d[0, m[0]]
+            + sum(d[a, b] for a, b in zip(m[:-1], m[1:]))
+            + d[m[-1], 0]
+            for m in plan.members
+        )
+        assert plan.lower_bound <= total + 1e-6
+
+
+class TestRepairPrimitives:
+    def test_strip_band_preserves_relative_order(self):
+        routes = [[5, 2, 9], [7, 3], []]
+        order = decompose.strip_band(routes, np.asarray([2, 3, 9]))
+        assert order == [2, 9, 3]
+        assert routes == [[5], [7], []]
+
+    def test_rebalance_restores_feasibility(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        locations, d, params, _ = _giant_request()
+        demands = np.asarray([loc["demand"] for loc in locations])
+        plan = decompose.build_plan(
+            d, demands, [0.0] * len(locations), params["capacities"],
+            params["start_times"],
+        )
+        caps = plan.arrays["capacities"]
+        # cram everything onto vehicle 0: grossly infeasible
+        routes = [list(range(1, len(locations)))] + [
+            [] for _ in range(len(caps) - 1)
+        ]
+        decompose.rebalance_capacity(plan, routes)
+        loads = [sum(demands[c] for c in r) for r in routes]
+        assert all(l <= c + 1e-6 for l, c in zip(loads, caps))
+        served = sorted(c for r in routes for c in r)
+        assert served == list(range(1, len(locations)))
+
+
+class TestShardRollup:
+    class _Sink:
+        def __init__(self):
+            self.calls = []
+            self.cancelled = False
+
+        def record(self, best, iters, evals_per_iter):
+            # mirror ProgressSink: unreadable best counts evals only
+            try:
+                cost = float(np.min(np.asarray(best)))
+            except Exception:
+                cost = None
+            self.calls.append((cost, iters))
+
+        def note_cancel_seen(self):
+            pass
+
+    def test_rollup_publishes_monotone_sum_once_complete(self):
+        sink = self._Sink()
+        roll = decompose.ShardRollup(sink, 2)
+        roll.begin([0])
+        roll.record(np.asarray([[10.0, 12.0]]), 5, 1.0)
+        # shard 1 has no incumbent yet: eval-only forward, no cost
+        assert sink.calls[-1][0] is None
+        roll.begin([1])
+        roll.record(np.asarray([[7.0, 9.0]]), 5, 1.0)
+        assert sink.calls[-1][0] == pytest.approx(17.0)
+        roll.record(np.asarray([[6.0, 9.0]]), 5, 1.0)
+        assert sink.calls[-1][0] == pytest.approx(16.0)
+        roll.publish_total(15.5)
+        assert sink.calls[-1][0] == pytest.approx(15.5)
+
+
+# ---------------------------------------------------------------------------
+# The decomposition oracle: off == on below the ceiling, byte-identical
+# ---------------------------------------------------------------------------
+
+
+class TestOracleEquivalence:
+    def _small_request(self):
+        rng = np.random.default_rng(0)
+        n = 13
+        coords = rng.uniform(0, 100, size=(n, 2))
+        d = _euclid(coords)
+        locations = [{"id": i, "demand": 1.0} for i in range(n)]
+        params = {
+            "name": "small",
+            "capacities": [8.0, 8.0],
+            "start_times": [0.0, 0.0],
+            "ignored_customers": [],
+            "completed_customers": [],
+        }
+        opts = {"seed": 5, "iteration_count": 200, "population_size": 8}
+        return locations, d.tolist(), params, opts
+
+    @pytest.mark.parametrize("mode", ["on", "auto"])
+    def test_within_one_tier_decomp_is_a_byte_identical_noop(
+        self, monkeypatch, mode
+    ):
+        locations, d, params, opts = self._small_request()
+        monkeypatch.setenv("VRPMS_DECOMP", "off")
+        off = _run(params, dict(opts), locations, d)
+        monkeypatch.setenv("VRPMS_DECOMP", mode)
+        on = _run(params, dict(opts), locations, d)
+        assert json.dumps(off, sort_keys=True) == json.dumps(
+            on, sort_keys=True
+        )
+        assert "decomposition" not in on
+
+
+# ---------------------------------------------------------------------------
+# The full service path above the ceiling
+# ---------------------------------------------------------------------------
+
+
+class TestDecomposedService:
+    @pytest.mark.parametrize("seed", [3, 11])
+    def test_giant_request_solves_valid_and_bounded(
+        self, monkeypatch, seed
+    ):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        locations, d, params, opts = _giant_request(seed=seed)
+        res = _run(params, opts, locations, d)
+        dec = res["decomposition"]
+        assert dec["shards"] >= 2 and dec["tier"] == 32
+        assert dec["launches"] == -(-dec["shards"] // dec["maxBatch"])
+        # every customer served exactly once
+        served = sorted(
+            c for v in res["vehicles"] for c in v["tour"][1:-1]
+        )
+        assert served == list(range(1, len(locations)))
+        # capacity respected after boundary repair + rebalance
+        for v in res["vehicles"]:
+            assert v["load"] <= v["capacity"] + 1e-6
+            assert v["tour"][0] == 0 and v["tour"][-1] == 0
+        # bounded gap vs the shard-sum lower bound
+        assert dec["lowerBound"] is not None
+        assert res["durationSum"] >= dec["lowerBound"] - 1e-6
+        assert res["durationSum"] <= 4.0 * dec["lowerBound"]
+
+    def test_forced_solo_dispatch_launches_per_shard(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        monkeypatch.setenv("VRPMS_SCHED_MAX_BATCH", "1")
+        locations, d, params, opts = _giant_request()
+        res = _run(params, opts, locations, d)
+        dec = res["decomposition"]
+        assert dec["maxBatch"] == 1
+        assert dec["launches"] == dec["shards"]
+
+    def test_decomp_off_keeps_the_monolithic_path(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        monkeypatch.setenv("VRPMS_DECOMP", "off")
+        locations, d, params, opts = _giant_request()
+        opts = dict(opts, iteration_count=100)
+        res = _run(params, opts, locations, d)
+        assert "decomposition" not in res
+        served = sorted(
+            c for v in res["vehicles"] for c in v["tour"][1:-1]
+        )
+        assert served == list(range(1, len(locations)))
+
+    def test_unsupported_options_keep_the_monolithic_path(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        locations, d, params, opts = _giant_request()
+        opts = dict(opts, iteration_count=100, local_search=True)
+        res = _run(params, opts, locations, d)
+        assert "decomposition" not in res
+
+    def test_deterministic_at_fixed_seed(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        locations, d, params, opts = _giant_request()
+        a = _run(params, dict(opts), locations, d)
+        b = _run(params, dict(opts), locations, d)
+        assert json.dumps(a, sort_keys=True) == json.dumps(
+            b, sort_keys=True
+        )
+
+
+# ---------------------------------------------------------------------------
+# Streamed CVRPLIB parse (no O(n^2) matrix for giant files)
+# ---------------------------------------------------------------------------
+
+
+def _vrp_text(coords, demands, capacity, k=4):
+    n = len(coords)
+    lines = [
+        f"NAME : synth-n{n}-k{k}",
+        "TYPE : CVRP",
+        f"DIMENSION : {n}",
+        "EDGE_WEIGHT_TYPE : EUC_2D",
+        f"CAPACITY : {capacity}",
+        "NODE_COORD_SECTION",
+    ]
+    lines += [
+        f"{i + 1} {coords[i][0]:.1f} {coords[i][1]:.1f}" for i in range(n)
+    ]
+    lines.append("DEMAND_SECTION")
+    lines += [f"{i + 1} {int(demands[i])}" for i in range(n)]
+    lines += ["DEPOT_SECTION", "1", "-1", "EOF"]
+    return "\n".join(lines)
+
+
+class TestStreamedCvrplib:
+    def test_streamed_parse_skips_matrix_and_keeps_coords(self):
+        from vrpms_tpu.io.cvrplib import parse_cvrplib
+
+        coords, demands = synth_clustered_coords(30, 3, seed=4)
+        text = _vrp_text(coords, demands, 50)
+        inst, meta = parse_cvrplib(text, max_dense_n=10)
+        assert inst is None and meta["streamed"] is True
+        assert meta["coords"].shape == (30, 2)
+        assert len(meta["demands"]) == 30
+        assert len(meta["capacities"]) == 4  # the -k4 NAME suffix
+
+    def test_shard_matrix_matches_dense_parse(self):
+        from vrpms_tpu.io.cvrplib import parse_cvrplib, shard_matrix
+
+        coords, demands = synth_clustered_coords(30, 3, seed=4)
+        text = _vrp_text(coords, demands, 50)
+        dense, _ = parse_cvrplib(text)
+        _, meta = parse_cvrplib(text, max_dense_n=10)
+        nodes = [0, 3, 7, 21]
+        sub = shard_matrix(meta["coords"], nodes)
+        full = np.asarray(dense.durations[0])[np.ix_(nodes, nodes)]
+        np.testing.assert_allclose(sub, full, atol=1e-5)
+        # _Dist's coords-mode accessors are the same convention: the
+        # submatrix delegates to shard_matrix and the scalar leg must
+        # match it entry for entry
+        dist = decompose._Dist(
+            {"coords": meta["coords"], "round_nint": True}
+        )
+        np.testing.assert_allclose(dist.sub(nodes), sub, atol=1e-5)
+        assert dist.point(3, 21) == pytest.approx(float(sub[1, 3]))
+
+    def test_streamed_giant_solves_without_dense_matrix(
+        self, monkeypatch
+    ):
+        """The full streamed pipeline: parse (no O(n^2) matrix) ->
+        coords plan -> batched shard solves -> stitch -> valid routes,
+        with every submatrix built on demand from coordinates."""
+        from vrpms_tpu.core.cost import CostWeights
+        from vrpms_tpu.io.cvrplib import parse_cvrplib
+        from vrpms_tpu.solvers import SAParams
+
+        monkeypatch.setenv("VRPMS_TIERS", SMALL_LADDER)
+        coords, demands = synth_clustered_coords(61, 4, seed=3)
+        cap = float(np.ceil(demands.sum() * 1.3 / 6))
+        text = _vrp_text(coords, demands, cap, k=6)
+        inst, meta = parse_cvrplib(text, max_dense_n=32)
+        assert inst is None and meta["streamed"] is True
+        plan = decompose.build_plan(
+            None,
+            meta["demands"],
+            [0.0] * 61,
+            meta["capacities"],
+            meta["start_times"],
+            coords=meta["coords"],
+            round_nint=meta["round_nint"],
+        )
+        assert "durations" not in plan.arrays  # nothing O(n^2) exists
+        assert plan.lower_bound is not None and plan.lower_bound > 0
+        w = CostWeights.make()
+        insts = decompose.shard_instances(plan)
+        results, launches = decompose.solve_shards(
+            insts, list(range(len(insts))),
+            SAParams(n_chains=8, n_iters=100), weights=w,
+        )
+        assert launches == 1
+        routes = decompose.stitch(plan, results)
+        decompose.repair_boundary(plan, routes, seed=1, weights=w)
+        decompose.rebalance_capacity(plan, routes)
+        served = sorted(c for r in routes for c in r)
+        assert served == list(range(1, 61))
+        ev = decompose.evaluate_routes(plan, routes)
+        assert ev["cap_excess"] == 0.0
+        assert ev["distance"] >= plan.lower_bound - 1e-6
+
+    def test_dense_parse_unchanged_below_threshold(self):
+        from vrpms_tpu.io.cvrplib import parse_cvrplib
+
+        coords, demands = synth_clustered_coords(12, 2, seed=4)
+        text = _vrp_text(coords, demands, 50)
+        a, _ = parse_cvrplib(text)
+        b, meta = parse_cvrplib(text, max_dense_n=100)
+        assert b is not None and "streamed" not in meta
+        np.testing.assert_array_equal(
+            np.asarray(a.durations), np.asarray(b.durations)
+        )
+
+
+# ---------------------------------------------------------------------------
+# GA / ACO continuation schedules (the warm-seed satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestContinuationSchedules:
+    def test_ga_ramp_keeps_slot0_exact_and_perms_valid(self):
+        import jax
+
+        from vrpms_tpu.solvers.ga import continuation_perm_ramp
+
+        n = 12
+        warm = np.random.default_rng(0).permutation(np.arange(1, n + 1))
+        warm = np.asarray(warm, dtype=np.int32)
+        pop = continuation_perm_ramp(
+            jax.random.key(0), 16, warm, "gather"
+        )
+        pop = np.asarray(pop)
+        assert pop.shape == (16, n)
+        assert np.array_equal(pop[0], warm)  # exploitation anchor
+        # ... and ONLY slot 0: the mid/heavy groups must not waste
+        # slots on further exact copies of the seed
+        exact = [i for i in range(16) if np.array_equal(pop[i], warm)]
+        assert exact == [0], exact
+        for row in pop:
+            assert sorted(row.tolist()) == list(range(1, n + 1))
+        # the ramp grades perturbation: light clones nearer the seed
+        # than the heavy diversity tail, on average
+        ham = (pop != warm[None]).sum(axis=1)
+        assert ham[1:4].mean() <= ham[12:].mean()
+
+    def test_aco_continuation_predeposits_harder(self):
+        from vrpms_tpu.core.cost import CostWeights
+        from vrpms_tpu.io.synth import synth_cvrp
+        from vrpms_tpu.solvers.aco import (
+            ACOParams,
+            CONTINUATION_DEPOSIT,
+            WARM_DEPOSIT,
+            _aco_init_fn,
+        )
+        import dataclasses
+        import jax.numpy as jnp
+
+        assert CONTINUATION_DEPOSIT > WARM_DEPOSIT
+        inst = synth_cvrp(10, 2, seed=0)
+        w = CostWeights.make()
+        seed_perm = jnp.arange(1, 10, dtype=jnp.int32)
+        params = dataclasses.replace(ACOParams(), n_iters=0, knn_k=0)
+        tau_w = _aco_init_fn(params, 0, True, WARM_DEPOSIT)(
+            inst, w, seed_perm
+        )[0]
+        tau_c = _aco_init_fn(params, 0, True, CONTINUATION_DEPOSIT)(
+            inst, w, seed_perm
+        )[0]
+        # seed-tour edges carry strictly more pheromone under the
+        # continuation pre-deposit; untouched edges stay equal
+        diff = np.asarray(tau_c) - np.asarray(tau_w)
+        assert diff.max() > 0
+        assert diff.min() >= -1e-12
+
+    def test_aco_continuation_solve_never_worse_than_seed(self):
+        from vrpms_tpu.core.split import greedy_split_giant
+        from vrpms_tpu.core.cost import CostWeights, exact_cost
+        from vrpms_tpu.io.synth import synth_cvrp
+        from vrpms_tpu.solvers.aco import ACOParams, solve_aco
+        import jax.numpy as jnp
+
+        inst = synth_cvrp(10, 2, seed=1)
+        w = CostWeights.make()
+        seed_perm = jnp.arange(1, 10, dtype=jnp.int32)
+        res = solve_aco(
+            inst,
+            key=0,
+            params=ACOParams(n_ants=8, n_iters=10),
+            weights=w,
+            init_perm=seed_perm,
+            continuation=True,
+        )
+        _, seed_cost = exact_cost(
+            greedy_split_giant(seed_perm, inst), inst, w
+        )
+        assert float(res.cost) <= float(seed_cost) + 1e-5

@@ -170,6 +170,21 @@ REGISTRY: dict[str, Var] = {
            "Replica membership heartbeat interval (TTL is 3 beats)."),
         _v("VRPMS_RECLAIM_S", "float", 1.0,
            "Expired-lease reclaim scan interval."),
+        # -- giant-instance decomposition ------------------------------
+        _v("VRPMS_DECOMP", "str", "auto",
+           "Giant-instance decompose-solve-stitch path for VRP SA "
+           "requests ABOVE the tier ladder top: off disables, auto/on "
+           "engage (a no-op for any instance that fits one tier, so "
+           "responses below the ceiling stay byte-identical)."),
+        _v("VRPMS_DECOMP_TIER", "int", 0,
+           "Target shard NODE tier for decomposed solves; 0 = auto "
+           "(the largest ladder tier <= 256). Shards pad to one common "
+           "tier so they merge into vmapped batched launches."),
+        _v("VRPMS_DECOMP_BOUNDARY", "float", 1.25,
+           "Frontier ratio of the boundary re-opt band: a customer "
+           "joins the band when its distance to the nearest OTHER "
+           "shard center is within this factor of the distance to its "
+           "own shard's center."),
         # -- observability ---------------------------------------------
         _v("VRPMS_LOG", "switch", True,
            "Structured JSON event log (off silences it)."),

@@ -1,0 +1,919 @@
+"""Giant-instance decomposition: cluster -> batched tier solves -> stitch.
+
+The tier ladder (core.tiers) tops out around n=1024 nodes; above it
+there is no canonical shape, the TD delta kernel is gated, and a
+monolithic SA state at n=10k would specialize a one-off multi-GB
+program no other request ever shares. This module converts those
+instances into exactly the workload the rest of the system was built
+to exploit:
+
+  1. **cluster** — customers are spatially partitioned (medoid
+     farthest-point over the duration matrix, or k-means over
+     coordinates when the matrix was never materialized — the streamed
+     CVRPLIB path) into K shards, every shard sized to fit ONE
+     canonical node tier. Same tier by construction means the shard
+     instances share one padded shape, one compiled program, and one
+     micro-batch bucket.
+  2. **solve** — the K shard instances dispatch through the SAME
+     batched kernel the micro-batcher uses (sched.batch.solve_sa_batch)
+     in chunks of max_batch: ceil(K / max_batch) vmapped launches
+     instead of K solo solves. Per-shard incumbents roll up through a
+     ProgressFanout-style aggregator (ShardRollup) into one monotone
+     incumbent/gap stream on the job's progress sink.
+  3. **stitch** — shard routes merge onto their assigned slice of the
+     global fleet (slices proportional to shard demand), then the
+     cross-shard frontier is repaired: the band of customers nearest a
+     neighboring shard's center is STRIPPED from the merged routes
+     (their relative visit order preserved — core.delta's strip
+     semantics) and re-optimized as one small warm-seeded same-tier
+     instance on a reserved fleet slice (SA continuation from the
+     stripped order); bands too small to warrant a solve, or customers
+     that do not fit the reserved capacity, fall back to the
+     capacity-aware cheapest-insertion repair.
+
+Everything here is host-side numpy except the shard solves themselves;
+solver/scheduler imports are function-level (the same layering rule
+sched.batch follows). The service wires this in behind VRPMS_DECOMP
+(service.solve._solve_decomposed); tests and benchmarks drive it
+directly.
+
+Env:
+  VRPMS_DECOMP          — off | auto (default) | on; auto/on engage the
+                          path for VRP SA requests above the ladder top.
+  VRPMS_DECOMP_TIER     — target shard NODE tier (0 = auto: the largest
+                          ladder tier <= 256).
+  VRPMS_DECOMP_BOUNDARY — frontier ratio: a customer joins the boundary
+                          band when its distance to the nearest OTHER
+                          shard center is within this factor of the
+                          distance to its own.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+
+import numpy as np
+
+from vrpms_tpu import config
+from vrpms_tpu.core import tiers
+
+#: auto shard node tier: the largest ladder tier at or below this —
+#: big enough to amortize per-shard fixed costs, small enough that a
+#: 10k-customer instance still yields a few dozen batchable shards
+DEFAULT_SHARD_TARGET = 256
+
+#: bands smaller than this greedy-insert instead of paying a solve
+REOPT_MIN = 6
+
+#: default SA budget of the boundary re-opt pass (a CONTINUATION from
+#: the stripped order — the band re-enters the anneal warm, so a small
+#: budget refines instead of re-melting)
+REOPT_ITERS = 2000
+
+
+# ---------------------------------------------------------------------------
+# Engagement: when does a request take the decomposed path?
+# ---------------------------------------------------------------------------
+
+
+def mode() -> str:
+    """VRPMS_DECOMP normalized to off|auto|on (junk falls back to auto,
+    the registry's forgiving-parse policy)."""
+    raw = str(config.get("VRPMS_DECOMP") or "auto").strip().lower()
+    if raw in ("off", "0", "false", "no", "none"):
+        return "off"
+    return raw if raw in ("auto", "on") else "auto"
+
+
+def ceiling(lad=None) -> int | None:
+    """The ladder-top NODE tier — the largest instance the monolithic
+    tier path canonicalizes. None when tiering is off (no ceiling
+    notion, so decomposition never engages)."""
+    lad = lad if lad is not None else tiers.ladder()
+    if lad is None or not lad.n:
+        return None
+    return lad.n[-1]
+
+
+def engaged(problem: str, algorithm: str, n_nodes: int, opts: dict) -> bool:
+    """Whether this request takes the decompose-solve-stitch path.
+
+    Engages only for VRP SA requests strictly ABOVE the ladder top —
+    any instance that fits one tier keeps the exact monolithic path, so
+    VRPMS_DECOMP on/auto is byte-identical to off below the ceiling.
+    Options the decomposed path does not model (islands, ILS, polish,
+    warm starts, makespan pricing) keep the monolithic path too: a
+    requested feature must never be silently dropped.
+    """
+    if mode() == "off":
+        return False
+    if problem != "vrp" or algorithm != "sa":
+        return False
+    top = ceiling()
+    if top is None or n_nodes <= top:
+        return False
+    unsupported = (
+        "islands", "ils_rounds", "warm_start", "local_search",
+        "local_search_pool", "makespan_weight", "profile",
+    )
+    return not any(opts.get(k) for k in unsupported)
+
+
+def shard_node_tier(lad=None) -> int:
+    """The common NODE tier every shard pads to: VRPMS_DECOMP_TIER, or
+    the largest ladder tier <= DEFAULT_SHARD_TARGET (never above the
+    ladder top — shards must fit one tier by construction)."""
+    lad = lad if lad is not None else tiers.ladder()
+    n_tiers = lad.n if (lad is not None and lad.n) else (DEFAULT_SHARD_TARGET,)
+    target = int(config.get("VRPMS_DECOMP_TIER") or 0)
+    if target <= 0:
+        target = DEFAULT_SHARD_TARGET
+    target = min(target, n_tiers[-1])
+    at_or_below = [t for t in n_tiers if t <= target]
+    return at_or_below[-1] if at_or_below else n_tiers[0]
+
+
+# ---------------------------------------------------------------------------
+# Partitioning: customers -> K tier-sized shards (+ the boundary band)
+# ---------------------------------------------------------------------------
+
+
+def _balanced_assign(dist: np.ndarray, cap: int) -> np.ndarray:
+    """Assign each of n customers (rows of `dist`: distance to each of
+    the k centers) to its nearest center with space, capped at `cap`
+    members per center. Customers with the most to lose (largest
+    best-vs-second-best regret) choose first — the classic regret
+    heuristic, deterministic. Returns labels [n]."""
+    n, k = dist.shape
+    if k == 1:
+        return np.zeros(n, dtype=np.int64)
+    part = np.partition(dist, 1, axis=1)
+    regret = part[:, 1] - part[:, 0]
+    order = np.argsort(-regret, kind="stable")
+    counts = np.zeros(k, dtype=np.int64)
+    labels = np.full(n, -1, dtype=np.int64)
+    for c in order:
+        for center in np.argsort(dist[c], kind="stable"):
+            if counts[center] < cap:
+                labels[c] = center
+                counts[center] += 1
+                break
+        else:  # every center full (k*cap < n) — least-filled fallback
+            center = int(np.argmin(counts))
+            labels[c] = center
+            counts[center] += 1
+    return labels
+
+
+def partition_matrix(d: np.ndarray, k: int, cap: int):
+    """Medoid partition straight off the duration matrix (the service
+    path: requests carry a matrix, never coordinates). Farthest-point
+    medoid seeding from the depot, then regret-ordered balanced
+    nearest-medoid assignment. Returns (labels [n-1], dist [n-1, k]) in
+    CUSTOMER indexing (customer i is node position i+1). The clustering
+    metric is the symmetrized duration, computed COLUMN-WISE per medoid
+    (O(n*k)) — a full np.minimum(d, d.T) copy would double the one
+    giant allocation this path carries."""
+
+    def sym_col(j):  # min(d[c, j], d[j, c]) over customers c
+        return np.minimum(d[1:, j], d[j, 1:])
+
+    medoids = [1 + int(np.argmax(np.minimum(d[0, 1:], d[1:, 0])))]
+    cols = [sym_col(medoids[0])]
+    while len(medoids) < k:
+        to_set = np.min(np.stack(cols, axis=1), axis=1)
+        far = 1 + int(np.argmax(to_set))
+        if far in medoids:  # degenerate (duplicate points)
+            far = 1 + int(np.argmin(np.isin(
+                np.arange(1, d.shape[0]), medoids)))
+        medoids.append(far)
+        cols.append(sym_col(far))
+    dist = np.stack(cols, axis=1)
+    return _balanced_assign(dist, cap), dist
+
+
+def partition_coords(coords: np.ndarray, k: int, cap: int, seed: int = 0,
+                     iters: int = 15):
+    """k-means partition over customer COORDINATES (the streamed
+    CVRPLIB / generator path, where the O(n^2) matrix was deliberately
+    never built). Seeded k-means++ init, a few Lloyd iterations, then
+    the same balanced assignment as partition_matrix. `coords` includes
+    the depot at row 0; returns (labels [n-1], dist [n-1, k])."""
+    pts = np.asarray(coords, dtype=np.float64)[1:]
+    n = pts.shape[0]
+    rng = np.random.default_rng(seed)
+    centers = [pts[int(rng.integers(n))]]
+    for _ in range(1, k):
+        d2 = np.min(
+            ((pts[:, None] - np.asarray(centers)[None]) ** 2).sum(-1), axis=1
+        )
+        total = float(d2.sum())
+        if total <= 0:
+            centers.append(pts[int(rng.integers(n))])
+            continue
+        centers.append(pts[int(rng.choice(n, p=d2 / total))])
+    centers = np.asarray(centers)
+    for _ in range(iters):
+        dist = np.linalg.norm(pts[:, None] - centers[None], axis=-1)
+        labels = np.argmin(dist, axis=1)
+        for j in range(k):
+            sel = pts[labels == j]
+            if len(sel):
+                centers[j] = sel.mean(axis=0)
+    dist = np.linalg.norm(pts[:, None] - centers[None], axis=-1)
+    return _balanced_assign(dist, cap), dist
+
+
+def boundary_band(labels: np.ndarray, dist: np.ndarray, ratio: float,
+                  cap: int) -> np.ndarray:
+    """The boundary band: customers whose distance to the nearest OTHER
+    shard center is within `ratio` of the distance to their own —
+    exactly the customers a shard-respecting solution most plausibly
+    misplaces. Nearest-frontier-first, capped at `cap` so the band
+    itself fits one tier. Returns NODE positions (customer index + 1),
+    sorted ascending."""
+    n, k = dist.shape
+    if k < 2 or cap <= 0:
+        return np.zeros(0, dtype=np.int64)
+    own = dist[np.arange(n), labels]
+    masked = dist.copy()
+    masked[np.arange(n), labels] = np.inf
+    other = masked.min(axis=1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        r = other / np.maximum(own, 1e-12)
+    band = np.flatnonzero(r <= ratio)
+    if band.size > cap:
+        band = band[np.argsort(r[band], kind="stable")[:cap]]
+    return np.sort(band) + 1
+
+
+def boundary_ratio() -> float:
+    val = float(config.get("VRPMS_DECOMP_BOUNDARY"))
+    return val if val > 0 else 1.25
+
+
+# ---------------------------------------------------------------------------
+# The plan: shards, fleet slices, boundary band, shard-sum lower bound
+# ---------------------------------------------------------------------------
+
+
+class _Dist:
+    """Distance accessor over either the dense duration matrix or raw
+    coordinates (the streamed giant-file path, where the O(n^2) matrix
+    deliberately never exists): `sub` builds one shard's submatrix on
+    demand, `point` computes a single leg. Coordinate mode mirrors the
+    CVRPLIB nint rounding convention so a shard of a streamed load
+    prices identically to the same slice of a dense load."""
+
+    def __init__(self, arrays: dict):
+        self._d = arrays.get("durations")
+        self._coords = arrays.get("coords")
+        self._nint = bool(arrays.get("round_nint", False))
+
+    def sub(self, idx) -> np.ndarray:
+        if self._d is not None:
+            idx = np.asarray(idx, dtype=np.int64)
+            return self._d[np.ix_(idx, idx)]
+        from vrpms_tpu.io.cvrplib import shard_matrix
+
+        return shard_matrix(self._coords, idx, self._nint).astype(
+            np.float32
+        )
+
+    def point(self, a, b) -> float:
+        if self._d is not None:
+            return float(self._d[a, b])
+        # one leg of io.cvrplib._euc2d's convention, inlined: building
+        # a 2x2 shard_matrix per call would triple the host repair
+        # loops' cost (tests pin this equal to a shard_matrix entry)
+        d = float(np.linalg.norm(self._coords[a] - self._coords[b]))
+        return float(np.floor(d + 0.5)) if self._nint else d
+
+
+@dataclasses.dataclass
+class DecompPlan:
+    """One giant request, decomposed. Node positions are ACTIVE
+    positions (depot 0, customers 1..n-1) of the request's active set;
+    vehicle ids are global fleet indices."""
+
+    members: list          # per-shard np arrays of node positions
+    boundary: np.ndarray   # node positions of the frontier band
+    vehicles: list         # per-shard np arrays of global vehicle ids
+    boundary_vehicles: np.ndarray  # reserved fleet slice for the band
+    tier_n: int            # common node tier every shard pads to
+    tier_v: int            # common vehicle tier
+    lower_bound: float | None  # shard-sum quick lower bound
+    arrays: dict           # host inputs: durations OR coords, demands,
+                           # service, capacities, start_times, ...
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.members)
+
+    @property
+    def dist(self) -> _Dist:
+        return _Dist(self.arrays)
+
+
+def assign_fleet(capacities: np.ndarray, weights: list) -> list:
+    """Split the global fleet into len(weights) slices sized to the
+    demand weights: one vehicle per positive-weight group first, then
+    each spare vehicle goes to the group with the largest CAPACITY
+    DEFICIT (demand minus the capacity already assigned) — directly
+    minimizing the excess the shard solves would otherwise have to
+    penalize, where a plain proportional split leaves half the shards
+    one vehicle short. Returns per-group arrays of vehicle ids,
+    contiguous in id order — capacities are typically uniform, and
+    contiguity keeps the stitched vehicle numbering readable."""
+    caps = np.asarray(capacities, dtype=np.float64)
+    v = len(caps)
+    g = len(weights)
+    w = np.maximum(np.asarray(weights, dtype=np.float64), 0.0)
+    if w.sum() <= 0:
+        w = np.ones(g)
+    counts = np.zeros(g, dtype=np.int64)
+    active = w > 0
+    counts[active] = 1
+    spare = v - int(counts.sum())
+    if spare < 0:
+        raise ValueError(
+            f"{v} vehicles cannot cover {int(active.sum())} shard groups"
+        )
+    mean_cap = float(caps.mean())
+    assigned = counts * mean_cap
+    for _ in range(spare):
+        deficit = np.where(active, w - assigned, -np.inf)
+        i = int(np.argmax(deficit))
+        if deficit[i] <= 0:
+            # everyone covered: spread the rest proportionally
+            i = int(np.argmax(np.where(active, w / np.maximum(
+                counts, 1), -np.inf)))
+        counts[i] += 1
+        assigned[i] += mean_cap
+    out, at = [], 0
+    for c in counts:
+        out.append(np.arange(at, at + int(c), dtype=np.int64))
+        at += int(c)
+    return out
+
+
+def shard_sum_lower_bound(dist: _Dist, members: list) -> float | None:
+    """Sum of per-shard MST bounds over (depot + shard members) — the
+    ms-scale gap reference for decomposed solves (the quadratic-in-n
+    monolithic quick bound would dominate a 10k submit). Valid for any
+    shard-respecting route set: each shard's routes plus the depot form
+    a connected spanning subgraph of its node set, so the shard MST is
+    a floor; sums stay a floor of the decomposed objective. Submatrices
+    are built (and symmetrized) per shard, O(shard^2) each — never a
+    full-matrix copy. Returns None when vacuous."""
+    from vrpms_tpu.io.bounds import _mst_weight
+
+    total = 0.0
+    for m in members:
+        nodes = np.concatenate([[0], np.asarray(m, dtype=np.int64)])
+        sm = np.asarray(dist.sub(nodes), dtype=np.float64)
+        total += float(_mst_weight(np.minimum(sm, sm.T)))
+    return total if total > 0 else None
+
+
+def build_plan(
+    durations,
+    demands,
+    service,
+    capacities,
+    start_times,
+    slice_minutes: float = 60.0,
+    seed: int = 0,
+    coords=None,
+    round_nint: bool = False,
+) -> DecompPlan:
+    """Cluster a giant untimed CVRP into a DecompPlan.
+
+    Exactly one distance source: `durations` — the dense [N, N] matrix
+    (float32 host copy is taken; the service path, where requests carry
+    a matrix) — or `coords` [N, 2] (the STREAMED path: cvrplib
+    parse_cvrplib(max_dense_n=...) meta, synth_clustered_coords), which
+    partitions by k-means and builds every submatrix on demand so
+    nothing O(n^2) ever materializes (`round_nint` mirrors the CVRPLIB
+    rounding convention). Raises ValueError when the fleet cannot cover
+    the shard count — the service maps that to a Data error.
+    """
+    if (durations is None) == (coords is None):
+        raise ValueError(
+            "decomposition needs exactly one of durations (dense) or "
+            "coords (streamed)"
+        )
+    arrays: dict = {}
+    if durations is not None:
+        d = np.asarray(durations, dtype=np.float32)
+        if d.ndim != 2:
+            raise ValueError(
+                "decomposition requires an untimed [N, N] matrix"
+            )
+        n = d.shape[0]
+        arrays["durations"] = d
+    else:
+        pts = np.asarray(coords, dtype=np.float64)
+        n = pts.shape[0]
+        arrays["coords"] = pts
+        arrays["round_nint"] = bool(round_nint)
+    demands = np.asarray(demands, dtype=np.float64)
+    service = np.asarray(service, dtype=np.float64)
+    capacities = np.asarray(capacities, dtype=np.float64)
+    start_times = np.asarray(start_times, dtype=np.float64)
+
+    lad = tiers.ladder()
+    tier_n = shard_node_tier(lad)
+    cap = tier_n - 1  # customers per shard
+    k = max(1, math.ceil((n - 1) / cap))
+    if k > len(capacities):
+        raise ValueError(
+            f"decomposition needs at least {k} vehicles for {n - 1} "
+            f"customers at shard tier {tier_n}, got {len(capacities)}"
+        )
+    if durations is not None:
+        labels, dist = partition_matrix(d, k, cap)
+    else:
+        labels, dist = partition_coords(pts, k, cap, seed=seed)
+    members = [
+        np.flatnonzero(labels == j).astype(np.int64) + 1 for j in range(k)
+    ]
+    members = [m for m in members if m.size]
+    band = boundary_band(labels, dist, boundary_ratio(), cap)
+
+    band_demand = float(demands[band].sum()) if band.size else 0.0
+    reserve_band = band.size >= REOPT_MIN and len(capacities) > len(members)
+    if reserve_band:
+        # the band ends up stripped onto the reserved slice, so shard
+        # slices are sized for what each shard KEEPS — counting band
+        # demand twice would starve the shards of vehicles
+        band_set = set(int(c) for c in band)
+        weights = [
+            float(sum(demands[c] for c in m if int(c) not in band_set))
+            for m in members
+        ]
+        weights.append(band_demand)
+    else:
+        weights = [float(demands[m].sum()) for m in members]
+    slices = assign_fleet(capacities, weights)
+    vehicles = slices[: len(members)]
+    boundary_vehicles = (
+        slices[len(members)] if reserve_band else np.zeros(0, dtype=np.int64)
+    )
+
+    group_sizes = [len(s) for s in slices]
+    v_tiers = lad.v if (lad is not None and lad.v) else ()
+    tier_v = tiers.tier_up(max(group_sizes), v_tiers) if v_tiers else max(group_sizes)
+
+    arrays.update(
+        demands=demands,
+        service=service,
+        capacities=capacities,
+        start_times=start_times,
+        slice_minutes=float(slice_minutes),
+    )
+    lb = shard_sum_lower_bound(_Dist(arrays), members)
+
+    return DecompPlan(
+        members=members,
+        boundary=band,
+        vehicles=vehicles,
+        boundary_vehicles=boundary_vehicles,
+        tier_n=tier_n,
+        tier_v=tier_v,
+        lower_bound=lb,
+        arrays=arrays,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Shard instances: every shard pads to ONE (tier_n, tier_v) shape
+# ---------------------------------------------------------------------------
+
+
+def _sub_instance(plan: DecompPlan, nodes: np.ndarray, veh: np.ndarray,
+                  lad1: "tiers.TierLadder"):
+    from vrpms_tpu.core.instance import make_instance
+
+    a = plan.arrays
+    idx = np.concatenate([[0], nodes]).astype(np.int64)
+    inst = make_instance(
+        plan.dist.sub(idx),
+        demands=a["demands"][idx],
+        capacities=a["capacities"][veh],
+        service=a["service"][idx],
+        start_times=a["start_times"][veh],
+        slice_minutes=a["slice_minutes"],
+    )
+    return tiers.pad_instance(inst, lad1)
+
+
+def _shard_ladder(plan: DecompPlan) -> "tiers.TierLadder":
+    return tiers.TierLadder(n=(plan.tier_n,), v=(plan.tier_v,), t=(1,))
+
+
+def shard_instances(plan: DecompPlan) -> list:
+    """Build + tier-pad every shard's Instance. All shards share one
+    padded shape AND one pytree metadata set (the stacking contract):
+    het_fleet is forced uniform across shards — a slice that happens to
+    be uniform-capacity must not split the batch."""
+    import dataclasses as _dc
+
+    lad1 = _shard_ladder(plan)
+    insts = [
+        _sub_instance(plan, m, v, lad1)
+        for m, v in zip(plan.members, plan.vehicles)
+    ]
+    if len({i.het_fleet for i in insts}) > 1:
+        insts = [
+            i if i.het_fleet else _dc.replace(i, het_fleet=True)
+            for i in insts
+        ]
+    return insts
+
+
+# ---------------------------------------------------------------------------
+# Progress: K shard incumbent streams -> one monotone rollup
+# ---------------------------------------------------------------------------
+
+
+class ShardRollup:
+    """ProgressFanout-style aggregator for the decomposed solve: the
+    batched launch syncs a [K, B] per-shard best array; the rollup
+    tracks each shard's best-so-far and publishes the SUM to the job's
+    single sink — one monotone incumbent/gap stream for the whole
+    decomposition. Chunked dispatch publishes only once every shard has
+    reported (a partial sum would jump upward when the next chunk
+    starts); eval accounting flows through either way. Cancellation
+    passes straight through, so a job DELETE stops shard chunks at
+    their next block boundary."""
+
+    def __init__(self, sink, n_shards: int):
+        self._sink = sink
+        self._best = [None] * n_shards
+        self._chunk: list = []
+
+    def begin(self, shard_indices) -> None:
+        self._chunk = list(shard_indices)
+
+    def record(self, best, iters: int, evals_per_iter) -> None:
+        try:
+            rows = np.asarray(best)
+            per = rows.reshape(rows.shape[0], -1).min(axis=1)
+        except Exception:
+            return
+        for i, si in enumerate(self._chunk):
+            if i >= per.shape[0]:
+                break
+            b = float(per[i])
+            if self._best[si] is None or b < self._best[si]:
+                self._best[si] = b
+        if self._sink is None:
+            return
+        if any(b is None for b in self._best):
+            # not every shard has an incumbent yet: forward the eval
+            # accounting but no cost (an unreadable best is the sink's
+            # documented "count evals, skip the snapshot" path)
+            self._sink.record(None, iters, evals_per_iter)
+            return
+        self._sink.record(
+            np.asarray([sum(self._best)], dtype=np.float64),
+            iters,
+            evals_per_iter,
+        )
+
+    def publish_total(self, total: float) -> None:
+        """Post-stitch final total (boundary repair included)."""
+        if self._sink is not None:
+            self._sink.record(np.asarray([float(total)]), 0, None)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._sink is not None and self._sink.cancelled
+
+    def note_cancel_seen(self) -> None:
+        if self._sink is not None:
+            self._sink.note_cancel_seen()
+
+
+# ---------------------------------------------------------------------------
+# Batched shard dispatch: ceil(K / max_batch) vmapped launches
+# ---------------------------------------------------------------------------
+
+
+def solve_shards(
+    insts: list,
+    seeds: list,
+    params,
+    weights=None,
+    deadline_s: float | None = None,
+    max_batch: int = 16,
+    rollup: ShardRollup | None = None,
+):
+    """Solve every shard on the batched SA kernel in chunks of
+    `max_batch` — the decomposition rides the micro-batcher's vmapped
+    launch (sched.batch.solve_sa_batch) instead of a Python loop of
+    solo solves. Returns (results, launches). The deadline splits
+    evenly across the remaining chunks; a cancelled rollup collapses
+    the remaining chunks to a zero budget so they return their
+    constructive incumbents at one block's cost."""
+    from vrpms_tpu.obs import progress
+    from vrpms_tpu.sched.batch import solve_sa_batch
+
+    max_batch = max(1, int(max_batch))
+    k = len(insts)
+    n_chunks = math.ceil(k / max_batch)
+    results: list = []
+    launches = 0
+    t0 = time.monotonic()
+    for ci in range(n_chunks):
+        lo = ci * max_batch
+        chunk = insts[lo : lo + max_batch]
+        chunk_deadline = None
+        if deadline_s is not None:
+            remaining = max(0.0, deadline_s - (time.monotonic() - t0))
+            chunk_deadline = remaining / (n_chunks - ci)
+        if rollup is not None:
+            if rollup.cancelled:
+                chunk_deadline = 0.0
+            rollup.begin(range(lo, lo + len(chunk)))
+        with progress.attach(rollup):
+            results.extend(
+                solve_sa_batch(
+                    chunk,
+                    seeds[lo : lo + len(chunk)],
+                    params=params,
+                    weights=weights,
+                    deadline_s=chunk_deadline,
+                )
+            )
+        launches += 1
+    return results, launches
+
+
+# ---------------------------------------------------------------------------
+# Stitch: shard routes -> global fleet, then boundary repair
+# ---------------------------------------------------------------------------
+
+
+def stitch(plan: DecompPlan, results: list) -> list:
+    """Merge shard SolveResults into per-global-vehicle routes of node
+    positions. Shard route r rides global vehicle plan.vehicles[s][r];
+    routes the solver parked on a shard's phantom vehicles (possible
+    only on pathological penalized solutions) are collected and
+    re-inserted by the capacity-aware repair."""
+    from vrpms_tpu.core.encoding import routes_from_giant
+
+    v_total = len(plan.arrays["capacities"])
+    routes: list = [[] for _ in range(v_total)]
+    leftovers: list = []
+    for members, veh, res in zip(plan.members, plan.vehicles, results):
+        n_real = members.size + 1
+        for r, route in enumerate(routes_from_giant(res.giant, n_real)):
+            mapped = [int(members[c - 1]) for c in route]
+            if not mapped:
+                continue
+            if r < len(veh):
+                routes[int(veh[r])].extend(mapped)
+            else:
+                leftovers.extend(mapped)
+    if leftovers:
+        _insert_capacitated(plan, routes, leftovers)
+    return routes
+
+
+def strip_band(routes: list, band: np.ndarray) -> list:
+    """Remove the boundary band from merged routes IN PLACE, returning
+    the stripped customers in their merged visit order (vehicle id
+    order, then position) — the warm seed of the band re-opt, exactly
+    core.delta's strip semantics over positions."""
+    band_set = set(int(c) for c in band)
+    order: list = []
+    for v, route in enumerate(routes):
+        kept = []
+        for c in route:
+            if c in band_set and c not in order:
+                order.append(c)
+            elif c not in band_set:
+                kept.append(c)
+        routes[v] = kept
+    for c in band_set - set(order):  # defensive: band member never routed
+        order.append(c)
+    return order
+
+
+def _insert_capacitated(plan: DecompPlan, routes: list, custs: list) -> None:
+    """Capacity-aware cheapest insertion (the greedy-insert repair of
+    core.delta, made load-feasible): each customer lands at the
+    cheapest position whose route still has capacity headroom; with no
+    feasible slot anywhere it takes the globally cheapest slot — the
+    same penalized-best-effort semantics the SA objective prices."""
+    d = plan.dist.point
+    demands = plan.arrays["demands"]
+    caps = plan.arrays["capacities"]
+    loads = [float(demands[r].sum()) if r else 0.0 for r in routes]
+    for c in custs:
+        best = best_any = None  # (delta, v, pos)
+        for v, route in enumerate(routes):
+            seq = [0] + route + [0]
+            feasible = loads[v] + demands[c] <= caps[v] + 1e-9
+            for pos in range(1, len(seq)):
+                a, b = seq[pos - 1], seq[pos]
+                delta = d(a, c) + d(c, b) - d(a, b)
+                cand = (delta, v, pos - 1)
+                if best_any is None or cand < best_any:
+                    best_any = cand
+                if feasible and (best is None or cand < best):
+                    best = cand
+        _, v, pos = best if best is not None else best_any
+        routes[v].insert(pos, int(c))
+        loads[v] += float(demands[c])
+
+
+def band_instance(plan: DecompPlan):
+    """The boundary band as its own SAME-TIER instance on the reserved
+    fleet slice (None when the band is too small or has no slice)."""
+    if plan.boundary.size < REOPT_MIN or plan.boundary_vehicles.size == 0:
+        return None
+    return _sub_instance(
+        plan, plan.boundary, plan.boundary_vehicles, _shard_ladder(plan)
+    )
+
+
+def repair_boundary(
+    plan: DecompPlan,
+    routes: list,
+    seed: int = 0,
+    weights=None,
+    deadline_s: float | None = None,
+    n_chains: int = 32,
+    n_iters: int = REOPT_ITERS,
+) -> dict:
+    """The stitch pass's frontier repair: strip the boundary band from
+    the merged routes, then re-optimize it as ONE small warm-seeded
+    instance (SA continuation from the stripped visit order) on the
+    reserved fleet slice; bands below REOPT_MIN — or customers the
+    reserved capacity cannot hold — fall back to capacity-aware
+    cheapest insertion. Returns a report dict for the response's
+    `decomposition` block."""
+    band = plan.boundary
+    if band.size == 0:
+        return {"boundary": 0, "reoptimized": False}
+    order = strip_band(routes, band)
+    inst = band_instance(plan)
+    if inst is None:
+        _insert_capacitated(plan, routes, order)
+        return {"boundary": int(band.size), "reoptimized": False}
+
+    import jax
+
+    from vrpms_tpu.core.cost import resolve_eval_mode
+    from vrpms_tpu.core.encoding import routes_from_giant
+    from vrpms_tpu.core.split import greedy_split_giant
+    from vrpms_tpu.solvers import SAParams
+    from vrpms_tpu.solvers.sa import (
+        continuation_params,
+        perturbed_clones,
+        solve_sa,
+    )
+
+    pos_of = {int(c): i + 1 for i, c in enumerate(band)}
+    warm = tiers.pad_perm(
+        np.asarray([pos_of[c] for c in order], dtype=np.int32), inst
+    )
+    params = SAParams(n_chains=n_chains, n_iters=n_iters)
+    seed_giant = greedy_split_giant(warm, inst)
+    params = continuation_params(inst, params, seed_giant, weights)
+    init = perturbed_clones(
+        jax.random.key(seed + 1),
+        params.n_chains,
+        seed_giant,
+        resolve_eval_mode("auto"),
+        length_real=inst.move_limit,
+    )
+    from vrpms_tpu.obs import progress
+
+    with progress.masked():
+        # the band instance's costs are a fraction of the full
+        # instance's: left unmasked they would publish as the job's
+        # incumbent and the improves-only filter would then discard
+        # every honest full-instance total that follows
+        res = solve_sa(
+            inst,
+            key=seed,
+            params=params,
+            weights=weights,
+            init_giants=init,
+            deadline_s=deadline_s,
+        )
+    n_real = band.size + 1
+    overflow: list = []
+    for r, route in enumerate(routes_from_giant(res.giant, n_real)):
+        mapped = [int(band[c - 1]) for c in route]
+        if not mapped:
+            continue
+        if r < plan.boundary_vehicles.size:
+            routes[int(plan.boundary_vehicles[r])].extend(mapped)
+        else:
+            overflow.extend(mapped)
+    if overflow:
+        _insert_capacitated(plan, routes, overflow)
+    return {
+        "boundary": int(band.size),
+        "reoptimized": True,
+        "reoptEvals": int(res.evals),
+    }
+
+
+def rebalance_capacity(plan: DecompPlan, routes: list) -> int:
+    """Post-stitch feasibility sweep: while a vehicle carries more than
+    its capacity, relocate the overloaded route's cheapest-to-move
+    customer to the cheapest position on a route with headroom. The
+    shard solves are independently capacity-feasible almost always, but
+    the penalized SA objective CAN return a slightly overloaded route
+    (and the band re-opt's slice is sized by estimate) — this sweep
+    restores feasibility whenever fleet headroom exists at all, the
+    same guarantee the monolithic exact path's packing gives. Bounded
+    at one relocation per customer; returns relocations performed."""
+    d = plan.dist.point
+    demands = plan.arrays["demands"]
+    caps = plan.arrays["capacities"]
+    loads = [float(demands[r].sum()) if r else 0.0 for r in routes]
+    budget = sum(len(r) for r in routes)
+    moves = 0
+    progressed = True
+    while progressed and moves < budget:
+        progressed = False
+        for v, route in enumerate(routes):
+            while loads[v] > caps[v] + 1e-9 and moves < budget:
+                best = None  # (net_delta, ci, tv, tpos)
+                seq = [0] + route + [0]
+                for ci, c in enumerate(route):
+                    gain = (
+                        d(seq[ci], c) + d(c, seq[ci + 2])
+                        - d(seq[ci], seq[ci + 2])
+                    )
+                    for tv, target in enumerate(routes):
+                        if tv == v or (
+                            loads[tv] + demands[c] > caps[tv] + 1e-9
+                        ):
+                            continue
+                        tseq = [0] + target + [0]
+                        for pos in range(1, len(tseq)):
+                            a, b = tseq[pos - 1], tseq[pos]
+                            delta = d(a, c) + d(c, b) - d(a, b)
+                            cand = (delta - gain, ci, tv, pos - 1)
+                            if best is None or cand < best:
+                                best = cand
+                if best is None:
+                    break  # no headroom anywhere: leave penalized
+                _, ci, tv, pos = best
+                c = route.pop(ci)
+                routes[tv].insert(pos, c)
+                loads[v] -= float(demands[c])
+                loads[tv] += float(demands[c])
+                moves += 1
+                progressed = True
+    return moves
+
+
+# ---------------------------------------------------------------------------
+# Host pricing of the stitched solution (untimed CVRP only — the
+# decomposed path's engagement gate)
+# ---------------------------------------------------------------------------
+
+
+def evaluate_routes(plan: DecompPlan, routes: list) -> dict:
+    """Price the final global routes exactly as core.cost's untimed
+    path would: route duration = legs + service of visited customers,
+    distance = legs only, capacity excess per route against its own
+    vehicle. Host numpy — O(n), never builds the giant tensor."""
+    d = plan.dist.point
+    demands = plan.arrays["demands"]
+    service = plan.arrays["service"]
+    caps = plan.arrays["capacities"]
+    route_durations, loads = [], []
+    distance = excess = 0.0
+    for v, route in enumerate(routes):
+        if not route:
+            route_durations.append(0.0)
+            loads.append(0.0)
+            continue
+        path = [0] + route + [0]
+        legs = float(sum(d(a, b) for a, b in zip(path[:-1], path[1:])))
+        srv = float(sum(service[c] for c in route))
+        load = float(sum(demands[c] for c in route))
+        distance += legs
+        route_durations.append(legs + srv)
+        loads.append(load)
+        excess += max(0.0, load - float(caps[v]))
+    return {
+        "distance": distance,
+        "duration_sum": float(sum(route_durations)),
+        "duration_max": float(max(route_durations) if route_durations else 0.0),
+        "route_durations": route_durations,
+        "route_loads": loads,
+        "cap_excess": excess,
+    }

@@ -24,12 +24,25 @@ def _euc2d(coords: np.ndarray, round_nint: bool) -> np.ndarray:
     return d
 
 
-def parse_cvrplib(text: str, round_nint: bool = True, n_vehicles: int | None = None):
+def parse_cvrplib(
+    text: str,
+    round_nint: bool = True,
+    n_vehicles: int | None = None,
+    max_dense_n: int | None = None,
+):
     """Parse CVRPLIB .vrp text -> (Instance, meta dict).
 
     The vehicle count comes from (in priority order): the n_vehicles
     argument, the `-kV` suffix of the NAME field, or
     ceil(total demand / capacity) + 1 slack vehicle.
+
+    `max_dense_n` gates the O(n^2) matrix materialization for giant
+    EUC_2D instances: above it the Instance (and the dense matrix) is
+    NOT built — the returned Instance is None and the meta dict carries
+    everything the decomposition path needs instead (coords, demands,
+    capacities, start_times, streamed=True). A 10k-customer file then
+    parses in O(n) memory; per-shard submatrices are built later from
+    the coords (shard_matrix), so nothing quadratic ever materializes.
     """
     fields: dict[str, str] = {}
     sections: dict[str, list[list[float]]] = {}
@@ -56,10 +69,12 @@ def parse_cvrplib(text: str, round_nint: bool = True, n_vehicles: int | None = N
 
     # Node ids in the file are 1-based with the depot conventionally first
     # (DEPOT_SECTION confirms); we re-sort by id and index from 0.
+    streamed = False
     if ew_type == "EUC_2D":
         rows = sorted(sections["NODE_COORD_SECTION"], key=lambda r: r[0])
         coords = np.asarray([[r[1], r[2]] for r in rows])
-        d = _euc2d(coords, round_nint)
+        streamed = max_dense_n is not None and dim > max_dense_n
+        d = None if streamed else _euc2d(coords, round_nint)
     elif ew_type == "EXPLICIT":
         fmt = fields.get("EDGE_WEIGHT_FORMAT", "FULL_MATRIX")
         flat = [x for row in sections["EDGE_WEIGHT_SECTION"] for x in row]
@@ -80,7 +95,8 @@ def parse_cvrplib(text: str, round_nint: bool = True, n_vehicles: int | None = N
         depot = dep_rows[0] - 1
     if depot != 0:
         order = [depot] + [i for i in range(dim) if i != depot]
-        d = d[np.ix_(order, order)]
+        if d is not None:
+            d = d[np.ix_(order, order)]
         demands = demands[order]
         if coords is not None:
             coords = coords[order]
@@ -96,11 +112,30 @@ def parse_cvrplib(text: str, round_nint: bool = True, n_vehicles: int | None = N
             n_vehicles = 1
 
     cap = capacity if capacity > 0 else 1e9
+    meta = {"name": name, "dimension": dim, "capacity": capacity, "coords": coords}
+    if streamed:
+        meta.update(
+            streamed=True,
+            round_nint=round_nint,
+            demands=demands,
+            capacities=[cap] * n_vehicles,
+            start_times=[0.0] * n_vehicles,
+        )
+        return None, meta
     inst = make_instance(
         d, demands=demands, capacities=[cap] * n_vehicles
     )
-    meta = {"name": name, "dimension": dim, "capacity": capacity, "coords": coords}
     return inst, meta
+
+
+def shard_matrix(coords: np.ndarray, nodes, round_nint: bool = True):
+    """The dense duration submatrix of one shard of a STREAMED giant
+    instance (node 0 the depot plus the shard members), with the same
+    nint rounding convention the full parse would have applied — so a
+    shard of a streamed load prices identically to the same slice of a
+    dense load. O(shard^2), never O(n^2)."""
+    idx = np.asarray(nodes, dtype=np.int64)
+    return _euc2d(np.asarray(coords)[idx], round_nint)
 
 
 def load_cvrplib(path: str, **kw):

@@ -302,3 +302,19 @@ def attach(sink):
         yield sink
     finally:
         _active.reset(token)
+
+
+@contextlib.contextmanager
+def masked():
+    """HIDE the active sink for the duration of a nested auxiliary
+    solve — e.g. the decomposition's boundary re-opt, whose tiny
+    band-instance costs must not publish into the enclosing job's
+    incumbent stream (they would beat the full-instance sum and stick,
+    the improves-only filter discarding every honest later total). The
+    auxiliary solve also skips cooperative-cancel checks while masked;
+    callers bound it with a deadline instead."""
+    token = _active.set(None)
+    try:
+        yield
+    finally:
+        _active.reset(token)

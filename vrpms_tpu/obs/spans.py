@@ -64,6 +64,8 @@ KNOWN_SPAN_NAMES = frozenset({
     "resolve.delta",    # request-delta application (core.delta)
     "queue.wait",       # retroactive admission-queue wait
     "solve",            # one job's solver run (worker side)
+    "decompose",        # giant-instance clustering + shard planning
+    "stitch",           # shard-route merge + boundary repair
     "solver.solve",     # the device solve inside a request
     "solver.polish",    # post-solve local-search polish
     "finish",           # decode + response assembly

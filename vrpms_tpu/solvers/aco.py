@@ -264,20 +264,31 @@ def _aco_block_fn(params: ACOParams, n_block: int):
     return run
 
 
+#: pheromone pre-deposit multipliers (of tau0) for a warm seed's route
+#: edges: plain warm starts get a light bias; CONTINUATION seeds (an
+#: already-annealed tour of a neighboring instance — the dynamic
+#: re-solve and boundary re-opt paths) pre-deposit hard enough that the
+#: colony starts near-converged on the seed tour and spends its budget
+#: refining it, the ACO analogue of sa.continuation_params
+WARM_DEPOSIT = 2.0
+CONTINUATION_DEPOSIT = 6.0
+
+
 @lru_cache(maxsize=16)
-def _aco_init_fn(params: ACOParams, pool: int, warm: bool = False):
+def _aco_init_fn(params: ACOParams, pool: int, warm: bool = False,
+                 deposit_scale: float = WARM_DEPOSIT):
     """Jitted colony-state init (tau0 scale + incumbent evaluation).
 
     `init_perm` is the starting incumbent — identity order by default,
     or (warm=True) a warm-start seed: it is evaluated as best-so-far
     (so the solve can never return worse than the checkpoint), and a
-    WARM seed's split route additionally receives a 2x-tau0 pheromone
-    head start, biasing early construction toward the known-good edges
-    without freezing exploration (MMAS clipping re-engages
-    immediately). Cold solves keep the classic uniform pheromone init —
-    the identity incumbent is arbitrary and must not steer
-    construction. `pool` > 0 allocates the top-K elite pool (seeded
-    with the incumbent; empty slots at +inf).
+    WARM seed's split route additionally receives a deposit_scale x
+    tau0 pheromone head start, biasing early construction toward the
+    known-good edges without freezing exploration (MMAS clipping
+    re-engages immediately). Cold solves keep the classic uniform
+    pheromone init — the identity incumbent is arbitrary and must not
+    steer construction. `pool` > 0 allocates the top-K elite pool
+    (seeded with the incumbent; empty slots at +inf).
     """
     from vrpms_tpu.core.cost import resolve_eval_mode
 
@@ -296,7 +307,10 @@ def _aco_init_fn(params: ACOParams, pool: int, warm: bool = False):
         tau = jnp.full((inst.n_nodes, inst.n_nodes), tau0)
         if warm:
             tau = deposit(
-                tau, greedy_split_giant(init_perm, inst), 2.0 * tau0, hot
+                tau,
+                greedy_split_giant(init_perm, inst),
+                deposit_scale * tau0,
+                hot,
             )
         fit0 = fitness(init_perm[None])[0]
         pool_perms = jnp.tile(init_perm[None], (pool, 1))
@@ -314,6 +328,7 @@ def solve_aco(
     deadline_s: float | None = None,
     init_perm: jax.Array | None = None,
     pool: int = 0,
+    continuation: bool = False,
 ) -> SolveResult:
     """MMAS colony search; with `deadline_s` the colony runs in fixed
     16-iteration device blocks under common.run_blocked's granularity
@@ -321,8 +336,11 @@ def solve_aco(
 
     `init_perm` warm-starts the colony (incumbent + pheromone head
     start, see _aco_init_fn) — the solve never returns worse than the
-    seed. `pool` > 0 additionally returns the top-`pool` ant orders
-    seen across all iterations as split giants (SolveResult.pool, best
+    seed; `continuation` (a seed from an explicit re-solve source)
+    raises the pre-deposit to CONTINUATION_DEPOSIT so the colony
+    refines the seed tour instead of re-exploring from a light bias.
+    `pool` > 0 additionally returns the top-`pool` ant orders seen
+    across all iterations as split giants (SolveResult.pool, best
     first) — the multi-start polish hook every other solver exposes.
     """
     from vrpms_tpu.solvers.common import run_blocked
@@ -337,7 +355,8 @@ def solve_aco(
     warm = init_perm is not None
     if init_perm is None:
         init_perm = jnp.arange(1, inst.n_customers + 1, dtype=jnp.int32)
-    state = _aco_init_fn(block_params, pool, warm)(inst, w, init_perm)
+    scale = CONTINUATION_DEPOSIT if (warm and continuation) else WARM_DEPOSIT
+    state = _aco_init_fn(block_params, pool, warm, scale)(inst, w, init_perm)
     knn_mask = aco_knn_mask(inst, params.knn_k)
 
     def step_block(st, nb, start):

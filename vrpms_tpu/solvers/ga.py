@@ -185,6 +185,42 @@ def perturbed_perm_clones(
     return _perturb_perms_fn(pop, mode, n_moves)(key, perm, jnp.int32(lim))
 
 
+def continuation_perm_ramp(
+    key: jax.Array, pop: int, perm: jax.Array, mode: str, n_real_perm=None,
+) -> jax.Array:
+    """Seeded-population RAMP for CONTINUATION re-solves — the GA twin
+    of sa.continuation_params. A continuation seed is an already-
+    annealed tour of a neighboring instance, so the flat 6-move
+    decorrelation of perturbed_perm_clones destroys more of it than a
+    small delta warrants; the ramp instead grades perturbation strength
+    across the population: a quarter stays within ~2 moves of the seed
+    (exploitation — slot 0 exactly the seed), half at the standard 6
+    (the crossover mixing pool), and the last quarter at 18 (the
+    diversity tail a converged seed would otherwise lose, standing in
+    for cold immigrants without abandoning the seed's basin)."""
+    light = max(1, pop // 4)
+    heavy = max(0, pop // 4)
+    mid = max(0, pop - light - heavy)
+    lim = perm.shape[0] if n_real_perm is None else n_real_perm
+    k1, k2, k3 = jax.random.split(key, 3)
+    parts = [_perturb_perms_fn(light, mode, 2)(k1, perm, jnp.int32(lim))]
+    # _perturb_perms_fn pins ITS slot 0 to the exact seed; only the
+    # light group may keep that anchor — the mid/heavy groups oversample
+    # by one and drop it, or every group would waste a slot on a
+    # duplicate of the seed
+    if mid:
+        parts.append(
+            _perturb_perms_fn(mid + 1, mode, 6)(k2, perm, jnp.int32(lim))[1:]
+        )
+    if heavy:
+        parts.append(
+            _perturb_perms_fn(heavy + 1, mode, 18)(
+                k3, perm, jnp.int32(lim)
+            )[1:]
+        )
+    return jnp.concatenate(parts, axis=0)
+
+
 def order_crossover(
     p1: jax.Array, p2: jax.Array, key: jax.Array, lim=None
 ) -> jax.Array:
