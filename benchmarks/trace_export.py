@@ -1,0 +1,248 @@
+"""Durable trace export: overhead gate + store-down serving phase.
+
+    python -m benchmarks.trace_export [--reps 8] [--iters 800]
+                                      [--customers 60] [--chains 64]
+                                      [--rtt-ms 25]
+                                      [--out benchmarks/records/...json]
+
+The fleet-observability acceptance bar (ISSUE 14), three phases:
+
+  1. **Overhead** — the PR-5/PR-1 paired design on the REAL request
+     path (service.solve.run_vrp bracketed by the exact per-request
+     trace lifecycle the HTTP layer runs), alternating
+     VRPMS_TRACE_EXPORT on/off each rep. The export store sits behind
+     an RTT shim (default 25 ms per batch write — the hosted store's
+     real per-op cost) so the measurement includes a realistically
+     SLOW trace store; the exporter is a bounded background flusher,
+     so solves/sec must not care: gate < 1% overhead.
+  2. **Steady state** — after the on-arm drains, every offered span
+     must be accounted `ok`: gate zero dropped.
+  3. **Store down** — the trace store hard-fails; the same request mix
+     must serve 100% (export failures only tick the `failed` counter)
+     and the local debug ring must still hold the traces: gate 100%
+     served, local trace present.
+
+Prints one JSON line on stdout (bench.py convention); diagnostics to
+stderr; `--out` also writes the committed record the CI gate asserts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def build_request(n_customers: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n = n_customers + 1
+    pts = rng.uniform(0, 100, size=(n, 2))
+    matrix = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1).tolist()
+    locations = [
+        {"id": i, "demand": 2 if i else 0} for i in range(n)
+    ]
+    n_vehicles = max(2, n_customers // 10)
+    cap = 2.0 * n_customers / n_vehicles * 1.3
+    params = {
+        "name": "trace-export",
+        "description": "bench",
+        "auth": None,
+        "ignored_customers": [],
+        "completed_customers": [],
+        "capacities": [cap] * n_vehicles,
+        "start_times": [0.0] * n_vehicles,
+    }
+    return params, locations, matrix
+
+
+class RttShim:
+    """The hosted store's per-op latency, applied to the export write
+    path only — the background flusher pays it, requests must not."""
+
+    def __init__(self, inner, rtt_s: float):
+        self.inner = inner
+        self.rtt_s = rtt_s
+        self.writes = 0
+
+    def put_trace_spans(self, rows):
+        time.sleep(self.rtt_s)
+        self.writes += 1
+        return self.inner.put_trace_spans(rows)
+
+
+class DownStore:
+    """A hard-down trace store: every batch write fails."""
+
+    def put_trace_spans(self, rows):
+        raise RuntimeError("injected: trace store down")
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--reps", type=int, default=8,
+                        help="measured solve pairs (one per export state)")
+    parser.add_argument("--iters", type=int, default=800)
+    parser.add_argument("--customers", type=int, default=60)
+    parser.add_argument("--chains", type=int, default=64)
+    parser.add_argument("--rtt-ms", type=float, default=25.0,
+                        help="simulated store RTT per export batch write")
+    parser.add_argument("--down-requests", type=int, default=6,
+                        help="requests served during the store-down phase")
+    parser.add_argument("--out", default=None,
+                        help="also write the committed record here")
+    args = parser.parse_args()
+
+    os.environ["VRPMS_LOG"] = "off"  # isolate the export delta
+    os.environ["VRPMS_STORE"] = "memory"
+    os.environ["VRPMS_TRACING"] = "on"
+    os.environ["VRPMS_TRACE_EXPORT"] = "off"
+    import store
+    from service import obs as service_obs
+    from service.solve import run_vrp
+    from vrpms_tpu.obs import export, spans
+
+    def count(outcome: str) -> float:
+        return service_obs.TRACE_EXPORT.labels(outcome=outcome).value
+
+    params, locations, matrix = build_request(args.customers)
+    opts = {
+        "seed": 1,
+        "iteration_count": args.iters,
+        "population_size": args.chains,
+    }
+
+    def one_solve(seed: int) -> float:
+        """One request-shaped solve under the current export state: the
+        exact per-request span lifecycle the service runs (the PR-5
+        trace_overhead harness)."""
+        errors: list = []
+        t0 = time.perf_counter()
+        trace = spans.start_trace(None)
+        tokens = None
+        if trace is not None:
+            root = trace.span("POST /api/vrp/sa")
+            tokens = spans.activate(trace, root)
+        try:
+            result = run_vrp(
+                "sa", params, dict(opts, seed=seed), {}, locations, matrix,
+                errors, database=None,
+            )
+        finally:
+            if trace is not None:
+                trace.root().end()
+                spans.deactivate(tokens)
+                trace.finish()
+        elapsed = (time.perf_counter() - t0) * 1e3
+        assert result is not None and not errors, errors
+        return elapsed
+
+    shim = RttShim(store.get_database("vrp", None), args.rtt_ms / 1e3)
+    export.set_store_factory(lambda: shim)
+
+    print(
+        f"[trace_export] warmup solve ({args.customers} customers, "
+        f"{args.chains}x{args.iters})",
+        file=sys.stderr,
+    )
+    one_solve(0)  # compile
+
+    # -- phase 1: paired on/off overhead ------------------------------------
+    on_ms, off_ms = [], []
+    offered_spans = 0
+    for rep in range(args.reps):
+        pair = (("on", on_ms), ("off", off_ms))
+        if rep % 2:
+            pair = pair[::-1]
+        for state, sink in pair:
+            os.environ["VRPMS_TRACE_EXPORT"] = state
+            sink.append(one_solve(rep + 1))
+    os.environ["VRPMS_TRACE_EXPORT"] = "on"
+    assert export.flush(30.0), "exporter failed to drain"
+    overhead_pct = 100.0 * statistics.median(
+        (on - off) / off for on, off in zip(on_ms, off_ms)
+    )
+
+    # -- phase 2: steady-state accounting -----------------------------------
+    ok, dropped, failed = count("ok"), count("dropped"), count("failed")
+    offered_spans = ok + dropped + failed
+    print(
+        f"[trace_export] steady state: ok={ok:.0f} dropped={dropped:.0f} "
+        f"failed={failed:.0f} batchWrites={shim.writes}",
+        file=sys.stderr,
+    )
+
+    # -- phase 3: store down --------------------------------------------------
+    export.set_store_factory(lambda: DownStore())
+    served = 0
+    last_tid = None
+    for i in range(args.down_requests):
+        errors: list = []
+        trace = spans.start_trace(None)
+        root = trace.span("POST /api/vrp/sa")
+        tokens = spans.activate(trace, root)
+        try:
+            result = run_vrp(
+                "sa", params, dict(opts, seed=100 + i), {}, locations,
+                matrix, errors, database=None,
+            )
+        finally:
+            trace.root().end()
+            spans.deactivate(tokens)
+            trace.finish()
+        if result is not None and not errors:
+            served += 1
+        last_tid = trace.trace_id
+    export.flush(30.0)
+    down_failed = count("failed") - failed
+    local_trace_ok = spans.ring_get(last_tid) is not None
+    export.set_store_factory(None)
+    export.reset_exporter()
+
+    served_frac = served / max(1, args.down_requests)
+    gate = {
+        "overheadPct": round(overhead_pct, 3),
+        "overheadMax": 1.0,
+        "droppedSteadyState": int(dropped),
+        "offeredSpans": int(offered_spans),
+        "okSpans": int(ok),
+        "storeDownServed": served_frac,
+        "storeDownFailedSpans": int(down_failed),
+        "localTraceServedWhileDown": bool(local_trace_ok),
+        "pass": (
+            overhead_pct < 1.0
+            and dropped == 0
+            and failed == 0
+            and ok > 0
+            and served_frac == 1.0
+            and down_failed > 0
+            and local_trace_ok
+        ),
+    }
+    line = {
+        "bench": "trace_export",
+        "customers": args.customers,
+        "chains": args.chains,
+        "iters": args.iters,
+        "reps": args.reps,
+        "rttMs": args.rtt_ms,
+        "solve_ms_export_on": round(statistics.median(on_ms), 2),
+        "solve_ms_export_off": round(statistics.median(off_ms), 2),
+        "batchWrites": shim.writes,
+        "gate": gate,
+        "pass": gate["pass"],
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(line, f, indent=2)
+            f.write("\n")
+    return 0 if line["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

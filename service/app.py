@@ -23,7 +23,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from service import obs
 from service.api.index import handler as health_handler
 from vrpms_tpu import config
-from service.debug import TraceDetailHandler, TracesHandler
+from service.debug import (
+    FleetHandler,
+    JobTimelineHandler,
+    TraceDetailHandler,
+    TracesHandler,
+)
 from service.jobs import (
     JobResolveHandler,
     JobsHandler,
@@ -55,6 +60,7 @@ ROUTES = {
     "/api/jobs": JobsHandler,
     "/api/ready": ReadyHandler,
     "/api/debug/traces": TracesHandler,
+    "/api/debug/fleet": FleetHandler,
     "/metrics": obs.MetricsHandler,
 }
 
@@ -76,11 +82,14 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
         if cls is None and path.startswith("/api/jobs/"):
             # parameterized routes: /api/jobs/{id} status polls and
             # cancels, /api/jobs/{id}/stream live SSE progress,
-            # /api/jobs/{id}/resolve cancel-and-resolve
+            # /api/jobs/{id}/resolve cancel-and-resolve,
+            # /api/jobs/{id}/timeline the stitched per-job event list
             if path.endswith("/stream"):
                 cls = JobStreamHandler
             elif path.endswith("/resolve"):
                 cls = JobResolveHandler
+            elif path.endswith("/timeline"):
+                cls = JobTimelineHandler
             else:
                 cls = JobStatusHandler
         if cls is None and path.startswith("/api/debug/traces/"):

@@ -1,18 +1,39 @@
-"""Trace debug surface: the operator's window into recent requests.
+"""Debug surfaces: the operator's window into requests — and the fleet.
 
-Two read-only endpoints over the completed-trace ring
+Process-local endpoints (PR 5) over the completed-trace ring
 (vrpms_tpu.obs.spans):
 
   GET /api/debug/traces            — newest-first summaries, filterable
                                      by ?minMs= (minimum duration),
-                                     ?status= (ok|error), ?limit=
+                                     ?status= (ok|error), ?limit=;
+                                     ?jobId= resolves a job to its
+                                     trace (live registry, then the
+                                     store record), ?scope=fleet lists
+                                     store-backed summaries
   GET /api/debug/traces/{traceId}  — one trace's full span tree
 
-These answer the question aggregate histograms cannot: WHERE did that
-slow request spend its time — queue wait, compile, batch-neighbor
-interference, or a store retry storm. The histogram exemplars on
-/metrics (`# {trace_id="..."}`) and the `traceId` echoed in every
-response envelope are the join keys into this surface.
+Fleet-aware extensions (durable trace export, VRPMS_TRACE_EXPORT=on):
+
+  * the detail read FEDERATES — local ring spans merge with the trace's
+    exported rows from every replica (store.base get_trace_spans), so a
+    store-queue job submitted here and solved elsewhere reads as ONE
+    waterfall from ANY replica; on span-id conflict the local ring
+    wins (it is the live, unserialized truth);
+  * store-down degrades to local-only with a `degraded: true` marker,
+    never a 500 — trace reads are evidence, not dependencies;
+  * GET /api/jobs/{id}/timeline stitches a job's spans plus its
+    persisted progress profile into one ordered human-readable event
+    list (which replica claimed it, batch size and QoS class, shard
+    rollup for decomposed jobs, requeue attempts);
+  * GET /api/debug/fleet aggregates the replica heartbeat registry's
+    status docs (inflight, claim mix, warmed tiers — sched.replica
+    publishes them each beat) with the shared queue's depth into the
+    one endpoint an operator or autoscaler polls instead of N
+    /api/ready s.
+
+With VRPMS_TRACE_EXPORT=off (the local default) no store read happens
+on any pre-existing surface and responses stay byte-identical to the
+process-local contract.
 
 Header-sampled like the poll/readiness GETs (service.obs
 begin_request_obs): debug reads only trace when the caller sends a
@@ -21,16 +42,188 @@ valid traceparent, so inspecting the ring doesn't churn it.
 
 from __future__ import annotations
 
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler
 
+import store
 from service import obs
 from service.helpers import respond_json
+from vrpms_tpu.obs import export as trace_export
 from vrpms_tpu.obs import spans
 
 
+def _bad_request(handler, reason: str) -> None:
+    handler._obs_errors = ["Bad request"]
+    respond_json(handler, 400, {
+        "success": False,
+        "errors": [{"what": "Bad request", "reason": reason}],
+    })
+
+
+def _trace_db():
+    return store.get_database("vrp", None)
+
+
+def _store_trace_rows(trace_id: str | None) -> tuple[list, bool]:
+    """(rows, degraded) for a trace's exported rows. Export off — the
+    local default — means NO store read at all (rows=[], healthy), so
+    the pre-export surfaces cannot gain latency or new failure modes.
+    degraded=True means the store could not be read (the caller serves
+    local-only and says so)."""
+    if not trace_export.enabled() or not trace_id:
+        return [], False
+    try:
+        rows = _trace_db().get_trace_spans(trace_id)
+    except Exception:
+        rows = None
+    if rows is None:
+        return [], True
+    return rows, False
+
+
+# ---------------------------------------------------------------------------
+# Federated merge: local ring + exported rows -> one span tree
+# ---------------------------------------------------------------------------
+
+
+def merge_trace(trace_id: str, local, rows: list) -> dict | None:
+    """One cross-replica span tree from every source that recorded
+    part of this trace: the local ring/live Trace (when present) plus
+    each replica's exported row. Span offsets are rebased onto the
+    EARLIEST source's start clock (replicas must be NTP-sane — the
+    lease contract already requires it), spans carry their recording
+    replica, and on span-id conflict the LOCAL span wins. None when no
+    source holds the trace."""
+    sources: list[tuple[dict, bool]] = []
+    if local is not None:
+        doc = local.to_dict()
+        doc.setdefault(
+            "replica",
+            getattr(local, "export_replica", None)
+            or trace_export.replica_identity(),
+        )
+        sources.append((doc, True))
+    for row in rows:
+        doc = row.get("doc") or {}
+        if not doc.get("spans"):
+            continue
+        if doc.get("replica") is None:
+            doc = dict(doc, replica=row.get("replica"))
+        if any(doc.get("replica") == d.get("replica") for d, _ in sources):
+            # the local ring supersedes this replica's own exported row
+            continue
+        sources.append((doc, False))
+    if not sources:
+        return None
+    starts = [
+        d.get("startedAt") for d, _ in sources
+        if d.get("startedAt") is not None
+    ]
+    base = min(starts) if starts else 0.0
+    by_id: dict = {}
+    replicas: list = []
+    status, truncated = "ok", False
+    for doc, is_local in sources:
+        rep = doc.get("replica")
+        if rep and rep not in replicas:
+            replicas.append(rep)
+        if doc.get("status") == "error":
+            status = "error"
+        truncated = truncated or bool(doc.get("truncated"))
+        started = doc.get("startedAt") or base
+        shift_ms = (started - base) * 1e3
+        for span in doc.get("spans") or []:
+            sid = span.get("spanId")
+            if sid in by_id and not is_local:
+                continue  # local wins; first exported row wins the rest
+            span = dict(span)
+            span["startMs"] = round(shift_ms + (span.get("startMs") or 0), 3)
+            if span.get("events"):
+                # event offsets are relative to THEIR trace's start:
+                # rebase them onto the merged clock too, or a remote
+                # span's lifecycle events would sort seconds early
+                span["events"] = [
+                    (
+                        dict(ev, offsetMs=round(shift_ms + ev["offsetMs"], 3))
+                        if ev.get("offsetMs") is not None
+                        else dict(ev)
+                    )
+                    for ev in span["events"]
+                ]
+            if rep and "replica" not in span:
+                span["replica"] = rep
+            by_id[sid] = span
+    merged = sorted(by_id.values(), key=lambda s: s.get("startMs") or 0)
+    end = 0.0
+    for span in merged:
+        if span.get("durationMs") is not None:
+            end = max(end, span["startMs"] + span["durationMs"])
+    return {
+        "traceId": trace_id,
+        "startedAt": base,
+        "durationMs": round(end, 3),
+        "status": status,
+        "truncated": truncated,
+        "replicas": replicas,
+        "spans": merged,
+    }
+
+
+def _summary_from_rows(trace_id: str, rows: list) -> dict | None:
+    """A ring_snapshot-shaped summary for a trace only the store has
+    (the ?jobId= jump when the job solved on another replica)."""
+    merged = merge_trace(trace_id, None, rows)
+    if merged is None:
+        return None
+    root = merged["spans"][0] if merged["spans"] else None
+    return {
+        "traceId": trace_id,
+        "startedAt": merged["startedAt"],
+        "durationMs": merged["durationMs"],
+        "status": merged["status"],
+        "root": root.get("name") if root else None,
+        "spans": len(merged["spans"]),
+        "replicas": merged["replicas"],
+    }
+
+
+def _resolve_job_trace(handler, job_id: str):
+    """jobId -> (traceId, record, responded): the live registry first
+    (a running job's trace is not in any ring yet), then the store
+    record. Writes the 404/store-error envelope itself and returns
+    responded=True when it did."""
+    from service import jobs as jobs_mod
+
+    live = jobs_mod.get_live_job(job_id)
+    if live is not None and live.trace is not None:
+        return live.trace.trace_id, None, False
+    errors: list = []
+    try:
+        record = _trace_db().get_job(job_id, errors)
+    except Exception as e:
+        errors.append({"what": "Database error", "reason": str(e)})
+        record = None
+    if errors:
+        handler._obs_errors = [e.get("what", "unknown") for e in errors]
+        respond_json(handler, 400, {"success": False, "errors": errors})
+        return None, None, True
+    if record is None:
+        handler._obs_errors = ["Not found"]
+        respond_json(handler, 404, {
+            "success": False,
+            "errors": [{
+                "what": "Not found",
+                "reason": f"no job with id {job_id!r}",
+            }],
+        })
+        return None, None, True
+    return record.get("traceId"), record, False
+
+
 class TracesHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
-    """GET /api/debug/traces — the recent-trace ring, filtered."""
+    """GET /api/debug/traces — recent traces, filtered; ?jobId= jumps
+    from a job to its trace; ?scope=fleet lists exported summaries."""
 
     def do_GET(self):
         obs.begin_request_obs(self, sample="header")
@@ -45,26 +238,24 @@ class TracesHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             min_ms = float(query.get("minMs", ["0"])[0])
             limit = int(query.get("limit", ["50"])[0])
         except (TypeError, ValueError):
-            self._obs_errors = ["Bad request"]
-            respond_json(self, 400, {
-                "success": False,
-                "errors": [{
-                    "what": "Bad request",
-                    "reason": "'minMs' must be a number and 'limit' an "
-                    "integer",
-                }],
-            })
+            _bad_request(
+                self, "'minMs' must be a number and 'limit' an integer"
+            )
             return
         status = query.get("status", [None])[0]
         if status is not None and status not in ("ok", "error"):
-            self._obs_errors = ["Bad request"]
-            respond_json(self, 400, {
-                "success": False,
-                "errors": [{
-                    "what": "Bad request",
-                    "reason": "'status' must be 'ok' or 'error'",
-                }],
-            })
+            _bad_request(self, "'status' must be 'ok' or 'error'")
+            return
+        scope = query.get("scope", [None])[0]
+        if scope is not None and scope not in ("local", "fleet"):
+            _bad_request(self, "'scope' must be 'local' or 'fleet'")
+            return
+        job_id = query.get("jobId", [None])[0]
+        if job_id is not None:
+            self._job_traces(job_id)
+            return
+        if scope == "fleet":
+            self._fleet_traces(min_ms, status, limit)
             return
         respond_json(self, 200, {
             "success": True,
@@ -75,9 +266,75 @@ class TracesHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             ),
         })
 
+    def _fleet_traces(self, min_ms: float, status, limit: int):
+        """Store-backed summaries (every replica's exports merged);
+        export off or store down degrades to the local ring, marked."""
+        summaries, degraded = None, False
+        filtered = status is not None or min_ms > 0
+        if trace_export.enabled():
+            try:
+                # with filters active, scan deeper than the page size:
+                # filtering AFTER a newest-`limit` cut would hide any
+                # matching trace older than the newest page
+                summaries = _trace_db().list_traces(
+                    limit=max(limit * 4, 200) if filtered else limit
+                )
+            except Exception:
+                summaries = None
+            degraded = summaries is None
+        payload: dict = {
+            "success": True,
+            "tracing": spans.tracing_enabled(),
+            "scope": "fleet" if summaries is not None else "local",
+        }
+        if summaries is not None:
+            payload["traces"] = [
+                s for s in summaries
+                if (status is None or s.get("status") == status)
+                and (s.get("durationMs") or 0) >= min_ms
+            ][: max(1, limit)]
+        else:
+            # local fallback keeps the surface useful mid-outage (or
+            # with export off, where no fleet view exists to serve)
+            payload["capacity"] = spans.ring_capacity()
+            payload["traces"] = spans.ring_snapshot(
+                min_duration_ms=min_ms, status=status, limit=limit
+            )
+        if degraded:
+            payload["degraded"] = True
+        respond_json(self, 200, payload)
+
+    def _job_traces(self, job_id: str):
+        """?jobId= — resolve the job to its trace and answer with that
+        trace's summary (ring first, exported rows second), so an
+        operator jumps from a job to its waterfall without grepping."""
+        trace_id, _record, responded = _resolve_job_trace(self, job_id)
+        if responded:
+            return
+        payload: dict = {
+            "success": True,
+            "tracing": spans.tracing_enabled(),
+            "jobId": job_id,
+            "resolvedTraceId": trace_id,
+            "traces": [],
+        }
+        if trace_id:
+            local = spans.ring_get(trace_id)
+            if local is not None:
+                payload["traces"] = [local.summary()]
+            else:
+                rows, degraded = _store_trace_rows(trace_id)
+                summary = _summary_from_rows(trace_id, rows)
+                if summary is not None:
+                    payload["traces"] = [summary]
+                if degraded:
+                    payload["degraded"] = True
+        respond_json(self, 200, payload)
+
 
 class TraceDetailHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
-    """GET /api/debug/traces/{traceId} — one trace's full span tree."""
+    """GET /api/debug/traces/{traceId} — one trace's full span tree,
+    federated across replicas when trace export is on."""
 
     def do_GET(self):
         obs.begin_request_obs(self, sample="header")
@@ -90,19 +347,378 @@ class TraceDetailHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
         trace_id = (
             self.path.split("?", 1)[0].rstrip("/").rsplit("/", 1)[-1]
         )
-        trace = spans.ring_get(trace_id)
-        if trace is None:
-            self._obs_errors = ["Not found"]
-            respond_json(self, 404, {
-                "success": False,
-                "errors": [{
-                    "what": "Not found",
-                    "reason": (
-                        f"no completed trace {trace_id!r} in the ring "
-                        "(it may not have finished yet, or was evicted "
-                        "— see VRPMS_TRACE_RING)"
-                    ),
-                }],
-            })
+        local = spans.ring_get(trace_id)
+        if not trace_export.enabled():
+            # the PR-5 process-local contract, byte-identical: no store
+            # read, no merge, no new keys
+            if local is None:
+                self._not_found(trace_id, degraded=False)
+                return
+            respond_json(
+                self, 200, {"success": True, "trace": local.to_dict()}
+            )
             return
-        respond_json(self, 200, {"success": True, "trace": trace.to_dict()})
+        rows, degraded = _store_trace_rows(trace_id)
+        merged = merge_trace(trace_id, local, rows)
+        if merged is None:
+            self._not_found(trace_id, degraded=degraded)
+            return
+        payload: dict = {"success": True, "trace": merged}
+        if degraded:
+            # the store could not answer: this is the LOCAL view only,
+            # another replica's half may exist
+            payload["degraded"] = True
+        respond_json(self, 200, payload)
+
+    def _not_found(self, trace_id: str, degraded: bool):
+        self._obs_errors = ["Not found"]
+        payload: dict = {
+            "success": False,
+            "errors": [{
+                "what": "Not found",
+                "reason": (
+                    f"no completed trace {trace_id!r} in the ring "
+                    "(it may not have finished yet, or was evicted "
+                    "— see VRPMS_TRACE_RING)"
+                ),
+            }],
+        }
+        if degraded:
+            payload["degraded"] = True
+        respond_json(self, 404, payload)
+
+
+# ---------------------------------------------------------------------------
+# Per-job timeline
+# ---------------------------------------------------------------------------
+
+#: ordered, human-readable event kinds the timeline stitches from spans
+_SPAN_EVENT_KINDS = {
+    "queue.wait": "waited in queue",
+    "dist.claim_batch": "claimed from the shared queue",
+    "dist.execute": "executed on replica",
+    "solve": "solved",
+    "decompose": "decomposed",
+    "stitch": "stitched",
+    "qos.shed": "shed",
+    "store.persist_job": "record persisted",
+}
+
+#: incumbent entries kept verbatim in a timeline before thinning
+MAX_TIMELINE_INCUMBENTS = 32
+
+
+def _span_events(merged: dict | None) -> list:
+    events: list = []
+    if merged is None:
+        return events
+    for span in merged["spans"]:
+        name = span.get("name")
+        if name not in _SPAN_EVENT_KINDS:
+            continue
+        attrs = span.get("attributes") or {}
+        at_ms = span.get("startMs")
+        detail = _SPAN_EVENT_KINDS[name]
+        ev: dict = {"atMs": at_ms, "event": name}
+        rep = span.get("replica")
+        if rep:
+            ev["replica"] = rep
+        # a live (unfinished) span has no duration yet — the
+        # human-readable strings must say so, not read "Nonems"
+        dur = span.get("durationMs")
+        dur_text = "still running" if dur is None else f"{dur}ms"
+        if name == "queue.wait":
+            detail = f"waited {dur_text} in queue"
+            if attrs.get("requeued"):
+                detail += " (after a requeue)"
+        elif name == "dist.claim_batch":
+            size = attrs.get("size") or 1
+            detail = (
+                f"claimed by replica {rep or '?'} "
+                f"({attrs.get('kind') or 'own'} arc, batch of {size}"
+            )
+            if attrs.get("qos"):
+                detail += f", qos {attrs['qos']}"
+            detail += ")"
+            ev["batchSize"] = size
+        elif name == "dist.execute":
+            attempt = attrs.get("attempt") or 1
+            detail = f"executed on replica {rep or '?'} (attempt {attempt})"
+            ev["attempt"] = attempt
+        elif name == "solve":
+            detail = (
+                f"solve ran {dur_text}"
+                f" (attempt {attrs.get('attempt') or 1}"
+            )
+            if (attrs.get("batchSize") or 1) > 1:
+                detail += f", micro-batched x{attrs['batchSize']}"
+            detail += f") on replica {rep or '?'}"
+            ev["attempt"] = attrs.get("attempt") or 1
+            # the requeue story: job.* lifecycle events ride the spans
+            for sub in span.get("events") or []:
+                if str(sub.get("name", "")).startswith("job."):
+                    events.append({
+                        "atMs": sub.get("offsetMs"),
+                        "event": sub["name"],
+                        "detail": sub["name"].replace("job.", "job "),
+                    })
+        elif name == "decompose":
+            shards = attrs.get("shards")
+            subs = span.get("events") or []
+            launches = [e for e in subs if e.get("name") == "launch"]
+            detail = (
+                f"decomposed into {shards} tier-{attrs.get('tier')} "
+                f"shards"
+            )
+            if launches:
+                detail += f", dispatched as {len(launches)} vmapped launches"
+            ev["shards"] = shards
+            ev["launches"] = len(launches) or None
+        elif name == "stitch":
+            detail = (
+                f"stitched shard routes (boundary band of "
+                f"{attrs.get('boundary')} customers)"
+            )
+        elif name == "qos.shed":
+            detail = (
+                f"shed ({attrs.get('reason')}, qos {attrs.get('qos')})"
+            )
+        ev["detail"] = detail
+        if span.get("durationMs") is not None:
+            ev["durationMs"] = span["durationMs"]
+        events.append(ev)
+    return events
+
+
+def _incumbent_events(record: dict, merged: dict | None) -> list:
+    """The persisted convergence profile as timeline entries, anchored
+    under the solve span's clock when one is known."""
+    progress = record.get("progress")
+    if isinstance(progress, dict):
+        # the persisted sink profile: {"blocks", "improvements": [...]}
+        profile = list(progress.get("improvements") or [])
+    else:
+        profile = list(progress or [])
+    if not profile:
+        snap = record.get("incumbent")
+        profile = [snap] if snap else []
+    profile = [s for s in profile if isinstance(s, dict)]
+    solve_start = None
+    if merged is not None:
+        for span in merged["spans"]:
+            if span.get("name") == "solve":
+                solve_start = span.get("startMs")
+                break
+    if len(profile) > MAX_TIMELINE_INCUMBENTS:
+        # thin evenly, always keeping the first and the final incumbent
+        step = (len(profile) - 1) / (MAX_TIMELINE_INCUMBENTS - 1)
+        profile = [
+            profile[round(i * step)]
+            for i in range(MAX_TIMELINE_INCUMBENTS)
+        ]
+    events = []
+    for snap in profile:
+        wall = snap.get("wallMs")
+        ev = {
+            "atMs": (
+                None
+                if wall is None or solve_start is None
+                else round(solve_start + wall, 3)
+            ),
+            "event": "incumbent",
+            "detail": (
+                f"incumbent {snap.get('bestCost')}"
+                + (
+                    f" (gap {snap.get('gap')})"
+                    if snap.get("gap") is not None
+                    else ""
+                )
+            ),
+            "bestCost": snap.get("bestCost"),
+            "gap": snap.get("gap"),
+            "block": snap.get("block"),
+        }
+        events.append(ev)
+    return events
+
+
+def build_timeline(record: dict, merged: dict | None) -> list:
+    """One ordered event list for a job: lifecycle from the persisted
+    record, execution detail from its (federated) spans, convergence
+    from the progress profile. Events carry `atMs` relative to the
+    trace start (submit) where the clock is known; unknown-clock events
+    sort after their section in emit order."""
+    t0 = merged["startedAt"] if merged is not None else None
+    submitted = record.get("submittedAt")
+
+    def rel(ts) -> float | None:
+        if ts is None:
+            return None
+        base = t0 if t0 is not None else submitted
+        return None if base is None else round((ts - base) * 1e3, 3)
+
+    events: list = [{
+        "atMs": 0.0 if submitted is not None else None,
+        "event": "submitted",
+        "detail": (
+            f"{record.get('problem')}/{record.get('algorithm')} job "
+            f"submitted"
+        ),
+    }]
+    if record.get("startedAt"):
+        events.append({
+            "atMs": rel(record["startedAt"]),
+            "event": "started",
+            "detail": "solve started"
+            + (
+                f" (queue wait {record.get('queueWaitMs')}ms)"
+                if record.get("queueWaitMs") is not None
+                else ""
+            ),
+        })
+    events += _span_events(merged)
+    events += _incumbent_events(record, merged)
+    if int(record.get("attempt") or 1) > 1:
+        events.append({
+            "atMs": None,
+            "event": "requeued",
+            "detail": (
+                f"attempt {record['attempt']}: the first replica's "
+                "lease expired; a peer reclaimed and re-ran the job"
+            ),
+        })
+    if record.get("finishedAt"):
+        status = record.get("status")
+        events.append({
+            "atMs": rel(record["finishedAt"]),
+            "event": status or "finished",
+            "detail": f"job {status or 'finished'}"
+            + (" (cancelled)" if (record.get("message") or {}).get(
+                "cancelled") else ""),
+        })
+    # stable order: known clocks first in time order, unknown clocks
+    # keep their emit position at the end of the same millisecond
+    return sorted(
+        events,
+        key=lambda e: (e["atMs"] is None, e["atMs"] or 0.0),
+    )
+
+
+class JobTimelineHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/jobs/{id}/timeline — the job's story as one ordered,
+    human-readable event list, resolved across replicas via the trace
+    store when export is on."""
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            self._timeline()
+        finally:
+            obs.end_request_obs(self)
+
+    def _timeline(self):
+        from service import jobs as jobs_mod
+
+        job_id = jobs_mod._job_id_from_path(self.path)
+        record = jobs_mod._load_job_record(self, job_id)
+        if record is None:
+            return
+        live = jobs_mod.get_live_job(job_id)
+        trace_id = record.get("traceId")
+        local = None
+        if trace_id:
+            local = spans.ring_get(trace_id)
+            if local is None and live is not None and live.trace is not None:
+                # still running here: the live trace is the local truth
+                local = live.trace
+        rows, degraded = _store_trace_rows(trace_id)
+        merged = (
+            merge_trace(trace_id, local, rows) if trace_id else None
+        )
+        if live is not None and live.sink is not None:
+            snap = live.sink.snapshot()
+            if snap is not None:
+                record = dict(record, incumbent=snap)
+        payload: dict = {
+            "success": True,
+            "jobId": job_id,
+            "status": record.get("status"),
+            "traceId": trace_id,
+            "replicas": merged["replicas"] if merged is not None else [],
+            "timeline": build_timeline(record, merged),
+        }
+        if degraded or self._job_db_degraded:
+            payload["degraded"] = True
+        respond_json(self, 200, payload)
+
+
+# ---------------------------------------------------------------------------
+# Fleet rollup
+# ---------------------------------------------------------------------------
+
+
+class FleetHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/debug/fleet — every replica's heartbeat status doc plus
+    the shared queue's depth, from any replica: the autoscaler's one
+    poll. Store-down (or VRPMS_QUEUE=local) serves the local replica's
+    view only, marked accordingly — never a 500."""
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            self._fleet()
+        finally:
+            obs.end_request_obs(self)
+
+    def _fleet(self):
+        from service import jobs as jobs_mod
+
+        dist = jobs_mod.dist_queue_enabled()
+        self_id = jobs_mod.replica_id()
+        fleet: dict = {
+            "queue": "store" if dist else "local",
+            "generatedBy": self_id,
+            "generatedAt": time.time(),
+        }
+        degraded = False
+        replicas: dict = {}
+        if dist:
+            rep = jobs_mod._replica  # peek — polling must not build one
+            qs = None
+            try:
+                qs = rep.store if rep is not None else store.get_queue_store()
+            except Exception:
+                degraded = True
+            if qs is not None:
+                try:
+                    members = qs.replicas()
+                except Exception:
+                    members, degraded = [], True
+                infos = None
+                try:
+                    infos = qs.replica_infos()
+                except Exception:
+                    degraded = True
+                for rid in members:
+                    replicas[rid] = dict(
+                        (infos or {}).get(rid) or {}, replicaId=rid
+                    )
+                depth = jobs_mod._shared_depth(qs)
+                if depth is not None:
+                    fleet["sharedDepth"] = depth
+                classes = jobs_mod._shared_class_depths(qs)
+                if classes is not None:
+                    fleet["sharedQueuedByClass"] = classes
+        # this process answers with its LIVE state (fresher than its
+        # last heartbeat doc), so a fleet of one still tells the story
+        replicas[self_id] = dict(
+            replicas.get(self_id) or {},
+            **jobs_mod.replica_info(),
+            replicaId=self_id,
+            self=True,
+        )
+        fleet["replicas"] = replicas
+        payload: dict = {"success": True, "fleet": fleet}
+        if degraded:
+            payload["degraded"] = True
+        respond_json(self, 200, payload)

@@ -74,6 +74,7 @@ from vrpms_tpu.obs import (
     set_request_id,
     spans,
 )
+from vrpms_tpu.obs import export as trace_export
 from vrpms_tpu.sched import (
     DONE,
     FAILED,
@@ -944,6 +945,55 @@ def replica_id() -> str:
     return _replica_id_cached
 
 
+# exported trace rows, scraped metrics, and readiness must all name
+# this process the same way: the exporter's identity IS replica_id
+trace_export.set_replica_provider(replica_id)
+
+
+def replica_info() -> dict:
+    """This process's fleet-rollup heartbeat doc: what an operator (or
+    autoscaler) polling GET /api/debug/fleet on ANY replica learns
+    about THIS one — inflight leases, the observed claim mix, warmed
+    tiers, and local queue depth. Published to the store's replica
+    registry each heartbeat (sched.replica), so the rollup needs no
+    replica-to-replica RPC."""
+    info: dict = {"updatedAt": time.time()}
+    rep = _replica
+    if rep is not None:
+        try:
+            info["inflight"] = rep.inflight()
+            mix = rep.claim_mix()
+            # bounded: the hottest handful tells the routing story
+            info["claimMix"] = {
+                token: round(weight, 3)
+                for token, weight in list(mix.items())[:8]
+            }
+        except Exception:
+            pass
+    s = _scheduler
+    if s is not None:
+        try:
+            info["queued"] = sum(s.queues().values())
+        except Exception:
+            pass
+        if qos_enabled():
+            try:
+                classes: dict = {}
+                for depths in s.queues_by_class().values():
+                    for cls, n in depths.items():
+                        classes[cls] = classes.get(cls, 0) + n
+                info["queuedByClass"] = classes
+            except Exception:
+                pass
+    try:
+        from service import warmup as warmup_mod
+
+        info["tiersWarmed"] = warmup_mod.warmed_tiers()
+    except Exception:
+        info["tiersWarmed"] = []
+    return info
+
+
 def ring_token(problem: str, inst) -> str | None:
     """The ring routing key: the PADDED tier shape plus the feature
     flags that split compiled programs — deliberately COARSER than
@@ -1125,10 +1175,17 @@ def _materialize_entry(entry: dict, rid: str | None = None) -> Job:
     if tp:
         trace = spans.start_trace(tp)
         if trace is not None:
+            # this attempt's spans export under the LEASING replica's
+            # identity: the submitting replica's row for the same
+            # trace_id stays intact (federated reads union them)
+            trace.export_replica = rid or replica_id()
             root = trace.span("dist.execute")
             root.set(
                 jobId=job.id,
                 replicaId=rid or replica_id(),
+                # same value under the cross-surface attr name every
+                # trace root carries (service.obs.begin_request_obs)
+                replica=rid or replica_id(),
                 attempt=attempt,
             )
             if entry.get("_claim_batch"):
@@ -1354,6 +1411,9 @@ def build_replica(rid: str, scheduler=None, **kw):
         complete=_dist_complete,
         dead=_dist_dead,
         on_event=lambda name, **ekw: _dist_event(name, replicaId=rid, **ekw),
+        # heartbeat status doc: what GET /api/debug/fleet on any peer
+        # reports about this replica
+        info=replica_info,
         **defaults,
     )
 
@@ -1826,10 +1886,10 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
 
 
 def _job_id_from_path(path: str) -> str:
-    """The {id} segment of /api/jobs/{id}[/stream|/resolve] — the ONE
-    parser every per-job handler uses."""
+    """The {id} segment of /api/jobs/{id}[/stream|/resolve|/timeline]
+    — the ONE parser every per-job handler uses."""
     parts = [p for p in path.split("?", 1)[0].rstrip("/").split("/") if p]
-    if parts and parts[-1] in ("stream", "resolve"):
+    if parts and parts[-1] in ("stream", "resolve", "timeline"):
         parts = parts[:-1]
     return parts[-1] if parts else ""
 

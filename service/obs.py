@@ -284,14 +284,31 @@ CACHE_EVICTIONS = REGISTRY.counter(
 )
 BUILD_INFO = REGISTRY.gauge(
     "vrpms_build_info",
-    "Constant 1, labeled with the package version, jax version, and "
-    "backend platform — correlate deploys with behavior shifts",
-    labels=("version", "jaxVersion", "platform"),
+    "Constant 1, labeled with the package version, jax version, "
+    "backend platform, and this process's replica identity — correlate "
+    "deploys (and fleet members) with behavior shifts",
+    labels=("version", "jaxVersion", "platform", "replicaId"),
 )
 TRACE_RING_SIZE = REGISTRY.gauge(
     "vrpms_trace_ring_size",
     "Completed traces currently retained in the debug ring "
     "(GET /api/debug/traces); refreshed per scrape",
+)
+TRACE_EXPORT = REGISTRY.counter(
+    "vrpms_trace_export_total",
+    "Spans offered to the durable trace exporter, by outcome (ok = "
+    "batch-written to the store's trace_spans seam, dropped = export "
+    "queue overflow or an oversized trace document, failed = the store "
+    "write failed — single-attempt, fail-open). Every offered span is "
+    "accounted exactly once, so ok/(ok+dropped+failed) is the export "
+    "delivery rate",
+    labels=("outcome",),
+)
+TRACE_EXPORT_QUEUE = REGISTRY.gauge(
+    "vrpms_trace_export_queue_depth",
+    "Completed traces waiting in the bounded export queue for the "
+    "background flusher (VRPMS_TRACE_EXPORT_QUEUE caps it; sustained "
+    "depth near the cap precedes drops); refreshed per scrape",
 )
 UPTIME = REGISTRY.gauge(
     "vrpms_uptime_seconds", "Seconds since service process start"
@@ -380,6 +397,12 @@ def refresh_gauges() -> None:
     except Exception:
         pass
     TRACE_RING_SIZE.set(spans.ring_size())
+    try:
+        from vrpms_tpu.obs import export as trace_export
+
+        TRACE_EXPORT_QUEUE.set(trace_export.queue_depth())
+    except Exception:
+        pass
     jax_version = "unavailable"
     try:
         import jax
@@ -396,8 +419,30 @@ def refresh_gauges() -> None:
     except Exception:  # pragma: no cover - version attr always present
         pkg_version = "unknown"
     BUILD_INFO.labels(
-        version=pkg_version, jaxVersion=jax_version, platform=backend
+        version=pkg_version, jaxVersion=jax_version, platform=backend,
+        replicaId=_replica_label(),
     ).set(1)
+
+
+_replica_label_cached: str | None = None
+
+
+def _replica_label() -> str:
+    """This process's replica identity for metric labels and trace-root
+    attribution (lazy: service.jobs imports this module at its top, so
+    the reverse import must wait until request/scrape time). Resolved
+    ONCE per process: label values must stay stable or every
+    scheduler rebuild would mint a fresh vrpms_build_info series
+    (label-set children are never retired)."""
+    global _replica_label_cached
+    if _replica_label_cached is None:
+        try:
+            from service.jobs import replica_id
+
+            _replica_label_cached = replica_id()
+        except Exception:  # pragma: no cover - jobs always importable
+            return ""
+    return _replica_label_cached
 
 
 def route_label(path: str) -> str:
@@ -407,6 +452,8 @@ def route_label(path: str) -> str:
             return "/api/jobs/{id}/stream"
         if path.endswith("/resolve"):
             return "/api/jobs/{id}/resolve"
+        if path.endswith("/timeline"):
+            return "/api/jobs/{id}/timeline"
         return "/api/jobs/{id}"
     if path.startswith("/api/debug/traces/"):
         # same rule for per-trace detail reads
@@ -452,7 +499,9 @@ def begin_request_obs(handler, sample: str = "always") -> None:
         root = trace.span(
             f"{getattr(handler, 'command', 'HTTP')} {route_label(path)}"
         )
-        root.set(requestId=handler._request_id)
+        # the root names the process that recorded it: exported spans
+        # and cross-replica waterfalls stay attributable
+        root.set(requestId=handler._request_id, replica=_replica_label())
         handler._trace_root = root
         handler._span_tokens = spans.activate(trace, root)
 
@@ -616,6 +665,14 @@ def _wire_compile_obs() -> None:
         from vrpms_tpu.obs import progress
 
         progress.set_observer(_record_progress)
+    except Exception:
+        pass
+    try:
+        from vrpms_tpu.obs import export as trace_export
+
+        trace_export.set_observer(
+            lambda outcome, n: TRACE_EXPORT.labels(outcome=outcome).inc(n)
+        )
     except Exception:
         pass
 

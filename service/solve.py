@@ -1087,8 +1087,36 @@ def _solve_decomposed(prep: Prepared, errors) -> dict | None:
     sink = progress.active_sink()
     rollup = decompose.ShardRollup(sink, plan.n_shards)
     with _device_ctx(opts.get("backend")):
-        with spans.span("decompose", shards=plan.n_shards, tier=plan.tier_n):
+        with spans.span(
+            "decompose", shards=plan.n_shards, tier=plan.tier_n
+        ) as dspan:
             insts = decompose.shard_instances(plan)
+            if dspan is not None:
+                # per-shard events: the n=5000 waterfall names every
+                # shard (index, size, launch chunk) instead of one
+                # opaque span. Capped BELOW the span event limit so the
+                # launch-timing events emitted during the solve always
+                # have room — a 100-shard plan must not spend the whole
+                # cap on shard rows and silently drop the launch story
+                launch_room = math.ceil(plan.n_shards / max_batch) + 1
+                shard_cap = max(
+                    0, spans.MAX_EVENTS_PER_SPAN - launch_room
+                )
+                for si, members in enumerate(plan.members):
+                    if si >= shard_cap:
+                        dspan.event(
+                            "shard.truncated",
+                            shown=shard_cap,
+                            shards=plan.n_shards,
+                        )
+                        break
+                    dspan.event(
+                        "shard",
+                        shard=si,
+                        tier=plan.tier_n,
+                        n=int(members.size),
+                        chunk=si // max_batch,
+                    )
         seeds = [seed + i for i in range(len(insts))]
         with spans.span(
             "solver.solve", algorithm=prep.algorithm, problem=prep.problem
@@ -1101,6 +1129,20 @@ def _solve_decomposed(prep: Prepared, errors) -> dict | None:
                 deadline_s=None if deadline is None else 0.8 * deadline,
                 max_batch=max_batch,
                 rollup=rollup,
+                # launch timing lands on the SAME decompose span (spans
+                # may be annotated after end), so shards and the
+                # vmapped launches that ran them read as one story
+                on_launch=(
+                    None
+                    if dspan is None
+                    else lambda ci, lo, size, wall_s: dspan.event(
+                        "launch",
+                        chunk=ci,
+                        shardLo=lo,
+                        size=size,
+                        wallMs=round(wall_s * 1e3, 2),
+                    )
+                ),
             )
         with spans.span("stitch", boundary=int(plan.boundary.size)):
             routes = decompose.stitch(plan, results)

@@ -238,13 +238,26 @@ class JobQueueStore:
         block on it)."""
         return None
 
-    def register_replica(self, replica_id: str, ttl_s: float) -> None:
-        """Heartbeat this replica into the ring membership."""
+    def register_replica(self, replica_id: str, ttl_s: float,
+                         info: dict | None = None) -> None:
+        """Heartbeat this replica into the ring membership. `info` is
+        an optional small status doc (inflight, claim mix, warmed
+        tiers — sched.replica publishes it each beat) that
+        `replica_infos` serves to the fleet rollup; backends predating
+        the parameter may ignore it (callers fall back to the 2-arg
+        call on TypeError)."""
         raise NotImplementedError
 
     def replicas(self) -> list[str]:
         """Replica ids with a live (unexpired) heartbeat, sorted."""
         raise NotImplementedError
+
+    def replica_infos(self) -> dict | None:
+        """{replica_id: heartbeat status doc} for live replicas — the
+        GET /api/debug/fleet cross-replica view. Default None = backend
+        predates the heartbeat docs (the rollup serves membership ids
+        only, never fails)."""
+        return None
 
 
 class Database:
@@ -382,6 +395,111 @@ class Database:
             return False
         self._cache_recovered("write")
         return True
+
+    # -- durable trace export (fleet observability extension) ---------------
+    # One row per (trace_id, replica): each replica that recorded spans
+    # for a trace exports ITS span set as one bounded document, so a
+    # cross-replica job's full waterfall is the union of its rows and
+    # replicas never clobber each other's half. Strictly best-effort,
+    # with the solution cache's inverted resilience policy (see
+    # store.resilient._cache_call): a trace store outage drops spans —
+    # it must never block, slow, or fail a solve, and the exporter's
+    # counters (vrpms_trace_export_total) account for every span either
+    # way. Reads distinguish "no rows" ([]) from "store unreachable"
+    # (None) so the federated debug surfaces can degrade to local-only
+    # with an honest `degraded: true` marker.
+    def _put_trace_rows(self, rows: list):
+        raise NotImplementedError
+
+    def _fetch_trace_rows(self, trace_id: str) -> list:
+        raise NotImplementedError
+
+    def _list_trace_rows(self, limit: int) -> list:
+        raise NotImplementedError
+
+    def put_trace_spans(self, rows: list) -> bool:
+        """Batch-write exported trace rows ({trace_id, replica, doc,
+        summary columns}); one store call for the whole batch. False on
+        failure (the exporter counts the spans as failed)."""
+        if not rows:
+            return True
+        try:
+            self._put_trace_rows(rows)
+        except Exception as exc:
+            self._cache_warn("trace_write", exc)
+            return False
+        self._cache_recovered("trace_write")
+        return True
+
+    def get_trace_spans(self, trace_id: str) -> list | None:
+        """Every replica's exported row for `trace_id`; [] when none,
+        None when the store could not be read (degraded marker)."""
+        try:
+            rows = self._fetch_trace_rows(trace_id)
+        except Exception as exc:
+            self._cache_warn("trace_read", exc)
+            return None
+        self._cache_recovered("trace_read")
+        return list(rows or [])
+
+    def list_traces(self, limit: int = 50) -> list | None:
+        """Newest-first exported-trace summaries, one per trace with
+        its rows merged across replicas; None when the store could not
+        be read (the fleet-scope debug list degrades to local-only)."""
+        try:
+            rows = self._list_trace_rows(max(1, int(limit)) * 4)
+        except Exception as exc:
+            self._cache_warn("trace_read", exc)
+            return None
+        self._cache_recovered("trace_read")
+        merged: dict = {}
+        order: list = []
+        for row in rows or []:
+            tid = row.get("trace_id")
+            if tid is None:
+                continue
+            cur = merged.get(tid)
+            if cur is None:
+                merged[tid] = cur = {
+                    "traceId": tid,
+                    "startedAt": row.get("started_at"),
+                    "endAt": None,
+                    "status": row.get("status") or "ok",
+                    "root": row.get("root"),
+                    "spans": 0,
+                    "replicas": [],
+                }
+                order.append(tid)
+            started = row.get("started_at")
+            if started is not None and (
+                cur["startedAt"] is None or started < cur["startedAt"]
+            ):
+                # the earliest replica's row is the submitting side:
+                # its root names the trace
+                cur["startedAt"] = started
+                if row.get("root"):
+                    cur["root"] = row.get("root")
+            if started is not None and row.get("duration_ms") is not None:
+                end = started + float(row["duration_ms"]) / 1e3
+                if cur["endAt"] is None or end > cur["endAt"]:
+                    cur["endAt"] = end
+            if row.get("status") == "error":
+                cur["status"] = "error"
+            cur["spans"] += int(row.get("spans") or 0)
+            rep = row.get("replica")
+            if rep and rep not in cur["replicas"]:
+                cur["replicas"].append(rep)
+        out = []
+        for tid in order[: max(1, int(limit))]:
+            cur = merged[tid]
+            end = cur.pop("endAt")
+            cur["durationMs"] = (
+                None
+                if end is None or cur["startedAt"] is None
+                else round((end - cur["startedAt"]) * 1e3, 3)
+            )
+            out.append(cur)
+        return out
 
     # -- async job records (scheduler extension) ----------------------------
     # The jobs API (service.jobs) persists each job's lifecycle record

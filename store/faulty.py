@@ -70,6 +70,14 @@ class _FaultyMixin(_InMemoryMixin):
         self._injector.apply("read")
         return super()._fetch_cached_solution(key)
 
+    def _fetch_trace_rows(self, trace_id):
+        self._injector.apply("read")
+        return super()._fetch_trace_rows(trace_id)
+
+    def _list_trace_rows(self, limit):
+        self._injector.apply("read")
+        return super()._list_trace_rows(limit)
+
     # -- writes -------------------------------------------------------------
     def _insert_solution(self, data):
         self._injector.apply("write")
@@ -86,6 +94,13 @@ class _FaultyMixin(_InMemoryMixin):
     def _upsert_cached_solution(self, key, family, entry):
         self._injector.apply("write")
         return super()._upsert_cached_solution(key, family, entry)
+
+    def _put_trace_rows(self, rows):
+        # one injection per exporter batch (it is ONE upsert on the
+        # real backend), so a plan fails the whole batch or none —
+        # the exporter's failed counter ticks once per batch's spans
+        self._injector.apply("write")
+        return super()._put_trace_rows(rows)
 
 
 class FaultyDatabaseVRP(_FaultyMixin, DatabaseVRP):
@@ -153,10 +168,16 @@ class FaultyJobQueue(InMemoryJobQueue):
         self._injector.apply("read")
         return super().tenant_depths()
 
-    def register_replica(self, replica_id, ttl_s):
+    def register_replica(self, replica_id, ttl_s, info=None):
         self._injector.apply("read")
-        return super().register_replica(replica_id, ttl_s)
+        return super().register_replica(replica_id, ttl_s, info)
 
     def replicas(self):
         self._injector.apply("read")
         return super().replicas()
+
+    def replica_infos(self):
+        # the fleet rollup's cross-replica read; a plan that downs
+        # reads must degrade it to membership-ids-only, never a 500
+        self._injector.apply("read")
+        return super().replica_infos()

@@ -43,6 +43,7 @@ _tables: dict = {  # guarded-by: _lock
     "solution_cache": {},
     "job_queue": {},
     "replicas": {},
+    "trace_spans": {},
 }
 _tokens: dict = {}  # guarded-by: _lock
 _fixtures_loaded = False  # guarded-by: _fixtures_lock
@@ -58,6 +59,7 @@ def reset():
         _tables["solution_cache"].clear()
         _tables["job_queue"].clear()
         _tables["replicas"].clear()
+        _tables["trace_spans"].clear()
         _tokens.clear()
     global _fixtures_loaded
     with _fixtures_lock:
@@ -186,6 +188,45 @@ class _InMemoryMixin(Database):
                 cache.pop(next(iter(cache)))
                 evicted += 1
         notify_cache_evictions(evicted)
+
+    # -- durable trace export: bounded per-(trace, replica) rows ------------
+    # Insertion order is write recency; eviction drops the oldest-
+    # written row first (exported traces are debug evidence, not
+    # durable state — the Supabase backend pairs its table with a
+    # retention job instead, see store/schema.sql).
+    MAX_TRACE_ROWS = 2048
+
+    def _put_trace_rows(self, rows: list):
+        with _lock:
+            table = _tables["trace_spans"]
+            for row in rows:
+                key = (str(row.get("trace_id")), str(row.get("replica")))
+                table.pop(key, None)  # refresh insertion order
+                table[key] = dict(row)
+            while len(table) > self.MAX_TRACE_ROWS:
+                table.pop(next(iter(table)))
+
+    def _fetch_trace_rows(self, trace_id):
+        with _lock:
+            return [
+                dict(row)
+                for (tid, _rep), row in _tables["trace_spans"].items()
+                if tid == str(trace_id)
+            ]
+
+    def _list_trace_rows(self, limit):
+        with _lock:
+            rows = list(_tables["trace_spans"].values())
+        # newest-written first, summary columns only (the doc can be
+        # hundreds of KB across a deep list — the slim-scan rule the
+        # cache family reads follow)
+        return [
+            {k: row.get(k) for k in (
+                "trace_id", "replica", "started_at", "duration_ms",
+                "status", "root", "spans",
+            )}
+            for row in reversed(rows[-max(1, int(limit)):])
+        ]
 
     def _upsert_warmstart(self, owner, name, state: dict):
         with _lock:
@@ -435,14 +476,38 @@ class InMemoryJobQueue(JobQueueStore):
                 1 for r in self._rows_locked().values() if r["state"] == Q_QUEUED
             )
 
-    def register_replica(self, replica_id: str, ttl_s: float) -> None:
+    def register_replica(self, replica_id: str, ttl_s: float,
+                         info: dict | None = None) -> None:
         with _lock:
-            _tables["replicas"][replica_id] = time.time() + ttl_s
+            prev = _tables["replicas"].get(replica_id)
+            if info is None and isinstance(prev, tuple):
+                # a heartbeat without a status doc keeps the last one
+                # (mixed fleets: peers predating the info field)
+                info = prev[1]
+            _tables["replicas"][replica_id] = (time.time() + ttl_s, info)
+
+    @staticmethod
+    def _reg_expiry(value) -> float:
+        # rows written before the info field was a (expiry, info) tuple
+        return value[0] if isinstance(value, tuple) else value
 
     def replicas(self) -> list[str]:
         now = time.time()
         with _lock:
             reg = _tables["replicas"]
-            for rid in [r for r, exp in reg.items() if exp <= now]:
+            for rid in [
+                r for r, v in reg.items() if self._reg_expiry(v) <= now
+            ]:
                 del reg[rid]
             return sorted(reg)
+
+    def replica_infos(self) -> dict:
+        """{replica_id: last heartbeat status doc} for live replicas —
+        the fleet rollup's cross-replica view (GET /api/debug/fleet)."""
+        now = time.time()
+        with _lock:
+            return {
+                rid: dict(v[1]) if isinstance(v, tuple) and v[1] else {}
+                for rid, v in _tables["replicas"].items()
+                if self._reg_expiry(v) > now
+            }

@@ -699,6 +699,28 @@ class _ResilientMixin(Database):
             "_upsert_cached_solution", (key, family, entry)
         )
 
+    # -- trace-export primitives: the cache's inverted policy ---------------
+    # Exported traces are debug evidence, recomputable from nothing:
+    # a failed write is a dropped trace (counted by the exporter), a
+    # failed read degrades the federated debug surface to local-only
+    # with an honest marker. So: single attempt, NO retries (the
+    # exporter flushes on a background thread, but the federated READS
+    # run on debug-request HTTP threads), NO degraded-cache fallback
+    # (stale spans presented as the fleet view would lie), NO journal
+    # spooling (trace rows must never compete with job records for
+    # bounded journal slots during an outage) — while the per-call
+    # deadline and the shared circuit breaker still apply, so a down
+    # store costs one deadline before the open circuit sheds trace
+    # traffic instantly.
+    def _put_trace_rows(self, rows):
+        return self._cache_call("_put_trace_rows", (rows,))
+
+    def _fetch_trace_rows(self, trace_id):
+        return self._cache_call("_fetch_trace_rows", (trace_id,))
+
+    def _list_trace_rows(self, limit):
+        return self._cache_call("_list_trace_rows", (limit,))
+
 
 class ResilientDatabaseVRP(_ResilientMixin, DatabaseVRP):
     pass

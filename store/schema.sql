@@ -140,10 +140,47 @@ create index if not exists jobs_queue_claim_qos
 
 -- Ring membership: one heartbeat row per live replica; consistent-hash
 -- arcs are derived client-side from the live id set (sched/ring.py).
+-- `info` is the replica's heartbeat status doc (inflight, claim mix,
+-- warmed tiers — sched/replica.py publishes it each beat) that
+-- GET /api/debug/fleet aggregates into one fleet rollup; replicas
+-- predating the column keep heartbeating (the store latches off the
+-- doc write on the first undefined-column error and the rollup
+-- degrades to membership ids).
 create table if not exists replicas (
   id text primary key,              -- upsert target: on_conflict="id"
   expires_at timestamptz not null
 );
+alter table replicas add column if not exists info jsonb;
+
+-- Durable trace export (fleet observability; store/base.py trace seam,
+-- vrpms_tpu/obs/export.py): one row per (trace_id, replica) — each
+-- replica that recorded spans for a trace exports ITS span set as one
+-- bounded document (the exporter trims events, then attributes, then
+-- drops the trace rather than write an oversized row), so a
+-- cross-replica job's full waterfall is the union of its trace's rows
+-- and replicas never clobber each other's half. The summary columns
+-- (started_at epoch seconds, duration_ms, status, root, spans count)
+-- exist so list scans never transfer the documents. Strictly
+-- best-effort: writes are single-attempt behind the shared circuit
+-- breaker (store/resilient.py) and an outage drops spans, never blocks
+-- a solve. Rows accumulate with traffic: pair with a retention job,
+-- e.g. pg_cron:
+--   delete from trace_spans where updated_at < now() - '3 days';
+-- (the in-memory backend bounds itself at store.memory MAX_TRACE_ROWS).
+create table if not exists trace_spans (
+  trace_id text not null,
+  replica text not null,
+  started_at double precision,      -- trace start, epoch seconds
+  duration_ms double precision,
+  status text,
+  root text,                        -- root span name (summary lists)
+  spans integer,                    -- span count in doc
+  doc jsonb not null,               -- the replica's full span tree
+  updated_at timestamptz not null default now(),
+  primary key (trace_id, replica)   -- upsert: on_conflict="trace_id,replica"
+);
+create index if not exists trace_spans_updated_at
+  on trace_spans (updated_at desc);
 
 -- Belt-and-braces stale-lease sweep: reclaim normally happens in every
 -- replica's scan loop, but if ALL replicas die mid-lease the entries

@@ -145,6 +145,46 @@ class _SupabaseMixin(Database):
         )
         return result.data[0] if result.data else None
 
+    def _put_trace_rows(self, rows: list):
+        # one upsert for the whole exporter batch (the point of
+        # batching: K traces = ONE network round trip); updated_at
+        # rides the payload for the same reason as the cache upsert —
+        # the retention job and the newest-first list both read it
+        from datetime import datetime, timezone
+
+        now = datetime.now(timezone.utc).isoformat()
+        return (
+            self.client.table("trace_spans")
+            .upsert(
+                [dict(row, updated_at=now) for row in rows],
+                on_conflict="trace_id,replica",
+            )
+            .execute()
+        )
+
+    def _fetch_trace_rows(self, trace_id):
+        result = (
+            self.client.table("trace_spans")
+            .select("*")
+            .eq("trace_id", trace_id)
+            .execute()
+        )
+        return list(result.data)
+
+    def _list_trace_rows(self, limit):
+        # slim scan (the cache family-read precedent): summaries never
+        # transfer the span documents, only the indexed summary columns
+        result = (
+            self.client.table("trace_spans")
+            .select(
+                "trace_id,replica,started_at,duration_ms,status,root,spans"
+            )
+            .order("updated_at", desc=True)
+            .limit(max(1, int(limit)))
+            .execute()
+        )
+        return list(result.data)
+
     def _upsert_cached_solution(self, key, family, entry: dict):
         # updated_at must ride the payload: the column default fires on
         # INSERT only, and recency ordering + the documented retention
@@ -621,16 +661,43 @@ class SupabaseJobQueue(JobQueueStore):
                 depths[tenant] = depths.get(tenant, 0) + 1
         return depths
 
-    def register_replica(self, replica_id: str, ttl_s: float) -> None:
+    #: class-level latch, the _qos_cols pattern: False once an info
+    #: write failed with an undefined-column error (a replicas table
+    #: predating the fleet-rollup migration) — heartbeats then write
+    #: without the doc instead of failing every beat.
+    _info_col = True
+
+    def register_replica(self, replica_id: str, ttl_s: float,
+                         info: dict | None = None) -> None:
         import time as _time
 
-        self.client.table("replicas").upsert(
-            {
-                "id": replica_id,
-                "expires_at": self._iso(_time.time() + ttl_s),
-            },
-            on_conflict="id",
-        ).execute()
+        row = {
+            "id": replica_id,
+            "expires_at": self._iso(_time.time() + ttl_s),
+        }
+        if info is not None and type(self)._info_col:
+            try:
+                self.client.table("replicas").upsert(
+                    dict(row, info=info), on_conflict="id"
+                ).execute()
+                return
+            except Exception as exc:
+                # precise undefined-column match only (the _qos_cols
+                # rule): a transient error whose text merely CONTAINS
+                # "info" must re-raise, not silently disable the doc
+                # for the process lifetime
+                text = str(exc)
+                if "42703" not in text and 'column "info"' not in text:
+                    raise  # transient failure: the caller's problem
+                type(self)._info_col = False
+                log_event(
+                    "store.replica_info_column_missing",
+                    level="warn",
+                    hint="apply the replicas.info migration in "
+                    "store/schema.sql; /api/debug/fleet degrades to "
+                    "membership ids only",
+                )
+        self.client.table("replicas").upsert(row, on_conflict="id").execute()
 
     def replicas(self) -> list[str]:
         import time as _time
@@ -642,3 +709,19 @@ class SupabaseJobQueue(JobQueueStore):
             .execute()
         )
         return sorted(row["id"] for row in result.data)
+
+    def replica_infos(self) -> dict | None:
+        import time as _time
+
+        if not type(self)._info_col:
+            return None  # schema predates the docs: ids-only rollup
+        try:
+            result = (
+                self.client.table("replicas")
+                .select("id,info")
+                .gt("expires_at", self._iso(_time.time()))
+                .execute()
+            )
+        except Exception:
+            return None  # the rollup fails open to membership ids
+        return {row["id"]: row.get("info") or {} for row in result.data}

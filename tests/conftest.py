@@ -104,6 +104,12 @@ _SLOW_PATTERNS = (
     "test_qos.py::TestQosHTTP",
     "test_qos.py::TestQosDistHTTP",
     "test_qos.py::TestQosOffGuard",
+    # fleet-observability end-to-end layers: the federated HTTP
+    # surfaces, real cross-replica solves, and chaos requests (the
+    # exporter/seam units stay quick; tier1.yml runs the file in full)
+    "test_trace_export.py::TestFederatedHTTP",
+    "test_trace_export.py::TestCrossReplicaFederation",
+    "test_trace_export.py::TestExportChaos",
     # dynamic re-solve end-to-end solves (unit/envelope layers stay
     # quick; tier1.yml runs the file in full)
     "test_resolve.py::TestDeltaHTTP",

@@ -1,0 +1,821 @@
+"""Fleet observability tests: durable trace export, federated debug
+surfaces, per-job timelines, and the fleet rollup (ISSUE 14).
+
+Layers:
+
+  * TestExporterUnit — the exporter contract: off-by-default builds
+    nothing, batched rows through the store trace seam, the bounded
+    queue drops the OLDEST trace (counted), oversized documents
+    degrade (events, then attributes) before dropping, store failures
+    count `failed` and never raise into the request path;
+  * TestTraceSeam — the store seam on the memory/faulty backends:
+    per-(trace, replica) rows union instead of clobbering, list
+    summaries merge rows per trace, chaos plans inject, the in-memory
+    table stays bounded;
+  * TestFederatedHTTP (slow) — the debug endpoints end to end: detail
+    merge (local ring wins on span-id conflict), store-down serves
+    local-only with `degraded: true` (never a 500), ?scope=fleet,
+    ?jobId= job-to-trace resolution, GET /api/jobs/{id}/timeline, the
+    /api/debug/fleet rollup, and the VRPMS_TRACE_EXPORT=off guard that
+    keeps every pre-export response shape untouched;
+  * TestCrossReplicaFederation (slow) — the acceptance gate: a
+    two-in-process-replica store-queue job (the test_distqueue
+    harness) whose federated read returns spans from BOTH replicas
+    under ONE traceId — including the kill-mid-flight case, where the
+    reclaimed attempt's dist.execute span carries attempt=2;
+  * TestExportChaos — export failures drop cleanly: counters tick,
+    requests are unaffected.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+import store
+import store.memory as mem
+from service import obs as service_obs
+from store.faulty import reset_faults
+from store.resilient import reset_resilience
+from vrpms_tpu.obs import export, spans
+from vrpms_tpu.sched import Replica, Scheduler
+from vrpms_tpu.sched.ring import SLOTS, HashRing
+
+
+def _export_count(outcome: str) -> float:
+    return service_obs.TRACE_EXPORT.labels(outcome=outcome).value
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    monkeypatch.setenv("VRPMS_STORE", "memory")
+    monkeypatch.delenv("VRPMS_QUEUE", raising=False)
+    monkeypatch.delenv("VRPMS_TRACE_EXPORT", raising=False)
+    mem.reset()
+    reset_faults()
+    reset_resilience()  # a prior suite's open breaker must not shed us
+    export.reset_exporter()
+    export.set_store_factory(None)
+    # service.obs wires the observer at import; later imports of other
+    # modules must never have left a stale one behind
+    export.set_observer(
+        lambda outcome, n: service_obs.TRACE_EXPORT.labels(
+            outcome=outcome
+        ).inc(n)
+    )
+    spans.reset_ring()
+    yield
+    export.reset_exporter()
+    export.set_store_factory(None)
+    mem.reset()
+    reset_faults()
+    spans.reset_ring()
+
+
+def _make_trace(tid=None, root_name="POST /api/vrp/sa", n_children=1):
+    t = spans.Trace(trace_id=tid)
+    root = t.span(root_name)
+    root.set(replica="local-test")
+    for i in range(n_children):
+        child = t.span("solve", parent_id=root.span_id)
+        child.set(jobId=f"j{i}")
+        child.end()
+    root.end()
+    return t
+
+
+def _wait(cond, timeout=10.0, every=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# Exporter unit layer
+# ---------------------------------------------------------------------------
+
+
+class TestExporterUnit:
+    def test_off_by_default_builds_nothing_and_writes_nothing(self):
+        t = _make_trace()
+        t.finish()
+        assert export._exporter is None  # no exporter constructed
+        assert mem._tables["trace_spans"] == {}
+        assert spans.ring_get(t.trace_id) is not None  # ring untouched
+
+    def test_export_round_trip(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TRACE_EXPORT", "on")
+        ok0 = _export_count("ok")
+        t = _make_trace(n_children=2)
+        t.finish()
+        assert export.flush(10.0)
+        db = store.get_database("vrp", None)
+        rows = db.get_trace_spans(t.trace_id)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["spans"] == 3
+        assert row["status"] == "ok"
+        assert row["root"] == "POST /api/vrp/sa"
+        assert row["started_at"] == pytest.approx(t.start_ts)
+        names = [s["name"] for s in row["doc"]["spans"]]
+        assert names == ["POST /api/vrp/sa", "solve", "solve"]
+        assert row["doc"]["replica"] == row["replica"]
+        assert _export_count("ok") - ok0 == 3
+        summaries = db.list_traces(10)
+        assert [s["traceId"] for s in summaries] == [t.trace_id]
+        assert summaries[0]["spans"] == 3
+
+    def test_empty_traces_are_not_offered(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TRACE_EXPORT", "on")
+        t = spans.Trace()
+        t.finish()  # no spans: no evidence
+        assert export._exporter is None
+
+    def test_queue_overflow_drops_oldest(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_TRACE_EXPORT", "on")
+        gate = threading.Event()
+        written: list = []
+
+        class SlowDB:
+            def put_trace_spans(self, rows):
+                gate.wait(10)
+                written.extend(rows)
+                return True
+
+        export.set_store_factory(lambda: SlowDB())
+        dropped0 = _export_count("dropped")
+        exp = export.TraceExporter(queue_cap=2, batch=1, flush_s=0.01)
+        try:
+            traces = [_make_trace(n_children=0) for _ in range(5)]
+            for t in traces:
+                exp.offer(t)
+            # flusher holds one in flight; cap 2 → at least 2 dropped
+            assert _wait(
+                lambda: _export_count("dropped") - dropped0 >= 2
+            ), _export_count("dropped")
+        finally:
+            gate.set()
+            exp.stop(2.0)
+        assert written  # the survivors were still written
+
+    def test_oversized_doc_degrades_then_drops(self):
+        t = spans.Trace()
+        s = t.span("solve")
+        s.set(huge="x" * (export.MAX_ROW_BYTES + 1024))
+        for i in range(10):
+            s.event("block", i=i)
+        s.end()
+        row = export.serialize_trace(t, "r1")
+        # events went first, then the oversized attributes; the doc
+        # survives, marked truncated
+        assert row is not None
+        doc_span = row["doc"]["spans"][0]
+        assert "events" not in doc_span and "attributes" not in doc_span
+        assert row["doc"]["truncated"] is True
+        # a skeleton that is itself too big has nothing left to shed
+        t2 = spans.Trace()
+        t2.span("x" * (export.MAX_ROW_BYTES + 1024)).end()
+        assert export.serialize_trace(t2, "r1") is None
+
+    def test_store_failure_counts_failed_and_never_raises(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_TRACE_EXPORT", "on")
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        failed0 = _export_count("failed")
+        t = _make_trace()
+        t.finish()  # must not raise
+        assert export.flush(10.0)
+        assert _export_count("failed") - failed0 == 2
+        assert export.queue_depth() == 0
+
+    def test_replica_identity_prefers_provider(self):
+        assert export.replica_identity()  # never empty
+        export.set_replica_provider(lambda: "prov-1")
+        try:
+            assert export.replica_identity() == "prov-1"
+        finally:
+            from service.jobs import replica_id
+
+            export.set_replica_provider(replica_id)
+
+
+# ---------------------------------------------------------------------------
+# Store trace seam
+# ---------------------------------------------------------------------------
+
+
+class TestTraceSeam:
+    def _row(self, tid, replica, names, started=1000.0):
+        return {
+            "trace_id": tid,
+            "replica": replica,
+            "started_at": started,
+            "duration_ms": 5.0,
+            "status": "ok",
+            "root": names[0],
+            "spans": len(names),
+            "doc": {
+                "traceId": tid,
+                "startedAt": started,
+                "durationMs": 5.0,
+                "status": "ok",
+                "replica": replica,
+                "spans": [
+                    {
+                        "name": n,
+                        "spanId": uuid.uuid4().hex[:16],
+                        "parentId": None,
+                        "startMs": 0.0,
+                        "durationMs": 1.0,
+                        "status": "ok",
+                    }
+                    for n in names
+                ],
+            },
+        }
+
+    def test_rows_union_per_replica(self):
+        db = store.get_database("vrp", None)
+        tid = uuid.uuid4().hex
+        assert db.put_trace_spans([self._row(tid, "a", ["http"])])
+        assert db.put_trace_spans(
+            [self._row(tid, "b", ["dist.execute", "solve"], started=1000.5)]
+        )
+        rows = db.get_trace_spans(tid)
+        assert {r["replica"] for r in rows} == {"a", "b"}
+        # one summary per trace, rows merged: spans summed, both
+        # replicas named, duration spanning the earliest start to the
+        # latest end
+        (summary,) = db.list_traces(10)
+        assert summary["traceId"] == tid
+        assert summary["spans"] == 3
+        assert sorted(summary["replicas"]) == ["a", "b"]
+        assert summary["durationMs"] == pytest.approx(505.0)
+
+    def test_same_replica_overwrites_not_duplicates(self):
+        db = store.get_database("vrp", None)
+        tid = uuid.uuid4().hex
+        db.put_trace_spans([self._row(tid, "a", ["http"])])
+        db.put_trace_spans([self._row(tid, "a", ["http", "solve"])])
+        rows = db.get_trace_spans(tid)
+        assert len(rows) == 1 and rows[0]["spans"] == 2
+
+    def test_memory_table_stays_bounded(self):
+        db = store.get_database("vrp", None)
+        cap = mem._InMemoryMixin.MAX_TRACE_ROWS
+        rows = [
+            self._row(uuid.uuid4().hex, "a", ["x"]) for _ in range(40)
+        ]
+        mem._tables["trace_spans"].update({
+            (f"t{i}", "a"): {"trace_id": f"t{i}", "replica": "a"}
+            for i in range(cap)
+        })
+        db.put_trace_spans(rows)
+        assert len(mem._tables["trace_spans"]) == cap
+
+    def test_faulty_plan_injects(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        db = store.get_database("vrp", None)
+        tid = uuid.uuid4().hex
+        assert db.put_trace_spans([self._row(tid, "a", ["x"])]) is False
+        assert db.get_trace_spans(tid) is None
+        assert db.list_traces(5) is None
+
+    def test_replica_info_registry(self):
+        qs = store.get_queue_store()
+        qs.register_replica("r1", 60.0, {"inflight": 3})
+        qs.register_replica("r2", 60.0)
+        infos = qs.replica_infos()
+        assert infos["r1"] == {"inflight": 3}
+        assert infos["r2"] == {}
+        # a doc-less re-beat keeps the last doc (mixed fleets)
+        qs.register_replica("r1", 60.0)
+        assert qs.replica_infos()["r1"] == {"inflight": 3}
+        assert sorted(qs.replicas()) == ["r1", "r2"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+
+
+def _seed_dataset(key, n, seed=11):
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        key, [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+    )
+    mem.seed_durations(key, d.tolist())
+
+
+def _solve_content(key, n, seed=1):
+    return {
+        "problem": "vrp",
+        "algorithm": "sa",
+        "solutionName": f"obs-{key}",
+        "solutionDescription": "t",
+        "locationsKey": key,
+        "durationsKey": key,
+        "capacities": [2 * n] * 3,
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": seed,
+        "iterationCount": 200,
+        "populationSize": 8,
+    }
+
+
+@pytest.fixture(scope="module")
+def server():
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    from service import jobs as jobs_mod
+    from service.app import serve
+
+    jobs_mod.shutdown_scheduler()
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    jobs_mod.shutdown_scheduler()
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _poll(base, job_id, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, resp = _get(base, f"/api/jobs/{job_id}")
+        assert status == 200, resp
+        if resp["job"]["status"] in ("done", "failed"):
+            return resp["job"]
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never finished")
+
+
+class TestFederatedHTTP:
+    @pytest.fixture(autouse=True)
+    def env(self, server, monkeypatch):
+        from service import jobs as jobs_mod
+
+        monkeypatch.setenv("VRPMS_TRACE_EXPORT", "on")
+        _seed_dataset("fed7", 7)
+        yield
+        jobs_mod.shutdown_scheduler()
+
+    def _store_row(self, tid, replica, names, started, span_ids=None):
+        span_ids = span_ids or [uuid.uuid4().hex[:16] for _ in names]
+        doc = {
+            "traceId": tid,
+            "startedAt": started,
+            "durationMs": 3.0,
+            "status": "ok",
+            "replica": replica,
+            "spans": [
+                {
+                    "name": n,
+                    "spanId": sid,
+                    "parentId": None,
+                    "startMs": float(i),
+                    "durationMs": 1.0,
+                    "status": "ok",
+                    "events": [{"name": "job.started", "offsetMs": 0.5}],
+                }
+                for i, (n, sid) in enumerate(zip(names, span_ids))
+            ],
+        }
+        return {
+            "trace_id": tid,
+            "replica": replica,
+            "started_at": started,
+            "duration_ms": 3.0,
+            "status": "ok",
+            "root": names[0],
+            "spans": len(names),
+            "doc": doc,
+        }
+
+    def test_detail_federates_and_local_wins(self, server):
+        t = _make_trace()
+        t.finish()
+        local_solve = [
+            s for s in t.to_dict()["spans"] if s["name"] == "solve"
+        ][0]
+        db = store.get_database("vrp", None)
+        # another replica exported its half — including a CONFLICTING
+        # copy of the local solve span id, which must lose to the ring
+        db.put_trace_spans([
+            self._store_row(
+                t.trace_id, "replica-b",
+                ["dist.execute", "bogus-copy"],
+                started=t.start_ts + 0.002,
+                span_ids=[uuid.uuid4().hex[:16], local_solve["spanId"]],
+            ),
+        ])
+        status, resp = _get(server, f"/api/debug/traces/{t.trace_id}")
+        assert status == 200, resp
+        trace = resp["trace"]
+        assert "degraded" not in resp
+        names = [s["name"] for s in trace["spans"]]
+        assert "dist.execute" in names
+        assert "bogus-copy" not in names  # the local span id won
+        assert "solve" in names
+        assert len(trace["replicas"]) == 2
+        # the remote span's offset was rebased onto the earliest start
+        dist = [s for s in trace["spans"] if s["name"] == "dist.execute"][0]
+        assert dist["startMs"] >= 2.0
+        assert dist["replica"] == "replica-b"
+        # ...and its EVENTS were rebased onto the same merged clock (an
+        # un-shifted offset would sort the event before its own span)
+        (ev,) = dist["events"]
+        assert ev["offsetMs"] == pytest.approx(2.5, abs=0.3)
+
+    def test_detail_store_down_degrades_local_only(
+        self, server, monkeypatch
+    ):
+        t = _make_trace()
+        t.finish()
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        status, resp = _get(server, f"/api/debug/traces/{t.trace_id}")
+        assert status == 200, resp
+        assert resp["degraded"] is True
+        assert [s["name"] for s in resp["trace"]["spans"]] == [
+            "POST /api/vrp/sa", "solve",
+        ]
+
+    def test_detail_unknown_is_404_never_500(self, server, monkeypatch):
+        status, resp = _get(server, f"/api/debug/traces/{uuid.uuid4().hex}")
+        assert status == 404 and not resp["success"]
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        status, resp = _get(server, f"/api/debug/traces/{uuid.uuid4().hex}")
+        assert status == 404, resp
+        assert resp["degraded"] is True
+
+    def test_export_off_keeps_surfaces_byte_identical(
+        self, server, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_TRACE_EXPORT", "off")
+        t = _make_trace()
+        t.finish()
+        # a store row exists for the trace — off means it is NEVER read
+        db = store.get_database("vrp", None)
+        db.put_trace_spans([
+            self._store_row(t.trace_id, "replica-b", ["dist.execute"],
+                            started=t.start_ts),
+        ])
+        status, resp = _get(server, f"/api/debug/traces/{t.trace_id}")
+        assert status == 200
+        assert set(resp) == {"success", "trace", "requestId"}
+        assert resp["trace"] == t.to_dict()  # no merge keys, no replicas
+        status, resp = _get(server, "/api/debug/traces")
+        assert status == 200
+        assert set(resp) == {
+            "success", "tracing", "capacity", "traces", "requestId",
+        }
+
+    def test_fleet_scope_lists_exported_summaries(
+        self, server, monkeypatch
+    ):
+        for _ in range(2):
+            _make_trace().finish()
+        assert export.flush(10.0)
+        status, resp = _get(server, "/api/debug/traces?scope=fleet")
+        assert status == 200, resp
+        assert resp["scope"] == "fleet"
+        assert len(resp["traces"]) == 2
+        assert all(t["replicas"] for t in resp["traces"])
+        # store down: local ring serves, marked degraded
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        status, resp = _get(server, "/api/debug/traces?scope=fleet")
+        assert status == 200, resp
+        assert resp["degraded"] is True and resp["scope"] == "local"
+        assert len(resp["traces"]) == 2  # the ring still has them
+
+    def test_bad_scope_is_400(self, server):
+        status, resp = _get(server, "/api/debug/traces?scope=galaxy")
+        assert status == 400 and not resp["success"]
+
+    def test_jobid_filter_resolves_trace(self, server):
+        status, resp = _post(server, "/api/jobs", _solve_content("fed7", 7))
+        assert status == 202, resp
+        job = _poll(server, resp["jobId"])
+        assert job["status"] == "done"
+        assert job["traceId"]
+        status, resp = _get(
+            server, f"/api/debug/traces?jobId={job['id']}"
+        )
+        assert status == 200, resp
+        assert resp["resolvedTraceId"] == job["traceId"]
+        assert resp["traces"] and (
+            resp["traces"][0]["traceId"] == job["traceId"]
+        )
+
+    def test_jobid_unknown_is_404(self, server):
+        status, resp = _get(server, "/api/debug/traces?jobId=nope")
+        assert status == 404 and not resp["success"]
+
+    def test_timeline_tells_the_job_story(self, server):
+        status, resp = _post(
+            server, "/api/jobs", _solve_content("fed7", 7, seed=5)
+        )
+        assert status == 202, resp
+        job = _poll(server, resp["jobId"])
+        assert job["status"] == "done"
+        status, resp = _get(server, f"/api/jobs/{job['id']}/timeline")
+        assert status == 200, resp
+        assert resp["traceId"] == job["traceId"]
+        events = resp["timeline"]
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "submitted"
+        assert "solve" in kinds
+        assert kinds[-1] == "done" or "done" in kinds
+        # ordered: every clocked event is monotone
+        clocked = [e["atMs"] for e in events if e["atMs"] is not None]
+        assert clocked == sorted(clocked)
+        solve_ev = [e for e in events if e["event"] == "solve"][0]
+        assert "replica" in solve_ev and "ran" in solve_ev["detail"]
+        # incumbents from the persisted progress profile ride along
+        assert any(e["event"] == "incumbent" for e in events) or (
+            job.get("progress") is None
+        )
+
+    def test_timeline_unknown_job_is_404(self, server):
+        status, resp = _get(server, "/api/jobs/nope/timeline")
+        assert status == 404 and not resp["success"]
+
+    def test_fleet_endpoint_local_mode(self, server):
+        status, resp = _get(server, "/api/debug/fleet")
+        assert status == 200, resp
+        fleet = resp["fleet"]
+        assert fleet["queue"] == "local"
+        (self_info,) = [
+            r for r in fleet["replicas"].values() if r.get("self")
+        ]
+        assert isinstance(self_info["tiersWarmed"], list)
+        assert self_info["replicaId"] == fleet["generatedBy"]
+
+    def test_fleet_endpoint_aggregates_heartbeat_docs(
+        self, server, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        qs = store.get_queue_store()
+        qs.register_replica(
+            "peer-1", 60.0,
+            {"inflight": 3, "tiersWarmed": ["vrp:8x8x3"], "queued": 1},
+        )
+        status, resp = _get(server, "/api/debug/fleet")
+        assert status == 200, resp
+        fleet = resp["fleet"]
+        assert fleet["queue"] == "store"
+        peer = fleet["replicas"]["peer-1"]
+        assert peer["inflight"] == 3
+        assert peer["tiersWarmed"] == ["vrp:8x8x3"]
+        assert not peer.get("self")
+        assert any(
+            r.get("self") for r in fleet["replicas"].values()
+        )
+        assert fleet.get("sharedDepth") == 0
+
+    def test_fleet_endpoint_store_down_degrades(
+        self, server, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        monkeypatch.setenv("VRPMS_DEPTH_MEMO_MS", "0")
+        status, resp = _get(server, "/api/debug/fleet")
+        assert status == 200, resp
+        assert resp["degraded"] is True
+        # the local replica's live view still serves
+        assert any(
+            r.get("self") for r in resp["fleet"]["replicas"].values()
+        )
+
+
+# ---------------------------------------------------------------------------
+# Cross-replica federation (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+def _service_replica(rid, runner=None, **kw):
+    from service import jobs as jobs_mod
+
+    sched = Scheduler(
+        runner if runner is not None else jobs_mod._runner,
+        queue_limit=64,
+        window_s=0.005,
+        max_batch=8,
+        on_event=jobs_mod._on_event,
+        watchdog_s=0,
+    )
+    defaults = dict(
+        lease_s=1.0, poll_s=0.01, heartbeat_s=0.1, reclaim_s=0.05,
+        vnodes=16,
+    )
+    defaults.update(kw)
+    rep = Replica(
+        store.get_queue_store(),
+        rid,
+        materialize=lambda e: jobs_mod._materialize_entry(e, rid),
+        submit=lambda job: sched.submit(
+            job, backend=job.payload.get("backend") or "default"
+        ),
+        complete=jobs_mod._dist_complete,
+        dead=jobs_mod._dist_dead,
+        **defaults,
+    )
+    rep._test_scheduler = sched
+    return rep
+
+
+class TestCrossReplicaFederation:
+    def _entry(self, job_id, tid, slot, content, bucket="fed9-tier"):
+        return {
+            "id": job_id,
+            "slot": slot,
+            "bucket": bucket,
+            "time_limit": None,
+            "submitted_at": time.time(),
+            "payload": {
+                "content": content,
+                "requestId": f"req-{job_id}",
+                "problem": "vrp",
+                "algorithm": "sa",
+                "traceparent": f"00-{tid}-{uuid.uuid4().hex[:16]}-01",
+            },
+        }
+
+    def _submit_side_trace(self, tid):
+        """The submitting replica's half of the trace: the HTTP root it
+        records before the 202, finished (and exported) there."""
+        t = spans.Trace(trace_id=tid)
+        root = t.span("POST /api/jobs")
+        t.span("parse", parent_id=root.span_id).end()
+        root.end()
+        t.finish()
+        return t
+
+    def test_federated_read_spans_both_replicas_incl_attempt2(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_TRACE_EXPORT", "on")
+        _seed_dataset("fed9", 9)
+        qs = store.get_queue_store()
+
+        block = threading.Event()
+
+        def blocked_runner(jobs):
+            block.wait(timeout=600)  # a wedged box: never completes
+
+        victim = _service_replica(
+            "victim", runner=blocked_runner, lease_s=0.8, steal=False
+        )
+        rescuer = _service_replica("rescuer", lease_s=0.8, steal=False)
+        qs.register_replica("victim", 60.0)
+        qs.register_replica("rescuer", 60.0)
+        ring = HashRing(["victim", "rescuer"], vnodes=16)
+        victim_slot = next(
+            s for s in range(0, SLOTS, 191) if ring.owner(s) == "victim"
+        )
+        rescuer_slot = next(
+            s for s in range(0, SLOTS, 191) if ring.owner(s) == "rescuer"
+        )
+        # job A: claimed by the victim, which dies mid-flight — the
+        # rescuer reclaims it at attempt 2. job B: solved directly by
+        # the rescuer at attempt 1.
+        tid_a, tid_b = uuid.uuid4().hex, uuid.uuid4().hex
+        entry_a = self._entry(
+            uuid.uuid4().hex[:16], tid_a, victim_slot,
+            _solve_content("fed9", 9, seed=31),
+        )
+        # a DISTINCT ring token: claim-K batching fills mates by token
+        # from the whole queue, so sharing one would let the victim's
+        # batch claim sweep job B up too
+        entry_b = self._entry(
+            uuid.uuid4().hex[:16], tid_b, rescuer_slot,
+            _solve_content("fed9", 9, seed=32), bucket="fed9-tier-b",
+        )
+        # the submit side's half of both traces, exported from "here"
+        self._submit_side_trace(tid_a)
+        self._submit_side_trace(tid_b)
+        qs.enqueue(entry_a)
+        qs.enqueue(entry_b)
+        try:
+            victim.start()
+            rescuer.start()
+            assert _wait(lambda: victim.inflight() >= 1, timeout=20)
+            victim.kill()
+
+            db = store.get_database("vrp", None)
+
+            def both_done():
+                for e in (entry_a, entry_b):
+                    rec = db.get_job_seed(e["id"])
+                    if rec is None or rec.get("status") != "done":
+                        return False
+                return True
+
+            assert _wait(both_done, timeout=120), {
+                e["id"]: db.get_job_seed(e["id"])
+                for e in (entry_a, entry_b)
+            }
+        finally:
+            block.set()
+            victim.kill()
+            rescuer.stop()
+            victim._test_scheduler.shutdown(timeout=0.2)
+            rescuer._test_scheduler.shutdown(timeout=5.0)
+        assert export.flush(15.0)
+
+        from service.debug import merge_trace
+
+        my_rid = export.replica_identity()
+        for tid, attempt in ((tid_a, 2), (tid_b, 1)):
+            rows = db.get_trace_spans(tid)
+            assert rows is not None and rows, tid
+            merged = merge_trace(tid, spans.ring_get(tid), rows)
+            assert merged is not None
+            # spans from BOTH replicas under ONE traceId: the submit
+            # side's HTTP root + the executing replica's claim-side
+            # spans
+            assert my_rid in merged["replicas"], merged["replicas"]
+            assert "rescuer" in merged["replicas"], merged["replicas"]
+            names = [s["name"] for s in merged["spans"]]
+            assert "POST /api/jobs" in names
+            assert "dist.execute" in names
+            assert "solve" in names
+            dist = [
+                s for s in merged["spans"] if s["name"] == "dist.execute"
+            ]
+            assert max(
+                s.get("attributes", {}).get("attempt", 1) for s in dist
+            ) == attempt, (tid, dist)
+            # every claim-side span is attributed to the replica that
+            # recorded it
+            solve = [s for s in merged["spans"] if s["name"] == "solve"]
+            assert all(s.get("replica") == "rescuer" for s in solve)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: export failures drop cleanly
+# ---------------------------------------------------------------------------
+
+
+class TestExportChaos:
+    def test_export_failure_never_touches_requests(
+        self, server, monkeypatch
+    ):
+        from service import jobs as jobs_mod
+
+        jobs_mod.shutdown_scheduler()
+        monkeypatch.setenv("VRPMS_TRACE_EXPORT", "on")
+        # writes down: the exporter's batch write fails every time,
+        # while the request path's reads (locations/durations) work
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down;ops=writes")
+        _seed_dataset("chaos7", 7)
+        failed0 = _export_count("failed")
+        for seed in range(3):
+            status, resp = _post(
+                server, "/api/vrp/sa",
+                _solve_content("chaos7", 7, seed=seed),
+            )
+            assert status == 200, resp
+            assert resp["success"] is True
+        assert export.flush(15.0)
+        assert _export_count("failed") - failed0 > 0
+        assert export.queue_depth() == 0
+        jobs_mod.shutdown_scheduler()

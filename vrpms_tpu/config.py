@@ -196,6 +196,21 @@ REGISTRY: dict[str, Var] = {
            "Traces at least this slow auto-log their full waterfall."),
         _v("VRPMS_PROGRESS", "switch", True,
            "Live incumbent progress + cooperative cancellation."),
+        _v("VRPMS_TRACE_EXPORT", "switch", False,
+           "Durable trace export: completed traces batch-write to the "
+           "store's trace_spans seam so GET /api/debug/traces federates "
+           "across replicas. Off by default locally; turn on for "
+           "store-backed (VRPMS_QUEUE=store) deployments."),
+        _v("VRPMS_TRACE_EXPORT_QUEUE", "int", 256,
+           "Bounded export queue: completed traces awaiting the "
+           "background flusher; overflow DROPS the oldest spans "
+           "(counted vrpms_trace_export_total{outcome=dropped}), never "
+           "blocks a request."),
+        _v("VRPMS_TRACE_EXPORT_BATCH", "int", 16,
+           "Max traces one flusher round batch-writes per store call."),
+        _v("VRPMS_TRACE_EXPORT_FLUSH_MS", "float", 50.0,
+           "Idle wait between exporter flush rounds in milliseconds "
+           "(a non-empty queue flushes immediately)."),
         _v("VRPMS_ILS_TRACE", "str", None,
            "Truthy: print ILS round-by-round trace lines to stderr."),
         # -- solver + compile knobs ------------------------------------

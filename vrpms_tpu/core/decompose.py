@@ -611,6 +611,7 @@ def solve_shards(
     deadline_s: float | None = None,
     max_batch: int = 16,
     rollup: ShardRollup | None = None,
+    on_launch=None,
 ):
     """Solve every shard on the batched SA kernel in chunks of
     `max_batch` — the decomposition rides the micro-batcher's vmapped
@@ -618,7 +619,10 @@ def solve_shards(
     solo solves. Returns (results, launches). The deadline splits
     evenly across the remaining chunks; a cancelled rollup collapses
     the remaining chunks to a zero budget so they return their
-    constructive incumbents at one block's cost."""
+    constructive incumbents at one block's cost. `on_launch(chunk_index,
+    shard_lo, size, wall_s)` fires after each vmapped launch — the
+    service hangs per-launch trace events off it so the n=5000
+    waterfall shows where the launches spent their time."""
     from vrpms_tpu.obs import progress
     from vrpms_tpu.sched.batch import solve_sa_batch
 
@@ -639,6 +643,7 @@ def solve_shards(
             if rollup.cancelled:
                 chunk_deadline = 0.0
             rollup.begin(range(lo, lo + len(chunk)))
+        launch_t0 = time.monotonic()
         with progress.attach(rollup):
             results.extend(
                 solve_sa_batch(
@@ -650,6 +655,11 @@ def solve_shards(
                 )
             )
         launches += 1
+        if on_launch is not None:
+            try:
+                on_launch(ci, lo, len(chunk), time.monotonic() - launch_t0)
+            except Exception:
+                pass  # trace bookkeeping must never fail a solve
     return results, launches
 
 

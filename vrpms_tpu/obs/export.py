@@ -1,0 +1,397 @@
+"""Best-effort durable trace export: the fleet half of span tracing.
+
+The completed-trace ring (obs.spans) is process-local, so under
+VRPMS_QUEUE=store a job submitted on replica A and executed on replica
+B has its spans split across two rings no single debug read can see.
+This module closes that gap WITHOUT touching the request path's cost
+model: when VRPMS_TRACE_EXPORT is on, `Trace.finish` hands the
+completed trace to a bounded in-process queue (one deque append), and
+a background flusher batch-writes serialized span trees through the
+store's trace seam (store.base put_trace_spans — one row per
+(trace_id, replica), so replicas never clobber each other's half of a
+cross-replica trace). The federated debug surfaces (service.debug)
+merge those rows back with the local ring.
+
+Failure policy — an export outage drops spans, never blocks or fails
+a solve:
+
+  * queue full    -> the OLDEST queued trace is dropped (keep the
+                     newest evidence) and counted `dropped`;
+  * oversized doc -> events are trimmed, then attributes; a doc still
+                     over the row bound is dropped (counted `dropped`);
+  * store failure -> the batch's spans count `failed` (single-attempt,
+                     fail-open — store.resilient gives trace writes the
+                     solution cache's inverted policy: no retries, no
+                     journal, shared breaker);
+  * success       -> the batch's spans count `ok`.
+
+Every span offered is accounted exactly once across those outcomes
+(vrpms_trace_export_total{outcome} via the observer seam, plus the
+queue-depth gauge service.obs scrapes), so "are we losing telemetry"
+is a dashboard question, not an archaeology project.
+
+Knobs (vrpms_tpu.config): VRPMS_TRACE_EXPORT (off by default — local
+serving keeps the PR-5 process-local contract byte-identical),
+VRPMS_TRACE_EXPORT_QUEUE / _BATCH / _FLUSH_MS. Knobs are read when the
+exporter singleton is built; tests use `reset_exporter()` after
+changing them.
+
+Stdlib-only, like the rest of vrpms_tpu.obs: the store is reached
+through an injected factory (service wiring / tests), defaulting to a
+lazy `store.get_database` import on the flusher thread — never at
+import time, so the one-way obs -> (nothing) import rule holds.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import uuid
+
+from vrpms_tpu import config
+from vrpms_tpu.obs.logging import log_event
+
+#: hard bound on one exported row's serialized document — a runaway
+#: trace must degrade (events first, then attributes) or drop, never
+#: write an unbounded jsonb row
+MAX_ROW_BYTES = 262144
+
+OK, DROPPED, FAILED = "ok", "dropped", "failed"
+
+
+def enabled() -> bool:
+    return config.enabled("VRPMS_TRACE_EXPORT")
+
+
+# ---------------------------------------------------------------------------
+# Seams: metrics observer, replica identity, store factory
+# ---------------------------------------------------------------------------
+
+_observer = None
+
+
+def set_observer(fn) -> None:
+    """fn(outcome: str, n_spans: int) — service.obs wires the
+    vrpms_trace_export_total counter in (the set_cache_observer
+    pattern: this package stays free of service imports)."""
+    global _observer
+    _observer = fn
+
+
+def _notify(outcome: str, n: int) -> None:
+    if n and _observer is not None:
+        try:
+            _observer(outcome, n)
+        except Exception:
+            pass  # telemetry about telemetry must never break either
+
+
+_replica_provider = None
+_generated_replica: str | None = None
+
+
+def set_replica_provider(fn) -> None:
+    """fn() -> str — service.jobs wires its replica_id() in so exported
+    rows and /api/ready agree on this process's identity."""
+    global _replica_provider
+    _replica_provider = fn
+
+
+def replica_identity() -> str:
+    if _replica_provider is not None:
+        try:
+            rid = _replica_provider()
+            if rid:
+                return str(rid)
+        except Exception:
+            pass
+    global _generated_replica
+    if _generated_replica is None:
+        _generated_replica = (
+            config.get("VRPMS_REPLICA_ID")
+            or f"replica-{uuid.uuid4().hex[:8]}"
+        )
+    return _generated_replica
+
+
+_store_factory = None
+
+
+def set_store_factory(fn) -> None:
+    """fn() -> a store.base.Database (anything with put_trace_spans).
+    Tests and benchmarks inject shims here; None restores the default
+    (the configured store, resolved lazily on the flusher thread)."""
+    global _store_factory
+    _store_factory = fn
+
+
+def _store():
+    if _store_factory is not None:
+        return _store_factory()
+    from store import get_database
+
+    return get_database("vrp", None)
+
+
+# ---------------------------------------------------------------------------
+# Serialization: one bounded row per (trace, replica)
+# ---------------------------------------------------------------------------
+
+
+def serialize_trace(trace, replica: str) -> dict | None:
+    """The store row for one completed trace as THIS replica saw it.
+    Enforces the row byte bound by degrading gracefully — span events
+    go first, then attributes; None means even the skeleton is too big
+    (caller counts the spans dropped)."""
+    doc = trace.to_dict()
+    doc["replica"] = replica
+    root = doc["spans"][0]["name"] if doc["spans"] else None
+    for strip in (None, "events", "attributes"):
+        if strip is not None:
+            stripped = False
+            for span in doc["spans"]:
+                if strip in span:
+                    span.pop(strip, None)
+                    stripped = True
+            if stripped:
+                doc["truncated"] = True
+            else:
+                continue  # nothing left to strip at this level
+        try:
+            size = len(json.dumps(doc))
+        except (TypeError, ValueError):
+            return None  # unserializable attribute snuck in: drop
+        if size <= MAX_ROW_BYTES:
+            return {
+                "trace_id": trace.trace_id,
+                "replica": replica,
+                "started_at": trace.start_ts,
+                "duration_ms": doc["durationMs"],
+                "status": doc["status"],
+                "root": root,
+                "spans": len(doc["spans"]),
+                "doc": doc,
+            }
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The exporter: bounded queue + background batch flusher
+# ---------------------------------------------------------------------------
+
+
+class TraceExporter:
+    """Bounded hand-off between `Trace.finish` and the store.
+
+    `offer` is the request-path half: one lock/append (plus an eviction
+    pop when full) — serialization and store I/O happen on the flusher
+    thread. The flusher drains up to `batch` traces per round into ONE
+    put_trace_spans call, then idles `flush_s` (a fresh offer wakes it
+    immediately)."""
+
+    def __init__(self, queue_cap: int = 256, batch: int = 16,
+                 flush_s: float = 0.05):
+        self.queue_cap = max(1, int(queue_cap))
+        self.batch = max(1, int(batch))
+        self.flush_s = max(0.001, float(flush_s))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: collections.deque = collections.deque()  # guarded-by: _lock
+        self._busy = False  # guarded-by: _lock
+        self._halt = False  # guarded-by: _lock
+        self._warned = False  # guarded-by: _lock
+        # flusher-thread-only store handle, reused across rounds (a
+        # hosted-store client per batch would pay construction + a new
+        # session every ~flush_s); keyed by the active selector so env
+        # flips (tests, live re-config) rebuild it, and dropped after
+        # any failed write so a broken client is never pinned
+        self._db = None
+        self._db_key = None
+        self._thread = threading.Thread(
+            target=self._run, name="vrpms-trace-export", daemon=True
+        )
+        self._thread.start()
+
+    # -- request-path side --------------------------------------------------
+    def offer(self, trace) -> None:
+        dropped = None
+        with self._lock:
+            if self._halt:
+                return
+            self._queue.append(trace)
+            if len(self._queue) > self.queue_cap:
+                # drop the OLDEST evidence, keep the newest; the
+                # counter makes the loss visible
+                dropped = self._queue.popleft()
+            self._cond.notify()
+        if dropped is not None:
+            self._note_drop(dropped)
+
+    def _note_drop(self, trace) -> None:
+        _notify(DROPPED, self._span_count(trace))
+        with self._lock:
+            warned, self._warned = self._warned, True
+        if not warned:
+            # one structured event per backlog episode, not per drop
+            log_event(
+                "trace_export.dropping",
+                level="warn",
+                queue=self.queue_cap,
+                hint="raise VRPMS_TRACE_EXPORT_QUEUE or check store "
+                "latency; spans are being dropped",
+            )
+
+    @staticmethod
+    def _span_count(trace) -> int:
+        try:
+            with trace._lock:
+                return len(trace.spans)
+        except Exception:
+            return 1
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- flusher side -------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._halt:
+                    self._cond.wait(self.flush_s)
+                    if not self._queue and not self._halt:
+                        # idle tick: clear the backlog-warn latch so a
+                        # NEW backlog episode logs again
+                        self._warned = False
+                if self._halt and not self._queue:
+                    return
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(self.batch, len(self._queue)))
+                ]
+                self._busy = True
+            try:
+                self._flush(batch)
+            finally:
+                with self._lock:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def _flush(self, batch: list) -> None:
+        rid_default = replica_identity()
+        rows, ok_spans, dropped = [], 0, 0
+        for trace in batch:
+            n = self._span_count(trace)
+            row = None
+            try:
+                rid = getattr(trace, "export_replica", None) or rid_default
+                row = serialize_trace(trace, rid)
+            except Exception:
+                row = None
+            if row is None:
+                dropped += n
+                continue
+            rows.append(row)
+            ok_spans += n
+        if dropped:
+            _notify(DROPPED, dropped)
+        if not rows:
+            return
+        try:
+            wrote = self._resolve_store().put_trace_spans(rows)
+        except Exception:
+            wrote = False  # a factory/store constructor failure
+        if not wrote:
+            self._db = None  # fresh client next round
+        _notify(OK if wrote else FAILED, ok_spans)
+
+    def _resolve_store(self):
+        """The flusher's cached store handle (flusher thread only)."""
+        # the factory OBJECT rides the key (identity equality; holding
+        # the reference also keeps a replaced factory from aliasing)
+        key = (
+            _store_factory,
+            config.raw("VRPMS_STORE"),
+            config.get("SUPABASE_URL"),
+        )
+        if self._db is None or self._db_key != key:
+            self._db = _store()
+            self._db_key = key
+        return self._db
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until the queue is drained and no batch is in flight
+        (tests / benchmarks / shutdown); False on timeout."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._queue or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.05))
+        return True
+
+    def stop(self, drain_s: float = 2.0) -> None:
+        self.flush(timeout=drain_s)
+        with self._lock:
+            self._halt = True
+            self._cond.notify_all()
+        self._thread.join(timeout=drain_s + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Process singleton + the Trace.finish hook
+# ---------------------------------------------------------------------------
+
+_exporter_lock = threading.Lock()
+_exporter: TraceExporter | None = None  # guarded-by: _exporter_lock
+
+
+def get_exporter() -> TraceExporter:
+    global _exporter
+    with _exporter_lock:
+        if _exporter is None:
+            _exporter = TraceExporter(
+                queue_cap=config.get("VRPMS_TRACE_EXPORT_QUEUE"),
+                batch=config.get("VRPMS_TRACE_EXPORT_BATCH"),
+                flush_s=config.get("VRPMS_TRACE_EXPORT_FLUSH_MS") / 1e3,
+            )
+        return _exporter
+
+
+def offer(trace) -> None:
+    """The spans.Trace.finish hook: hand a completed trace to the
+    exporter. With the switch off this is ONE env read — the always-on
+    hot-path contract every obs hook honors."""
+    if not enabled():
+        return
+    if not trace.spans:
+        return  # an empty trace carries no evidence (the ring rule)
+    get_exporter().offer(trace)
+
+
+def queue_depth() -> int:
+    """Exporter backlog for the scrape-time gauge (0 when no exporter
+    was ever built — scraping must not build one)."""
+    with _exporter_lock:
+        exp = _exporter
+    return exp.depth() if exp is not None else 0
+
+
+def flush(timeout: float = 10.0) -> bool:
+    """Drain the exporter if one exists (tests/benchmarks/shutdown)."""
+    with _exporter_lock:
+        exp = _exporter
+    return exp.flush(timeout) if exp is not None else True
+
+
+def reset_exporter() -> None:
+    """Stop and forget the exporter (tests; knobs re-read on rebuild)."""
+    global _exporter
+    with _exporter_lock:
+        exp, _exporter = _exporter, None
+    if exp is not None:
+        exp.stop(drain_s=0.5)

@@ -42,6 +42,7 @@ import time
 import uuid
 
 from vrpms_tpu import config
+from vrpms_tpu.obs import export as trace_export
 from vrpms_tpu.obs.logging import log_event
 
 #: hard caps so a runaway request can never grow an unbounded trace
@@ -235,6 +236,13 @@ class Trace:
                  remote_parent_id: str | None = None):
         self.trace_id = trace_id or new_trace_id()
         self.remote_parent_id = remote_parent_id
+        #: which replica's spans these are, for the durable exporter's
+        #: (trace_id, replica) row key — None means the process default
+        #: (obs.export.replica_identity). The distributed claim path
+        #: stamps the leasing replica's id so a submit-side trace and
+        #: an execute-side trace sharing one trace_id never clobber
+        #: each other's exported row.
+        self.export_replica: str | None = None
         self.start_mono = time.monotonic()
         self.start_ts = time.time()
         self.spans: list[Span] = []  # guarded-by: _lock
@@ -304,6 +312,10 @@ class Trace:
             self.status = status
         dur = self.duration_ms()
         _ring_push(self)
+        # durable export (VRPMS_TRACE_EXPORT; off = one env read): the
+        # completed trace is handed to a bounded background flusher so
+        # the fleet debug surfaces can federate it across replicas
+        trace_export.offer(self)
         if dur >= slow_threshold_ms():
             log_event(
                 "trace.slow",

@@ -73,6 +73,7 @@ class Replica:
         steal: bool = True,
         vnodes: int = 64,
         claim_batch: int = 0,
+        info=None,
     ):
         self.store = store
         self.replica_id = replica_id
@@ -81,6 +82,11 @@ class Replica:
         self._complete = complete
         self._dead = dead
         self._on_event = on_event
+        # optional heartbeat status doc provider: () -> dict, published
+        # with each membership beat so peers' fleet rollups
+        # (GET /api/debug/fleet) see this replica's inflight/claim-mix/
+        # warmed-tier state without any replica-to-replica RPC
+        self._info = info
         self.lease_s = max(0.05, float(lease_s))
         self.poll_s = max(0.005, float(poll_s))
         self.heartbeat_s = max(0.05, float(heartbeat_s))
@@ -240,10 +246,25 @@ class Replica:
             self._halt.wait(self.poll_s)
 
     def _heartbeat(self) -> None:
+        doc = None
+        if self._info is not None:
+            try:
+                doc = self._info()
+            except Exception:
+                doc = None  # a broken provider must not stop the beat
         try:
             # membership TTL = 3 heartbeats: one missed beat (GC pause,
             # slow store call) must not flap the ring
-            self.store.register_replica(self.replica_id, 3 * self.heartbeat_s)
+            ttl = 3 * self.heartbeat_s
+            if doc is None:
+                self.store.register_replica(self.replica_id, ttl)
+            else:
+                try:
+                    self.store.register_replica(self.replica_id, ttl, doc)
+                except TypeError:
+                    # backend predates the info parameter: membership
+                    # still beats, the fleet rollup just loses the doc
+                    self.store.register_replica(self.replica_id, ttl)
         except Exception as exc:
             self._store_error("register_replica", exc)
             return
