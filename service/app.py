@@ -30,6 +30,7 @@ from service.debug import (
     TracesHandler,
 )
 from service.jobs import (
+    DrainHandler,
     JobResolveHandler,
     JobsHandler,
     JobStatusHandler,
@@ -59,6 +60,7 @@ ROUTES = {
     "/api/tsp/bf": tsp_bf,
     "/api/jobs": JobsHandler,
     "/api/ready": ReadyHandler,
+    "/api/admin/drain": DrainHandler,
     "/api/debug/traces": TracesHandler,
     "/api/debug/fleet": FleetHandler,
     "/metrics": obs.MetricsHandler,
@@ -225,7 +227,10 @@ def main():
     )
     # SIGTERM (the orchestrator's stop signal) must reach the drain
     # path — the default handler would kill the process with jobs still
-    # queued and waiters parked
+    # queued and waiters parked. On the store-backed queue the shutdown
+    # is a graceful drain: in-flight leases get VRPMS_DRAIN_GRACE_S to
+    # finish, the rest checkpoint-and-nack to peers (service.jobs.
+    # shutdown_scheduler)
     import signal
 
     def _sigterm(*_):

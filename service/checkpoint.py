@@ -1,0 +1,497 @@
+"""Durable solve checkpoints: capture, background flush, resume.
+
+A lease reclaim (sched.replica), a watchdog requeue (sched.worker), or
+a graceful drain used to re-run a job FROM ZERO at attempt=2 — a
+replica dying at 95% of a long anneal threw away every improving
+incumbent it had already published, and a decomposed giant lost all its
+solved shards. This module closes that gap with three pieces:
+
+  * **capture** — `register()` hangs a `_Handle` off the job's
+    ProgressSink; the solver seam (solvers.common.run_blocked) asks it
+    `due()` at every block boundary and, at most once per
+    `VRPMS_CKPT_MS`, `offer()`s the champion tour. The decomposed path
+    (service.solve._solve_decomposed) instead calls `note_shard()` as
+    each shard chunk completes. Capture only snapshots host/device
+    arrays the drivers already synced — it never changes the block
+    decomposition or any device computation, so fixed-seed responses
+    are byte-identical with checkpointing on or off.
+  * **flush** — one background daemon thread decodes pending giants to
+    routes in ORIGINAL location ids and writes
+    `{problem, algorithm, routes, cost, evals, elapsedMs, shards?}`
+    through the store.base checkpoint seam (put/get/delete keyed by
+    job id + attempt). Strictly best-effort with the solution cache's
+    fail-open store policy: a failed write increments
+    `vrpms_ckpt_total{outcome="dropped"}` and nothing else.
+  * **resume** — `load_resume()` reads the latest checkpoint for a
+    reclaimed / requeued / drain-nacked job id; the service injects the
+    routes as a `warmStart: {"tour": ...}` spec (distributed claims) or
+    seeds the surviving Prepared directly (local watchdog requeues), so
+    attempt=2 enters through the EXISTING Prepared.resolve continuation
+    path — SA re-enters at the seed-estimated temperature, GA ramps the
+    seeded population, ACO pre-deposits the seed tour's pheromone — and
+    `seed_incumbent` opens the new sink at the checkpoint cost so the
+    first published incumbent can never be worse than the checkpoint.
+
+Terminal paths call `finished()` / `delete_for()` so acked and dead
+jobs leave no stale rows behind (the hosted backend's retention sweep
+in store/schema.sql is the backstop). `VRPMS_CKPT=off` disables
+everything: no handle is attached, no store op runs, and the request
+path is byte-identical to the pre-checkpoint service.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import store
+from service import obs
+from vrpms_tpu import config
+from vrpms_tpu.obs import log_event
+
+
+def enabled() -> bool:
+    """The VRPMS_CKPT master switch (default on). Read per call so
+    tests and embedders toggle at runtime. Capture additionally needs a
+    progress sink, so VRPMS_PROGRESS=off implies no checkpoints."""
+    return config.enabled("VRPMS_CKPT")
+
+
+def interval_s() -> float:
+    return max(0.0, config.get("VRPMS_CKPT_MS")) / 1e3
+
+
+def _dropped(n: int = 1) -> None:
+    obs.CKPT_TOTAL.labels(outcome="dropped").inc(n)
+
+
+class _Entry:
+    """One live job's checkpoint state (capture side + flusher side)."""
+
+    def __init__(self, job, prep, attempt: int):
+        self.job_id = job.id
+        self.attempt = max(1, int(attempt))
+        self.problem = prep.problem
+        self.algorithm = prep.algorithm
+        # decode context: giant tours are in padded active indexing;
+        # routes persist in ORIGINAL location ids (robust to active-set
+        # drift at resume, like every other warm-seed source)
+        self.orig_ids = list(prep.orig_ids or [])
+        inst = prep.inst
+        self.n_real = (
+            None
+            if inst is None or inst.n_real is None
+            else int(inst.n_real)
+        )
+        # span parentage for ckpt.write (the _persist pattern: flusher
+        # threads have no active trace context)
+        self.trace = job.trace
+        self.span = job.span
+        self.lock = threading.Lock()
+        self.pending = None  # guarded-by: lock (host copy of the giant)
+        self.snap = None  # guarded-by: lock (sink snapshot at capture)
+        self.shards = {}  # guarded-by: lock ({shard: {routes, cost}})
+        self.dirty = False  # guarded-by: lock
+        self.closed = False  # guarded-by: lock
+        self.wrote = False  # guarded-by: lock (any row persisted)
+        self.resumed = False  # guarded-by: lock (seeded from a row)
+        # first capture waits ONE full interval from registration: a
+        # solve shorter than VRPMS_CKPT_MS never pays a checkpoint
+        self.last_capture = time.monotonic()  # guarded-by: lock
+        self.last_seq = 0  # guarded-by: lock (sink.seq at last capture)
+
+    # -- capture side (solver / worker threads) -----------------------------
+    def due(self, sink) -> bool:
+        now = time.monotonic()
+        with self.lock:
+            if self.closed:
+                return False
+            if now - self.last_capture < interval_s():
+                return False
+            # only improved incumbents are worth a write: the sink's
+            # seq advances exactly when it publishes one
+            return sink.seq != self.last_seq
+
+    def offer(self, sink, giant) -> None:
+        import numpy as np
+
+        try:
+            arr = np.asarray(giant)
+        except Exception:
+            _dropped()
+            return
+        snap = sink.snapshot()
+        with self.lock:
+            if self.closed:
+                return
+            self.pending = arr
+            self.snap = snap
+            self.dirty = True
+            self.last_capture = time.monotonic()
+            self.last_seq = sink.seq
+        _checkpointer().kick()
+
+    def note_shard(self, shard: int, routes: list, cost: float) -> None:
+        """A decomposed solve finished shard `shard` (routes in
+        shard-LOCAL node positions): persist it so a resumed attempt
+        solves only the remaining shards before stitching."""
+        with self.lock:
+            if self.closed:
+                return
+            self.shards[int(shard)] = {
+                "routes": [list(map(int, r)) for r in routes],
+                "cost": float(cost),
+            }
+            self.dirty = True
+            self.last_capture = time.monotonic()
+        _checkpointer().kick()
+
+    def mark_resumed(self) -> None:
+        with self.lock:
+            self.resumed = True
+
+    # -- flusher side --------------------------------------------------------
+    def take(self):
+        """(giant, snap, shards, attempt) snapshot for one flush, or
+        None when there is nothing new; clears the dirty flag."""
+        with self.lock:
+            if not self.dirty or self.closed:
+                return None
+            self.dirty = False
+            return (
+                self.pending,
+                dict(self.snap) if self.snap else None,
+                {k: dict(v) for k, v in self.shards.items()},
+                self.attempt,
+            )
+
+    def close(self) -> tuple[bool, bool]:
+        """Stop further captures/flushes; returns (may_have_rows,
+        resumed). A capture whose write is still in flight counts — the
+        terminal delete must not skip a row that lands a moment
+        later."""
+        with self.lock:
+            self.closed = True
+            captured = self.pending is not None or bool(self.shards)
+            return self.wrote or captured, self.resumed
+
+    def note_wrote(self) -> None:
+        with self.lock:
+            self.wrote = True
+
+    def decode_routes(self, giant) -> list | None:
+        """Champion giant (padded active indexing) -> routes of
+        ORIGINAL location ids, the shape every warm-seed source uses."""
+        if giant is None:
+            return None
+        from vrpms_tpu.core.encoding import routes_from_giant
+
+        routes = []
+        for route in routes_from_giant(giant, self.n_real):
+            if route:
+                routes.append([int(self.orig_ids[c]) for c in route])
+        return routes or None
+
+
+class Checkpointer:
+    """The process checkpointer: a registry of live jobs' entries and
+    ONE background flusher thread that owns every checkpoint store op
+    (writes strictly ordered with deletes — no device-loop thread ever
+    pays a checkpoint store round trip)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}  # guarded-by: _lock
+        self._deletes: list[str] = []  # guarded-by: _lock
+        self._thread = None  # guarded-by: _lock
+        self._wake = threading.Event()
+
+    # -- registry ------------------------------------------------------------
+    def register(self, job, prep, attempt: int = 1):
+        """Attach a capture handle to `job`'s sink (no-op without a
+        sink, a prep, or VRPMS_CKPT). Returns the entry or None."""
+        if not enabled() or job.sink is None or prep is None:
+            return None
+        if prep.inst is None and prep.decomp is None:
+            return None
+        entry = _Entry(job, prep, attempt)
+        with self._lock:
+            self._entries[job.id] = entry
+        job.sink.ckpt = entry
+        self._ensure_thread()
+        return entry
+
+    def entry_for(self, job_id: str) -> _Entry | None:
+        with self._lock:
+            return self._entries.get(str(job_id))
+
+    def finished(self, job_id: str, delete: bool = True) -> None:
+        """Terminal hygiene: stop captures and (when any row may exist
+        — this process wrote one, or the attempt was itself resumed
+        from one) queue the job's rows for deletion. Jobs that never
+        checkpointed cost no store op here."""
+        with self._lock:
+            entry = self._entries.pop(str(job_id), None)
+        if entry is None:
+            return
+        wrote, resumed = entry.close()
+        if delete and (wrote or resumed) and enabled():
+            self.delete_for(job_id)
+
+    def delete_for(self, job_id: str) -> None:
+        """Queue an unconditional checkpoint-row delete (the dead-entry
+        path: the rows may have been written by ANOTHER replica)."""
+        if not enabled():
+            return
+        with self._lock:
+            self._deletes.append(str(job_id))
+        self._ensure_thread()
+        self._wake.set()
+
+    def flush_job(self, job_id: str) -> bool:
+        """Synchronously flush one job's pending state (the drain
+        path: the entry must be durable BEFORE the nack hands the job
+        to a peer). Returns True when a row was written."""
+        entry = self.entry_for(job_id)
+        if entry is None:
+            return False
+        return self._flush_entry(entry, self._db())
+
+    def kick(self) -> None:
+        self._wake.set()
+
+    # -- the flusher thread --------------------------------------------------
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._run, name="vrpms-ckpt-flusher", daemon=True
+            )
+            self._thread.start()
+
+    def _run(self) -> None:  # pragma: no cover - exercised via the API
+        while True:
+            self._wake.wait(timeout=min(1.0, max(0.02, interval_s())))
+            self._wake.clear()
+            try:
+                self.flush_round()
+            except Exception as exc:
+                # the flusher must never die; a broken round drops its
+                # captures (accounted) and the next cadence retries
+                _dropped()
+                log_event(
+                    "ckpt.flush_error",
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+
+    def _db(self):
+        return store.get_database("vrp", None)
+
+    def flush_round(self) -> int:
+        """One flush pass: write every dirty entry, then run queued
+        deletes (same thread, so a delete can never be overtaken by a
+        late write for the same job). Returns rows written."""
+        with self._lock:
+            entries = list(self._entries.values())
+            deletes, self._deletes = self._deletes, []
+        wrote = 0
+        db = None
+        for entry in entries:
+            if db is None:
+                db = self._db()
+            if self._flush_entry(entry, db):
+                wrote += 1
+        for job_id in deletes:
+            if db is None:
+                db = self._db()
+            db.delete_checkpoint(job_id)
+        return wrote
+
+    def _flush_entry(self, entry: _Entry, db) -> bool:
+        taken = entry.take()
+        if taken is None:
+            return False
+        giant, snap, shards, attempt = taken
+        try:
+            routes = entry.decode_routes(giant)
+        except Exception as exc:
+            _dropped()
+            log_event(
+                "ckpt.decode_error",
+                jobId=entry.job_id,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            return False
+        state = {
+            "problem": entry.problem,
+            "algorithm": entry.algorithm,
+            "routes": routes,
+            "cost": None if snap is None else snap.get("bestCost"),
+            "evals": None if snap is None else snap.get("evals"),
+            "elapsedMs": None if snap is None else snap.get("wallMs"),
+        }
+        if shards:
+            state["shards"] = {str(k): v for k, v in shards.items()}
+        # explicit span on the job's own trace (the _persist pattern:
+        # no trace context is active on the flusher thread)
+        sp = None
+        if entry.trace is not None:
+            sp = entry.trace.span(
+                "ckpt.write",
+                parent_id=(
+                    entry.span.span_id if entry.span is not None else None
+                ),
+            )
+            sp.set(
+                jobId=entry.job_id,
+                attempt=attempt,
+                cost=state["cost"],
+                shards=len(shards) or None,
+            )
+        try:
+            ok = db.put_checkpoint(entry.job_id, attempt, state)
+        finally:
+            if sp is not None:
+                sp.end(status=None)
+        if ok:
+            entry.note_wrote()
+            obs.CKPT_TOTAL.labels(outcome="written").inc()
+        else:
+            _dropped()
+        return ok
+
+
+_ckpt_lock = threading.Lock()
+_ckpt: Checkpointer | None = None  # guarded-by: _ckpt_lock
+
+
+def _checkpointer() -> Checkpointer:
+    global _ckpt
+    with _ckpt_lock:
+        if _ckpt is None:
+            _ckpt = Checkpointer()
+        return _ckpt
+
+
+def checkpointer() -> Checkpointer:
+    """The process singleton (tests reach flush_round/entries here)."""
+    return _checkpointer()
+
+
+def reset() -> None:
+    """Forget the registry (test hygiene between in-process services;
+    the daemon thread, if any, keeps idling harmlessly)."""
+    global _ckpt
+    with _ckpt_lock:
+        _ckpt = None
+
+
+# ---------------------------------------------------------------------------
+# Resume: reclaimed / requeued / drain-nacked attempts seed from the rows
+# ---------------------------------------------------------------------------
+
+
+def load_resume(job_id: str) -> dict | None:
+    """The latest durable checkpoint STATE for `job_id`, or None
+    (disabled, missing, unreadable — every miss degrades to a
+    from-zero attempt, never to a failed job)."""
+    if not enabled():
+        return None
+    try:
+        row = store.get_database("vrp", None).get_checkpoint(job_id)
+    except Exception:
+        return None
+    if not isinstance(row, dict):
+        return None
+    state = row.get("state")
+    return state if isinstance(state, dict) else None
+
+
+def note_resumed(job, state: dict, source: str) -> None:
+    """Account a successful resume: the counter, a zero-width
+    ckpt.resume span on the job's trace, and — monolithic resumes —
+    the sink opens at the checkpoint cost so the first published
+    incumbent can never be worse than the checkpoint."""
+    obs.CKPT_TOTAL.labels(outcome="resumed").inc()
+    if job.trace is not None:
+        sp = job.trace.span(
+            "ckpt.resume",
+            parent_id=job.span.span_id if job.span is not None else None,
+        )
+        sp.set(
+            jobId=job.id,
+            source=source,
+            cost=state.get("cost"),
+            shards=len(state.get("shards") or {}) or None,
+        )
+        sp.end()
+    if (
+        job.sink is not None
+        and state.get("cost") is not None
+        and not state.get("shards")
+    ):
+        try:
+            job.sink.seed_incumbent(
+                float(state["cost"]), int(state.get("evals") or 0)
+            )
+        except (TypeError, ValueError):
+            pass
+    entry = _checkpointer().entry_for(job.id)
+    if entry is not None:
+        entry.mark_resumed()
+    log_event(
+        "ckpt.resume",
+        jobId=job.id,
+        source=source,
+        cost=state.get("cost"),
+        shards=len(state.get("shards") or {}) or None,
+    )
+
+
+def apply_local_resume(job) -> None:
+    """The watchdog-requeue half of resume: the Job object (and its
+    Prepared) survived the worker crash in-process, so the checkpoint
+    seeds the EXISTING prep — warm perm + continuation marker for
+    monolithic solves, the completed-shard map for decomposed ones —
+    and the remaining budget replaces the fresh one the requeue reset
+    granted. Best-effort: any mismatch solves from zero."""
+    if not enabled() or not job.requeued:
+        return
+    prep = (job.payload or {}).get("prep")
+    if prep is None:
+        return
+    state = load_resume(job.id)
+    if state is None:
+        return
+    if (
+        state.get("problem") != prep.problem
+        or state.get("algorithm") != prep.algorithm
+    ):
+        return
+    seeded = False
+    if prep.decomp is not None:
+        if state.get("shards"):
+            prep.ckpt = state
+            seeded = True
+    elif state.get("routes"):
+        from service import cache as solution_cache
+
+        try:
+            warm = solution_cache._repair_perm(prep, state["routes"])
+        except Exception:
+            warm = None
+        if warm is not None:
+            prep.warm = warm
+            prep.resolve = {"seedSource": "checkpoint", "seeded": True}
+            seeded = True
+    if not seeded:
+        return
+    # remaining budget: the requeue forgave the crashed run's elapsed
+    # time (sched.queue.reopen_for_requeue) — a RESUMED attempt must
+    # not also get a fresh budget, or crash-resume would grant more
+    # wall clock than the request paid for
+    elapsed_ms = state.get("elapsedMs")
+    if job.time_limit and job.time_limit > 0 and elapsed_ms:
+        job.payload["ckpt_elapsed_s"] = float(elapsed_ms) / 1e3
+    note_resumed(job, state, source="watchdog")

@@ -718,6 +718,12 @@ class FleetHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             self=True,
         )
         fleet["replicas"] = replicas
+        drain = jobs_mod.drain_info()
+        if drain is not None:
+            # the answering replica is draining: surfaced at the top
+            # level too (a fully-drained replica has deregistered its
+            # heartbeat, so the members list alone would hide it)
+            fleet["draining"] = drain
         payload: dict = {"success": True, "fleet": fleet}
         if degraded:
             payload["degraded"] = True

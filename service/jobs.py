@@ -39,6 +39,7 @@ import store
 from service import obs
 from vrpms_tpu import config
 from service import cache as solution_cache
+from service import checkpoint as ckpt_mod
 from service.helpers import (
     fail,
     read_json_body,
@@ -452,8 +453,29 @@ def _inject_span_stats(job: Job) -> None:
 
 def _run_solo(job: Job) -> None:
     prep: Prepared = job.payload["prep"]
+    if job.requeued and not (job.payload or {}).get("dist"):
+        # watchdog-requeue resume: the Job (and its Prepared) survived
+        # the worker crash in-process — seed it from the durable
+        # checkpoint so attempt=2 continues instead of restarting
+        # (distributed reclaims resumed at materialize already)
+        ckpt_mod.apply_local_resume(job)
     if job.time_limit and job.time_limit > 0:
         prep.opts = dict(prep.opts, time_limit=_remaining_budget(job))
+        ckpt_elapsed = (job.payload or {}).get("ckpt_elapsed_s")
+        if ckpt_elapsed:
+            # a RESUMED attempt runs on the REMAINING budget: the
+            # requeue forgave the crashed run's clock, the checkpoint
+            # remembers how much of it was spent
+            current = prep.opts.get("time_limit")
+            remaining = max(0.0, float(job.time_limit) - float(ckpt_elapsed))
+            prep.opts = dict(
+                prep.opts,
+                time_limit=(
+                    remaining
+                    if current is None
+                    else min(float(current), remaining)
+                ),
+            )
     errors: list = []
     token = set_request_id(job.request_id)
     span_tokens = _activate_job_context(job)
@@ -617,8 +639,16 @@ def _runner(jobs: list[Job]) -> None:
         # a merged job is cut by at most half its budget)
         batch = [
             j for j in jobs
-            if not (j.time_limit and j.time_limit > 0)
-            or _remaining_budget(j) >= 0.5 * j.time_limit
+            if (
+                not (j.time_limit and j.time_limit > 0)
+                or _remaining_budget(j) >= 0.5 * j.time_limit
+            )
+            # a requeued job may hold a checkpoint to resume from; the
+            # batched launch has no per-job init, so it solves solo
+            # (its seed, continuation schedule, and remaining budget
+            # all apply there) — without checkpointing the requeue
+            # keeps its batched path exactly as before
+            and not (j.requeued and ckpt_mod.enabled())
         ]
         if len(batch) > 1:
             t0 = time.monotonic()
@@ -790,6 +820,12 @@ def _on_event(name: str, job: Job) -> None:
         # fairness bookkeeping: the tenant's quota slot frees the
         # moment the job is terminal, whatever path got it there
         _tenant_release(job)
+        if not (job.payload or {}).get("dist"):
+            # stale-checkpoint hygiene: a terminal local job's rows are
+            # dead state (distributed jobs clean up in _dist_complete,
+            # gated on the ack — an un-acked completion's rows belong
+            # to the reclaiming peer)
+            ckpt_mod.checkpointer().finished(job.id)
     if terminal and job.trace is not None and job.trace.deferred:
         # finish BEFORE the terminal persist: once a poll can read the
         # job as done, GET /api/debug/traces/{traceId} must find the
@@ -879,7 +915,15 @@ def shutdown_scheduler() -> int:
     with _replica_lock:
         r, _replica = _replica, None
     if r is not None:
+        if ckpt_mod.enabled() and not r.draining:
+            # SIGTERM = graceful drain: in-flight leases get the grace
+            # window, the rest checkpoint-and-nack to peers (no burned
+            # attempt, no lease-expiry wait)
+            r.drain(
+                config.get("VRPMS_DRAIN_GRACE_S"), requeue=_drain_requeue
+            )
         r.stop(drain_s=config.get("VRPMS_REPLICA_DRAIN_S"))
+    _reset_drain()  # a rebuilt service starts undrained
     global _replica_id_cached
     _replica_id_cached = None  # a rebuilt service re-reads the env
     with _depth_lock:
@@ -958,6 +1002,10 @@ def replica_info() -> dict:
     registry each heartbeat (sched.replica), so the rollup needs no
     replica-to-replica RPC."""
     info: dict = {"updatedAt": time.time()}
+    if is_draining():
+        # peers' fleet rollups (and the local overlay) see the drain:
+        # this replica is finishing or handing off its leases
+        info["draining"] = True
     rep = _replica
     if rep is not None:
         try:
@@ -1104,6 +1152,8 @@ def _dist_event(name: str, replicaId: str | None = None, **kw) -> None:
         obs.DIST_LEASES.labels(event="expired_dead").inc()
     elif name == "lease_lost":
         obs.DIST_LEASES.labels(event="lost").inc()
+    elif name == "drain_requeued":
+        obs.DIST_LEASES.labels(event="drain_requeued").inc()
     elif name == "ack_lost":
         obs.DIST_LEASES.labels(event="ack_lost").inc()
     elif name == "nack":
@@ -1244,6 +1294,34 @@ def _materialize_entry(entry: dict, rid: str | None = None) -> Job:
     errors: list = []
     try:
         ctx = _parse_content(content, errors)
+        # crash-resume: a reclaimed entry (attempt > 0) or a drain-
+        # nacked one (payload marked "ckpt") loads the predecessor
+        # attempt's durable checkpoint and enters through the EXISTING
+        # Prepared.resolve continuation path — the routes become an
+        # inline warmStart tour, so SA re-enters at the seed-estimated
+        # temperature, GA ramps, ACO pre-deposits, all with the
+        # remaining budget (submitted_mono is back-dated below).
+        # Best-effort: a missing/unreadable checkpoint solves from zero.
+        resume_state = None
+        if (
+            ctx is not None
+            and ckpt_mod.enabled()
+            and (entry.get("attempt") or payload.get("ckpt"))
+        ):
+            resume_state = ckpt_mod.load_resume(job.id)
+            if resume_state is not None and (
+                resume_state.get("problem") != ctx["problem"]
+                or resume_state.get("algorithm") != ctx["algorithm"]
+            ):
+                resume_state = None
+            if (
+                resume_state is not None
+                and resume_state.get("routes")
+                and not resume_state.get("shards")
+            ):
+                ctx["opts"]["warm_start"] = {
+                    "tour": resume_state["routes"]
+                }
         prep = None
         if ctx is not None:
             prep = prepare_request(
@@ -1270,10 +1348,28 @@ def _materialize_entry(entry: dict, rid: str | None = None) -> Job:
                 )
             job.finish(DONE)
             return job
+        if resume_state is not None and resume_state.get("shards"):
+            if prep.decomp is not None:
+                # resumed decomposition: solve only the shards the
+                # checkpoint does not already carry (service.solve
+                # validates them against the rebuilt plan)
+                prep.ckpt = resume_state
+            else:
+                resume_state = None  # plan gone (config drift): cold
         job.payload["prep"] = prep
         job.payload["backend"] = _backend_label(ctx["opts"])
         job.bucket = _bucket_key(prep)
         _attach_sink(job, prep)
+        ckpt_mod.checkpointer().register(job, prep, attempt=attempt)
+        if resume_state is not None and (
+            prep.ckpt is not None
+            or (prep.resolve is not None and prep.resolve.get("seeded"))
+        ):
+            ckpt_mod.note_resumed(
+                job,
+                resume_state,
+                source="reclaim" if entry.get("attempt") else "drain",
+            )
         _register_live(job)
         return job
     except Exception as e:
@@ -1317,6 +1413,9 @@ def _dist_complete(job: Job, entry: dict, acked: bool) -> None:
         db = store.get_database(job.payload.get("problem") or "vrp", None)
         job.payload["job_db"] = db
         _persist(job)
+        # ack confirmed: this replica owns the terminal — its
+        # checkpoint rows are dead state now (stale-checkpoint hygiene)
+        ckpt_mod.checkpointer().finished(job.id)
         if "prep" not in job.payload:
             # born terminal at materialize (cache hit, trivial, or
             # build failure): never passed through the scheduler, so
@@ -1324,6 +1423,10 @@ def _dist_complete(job: Job, entry: dict, acked: bool) -> None:
             obs.JOBS_TOTAL.labels(
                 outcome="done" if job.status == DONE else "failed"
             ).inc()
+    if not acked:
+        # lease lost: the reclaiming peer owns the job NOW — stop our
+        # captures but keep the rows (the peer's resume reads them)
+        ckpt_mod.checkpointer().finished(job.id, delete=False)
     # an un-acked completion publishes nothing (the reclaimer owns the
     # record — counted + logged by the replica's ack_lost event), but
     # local waiters still get released
@@ -1363,6 +1466,9 @@ def _dist_dead(entry: dict) -> None:
         )
     except Exception:
         pass  # save_job is already best-effort; never kill the loop
+    # nack-dead hygiene: a twice-crashed job will never resume — its
+    # checkpoint rows (possibly written by ANOTHER replica) are garbage
+    ckpt_mod.checkpointer().delete_for(job_id)
     obs.JOBS_FAILED.labels(reason="crash").inc()
     obs.JOBS_TOTAL.labels(outcome="failed").inc()
 
@@ -1389,6 +1495,9 @@ def build_replica(rid: str, scheduler=None, **kw):
             # forever (and the prepared instance would leak). The sink
             # stays open: attached streams ride keep-alives to their
             # timeout and reconnect onto the record-follow path.
+            # Checkpoint captures stop too — the next claimant owns the
+            # job (its rows, if any, stay for that claimant's resume).
+            ckpt_mod.checkpointer().finished(job.id, delete=False)
             _drop_live(job.id)
             raise
 
@@ -1764,6 +1873,21 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
     predecessor was cancelled and reached its terminal record — seed
     retrieval needs the final incumbent to exist."""
     self = handler
+    if is_draining():
+        # a draining replica takes on nothing new: readiness already
+        # steers load balancers away, this is the belt for requests
+        # that still arrive (clients retry against a healthy peer)
+        self._obs_errors = ["Service unavailable"]
+        _respond(self, 503, {
+            "success": False,
+            "errors": [{
+                "what": "Service unavailable",
+                "reason": "replica is draining; submit to another "
+                "replica (in-flight jobs are finishing or moving to "
+                "peers)",
+            }],
+        })
+        return
     problem, algorithm = ctx["problem"], ctx["algorithm"]
     params, opts, algo_params = ctx["params"], ctx["opts"], ctx["algo_params"]
     database = ctx["database"]
@@ -1841,6 +1965,7 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
     # worker may pop the job the instant it lands, and the runner
     # reads job.sink then
     _attach_sink(job, prep)
+    ckpt_mod.checkpointer().register(job, prep)
     _register_live(job)
     try:
         _persist(job)  # queued record first: a poll can never 404
@@ -1855,6 +1980,9 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
             self._trace.deferred = False  # never scheduled: ours again
         if job.sink is not None:
             job.sink.close("failed")
+        # never scheduled: the checkpointer entry must go too, or every
+        # overload-rejected submit would leak one registry slot forever
+        ckpt_mod.checkpointer().finished(job.id, delete=False)
         _drop_live(job.id)
         _tenant_release(job)  # never scheduled: free the quota slot
         obs.SCHED_REJECTS.labels(reason="queue_full").inc()
@@ -1876,6 +2004,7 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
             self._trace.deferred = False
         if job.sink is not None:
             job.sink.close("failed")
+        ckpt_mod.checkpointer().finished(job.id, delete=False)
         _drop_live(job.id)
         _tenant_release(job)
         raise
@@ -2268,6 +2397,132 @@ class JobResolveHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
 
 
 # ---------------------------------------------------------------------------
+# Graceful drain (POST /api/admin/drain + SIGTERM)
+# ---------------------------------------------------------------------------
+# A draining replica stops taking on new work — async submits shed with
+# 503 and the readiness probe reports `draining` so load balancers
+# rotate it out — while in-flight jobs get VRPMS_DRAIN_GRACE_S to
+# finish. Whatever cannot finish in the grace window is checkpointed
+# (the freshest captured incumbent / completed shards flush
+# synchronously) and NACKED back to the shared queue with a
+# {"ckpt": true} payload marker, so a peer claims it, loads the
+# checkpoint, and resumes exactly-once — the voluntary twin of the
+# lease-reclaim crash path, without burning an attempt or waiting out
+# a lease expiry. Local-queue deployments (no peers) simply let
+# in-flight work finish. SIGTERM runs the same sequence through
+# shutdown_scheduler (service.app).
+
+_drain_lock = threading.Lock()
+_drain_state: dict = {  # guarded-by: _drain_lock
+    "draining": False,
+    "startedAt": None,
+    "requeued": 0,
+    "complete": False,
+}
+
+
+def is_draining() -> bool:
+    with _drain_lock:
+        return bool(_drain_state["draining"])
+
+
+def drain_info() -> dict | None:
+    """The drain state doc for readiness / fleet surfaces; None when
+    not draining."""
+    with _drain_lock:
+        if not _drain_state["draining"]:
+            return None
+        return dict(_drain_state)
+
+
+def _reset_drain() -> None:
+    with _drain_lock:
+        _drain_state.update(
+            draining=False, startedAt=None, requeued=0, complete=False
+        )
+
+
+def _drain_requeue(job: Job, entry: dict):
+    """Replica.drain's per-job hook: flush the job's freshest captured
+    checkpoint state NOW (the nack is about to hand the job to a peer)
+    and stop local captures without deleting the rows — the peer's
+    resume reads them. The returned note marks the queue entry so the
+    claimant probes the checkpoint store even at attempt=0."""
+    try:
+        ckpt_mod.checkpointer().flush_job(job.id)
+    except Exception:
+        pass
+    ckpt_mod.checkpointer().finished(job.id, delete=False)
+    return {"ckpt": True} if ckpt_mod.enabled() else None
+
+
+def _drain_worker(grace_s: float) -> None:
+    rep = _replica
+    requeued = 0
+    if rep is not None:
+        requeued = rep.drain(grace_s, requeue=_drain_requeue)
+    else:
+        # local queue: no peers to hand work to — in-flight jobs just
+        # finish (cooperative; the grace bounds how long we watch)
+        deadline = time.monotonic() + max(0.0, grace_s)
+        while _running_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+    with _drain_lock:
+        _drain_state.update(requeued=requeued, complete=True)
+    log_event("drain.complete", requeued=requeued)
+
+
+def start_drain(grace_s: float | None = None) -> dict:
+    """Flip this replica into drain mode (idempotent) and run the
+    drain on a background thread; returns the current drain state."""
+    grace = (
+        float(grace_s)
+        if grace_s is not None
+        else config.get("VRPMS_DRAIN_GRACE_S")
+    )
+    with _drain_lock:
+        if _drain_state["draining"]:
+            return dict(_drain_state)
+        _drain_state.update(
+            draining=True, startedAt=time.time(), requeued=0,
+            complete=False,
+        )
+        state = dict(_drain_state)
+    log_event("drain.started", graceS=grace)
+    threading.Thread(
+        target=_drain_worker, args=(grace,), name="vrpms-drain",
+        daemon=True,
+    ).start()
+    return state
+
+
+class DrainHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """POST /api/admin/drain — begin a graceful drain: stop claiming,
+    let in-flight jobs finish within the grace window, checkpoint-and-
+    requeue the rest to peers, deregister the heartbeat. 202 with the
+    drain state; idempotent (a second POST reports progress). GET
+    answers the current state without starting anything."""
+
+    def do_POST(self):
+        obs.begin_request_obs(self)
+        try:
+            state = start_drain()
+            _respond(self, 202, {"success": True, "drain": state})
+        finally:
+            obs.end_request_obs(self)
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            _respond(self, 200, {
+                "success": True,
+                "drain": drain_info() or {"draining": False},
+            })
+        finally:
+            obs.end_request_obs(self)
+
+
+# ---------------------------------------------------------------------------
 # Readiness probe
 # ---------------------------------------------------------------------------
 
@@ -2301,12 +2556,17 @@ def readiness() -> tuple[int, dict]:
         and s.last_restart_mono is not None
         and time.monotonic() - s.last_restart_mono < window_s
     )
+    drain = drain_info()
     status = "ok"
     if (
         any(state != "closed" for state in circuits.values())
         or any(journal.values())
         or any(state == "wedged" for state in workers.values())
         or recent_restart
+        # a draining replica still answers, but load balancers should
+        # rotate it out — in-flight work is finishing or moving to
+        # peers and nothing new will be claimed
+        or drain is not None
     ):
         status = "degraded"
     watchdog_on = config.get("VRPMS_SCHED_WATCHDOG_MS") > 0
@@ -2323,6 +2583,9 @@ def readiness() -> tuple[int, dict]:
         "workers": workers,
         "workerRestarts": restarts,
     }
+    if drain is not None:
+        body["draining"] = True
+        body["drain"] = drain
     if dist_queue_enabled():
         # operators see the ring from any replica: who am I, who else
         # is alive, which share of the tier space (and therefore which

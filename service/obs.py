@@ -189,7 +189,9 @@ DIST_LEASES = REGISTRY.counter(
     "lease re-queued | expired_dead = reclaimed past the attempt "
     "ceiling, failed clean | lost = this replica's lease was taken — "
     "its result is discarded | nack = entry returned, local admission "
-    "full | ack_lost = terminal ack refused, record not published)",
+    "full | ack_lost = terminal ack refused, record not published | "
+    "drain_requeued = checkpoint-and-nacked to a peer by a graceful "
+    "drain)",
     labels=("event",),
 )
 DIST_QUEUE_DEPTH = REGISTRY.gauge(
@@ -201,6 +203,14 @@ WORKER_RESTARTS = REGISTRY.counter(
     "vrpms_sched_worker_restarts_total",
     "Watchdog worker restarts, by backend and reason (died|wedged)",
     labels=("backend", "reason"),
+)
+CKPT_TOTAL = REGISTRY.counter(
+    "vrpms_ckpt_total",
+    "Durable solve-checkpoint events (written = one checkpoint row "
+    "persisted, resumed = a reclaimed/requeued/drained attempt seeded "
+    "from a checkpoint, dropped = a capture or write failed — "
+    "fail-open, the solve is unaffected)",
+    labels=("outcome",),
 )
 SCHED_REQUEUES = REGISTRY.counter(
     "vrpms_sched_requeues_total",

@@ -881,6 +881,11 @@ class Prepared:
     # monolithic Instance (prep.inst stays None — the whole point is
     # never materializing the giant padded tensors)
     decomp: object = None
+    # crash-resume context (service.checkpoint): the predecessor
+    # attempt's durable checkpoint state — today only its completed
+    # SHARD map is consumed here (a resumed decomposition solves only
+    # the remaining shards); monolithic resumes ride warm/resolve above
+    ckpt: dict | None = None
 
 
 def prepare_vrp(algorithm, params, opts, ga_params, locations, matrix,
@@ -1086,6 +1091,31 @@ def _solve_decomposed(prep: Prepared, errors) -> dict | None:
     max_batch = max(1, int(config.get("VRPMS_SCHED_MAX_BATCH")))
     sink = progress.active_sink()
     rollup = decompose.ShardRollup(sink, plan.n_shards)
+    # crash-resume: restore checkpoint-completed shards (validated
+    # against THIS plan) and checkpoint each newly completed shard's
+    # routes, so a killed decomposition resumes with only the remaining
+    # shards to solve. The capture handle rides the job's sink
+    # (service.checkpoint.register); solves with none attached — sync
+    # paths, VRPMS_CKPT=off — pay a getattr and nothing else.
+    ckpt_handle = getattr(sink, "ckpt", None)
+    completed = {}
+    if prep.ckpt is not None:
+        completed = decompose.completed_from_state(
+            plan, prep.ckpt.get("shards")
+        )
+        if ckpt_handle is not None:
+            # the resumed attempt's OWN checkpoint must carry the
+            # restored shards too: its upsert supersedes the
+            # predecessor's row, and a second failover would otherwise
+            # read back only the shards THIS attempt solved
+            for si, cs in completed.items():
+                ckpt_handle.note_shard(si, cs.routes, cs.cost)
+
+    def _note_shard(si: int, res) -> None:
+        if ckpt_handle is None:
+            return
+        local = decompose._local_routes(res, int(plan.members[si].size) + 1)
+        ckpt_handle.note_shard(si, local, float(res.cost))
     with _device_ctx(opts.get("backend")):
         with spans.span(
             "decompose", shards=plan.n_shards, tier=plan.tier_n
@@ -1129,6 +1159,8 @@ def _solve_decomposed(prep: Prepared, errors) -> dict | None:
                 deadline_s=None if deadline is None else 0.8 * deadline,
                 max_batch=max_batch,
                 rollup=rollup,
+                completed=completed,
+                on_shard=_note_shard,
                 # launch timing lands on the SAME decompose span (spans
                 # may be annotated after end), so shards and the
                 # vmapped launches that ran them read as one story
@@ -1215,6 +1247,10 @@ def _solve_decomposed(prep: Prepared, errors) -> dict | None:
             "lowerBound": plan.lower_bound,
         },
     }
+    if completed:
+        # disclose the resume: how many shards this attempt restored
+        # from the predecessor's checkpoint instead of re-solving
+        result["decomposition"]["resumedShards"] = len(completed)
     if opts.get("include_stats"):
         result["stats"] = {
             "algorithm": prep.algorithm,

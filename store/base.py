@@ -207,9 +207,15 @@ class JobQueueStore:
         the job's terminal record."""
         raise NotImplementedError
 
-    def nack(self, owner: str, job_id: str) -> bool:
+    def nack(self, owner: str, job_id: str, note: dict | None = None) -> bool:
         """Voluntarily return a leased entry to the queue (local
-        admission full, shutdown) WITHOUT burning an attempt."""
+        admission full, shutdown, graceful drain) WITHOUT burning an
+        attempt. `note`, when given, merges into the entry's payload so
+        the next claimant sees why it came back — the drain path writes
+        {"ckpt": true} so a peer knows a durable checkpoint exists and
+        resumes from it instead of solving from zero. Backends
+        predating the parameter are called without it (the Replica
+        falls back on TypeError)."""
         raise NotImplementedError
 
     def reclaim_expired(self, max_attempts: int | None = None):
@@ -251,6 +257,13 @@ class JobQueueStore:
     def replicas(self) -> list[str]:
         """Replica ids with a live (unexpired) heartbeat, sorted."""
         raise NotImplementedError
+
+    def deregister_replica(self, replica_id: str) -> None:
+        """Remove this replica's heartbeat row NOW (graceful drain):
+        peers' next ring refresh drops it without waiting out the TTL,
+        so its arcs move immediately. Best-effort default no-op —
+        membership expiry is the fallback either way."""
+        return None
 
     def replica_infos(self) -> dict | None:
         """{replica_id: heartbeat status doc} for live replicas — the
@@ -500,6 +513,66 @@ class Database:
             )
             out.append(cur)
         return out
+
+    # -- durable solve checkpoints (crash-resume extension) -----------------
+    # One row per (job id, attempt): a running solve's latest durable
+    # incumbent — routes in original location ids, penalized cost,
+    # evals, elapsed, and (decomposed giants) each completed shard's
+    # routes — written by the background checkpointer
+    # (service.checkpoint) at a bounded cadence. Reclaimed/requeued
+    # attempts read the LATEST row and warm-resume through the existing
+    # Prepared.resolve continuation path. Strictly best-effort with the
+    # solution cache's fail-open policy (see store.resilient._cache_call
+    # and the single-attempt primitives below): a checkpoint store
+    # outage drops the write — accounted in
+    # vrpms_ckpt_total{outcome="dropped"} — and must never fail, slow,
+    # or change the solve it shadows. Terminal ack/dead paths delete a
+    # job's rows (stale-checkpoint hygiene); the hosted backend pairs
+    # the table with a retention sweep (store/schema.sql).
+    def _fetch_checkpoint(self, job_id: str):
+        raise NotImplementedError
+
+    def _upsert_checkpoint(self, job_id: str, attempt: int, state: dict):
+        raise NotImplementedError
+
+    def _delete_checkpoint(self, job_id: str):
+        raise NotImplementedError
+
+    def put_checkpoint(self, job_id: str, attempt: int, state: dict) -> bool:
+        """Persist a job's latest checkpoint state for `attempt`; False
+        on failure (the checkpointer counts the write as dropped)."""
+        try:
+            self._upsert_checkpoint(str(job_id), int(attempt), state)
+        except Exception as exc:
+            self._cache_warn("ckpt_write", exc)
+            return False
+        self._cache_recovered("ckpt_write")
+        return True
+
+    def get_checkpoint(self, job_id: str) -> dict | None:
+        """The LATEST-attempt checkpoint row for `job_id` as
+        {"attempt": int, "state": dict}; None on miss or failure — a
+        checkpoint that cannot be read degrades to a from-zero resume,
+        never to a failed job."""
+        try:
+            row = self._fetch_checkpoint(str(job_id))
+        except Exception as exc:
+            self._cache_warn("ckpt_read", exc)
+            return None
+        self._cache_recovered("ckpt_read")
+        return row
+
+    def delete_checkpoint(self, job_id: str) -> bool:
+        """Drop every checkpoint row for `job_id` (terminal hygiene:
+        ack'd and dead jobs must not leave stale resume state behind);
+        False on failure (the retention sweep is the backstop)."""
+        try:
+            self._delete_checkpoint(str(job_id))
+        except Exception as exc:
+            self._cache_warn("ckpt_delete", exc)
+            return False
+        self._cache_recovered("ckpt_delete")
+        return True
 
     # -- async job records (scheduler extension) ----------------------------
     # The jobs API (service.jobs) persists each job's lifecycle record

@@ -74,6 +74,10 @@ class _FaultyMixin(_InMemoryMixin):
         self._injector.apply("read")
         return super()._fetch_trace_rows(trace_id)
 
+    def _fetch_checkpoint(self, job_id):
+        self._injector.apply("read")
+        return super()._fetch_checkpoint(job_id)
+
     def _list_trace_rows(self, limit):
         self._injector.apply("read")
         return super()._list_trace_rows(limit)
@@ -101,6 +105,17 @@ class _FaultyMixin(_InMemoryMixin):
         # the exporter's failed counter ticks once per batch's spans
         self._injector.apply("write")
         return super()._put_trace_rows(rows)
+
+    def _upsert_checkpoint(self, job_id, attempt, state):
+        # a failed checkpoint write must only ever increment
+        # vrpms_ckpt_total{dropped} — never fail (or slow) the solve it
+        # shadows; tests/test_chaos.py pins that under live plans
+        self._injector.apply("write")
+        return super()._upsert_checkpoint(job_id, attempt, state)
+
+    def _delete_checkpoint(self, job_id):
+        self._injector.apply("write")
+        return super()._delete_checkpoint(job_id)
 
 
 class FaultyDatabaseVRP(_FaultyMixin, DatabaseVRP):
@@ -145,9 +160,9 @@ class FaultyJobQueue(InMemoryJobQueue):
         self._injector.apply("write")
         return super().ack(owner, job_id)
 
-    def nack(self, owner, job_id):
+    def nack(self, owner, job_id, note=None):
         self._injector.apply("write")
-        return super().nack(owner, job_id)
+        return super().nack(owner, job_id, note)
 
     def reclaim_expired(self, max_attempts=None):
         self._injector.apply("read")
@@ -181,3 +196,10 @@ class FaultyJobQueue(InMemoryJobQueue):
         # reads must degrade it to membership-ids-only, never a 500
         self._injector.apply("read")
         return super().replica_infos()
+
+    def deregister_replica(self, replica_id):
+        # drain's heartbeat removal is best-effort: a plan that downs
+        # writes must leave TTL expiry as the fallback, never crash
+        # the drain
+        self._injector.apply("write")
+        return super().deregister_replica(replica_id)

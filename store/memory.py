@@ -44,6 +44,7 @@ _tables: dict = {  # guarded-by: _lock
     "job_queue": {},
     "replicas": {},
     "trace_spans": {},
+    "checkpoints": {},
 }
 _tokens: dict = {}  # guarded-by: _lock
 _fixtures_loaded = False  # guarded-by: _fixtures_lock
@@ -60,6 +61,7 @@ def reset():
         _tables["job_queue"].clear()
         _tables["replicas"].clear()
         _tables["trace_spans"].clear()
+        _tables["checkpoints"].clear()
         _tokens.clear()
     global _fixtures_loaded
     with _fixtures_lock:
@@ -227,6 +229,43 @@ class _InMemoryMixin(Database):
             )}
             for row in reversed(rows[-max(1, int(limit)):])
         ]
+
+    # -- durable solve checkpoints: bounded per-(job, attempt) rows ---------
+    # Insertion order is write recency; eviction drops the oldest-
+    # written row first (checkpoints are crash-recovery state for LIVE
+    # jobs, not an archive — the Supabase backend pairs its table with
+    # a retention sweep instead, see store/schema.sql).
+    MAX_CHECKPOINTS = 2048
+
+    def _fetch_checkpoint(self, job_id):
+        with _lock:
+            rows = [
+                row
+                for (jid, _att), row in _tables["checkpoints"].items()
+                if jid == str(job_id)
+            ]
+        if not rows:
+            return None
+        return dict(max(rows, key=lambda r: int(r.get("attempt") or 0)))
+
+    def _upsert_checkpoint(self, job_id, attempt, state: dict):
+        with _lock:
+            table = _tables["checkpoints"]
+            key = (str(job_id), int(attempt))
+            table.pop(key, None)  # refresh insertion order
+            table[key] = {
+                "job_id": str(job_id),
+                "attempt": int(attempt),
+                "state": state,
+            }
+            while len(table) > self.MAX_CHECKPOINTS:
+                table.pop(next(iter(table)))
+
+    def _delete_checkpoint(self, job_id):
+        with _lock:
+            table = _tables["checkpoints"]
+            for key in [k for k in table if k[0] == str(job_id)]:
+                del table[key]
 
     def _upsert_warmstart(self, owner, name, state: dict):
         with _lock:
@@ -435,7 +474,7 @@ class InMemoryJobQueue(JobQueueStore):
             del self._rows_locked()[str(job_id)]
             return True
 
-    def nack(self, owner: str, job_id: str) -> bool:
+    def nack(self, owner: str, job_id: str, note: dict | None = None) -> bool:
         with _lock:
             row = self._owned_locked(owner, job_id)
             if row is None:
@@ -443,6 +482,12 @@ class InMemoryJobQueue(JobQueueStore):
             row["state"] = Q_QUEUED
             row["lease_owner"] = None
             row["lease_expires_at"] = None
+            if note:
+                # drain marker: the next claimant's payload carries it
+                # (e.g. {"ckpt": true} — a durable checkpoint exists)
+                payload = dict(row.get("payload") or {})
+                payload.update(note)
+                row["payload"] = payload
             return True
 
     def reclaim_expired(self, max_attempts: int | None = None):
@@ -485,6 +530,10 @@ class InMemoryJobQueue(JobQueueStore):
                 # (mixed fleets: peers predating the info field)
                 info = prev[1]
             _tables["replicas"][replica_id] = (time.time() + ttl_s, info)
+
+    def deregister_replica(self, replica_id: str) -> None:
+        with _lock:
+            _tables["replicas"].pop(replica_id, None)
 
     @staticmethod
     def _reg_expiry(value) -> float:

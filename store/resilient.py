@@ -712,6 +712,27 @@ class _ResilientMixin(Database):
     # deadline and the shared circuit breaker still apply, so a down
     # store costs one deadline before the open circuit sheds trace
     # traffic instantly.
+    # -- checkpoint primitives: the cache's inverted policy too -------------
+    # A checkpoint is recoverable state whose safe answer is always
+    # "none" (resume degrades to solving from zero): single attempt, NO
+    # retries (writes run on the background checkpointer but READS sit
+    # on the claim path of every reclaimed job), NO degraded-cache
+    # fallback (a stale checkpoint served as fresh could resume a job
+    # backwards), NO journal spooling (checkpoint rows must never
+    # compete with job records for bounded journal slots during an
+    # outage — they are refreshed at the next cadence tick anyway).
+    # The per-call deadline and shared breaker still apply.
+    def _fetch_checkpoint(self, job_id):
+        return self._cache_call("_fetch_checkpoint", (job_id,))
+
+    def _upsert_checkpoint(self, job_id, attempt, state):
+        return self._cache_call(
+            "_upsert_checkpoint", (job_id, attempt, state)
+        )
+
+    def _delete_checkpoint(self, job_id):
+        return self._cache_call("_delete_checkpoint", (job_id,))
+
     def _put_trace_rows(self, rows):
         return self._cache_call("_put_trace_rows", (rows,))
 

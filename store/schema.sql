@@ -182,6 +182,35 @@ create table if not exists trace_spans (
 create index if not exists trace_spans_updated_at
   on trace_spans (updated_at desc);
 
+-- Durable solve checkpoints (crash-resumable solves; store/base.py
+-- checkpoint seam, service/checkpoint.py): one row per (job id,
+-- attempt) holding the running solve's latest durable incumbent —
+-- routes in original location ids, penalized cost, evals, elapsed,
+-- and (decomposed giant solves) each completed shard's routes — so a
+-- lease reclaim or watchdog requeue warm-resumes from it instead of
+-- re-solving from zero. The background checkpointer refreshes the row
+-- at the VRPMS_CKPT_MS cadence; reads take the LATEST attempt.
+-- Strictly best-effort: writes are single-attempt behind the shared
+-- circuit breaker (store/resilient.py) and a failed write only
+-- increments vrpms_ckpt_total{dropped} — it never fails a solve.
+-- Terminal ack/dead paths delete a job's rows (stale-checkpoint
+-- hygiene), but crashed-and-abandoned jobs can orphan rows: pair with
+-- a retention sweep like the trace_spans one, e.g. pg_cron:
+--   delete from solve_checkpoints
+--    where updated_at < now() - interval '1 day';
+-- (the in-memory backend bounds itself at store.memory
+-- MAX_CHECKPOINTS).
+create table if not exists solve_checkpoints (
+  job_id text not null,
+  attempt integer not null default 1,
+  state jsonb not null,             -- {problem, algorithm, routes,
+                                    --  cost, evals, elapsedMs, shards?}
+  updated_at timestamptz not null default now(),
+  primary key (job_id, attempt)     -- upsert: on_conflict="job_id,attempt"
+);
+create index if not exists solve_checkpoints_updated_at
+  on solve_checkpoints (updated_at);
+
 -- Belt-and-braces stale-lease sweep: reclaim normally happens in every
 -- replica's scan loop, but if ALL replicas die mid-lease the entries
 -- sit leased until one comes back. A pg_cron job returns them to the

@@ -185,6 +185,49 @@ class _SupabaseMixin(Database):
         )
         return list(result.data)
 
+    def _fetch_checkpoint(self, job_id):
+        # latest attempt wins: the resume path wants the newest durable
+        # incumbent (an attempt-2 run that checkpointed supersedes the
+        # attempt-1 rows it resumed from)
+        result = (
+            self.client.table("solve_checkpoints")
+            .select("job_id,attempt,state")
+            .eq("job_id", job_id)
+            .order("attempt", desc=True)
+            .limit(1)
+            .execute()
+        )
+        return result.data[0] if result.data else None
+
+    def _upsert_checkpoint(self, job_id, attempt, state: dict):
+        # updated_at rides the payload (the solution-cache rule): the
+        # column default fires on INSERT only and the retention sweep
+        # reads it — a long solve's refreshed checkpoint must not age
+        # out mid-run
+        from datetime import datetime, timezone
+
+        return (
+            self.client.table("solve_checkpoints")
+            .upsert(
+                {
+                    "job_id": job_id,
+                    "attempt": int(attempt),
+                    "state": state,
+                    "updated_at": datetime.now(timezone.utc).isoformat(),
+                },
+                on_conflict="job_id,attempt",
+            )
+            .execute()
+        )
+
+    def _delete_checkpoint(self, job_id):
+        return (
+            self.client.table("solve_checkpoints")
+            .delete()
+            .eq("job_id", job_id)
+            .execute()
+        )
+
     def _upsert_cached_solution(self, key, family, entry: dict):
         # updated_at must ride the payload: the column default fires on
         # INSERT only, and recency ordering + the documented retention
@@ -541,15 +584,32 @@ class SupabaseJobQueue(JobQueueStore):
             },
         )
 
-    def nack(self, owner: str, job_id: str) -> bool:
-        return self._owned_update(
-            owner, job_id,
-            {
-                "queue_state": Q_QUEUED,
-                "lease_owner": None,
-                "lease_expires_at": None,
-            },
-        )
+    def nack(self, owner: str, job_id: str, note: dict | None = None) -> bool:
+        patch = {
+            "queue_state": Q_QUEUED,
+            "lease_owner": None,
+            "lease_expires_at": None,
+        }
+        if note:
+            # merge the drain marker into the entry payload. The
+            # read-modify-write is safe: we still HOLD the lease, so no
+            # peer can touch the row between the select and the
+            # owner-conditional update (which arbitrates if the lease
+            # expired underneath us anyway).
+            sel = (
+                self.client.table("jobs")
+                .select("queue_entry")
+                .eq("id", job_id)
+                .limit(1)
+                .execute()
+            )
+            if sel.data:
+                doc = dict(sel.data[0].get("queue_entry") or {})
+                payload = dict(doc.get("payload") or {})
+                payload.update(note)
+                doc["payload"] = payload
+                patch["queue_entry"] = doc
+        return self._owned_update(owner, job_id, patch)
 
     def reclaim_expired(self, max_attempts: int | None = None):
         import time as _time
@@ -709,6 +769,12 @@ class SupabaseJobQueue(JobQueueStore):
             .execute()
         )
         return sorted(row["id"] for row in result.data)
+
+    def deregister_replica(self, replica_id: str) -> None:
+        # graceful drain: drop the heartbeat row now so peers' next
+        # ring refresh moves this replica's arcs without waiting out
+        # the TTL
+        self.client.table("replicas").delete().eq("id", replica_id).execute()
 
     def replica_infos(self) -> dict | None:
         import time as _time

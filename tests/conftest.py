@@ -110,6 +110,17 @@ _SLOW_PATTERNS = (
     "test_trace_export.py::TestFederatedHTTP",
     "test_trace_export.py::TestCrossReplicaFederation",
     "test_trace_export.py::TestExportChaos",
+    # crash-resume end-to-end layers: real kill/drain solves across
+    # in-process replicas + the HTTP drain surface (the store-seam and
+    # hygiene units stay quick; tier1.yml runs the file in full)
+    "test_checkpoint.py::TestResumeReclaim",
+    "test_checkpoint.py::TestResumeDecomposition",
+    "test_checkpoint.py::TestDrain",
+    "test_checkpoint.py::TestDrainHTTP",
+    "test_checkpoint.py::TestLocalWatchdogResume",
+    "test_checkpoint.py::TestCaptureAndHygiene",
+    "test_checkpoint.py::TestOffByteIdentity",
+    "test_chaos.py::TestCheckpointChaos",
     # dynamic re-solve end-to-end solves (unit/envelope layers stay
     # quick; tier1.yml runs the file in full)
     "test_resolve.py::TestDeltaHTTP",

@@ -162,6 +162,24 @@ REGISTRY: dict[str, Var] = {
            "restarts keep their ring arcs); unset generates one."),
         _v("VRPMS_REPLICA_DRAIN_S", "float", 5.0,
            "Graceful-stop window for in-flight leases at shutdown."),
+        _v("VRPMS_DRAIN_GRACE_S", "float", 10.0,
+           "Graceful-drain window (POST /api/admin/drain and SIGTERM): "
+           "in-flight jobs get this long to finish before they are "
+           "checkpointed and nacked back to the shared queue for a "
+           "peer to resume."),
+        # -- crash-resumable solves ------------------------------------
+        _v("VRPMS_CKPT", "switch", True,
+           "Durable solve checkpoints: a background checkpointer "
+           "persists each async job's latest incumbent (and each "
+           "completed decomposition shard) so lease reclaims, watchdog "
+           "requeues, and drained replicas resume instead of "
+           "re-solving from zero. Off = byte-identical pre-checkpoint "
+           "behavior; requires VRPMS_PROGRESS (capture rides the "
+           "progress sink)."),
+        _v("VRPMS_CKPT_MS", "float", 2000.0,
+           "Minimum interval between checkpoint captures of one job's "
+           "incumbent (bounded cadence: solves shorter than this never "
+           "pay a checkpoint write)."),
         _v("VRPMS_RING_VNODES", "int", 64,
            "Virtual nodes per replica on the consistent-hash ring."),
         _v("VRPMS_LEASE_S", "float", 15.0,

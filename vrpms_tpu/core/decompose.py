@@ -539,6 +539,59 @@ def shard_instances(plan: DecompPlan) -> list:
 # ---------------------------------------------------------------------------
 
 
+class CompletedShard:
+    """A shard restored from a durable checkpoint instead of solved:
+    `routes` are shard-LOCAL (node positions 1..m in the shard's
+    sub-instance), `cost` the checkpointed penalized objective. `evals`
+    is 0 by construction — a resumed attempt did not re-evaluate this
+    shard, which is exactly what the recovery benchmark measures."""
+
+    __slots__ = ("routes", "cost", "evals")
+
+    def __init__(self, routes: list, cost: float):
+        self.routes = [list(map(int, r)) for r in routes]
+        self.cost = float(cost)
+        self.evals = 0
+
+
+def completed_from_state(plan: DecompPlan, shards_state) -> dict:
+    """Validate a checkpoint's per-shard routes against THIS plan and
+    return {shard index: CompletedShard} for the shards that can be
+    skipped. Plans are deterministic for an unchanged request (seeded
+    medoid/k-means over the same active set), so stored local routes
+    normally match; any shard that does not validate — index out of
+    range, wrong customer set — simply re-solves. Never raises."""
+    out: dict = {}
+    if not isinstance(shards_state, dict):
+        return out
+    for key, doc in shards_state.items():
+        try:
+            si = int(key)
+            if not 0 <= si < plan.n_shards:
+                continue
+            routes = (doc or {}).get("routes")
+            cost = float((doc or {}).get("cost"))
+            m = int(plan.members[si].size)
+            visited = sorted(c for r in routes for c in r)
+            if visited != list(range(1, m + 1)):
+                continue
+            out[si] = CompletedShard(routes, cost)
+        except (TypeError, ValueError, KeyError):
+            continue
+    return out
+
+
+def _local_routes(res, n_real: int) -> list:
+    """Per-vehicle shard-LOCAL routes out of either a SolveResult (its
+    giant decodes) or a CompletedShard (already routes)."""
+    routes = getattr(res, "routes", None)
+    if routes is not None:
+        return routes
+    from vrpms_tpu.core.encoding import routes_from_giant
+
+    return routes_from_giant(res.giant, n_real)
+
+
 class ShardRollup:
     """ProgressFanout-style aggregator for the decomposed solve: the
     batched launch syncs a [K, B] per-shard best array; the rollup
@@ -554,6 +607,13 @@ class ShardRollup:
         self._sink = sink
         self._best = [None] * n_shards
         self._chunk: list = []
+
+    def seed(self, shard: int, cost: float) -> None:
+        """Pre-fill a resumed (checkpoint-restored) shard's best so the
+        rolled-up incumbent stream prices the WHOLE instance once the
+        remaining shards report — a resumed decomposition's stream is
+        indistinguishable from a fresh one's."""
+        self._best[int(shard)] = float(cost)
 
     def begin(self, shard_indices) -> None:
         self._chunk = list(shard_indices)
@@ -612,6 +672,8 @@ def solve_shards(
     max_batch: int = 16,
     rollup: ShardRollup | None = None,
     on_launch=None,
+    completed: dict | None = None,
+    on_shard=None,
 ):
     """Solve every shard on the batched SA kernel in chunks of
     `max_batch` — the decomposition rides the micro-batcher's vmapped
@@ -622,42 +684,65 @@ def solve_shards(
     constructive incumbents at one block's cost. `on_launch(chunk_index,
     shard_lo, size, wall_s)` fires after each vmapped launch — the
     service hangs per-launch trace events off it so the n=5000
-    waterfall shows where the launches spent their time."""
+    waterfall shows where the launches spent their time.
+
+    `completed` ({shard index: CompletedShard}, from
+    completed_from_state) restores checkpoint-solved shards WITHOUT
+    re-solving them: only the remaining shards dispatch (fewer chunks,
+    the deadline splits across what is actually left), their bests seed
+    the rollup, and the results list carries the restored entries in
+    place. `on_shard(shard_index, result)` fires once per NEWLY solved
+    shard as its chunk completes — the durable checkpointer persists
+    each shard's routes there, so a crash mid-decomposition loses at
+    most the in-flight chunk."""
     from vrpms_tpu.obs import progress
     from vrpms_tpu.sched.batch import solve_sa_batch
 
     max_batch = max(1, int(max_batch))
     k = len(insts)
-    n_chunks = math.ceil(k / max_batch)
-    results: list = []
+    results: list = [None] * k
+    for si, cs in (completed or {}).items():
+        results[si] = cs
+        if rollup is not None:
+            rollup.seed(si, cs.cost)
+    remaining = [i for i in range(k) if results[i] is None]
+    n_chunks = math.ceil(len(remaining) / max_batch)
     launches = 0
     t0 = time.monotonic()
     for ci in range(n_chunks):
-        lo = ci * max_batch
-        chunk = insts[lo : lo + max_batch]
+        ids = remaining[ci * max_batch : (ci + 1) * max_batch]
+        chunk = [insts[i] for i in ids]
         chunk_deadline = None
         if deadline_s is not None:
-            remaining = max(0.0, deadline_s - (time.monotonic() - t0))
-            chunk_deadline = remaining / (n_chunks - ci)
+            left = max(0.0, deadline_s - (time.monotonic() - t0))
+            chunk_deadline = left / (n_chunks - ci)
         if rollup is not None:
             if rollup.cancelled:
                 chunk_deadline = 0.0
-            rollup.begin(range(lo, lo + len(chunk)))
+            rollup.begin(ids)
         launch_t0 = time.monotonic()
         with progress.attach(rollup):
-            results.extend(
-                solve_sa_batch(
-                    chunk,
-                    seeds[lo : lo + len(chunk)],
-                    params=params,
-                    weights=weights,
-                    deadline_s=chunk_deadline,
-                )
+            solved = solve_sa_batch(
+                chunk,
+                [seeds[i] for i in ids],
+                params=params,
+                weights=weights,
+                deadline_s=chunk_deadline,
             )
         launches += 1
+        for si, res in zip(ids, solved):
+            results[si] = res
+            if on_shard is not None:
+                try:
+                    on_shard(si, res)
+                except Exception:
+                    pass  # checkpoint bookkeeping must never fail a solve
         if on_launch is not None:
             try:
-                on_launch(ci, lo, len(chunk), time.monotonic() - launch_t0)
+                on_launch(
+                    ci, ids[0] if ids else 0, len(chunk),
+                    time.monotonic() - launch_t0,
+                )
             except Exception:
                 pass  # trace bookkeeping must never fail a solve
     return results, launches
@@ -674,14 +759,12 @@ def stitch(plan: DecompPlan, results: list) -> list:
     routes the solver parked on a shard's phantom vehicles (possible
     only on pathological penalized solutions) are collected and
     re-inserted by the capacity-aware repair."""
-    from vrpms_tpu.core.encoding import routes_from_giant
-
     v_total = len(plan.arrays["capacities"])
     routes: list = [[] for _ in range(v_total)]
     leftovers: list = []
     for members, veh, res in zip(plan.members, plan.vehicles, results):
         n_real = members.size + 1
-        for r, route in enumerate(routes_from_giant(res.giant, n_real)):
+        for r, route in enumerate(_local_routes(res, n_real)):
             mapped = [int(members[c - 1]) for c in route]
             if not mapped:
                 continue

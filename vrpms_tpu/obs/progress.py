@@ -98,6 +98,13 @@ class ProgressSink:
         self.status: str | None = None   # terminal: done|failed|...
         self._cancel = False
         self._ack = False  # a driver stopped FOR the cancel
+        # durable-checkpoint capture handle (service.checkpoint): when
+        # attached, the solver seam offers the champion tour to it at a
+        # bounded cadence (want_incumbent/offer_incumbent below).
+        # Opaque here — this module stays store-free; None (the
+        # default, and VRPMS_CKPT=off) costs one attribute read per
+        # block boundary and nothing else.
+        self.ckpt = None
 
     # -- solver side (device-owning thread) ---------------------------------
     def record(self, best, iters: int, evals_per_iter: float | None) -> None:
@@ -163,6 +170,70 @@ class ProgressSink:
             self.status = status
             self.seq += 1
             self._new.notify_all()
+
+    # -- durable-checkpoint capture (crash-resumable solves) ----------------
+    def seed_incumbent(self, cost: float, evals: int = 0) -> None:
+        """Pre-publish a RESUMED attempt's inherited incumbent (the
+        predecessor's checkpoint) as the block-0 snapshot: the stream
+        opens at the checkpoint cost, and the improves-only filter then
+        guarantees the first live-published incumbent is never worse
+        than the checkpoint — the resume contract. No-op once anything
+        was published."""
+        with self._new:
+            if self._latest is not None:
+                return
+            snap = {
+                "block": 0,
+                "wallMs": 0.0,
+                "bestCost": float(cost),
+                "gap": (
+                    None
+                    if self.lower_bound is None
+                    else round(
+                        (float(cost) - self.lower_bound) / self.lower_bound,
+                        6,
+                    )
+                ),
+                "evals": int(evals),
+                "resumed": True,
+            }
+            self._latest = snap
+            self._profile.append(snap)
+            self.seq += 1
+            self._new.notify_all()
+        obs = _observer
+        if obs is not None:
+            try:
+                obs(self, snap)
+            except Exception:
+                pass
+
+    def want_incumbent(self) -> bool:
+        """Should the solver seam extract + offer the champion tour at
+        this block boundary? True only when a checkpoint handle is
+        attached AND its cadence says a capture is due — the handle
+        owns the interval/improvement bookkeeping, so the hot path
+        pays one attribute read when checkpointing is off."""
+        h = self.ckpt
+        if h is None:
+            return False
+        try:
+            return h.due(self)
+        except Exception:
+            return False  # a broken handle must never stop the solve
+
+    def offer_incumbent(self, giant) -> None:
+        """Hand the champion tour (the synced best state's giant, a
+        device or host array) to the checkpoint handle. Best-effort:
+        decode + store write happen on the checkpointer's background
+        thread, never here."""
+        h = self.ckpt
+        if h is None:
+            return
+        try:
+            h.offer(self, giant)
+        except Exception:
+            pass  # capture must never kill the device loop
 
     # -- cancellation --------------------------------------------------------
     def cancel(self) -> None:

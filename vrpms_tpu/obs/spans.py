@@ -73,6 +73,8 @@ KNOWN_SPAN_NAMES = frozenset({
     "dist.execute",     # distributed-queue claim-side execution
     "dist.claim_batch",  # how this job's store claim was assembled
     "qos.shed",         # a request shed by QoS policy (class + reason)
+    "ckpt.write",       # one durable checkpoint write (background)
+    "ckpt.resume",      # a requeued attempt seeded from a checkpoint
     "store.read",       # table reads on the request path
     "store.persist",    # solution/warm-start persistence
     "store.persist_job",  # terminal job-record persistence

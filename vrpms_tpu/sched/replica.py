@@ -103,6 +103,7 @@ class Replica:
         self.claim_batch = int(claim_batch)
         self._halt = threading.Event()
         self._stopping = False  # drain mode: ack/renew, claim nothing
+        self._draining = False  # graceful drain: also stop heartbeating
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
         # {job_id: (job, entry, lost)} — claimed, not yet acked
@@ -147,6 +148,73 @@ class Replica:
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout=drain_s + 1.0)
+
+    def drain(self, grace_s: float, requeue=None) -> int:
+        """Graceful drain (POST /api/admin/drain, SIGTERM): stop
+        CLAIMING and HEARTBEATING, give in-flight jobs `grace_s` to
+        finish (the monitor keeps acking them), then hand the leftovers
+        back to the shared queue for a peer: `requeue(job, entry)` — the
+        service's checkpoint-flush hook — returns an optional payload
+        note the nack merges in (e.g. {"ckpt": true}), the entry nacks
+        WITHOUT burning an attempt, the local lease is marked lost so a
+        late completion never publishes, and the solve is cooperatively
+        cancelled to free the device. Finally the membership heartbeat
+        deregisters so peers' next ring refresh moves our arcs at once.
+        The loop thread stays alive (lost-lease completions still need
+        their non-publishing cleanup); stop()/kill() end it. Returns
+        the number of jobs requeued."""
+        self._stopping = True
+        self._draining = True
+        deadline = time.monotonic() + max(0.0, grace_s)
+        while self.inflight() and time.monotonic() < deadline:
+            time.sleep(min(0.02, self.poll_s))
+        with self._lock:
+            items = list(self._inflight.items())
+        nacked = 0
+        for job_id, (job, entry, lost) in items:
+            if lost or job.done_event.is_set():
+                continue
+            note = None
+            if requeue is not None:
+                try:
+                    note = requeue(job, entry)
+                except Exception:
+                    note = None  # a broken hook must not stop the drain
+            try:
+                try:
+                    ok = self.store.nack(self.replica_id, job_id, note)
+                except TypeError:
+                    # backend predates the note parameter: the entry
+                    # still requeues, the claimant just probes the
+                    # checkpoint store on attempt alone
+                    ok = self.store.nack(self.replica_id, job_id)
+            except Exception as exc:
+                self._store_error("nack", exc)
+                continue
+            if not ok:
+                continue  # lease already lost: the peer owns it
+            nacked += 1
+            self._emit("drain_requeued", jobId=job_id)
+            with self._lock:
+                if job_id in self._inflight:
+                    # never publish: the entry is queued again — a peer
+                    # will complete it (the lease_lost discipline)
+                    self._inflight[job_id] = (job, entry, True)
+            sink = getattr(job, "sink", None)
+            if sink is not None:
+                try:
+                    sink.cancel()
+                except Exception:
+                    pass
+        try:
+            self.store.deregister_replica(self.replica_id)
+        except Exception as exc:
+            self._store_error("deregister_replica", exc)
+        return nacked
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def kill(self) -> None:
         """Simulated crash (tests/bench): halt instantly WITHOUT acking
@@ -234,7 +302,11 @@ class Replica:
             now = time.monotonic()
             if now >= self._backoff_until:
                 if now >= self._next_heartbeat:
-                    self._heartbeat()
+                    if not self._draining:
+                        # a draining replica must STAY deregistered:
+                        # re-heartbeating would put its arcs back on
+                        # the ring after drain() removed them
+                        self._heartbeat()
                     self._next_heartbeat = now + self.heartbeat_s
                 if now >= self._next_reclaim:
                     self._reclaim()
@@ -246,6 +318,14 @@ class Replica:
             self._halt.wait(self.poll_s)
 
     def _heartbeat(self) -> None:
+        if self._draining:
+            # drain() flipped the flag after the loop's own check: a
+            # beat landing now would re-register the row drain() is
+            # about to (or just did) deregister. Re-checking here
+            # narrows the race to a store write already in flight —
+            # whose resurrected row the membership TTL still expires,
+            # the documented fallback.
+            return
         doc = None
         if self._info is not None:
             try:

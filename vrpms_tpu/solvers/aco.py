@@ -367,6 +367,10 @@ def solve_aco(
     state, done = run_blocked(
         step_block, state, params.n_iters, 16, deadline_s, lambda st: st[2],
         evals_per_iter=params.n_ants,
+        # durable-checkpoint capture: the colony's global-best perm
+        # split to a giant (only when the sink's checkpoint cadence is
+        # due)
+        incumbent=lambda st: greedy_split_giant(st[1], inst),
     )
 
     _, best_perm, _, pool_perms, pool_fits = state

@@ -46,6 +46,7 @@ def run_blocked(
     sync,
     rate_hint: float | None = None,
     evals_per_iter: float | None = None,
+    incumbent=None,
 ):
     """Deadline-aware composition of jitted iteration blocks — the one
     block-driver loop shared by SA, GA, and ACO (identical granularity
@@ -86,6 +87,15 @@ def run_blocked(
     incumbent. Neither path changes the block decomposition or any
     device computation, so fixed-seed trajectories are bit-identical
     with or without a sink attached.
+
+    `incumbent(state)`, when given, extracts the champion TOUR from the
+    loop state (solvers pass it so durable checkpointing can persist a
+    resumable incumbent, not just its cost). It is called only when the
+    sink's checkpoint handle says a capture is due
+    (ProgressSink.want_incumbent — bounded VRPMS_CKPT_MS cadence), so
+    the common case costs one attribute read per boundary; like the
+    sink itself it only READS the already-synced state and never
+    changes the trajectory.
     """
     import time
 
@@ -111,6 +121,7 @@ def run_blocked(
                 trace.record(best, n_total, evals_per_iter)
             if sink is not None:
                 sink.record(best, n_total, evals_per_iter)
+                _maybe_capture(sink, incumbent, state)
         return state, n_total
     block = max(1, min(n_total, block_size))
     done = 0
@@ -153,9 +164,26 @@ def run_blocked(
             trace.record(best, nb, evals_per_iter)
         if sink is not None:
             sink.record(best, nb, evals_per_iter)
+            _maybe_capture(sink, incumbent, state)
         if time.monotonic() - t_start >= deadline_s:
             break
     return state, done
+
+
+def _maybe_capture(sink, incumbent, state) -> None:
+    """Offer the champion tour to the sink's durable-checkpoint handle
+    when a capture is due (see run_blocked's `incumbent` contract).
+    Batched fanouts and shard rollups carry no capture protocol — the
+    getattr guard makes them (and plain sinks with no handle) free."""
+    if incumbent is None:
+        return
+    want = getattr(sink, "want_incumbent", None)
+    if want is None or not want():
+        return
+    try:
+        sink.offer_incumbent(incumbent(state))
+    except Exception:
+        pass  # capture must never kill the device loop
 
 
 def seed_objective(giant, inst: Instance, w: CostWeights | None = None) -> float:

@@ -536,6 +536,9 @@ def solve_ga(
     state, done = run_blocked(
         step_block, state, params.generations, 32, deadline_s,
         lambda st: st[3], evals_per_iter=gen_evals,
+        # durable-checkpoint capture: the best-so-far genome split to a
+        # giant (only when the sink's checkpoint cadence is due)
+        incumbent=lambda st: greedy_split_giant(st[2], inst),
     )
 
     perms, fits, best_perm, _ = state

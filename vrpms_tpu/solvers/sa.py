@@ -513,6 +513,9 @@ def solve_sa(
     state, done = run_blocked(
         step_block, state, n_iters, 512, deadline_s, lambda st: st[3],
         rate_hint=_rate_get(rate_key), evals_per_iter=giants.shape[0],
+        # durable-checkpoint capture: the champion chain's best giant,
+        # extracted only when the sink's checkpoint cadence is due
+        incumbent=lambda st: st[2][jnp.argmin(st[3])],
     )
     if deadline_s is not None and done:
         el = _time.monotonic() - t_run
