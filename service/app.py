@@ -138,6 +138,13 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
 
 def serve(port: int = 8080):
     server = ThreadingHTTPServer(("0.0.0.0", port), Router)
+    # advertise the BOUND address (port=0 resolves here) so peers' SSE
+    # relays can reach this replica's live registry via the heartbeat
+    # registry (service.jobs federated reads)
+    from service import jobs as jobs_mod
+
+    host, bound_port = server.server_address[:2]
+    jobs_mod.set_advertised_addr(str(host), int(bound_port))
     return server
 
 

@@ -205,6 +205,7 @@ class Checkpointer:
         self._deletes: list[str] = []  # guarded-by: _lock
         self._thread = None  # guarded-by: _lock
         self._wake = threading.Event()
+        self._last_write = None  # guarded-by: _lock (wall time)
 
     # -- registry ------------------------------------------------------------
     def register(self, job, prep, attempt: int = 1):
@@ -330,6 +331,10 @@ class Checkpointer:
             "cost": None if snap is None else snap.get("bestCost"),
             "evals": None if snap is None else snap.get("evals"),
             "elapsedMs": None if snap is None else snap.get("wallMs"),
+            # federated-read provenance: the block id keys SSE event
+            # ids on non-owning replicas, writtenAt anchors staleMs
+            "block": None if snap is None else snap.get("block"),
+            "writtenAt": time.time(),
         }
         if shards:
             state["shards"] = {str(k): v for k, v in shards.items()}
@@ -356,10 +361,29 @@ class Checkpointer:
                 sp.end(status=None)
         if ok:
             entry.note_wrote()
+            with self._lock:
+                self._last_write = time.time()
             obs.CKPT_TOTAL.labels(outcome="written").inc()
         else:
             _dropped()
         return ok
+
+    def health(self) -> dict:
+        """Checkpointer liveness for the fleet status doc: live entry
+        count and the age of the last successful flush (None = this
+        process has not written a row yet). A wedged flusher shows up
+        as a growing age with entries > 0 — visible in
+        GET /api/debug/fleet BEFORE a crash makes it expensive."""
+        with self._lock:
+            entries = len(self._entries)
+            last = self._last_write
+        return {
+            "entries": entries,
+            "lastFlushAgeMs": (
+                None if last is None
+                else max(0, round((time.time() - last) * 1e3))
+            ),
+        }
 
 
 _ckpt_lock = threading.Lock()

@@ -401,6 +401,8 @@ _SPAN_EVENT_KINDS = {
     "decompose": "decomposed",
     "stitch": "stitched",
     "qos.shed": "shed",
+    "ckpt.write": "checkpoint written",
+    "ckpt.resume": "resumed from checkpoint",
     "store.persist_job": "record persisted",
 }
 
@@ -483,6 +485,47 @@ def _span_events(merged: dict | None) -> list:
             detail = (
                 f"shed ({attrs.get('reason')}, qos {attrs.get('qos')})"
             )
+        elif name == "ckpt.write":
+            attempt = attrs.get("attempt") or 1
+            detail = f"checkpoint written (attempt {attempt}"
+            if attrs.get("cost") is not None:
+                detail += f", cost {attrs['cost']}"
+                ev["cost"] = attrs["cost"]
+            if attrs.get("shards"):
+                detail += f", {attrs['shards']} shards"
+            detail += f") by replica {rep or '?'}"
+            ev["attempt"] = attempt
+        elif name == "ckpt.resume":
+            source = attrs.get("source") or "?"
+            if source == "drain":
+                # the handoff that PRECEDED this resume: a draining
+                # peer flushed its freshest checkpoint and nacked the
+                # entry back to the shared queue (no attempt burned)
+                events.append({
+                    "atMs": at_ms,
+                    "event": "drain.nack",
+                    "detail": (
+                        "a draining replica checkpointed the solve and "
+                        "nacked it back to the shared queue for a peer"
+                    ),
+                })
+            detail = (
+                f"resumed from checkpoint ({source}"
+                + (
+                    f", cost {attrs.get('cost')}"
+                    if attrs.get("cost") is not None
+                    else ""
+                )
+                + (
+                    f", {attrs.get('shards')} shards done"
+                    if attrs.get("shards")
+                    else ""
+                )
+                + f") on replica {rep or '?'}"
+            )
+            ev["source"] = source
+            if attrs.get("cost") is not None:
+                ev["cost"] = attrs["cost"]
         ev["detail"] = detail
         if span.get("durationMs") is not None:
             ev["durationMs"] = span["durationMs"]
@@ -639,6 +682,18 @@ class JobTimelineHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             snap = live.sink.snapshot()
             if snap is not None:
                 record = dict(record, incumbent=snap)
+        elif (
+            jobs_mod._federation_enabled()
+            and record.get("status") not in ("done", "failed")
+        ):
+            # another replica's live solve: the timeline closes on the
+            # checkpoint-sourced incumbent (marked, like the status
+            # poll); a failed checkpoint read only flags degraded
+            snap, ckpt_degraded = jobs_mod._checkpoint_incumbent(job_id)
+            if snap is not None:
+                record = dict(record, incumbent=snap)
+            if ckpt_degraded:
+                degraded = True
         payload: dict = {
             "success": True,
             "jobId": job_id,

@@ -928,6 +928,10 @@ def shutdown_scheduler() -> int:
     _replica_id_cached = None  # a rebuilt service re-reads the env
     with _depth_lock:
         _memos.clear()  # a rebuilt service re-reads its own queue
+    with _read_lock:
+        _read_cache.clear()  # and serves no stale job reads
+    global _advertised_addr
+    _advertised_addr = None  # a rebuilt server re-registers its bind
     global _qos_policy
     with _qos_policy_lock:
         _qos_policy = None  # fresh per-class drain EWMAs on rebuild
@@ -994,6 +998,22 @@ def replica_id() -> str:
 trace_export.set_replica_provider(replica_id)
 
 
+_advertised_addr: str | None = None
+
+
+def set_advertised_addr(host: str, port: int) -> None:
+    """Register the HTTP address peers can reach THIS replica at
+    (service.app calls it when the port binds). Published in the
+    heartbeat doc so a non-owning replica's SSE relay can locate the
+    owner; a wildcard bind advertises loopback — right for same-host
+    fleets (tests, the two-replica bench), and real deployments bind
+    the pod address their peers route to."""
+    global _advertised_addr
+    if not host or host in ("0.0.0.0", "::"):
+        host = "127.0.0.1"
+    _advertised_addr = f"{host}:{int(port)}"
+
+
 def replica_info() -> dict:
     """This process's fleet-rollup heartbeat doc: what an operator (or
     autoscaler) polling GET /api/debug/fleet on ANY replica learns
@@ -1033,6 +1053,21 @@ def replica_info() -> dict:
                 info["queuedByClass"] = classes
             except Exception:
                 pass
+    if _advertised_addr:
+        # where peers' SSE relays reach this replica's live registry
+        info["addr"] = _advertised_addr
+    try:
+        # checkpointer liveness: a wedged flusher shows up fleet-wide
+        # as a growing lastFlushAgeMs with entries > 0, plus this
+        # replica's own vrpms_ckpt_total split
+        ck = ckpt_mod.checkpointer().health()
+        for outcome in ("written", "resumed", "dropped"):
+            ck[outcome] = round(
+                obs.CKPT_TOTAL.labels(outcome=outcome).value
+            )
+        info["ckpt"] = ck
+    except Exception:
+        pass
     try:
         from service import warmup as warmup_mod
 
@@ -1127,6 +1162,51 @@ def _shared_class_depths(qs) -> dict | None:
     unreadable or predates the QoS columns — the probe omits the
     field."""
     return _memo_read("classes", qs.depth_by_class)
+
+
+# Watcher-scale read cache (the depth memo generalized to the job-read
+# path): N clients polling ONE job's record / checkpoint overlay /
+# owner lookup cost one store read per VRPMS_READ_TTL_MS instead of N.
+# Engaged ONLY on the distributed queue with a positive TTL — the
+# local-queue path never touches it, so local-mode responses stay
+# byte-identical by construction, and TTL=0 reads through.
+_read_lock = threading.Lock()
+_read_cache: dict[str, tuple[float, object]] = {}  # guarded-by: _read_lock
+#: insertion-order bound: watchers concentrate on few hot jobs, so a
+#: small cap holds the working set; overflow evicts the oldest entry
+_READ_CACHE_CAP = 512
+
+
+def _read_cache_enabled() -> bool:
+    return dist_queue_enabled() and config.get("VRPMS_READ_TTL_MS") > 0
+
+
+def _cached_read(key: str, fetch, cacheable=None):
+    """Bounded read-through memo on the job-read path. `fetch()`
+    exceptions propagate uncached (callers keep their own degraded
+    ladders); a value failing `cacheable` (default: any non-None) is
+    returned but never memoized, so errored/degraded reads are retried
+    at the very next poll instead of being served for a TTL."""
+    if not _read_cache_enabled():
+        return fetch()
+    now = time.monotonic()
+    ttl = config.get("VRPMS_READ_TTL_MS") / 1e3
+    with _read_lock:
+        memo = _read_cache.get(key)
+    if memo is not None and now - memo[0] < ttl:
+        obs.READ_CACHE.labels(outcome="hit").inc()
+        return memo[1]
+    obs.READ_CACHE.labels(
+        outcome="miss" if memo is None else "stale"
+    ).inc()
+    value = fetch()
+    if (cacheable or (lambda v: v is not None))(value):
+        with _read_lock:
+            if key not in _read_cache:
+                while len(_read_cache) >= _READ_CACHE_CAP:
+                    _read_cache.pop(next(iter(_read_cache)))
+            _read_cache[key] = (now, value)
+    return value
 
 
 def _dist_event(name: str, replicaId: str | None = None, **kw) -> None:
@@ -2023,18 +2103,141 @@ def _job_id_from_path(path: str) -> str:
     return parts[-1] if parts else ""
 
 
+def _federation_enabled() -> bool:
+    """Federated reads: a non-owning replica overlays checkpoint (or
+    relayed) incumbents on the store record. VRPMS_READ_RELAY=off (or
+    the local queue, where every job IS owned here) restores the
+    pre-federation responses byte-identically."""
+    return dist_queue_enabled() and config.enabled("VRPMS_READ_RELAY")
+
+
+def _checkpoint_incumbent(job_id: str) -> tuple[dict | None, bool]:
+    """The latest durable checkpoint row as a MARKED incumbent snapshot
+    for a job some OTHER replica is solving: (snapshot, degraded).
+    The snapshot always carries `incumbentSource: "checkpoint"` and
+    `staleMs` (age of the row's write; None for rows predating the
+    writtenAt field) — an honest bounded-staleness view, never passed
+    off as live. degraded=True means the store could not answer (the
+    caller marks the response; a miss is NOT degraded — short solves
+    legitimately never checkpoint)."""
+    errors: list = []
+
+    def fetch():
+        db = store.get_database("vrp", None)
+        with spans.span("read.federate", jobId=job_id):
+            return db.get_checkpoint(job_id, errors)
+
+    try:
+        row = _cached_read(
+            f"ckpt:{job_id}", fetch,
+            cacheable=lambda v: v is not None and not errors,
+        )
+    except Exception:
+        return None, True
+    if errors:
+        return None, True
+    if not isinstance(row, dict):
+        return None, False
+    state = row.get("state")
+    if not isinstance(state, dict) or state.get("cost") is None:
+        return None, False
+    written = state.get("writtenAt")
+    snap = {
+        "block": state.get("block"),
+        "wallMs": state.get("elapsedMs"),
+        "bestCost": state.get("cost"),
+        "evals": state.get("evals"),
+        "incumbentSource": "checkpoint",
+        "staleMs": (
+            None if written is None
+            else max(0, round((time.time() - float(written)) * 1e3))
+        ),
+    }
+    return snap, False
+
+
+def _relay_snap(job_id: str) -> dict | None:
+    """Live incumbent relayed from the OWNING replica (located via the
+    queue entry's lease + the heartbeat registry's advertised address),
+    marked `incumbentSource: "relay"`. Strictly best-effort: any gap —
+    no replica loop here, unleased entry, owner gone, no advertised
+    addr, fetch error, or the owner itself answering with second-hand
+    (marked) state — returns None and the caller falls back to the
+    checkpoint row. Never raises."""
+    rep = _replica
+    if rep is None:
+        return None
+    try:
+        owner = _cached_read(
+            f"owner:{job_id}", lambda: rep.owner_of(job_id)
+        )
+        if not owner or owner == replica_id():
+            return None
+        infos = _cached_read(
+            "replica_infos", lambda: rep.store.replica_infos()
+        )
+    except Exception:
+        return None
+    addr = ((infos or {}).get(owner) or {}).get("addr")
+    if not addr:
+        return None
+
+    def fetch():
+        import urllib.request
+
+        with spans.span("read.relay", jobId=job_id, owner=owner):
+            req = urllib.request.Request(
+                f"http://{addr}/api/jobs/{job_id}"
+            )
+            with urllib.request.urlopen(req, timeout=1.0) as resp:
+                doc = json.loads(resp.read().decode("utf-8"))
+        snap = (doc.get("job") or {}).get("incumbent")
+        if not isinstance(snap, dict) or "incumbentSource" in snap:
+            # the owner answered with its OWN federated overlay (it
+            # lost the lease): second-hand state must not be re-marked
+            # as a live relay
+            return None
+        return {"snap": snap, "at": time.time()}
+
+    try:
+        got = _cached_read(f"relay:{job_id}", fetch)
+    except Exception:
+        return None
+    if got is None:
+        return None
+    snap = dict(got["snap"])
+    snap["incumbentSource"] = "relay"
+    snap["staleMs"] = max(0, round((time.time() - got["at"]) * 1e3))
+    return snap
+
+
 def _load_job_record(handler, job_id: str) -> dict | None:
     """Fetch a job's persisted record for an HTTP handler — the ONE
     store-read + error-envelope ladder behind the status poll, the
     cancel, and the stream. Writes the Database-error / 400 / 404
     envelope itself and returns None when it already responded; flags
-    degraded reads on `handler._job_db_degraded`."""
+    degraded reads on `handler._job_db_degraded`. On the distributed
+    queue the read goes through the watcher-scale cache (clean,
+    non-degraded records only; a hit costs no store round trip)."""
     errors: list = []
-    try:
+    handler._job_db_degraded = False
+
+    def fetch():
         db = store.get_database("vrp", None)
         with spans.span("store.read", tables="jobs"):
             record = db.get_job(job_id, errors)
         handler._job_db_degraded = getattr(db, "degraded", False)
+        return record
+
+    try:
+        record = _cached_read(
+            f"job:{job_id}", fetch,
+            cacheable=lambda v: (
+                v is not None
+                and not errors
+                and not handler._job_db_degraded
+            ),
+        )
     except Exception as e:
         fail(handler, [{"what": "Database error", "reason": str(e)}])
         return None
@@ -2088,6 +2291,24 @@ class JobStatusHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
                 overlay["incumbent"] = snap
             if overlay:
                 record = dict(record, **overlay)
+            if _federation_enabled():
+                obs.FEDERATED_READS.labels(source="live").inc()
+        elif (
+            _federation_enabled()
+            and record.get("status") not in (DONE, FAILED)
+        ):
+            # another replica's live solve: overlay the latest durable
+            # checkpoint as an HONESTLY MARKED incumbent (the live
+            # overlay above never carries the markers). A store outage
+            # degrades to the bare record with the degraded flag —
+            # marked, never a 500.
+            snap, ckpt_degraded = _checkpoint_incumbent(job_id)
+            if snap is not None:
+                record = dict(record, incumbent=snap)
+                obs.FEDERATED_READS.labels(source="checkpoint").inc()
+            if ckpt_degraded:
+                self._job_db_degraded = True
+                obs.FEDERATED_READS.labels(source="degraded").inc()
         payload = {"success": True, "job": record}
         if self._job_db_degraded:
             # the record came from the degraded-mode fallback (possibly
@@ -2180,6 +2401,17 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             record = _load_job_record(self, job_id)
             if record is None:
                 return
+        # reconnect contract: progress events carry `id: {block}`, so a
+        # dropped watcher resends the last block it saw (Last-Event-ID
+        # — possibly to a DIFFERENT replica) and resumes without the
+        # already-seen incumbent being replayed
+        last_id = None
+        raw = self.headers.get("Last-Event-ID")
+        if raw:
+            try:
+                last_id = int(raw)
+            except (TypeError, ValueError):
+                last_id = None
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream; charset=utf-8")
         self.send_header("Cache-Control", "no-cache")
@@ -2189,9 +2421,9 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
         self.end_headers()
         try:
             if job is None:
-                self._follow_record(job_id, record)
+                self._follow_record(job_id, record, last_id)
                 return
-            self._follow(job)
+            self._follow(job, last_id)
         except (BrokenPipeError, ConnectionResetError, OSError) as e:
             # client went away mid-stream; the solve is unaffected
             log_event(
@@ -2199,29 +2431,63 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
                 error=f"{type(e).__name__}: {e}",
             )
 
-    def _emit(self, name: str, payload: dict) -> None:
-        self.wfile.write(
-            f"event: {name}\ndata: {json.dumps(payload)}\n\n".encode("utf-8")
-        )
+    def _emit(self, name: str, payload: dict, event_id=None) -> None:
+        frame = f"event: {name}\n"
+        if event_id is not None:
+            frame += f"id: {event_id}\n"
+        frame += f"data: {json.dumps(payload)}\n\n"
+        self.wfile.write(frame.encode("utf-8"))
         self.wfile.flush()
 
-    def _follow_record(self, job_id: str, record: dict) -> None:
+    def _federated_snap(self, job_id: str) -> dict | None:
+        """A non-owning replica's freshest view of a running solve:
+        relay from the owner when it is reachable, else the durable
+        checkpoint row — both marked with incumbentSource/staleMs. A
+        store outage counts one degraded read and returns None (the
+        stream keeps heart-beating on the bare record; headers are long
+        sent, so degrading is the only honest option — never a 500)."""
+        snap = _relay_snap(job_id)
+        if snap is not None:
+            obs.FEDERATED_READS.labels(source="relay").inc()
+            return snap
+        snap, ckpt_degraded = _checkpoint_incumbent(job_id)
+        if ckpt_degraded:
+            obs.FEDERATED_READS.labels(source="degraded").inc()
+            return None
+        if snap is not None:
+            obs.FEDERATED_READS.labels(source="checkpoint").inc()
+        return snap
+
+    def _follow_record(self, job_id: str, record: dict,
+                       last_id=None) -> None:
         """Stream a job this process does NOT own (another replica's, or
         one predating a restart of this one): no live sink exists, so
         follow the persisted record — terminal already means one
         terminal event now; otherwise poll the store at a gentle cadence
         until it turns terminal, emitting its incumbent snapshots as
-        they land. A non-terminal record must NEVER be reported as
-        `failed`: the job is healthy, just not ours."""
+        they land. With federated reads on, each round also overlays the
+        owner-relayed (or checkpoint-sourced) incumbent at the
+        checkpoint cadence, so a watcher pinned to a NON-owning replica
+        tracks the solve within one cadence of the owner's view. A
+        non-terminal record must NEVER be reported as `failed`: the job
+        is healthy, just not ours."""
         timeout_s = config.get("VRPMS_STREAM_TIMEOUT_S")
         deadline = time.monotonic() + timeout_s
-        last_block = None
+        last_block = last_id
+        federate = _federation_enabled()
+        # the checkpoint row refreshes at the checkpoint cadence —
+        # polling a non-owned job faster than that buys nothing
+        poll_s = min(2.0, ckpt_mod.interval_s()) if federate else 2.0
         while True:
             status = record.get("status")
             snap = record.get("incumbent")
+            if federate and status not in (DONE, FAILED):
+                fed = self._federated_snap(job_id)
+                if fed is not None:
+                    snap = fed
             if snap is not None and snap.get("block") != last_block:
                 last_block = snap.get("block")
-                self._emit("progress", snap)
+                self._emit("progress", snap, event_id=last_block)
             if status in ("done", "failed"):
                 self._emit("done" if status == "done" else "failed", record)
                 return
@@ -2230,17 +2496,24 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
                 return
             self.wfile.write(b": keep-alive\n\n")
             self.wfile.flush()
-            time.sleep(2.0)
+            time.sleep(max(0.05, poll_s))
             errors: list = []
-            try:
+
+            def fetch():
                 db = store.get_database("vrp", None)
-                fresh = db.get_job(job_id, errors)
+                return db.get_job(job_id, errors)
+
+            try:
+                fresh = _cached_read(
+                    f"job:{job_id}", fetch,
+                    cacheable=lambda v: v is not None and not errors,
+                )
             except Exception:
                 fresh = None
             if fresh is not None and not errors:
                 record = fresh
 
-    def _follow(self, job: Job) -> None:
+    def _follow(self, job: Job, last_id=None) -> None:
         timeout_s = config.get("VRPMS_STREAM_TIMEOUT_S")
         deadline = time.monotonic() + timeout_s
         sink = job.sink
@@ -2256,7 +2529,11 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
                 self.wfile.flush()
             self._emit_terminal(job)
             return
-        seen, last_block = 0, None
+        # a reconnecting watcher's Last-Event-ID primes the dedupe so
+        # the replay-first rule skips the one block it already saw
+        # (`!=`, not `>`: blocks legitimately restart at 0 on a
+        # requeued/resumed attempt, which MUST stream again)
+        seen, last_block = 0, last_id
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -2267,7 +2544,7 @@ class JobStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             )
             if snap is not None and snap.get("block") != last_block:
                 last_block = snap.get("block")
-                self._emit("progress", snap)
+                self._emit("progress", snap, event_id=last_block)
             if closed:
                 self._emit_terminal(job)
                 return

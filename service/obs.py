@@ -212,6 +212,23 @@ CKPT_TOTAL = REGISTRY.counter(
     "fail-open, the solve is unaffected)",
     labels=("outcome",),
 )
+READ_CACHE = REGISTRY.counter(
+    "vrpms_read_cache_total",
+    "Job-read cache lookups on the distributed queue (hit = served "
+    "from a fresh memo, miss = no memo — store read, stale = memo "
+    "past VRPMS_READ_TTL_MS — refetched); local-queue mode and "
+    "TTL=0 never touch the cache",
+    labels=("outcome",),
+)
+FEDERATED_READS = REGISTRY.counter(
+    "vrpms_federated_reads_total",
+    "Job reads answered fleet-wide, by incumbent source (live = this "
+    "replica owns the solve, checkpoint = overlay from the durable "
+    "checkpoint row, relay = live progress fetched from the owning "
+    "replica, degraded = store/owner unreachable — marked, never a "
+    "500)",
+    labels=("source",),
+)
 SCHED_REQUEUES = REGISTRY.counter(
     "vrpms_sched_requeues_total",
     "In-flight jobs re-admitted after a worker crash (once per job max)",

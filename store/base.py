@@ -244,6 +244,14 @@ class JobQueueStore:
         block on it)."""
         return None
 
+    def get_entry(self, job_id: str) -> dict | None:
+        """One queue entry by job id (no lease taken) — the federated
+        read path's owner lookup: `lease_owner` names the replica whose
+        live registry holds the solve. Default None = backend predates
+        the op (callers fall back to checkpoint-sourced overlays,
+        never fail)."""
+        return None
+
     def register_replica(self, replica_id: str, ttl_s: float,
                          info: dict | None = None) -> None:
         """Heartbeat this replica into the ring membership. `info` is
@@ -549,15 +557,21 @@ class Database:
         self._cache_recovered("ckpt_write")
         return True
 
-    def get_checkpoint(self, job_id: str) -> dict | None:
+    def get_checkpoint(self, job_id: str, errors=None) -> dict | None:
         """The LATEST-attempt checkpoint row for `job_id` as
         {"attempt": int, "state": dict}; None on miss or failure — a
         checkpoint that cannot be read degrades to a from-zero resume,
-        never to a failed job."""
+        never to a failed job. The optional `errors` list (the get_job
+        convention) lets federated readers tell a miss from a store
+        outage so they can mark the response degraded."""
         try:
             row = self._fetch_checkpoint(str(job_id))
         except Exception as exc:
             self._cache_warn("ckpt_read", exc)
+            if errors is not None:
+                errors += [
+                    {"what": "Database read error", "reason": str(exc)}
+                ]
             return None
         self._cache_recovered("ckpt_read")
         return row

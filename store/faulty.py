@@ -183,6 +183,13 @@ class FaultyJobQueue(InMemoryJobQueue):
         self._injector.apply("read")
         return super().tenant_depths()
 
+    def get_entry(self, job_id):
+        # the federated owner lookup; a plan that downs reads must
+        # degrade the read path to checkpoint/marked responses, never
+        # a 500
+        self._injector.apply("read")
+        return super().get_entry(job_id)
+
     def register_replica(self, replica_id, ttl_s, info=None):
         self._injector.apply("read")
         return super().register_replica(replica_id, ttl_s, info)

@@ -521,6 +521,11 @@ class InMemoryJobQueue(JobQueueStore):
                 1 for r in self._rows_locked().values() if r["state"] == Q_QUEUED
             )
 
+    def get_entry(self, job_id: str) -> dict | None:
+        with _lock:
+            row = self._rows_locked().get(str(job_id))
+            return None if row is None else dict(row)
+
     def register_replica(self, replica_id: str, ttl_s: float,
                          info: dict | None = None) -> None:
         with _lock:

@@ -721,7 +721,12 @@ class _ResilientMixin(Database):
     # backwards), NO journal spooling (checkpoint rows must never
     # compete with job records for bounded journal slots during an
     # outage — they are refreshed at the next cadence tick anyway).
-    # The per-call deadline and shared breaker still apply.
+    # The per-call deadline and shared breaker still apply. The
+    # federated job-read path (service.jobs: checkpoint-sourced
+    # incumbent overlays for non-owning replicas) rides this same
+    # primitive, so per-poll checkpoint reads stay bounded-cost under
+    # an outage: one deadline, then the open breaker sheds instantly
+    # and the poll degrades to a marked store-record response.
     def _fetch_checkpoint(self, job_id):
         return self._cache_call("_fetch_checkpoint", (job_id,))
 

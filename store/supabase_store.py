@@ -663,6 +663,24 @@ class SupabaseJobQueue(JobQueueStore):
         )
         return int(result.count or 0)
 
+    def get_entry(self, job_id: str) -> dict | None:
+        # slim owner lookup for the federated read path: the lease
+        # columns identify the owning replica; the queue_entry doc
+        # rides along only because _entry reconstructs the contract
+        # shape from it (no conditional UPDATE — no lease is taken)
+        rows = (
+            self.client.table("jobs")
+            .select(
+                "id,slot,queue_state,lease_owner,lease_expires_at,"
+                "attempt,queue_entry"
+            )
+            .eq("id", str(job_id))
+            .limit(1)
+            .execute()
+            .data
+        )
+        return self._entry(rows[0]) if rows else None
+
     def depth_by_class(self) -> dict | None:
         if not type(self)._qos_cols:
             return None  # schema predates the columns: omit the view

@@ -1,0 +1,644 @@
+"""Federated live-progress reads (ISSUE 16 acceptance): any-replica
+incumbent visibility, SSE relay/reconnect, and watcher-scale caching.
+
+Layers, bottom up: the store owner-lookup seam (get_entry on the
+fail-open policy), Replica.owner_of resolution, the staleness-marker
+contract (checkpoint-sourced incumbents ALWAYS carry incumbentSource/
+staleMs; live overlays NEVER do), store-down degraded reads (marked,
+never a 500), the VRPMS_READ_TTL_MS=0 read-through byte-identity guard
+(mirroring the depth-memo tests), the SSE id:/Last-Event-ID reconnect
+contract, owner relay, and the timeline's checkpoint-lifecycle
+narration.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import store
+import store.memory as mem
+from service import checkpoint as ckpt_mod
+from service import debug as debug_mod
+from service import jobs as jobs_mod
+from store.base import JobQueueStore
+from store.faulty import reset_faults
+from store.resilient import reset_resilience
+from vrpms_tpu.sched import Replica
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    monkeypatch.setenv("VRPMS_STORE", "memory")
+    mem.reset()
+    reset_faults()
+    reset_resilience()
+    ckpt_mod.reset()
+    yield
+    jobs_mod.shutdown_scheduler()
+    ckpt_mod.reset()
+    mem.reset()
+    reset_faults()
+    reset_resilience()
+
+
+def _save_record(job_id: str, **over) -> dict:
+    record = {
+        "jobId": job_id,
+        "status": "running",
+        "problem": "vrp",
+        "algorithm": "sa",
+        "submittedAt": time.time(),
+    }
+    record.update(over)
+    store.get_database("vrp", None).save_job(job_id, record)
+    return record
+
+
+def _put_ckpt(job_id: str, cost=42.5, block=7, written_ago_s=0.5,
+              **over) -> dict:
+    state = {
+        "problem": "vrp",
+        "algorithm": "sa",
+        "routes": [[1, 2], [3]],
+        "cost": cost,
+        "evals": 1000,
+        "elapsedMs": 250.0,
+        "block": block,
+        "writtenAt": time.time() - written_ago_s,
+    }
+    state.update(over)
+    store.get_database("vrp", None).put_checkpoint(job_id, 1, state)
+    return state
+
+
+class _StatusShim:
+    """A bare object JobStatusHandler._status can run against."""
+
+    def __init__(self, job_id: str):
+        self.path = f"/api/jobs/{job_id}"
+        self.headers = {}
+
+
+def _poll_status(monkeypatch, job_id: str) -> tuple[int, dict]:
+    box: dict = {}
+    monkeypatch.setattr(
+        jobs_mod, "_respond",
+        lambda handler, code, body: box.update(code=code, body=body),
+    )
+    jobs_mod.JobStatusHandler._status(_StatusShim(job_id))
+    assert box, "handler never responded"
+    return box["code"], box["body"]
+
+
+# ---------------------------------------------------------------------------
+# Owner-lookup seam (store + replica resolution)
+# ---------------------------------------------------------------------------
+
+
+class TestOwnerLookup:
+    def test_memory_get_entry_roundtrip(self):
+        qs = store.get_queue_store()
+        assert qs.get_entry("nope") is None
+        qs.enqueue({"id": "e1", "slot": 3, "payload": {"content": {}}})
+        entry = qs.get_entry("e1")
+        assert entry["state"] == "queued" and entry["lease_owner"] is None
+        claimed = qs.claim("r1", lease_s=30.0)
+        assert claimed["id"] == "e1"
+        entry = qs.get_entry("e1")
+        assert entry["state"] == "leased"
+        assert entry["lease_owner"] == "r1"
+        # a COPY: mutating the returned dict must not corrupt the row
+        entry["lease_owner"] = "evil"
+        assert qs.get_entry("e1")["lease_owner"] == "r1"
+
+    def test_base_default_predates_the_op(self):
+        assert JobQueueStore().get_entry("any") is None
+
+    def _replica(self, rid="reader"):
+        return Replica(
+            store.get_queue_store(), rid,
+            materialize=lambda e: None, submit=lambda j: None,
+            complete=lambda *a: None, dead=lambda *a: None,
+            lease_s=30.0, poll_s=0.01, heartbeat_s=0.1, reclaim_s=1.0,
+            vnodes=4,
+        )
+
+    def test_owner_of_resolves_live_lease(self):
+        qs = store.get_queue_store()
+        qs.enqueue({"id": "e1", "slot": 0, "payload": {"content": {}}})
+        rep = self._replica()
+        assert rep.owner_of("e1") is None  # queued: nobody owns it
+        qs.claim("owner-rep", lease_s=30.0)
+        assert rep.owner_of("e1") == "owner-rep"
+        assert rep.owner_of("ghost") is None
+
+    def test_owner_of_expired_lease_is_nobody(self):
+        qs = store.get_queue_store()
+        qs.enqueue({"id": "e1", "slot": 0, "payload": {"content": {}}})
+        qs.claim("dead-rep", lease_s=0.01)
+        time.sleep(0.05)
+        assert self._replica().owner_of("e1") is None
+
+    def test_owner_of_store_down_is_none(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        monkeypatch.setenv("VRPMS_RESILIENCE", "off")
+        rep = self._replica()
+        assert rep.owner_of("e1") is None  # never raises
+
+
+# ---------------------------------------------------------------------------
+# Staleness-marker contract (the status poll)
+# ---------------------------------------------------------------------------
+
+
+class TestStalenessContract:
+    def test_checkpoint_overlay_always_carries_markers(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        jid = uuid.uuid4().hex[:12]
+        _save_record(jid)
+        _put_ckpt(jid, cost=42.5, block=7, written_ago_s=0.5)
+        code, body = _poll_status(monkeypatch, jid)
+        assert code == 200
+        inc = body["job"]["incumbent"]
+        assert inc["incumbentSource"] == "checkpoint"
+        assert isinstance(inc["staleMs"], int) and inc["staleMs"] >= 400
+        assert inc["bestCost"] == 42.5 and inc["block"] == 7
+        assert body["job"]["status"] == "running"  # never invented
+        assert "degraded" not in body
+
+    def test_rows_predating_written_at_mark_stale_none(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        jid = uuid.uuid4().hex[:12]
+        _save_record(jid)
+        state = _put_ckpt(jid)
+        del state["writtenAt"]
+        store.get_database("vrp", None).put_checkpoint(jid, 1, state)
+        code, body = _poll_status(monkeypatch, jid)
+        inc = body["job"]["incumbent"]
+        # the key is ALWAYS present on a checkpoint-sourced incumbent
+        assert inc["incumbentSource"] == "checkpoint"
+        assert inc["staleMs"] is None
+
+    def test_terminal_record_never_overlays(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(jid, status="done")
+        _put_ckpt(jid)  # a stale row the terminal delete missed
+        code, body = _poll_status(monkeypatch, jid)
+        assert body["job"] == record  # byte-identical, no overlay
+
+    def test_relay_off_restores_pre_federation_bytes(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(jid)
+        _put_ckpt(jid)
+        monkeypatch.setenv("VRPMS_READ_RELAY", "off")
+        code, body = _poll_status(monkeypatch, jid)
+        assert body == {"success": True, "job": record}
+
+    def test_local_queue_never_federates(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "local")
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(jid)
+        _put_ckpt(jid)
+        code, body = _poll_status(monkeypatch, jid)
+        assert body == {"success": True, "job": record}
+
+    def test_missing_checkpoint_is_not_degraded(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(jid)
+        code, body = _poll_status(monkeypatch, jid)
+        # short solves legitimately never checkpoint: bare record, clean
+        assert body == {"success": True, "job": record}
+
+
+# ---------------------------------------------------------------------------
+# Store-down degraded reads (marked, never a 500)
+# ---------------------------------------------------------------------------
+
+
+class _CkptDownDB:
+    """Record reads work; checkpoint reads are down (the outage window
+    where the jobs table answered but solve_checkpoints did not)."""
+
+    degraded = False
+
+    def __init__(self, record):
+        self._record = record
+
+    def get_job(self, job_id, errors):
+        return self._record
+
+    def get_checkpoint(self, job_id, errors=None):
+        if errors is not None:
+            errors += [{
+                "what": "Database read error", "reason": "ckpt store down",
+            }]
+        return None
+
+
+class TestDegradedReads:
+    def test_ckpt_store_down_marks_degraded_200(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(jid)
+        monkeypatch.setattr(
+            jobs_mod.store, "get_database",
+            lambda *a, **kw: _CkptDownDB(record),
+        )
+        code, body = _poll_status(monkeypatch, jid)
+        assert code == 200  # degraded, never a 500
+        assert body["degraded"] is True
+        assert "incumbent" not in body["job"]  # no invented state
+
+    def test_checkpoint_incumbent_reports_outage(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        monkeypatch.setenv("VRPMS_RESILIENCE", "off")
+        snap, degraded = jobs_mod._checkpoint_incumbent("j1")
+        assert snap is None and degraded is True
+
+    def test_checkpoint_miss_is_clean(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        snap, degraded = jobs_mod._checkpoint_incumbent("j-none")
+        assert snap is None and degraded is False
+
+
+# ---------------------------------------------------------------------------
+# Watcher-scale read cache (the depth-memo guard, generalized)
+# ---------------------------------------------------------------------------
+
+
+class _CountingDB:
+    degraded = False
+
+    def __init__(self, record):
+        self.calls = 0
+        self._record = record
+
+    def get_job(self, job_id, errors):
+        self.calls += 1
+        return self._record
+
+
+class TestReadCache:
+    def test_n_watchers_cost_one_read_per_ttl(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "60000")
+        monkeypatch.setenv("VRPMS_READ_RELAY", "off")
+        jid = uuid.uuid4().hex[:12]
+        db = _CountingDB(_save_record(jid, status="done"))
+        monkeypatch.setattr(
+            jobs_mod.store, "get_database", lambda *a, **kw: db
+        )
+        first = _poll_status(monkeypatch, jid)
+        for _ in range(63):
+            assert _poll_status(monkeypatch, jid) == first
+        assert db.calls == 1  # 64 watchers, ONE store round trip
+
+    def test_ttl_zero_reads_through_byte_identically(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_RELAY", "off")
+        jid = uuid.uuid4().hex[:12]
+        db = _CountingDB(_save_record(jid, status="done"))
+        monkeypatch.setattr(
+            jobs_mod.store, "get_database", lambda *a, **kw: db
+        )
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "60000")
+        cached = [
+            json.dumps(_poll_status(monkeypatch, jid), sort_keys=True)
+            for _ in range(3)
+        ]
+        jobs_mod.shutdown_scheduler()  # clears the cache between arms
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        through = [
+            json.dumps(_poll_status(monkeypatch, jid), sort_keys=True)
+            for _ in range(3)
+        ]
+        assert cached == through  # the cache changes cost, not bytes
+        assert db.calls == 1 + 3  # one cached read + three read-through
+
+    def test_local_mode_never_caches(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "local")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "60000")
+        jid = uuid.uuid4().hex[:12]
+        db = _CountingDB(_save_record(jid, status="done"))
+        monkeypatch.setattr(
+            jobs_mod.store, "get_database", lambda *a, **kw: db
+        )
+        for _ in range(4):
+            _poll_status(monkeypatch, jid)
+        assert db.calls == 4
+
+    def test_cache_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "60000")
+        for i in range(jobs_mod._READ_CACHE_CAP + 50):
+            jobs_mod._cached_read(f"job:bounded-{i}", lambda: {"i": 1})
+        with jobs_mod._read_lock:
+            assert len(jobs_mod._read_cache) <= jobs_mod._READ_CACHE_CAP
+
+    def test_errors_are_never_cached(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "60000")
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise RuntimeError("down")
+
+        for _ in range(3):
+            with pytest.raises(RuntimeError):
+                jobs_mod._cached_read("job:boom", boom)
+        assert calls["n"] == 3  # an outage is retried, not memoized
+
+
+# ---------------------------------------------------------------------------
+# SSE: id fields, Last-Event-ID reconnect, federated follow
+# ---------------------------------------------------------------------------
+
+
+def _StreamShim(job_id: str, last_event_id=None):
+    """A JobStreamHandler with the socket plumbing swapped for BytesIO —
+    the real _follow_record/_federated_snap/_emit methods, no HTTP."""
+    shim = object.__new__(jobs_mod.JobStreamHandler)
+    shim.path = f"/api/jobs/{job_id}/stream"
+    shim.headers = (
+        {} if last_event_id is None
+        else {"Last-Event-ID": str(last_event_id)}
+    )
+    shim.wfile = io.BytesIO()
+    return shim
+
+
+def _frames(shim) -> list[dict]:
+    """Parse captured SSE bytes into [{event, id?, data}] frames."""
+    out = []
+    for chunk in shim.wfile.getvalue().decode().split("\n\n"):
+        if not chunk.strip() or chunk.startswith(":"):
+            continue
+        frame: dict = {}
+        for line in chunk.splitlines():
+            if line.startswith("event: "):
+                frame["event"] = line[len("event: "):]
+            elif line.startswith("id: "):
+                frame["id"] = line[len("id: "):]
+            elif line.startswith("data: "):
+                frame["data"] = json.loads(line[len("data: "):])
+        out.append(frame)
+    return out
+
+
+class TestSSEReconnect:
+    def test_progress_events_carry_ids(self):
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(
+            jid, status="done", incumbent={"block": 5, "bestCost": 9.0}
+        )
+        shim = _StreamShim(jid)
+        jobs_mod.JobStreamHandler._follow_record(shim, jid, record, None)
+        frames = _frames(shim)
+        assert [f["event"] for f in frames] == ["progress", "done"]
+        assert frames[0]["id"] == "5"
+
+    def test_last_event_id_suppresses_the_seen_block(self):
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(
+            jid, status="done", incumbent={"block": 5, "bestCost": 9.0}
+        )
+        shim = _StreamShim(jid)
+        jobs_mod.JobStreamHandler._follow_record(shim, jid, record, 5)
+        frames = _frames(shim)
+        # the reconnecting watcher already saw block 5: straight to done
+        assert [f["event"] for f in frames] == ["done"]
+
+    def test_resumed_attempt_block_zero_streams_again(self):
+        # blocks restart at 0 on a resumed attempt: `!=` (not `>`) must
+        # let the new attempt's block 0 through a watcher who saw 5
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(
+            jid, status="done",
+            incumbent={"block": 0, "bestCost": 7.0, "resumed": True},
+        )
+        shim = _StreamShim(jid)
+        jobs_mod.JobStreamHandler._follow_record(shim, jid, record, 5)
+        frames = _frames(shim)
+        assert [f["event"] for f in frames] == ["progress", "done"]
+        assert frames[0]["id"] == "0"
+
+    def test_reconnect_over_http(self, monkeypatch):
+        """The end-to-end contract: drop, reconnect with Last-Event-ID
+        (as onto any replica), and the seen incumbent is not replayed."""
+        from service.app import serve
+
+        jobs_mod.shutdown_scheduler()
+        srv = serve(port=0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            jid = uuid.uuid4().hex[:12]
+            _save_record(
+                jid, status="done", incumbent={"block": 3, "bestCost": 1.0}
+            )
+            url = f"http://127.0.0.1:{port}/api/jobs/{jid}/stream"
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                first = resp.read().decode()
+            assert "id: 3" in first and "event: progress" in first
+            req = urllib.request.Request(
+                url, headers={"Last-Event-ID": "3"}
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                second = resp.read().decode()
+            assert "event: progress" not in second
+            assert "event: done" in second
+        finally:
+            srv.shutdown()
+            jobs_mod.shutdown_scheduler()
+
+    def test_follow_record_federates_checkpoint_snaps(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        monkeypatch.setenv("VRPMS_CKPT_MS", "20")
+        monkeypatch.setenv("VRPMS_STREAM_TIMEOUT_S", "0.4")
+        jid = uuid.uuid4().hex[:12]
+        record = _save_record(jid)  # running on "another replica"
+        _put_ckpt(jid, cost=42.5, block=7)
+        shim = _StreamShim(jid)
+        jobs_mod.JobStreamHandler._follow_record(shim, jid, record, None)
+        frames = _frames(shim)
+        progress = [f for f in frames if f["event"] == "progress"]
+        assert progress, frames
+        assert progress[0]["data"]["incumbentSource"] == "checkpoint"
+        assert "staleMs" in progress[0]["data"]
+        assert frames[-1]["event"] == "timeout"  # never invented failed
+
+
+# ---------------------------------------------------------------------------
+# Owner relay
+# ---------------------------------------------------------------------------
+
+
+class _OwnerStub:
+    """Stands in for jobs_mod._replica on the reader side."""
+
+    def __init__(self, owner, addr):
+        self._owner = owner
+        self.store = self
+        self._addr = addr
+
+    def owner_of(self, job_id):
+        return self._owner
+
+    def replica_infos(self):
+        return {self._owner: {"addr": self._addr}}
+
+
+def _relay_server(payload: dict):
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class TestRelay:
+    def test_relay_marks_and_rides_the_owner_view(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        monkeypatch.setenv("VRPMS_REPLICA_ID", "reader")
+        srv = _relay_server({
+            "success": True,
+            "job": {"incumbent": {"block": 9, "bestCost": 5.5}},
+        })
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            monkeypatch.setattr(
+                jobs_mod, "_replica", _OwnerStub("owner", addr)
+            )
+            snap = jobs_mod._relay_snap("j1")
+            assert snap["incumbentSource"] == "relay"
+            assert snap["bestCost"] == 5.5 and snap["block"] == 9
+            assert snap["staleMs"] >= 0
+        finally:
+            srv.shutdown()
+            jobs_mod._replica = None  # the stub must not reach drain
+
+    def test_second_hand_state_is_never_rerelayed(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        monkeypatch.setenv("VRPMS_REPLICA_ID", "reader")
+        srv = _relay_server({
+            "success": True,
+            "job": {"incumbent": {
+                "block": 9, "bestCost": 5.5,
+                "incumbentSource": "checkpoint", "staleMs": 100,
+            }},
+        })
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            monkeypatch.setattr(
+                jobs_mod, "_replica", _OwnerStub("owner", addr)
+            )
+            assert jobs_mod._relay_snap("j1") is None
+        finally:
+            srv.shutdown()
+            jobs_mod._replica = None  # the stub must not reach drain
+
+    def test_self_or_gone_owner_falls_back(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_QUEUE", "store")
+        monkeypatch.setenv("VRPMS_READ_TTL_MS", "0")
+        monkeypatch.setenv("VRPMS_REPLICA_ID", "reader")
+        # the owner is THIS replica: a relay to self would be a loop
+        monkeypatch.setattr(
+            jobs_mod, "_replica", _OwnerStub("reader", "127.0.0.1:1")
+        )
+        assert jobs_mod._relay_snap("j1") is None
+        # owner unreachable: None, the caller degrades to checkpoint
+        monkeypatch.setattr(
+            jobs_mod, "_replica", _OwnerStub("owner", "127.0.0.1:1")
+        )
+        assert jobs_mod._relay_snap("j1") is None
+        jobs_mod._replica = None  # the stub must not reach drain
+
+
+# ---------------------------------------------------------------------------
+# Fleet checkpoint health + timeline narration (the debug satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetCkptHealth:
+    def test_replica_info_carries_ckpt_health(self):
+        info = jobs_mod.replica_info()
+        ck = info["ckpt"]
+        assert set(ck) >= {
+            "entries", "lastFlushAgeMs", "written", "resumed", "dropped",
+        }
+        assert ck["entries"] == 0 and ck["lastFlushAgeMs"] is None
+
+    def test_health_tracks_flush_age(self):
+        ckpt = ckpt_mod.checkpointer()
+        with ckpt._lock:
+            ckpt._last_write = time.time() - 1.0
+        age = ckpt.health()["lastFlushAgeMs"]
+        assert age is not None and age >= 900
+
+
+class TestTimelineNarration:
+    @staticmethod
+    def _merged(spans):
+        return {"spans": spans, "replicas": [], "startedAt": 0.0}
+
+    def test_ckpt_write_and_resume_events(self):
+        events = debug_mod._span_events(self._merged([
+            {
+                "name": "ckpt.write", "startMs": 10.0, "durationMs": 2.0,
+                "replica": "r1",
+                "attributes": {"attempt": 1, "cost": 42.5},
+            },
+            {
+                "name": "ckpt.resume", "startMs": 20.0, "durationMs": 0.0,
+                "replica": "r2",
+                "attributes": {"source": "reclaim", "cost": 42.5},
+            },
+        ]))
+        kinds = [e["event"] for e in events]
+        assert kinds == ["ckpt.write", "ckpt.resume"]
+        assert "checkpoint written" in events[0]["detail"]
+        assert "cost 42.5" in events[0]["detail"]
+        assert "resumed from checkpoint (reclaim" in events[1]["detail"]
+        assert events[1]["source"] == "reclaim"
+
+    def test_drain_resume_narrates_the_nack(self):
+        events = debug_mod._span_events(self._merged([{
+            "name": "ckpt.resume", "startMs": 30.0, "durationMs": 0.0,
+            "replica": "r2", "attributes": {"source": "drain"},
+        }]))
+        kinds = [e["event"] for e in events]
+        assert kinds == ["drain.nack", "ckpt.resume"]
+        assert "nacked it back to the shared queue" in events[0]["detail"]

@@ -157,6 +157,19 @@ REGISTRY: dict[str, Var] = {
            "Shared-queue depth memo TTL for the 429/readiness paths "
            "(bounded staleness instead of a store round trip per "
            "request); 0 reads the store every time."),
+        _v("VRPMS_READ_TTL_MS", "float", 250.0,
+           "Job-read cache TTL on the distributed queue: N watchers "
+           "polling one job cost one store read per TTL instead of N "
+           "(terminal records, checkpoint overlays, owner lookups); "
+           "0 reads the store every time. Local-queue mode never "
+           "caches."),
+        _v("VRPMS_READ_RELAY", "switch", True,
+           "Federated reads on the distributed queue: a non-owning "
+           "replica answering GET /api/jobs/{id} (or its SSE stream) "
+           "overlays the latest checkpoint-sourced incumbent — marked "
+           "incumbentSource/staleMs — and relays live progress from "
+           "the owning replica found in the heartbeat registry. Off = "
+           "byte-identical pre-federation responses."),
         _v("VRPMS_REPLICA_ID", "str", None,
            "Stable replica identity (set to the pod/host name so "
            "restarts keep their ring arcs); unset generates one."),

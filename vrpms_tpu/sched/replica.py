@@ -280,6 +280,24 @@ class Replica:
             ring = self._refresh_ring()
         return ring is not None and ring.owner(s) == self.replica_id
 
+    def owner_of(self, job_id: str) -> str | None:
+        """The replica currently holding `job_id`'s live lease, or None
+        (unleased, lease expired, backend predates get_entry, or the
+        store is down — federated readers fall back to the checkpoint
+        row in every None case, so this is strictly best-effort)."""
+        try:
+            entry = self.store.get_entry(str(job_id))
+        except Exception as exc:
+            self._store_error("get_entry", exc)
+            return None
+        if not isinstance(entry, dict) or entry.get("state") != "leased":
+            return None
+        expires = entry.get("lease_expires_at")
+        if expires is not None and float(expires) <= time.time():
+            return None  # an expired lease names a dead/absent owner
+        owner = entry.get("lease_owner")
+        return str(owner) if owner else None
+
     # -- events -------------------------------------------------------------
     def _emit(self, name: str, **kw) -> None:
         if self._on_event is None:
