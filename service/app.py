@@ -8,6 +8,8 @@ threading HTTP server:
     python -m service.app --port 8080 [--fixtures fixtures.json] [--store memory]
 
 Routes: /api, /api/{vrp,tsp}/{ga,sa,aco,bf}, /api/jobs[/{id}],
+/api/subscriptions[/{id}[/deltas|/stream]] (standing re-solve-on-change
+jobs — service.subscriptions, VRPMS_SUBS-gated),
 /api/ready (ok|degraded|down readiness — service.jobs.readiness),
 /api/debug/traces[/{traceId}] (recent request traces — service.debug),
 /metrics (Prometheus text exposition — service.obs). Unknown paths
@@ -38,6 +40,13 @@ from service.jobs import (
     ReadyHandler,
     shutdown_scheduler,
 )
+from service.subscriptions import (
+    SubscriptionDeltasHandler,
+    SubscriptionDetailHandler,
+    SubscriptionsHandler,
+    SubscriptionStreamHandler,
+)
+from service.subscriptions import enabled as subs_enabled
 from service.api.vrp.ga.index import handler as vrp_ga
 from service.api.vrp.sa.index import handler as vrp_sa
 from service.api.vrp.aco.index import handler as vrp_aco
@@ -66,9 +75,16 @@ ROUTES = {
     "/metrics": obs.MetricsHandler,
 }
 
+# the standing-subscription surface registers for route-label purposes
+# unconditionally, but dispatch consults VRPMS_SUBS per request (below):
+# with the switch off every subscription path 404s and NO pre-existing
+# route's behavior shifts by a byte
+_SUB_ROUTES = {"/api/subscriptions": SubscriptionsHandler}
+
 # the request counter's route label values come from the route table —
 # an arbitrary 404 path can never mint a new series (service.obs)
 obs.KNOWN_ROUTES.update(ROUTES)
+obs.KNOWN_ROUTES.update(_SUB_ROUTES)
 
 
 class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
@@ -97,6 +113,24 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
         if cls is None and path.startswith("/api/debug/traces/"):
             # parameterized route: /api/debug/traces/{traceId}
             cls = TraceDetailHandler
+        if path == "/api/subscriptions" or path.startswith(
+            "/api/subscriptions/"
+        ):
+            # standing subscriptions (VRPMS_SUBS-gated per REQUEST so a
+            # flip needs no restart; off -> plain 404, byte-identical to
+            # the pre-subscription service): /api/subscriptions create/
+            # list, /{id} poll+delete, /{id}/deltas the change feed,
+            # /{id}/stream per-generation SSE
+            if not subs_enabled():
+                cls = None
+            elif path == "/api/subscriptions":
+                cls = SubscriptionsHandler
+            elif path.endswith("/deltas"):
+                cls = SubscriptionDeltasHandler
+            elif path.endswith("/stream"):
+                cls = SubscriptionStreamHandler
+            else:
+                cls = SubscriptionDetailHandler
         if cls is None:
             self.send_response(404)
             self.send_header("Content-type", "text/plain")
@@ -120,9 +154,10 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
         self._dispatch("POST")
 
     def do_DELETE(self):
-        # today only /api/jobs/{id} accepts DELETE (cooperative job
-        # cancellation); everything else answers 501 via the method
-        # check in _dispatch
+        # /api/jobs/{id} (cooperative job cancellation) and
+        # /api/subscriptions/{id} (retire a standing subscription,
+        # cancelling its in-flight generation) accept DELETE; everything
+        # else answers 501 via the method check in _dispatch
         self._dispatch("DELETE")
 
     def do_OPTIONS(self):

@@ -49,6 +49,7 @@ from http.server import BaseHTTPRequestHandler
 import store
 from service import obs
 from service.helpers import respond_json
+from vrpms_tpu import config
 from vrpms_tpu.obs import export as trace_export
 from vrpms_tpu.obs import spans
 
@@ -647,10 +648,68 @@ def build_timeline(record: dict, merged: dict | None) -> list:
     )
 
 
+def _lineage_events(record: dict, job_id: str) -> tuple[list, list]:
+    """Narrate the `resolvedFrom` chain behind a job — the standing-
+    subscription generations (or manual /resolve hops) that seeded it.
+    Walks predecessor records back through the shared store (so the
+    chain resolves fleet-wide regardless of which replica ran each
+    hop), numbering the root as generation 1. Returns (events, hops):
+    human-readable timeline entries plus the machine-readable chain."""
+    try:
+        db = store.get_database(record.get("problem") or "vrp", None)
+    except Exception:
+        return [], []
+    chain: list = []
+    seen = {job_id}
+    cur = record
+    while cur.get("resolvedFrom") and len(chain) < 16:
+        pid = cur["resolvedFrom"]
+        if pid in seen:
+            break  # defensive: a cyclic chain must not spin the walk
+        seen.add(pid)
+        prev = db.get_job(pid, [])
+        cost = None
+        if prev is not None:
+            cost = (prev.get("incumbent") or {}).get("bestCost")
+        chain.append({
+            "jobId": pid,
+            "cost": cost,
+            "status": prev.get("status") if prev is not None else None,
+        })
+        if prev is None:
+            break
+        cur = prev
+    if not chain:
+        return [], []
+    # chain[0] is the direct seed; the oldest ancestor is generation 1
+    events = []
+    root_gen = 1 if not cur.get("resolvedFrom") else None
+    for depth, hop in enumerate(reversed(chain)):
+        gen = (depth + 1) if root_gen else None
+        hop["generation"] = gen
+        events.append({
+            "atMs": None,
+            "event": "lineage",
+            "detail": (
+                (f"generation {gen}, " if gen else "")
+                + f"seeded from job {hop['jobId']}"
+                + (
+                    f" at cost {hop['cost']}"
+                    if hop["cost"] is not None
+                    else ""
+                )
+            ),
+        })
+    return events, list(reversed(chain))
+
+
 class JobTimelineHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
     """GET /api/jobs/{id}/timeline — the job's story as one ordered,
     human-readable event list, resolved across replicas via the trace
-    store when export is on."""
+    store when export is on. With standing subscriptions on, a job that
+    was seeded from a predecessor also narrates its `resolvedFrom`
+    lineage ("generation N, seeded from job X at cost C") so a
+    subscription's whole chain reads from any one generation."""
 
     def do_GET(self):
         obs.begin_request_obs(self, sample="header")
@@ -702,6 +761,14 @@ class JobTimelineHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             "replicas": merged["replicas"] if merged is not None else [],
             "timeline": build_timeline(record, merged),
         }
+        if config.enabled("VRPMS_SUBS") and record.get("resolvedFrom"):
+            # subscription-era narration only: with VRPMS_SUBS off the
+            # timeline stays byte-identical to the pre-subscription
+            # service even for manually /resolve-chained jobs
+            lin_events, hops = _lineage_events(record, job_id)
+            if hops:
+                payload["timeline"] = payload["timeline"] + lin_events
+                payload["lineage"] = hops
         if degraded or self._job_db_degraded:
             payload["degraded"] = True
         respond_json(self, 200, payload)

@@ -29,6 +29,7 @@ VRPMS_SCHED_QUEUE (admission bound, default 64), VRPMS_SCHED_WINDOW_MS
 
 from __future__ import annotations
 
+import io
 import json
 import threading
 import time
@@ -912,6 +913,15 @@ def shutdown_scheduler() -> int:
     window to finish and ack; anything still running re-queues to peers
     via lease expiry — never silent loss)."""
     global _scheduler, _drained, _replica
+    try:
+        # park the subscription manager FIRST: its debounce/cadence
+        # timers must not fire a generation into a scheduler that is
+        # mid-teardown (pending state is already durable in the store)
+        from service import subscriptions as subs_mod
+
+        subs_mod.reset()
+    except Exception:
+        pass
     with _replica_lock:
         r, _replica = _replica, None
     if r is not None:
@@ -1066,6 +1076,18 @@ def replica_info() -> dict:
                 obs.CKPT_TOTAL.labels(outcome=outcome).value
             )
         info["ckpt"] = ck
+    except Exception:
+        pass
+    try:
+        from service import subscriptions as subs_mod
+
+        if subs_mod.enabled():
+            # standing-subscription load: how many re-solve-on-change
+            # entities this replica manages, how stale their newest
+            # generation is, and how many deltas sit coalesced waiting
+            # for a debounce window to close (a growing backlog with an
+            # aging generation is a wedged manager, visible fleet-wide)
+            info["subs"] = subs_mod.manager().stats()
     except Exception:
         pass
     try:
@@ -1553,6 +1575,16 @@ def _dist_dead(entry: dict) -> None:
     obs.JOBS_TOTAL.labels(outcome="failed").inc()
 
 
+def _subs_tick() -> None:
+    """Replica-heartbeat hook: run the subscription manager's due-work
+    check (cadence fires + store adoption) on this replica. Lazy import
+    — subscriptions imports this module."""
+    from service import subscriptions as subs_mod
+
+    if subs_mod.enabled():
+        subs_mod.manager().tick()
+
+
 def build_replica(rid: str, scheduler=None, **kw):
     """A Replica wired to the service's materialize/complete path — the
     in-process multi-replica harness (tests, benchmarks/multi_replica)
@@ -1603,6 +1635,10 @@ def build_replica(rid: str, scheduler=None, **kw):
         # heartbeat status doc: what GET /api/debug/fleet on any peer
         # reports about this replica
         info=replica_info,
+        # standing-subscription scheduling rides the heartbeat: due
+        # cadences fire and orphaned (drained/crashed-owner) pending
+        # deltas are adopted by whichever live replica beats next
+        on_tick=_subs_tick,
         **defaults,
     )
 
@@ -1947,11 +1983,16 @@ def _submit_content(handler, content: dict, resolve_from: str | None = None):
     _submit_parsed(handler, ctx, resolve_from)
 
 
-def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
+def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None,
+                   prepared=None):
     """The back half of an async submit: prepare (instance build + seed
     resolution) and enqueue. On the resolve path this runs AFTER the
     predecessor was cancelled and reached its terminal record — seed
-    retrieval needs the final incumbent to exist."""
+    retrieval needs the final incumbent to exist. `prepared` (the
+    subscription generation path) carries a Prepared this request
+    already built — its no-op-delta dedupe needs the tier fingerprint
+    BEFORE deciding to launch, and preparing twice would double the
+    instance-build cost of every generation."""
     self = handler
     if is_draining():
         # a draining replica takes on nothing new: readiness already
@@ -1972,9 +2013,11 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
     params, opts, algo_params = ctx["params"], ctx["opts"], ctx["algo_params"]
     database = ctx["database"]
     errors: list = []
-    prep = prepare_request(problem, algorithm, params, opts, algo_params,
-                           ctx["locations"], ctx["durations"], errors,
-                           database)
+    prep = prepared
+    if prep is None:
+        prep = prepare_request(problem, algorithm, params, opts,
+                               algo_params, ctx["locations"],
+                               ctx["durations"], errors, database)
     if prep is None or errors:
         fail(self, errors)
         return
@@ -2092,6 +2135,60 @@ def _submit_parsed(handler, ctx: dict, resolve_from: str | None = None):
     if resolve_from:
         resp["resolvedFrom"] = resolve_from
     _respond(self, 202, resp)
+
+
+class _HeadlessSubmit:
+    """An HTTP-handler stand-in with no socket: subscription generation
+    launches (service.subscriptions) ride the EXACT _submit_parsed /
+    _submit_distributed pipeline — draining guard, QoS stamping, tenant
+    quota, lineage, trace deferral — and this shim captures the
+    envelope that would have gone over the wire. Every responder
+    (respond_json, fail, too_busy) funnels through send_response /
+    wfile, so capturing those two is capturing the contract."""
+
+    def __init__(self, request_id=None, trace=None, trace_root=None):
+        self._request_id = request_id
+        self._trace = trace
+        self._trace_id = trace.trace_id if trace is not None else None
+        self._trace_root = trace_root
+        self._obs_errors = None
+        self.algorithm = ""
+        self.problem = ""
+        self.headers: dict = {}
+        self.code: int | None = None
+        self.wfile = io.BytesIO()
+
+    def send_response(self, code):
+        self.code = code
+
+    def send_header(self, key, value):
+        pass
+
+    def end_headers(self):
+        pass
+
+    def result(self) -> tuple[int, dict]:
+        raw = self.wfile.getvalue()
+        try:
+            body = json.loads(raw.decode("utf-8")) if raw else {}
+        except ValueError:
+            body = {}
+        return self.code or 0, body
+
+
+def submit_headless(ctx: dict, resolve_from: str | None = None,
+                    prepared=None, request_id=None, trace=None,
+                    trace_root=None) -> tuple[int, dict]:
+    """Submit a parsed request with no HTTP handler — the jobs.py seam
+    the subscription manager launches generations through. Returns the
+    (status code, envelope) the pipeline would have answered: 202 with
+    a jobId on an accepted (or born-done) submit, 400/429/503 with the
+    contract's error envelope otherwise."""
+    shim = _HeadlessSubmit(
+        request_id=request_id, trace=trace, trace_root=trace_root
+    )
+    _submit_parsed(shim, ctx, resolve_from, prepared=prepared)
+    return shim.result()
 
 
 def _job_id_from_path(path: str) -> str:

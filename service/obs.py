@@ -220,6 +220,19 @@ READ_CACHE = REGISTRY.counter(
     "TTL=0 never touch the cache",
     labels=("outcome",),
 )
+SUB_GENERATIONS = REGISTRY.counter(
+    "vrpms_sub_generations_total",
+    "Standing-subscription re-solve generations launched, by trigger "
+    "(delta = a coalesced delta burst, cadence = the resolveEvery "
+    "timer, resume = a drain/crash adoption re-armed the schedule)",
+    labels=("trigger",),
+)
+SUB_COALESCED = REGISTRY.counter(
+    "vrpms_sub_coalesced_total",
+    "Deltas absorbed into an already-pending generation (every delta "
+    "beyond the first in one VRPMS_SUB_DEBOUNCE_MS window) plus no-op "
+    "bursts deduped by tier fingerprint before any solver launch",
+)
 FEDERATED_READS = REGISTRY.counter(
     "vrpms_federated_reads_total",
     "Job reads answered fleet-wide, by incumbent source (live = this "

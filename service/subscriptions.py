@@ -1,0 +1,1095 @@
+"""Standing subscriptions — durable re-solve-on-change jobs.
+
+A subscription is a store-persisted standing request: POST
+/api/subscriptions binds a dataset (the same request body POST
+/api/jobs takes), and the subscription then re-solves ITSELF — when a
+delta is posted to it (POST /api/subscriptions/{id}/deltas, the same
+{add, drop, demands, timeWindows} schema requests carry inline) or on
+an optional wall-clock cadence (`resolveEvery` seconds). Each re-solve
+is one GENERATION: a normal async job launched through the jobs.py
+submit seam (service.jobs.submit_headless), seeded from the previous
+generation's incumbent via the existing `warmStart: {jobId}`
+continuation path, with `resolvedFrom` lineage in the record and the
+trace root — so a subscription's history reads as one chain through
+GET /api/jobs/{id}/timeline and the `sub.generation` trace spans.
+
+The control-plane rules:
+
+  * **debounce/coalesce** — a burst of deltas inside one
+    VRPMS_SUB_DEBOUNCE_MS window composes into ONE pending delta and
+    launches ONE generation (every delta beyond the first counts in
+    vrpms_sub_coalesced_total);
+  * **no-op dedupe** — a pending delta whose post-application instance
+    carries the SAME tier fingerprint as the previous generation (adds
+    cancelled by drops, attributes rewritten to their current values)
+    is absorbed without any solver launch;
+  * **first-class queue citizenship** — generations ride the normal
+    submit pipeline, so QoS class, tenant quota accounting, the
+    distributed store queue, and the PR-15 checkpoint/drain marker all
+    apply with zero subscription-specific scheduling;
+  * **fleet durability** — the subscription doc (base content,
+    cumulative delta, pending delta, lineage tail) is store-persisted
+    at every mutation; the replica heartbeat tick adopts docs whose
+    owner left the ring (drain, crash), firing adopted pending state
+    as a trigger="resume" generation;
+  * **streaming** — GET /api/subscriptions/{id}/stream replays
+    terminal generations (Last-Event-ID aware, ids are
+    "{generation}:{block}") then follows the live one through the
+    owner's progress sink or, federated, the PR-16 relay/checkpoint
+    ladder.
+
+VRPMS_SUBS=off removes the routes (the router 404s them) and disables
+the manager, keeping every pre-subscription response byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler
+
+import store
+from service import jobs as jobs_mod
+from service import obs
+from service.helpers import read_json_body, respond_json, send_static_headers
+from service.solve import prepare_request
+from vrpms_tpu import config
+from vrpms_tpu.core import tiers
+from vrpms_tpu.core.delta import _DELTA_KEYS, _attr_map, _id_list
+from vrpms_tpu.obs import log_event, spans
+from vrpms_tpu.sched import DONE, FAILED
+from vrpms_tpu.sched import qos as qos_mod
+
+
+def enabled() -> bool:
+    return config.enabled("VRPMS_SUBS")
+
+
+def debounce_s() -> float:
+    return max(0.0, float(config.get("VRPMS_SUB_DEBOUNCE_MS"))) / 1e3
+
+
+def max_per_tenant() -> int:
+    return max(0, int(config.get("VRPMS_SUB_MAX_PER_TENANT")))
+
+
+def _db():
+    # subscription docs are problem-agnostic control-plane rows; any
+    # Database instance carries the seam (the jobs-record convention)
+    return store.get_database("vrp", None)
+
+
+#: lineage entries kept on the doc — enough chain for the timeline and
+#: stream replay without the doc growing with subscription lifetime
+LINEAGE_TAIL = 64
+
+#: create-body keys that configure the SUBSCRIPTION rather than the
+#: solve request it wraps
+_SUB_KEYS = ("resolveEvery",)
+
+
+def _compose_delta(cum: dict, new, errors: list) -> dict | None:
+    """Compose a newly-posted delta onto an accumulated one (the
+    coalescing step, and the fold of fired deltas into the cumulative
+    base-relative delta). Shape rules match core.delta's strict apply:
+    unknown keys, malformed lists/maps, and duplicate adds/drops are
+    contract violations (400), while an add that cancels an
+    accumulated drop (or vice versa) nets out — that is exactly the
+    no-op a burst is allowed to collapse to."""
+    if not isinstance(new, dict):
+        errors += [{"what": "Data error", "reason": "'delta' must be an object"}]
+        return None
+    unknown = [k for k in new if k not in _DELTA_KEYS]
+    if unknown:
+        errors += [{
+            "what": "Data error",
+            "reason": f"unknown delta key(s) {unknown}; expected one of "
+            f"{list(_DELTA_KEYS)}",
+        }]
+        return None
+    add = _id_list(new, "add", errors)
+    drop = _id_list(new, "drop", errors)
+    demands = _attr_map(new, "demands", errors)
+    windows = _attr_map(new, "timeWindows", errors)
+    if add is None or drop is None or demands is None or windows is None:
+        return None
+    both = [c for c in add if c in drop]
+    if both:
+        errors += [{
+            "what": "Data error",
+            "reason": f"delta adds and drops the same id(s) {both}",
+        }]
+        return None
+    out_add = list(cum.get("add") or [])
+    out_drop = list(cum.get("drop") or [])
+    for cid in add:
+        if repr(cid) in {repr(c) for c in out_drop}:
+            out_drop = [c for c in out_drop if repr(c) != repr(cid)]
+        elif repr(cid) in {repr(c) for c in out_add}:
+            errors += [{
+                "what": "Data error",
+                "reason": f"duplicate add: id {cid!r} is already pending",
+            }]
+            return None
+        else:
+            out_add.append(cid)
+    for cid in drop:
+        if repr(cid) in {repr(c) for c in out_add}:
+            out_add = [c for c in out_add if repr(c) != repr(cid)]
+        elif repr(cid) in {repr(c) for c in out_drop}:
+            errors += [{
+                "what": "Data error",
+                "reason": f"duplicate drop: id {cid!r} is already pending",
+            }]
+            return None
+        else:
+            out_drop.append(cid)
+    out_dem = dict(cum.get("demands") or {})
+    out_dem.update(demands)
+    out_win = dict(cum.get("timeWindows") or {})
+    out_win.update(windows)
+    out: dict = {}
+    if out_add:
+        out["add"] = out_add
+    if out_drop:
+        out["drop"] = out_drop
+    if out_dem:
+        out["demands"] = out_dem
+    if out_win:
+        out["timeWindows"] = out_win
+    return out
+
+
+def _prep_fingerprint(prep) -> str | None:
+    """The tier-fingerprint cache key content of a prepared request —
+    the no-op-delta dedupe identity. The cache attach already computed
+    it on the warm-start path; otherwise hash the instance directly.
+    Decomposed giants have no fingerprint (by design: materializing the
+    padded tensors is what decomposition avoids) — they never dedupe."""
+    cache = getattr(prep, "cache", None)
+    if isinstance(cache, dict) and cache.get("fingerprint"):
+        return cache["fingerprint"]
+    inst = getattr(prep, "inst", None)
+    if inst is None or getattr(prep, "decomp", None) is not None:
+        return None
+    try:
+        return tiers.fingerprint(inst)
+    except Exception:
+        return None
+
+
+class _Sub:
+    """In-process runtime state for one subscription: the doc (the
+    durable truth, persisted on every mutation) plus the monotonic
+    timer deadlines that must not survive a process anyway."""
+
+    __slots__ = ("doc", "fire_at", "cadence_at", "resume_pending")
+
+    def __init__(self, doc: dict):
+        self.doc = doc
+        self.fire_at: float | None = None  # debounce deadline (mono)
+        self.cadence_at: float | None = None  # next cadence fire (mono)
+        self.resume_pending = False  # adopted pending → trigger=resume
+
+
+class SubscriptionManager:
+    """The process-wide standing-subscription registry + scheduler.
+
+    One background worker thread serves every subscription's debounce
+    and cadence timers (started lazily at the first armed timer); the
+    replica heartbeat additionally calls tick() so cadences fire and
+    orphaned docs are adopted in fleet mode even when this process
+    never sees subscription HTTP traffic."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._subs: dict[str, _Sub] = {}  # guarded-by: _lock
+        self._gen = threading.Condition(self._lock)  # stream waiters
+        self._wake = threading.Event()
+        self._halt = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        self._halt.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+        with self._lock:
+            self._subs.clear()
+            self._gen.notify_all()
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            if self._halt.is_set():
+                return
+            self._thread = threading.Thread(
+                target=self._worker, name="vrpms-subs", daemon=True
+            )
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while not self._halt.is_set():
+            self.run_due()
+            timeout = 0.5
+            with self._lock:
+                now = time.monotonic()
+                deadlines = [
+                    t
+                    for sub in self._subs.values()
+                    for t in (sub.fire_at, sub.cadence_at)
+                    if t is not None
+                ]
+                if deadlines:
+                    timeout = min(0.5, max(0.005, min(deadlines) - now))
+            self._wake.wait(timeout)
+            self._wake.clear()
+
+    # -- control-plane API (the handlers call these) -----------------------
+
+    def create(self, content: dict) -> tuple[int, dict]:
+        resolve_every = content.get("resolveEvery")
+        if resolve_every is not None:
+            try:
+                resolve_every = float(resolve_every)
+                if resolve_every <= 0:
+                    raise ValueError
+            except (TypeError, ValueError):
+                return 400, {"success": False, "errors": [{
+                    "what": "Data error",
+                    "reason": "'resolveEvery' must be a positive number "
+                    "of seconds",
+                }]}
+        if content.get("delta") is not None:
+            return 400, {"success": False, "errors": [{
+                "what": "Data error",
+                "reason": "a subscription's create body takes no 'delta' "
+                "— post deltas to /api/subscriptions/{id}/deltas",
+            }]}
+        base = {k: v for k, v in content.items() if k not in _SUB_KEYS}
+        errors: list = []
+        ctx = jobs_mod._parse_content(dict(base), errors)
+        if ctx is None:
+            return 400, {"success": False, "errors": errors}
+        tenant = qos_mod.tenant_id(ctx["params"].get("auth"))
+        limit = max_per_tenant()
+        if limit > 0 and tenant is not None:
+            held = self._tenant_count(tenant)
+            if held is not None and held >= limit:
+                return 429, {"success": False, "errors": [{
+                    "what": "Too busy",
+                    "reason": "per-tenant standing-subscription quota "
+                    "exceeded; delete one or raise "
+                    "VRPMS_SUB_MAX_PER_TENANT",
+                }]}
+        now = time.time()
+        doc = {
+            "id": uuid.uuid4().hex,
+            "content": base,
+            "problem": ctx["problem"],
+            "algorithm": ctx["algorithm"],
+            "resolveEvery": resolve_every,
+            "tenant": tenant,
+            "qos": jobs_mod.job_qos_class(ctx["opts"]),
+            "generation": 0,
+            "lastJobId": None,
+            "lastFingerprint": None,
+            "delta": None,
+            "pending": None,
+            "pendingCount": 0,
+            "pendingAt": None,
+            "lineage": [],
+            "status": "active",
+            "replicaId": jobs_mod.replica_id(),
+            "createdAt": now,
+            "updatedAt": now,
+        }
+        sub = _Sub(doc)
+        with self._lock:
+            self._subs[doc["id"]] = sub
+            if resolve_every is not None:
+                sub.cadence_at = time.monotonic() + resolve_every
+        _db().put_subscription(doc["id"], doc)
+        if resolve_every is not None:
+            self._ensure_thread()
+            self._wake.set()
+        log_event(
+            "sub.created",
+            subscriptionId=doc["id"],
+            problem=doc["problem"],
+            algorithm=doc["algorithm"],
+            resolveEvery=resolve_every,
+        )
+        return 201, {
+            "success": True,
+            "subscriptionId": doc["id"],
+            "status": "active",
+            "resolveEvery": resolve_every,
+        }
+
+    def post_delta(self, sub_id: str, delta) -> tuple[int, dict]:
+        with self._lock:
+            sub = self._subs.get(sub_id)
+        if sub is None:
+            sub = self._adopt_from_store(sub_id)
+        if sub is None:
+            return 404, _not_found(sub_id)
+        errors: list = []
+        with self._lock:
+            doc = sub.doc
+            pending = _compose_delta(doc.get("pending") or {}, delta, errors)
+            if pending is None:
+                return 400, {"success": False, "errors": errors}
+            first = doc.get("pending") is None
+            if not first:
+                # every delta beyond the first in this debounce window
+                # is one launch the coalescer saved
+                obs.SUB_COALESCED.inc()
+            doc["pending"] = pending
+            doc["pendingCount"] = int(doc.get("pendingCount") or 0) + 1
+            doc["pendingAt"] = time.time()
+            doc["updatedAt"] = time.time()
+            if first:
+                # leading-edge debounce: the window opens at the FIRST
+                # delta of a burst and is not extended by later ones, so
+                # a continuous stream still fires every window
+                sub.fire_at = time.monotonic() + debounce_s()
+            count = doc["pendingCount"]
+        _db().put_subscription(sub_id, sub.doc)
+        self._ensure_thread()
+        self._wake.set()
+        log_event(
+            "sub.delta", subscriptionId=sub_id, pendingDeltas=count
+        )
+        return 202, {
+            "success": True,
+            "subscriptionId": sub_id,
+            "pendingDeltas": count,
+            "debounceMs": float(config.get("VRPMS_SUB_DEBOUNCE_MS")),
+        }
+
+    def lookup(self, sub_id: str) -> dict | None:
+        """The doc, live copy preferred (it has the freshest pending
+        state); falls back to the store so any replica answers."""
+        with self._lock:
+            sub = self._subs.get(sub_id)
+            if sub is not None:
+                return dict(sub.doc)
+        return _db().get_subscription(sub_id)
+
+    def delete(self, sub_id: str) -> tuple[int, dict]:
+        with self._lock:
+            sub = self._subs.pop(sub_id, None)
+            self._gen.notify_all()  # stream waiters re-check existence
+        doc = sub.doc if sub is not None else _db().get_subscription(sub_id)
+        if doc is None:
+            return 404, _not_found(sub_id)
+        # cooperative cancel of an in-flight generation (the PR-7
+        # cancel flag): the job runs to its cancelled terminal record,
+        # so the lineage chain stays intact, the tenant-quota slot is
+        # released by the terminal transition, and no queue entry is
+        # orphaned — the pending debounce timer died with the registry
+        # entry above, so nothing NEW can launch either
+        cancel_requested = False
+        job_id = doc.get("lastJobId")
+        if job_id:
+            live = jobs_mod.get_live_job(job_id)
+            if (
+                live is not None
+                and live.status not in (DONE, FAILED)
+                and live.sink is not None
+            ):
+                live.sink.cancel()
+                cancel_requested = True
+                log_event(
+                    "job.cancel_requested", jobId=job_id, via="subscription"
+                )
+        _db().delete_subscription(sub_id)
+        log_event(
+            "sub.deleted",
+            subscriptionId=sub_id,
+            cancelRequested=cancel_requested,
+            generation=doc.get("generation"),
+        )
+        return 200, {
+            "success": True,
+            "subscriptionId": sub_id,
+            "status": "deleted",
+            "cancelRequested": cancel_requested,
+        }
+
+    def list(self) -> tuple[int, dict]:
+        rows = _db().list_subscriptions()
+        degraded = rows is None
+        if degraded:
+            with self._lock:
+                rows = [dict(s.doc) for s in self._subs.values()]
+        body = {
+            "success": True,
+            "subscriptions": sorted(
+                (public_view(d) for d in rows),
+                key=lambda v: v.get("createdAt") or 0,
+            ),
+        }
+        if degraded:
+            body["degraded"] = True
+        return 200, body
+
+    # -- scheduling --------------------------------------------------------
+
+    def tick(self) -> None:
+        """The replica-heartbeat (and worker-loop) due-work pass: adopt
+        orphaned store docs, then fire due timers."""
+        if self._halt.is_set():
+            return
+        self._adopt_orphans()
+        self.run_due()
+
+    def run_due(self) -> None:
+        now = time.monotonic()
+        due: list[tuple[str, str]] = []
+        with self._lock:
+            for sub_id, sub in self._subs.items():
+                if sub.fire_at is not None and now >= sub.fire_at:
+                    due.append((
+                        sub_id, "resume" if sub.resume_pending else "delta"
+                    ))
+                elif sub.cadence_at is not None and now >= sub.cadence_at:
+                    due.append((sub_id, "cadence"))
+        for sub_id, trigger in due:
+            if self._halt.is_set():
+                return
+            try:
+                self._fire(sub_id, trigger)
+            except Exception as e:
+                log_event(
+                    "sub.fire_error",
+                    subscriptionId=sub_id,
+                    error=f"{type(e).__name__}: {e}",
+                )
+
+    def wait_generation(self, sub_id: str, seen_gen: int,
+                        timeout: float) -> dict | None:
+        """Park until the subscription's generation advances past
+        `seen_gen` (or the wait times out / the sub is deleted); returns
+        the current doc copy, or None when the sub is gone."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                sub = self._subs.get(sub_id)
+                if sub is None:
+                    return None
+                if int(sub.doc.get("generation") or 0) > seen_gen:
+                    return dict(sub.doc)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return dict(sub.doc)
+                self._gen.wait(timeout=min(remaining, 1.0))
+
+    def stats(self) -> dict:
+        """The fleet-debug block: this replica's standing load."""
+        with self._lock:
+            count = len(self._subs)
+            backlog = sum(
+                int(s.doc.get("pendingCount") or 0)
+                for s in self._subs.values()
+            )
+            newest = None
+            for s in self._subs.values():
+                for hop in s.doc.get("lineage") or []:
+                    at = hop.get("at")
+                    if at is not None and (newest is None or at > newest):
+                        newest = at
+        age = None if newest is None else round((time.time() - newest) * 1e3)
+        return {
+            "count": count,
+            "coalescedBacklog": backlog,
+            "lastGenerationAgeMs": age,
+        }
+
+    # -- internals ---------------------------------------------------------
+
+    def _tenant_count(self, tenant: str) -> int | None:
+        rows = _db().list_subscriptions()
+        if rows is None:
+            # unreadable store fails OPEN (the tenant-quota rule):
+            # count what this process knows instead
+            with self._lock:
+                rows = [s.doc for s in self._subs.values()]
+        return sum(1 for d in rows if d.get("tenant") == tenant)
+
+    def _adopt_from_store(self, sub_id: str) -> _Sub | None:
+        """Adopt one doc on touch (delta posted to a replica that has
+        never seen it — restart, or fleet routing): the toucher becomes
+        the owner, re-arming cadence from now."""
+        doc = _db().get_subscription(sub_id)
+        if doc is None:
+            return None
+        return self._adopt(doc)
+
+    def _adopt(self, doc: dict) -> _Sub:
+        with self._lock:
+            sub = self._subs.get(doc["id"])
+            if sub is not None:
+                return sub
+            sub = _Sub(doc)
+            self._subs[doc["id"]] = sub
+            if doc.get("resolveEvery"):
+                sub.cadence_at = time.monotonic() + float(doc["resolveEvery"])
+            if doc.get("pending") is not None:
+                # pending state from a drained/crashed owner fires as a
+                # resume generation at once — the burst already waited
+                # its debounce window somewhere else
+                sub.resume_pending = True
+                sub.fire_at = time.monotonic()
+        doc["replicaId"] = jobs_mod.replica_id()
+        _db().put_subscription(doc["id"], doc)
+        self._ensure_thread()
+        self._wake.set()
+        log_event("sub.adopted", subscriptionId=doc["id"])
+        return sub
+
+    def _adopt_orphans(self) -> None:
+        """Fleet sweep: take over docs whose owning replica left the
+        membership ring (drain/crash). Single-process (local-queue)
+        mode adopts everything — there is no other owner."""
+        rows = _db().list_subscriptions()
+        if rows is None:
+            return
+        mine = jobs_mod.replica_id()
+        members = None
+        if jobs_mod.dist_queue_enabled():
+            rep = jobs_mod._replica
+            ring = rep.ring() if rep is not None else None
+            if ring is not None:
+                members = set(ring.members)
+        for doc in rows:
+            with self._lock:
+                if doc.get("id") in self._subs:
+                    continue
+            owner = doc.get("replicaId")
+            if jobs_mod.dist_queue_enabled():
+                if members is None:
+                    # no membership view yet: only reclaim our own docs
+                    if owner != mine:
+                        continue
+                elif owner in members and owner != mine:
+                    continue  # the owner is alive — not ours to take
+            self._adopt(doc)
+
+    def _fire(self, sub_id: str, trigger: str) -> None:
+        """Launch one generation (or absorb a no-op burst). Runs on the
+        worker/tick thread; the manager lock is held only around doc
+        mutation, never across the parse/prepare/submit work."""
+        with self._lock:
+            sub = self._subs.get(sub_id)
+            if sub is None:
+                return
+            doc = sub.doc
+            if jobs_mod.is_draining():
+                # fire nothing into a draining replica: the doc (with
+                # its pending delta) is already durable — stop the
+                # timers so a peer's adoption sweep takes over
+                sub.fire_at = None
+                sub.cadence_at = None
+                return
+            errors: list = []
+            effective = _compose_delta(
+                doc.get("delta") or {}, doc.get("pending") or {}, errors
+            )
+            if effective is None:
+                # the pending burst conflicts with the accumulated
+                # delta (e.g. re-adding an id a fired generation
+                # already added): poison — drop it, keep the sub alive
+                self._absorb(sub, doc.get("delta"), errors=errors)
+                return
+            last_id = doc.get("lastJobId")
+            generation = int(doc.get("generation") or 0)
+            pending_count = int(doc.get("pendingCount") or 0)
+            sub.fire_at = None
+            sub.resume_pending = False
+            if trigger == "cadence" and doc.get("resolveEvery"):
+                sub.cadence_at = (
+                    time.monotonic() + float(doc["resolveEvery"])
+                )
+        # predecessor still solving? Deltas cancel-and-resolve (the
+        # /resolve semantic: the successor seeds from the cancelled
+        # run's final incumbent); cadences just wait their turn.
+        live = jobs_mod.get_live_job(last_id) if last_id else None
+        if live is not None and live.status not in (DONE, FAILED):
+            if trigger == "cadence":
+                with self._lock:
+                    if sub_id in self._subs:
+                        sub.cadence_at = time.monotonic() + 0.25
+                return
+            if live.sink is not None:
+                live.sink.cancel()
+            live.wait(timeout=float(config.get("VRPMS_RESOLVE_WAIT_S")))
+            if not live.done_event.is_set():
+                with self._lock:
+                    if sub_id in self._subs:
+                        sub.fire_at = (
+                            time.monotonic() + max(debounce_s(), 0.25)
+                        )
+                return
+        content = dict(doc["content"])
+        if effective:
+            content["delta"] = effective
+        if last_id:
+            content["warmStart"] = {"jobId": last_id}
+        errors = []
+        ctx = jobs_mod._parse_content(content, errors)
+        prep = None
+        if ctx is not None:
+            prep = prepare_request(
+                ctx["problem"], ctx["algorithm"], ctx["params"],
+                ctx["opts"], ctx["algo_params"], ctx["locations"],
+                ctx["durations"], errors, ctx["database"],
+            )
+        if prep is None or errors:
+            # dataset drift / poison delta: the generation cannot be
+            # built — record why, drop the pending burst (keeping it
+            # would wedge the subscription forever), keep the sub alive
+            with self._lock:
+                if sub_id in self._subs:
+                    self._absorb(sub, doc.get("delta"), errors=errors)
+            log_event(
+                "sub.generation_rejected",
+                subscriptionId=sub_id,
+                errors=[e.get("reason") for e in errors][:4],
+            )
+            return
+        fingerprint = _prep_fingerprint(prep)
+        if (
+            trigger != "cadence"
+            and fingerprint is not None
+            and fingerprint == doc.get("lastFingerprint")
+        ):
+            # no-op burst: the post-delta instance IS the previous
+            # generation's instance (tier-fingerprint identity) — fold
+            # the delta in, launch nothing
+            obs.SUB_COALESCED.inc()
+            with self._lock:
+                if sub_id in self._subs:
+                    self._absorb(sub, effective or None)
+            log_event(
+                "sub.noop_delta",
+                subscriptionId=sub_id,
+                generation=generation,
+                coalesced=pending_count,
+            )
+            return
+        trace = spans.start_trace(None)
+        root = None
+        tokens = None
+        if trace is not None:
+            root = trace.span("sub.generation")
+            root.set(
+                subscriptionId=sub_id,
+                generation=generation + 1,
+                trigger=trigger,
+            )
+            tokens = spans.activate(trace, root)
+        code, body = 0, {}
+        try:
+            code, body = jobs_mod.submit_headless(
+                ctx,
+                resolve_from=last_id,
+                prepared=prep,
+                request_id=obs.new_request_id(),
+                trace=trace,
+                trace_root=root,
+            )
+        finally:
+            if trace is not None:
+                status = None if code and code < 400 else "error"
+                root.end(status=status)
+                spans.deactivate(tokens)
+                if not trace.deferred:
+                    trace.finish(
+                        status="ok" if code and code < 400 else "error"
+                    )
+        job_id = body.get("jobId")
+        if code in (200, 201, 202) and job_id:
+            obs.SUB_GENERATIONS.labels(trigger=trigger).inc()
+            log_event(
+                "sub.generation",
+                subscriptionId=sub_id,
+                generation=generation + 1,
+                jobId=job_id,
+                trigger=trigger,
+                resolvedFrom=last_id,
+                coalesced=max(0, pending_count - 1),
+            )
+            with self._lock:
+                if sub_id not in self._subs:
+                    return  # deleted mid-launch: the job runs terminal
+                doc["generation"] = generation + 1
+                doc["lastJobId"] = job_id
+                doc["lastFingerprint"] = fingerprint
+                doc["delta"] = effective or None
+                doc["pending"] = None
+                doc["pendingCount"] = 0
+                doc["pendingAt"] = None
+                doc["lastError"] = None
+                doc["updatedAt"] = time.time()
+                lineage = list(doc.get("lineage") or [])
+                lineage.append({
+                    "generation": generation + 1,
+                    "jobId": job_id,
+                    "trigger": trigger,
+                    "resolvedFrom": last_id,
+                    "at": time.time(),
+                })
+                doc["lineage"] = lineage[-LINEAGE_TAIL:]
+                self._gen.notify_all()
+            _db().put_subscription(sub_id, doc)
+        elif code in (429, 503):
+            # backpressure: the burst stays pending and retries after
+            # another debounce window — never dropped, never doubled
+            with self._lock:
+                if sub_id in self._subs:
+                    sub.fire_at = time.monotonic() + max(debounce_s(), 0.25)
+                    doc["lastError"] = body.get("errors")
+            self._wake.set()
+        else:
+            with self._lock:
+                if sub_id in self._subs:
+                    self._absorb(
+                        sub, doc.get("delta"), errors=body.get("errors")
+                    )
+            log_event(
+                "sub.generation_rejected",
+                subscriptionId=sub_id,
+                code=code,
+            )
+
+    def _absorb(self, sub: _Sub, delta, errors=None) -> None:
+        """Clear the pending burst (folding `delta` in as the new
+        cumulative) without a launch; caller holds the lock."""
+        doc = sub.doc
+        doc["delta"] = delta
+        doc["pending"] = None
+        doc["pendingCount"] = 0
+        doc["pendingAt"] = None
+        if errors:
+            doc["lastError"] = errors
+        doc["updatedAt"] = time.time()
+        _db().put_subscription(doc["id"], doc)
+
+
+def public_view(doc: dict) -> dict:
+    """The response shape of a subscription doc: everything a client
+    steers by, minus the (possibly large) base content and the internal
+    fingerprint/replica fields."""
+    view = {
+        "subscriptionId": doc.get("id"),
+        "problem": doc.get("problem"),
+        "algorithm": doc.get("algorithm"),
+        "resolveEvery": doc.get("resolveEvery"),
+        "generation": int(doc.get("generation") or 0),
+        "lastJobId": doc.get("lastJobId"),
+        "pendingDeltas": int(doc.get("pendingCount") or 0),
+        "lineage": list(doc.get("lineage") or []),
+        "status": doc.get("status") or "active",
+        "createdAt": doc.get("createdAt"),
+        "updatedAt": doc.get("updatedAt"),
+    }
+    if doc.get("lastError"):
+        view["lastError"] = doc["lastError"]
+    return view
+
+
+def _not_found(sub_id: str) -> dict:
+    return {
+        "success": False,
+        "errors": [{
+            "what": "Not found",
+            "reason": f"no subscription with id '{sub_id}'",
+        }],
+    }
+
+
+_mgr: SubscriptionManager | None = None
+_mgr_lock = threading.Lock()
+
+
+def manager() -> SubscriptionManager:
+    global _mgr
+    with _mgr_lock:
+        if _mgr is None:
+            _mgr = SubscriptionManager()
+        return _mgr
+
+
+def reset() -> None:
+    """Park and forget the manager (tests, scheduler shutdown): timers
+    stop, in-memory registry clears; the store docs — the durable truth
+    — are untouched and re-adopted on the next touch/tick."""
+    global _mgr
+    with _mgr_lock:
+        m, _mgr = _mgr, None
+    if m is not None:
+        m.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+def _sub_id_from_path(path: str) -> str:
+    """The {id} segment of /api/subscriptions/{id}[/deltas|/stream]."""
+    parts = [p for p in path.split("?", 1)[0].split("/") if p]
+    if parts and parts[-1] in ("deltas", "stream"):
+        parts = parts[:-1]
+    return parts[-1] if parts else ""
+
+
+def _answer(handler, code: int, body: dict) -> None:
+    """Envelope responder with the repo's error-accounting convention:
+    contract rejections (400) and sheds (429) count in ERROR_KINDS like
+    fail()/too_busy() would; 404s only mark the access-log line."""
+    if code >= 400:
+        kinds = [
+            e.get("what", "unknown") for e in body.get("errors") or []
+        ] or ["error"]
+        handler._obs_errors = sorted(set(kinds))
+        if code != 404:
+            for what in kinds:
+                obs.ERROR_KINDS.labels(what=what).inc()
+    respond_json(handler, code, body)
+
+
+class SubscriptionsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """POST /api/subscriptions — create a standing subscription;
+    GET — list the fleet's standing subscriptions."""
+
+    def do_POST(self):
+        obs.begin_request_obs(self)
+        try:
+            content = read_json_body(self)
+            if content is None:
+                return
+            code, body = manager().create(content)
+            _answer(self, code, body)
+        finally:
+            obs.end_request_obs(self)
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            code, body = manager().list()
+            _answer(self, code, body)
+        finally:
+            obs.end_request_obs(self)
+
+
+class SubscriptionDetailHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/subscriptions/{id} — the doc view (any replica);
+    DELETE — cancel the in-flight generation cooperatively and remove
+    the subscription (terminal records + lineage survive)."""
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            sub_id = _sub_id_from_path(self.path)
+            doc = manager().lookup(sub_id)
+            if doc is None:
+                _answer(self, 404, _not_found(sub_id))
+                return
+            _answer(self, 200, {
+                "success": True, "subscription": public_view(doc),
+            })
+        finally:
+            obs.end_request_obs(self)
+
+    def do_DELETE(self):
+        obs.begin_request_obs(self)
+        try:
+            code, body = manager().delete(_sub_id_from_path(self.path))
+            _answer(self, code, body)
+        finally:
+            obs.end_request_obs(self)
+
+
+class SubscriptionDeltasHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """POST /api/subscriptions/{id}/deltas — feed a dataset change; the
+    debounced/coalesced burst becomes one re-solve generation."""
+
+    def do_POST(self):
+        obs.begin_request_obs(self)
+        try:
+            content = read_json_body(self)
+            if content is None:
+                return
+            delta = content.get("delta", content)
+            code, body = manager().post_delta(
+                _sub_id_from_path(self.path), delta
+            )
+            _answer(self, code, body)
+        finally:
+            obs.end_request_obs(self)
+
+
+class SubscriptionStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/subscriptions/{id}/stream — every generation's
+    incumbents as Server-Sent Events, across generations and replicas.
+
+    Event ids are "{generation}:{block}" ("{generation}:end" for a
+    generation's terminal frame), so Last-Event-ID replay resumes the
+    CHAIN, not just one job: terminal generations the client missed
+    replay from their records, then the live generation follows through
+    the local progress sink or — non-owner, federation on — the PR-16
+    relay/checkpoint ladder."""
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            self._stream()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream: nothing to answer
+        finally:
+            obs.end_request_obs(self)
+
+    def _emit(self, name: str, payload: dict, event_id=None) -> None:
+        frame = f"event: {name}\n"
+        if event_id is not None:
+            frame += f"id: {event_id}\n"
+        frame += f"data: {json.dumps(payload)}\n\n"
+        self.wfile.write(frame.encode("utf-8"))
+        try:
+            self.wfile.flush()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _parse_last(header) -> int:
+        """Last-Event-ID "{gen}:{block}" -> the last FULLY-streamed
+        generation (a mid-generation id replays that generation's
+        terminal again — the != dedupe rule: duplicates beat gaps)."""
+        if not header:
+            return 0
+        try:
+            gen_s, _, block_s = str(header).partition(":")
+            gen = int(gen_s)
+            return gen if block_s == "end" else gen - 1
+        except (TypeError, ValueError):
+            return 0
+
+    def _snap(self, job_id: str):
+        """The freshest incumbent view of one generation job: the local
+        sink when this replica owns it, else the federated ladder."""
+        live = jobs_mod.get_live_job(job_id)
+        if live is not None and live.sink is not None:
+            return live.sink.snapshot(), live.status
+        if jobs_mod._federation_enabled():
+            snap = jobs_mod._relay_snap(job_id)
+            if snap is not None:
+                obs.FEDERATED_READS.labels(source="relay").inc()
+                return snap, None
+            snap, degraded = jobs_mod._checkpoint_incumbent(job_id)
+            if degraded:
+                obs.FEDERATED_READS.labels(source="degraded").inc()
+            elif snap is not None:
+                obs.FEDERATED_READS.labels(source="checkpoint").inc()
+                return snap, None
+        return None, None
+
+    def _stream(self):
+        sub_id = _sub_id_from_path(self.path)
+        mgr = manager()
+        doc = mgr.lookup(sub_id)
+        if doc is None:
+            _answer(self, 404, _not_found(sub_id))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        send_static_headers(self)
+        self.end_headers()
+        last_gen = self._parse_last(self.headers.get("Last-Event-ID"))
+        self._emit("subscription", {
+            "subscriptionId": sub_id,
+            "generation": int(doc.get("generation") or 0),
+            "resolveEvery": doc.get("resolveEvery"),
+        })
+        deadline = (
+            time.monotonic() + float(config.get("VRPMS_STREAM_TIMEOUT_S"))
+        )
+        db = _db()
+        seen_gen = last_gen
+        last_block = -1
+        while time.monotonic() < deadline:
+            if doc is None:
+                self._emit("deleted", {"subscriptionId": sub_id})
+                return
+            cur_gen = int(doc.get("generation") or 0)
+            # replay every terminal generation the client has not seen
+            # (all but the newest are terminal by construction: a new
+            # generation only launches once its predecessor ended)
+            for hop in doc.get("lineage") or []:
+                gen = int(hop.get("generation") or 0)
+                if gen <= seen_gen or gen >= cur_gen:
+                    continue
+                self._emit_terminal(db, gen, hop)
+                seen_gen = gen
+                last_block = -1
+            if cur_gen > seen_gen:
+                # the newest generation: follow it live
+                job_id = doc.get("lastJobId")
+                snap, status = (None, None)
+                if job_id:
+                    snap, status = self._snap(job_id)
+                if snap is not None and snap.get("block") != last_block:
+                    last_block = snap.get("block")
+                    self._emit(
+                        "progress",
+                        dict(snap, generation=cur_gen, jobId=job_id),
+                        event_id=f"{cur_gen}:{last_block}",
+                    )
+                if status in (DONE, FAILED) or (
+                    job_id and jobs_mod.get_live_job(job_id) is None
+                ):
+                    hop = (doc.get("lineage") or [{}])[-1]
+                    self._emit_terminal(db, cur_gen, hop)
+                    seen_gen = cur_gen
+                    last_block = -1
+            fresh = mgr.wait_generation(
+                sub_id, seen_gen,
+                timeout=min(2.0, max(0.05, deadline - time.monotonic())),
+            )
+            if fresh is None:
+                # deleted while parked — or simply not registered on
+                # this replica: re-read the store before concluding
+                fresh = mgr.lookup(sub_id)
+            doc = fresh
+            if doc is not None and int(doc.get("generation") or 0) <= seen_gen:
+                self._emit("keep-alive", {"generation": seen_gen})
+        self._emit("timeout", {
+            "subscriptionId": sub_id, "generation": seen_gen,
+        })
+
+    def _emit_terminal(self, db, gen: int, hop: dict) -> None:
+        job_id = hop.get("jobId")
+        errors: list = []
+        record = db.get_job(job_id, errors) if job_id else None
+        payload = {
+            "generation": gen,
+            "jobId": job_id,
+            "trigger": hop.get("trigger"),
+            "resolvedFrom": hop.get("resolvedFrom"),
+        }
+        if record is not None:
+            payload["status"] = record.get("status")
+            if record.get("incumbent"):
+                payload["incumbent"] = record["incumbent"]
+            if record.get("resolvedFrom"):
+                payload["resolvedFrom"] = record["resolvedFrom"]
+        self._emit("generation", payload, event_id=f"{gen}:end")

@@ -588,6 +588,79 @@ class Database:
         self._cache_recovered("ckpt_delete")
         return True
 
+    # -- standing subscriptions (re-solve-on-change extension) --------------
+    # One row per subscription id: a standing re-solve-on-change job's
+    # durable control-plane doc — the base request content, cadence,
+    # generation counter, lineage tail, and last launched job id —
+    # written by the subscription manager (service.subscriptions) at
+    # every generation boundary. Any replica can read the full set
+    # (list) to adopt due cadences after a drain or crash, so the rows
+    # are durable state, not cache: reads/writes go through the
+    # fail-open latch wrappers below (an outage degrades a generation
+    # launch or a cadence adoption, never the solves themselves).
+    def _fetch_subscription(self, sub_id: str):
+        raise NotImplementedError
+
+    def _list_subscriptions(self):
+        raise NotImplementedError
+
+    def _upsert_subscription(self, sub_id: str, doc: dict):
+        raise NotImplementedError
+
+    def _delete_subscription(self, sub_id: str):
+        raise NotImplementedError
+
+    def put_subscription(self, sub_id: str, doc: dict) -> bool:
+        """Persist a subscription's control-plane doc; False on failure
+        (the manager keeps serving from its in-process copy)."""
+        try:
+            self._upsert_subscription(str(sub_id), doc)
+        except Exception as exc:
+            self._cache_warn("sub_write", exc)
+            return False
+        self._cache_recovered("sub_write")
+        return True
+
+    def get_subscription(self, sub_id: str, errors=None) -> dict | None:
+        """A subscription doc by id; None on miss or failure. The
+        optional `errors` list (the get_job convention) lets callers
+        tell a miss from a store outage."""
+        try:
+            row = self._fetch_subscription(str(sub_id))
+        except Exception as exc:
+            self._cache_warn("sub_read", exc)
+            if errors is not None:
+                errors += [
+                    {"what": "Database read error", "reason": str(exc)}
+                ]
+            return None
+        self._cache_recovered("sub_read")
+        return None if row is None else row.get("doc")
+
+    def list_subscriptions(self) -> list | None:
+        """Every stored subscription doc, or None when the store cannot
+        be read (callers must treat None as unknown, not empty — a
+        cadence adopter must not conclude the fleet has no standing
+        work because of one read blip)."""
+        try:
+            rows = self._list_subscriptions()
+        except Exception as exc:
+            self._cache_warn("sub_read", exc)
+            return None
+        self._cache_recovered("sub_read")
+        return [r.get("doc") for r in rows or []]
+
+    def delete_subscription(self, sub_id: str) -> bool:
+        """Drop a subscription row (DELETE endpoint / terminal
+        hygiene); False on failure."""
+        try:
+            self._delete_subscription(str(sub_id))
+        except Exception as exc:
+            self._cache_warn("sub_delete", exc)
+            return False
+        self._cache_recovered("sub_delete")
+        return True
+
     # -- async job records (scheduler extension) ----------------------------
     # The jobs API (service.jobs) persists each job's lifecycle record
     # through this seam so `GET /api/jobs/{id}` answers from whichever

@@ -82,6 +82,14 @@ class _FaultyMixin(_InMemoryMixin):
         self._injector.apply("read")
         return super()._list_trace_rows(limit)
 
+    def _fetch_subscription(self, sub_id):
+        self._injector.apply("read")
+        return super()._fetch_subscription(sub_id)
+
+    def _list_subscriptions(self):
+        self._injector.apply("read")
+        return super()._list_subscriptions()
+
     # -- writes -------------------------------------------------------------
     def _insert_solution(self, data):
         self._injector.apply("write")
@@ -116,6 +124,17 @@ class _FaultyMixin(_InMemoryMixin):
     def _delete_checkpoint(self, job_id):
         self._injector.apply("write")
         return super()._delete_checkpoint(job_id)
+
+    def _upsert_subscription(self, sub_id, doc):
+        # a failed subscription write degrades the durable copy only —
+        # the manager's in-process doc keeps serving, and the next
+        # generation boundary rewrites the row
+        self._injector.apply("write")
+        return super()._upsert_subscription(sub_id, doc)
+
+    def _delete_subscription(self, sub_id):
+        self._injector.apply("write")
+        return super()._delete_subscription(sub_id)
 
 
 class FaultyDatabaseVRP(_FaultyMixin, DatabaseVRP):

@@ -45,6 +45,7 @@ _tables: dict = {  # guarded-by: _lock
     "replicas": {},
     "trace_spans": {},
     "checkpoints": {},
+    "subscriptions": {},
 }
 _tokens: dict = {}  # guarded-by: _lock
 _fixtures_loaded = False  # guarded-by: _fixtures_lock
@@ -62,6 +63,7 @@ def reset():
         _tables["replicas"].clear()
         _tables["trace_spans"].clear()
         _tables["checkpoints"].clear()
+        _tables["subscriptions"].clear()
         _tokens.clear()
     global _fixtures_loaded
     with _fixtures_lock:
@@ -266,6 +268,35 @@ class _InMemoryMixin(Database):
             table = _tables["checkpoints"]
             for key in [k for k in table if k[0] == str(job_id)]:
                 del table[key]
+
+    # -- standing subscriptions: bounded per-id control-plane docs ----------
+    # Same recency discipline as checkpoints: pop-to-refresh keeps
+    # insertion order equal to write recency, eviction drops the
+    # oldest-written doc (a standing fleet of thousands fits; an
+    # unbounded one is a leak, not a workload).
+    MAX_SUBSCRIPTIONS = 2048
+
+    def _fetch_subscription(self, sub_id):
+        with _lock:
+            row = _tables["subscriptions"].get(str(sub_id))
+            return None if row is None else dict(row)
+
+    def _list_subscriptions(self):
+        with _lock:
+            return [dict(row) for row in _tables["subscriptions"].values()]
+
+    def _upsert_subscription(self, sub_id, doc: dict):
+        with _lock:
+            table = _tables["subscriptions"]
+            key = str(sub_id)
+            table.pop(key, None)  # refresh insertion order
+            table[key] = {"id": key, "doc": doc}
+            while len(table) > self.MAX_SUBSCRIPTIONS:
+                table.pop(next(iter(table)))
+
+    def _delete_subscription(self, sub_id):
+        with _lock:
+            _tables["subscriptions"].pop(str(sub_id), None)
 
     def _upsert_warmstart(self, owner, name, state: dict):
         with _lock:

@@ -738,6 +738,26 @@ class _ResilientMixin(Database):
     def _delete_checkpoint(self, job_id):
         return self._cache_call("_delete_checkpoint", (job_id,))
 
+    # -- subscription primitives: same inverted policy ----------------------
+    # A subscription row is control-plane state whose safe answer is
+    # "none"/"unknown": the manager keeps serving from its in-process
+    # doc, a missed list delays cadence adoption one tick, and a
+    # dropped write is rewritten at the next generation boundary.
+    # Single attempt, no degraded-cache fallback, no journal spooling
+    # (subscription docs must not compete with job records for bounded
+    # journal slots); the per-call deadline and shared breaker apply.
+    def _fetch_subscription(self, sub_id):
+        return self._cache_call("_fetch_subscription", (sub_id,))
+
+    def _list_subscriptions(self):
+        return self._cache_call("_list_subscriptions", ())
+
+    def _upsert_subscription(self, sub_id, doc):
+        return self._cache_call("_upsert_subscription", (sub_id, doc))
+
+    def _delete_subscription(self, sub_id):
+        return self._cache_call("_delete_subscription", (sub_id,))
+
     def _put_trace_rows(self, rows):
         return self._cache_call("_put_trace_rows", (rows,))
 

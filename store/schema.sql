@@ -211,6 +211,24 @@ create table if not exists solve_checkpoints (
 create index if not exists solve_checkpoints_updated_at
   on solve_checkpoints (updated_at);
 
+-- Standing subscriptions (service/subscriptions.py): one row per
+-- subscription holding its durable control-plane doc — the base
+-- request content, cadence, generation counter, lineage tail, and
+-- last launched job id. Rewritten at every generation boundary
+-- (updated_at rides the payload, like the solution cache, so it
+-- tracks write recency, not insert time). Any replica lists the
+-- table to adopt due cadences after a drain or crash; DELETE
+-- /api/subscriptions/{id} removes the row. No retention sweep — a
+-- subscription lives until deleted (the in-memory backend bounds
+-- itself at store.memory MAX_SUBSCRIPTIONS).
+create table if not exists subscriptions (
+  id text primary key,              -- upsert: on_conflict="id"
+  doc jsonb not null,               -- {id, content, problem, algorithm,
+                                    --  resolveEvery?, generation,
+                                    --  lastJobId, lineage, ...}
+  updated_at timestamptz not null default now()
+);
+
 -- Belt-and-braces stale-lease sweep: reclaim normally happens in every
 -- replica's scan loop, but if ALL replicas die mid-lease the entries
 -- sit leased until one comes back. A pg_cron job returns them to the

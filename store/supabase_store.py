@@ -228,6 +228,51 @@ class _SupabaseMixin(Database):
             .execute()
         )
 
+    def _fetch_subscription(self, sub_id):
+        result = (
+            self.client.table("subscriptions")
+            .select("id,doc")
+            .eq("id", sub_id)
+            .limit(1)
+            .execute()
+        )
+        return result.data[0] if result.data else None
+
+    def _list_subscriptions(self):
+        result = (
+            self.client.table("subscriptions")
+            .select("id,doc")
+            .execute()
+        )
+        return list(result.data)
+
+    def _upsert_subscription(self, sub_id, doc: dict):
+        # updated_at rides the payload (the solution-cache rule): the
+        # column default fires on INSERT only, and a long-lived
+        # subscription's doc is rewritten at every generation boundary
+        from datetime import datetime, timezone
+
+        return (
+            self.client.table("subscriptions")
+            .upsert(
+                {
+                    "id": sub_id,
+                    "doc": doc,
+                    "updated_at": datetime.now(timezone.utc).isoformat(),
+                },
+                on_conflict="id",
+            )
+            .execute()
+        )
+
+    def _delete_subscription(self, sub_id):
+        return (
+            self.client.table("subscriptions")
+            .delete()
+            .eq("id", sub_id)
+            .execute()
+        )
+
     def _upsert_cached_solution(self, key, family, entry: dict):
         # updated_at must ride the payload: the column default fires on
         # INSERT only, and recency ordering + the documented retention

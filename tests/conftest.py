@@ -135,6 +135,14 @@ _SLOW_PATTERNS = (
     "test_sa_delta_tw.py::TestTwDeltaKernel::test_metropolis_never_accepts_worse_at_zero_temp",
     "test_sa_delta_tw.py::TestTwDeltaKernel::test_uniform_window_without_knn",
     "test_sa_delta_tw.py::TestSolveSaDeltaTw::test_solve_level_driver",
+    # standing-subscription end-to-end layers: real generation solves,
+    # SSE replay, crash-resume, and the off-switch byte-identity pair
+    # (compose/store/contract/quota/adoption units stay quick;
+    # tier1.yml runs the file in full)
+    "test_subscriptions.py::TestGenerationsE2E",
+    "test_subscriptions.py::TestStreamSSE",
+    "test_subscriptions.py::TestResumeHandoff",
+    "test_subscriptions.py::TestOffGuard",
 )
 
 

@@ -193,6 +193,23 @@ REGISTRY: dict[str, Var] = {
            "Minimum interval between checkpoint captures of one job's "
            "incumbent (bounded cadence: solves shorter than this never "
            "pay a checkpoint write)."),
+        # -- standing subscriptions ------------------------------------
+        _v("VRPMS_SUBS", "switch", True,
+           "Standing subscriptions: POST /api/subscriptions creates a "
+           "durable re-solve-on-change entity that launches a warm-"
+           "seeded generation per coalesced delta burst (or on its "
+           "resolveEvery cadence), with lineage in records and trace "
+           "roots. Off = the subscription routes 404 and every pre-"
+           "subscription response stays byte-identical."),
+        _v("VRPMS_SUB_DEBOUNCE_MS", "float", 250.0,
+           "Delta debounce window per subscription: a burst of deltas "
+           "arriving within this window coalesces into ONE re-solve "
+           "generation (counted in vrpms_sub_coalesced_total); 0 "
+           "launches a generation per delta."),
+        _v("VRPMS_SUB_MAX_PER_TENANT", "int", 0,
+           "Max standing subscriptions one tenant may hold (QoS "
+           "fairness for the control plane, next to the per-tenant "
+           "job quota); 0 = unlimited."),
         _v("VRPMS_RING_VNODES", "int", 64,
            "Virtual nodes per replica on the consistent-hash ring."),
         _v("VRPMS_LEASE_S", "float", 15.0,

@@ -75,6 +75,7 @@ KNOWN_SPAN_NAMES = frozenset({
     "qos.shed",         # a request shed by QoS policy (class + reason)
     "ckpt.write",       # one durable checkpoint write (background)
     "ckpt.resume",      # a requeued attempt seeded from a checkpoint
+    "sub.generation",   # one standing-subscription re-solve launch
     "read.federate",    # checkpoint-sourced incumbent overlay (non-owner)
     "read.relay",       # live-progress relay from the owning replica
     "store.read",       # table reads on the request path

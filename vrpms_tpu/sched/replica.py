@@ -74,6 +74,7 @@ class Replica:
         vnodes: int = 64,
         claim_batch: int = 0,
         info=None,
+        on_tick=None,
     ):
         self.store = store
         self.replica_id = replica_id
@@ -87,6 +88,13 @@ class Replica:
         # (GET /api/debug/fleet) see this replica's inflight/claim-mix/
         # warmed-tier state without any replica-to-replica RPC
         self._info = info
+        # optional per-heartbeat standing-work hook: () -> None, run on
+        # the claim-loop thread at the heartbeat cadence while NOT
+        # draining. The service wires the subscription manager's
+        # due-generation check here, so cadence re-solves and
+        # drain/crash adoptions fire on any replica that is alive —
+        # no dedicated timer infrastructure per standing entity.
+        self._on_tick = on_tick
         self.lease_s = max(0.05, float(lease_s))
         self.poll_s = max(0.005, float(poll_s))
         self.heartbeat_s = max(0.05, float(heartbeat_s))
@@ -325,6 +333,14 @@ class Replica:
                         # re-heartbeating would put its arcs back on
                         # the ring after drain() removed them
                         self._heartbeat()
+                        if self._on_tick is not None:
+                            # standing-work scheduling rides the same
+                            # beat (a draining replica fires nothing —
+                            # its durable state is a peer's to adopt)
+                            try:
+                                self._on_tick()
+                            except Exception:
+                                pass  # a broken hook must not stop the loop
                     self._next_heartbeat = now + self.heartbeat_s
                 if now >= self._next_reclaim:
                     self._reclaim()
