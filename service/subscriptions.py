@@ -89,6 +89,14 @@ LINEAGE_TAIL = 64
 #: solve request it wraps
 _SUB_KEYS = ("resolveEvery",)
 
+#: non-owner stream poll cadence: a federated watcher cannot park on
+#: the owner's generation condition, so it re-reads the store at this
+#: bounded interval instead of spinning on unthrottled lookups
+_REMOTE_POLL_S = 0.5
+
+#: minimum spacing between keep-alive frames on an idle stream
+_KEEPALIVE_S = 2.0
+
 
 def _compose_delta(cum: dict, new, errors: list) -> dict | None:
     """Compose a newly-posted delta onto an accumulated one (the
@@ -150,6 +158,42 @@ def _compose_delta(cum: dict, new, errors: list) -> dict | None:
     out_dem.update(demands)
     out_win = dict(cum.get("timeWindows") or {})
     out_win.update(windows)
+    out: dict = {}
+    if out_add:
+        out["add"] = out_add
+    if out_drop:
+        out["drop"] = out_drop
+    if out_dem:
+        out["demands"] = out_dem
+    if out_win:
+        out["timeWindows"] = out_win
+    return out
+
+
+def _merge_bursts(older: dict, newer: dict) -> dict:
+    """Fold a claimed-but-unlaunched firing burst back UNDER deltas
+    posted while the launch was in flight (the requeue path). Unlike
+    `_compose_delta` this merge is lenient about cross-burst repeats:
+    the newer burst was validated against an EMPTY pending slot, so a
+    re-add of an id the firing burst already adds is idempotent (one
+    add), not a contract violation — while add/drop pairs still net
+    out and newer attribute rewrites win."""
+    out_add = list(older.get("add") or [])
+    out_drop = list(older.get("drop") or [])
+    for cid in newer.get("add") or []:
+        if repr(cid) in {repr(c) for c in out_drop}:
+            out_drop = [c for c in out_drop if repr(c) != repr(cid)]
+        elif repr(cid) not in {repr(c) for c in out_add}:
+            out_add.append(cid)
+    for cid in newer.get("drop") or []:
+        if repr(cid) in {repr(c) for c in out_add}:
+            out_add = [c for c in out_add if repr(c) != repr(cid)]
+        elif repr(cid) not in {repr(c) for c in out_drop}:
+            out_drop.append(cid)
+    out_dem = dict(older.get("demands") or {})
+    out_dem.update(newer.get("demands") or {})
+    out_win = dict(older.get("timeWindows") or {})
+    out_win.update(newer.get("timeWindows") or {})
     out: dict = {}
     if out_add:
         out["add"] = out_add
@@ -304,6 +348,8 @@ class SubscriptionManager:
             "pending": None,
             "pendingCount": 0,
             "pendingAt": None,
+            "firing": None,
+            "firingCount": 0,
             "lineage": [],
             "status": "active",
             "replicaId": jobs_mod.replica_id(),
@@ -342,6 +388,11 @@ class SubscriptionManager:
             return 404, _not_found(sub_id)
         errors: list = []
         with self._lock:
+            if self._subs.get(sub_id) is not sub:
+                # deleted (or superseded) between the registry read
+                # above and here: composing into the stale doc would
+                # persist a row the delete just dropped
+                return 404, _not_found(sub_id)
             doc = sub.doc
             pending = _compose_delta(doc.get("pending") or {}, delta, errors)
             if pending is None:
@@ -361,7 +412,9 @@ class SubscriptionManager:
                 # a continuous stream still fires every window
                 sub.fire_at = time.monotonic() + debounce_s()
             count = doc["pendingCount"]
-        _db().put_subscription(sub_id, sub.doc)
+            # persist under the lock: a concurrent DELETE must not see
+            # this write resurrect the row it just dropped
+            _db().put_subscription(sub_id, doc)
         self._ensure_thread()
         self._wake.set()
         log_event(
@@ -381,14 +434,22 @@ class SubscriptionManager:
             sub = self._subs.get(sub_id)
             if sub is not None:
                 return dict(sub.doc)
-        return _db().get_subscription(sub_id)
+        doc = _db().get_subscription(sub_id)
+        if doc is not None and doc.get("status") == "deleted":
+            return None  # tombstone of a delete the store couldn't drop
+        return doc
 
     def delete(self, sub_id: str) -> tuple[int, dict]:
         with self._lock:
             sub = self._subs.pop(sub_id, None)
+            if sub is not None:
+                # mark the live doc too: an in-flight holder of this
+                # reference (post_delta between its lock blocks) must
+                # not persist the row back after the store drop below
+                sub.doc["status"] = "deleted"
             self._gen.notify_all()  # stream waiters re-check existence
         doc = sub.doc if sub is not None else _db().get_subscription(sub_id)
-        if doc is None:
+        if doc is None or (sub is None and doc.get("status") == "deleted"):
             return 404, _not_found(sub_id)
         # cooperative cancel of an in-flight generation (the PR-7
         # cancel flag): the job runs to its cancelled terminal record,
@@ -410,19 +471,41 @@ class SubscriptionManager:
                 log_event(
                     "job.cancel_requested", jobId=job_id, via="subscription"
                 )
-        _db().delete_subscription(sub_id)
+        degraded = False
+        if not _db().delete_subscription(sub_id):
+            # the row survived a failed store delete — and the sub is
+            # already out of the local registry, so without a marker
+            # any replica's adoption sweep would resurrect it. Write a
+            # status tombstone (every read/adopt path skips those and
+            # the sweep retries the hard delete); if even that write
+            # fails, tell the client the delete may not stick
+            # fleet-wide.
+            tomb = dict(
+                doc,
+                status="deleted",
+                pending=None,
+                pendingCount=0,
+                firing=None,
+                firingCount=0,
+                updatedAt=time.time(),
+            )
+            degraded = not _db().put_subscription(sub_id, tomb)
         log_event(
             "sub.deleted",
             subscriptionId=sub_id,
             cancelRequested=cancel_requested,
             generation=doc.get("generation"),
+            degraded=degraded,
         )
-        return 200, {
+        body = {
             "success": True,
             "subscriptionId": sub_id,
             "status": "deleted",
             "cancelRequested": cancel_requested,
         }
+        if degraded:
+            body["degraded"] = True
+        return 200, body
 
     def list(self) -> tuple[int, dict]:
         rows = _db().list_subscriptions()
@@ -433,7 +516,11 @@ class SubscriptionManager:
         body = {
             "success": True,
             "subscriptions": sorted(
-                (public_view(d) for d in rows),
+                (
+                    public_view(d)
+                    for d in rows
+                    if d.get("status") != "deleted"
+                ),
                 key=lambda v: v.get("createdAt") or 0,
             ),
         }
@@ -456,11 +543,17 @@ class SubscriptionManager:
         due: list[tuple[str, str]] = []
         with self._lock:
             for sub_id, sub in self._subs.items():
+                # claim the deadline while the lock is held: run_due is
+                # entered concurrently (worker thread + replica
+                # heartbeat), and a deadline left armed here would let
+                # both collectors launch two generations from one burst
                 if sub.fire_at is not None and now >= sub.fire_at:
+                    sub.fire_at = None
                     due.append((
                         sub_id, "resume" if sub.resume_pending else "delta"
                     ))
                 elif sub.cadence_at is not None and now >= sub.cadence_at:
+                    sub.cadence_at = None
                     due.append((sub_id, "cadence"))
         for sub_id, trigger in due:
             if self._halt.is_set():
@@ -473,6 +566,22 @@ class SubscriptionManager:
                     subscriptionId=sub_id,
                     error=f"{type(e).__name__}: {e}",
                 )
+                with self._lock:
+                    sub = self._subs.get(sub_id)
+                    if sub is None:
+                        continue
+                    # the claimed deadline must not die with the
+                    # exception: put the burst back and re-arm
+                    self._requeue(sub)
+                    if (
+                        trigger == "cadence"
+                        and sub.cadence_at is None
+                        and sub.doc.get("resolveEvery")
+                    ):
+                        sub.cadence_at = (
+                            time.monotonic()
+                            + float(sub.doc["resolveEvery"])
+                        )
 
     def wait_generation(self, sub_id: str, seen_gen: int,
                         timeout: float) -> dict | None:
@@ -498,6 +607,7 @@ class SubscriptionManager:
             count = len(self._subs)
             backlog = sum(
                 int(s.doc.get("pendingCount") or 0)
+                + int(s.doc.get("firingCount") or 0)
                 for s in self._subs.values()
             )
             newest = None
@@ -522,14 +632,18 @@ class SubscriptionManager:
             # count what this process knows instead
             with self._lock:
                 rows = [s.doc for s in self._subs.values()]
-        return sum(1 for d in rows if d.get("tenant") == tenant)
+        return sum(
+            1
+            for d in rows
+            if d.get("tenant") == tenant and d.get("status") != "deleted"
+        )
 
     def _adopt_from_store(self, sub_id: str) -> _Sub | None:
         """Adopt one doc on touch (delta posted to a replica that has
         never seen it — restart, or fleet routing): the toucher becomes
         the owner, re-arming cadence from now."""
         doc = _db().get_subscription(sub_id)
-        if doc is None:
+        if doc is None or doc.get("status") == "deleted":
             return None
         return self._adopt(doc)
 
@@ -538,6 +652,20 @@ class SubscriptionManager:
             sub = self._subs.get(doc["id"])
             if sub is not None:
                 return sub
+            if doc.get("firing"):
+                # the previous owner died between claiming a burst into
+                # the firing slot and completing the launch: fold the
+                # claim back under any later-posted pending so the
+                # resume generation still carries it
+                doc["pending"] = _merge_bursts(
+                    doc["firing"], doc.get("pending") or {}
+                )
+                doc["pendingCount"] = (
+                    int(doc.get("firingCount") or 0)
+                    + int(doc.get("pendingCount") or 0)
+                )
+                doc["firing"] = None
+                doc["firingCount"] = 0
             sub = _Sub(doc)
             self._subs[doc["id"]] = sub
             if doc.get("resolveEvery"):
@@ -570,6 +698,11 @@ class SubscriptionManager:
             if ring is not None:
                 members = set(ring.members)
         for doc in rows:
+            if doc.get("status") == "deleted":
+                # tombstone of a delete whose hard drop failed: never
+                # resurrect it — retry the drop as sweep hygiene
+                _db().delete_subscription(doc.get("id"))
+                continue
             with self._lock:
                 if doc.get("id") in self._subs:
                     continue
@@ -596,12 +729,34 @@ class SubscriptionManager:
                 # fire nothing into a draining replica: the doc (with
                 # its pending delta) is already durable — stop the
                 # timers so a peer's adoption sweep takes over
+                self._requeue(sub, persist=False)
                 sub.fire_at = None
                 sub.cadence_at = None
                 return
+            if doc.get("firing"):
+                # leftover claim from a fire that died mid-launch:
+                # fold it back before claiming the current burst
+                self._requeue(sub, persist=False)
+            if doc.get("pending") is None and trigger != "cadence":
+                # spurious wake: the burst was consumed or requeued by
+                # a competing path already — nothing to fire
+                sub.resume_pending = False
+                return
+            # claim the burst into the firing slot: doc['pending'] is
+            # free again, so a delta posted while this launch is in
+            # flight opens a NEW debounce window (post_delta sees it
+            # as the first of a burst and arms fire_at) instead of
+            # composing into state the completion path clears
+            firing = doc.get("pending")
+            firing_count = int(doc.get("pendingCount") or 0)
+            doc["firing"] = firing
+            doc["firingCount"] = firing_count
+            doc["pending"] = None
+            doc["pendingCount"] = 0
+            doc["pendingAt"] = None
             errors: list = []
             effective = _compose_delta(
-                doc.get("delta") or {}, doc.get("pending") or {}, errors
+                doc.get("delta") or {}, firing or {}, errors
             )
             if effective is None:
                 # the pending burst conflicts with the accumulated
@@ -611,7 +766,7 @@ class SubscriptionManager:
                 return
             last_id = doc.get("lastJobId")
             generation = int(doc.get("generation") or 0)
-            pending_count = int(doc.get("pendingCount") or 0)
+            pending_count = firing_count
             sub.fire_at = None
             sub.resume_pending = False
             if trigger == "cadence" and doc.get("resolveEvery"):
@@ -626,6 +781,7 @@ class SubscriptionManager:
             if trigger == "cadence":
                 with self._lock:
                     if sub_id in self._subs:
+                        self._requeue(sub)
                         sub.cadence_at = time.monotonic() + 0.25
                 return
             if live.sink is not None:
@@ -634,6 +790,7 @@ class SubscriptionManager:
             if not live.done_event.is_set():
                 with self._lock:
                     if sub_id in self._subs:
+                        self._requeue(sub)
                         sub.fire_at = (
                             time.monotonic() + max(debounce_s(), 0.25)
                         )
@@ -734,9 +891,12 @@ class SubscriptionManager:
                 doc["lastJobId"] = job_id
                 doc["lastFingerprint"] = fingerprint
                 doc["delta"] = effective or None
-                doc["pending"] = None
-                doc["pendingCount"] = 0
-                doc["pendingAt"] = None
+                # only the CLAIMED burst is consumed: doc['pending']
+                # may hold deltas posted mid-launch whose debounce
+                # timer is already armed — they fire the next
+                # generation, never silently cleared here
+                doc["firing"] = None
+                doc["firingCount"] = 0
                 doc["lastError"] = None
                 doc["updatedAt"] = time.time()
                 lineage = list(doc.get("lineage") or [])
@@ -749,14 +909,27 @@ class SubscriptionManager:
                 })
                 doc["lineage"] = lineage[-LINEAGE_TAIL:]
                 self._gen.notify_all()
-            _db().put_subscription(sub_id, doc)
+                # persist under the lock (the _absorb idiom): a DELETE
+                # landing after the membership check above must not see
+                # its store row resurrected by this write
+                _db().put_subscription(sub_id, doc)
         elif code in (429, 503):
-            # backpressure: the burst stays pending and retries after
-            # another debounce window — never dropped, never doubled
+            # backpressure: the claimed burst goes back to pending and
+            # retries after another debounce window — never dropped,
+            # never doubled
             with self._lock:
                 if sub_id in self._subs:
-                    sub.fire_at = time.monotonic() + max(debounce_s(), 0.25)
                     doc["lastError"] = body.get("errors")
+                    self._requeue(sub)
+                    if trigger == "cadence" and doc.get("resolveEvery"):
+                        sub.cadence_at = min(
+                            sub.cadence_at or float("inf"),
+                            time.monotonic() + max(debounce_s(), 0.25),
+                        )
+                    else:
+                        sub.fire_at = (
+                            time.monotonic() + max(debounce_s(), 0.25)
+                        )
             self._wake.set()
         else:
             with self._lock:
@@ -771,17 +944,47 @@ class SubscriptionManager:
             )
 
     def _absorb(self, sub: _Sub, delta, errors=None) -> None:
-        """Clear the pending burst (folding `delta` in as the new
-        cumulative) without a launch; caller holds the lock."""
+        """Finish a CLAIMED burst without a launch (poison, no-op
+        dedupe, hard submit rejection): fold `delta` in as the new
+        cumulative and drop the firing slot. doc['pending'] is not
+        touched — it may hold deltas posted while the claim was in
+        flight, and their debounce timer is already armed. Caller
+        holds the lock."""
         doc = sub.doc
         doc["delta"] = delta
-        doc["pending"] = None
-        doc["pendingCount"] = 0
-        doc["pendingAt"] = None
+        doc["firing"] = None
+        doc["firingCount"] = 0
         if errors:
             doc["lastError"] = errors
         doc["updatedAt"] = time.time()
         _db().put_subscription(doc["id"], doc)
+
+    def _requeue(self, sub: _Sub, persist: bool = True) -> None:
+        """Fold a claimed-but-unlaunched firing burst back into
+        doc['pending'] — UNDER any deltas posted while the launch was
+        in flight — and re-arm its debounce timer, so the retry and
+        crash-recovery paths never drop a claimed burst. Caller holds
+        the lock."""
+        doc = sub.doc
+        firing = doc.get("firing")
+        if firing is None and not doc.get("firingCount"):
+            return
+        if firing is not None:
+            doc["pending"] = _merge_bursts(
+                firing, doc.get("pending") or {}
+            )
+            doc["pendingCount"] = (
+                int(doc.get("firingCount") or 0)
+                + int(doc.get("pendingCount") or 0)
+            )
+            doc["pendingAt"] = doc.get("pendingAt") or time.time()
+        doc["firing"] = None
+        doc["firingCount"] = 0
+        doc["updatedAt"] = time.time()
+        if doc.get("pending") is not None and sub.fire_at is None:
+            sub.fire_at = time.monotonic() + max(debounce_s(), 0.25)
+        if persist:
+            _db().put_subscription(doc["id"], doc)
 
 
 def public_view(doc: dict) -> dict:
@@ -1026,6 +1229,7 @@ class SubscriptionStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
         db = _db()
         seen_gen = last_gen
         last_block = -1
+        last_beat = time.monotonic()
         while time.monotonic() < deadline:
             if doc is None:
                 self._emit("deleted", {"subscriptionId": sub_id})
@@ -1067,11 +1271,21 @@ class SubscriptionStreamHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             )
             if fresh is None:
                 # deleted while parked — or simply not registered on
-                # this replica: re-read the store before concluding
+                # this replica. wait_generation cannot park on a sub
+                # this replica does not own, so sleep a bounded
+                # interval before re-reading the store: a federated
+                # watcher polls at _REMOTE_POLL_S, never spins
+                time.sleep(min(
+                    _REMOTE_POLL_S,
+                    max(0.0, deadline - time.monotonic()),
+                ))
                 fresh = mgr.lookup(sub_id)
             doc = fresh
             if doc is not None and int(doc.get("generation") or 0) <= seen_gen:
-                self._emit("keep-alive", {"generation": seen_gen})
+                now = time.monotonic()
+                if now - last_beat >= _KEEPALIVE_S:
+                    self._emit("keep-alive", {"generation": seen_gen})
+                    last_beat = now
         self._emit("timeout", {
             "subscriptionId": sub_id, "generation": seen_gen,
         })

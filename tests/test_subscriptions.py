@@ -305,6 +305,62 @@ class TestContracts:
         row = store.get_database("vrp", None).get_subscription(sid)
         assert row["pending"] == {"add": [3], "drop": [4]}
 
+    def test_concurrent_due_sweeps_fire_once(self, monkeypatch):
+        # run_due is entered from BOTH the worker thread and the
+        # replica heartbeat: the due-collection must claim the
+        # deadline under the lock, or one burst launches twice
+        monkeypatch.setenv("VRPMS_SUB_DEBOUNCE_MS", "60000")
+        _seed_dataset("subc6", 8)
+        mgr = subs_mod.manager()
+        _, body = mgr.create(_sub_content("subc6", 8))
+        sid = body["subscriptionId"]
+        mgr.post_delta(sid, {"add": [3]})
+        fired: list = []
+        monkeypatch.setattr(
+            mgr, "_fire", lambda s, trigger: fired.append((s, trigger))
+        )
+        with mgr._lock:
+            mgr._subs[sid].fire_at = time.monotonic() - 1.0  # due now
+        mgr.run_due()  # the worker sweep claims the deadline...
+        mgr.run_due()  # ...so the heartbeat sweep finds nothing due
+        assert fired == [(sid, "delta")]
+
+    def test_failed_store_delete_leaves_tombstone_not_zombie(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_SUB_DEBOUNCE_MS", "60000")
+        _seed_dataset("subc7", 8)
+        mgr = subs_mod.manager()
+        _, body = mgr.create(_sub_content("subc7", 8))
+        sid = body["subscriptionId"]
+        db = store.get_database("vrp", None)
+        real_delete = type(db).delete_subscription
+        failing = {"on": True}
+        monkeypatch.setattr(
+            type(db),
+            "delete_subscription",
+            lambda self, s: (
+                False if failing["on"] else real_delete(self, s)
+            ),
+        )
+        code, body = mgr.delete(sid)
+        assert code == 200 and body["status"] == "deleted"
+        assert "degraded" not in body  # the tombstone write stuck
+        # the tombstone hides the row from every read surface
+        assert mgr.lookup(sid) is None
+        _, lst = mgr.list()
+        assert sid not in {
+            v["subscriptionId"] for v in lst["subscriptions"]
+        }
+        assert mgr.post_delta(sid, {"add": [3]})[0] == 404
+        # the adoption sweep must NOT resurrect the deleted sub
+        mgr.tick()
+        assert mgr.stats()["count"] == 0
+        # once the store delete works again the sweep drops the row
+        failing["on"] = False
+        mgr.tick()
+        assert db.get_subscription(sid) is None
+
     def test_delete_is_terminal_and_clears_store(self, monkeypatch):
         monkeypatch.setenv("VRPMS_SUB_DEBOUNCE_MS", "60000")
         _seed_dataset("subc5", 8)
@@ -522,17 +578,61 @@ class TestGenerationsE2E:
         # add 6 then drop 6: nets to the generation-1 instance exactly
         mgr.post_delta(sid, {"add": [6]})
         mgr.post_delta(sid, {"drop": [6]})
+        # one in-window coalesce + one fingerprint-dedupe absorb: wait
+        # on the METRIC — claiming the burst zeroes pendingCount before
+        # the dedupe decision, so the count alone races the absorb
         assert _wait(
-            lambda: mgr.lookup(sid)["pendingCount"] == 0, timeout=30
+            lambda: _metric("vrpms_sub_coalesced_total") >= coalesced + 2,
+            timeout=30,
         )
         doc = mgr.lookup(sid)
         assert doc["generation"] == 1  # ZERO new launches
+        assert doc["pendingCount"] == 0
         assert (
             _metric("vrpms_sub_generations_total", trigger="delta")
             == launches
         )
-        # one in-window coalesce + one fingerprint-dedupe absorb
         assert _metric("vrpms_sub_coalesced_total") == coalesced + 2
+
+    def test_delta_posted_mid_launch_is_not_lost(self, monkeypatch):
+        # a delta landing while a generation launch is in flight (after
+        # the burst is claimed, before the completion path runs) must
+        # open a NEW debounce window and fire its own generation — not
+        # be silently discarded when the in-flight launch clears state
+        monkeypatch.setenv("VRPMS_SUB_DEBOUNCE_MS", "50")
+        _seed_dataset("subml", 9)
+        mgr = subs_mod.manager()
+        _, body = mgr.create(
+            _sub_content("subml", 9, ignoredCustomers=[7, 8])
+        )
+        sid = body["subscriptionId"]
+        real_prep = subs_mod.prepare_request
+        posted: list = []
+
+        def prep_hook(*a, **k):
+            if not posted:
+                posted.append(True)
+                code, _ = mgr.post_delta(sid, {"add": [8]})
+                assert code == 202
+            return real_prep(*a, **k)
+
+        monkeypatch.setattr(subs_mod, "prepare_request", prep_hook)
+        mgr.post_delta(sid, {"add": [7]})
+        assert _wait_generation(sid, 2, timeout=120)
+        doc = mgr.lookup(sid)
+        assert _wait_job_done(doc["lastJobId"])
+        doc = mgr.lookup(sid)
+        assert doc["generation"] == 2 and doc["pendingCount"] == 0
+        # the second generation solved the mid-launch delta's world
+        rec = store.get_database("vrp", None).get_job(
+            doc["lastJobId"], []
+        )
+        served = sorted(
+            c
+            for v in rec["message"]["vehicles"]
+            for c in v["tour"][1:-1]
+        )
+        assert served == list(range(1, 9))
 
     def test_lineage_chain_in_records_timeline_and_traces(
         self, monkeypatch
@@ -701,6 +801,35 @@ class TestStreamSSE:
         shim = _StreamShim("nope")
         subs_mod.SubscriptionStreamHandler._stream(shim)
         assert b'"success": false' in shim.wfile.getvalue().lower()
+
+    def test_non_owner_watcher_polls_bounded_not_spinning(
+        self, monkeypatch
+    ):
+        # a store-only doc (owned by another replica) cannot park on
+        # this manager's generation condition: the stream must fall
+        # back to a BOUNDED store poll with rate-limited keep-alives,
+        # not a flat-out lookup/keep-alive spin until the timeout
+        monkeypatch.setenv("VRPMS_STREAM_TIMEOUT_S", "1.5")
+        store.get_database("vrp", None).put_subscription(
+            "remote-sub",
+            {
+                "id": "remote-sub",
+                "generation": 0,
+                "lineage": [],
+                "status": "active",
+                "replicaId": "some-other-replica",
+            },
+        )
+        shim = _StreamShim("remote-sub")
+        subs_mod.SubscriptionStreamHandler._stream(shim)
+        frames = _frames(shim)
+        assert frames[0]["event"] == "subscription"
+        assert frames[-1]["event"] == "timeout"
+        beats = [f for f in frames if f["event"] == "keep-alive"]
+        # 1.5s of idle non-owner watching: a handful of polls, at most
+        # one keep-alive — the un-throttled loop emitted thousands
+        assert len(beats) <= 2, len(beats)
+        assert len(frames) <= 6, frames
 
 
 # ---------------------------------------------------------------------------
