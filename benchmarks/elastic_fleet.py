@@ -1,0 +1,758 @@
+"""Elastic-fleet benchmark: demand ramp, zero-loss scale-in, churn
+compiles, and controller overhead (the ISSUE-18 acceptance gate).
+
+Four phases against an RTT-shimmed store-backed queue (the hosted
+store's real per-op cost, the batched_claims convention):
+
+  ramp — a 1 -> 4 -> 1 replica ramp driven by the controller itself:
+      a steady trickle holds the recommendation at 1; a backlog burst
+      raises it (scale-up is immediate) and an HPA-emulation loop adds
+      in-process peer replicas to match; the drained backlog drops it
+      back to 1 after cooldown. Gates: every sampled recommendation
+      sits at or above the QoS-feasible minimum for that sample's own
+      backlog (desired >= clamped raw — scale-up immediate, scale-down
+      damped), the burst reaches the cap, the final recommendation
+      returns to 1, and the desired series changes direction <= 3
+      times (1 -> 4 -> 1 is two reversals; hysteresis + cooldown must
+      not flap it).
+
+  scalein — POST /api/admin/scalein mid-backlog (forced self-victim:
+      in-process peers share this process's heartbeat doc, so relaying
+      to "them" would loop back here). The service replica checkpoint-
+      drains; peers finish everything. Gates: zero lost jobs, zero
+      burned attempts (every record attempt still 1 — voluntary
+      handoff, not a crash reclaim), every job completed exactly once
+      (acked-completion spy).
+
+  churn — post-churn cold compiles, in fresh SUBPROCESSES (in-process
+      replicas share one jit cache, so cold compiles are only
+      measurable with per-box isolation, the multi_replica
+      convention). A two-member ring loses a peer; the survivor's
+      inherited tier-ladder shapes come from the SAME
+      inherited_spec the churn watcher computes. Both scenarios prime
+      the shape-independent programs and measure a steady serving
+      window first; then "prewarmed" runs the churn-hardening warmup
+      for the inherited spec before serving the post-churn trace,
+      "cold" serves it straight. Gate: prewarmed post-churn serving
+      compiles <= 2x the steady-window compiles, AND strictly fewer
+      than the cold contrast (no vacuous pass).
+
+  overhead — same-seed paired on/off 2-job blocks, finely interleaved
+      (VRPMS_AUTOSCALE toggled per block, alternating order, an HPA
+      poller hitting /api/debug/fleet at 4 Hz in BOTH arms): median
+      paired delta of solve wall-clock < 1%. The fixed-seed
+      byte-identity contract is tests/test_autoscale.py's job, not a
+      timing bench's.
+
+In-process note: peer joins during the ramp churn the ring, and the
+REAL churn watcher fires; its background warmup is intercepted at the
+_launch_warmup seam (launch count recorded) because in-process
+compiles would land inside the ramp's measurement window — the honest
+compile accounting is exactly what the churn phase's subprocesses do.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.elastic_fleet \
+        [--rtt-ms 25] [--burst 20] [--scalein-jobs 10] [--pairs 96] \
+        [--skip-churn] [--out records/elastic_fleet_r22.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import statistics
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+#: burst/trickle instance: 7 locations pads to the 8-tier (one shape,
+#: one prewarmed program family — controller effects, not compile
+#: noise, are the measurement). 30k iterations makes a warm solve
+#: ~0.4s: heavy enough that the job-seconds EWMA times the burst depth
+#: unambiguously demands the cap, and that a 0.3s drain grace really
+#: exercises the checkpoint-nack handoff. (timeLimit would be the
+#: obvious knob, but it is an EDF budget that queue wait consumes — a
+#: 20-deep burst of timeLimit jobs would expire in queue.)
+TRACE_N = 7
+TRACE_ITERS = 30000
+TRACE_POP = 8
+
+#: the ramp cap: 1 -> CAP -> 1
+CAP = 4
+
+#: churn-phase priming size: pads to tier 48, which the child's
+#: steady/serve tier sets exclude (the multi_replica convention — the
+#: shape-independent once-per-process programs are deployment warmup's
+#: bill, not churn's)
+PRIME_N = 40
+
+#: the option profile the tier warmup compiles (service.warmup) — the
+#: churn child serves with the SAME profile so a prewarmed tier is a
+#: jit-cache hit by construction, exactly like post-warmup traffic
+WARM_OPTS = {
+    "population_size": None,
+    "iteration_count": 512,
+    "time_limit": 0.0,
+    "local_search": True,
+    "local_search_pool": 32,
+}
+
+
+class _RttStore:
+    """Every queue-store op behind a fixed round-trip delay. Unlike
+    batched_claims' explicit-method shim this delegates EVERYTHING
+    (replica_infos, depth_by_class, info-carrying heartbeats, nack
+    notes) — the elastic-fleet controller reads registry surfaces the
+    older benches never touched."""
+
+    def __init__(self, inner, rtt_s: float):
+        self._inner = inner
+        self._rtt = rtt_s
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def call(*args, **kw):
+            if self._rtt > 0:
+                time.sleep(self._rtt)
+            return attr(*args, **kw)
+
+        return call
+
+
+# ---------------------------------------------------------------------------
+# HTTP helpers (the multi_replica idiom)
+# ---------------------------------------------------------------------------
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=60) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _seed_store(n: int) -> None:
+    import numpy as np
+
+    import store.memory as mem
+
+    rng = np.random.default_rng(17)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        f"bench{n}",
+        [{"id": i, "demand": 2 if i else 0} for i in range(n)],
+    )
+    mem.seed_durations(f"bench{n}", d.tolist())
+
+
+def _body(n: int, seed: int) -> dict:
+    return {
+        "problem": "vrp", "algorithm": "sa",
+        "solutionName": f"elastic-{n}", "solutionDescription": "fleet",
+        "locationsKey": f"bench{n}", "durationsKey": f"bench{n}",
+        "capacities": [3 * n] * 3, "startTimes": [0, 0, 0],
+        "ignoredCustomers": [], "completedCustomers": [],
+        "seed": seed, "iterationCount": TRACE_ITERS,
+        "populationSize": TRACE_POP,
+    }
+
+
+def _wait_done(base, job_ids, timeout_s=300.0) -> dict:
+    """Poll every job to terminal; returns {jobId: record}."""
+    out = {}
+    deadline = time.monotonic() + timeout_s
+    pending = list(job_ids)
+    while pending and time.monotonic() < deadline:
+        still = []
+        for jid in pending:
+            _, r = _get(base, f"/api/jobs/{jid}")
+            if r["job"]["status"] in ("done", "failed"):
+                out[jid] = r["job"]
+            else:
+                still.append(jid)
+        pending = still
+        if pending:
+            time.sleep(0.05)
+    for jid in pending:
+        out[jid] = {"status": "timeout"}
+    return out
+
+
+def _direction_changes(series) -> int:
+    moves = [b - a for a, b in zip(series, series[1:]) if b != a]
+    return sum(
+        1 for a, b in zip(moves, moves[1:]) if (a > 0) != (b > 0)
+    ) + (1 if moves else 0)
+
+
+# ---------------------------------------------------------------------------
+# churn child: one fresh process = one replica's post-churn compile bill
+# ---------------------------------------------------------------------------
+
+
+def _churn_child(spec_json: str) -> None:
+    cfg = json.loads(spec_json)
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    from vrpms_tpu.obs import compile as cobs
+
+    cobs.install()
+    from service.solve import _run_solver
+    from vrpms_tpu.core import tiers
+    from vrpms_tpu.io.synth import synth_cvrp
+
+    def solve(n, v, seed):
+        inst = tiers.maybe_pad(synth_cvrp(n, v, seed=seed))
+        errors: list = []
+        _run_solver(
+            inst, "sa", dict(WARM_OPTS, seed=seed), {}, errors, "vrp",
+            None,
+        )
+        if errors:
+            print(json.dumps({"error": errors}), flush=True)
+            raise SystemExit(1)
+
+    solve(PRIME_N, 3, 0)
+    prime_compiles, _ = cobs.snapshot()
+    # steady state: the tiers this replica owned pre-churn — first pass
+    # pays their compiles (deployment warmup's bill), the second pass
+    # IS the steady serving window
+    for i, (n, v) in enumerate(cfg["steady"]):
+        solve(n, v, 100 + i)
+    warm_compiles, _ = cobs.snapshot()
+    for i, (n, v) in enumerate(cfg["steady"]):
+        solve(n, v, 200 + i)
+    after_steady, _ = cobs.snapshot()
+    steady_compiles = after_steady - warm_compiles
+    # churn hardening (prewarmed scenario only): compile the inherited
+    # spec the watcher computed, exactly as the background thread would
+    warmup_compiles = 0
+    if cfg["mode"] == "prewarmed":
+        from service.warmup import warmup
+
+        warmup(cfg["spec"], ("sa",), log=False)
+        after_warm, _ = cobs.snapshot()
+        warmup_compiles = after_warm - after_steady
+    before_serve, _ = cobs.snapshot()
+    t0 = time.perf_counter()
+    # the post-churn serving window: traffic on the INHERITED tiers
+    for i, (n, v) in enumerate(cfg["serve"]):
+        solve(n, v, 300 + i)
+    serving_compiles = cobs.snapshot()[0] - before_serve
+    print(json.dumps({
+        "mode": cfg["mode"],
+        "primeCompiles": prime_compiles,
+        "steadyCompiles": steady_compiles,
+        "warmupCompiles": warmup_compiles,
+        "servingCompiles": serving_compiles,
+        "serveSeconds": round(time.perf_counter() - t0, 2),
+    }), flush=True)
+
+
+def _run_churn_child(cfg: dict) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "benchmarks.elastic_fleet",
+         "--churn-child", json.dumps(cfg)],
+        capture_output=True, text=True, env=env, timeout=1800,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"churn child failed: {out.stdout}\n{out.stderr}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def churn_phase() -> dict:
+    """Survivor inherits a dead peer's tier-ladder arcs: serving those
+    tiers after the watcher's pre-warm vs serving them cold."""
+    from service import autoscale as autoscale_mod
+    from service import warmup as warmup_mod
+    from vrpms_tpu.sched.ring import HashRing, slot
+
+    pairs = [
+        (shape, tok) for shape, tok in autoscale_mod.ladder_tokens()
+        if shape != "48x4"  # the prime tier stays out of both windows
+    ]
+    assert pairs, "tier ladder must be on"
+    svc = "replica-a"
+    # deterministic scan: a peer whose loss hands the survivor at least
+    # one ladder tier while it keeps at least one of its own
+    for i in range(50):
+        peer = f"peer-{i}"
+        prev, new = HashRing([svc, peer]), HashRing([svc])
+        inherited = [
+            s for s, t in pairs
+            if new.owner(slot(t)) == svc and prev.owner(slot(t)) != svc
+        ]
+        steady = [s for s, t in pairs if prev.owner(slot(t)) == svc]
+        if inherited and steady:
+            break
+    assert inherited and steady, "no peer split the ladder in 50 tries"
+    # the spec the watcher itself would compute for this churn
+    spec = autoscale_mod.inherited_spec(prev, new, svc)
+    assert sorted(spec.split(",")) == sorted(inherited), (spec, inherited)
+
+    def dims(shape):
+        n, v = warmup_mod.parse_shapes(shape)[0][:2]
+        return [n, v]
+
+    base_cfg = {
+        "spec": spec,
+        "steady": [dims(s) for s in steady],
+        "serve": [dims(s) for s in inherited],
+    }
+    print(f"== churn: survivor keeps {steady}, inherits {inherited}")
+    results = {}
+    for mode in ("prewarmed", "cold"):
+        results[mode] = _run_churn_child(dict(base_cfg, mode=mode))
+        print(f"   {mode}: {json.dumps(results[mode])}")
+    return {
+        "spec": spec,
+        "steadyTiers": steady,
+        "inheritedTiers": inherited,
+        "prewarmed": results["prewarmed"],
+        "cold": results["cold"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# fleet phases (one process, RTT-shimmed shared queue)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_sample(base) -> dict:
+    _, resp = _get(base, "/api/debug/fleet")
+    return resp["fleet"].get("autoscale") or {}
+
+
+def _spawn_peer(jobs_mod, i: int):
+    """An in-process peer replica with its own scheduler (the
+    one-replica-per-box model, multi_replica's harness)."""
+    from vrpms_tpu.sched import Scheduler
+
+    sched = Scheduler(
+        jobs_mod._runner,
+        queue_limit=64,
+        window_s=0.01,
+        max_batch=1,
+        on_event=jobs_mod._on_event,
+        watchdog_s=0,
+    )
+    rep = jobs_mod.build_replica(
+        f"peer-{i}", scheduler=sched,
+        lease_s=5.0, poll_s=0.01, heartbeat_s=0.25,
+    ).start()
+    rep._bench_sched = sched
+    return rep
+
+
+def _stop_peer(rep) -> None:
+    rep.stop()
+    rep._bench_sched.shutdown(timeout=2.0)
+
+
+def ramp_phase(base, jobs_mod, args, completions) -> tuple[dict, list]:
+    """steady-1 -> burst (HPA emulation grows peers to the
+    recommendation) -> drained -> back to 1."""
+    # steady trickle: the recommendation must sit at 1
+    steady_desired = []
+    for i in range(3):
+        status, resp = _post(base, "/api/jobs", _body(TRACE_N, 500 + i))
+        assert status == 202, resp
+        _wait_done(base, [resp["jobId"]])
+        steady_desired.append(_fleet_sample(base).get("desired"))
+    print(f"== ramp: steady desired {steady_desired}")
+
+    samples: list = []
+    stop = threading.Event()
+
+    def sampler():
+        t0 = time.monotonic()
+        while not stop.is_set():
+            try:
+                block = _fleet_sample(base)
+                samples.append({
+                    "t": round(time.monotonic() - t0, 3),
+                    "desired": block.get("desired"),
+                    "raw": block.get("raw"),
+                    "decision": block.get("decision"),
+                    "members": block.get("members"),
+                    "depth": block.get("depth"),
+                })
+            except Exception:
+                pass
+            time.sleep(0.15)
+
+    st = threading.Thread(target=sampler, daemon=True)
+    st.start()
+    time.sleep(0.5)  # a few pre-burst samples at desired=1
+
+    burst_ids = []
+    for i in range(args.burst):
+        status, resp = _post(base, "/api/jobs", _body(TRACE_N, 1000 + i))
+        assert status == 202, resp
+        burst_ids.append(resp["jobId"])
+
+    # HPA emulation: grow in-process peers toward the recommendation
+    peers: list = []
+    done = {}
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        desired = _fleet_sample(base).get("desired") or 1
+        while len(peers) < min(desired, CAP) - 1:
+            peers.append(_spawn_peer(jobs_mod, len(peers)))
+            print(f"   scale-up: peer-{len(peers) - 1} joins "
+                  f"(desired {desired})")
+        done = _wait_done(base, burst_ids, timeout_s=0.5)
+        if all(done[j]["status"] == "done" for j in burst_ids):
+            break
+    assert all(done[j]["status"] == "done" for j in burst_ids), done
+    # drained: the recommendation must return to 1 after cooldown
+    final_desired = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        final_desired = _fleet_sample(base).get("desired")
+        if final_desired == 1:
+            break
+        time.sleep(0.2)
+    time.sleep(0.4)  # tail samples at the settled value
+    stop.set()
+    st.join(timeout=5)
+
+    desired_series = [s["desired"] for s in samples if s["desired"]]
+    tracks = all(
+        s["desired"] >= min(s["raw"], CAP)
+        for s in samples
+        if s["desired"] and s["raw"]
+    )
+    records = [done[j] for j in burst_ids]
+    out = {
+        "steadyDesired": steady_desired,
+        "burstJobs": args.burst,
+        "done": sum(1 for r in records if r["status"] == "done"),
+        "maxDesired": max(desired_series),
+        "finalDesired": final_desired,
+        "directionChanges": _direction_changes(desired_series),
+        "tracksFeasibleMin": tracks,
+        "attemptsLeq1": all(
+            r.get("attempt") in (None, 1) for r in records
+        ),
+        "duplicateCompletions": sum(
+            1 for j in burst_ids if completions[j] > 1
+        ),
+        "peersSpawned": len(peers),
+        "samples": samples,
+    }
+    return out, peers
+
+
+def scalein_phase(base, jobs_mod, peers, args, completions) -> dict:
+    """Drain the service replica mid-backlog; peers finish the work."""
+    if not peers:
+        peers.append(_spawn_peer(jobs_mod, 0))
+    job_ids = []
+    for i in range(args.scalein_jobs):
+        status, resp = _post(base, "/api/jobs", _body(TRACE_N, 2000 + i))
+        assert status == 202, resp
+        job_ids.append(resp["jobId"])
+    time.sleep(0.4)  # let the service replica lease some of them
+    self_id = jobs_mod.replica_id()
+    status, resp = _post(
+        base, "/api/admin/scalein",
+        {"replicaId": self_id, "graceS": 0.3},
+    )
+    assert status == 202, resp
+    print(f"== scalein: victim {resp['scalein']['victim']} (local)")
+    # drain completes: leases finished within grace or checkpoint-
+    # nacked to the peers
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        _, d = _get(base, "/api/admin/drain")
+        if (d.get("drain") or {}).get("complete"):
+            break
+        time.sleep(0.1)
+    done = _wait_done(base, job_ids, timeout_s=180)
+    records = [done[j] for j in job_ids]
+    return {
+        "victim": resp["scalein"]["victim"],
+        "local": bool(resp["scalein"].get("local")),
+        "jobs": args.scalein_jobs,
+        "done": sum(1 for r in records if r["status"] == "done"),
+        "lost": sum(1 for r in records if r["status"] == "timeout"),
+        "requeued": (d.get("drain") or {}).get("requeued"),
+        "attemptsLeq1": all(
+            r.get("attempt") in (None, 1) for r in records
+        ),
+        "burnedAttempts": sum(
+            1 for r in records if (r.get("attempt") or 1) > 1
+        ),
+        "duplicateCompletions": sum(
+            1 for j in job_ids if completions[j] > 1
+        ),
+    }
+
+
+def overhead_phase(base, args) -> dict:
+    """Same-seed paired on/off micro-blocks, finely interleaved; an
+    HPA poller hits /api/debug/fleet at 4 Hz in BOTH arms. Host timing
+    on a shared box drifts in multi-second regimes (frequency,
+    placement) with ~5% fast jitter on top, so long per-arm rounds
+    alias a regime shift straight into the paired delta; instead each
+    pair runs a 2-job block per arm back-to-back (~2s window, drift
+    ~constant across it) with the SAME seeds in both arms (per-seed
+    local-search effort differs — identical data cancels it), and the
+    median over many pairs shrugs off the regime-boundary outliers.
+    Runs after the ramp peers scaled back in: one claim loop."""
+    stop = threading.Event()
+
+    def poller():
+        while not stop.is_set():
+            try:
+                _get(base, "/api/debug/fleet")
+            except Exception:
+                pass
+            time.sleep(0.25)
+
+    pt = threading.Thread(target=poller, daemon=True)
+    pt.start()
+
+    def block(seed0: int) -> float:
+        t0 = time.perf_counter()
+        ids = []
+        for i in range(args.block_jobs):
+            status, resp = _post(
+                base, "/api/jobs", _body(TRACE_N, seed0 + i)
+            )
+            assert status == 202, resp
+            ids.append(resp["jobId"])
+        done = _wait_done(base, ids, timeout_s=120)
+        assert all(done[j]["status"] == "done" for j in ids), done
+        return time.perf_counter() - t0
+
+    block(8000)
+    block(8100)  # warm both arms' steady state
+    deltas, on_total, off_total = [], 0.0, 0.0
+    for p in range(args.pairs):
+        order = ("off", "on") if p % 2 == 0 else ("on", "off")
+        t = {}
+        for arm in order:
+            os.environ["VRPMS_AUTOSCALE"] = arm
+            t[arm] = block(9000 + 10 * p)
+        deltas.append((t["on"] - t["off"]) / t["off"])
+        on_total += t["on"]
+        off_total += t["off"]
+    os.environ.pop("VRPMS_AUTOSCALE", None)
+    stop.set()
+    pt.join(timeout=5)
+    overhead_pct = 100.0 * statistics.median(deltas)
+    aggregate_pct = 100.0 * (on_total - off_total) / off_total
+    print(f"== overhead: on {on_total:.2f}s / off {off_total:.2f}s "
+          f"median {overhead_pct:+.2f}% aggregate {aggregate_pct:+.2f}%")
+    return {
+        "pairs": args.pairs,
+        "blockJobs": args.block_jobs,
+        "onSeconds": round(on_total, 3),
+        "offSeconds": round(off_total, 3),
+        "pairDeltasPct": [round(100 * d, 2) for d in deltas],
+        "aggregatePct": round(aggregate_pct, 3),
+        "overheadPct": round(overhead_pct, 3),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--churn-child", help=argparse.SUPPRESS)
+    ap.add_argument("--rtt-ms", type=float, default=25.0)
+    ap.add_argument("--burst", type=int, default=20)
+    ap.add_argument("--scalein-jobs", type=int, default=10)
+    ap.add_argument("--pairs", type=int, default=96)
+    ap.add_argument("--block-jobs", type=int, default=2)
+    ap.add_argument("--skip-churn", action="store_true")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--note", default=None)
+    args = ap.parse_args()
+    if args.churn_child:
+        _churn_child(args.churn_child)
+        return
+
+    os.environ["VRPMS_STORE"] = "memory"
+    # churn pre-warm rides the boot-warmup switch; serve() never acts
+    # on it (only the CLI does), so setting it here arms the watcher
+    # without paying a boot compile
+    os.environ["VRPMS_WARMUP"] = "tiers"
+    os.environ["VRPMS_QUEUE_POLL_MS"] = "10"
+    os.environ["VRPMS_RECLAIM_S"] = "0.5"
+    os.environ["VRPMS_LEASE_S"] = "5"
+    os.environ["VRPMS_HEARTBEAT_S"] = "0.25"
+    # one lease per replica: fleet size IS the concurrency knob, so
+    # the QoS-feasible minimum is directly actuator-visible
+    os.environ["VRPMS_QUEUE_MAX_INFLIGHT"] = "1"
+    # solo dispatch + cache off: no batch-shape compiles or cache hits
+    # inside measurement windows (the multi_replica convention)
+    os.environ["VRPMS_SCHED_MAX_BATCH"] = "1"
+    os.environ["VRPMS_CACHE"] = "off"
+    # a tight controller: headroom/cooldown sized so a ~20-job burst
+    # of subsecond solves walks the whole 1 -> 4 -> 1 ramp in seconds
+    os.environ["VRPMS_AUTOSCALE_HEADROOM_S"] = "2"
+    # long enough that EWMA drift under 4-way CPU contention cannot
+    # bounce a mid-burst down into an immediate re-up (flap guard)
+    os.environ["VRPMS_AUTOSCALE_COOLDOWN_S"] = "2.5"
+    os.environ["VRPMS_AUTOSCALE_MAX"] = str(CAP)
+    os.environ["VRPMS_DEPTH_MEMO_MS"] = "100"
+    _seed_store(TRACE_N)
+
+    import store
+    from store.memory import InMemoryJobQueue
+    from service import autoscale as autoscale_mod
+    from service import jobs as jobs_mod
+    from service.app import serve
+
+    rtt_s = args.rtt_ms / 1e3
+    store.get_queue_store = lambda: _RttStore(InMemoryJobQueue(), rtt_s)
+
+    # acked-completion spy: exactly-once evidence for the gates
+    completions: collections.Counter = collections.Counter()
+    real_complete = jobs_mod._dist_complete
+
+    def spy_complete(job, entry, acked):
+        if acked:
+            completions[job.id] += 1
+        return real_complete(job, entry, acked)
+
+    jobs_mod._dist_complete = spy_complete
+
+    # peer joins churn the ring and the REAL watcher fires; intercept
+    # its background warmup at the seam (see module docstring) —
+    # launches are still counted as evidence the watcher ran
+    churn_warm_launches: list = []
+    autoscale_mod._launch_warmup = churn_warm_launches.append
+
+    srv = serve(port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    os.environ["VRPMS_QUEUE"] = "store"
+    jobs_mod.get_replica()  # the service replica claims from boot
+
+    print("== prewarm: compiling the trace shape")
+    warm = []
+    for i in range(2):
+        status, resp = _post(base, "/api/jobs", _body(TRACE_N, 900 + i))
+        assert status == 202, resp
+        warm.append(resp["jobId"])
+    _wait_done(base, warm)
+
+    ramp, peers = ramp_phase(base, jobs_mod, args, completions)
+    print(json.dumps({k: v for k, v in ramp.items() if k != "samples"},
+                     indent=2))
+    # the ramp ended at desired 1: scale the peers back in before the
+    # timing phase (one claim loop = minimal jitter), scalein respawns
+    for rep in peers:
+        _stop_peer(rep)
+    peers = []
+    overhead = overhead_phase(base, args)
+    scalein = scalein_phase(base, jobs_mod, peers, args, completions)
+    print(json.dumps(scalein, indent=2))
+
+    for rep in peers:
+        _stop_peer(rep)
+    jobs_mod.shutdown_scheduler()
+    srv.shutdown()
+
+    churn = None if args.skip_churn else churn_phase()
+
+    gate = {
+        "rampTracksFeasibleMin": ramp["tracksFeasibleMin"],
+        "maxDesired": ramp["maxDesired"],
+        "cap": CAP,
+        "finalDesired": ramp["finalDesired"],
+        "directionChanges": ramp["directionChanges"],
+        "directionChangesMax": 3,
+        "jobsLost": scalein["lost"]
+        + (ramp["burstJobs"] - ramp["done"]),
+        "burnedAttempts": scalein["burnedAttempts"],
+        "duplicateCompletions": ramp["duplicateCompletions"]
+        + scalein["duplicateCompletions"],
+        "overheadPct": overhead["overheadPct"],
+        "overheadMax": 1.0,
+    }
+    checks = [
+        gate["rampTracksFeasibleMin"],
+        gate["maxDesired"] == CAP,
+        gate["finalDesired"] == 1,
+        gate["directionChanges"] <= gate["directionChangesMax"],
+        gate["jobsLost"] == 0,
+        gate["burnedAttempts"] == 0,
+        gate["duplicateCompletions"] == 0,
+        ramp["attemptsLeq1"] and scalein["attemptsLeq1"],
+        gate["overheadPct"] < gate["overheadMax"],
+    ]
+    if churn is not None:
+        gate["steadyCompiles"] = churn["prewarmed"]["steadyCompiles"]
+        gate["postChurnCompiles"] = churn["prewarmed"]["servingCompiles"]
+        gate["coldChurnCompiles"] = churn["cold"]["servingCompiles"]
+        checks.append(
+            gate["postChurnCompiles"] <= 2 * gate["steadyCompiles"]
+        )
+        # no vacuous pass: the hardening must beat the cold contrast
+        checks.append(
+            gate["coldChurnCompiles"] > gate["postChurnCompiles"]
+        )
+    gate["pass"] = all(checks)
+
+    record = {
+        "bench": "elastic_fleet",
+        "generatedAt": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "note": args.note,
+        "config": {
+            "rttMs": args.rtt_ms,
+            "burst": args.burst,
+            "scaleinJobs": args.scalein_jobs,
+            "pairs": args.pairs,
+            "blockJobs": args.block_jobs,
+            "traceN": TRACE_N,
+            "headroomS": 2.0,
+            "cooldownS": 2.5,
+            "cap": CAP,
+            "maxInflight": 1,
+        },
+        "ramp": ramp,
+        "scalein": scalein,
+        "churn": churn,
+        "overhead": overhead,
+        "churnWarmLaunchesDuringRamp": len(churn_warm_launches),
+        "gate": gate,
+    }
+    print(json.dumps({"gate": gate}, indent=2))
+    if args.out:
+        path = args.out
+        if not os.path.isabs(path):
+            path = os.path.join(os.path.dirname(__file__), path)
+        with open(path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        print(f"wrote {path}")
+    if not gate["pass"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

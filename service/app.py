@@ -40,6 +40,8 @@ from service.jobs import (
     ReadyHandler,
     shutdown_scheduler,
 )
+from service.autoscale import ScaleInHandler
+from service.autoscale import enabled as autoscale_enabled
 from service.subscriptions import (
     SubscriptionDeltasHandler,
     SubscriptionDetailHandler,
@@ -81,10 +83,15 @@ ROUTES = {
 # route's behavior shifts by a byte
 _SUB_ROUTES = {"/api/subscriptions": SubscriptionsHandler}
 
+# same contract for the elastic-fleet scale-in surface: registered for
+# route labels, VRPMS_AUTOSCALE consulted per request (off -> 404)
+_AUTOSCALE_ROUTES = {"/api/admin/scalein": ScaleInHandler}
+
 # the request counter's route label values come from the route table —
 # an arbitrary 404 path can never mint a new series (service.obs)
 obs.KNOWN_ROUTES.update(ROUTES)
 obs.KNOWN_ROUTES.update(_SUB_ROUTES)
+obs.KNOWN_ROUTES.update(_AUTOSCALE_ROUTES)
 
 
 class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
@@ -113,6 +120,11 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
         if cls is None and path.startswith("/api/debug/traces/"):
             # parameterized route: /api/debug/traces/{traceId}
             cls = TraceDetailHandler
+        if path == "/api/admin/scalein":
+            # elastic-fleet scale-in (VRPMS_AUTOSCALE-gated per request
+            # so a flip needs no restart; off -> plain 404, byte-
+            # identical to the pre-autoscale service)
+            cls = ScaleInHandler if autoscale_enabled() else None
         if path == "/api/subscriptions" or path.startswith(
             "/api/subscriptions/"
         ):

@@ -1,0 +1,501 @@
+"""Elastic-fleet wiring: the autoscale controller's service surfaces.
+
+The policy lives in :mod:`vrpms_tpu.sched.autoscale` (pure arithmetic,
+stdlib-only); this module feeds it the fleet's signals and exposes the
+three surfaces ISSUE 18 names:
+
+  * **recommendation** — :func:`observe` gathers shared depth (PR 11's
+    depth memo), per-class drain EWMAs (PR 12's QosPolicy), and the
+    stale-filtered live-member count (PR 14's heartbeat docs) through
+    the existing memoized fail-open read paths, folds them into the
+    controller, and publishes the result as the
+    ``vrpms_fleet_desired_replicas`` gauge and the ``autoscale`` block
+    on GET /api/debug/fleet. A store outage yields ``None`` inputs and
+    the controller freezes the last-known value marked ``degraded`` —
+    the solve path is never touched.
+  * **safe scale-in** — :class:`ScaleInHandler` (POST
+    /api/admin/scalein) picks the victim by claim-mix overlap (drain
+    the replica whose hot tiers the survivors already have warm) and
+    runs PR 15's checkpoint-drain against it: locally via
+    ``start_drain``, or relayed to the victim's advertised address.
+  * **churn hardening** — :func:`tick` (riding the replica heartbeat)
+    watches ring membership; when it changes (and VRPMS_WARMUP says
+    this deployment warms tiers), the tiers this replica newly owns
+    pre-warm on a background thread via PR 11's warmup, so post-churn
+    traffic meets warm caches instead of a compile storm.
+    The heartbeat hook itself never touches the store: recommendation
+    refreshes run on a dedicated observer thread, so the claim loop
+    pays nothing for the controller (the <1% solve-path budget).
+
+``VRPMS_AUTOSCALE=off`` removes all of it: no controller runs, the
+scalein route 404s, and every pre-autoscale response stays
+byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+import store
+from service import obs
+from service import jobs as jobs_mod
+from service.helpers import read_json_body, respond_json
+from vrpms_tpu import config
+from vrpms_tpu.obs import log_event, spans
+from vrpms_tpu.sched import autoscale as policy
+from vrpms_tpu.sched import qos as qos_mod
+
+enabled = policy.enabled
+
+_lock = threading.Lock()
+_controller: policy.Controller | None = None  # guarded-by: _lock
+_prev_ring = None  # guarded-by: _lock
+_last_scalein: dict | None = None  # guarded-by: _lock
+_ticker: threading.Thread | None = None  # guarded-by: _lock
+_ticker_stop: threading.Event | None = None  # guarded-by: _lock
+
+
+def controller() -> policy.Controller:
+    """The process controller singleton (hysteresis/cooldown state)."""
+    global _controller
+    with _lock:
+        if _controller is None:
+            _controller = policy.Controller()
+        return _controller
+
+
+def reset() -> None:
+    """Forget controller + churn state and stop the observer thread
+    (shutdown_scheduler calls this: a rebuilt service starts with fresh
+    cooldowns and no phantom previous ring)."""
+    global _controller, _prev_ring, _last_scalein, _ticker, _ticker_stop
+    with _lock:
+        _controller = None
+        _prev_ring = None
+        _last_scalein = None
+        if _ticker_stop is not None:
+            _ticker_stop.set()
+        _ticker = None
+        _ticker_stop = None
+
+
+# -- heartbeat-registry hygiene ---------------------------------------------
+
+
+def split_stale(members, infos, now=None) -> tuple[list, list]:
+    """Partition a membership snapshot into (live, stale) replica ids:
+    a member is STALE when its status doc's ``updatedAt`` is older than
+    the lease window (VRPMS_LEASE_S) — a crashed replica whose
+    heartbeat row has not yet TTL-expired must not inflate the live
+    count or the fleet aggregates. Members without a doc (or a doc
+    without a timestamp) count live: absence of evidence must not
+    shrink the fleet."""
+    now = time.time() if now is None else now
+    window = max(0.0, float(config.get("VRPMS_LEASE_S")))
+    live, stale = [], []
+    for rid in members:
+        doc = (infos or {}).get(rid) or {}
+        at = doc.get("updatedAt")
+        if window > 0 and isinstance(at, (int, float)) and now - at > window:
+            stale.append(rid)
+        else:
+            live.append(rid)
+    return live, stale
+
+
+# -- recommendation ---------------------------------------------------------
+
+
+def _gather() -> dict | None:
+    """The controller's input bundle, every field through an existing
+    memoized/fail-open read: shared depth + class split (the depth
+    memo), membership + docs (the fleet memo, stale-filtered), drain
+    EWMAs (QosPolicy, in-process). None = the store is unreadable and
+    no fresh memo exists — the controller must freeze, not guess."""
+    per = max(1, int(config.get("VRPMS_QUEUE_MAX_INFLIGHT")))
+    job_seconds = 1.0
+    if jobs_mod.dist_queue_enabled():
+        rep = jobs_mod._replica  # peek — observing must not start a loop
+        try:
+            qs = rep.store if rep is not None else store.get_queue_store()
+        except Exception:
+            return None
+        depth = jobs_mod._shared_depth(qs)
+        if depth is None:
+            return None
+        classes = jobs_mod._shared_class_depths(qs)
+        members = 1
+        fleet = jobs_mod._fleet_infos(qs)
+        if fleet is not None:
+            live, _stale = split_stale(fleet[0], fleet[1])
+            members = max(1, len(live))
+        elif rep is not None and rep.ring() is not None:
+            # registry unreadable but depth memo fresh: the cached ring
+            # is the best live-membership estimate (display-only — the
+            # desired count depends on backlog, not member count)
+            members = max(1, len(rep.ring().members))
+        if rep is not None:
+            job_seconds = rep.job_seconds_ewma()
+    else:
+        # local queue: a fleet of one, but the recommendation still
+        # tells an operator when one box stops being enough
+        s = jobs_mod._scheduler
+        depth = sum(s.queues().values()) if s is not None else 0
+        classes = None
+        if s is not None and jobs_mod.qos_enabled():
+            try:
+                classes = {}
+                for depths in s.queues_by_class().values():
+                    for cls, n in depths.items():
+                        classes[cls] = classes.get(cls, 0) + n
+            except Exception:
+                classes = None
+        members = 1
+    class_seconds = None
+    if jobs_mod.qos_enabled():
+        pol = jobs_mod.get_qos_policy()
+        class_seconds = {c: pol.class_seconds(c) for c in qos_mod.CLASSES}
+    return {
+        "depth": depth,
+        "classDepths": classes,
+        "classSeconds": class_seconds,
+        "jobSeconds": job_seconds,
+        "members": members,
+        "perReplica": per,
+    }
+
+
+def observe(now=None) -> dict:
+    """One controller observation: gather signals, fold, publish.
+    Never raises — any gathering failure is a ``None`` input and the
+    last-known recommendation survives marked degraded."""
+    ctl = controller()
+    now = time.monotonic() if now is None else now
+    try:
+        inputs = _gather()
+    except Exception:
+        inputs = None
+    rec = ctl.observe(inputs, now)
+    decision = rec.get("decision")
+    if decision in ("up", "down"):
+        obs.AUTOSCALE_TOTAL.labels(event=decision).inc()
+        log_event(
+            "autoscale.decision",
+            decision=decision,
+            desired=rec.get("desired"),
+            workSeconds=rec.get("workSeconds"),
+            members=rec.get("members"),
+        )
+    elif decision == "frozen":
+        obs.AUTOSCALE_TOTAL.labels(event="frozen").inc()
+    return rec
+
+
+def fleet_block() -> dict:
+    """The ``autoscale`` block GET /api/debug/fleet publishes: the
+    recommendation (inputs, decision, cooldown state), refreshed by the
+    poll itself so an HPA needs no replica tick to have run; plus the
+    last scale-in decision, for the runbook's audit trail."""
+    rec = observe()
+    with _lock:
+        last = dict(_last_scalein) if _last_scalein else None
+    if last is not None:
+        rec["lastScalein"] = last
+    return rec
+
+
+def _ticker_loop(stop: threading.Event) -> None:
+    """Dedicated observer thread: refresh the recommendation at
+    heartbeat cadence so the gauge stays live without debug polls. The
+    store reads (and their latency) happen HERE, never on the claim
+    loop — the controller's cost to the solve path is a thread-alive
+    check. Exits when reset() signals or the switch turns off."""
+    while not stop.is_set():
+        if not enabled():
+            return  # next tick() starts a fresh ticker if re-enabled
+        try:
+            observe()
+        except Exception:
+            pass
+        stop.wait(max(0.2, float(config.get("VRPMS_HEARTBEAT_S"))))
+
+
+def _ensure_ticker() -> None:
+    global _ticker, _ticker_stop
+    with _lock:
+        if _ticker is not None and _ticker.is_alive():
+            return
+        _ticker_stop = threading.Event()
+        _ticker = threading.Thread(
+            target=_ticker_loop,
+            args=(_ticker_stop,),
+            name="vrpms-autoscale",
+            daemon=True,
+        )
+        _ticker.start()
+
+
+def tick() -> None:
+    """Replica-heartbeat hook (service.jobs wires it next to the
+    subscription tick): ensure the observer thread is running and watch
+    the (in-memory) ring snapshot for membership churn. Does no store
+    I/O itself and never raises — the claim loop must not care."""
+    if not enabled():
+        return
+    _ensure_ticker()
+    try:
+        _watch_churn()
+    except Exception:
+        pass
+
+
+# -- churn hardening --------------------------------------------------------
+
+
+def ladder_tokens() -> list[tuple[str, str]]:
+    """``[("NxV" shape, ring token)]`` over the tier-ladder warm shapes
+    — the universe churn-hardening reasons over. Instances pad through
+    the SAME tiers.maybe_pad path requests take, so the tokens are
+    exactly the ones traffic routes by."""
+    from service import warmup as warmup_mod
+
+    spec = warmup_mod.tier_warm_shapes()
+    if not spec:
+        return []
+    from vrpms_tpu.core import tiers
+    from vrpms_tpu.io.synth import synth_cvrp
+
+    out = []
+    for n, v, _pop in warmup_mod.parse_shapes(spec):
+        inst = tiers.maybe_pad(synth_cvrp(n, v, seed=0))
+        tok = jobs_mod.ring_token("vrp", inst)
+        if tok is not None:
+            out.append((f"{n}x{v}", tok))
+    return out
+
+
+def inherited_spec(prev_ring, new_ring, rid: str) -> str:
+    """The warmup spec for exactly the tier-ladder tiers ``rid`` owns
+    on the new ring but not the old one — what the churn-hardening
+    pre-warm compiles, and what the ring-churn property test asserts
+    equals the inherited arcs."""
+    pairs = ladder_tokens()
+    if not pairs:
+        return ""
+    by_tok = {tok: shape for shape, tok in pairs}
+    toks = policy.inherited_tokens(
+        prev_ring, new_ring, rid, [t for _, t in pairs]
+    )
+    return ",".join(by_tok[t] for t in toks)
+
+
+def _launch_warmup(spec: str) -> None:
+    """Background-compile the inherited tiers (the monkeypatch seam the
+    tests and the bench intercept). owned_only re-checks ownership at
+    compile time — membership may move again before the thread runs."""
+    from service import warmup as warmup_mod
+
+    warmup_mod.start_background_warmup(
+        warmup_mod.warmup, spec, ("sa",), False, True
+    )
+
+
+def _watch_churn() -> None:
+    """Compare successive ring snapshots; on a membership change,
+    pre-warm whatever this replica inherited. First observation is a
+    no-op (boot warmup already covers the initial arcs). Rides the
+    VRPMS_WARMUP switch: a deployment that does not warm tiers at boot
+    has no warm tiers to inherit, so churn compiles nothing either —
+    membership-churning test fleets never pay compile storms."""
+    if not str(config.get("VRPMS_WARMUP") or "").strip():
+        return
+    rep = jobs_mod._replica
+    if rep is None:
+        return
+    ring = rep.ring()
+    if ring is None:
+        return
+    global _prev_ring
+    with _lock:
+        prev, _prev_ring = _prev_ring, ring
+    if prev is None or set(prev.members) == set(ring.members):
+        return
+    spec = inherited_spec(prev, ring, rep.replica_id)
+    if not spec:
+        return
+    obs.AUTOSCALE_TOTAL.labels(event="churn_warm").inc()
+    log_event(
+        "autoscale.churn_warm",
+        spec=spec,
+        members=len(ring.members),
+        was=len(prev.members),
+    )
+    _launch_warmup(spec)
+
+
+# -- safe scale-in ----------------------------------------------------------
+
+
+def _candidates() -> tuple[dict, str]:
+    """(status docs of live candidates, self id) — the stale-filtered
+    registry view with this process's doc overlaid live, the input
+    :func:`vrpms_tpu.sched.autoscale.choose_victim` scores."""
+    self_id = jobs_mod.replica_id()
+    docs: dict = {}
+    if jobs_mod.dist_queue_enabled():
+        rep = jobs_mod._replica
+        fleet = None
+        try:
+            qs = rep.store if rep is not None else store.get_queue_store()
+            fleet = jobs_mod._fleet_infos(qs)
+        except Exception:
+            fleet = None
+        if fleet is not None:
+            live, _stale = split_stale(fleet[0], fleet[1])
+            for rid in live:
+                docs[rid] = dict((fleet[1] or {}).get(rid) or {})
+    docs[self_id] = dict(docs.get(self_id) or {}, **jobs_mod.replica_info())
+    return docs, self_id
+
+
+def scalein_preview() -> dict:
+    """Victim selection dry-run (the GET surface and the runbook's
+    what-if): candidates scored by survivor warm-tier coverage, the
+    chosen victim, nothing drained."""
+    docs, self_id = _candidates()
+    victim, scores = policy.choose_victim(docs)
+    return {"victim": victim, "scores": scores, "self": self_id}
+
+
+def _relay_drain(addr: str) -> dict | None:
+    """POST the victim's own drain endpoint (PR 15's checkpoint-drain
+    runs there, against its leases). None on any failure — the caller
+    answers 502 and nothing was half-drained."""
+    import urllib.request
+
+    try:
+        req = urllib.request.Request(
+            f"http://{addr}/api/admin/drain", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=2.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except Exception:
+        return None
+
+
+class ScaleInHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """POST /api/admin/scalein — safe scale-in: pick the victim by
+    claim-mix overlap (drain the replica whose hot tiers the survivors
+    already have warm) and run the checkpoint-drain against it — zero
+    lost jobs, zero burned attempts. Body (optional):
+    ``{"replicaId": ..., "graceS": ...}`` forces a victim / sets the
+    local drain grace (a relayed victim drains with its own configured
+    grace). 202 with the victim + drain state; 409 when no drainable
+    victim exists (the last replica is never drained); 502 when the
+    victim cannot be reached. GET previews the decision without
+    draining anything."""
+
+    def do_POST(self):
+        obs.begin_request_obs(self)
+        try:
+            self._scalein()
+        finally:
+            obs.end_request_obs(self)
+
+    def _scalein(self):
+        content = read_json_body(self)
+        if content is None:
+            return  # read_json_body already wrote the 400 envelope
+        docs, self_id = _candidates()
+        victim, scores = policy.choose_victim(docs)
+        target = content.get("replicaId")
+        if target is not None:
+            if target not in docs:
+                respond_json(self, 404, {
+                    "success": False,
+                    "errors": [{
+                        "what": "Not found",
+                        "reason": f"replica {target!r} is not a live "
+                                  "fleet member",
+                    }],
+                })
+                return
+            victim = target
+        if victim is None:
+            respond_json(self, 409, {
+                "success": False,
+                "errors": [{
+                    "what": "Conflict",
+                    "reason": "no drainable victim: scale-in never "
+                              "drains the last live replica",
+                }],
+                "scores": scores,
+            })
+            return
+        grace = content.get("graceS")
+        with spans.span("fleet.scalein", victim=victim):
+            if victim == self_id:
+                state = jobs_mod.start_drain(
+                    None if grace is None else float(grace)
+                )
+                result = {"victim": victim, "local": True, "drain": state}
+            else:
+                addr = (docs.get(victim) or {}).get("addr")
+                peer = _relay_drain(addr) if addr else None
+                if peer is None:
+                    respond_json(self, 502, {
+                        "success": False,
+                        "errors": [{
+                            "what": "Bad gateway",
+                            "reason": (
+                                f"victim {victim!r} unreachable"
+                                if addr
+                                else f"victim {victim!r} advertises no "
+                                     "address"
+                            ),
+                        }],
+                        "scores": scores,
+                    })
+                    return
+                result = {
+                    "victim": victim,
+                    "relayed": True,
+                    "drain": peer.get("drain"),
+                }
+        global _last_scalein
+        with _lock:
+            _last_scalein = dict(result, at=time.time(), scores=scores)
+        obs.AUTOSCALE_TOTAL.labels(event="scalein").inc()
+        log_event(
+            "autoscale.scalein",
+            victim=victim,
+            local=bool(result.get("local")),
+            coverage=(scores.get(victim) or {}).get("coverage"),
+        )
+        respond_json(self, 202, {
+            "success": True, "scalein": result, "scores": scores,
+        })
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            preview = scalein_preview()
+            with _lock:
+                last = dict(_last_scalein) if _last_scalein else None
+            payload: dict = {"success": True, "scalein": preview}
+            if last is not None:
+                payload["last"] = last
+            respond_json(self, 200, payload)
+        finally:
+            obs.end_request_obs(self)
+
+
+# the desired-replica gauge rides the scrape like every other provider;
+# with the switch off it publishes nothing (pre-autoscale /metrics
+# unchanged beyond the series registration itself)
+obs.set_desired_replicas_provider(
+    lambda: controller().desired() if enabled() else None
+)

@@ -839,6 +839,25 @@ class FleetHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             replicaId=self_id,
             self=True,
         )
+        from service import autoscale as autoscale_mod
+
+        if autoscale_mod.enabled():
+            if dist:
+                # heartbeat-registry hygiene: a crashed replica's last
+                # doc lingers until its row TTLs out — mark it stale
+                # (updatedAt older than the lease window) and keep it
+                # OUT of the live-member count instead of silently
+                # counting it
+                live, stale = autoscale_mod.split_stale(
+                    list(replicas.keys()), replicas
+                )
+                for rid in stale:
+                    replicas[rid]["stale"] = True
+                fleet["members"] = {"live": len(live), "stale": len(stale)}
+            # the controller's recommendation (inputs, decision,
+            # cooldown state) — the block an HPA/external autoscaler
+            # polls; fail-open, degraded-marked under a store outage
+            fleet["autoscale"] = autoscale_mod.fleet_block()
         fleet["replicas"] = replicas
         drain = jobs_mod.drain_info()
         if drain is not None:

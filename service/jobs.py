@@ -922,6 +922,14 @@ def shutdown_scheduler() -> int:
         subs_mod.reset()
     except Exception:
         pass
+    try:
+        # forget the elastic-fleet controller too: a rebuilt service
+        # must not inherit a cooldown clock or a phantom previous ring
+        from service import autoscale as autoscale_mod
+
+        autoscale_mod.reset()
+    except Exception:
+        pass
     with _replica_lock:
         r, _replica = _replica, None
     if r is not None:
@@ -1184,6 +1192,23 @@ def _shared_class_depths(qs) -> dict | None:
     unreadable or predates the QoS columns — the probe omits the
     field."""
     return _memo_read("classes", qs.depth_by_class)
+
+
+def _fleet_infos(qs) -> tuple | None:
+    """Membership + status docs through the short-TTL memo — the
+    elastic-fleet controller's live-member read costs one registry
+    scan per TTL no matter how often it observes (the fleet DEBUG
+    surface still reads the store directly: operators want fresh).
+    None = store unreadable and no fresh memo (the controller
+    freezes, degraded)."""
+
+    def fetch():
+        members = qs.replicas()
+        if members is None:
+            return None
+        return (list(members), dict(qs.replica_infos() or {}))
+
+    return _memo_read("fleet", fetch)
 
 
 # Watcher-scale read cache (the depth memo generalized to the job-read
@@ -1585,6 +1610,23 @@ def _subs_tick() -> None:
         subs_mod.manager().tick()
 
 
+def _replica_tick() -> None:
+    """The replica's heartbeat-hook bundle: subscriptions first, then
+    the elastic-fleet controller (recommendation refresh + ring-churn
+    pre-warm). Each part guarded — one subsystem's failure must not
+    starve the other of its beat."""
+    try:
+        _subs_tick()
+    except Exception:
+        pass
+    try:
+        from service import autoscale as autoscale_mod
+
+        autoscale_mod.tick()
+    except Exception:
+        pass
+
+
 def build_replica(rid: str, scheduler=None, **kw):
     """A Replica wired to the service's materialize/complete path — the
     in-process multi-replica harness (tests, benchmarks/multi_replica)
@@ -1635,10 +1677,12 @@ def build_replica(rid: str, scheduler=None, **kw):
         # heartbeat status doc: what GET /api/debug/fleet on any peer
         # reports about this replica
         info=replica_info,
-        # standing-subscription scheduling rides the heartbeat: due
-        # cadences fire and orphaned (drained/crashed-owner) pending
-        # deltas are adopted by whichever live replica beats next
-        on_tick=_subs_tick,
+        # standing-subscription scheduling and the elastic-fleet
+        # controller both ride the heartbeat: due cadences fire,
+        # orphaned pending deltas are adopted, the desired-replica
+        # recommendation refreshes, and ring churn triggers
+        # inherited-tier pre-warm on whichever live replica beats next
+        on_tick=_replica_tick,
         **defaults,
     )
 
@@ -2856,7 +2900,11 @@ def start_drain(grace_s: float | None = None) -> dict:
     )
     with _drain_lock:
         if _drain_state["draining"]:
-            return dict(_drain_state)
+            # idempotent: a second request reports the in-flight
+            # drain's progress (marked) instead of spawning a second
+            # drain thread — the marker lives only in the RETURN value,
+            # never in the state doc
+            return dict(_drain_state, alreadyDraining=True)
         _drain_state.update(
             draining=True, startedAt=time.time(), requeued=0,
             complete=False,

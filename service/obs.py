@@ -199,6 +199,23 @@ DIST_QUEUE_DEPTH = REGISTRY.gauge(
     "Unleased jobs waiting in the SHARED store-backed queue (the "
     "cross-replica backpressure signal); refreshed per scrape",
 )
+FLEET_DESIRED = REGISTRY.gauge(
+    "vrpms_fleet_desired_replicas",
+    "The elastic-fleet controller's desired replica count (backlog "
+    "work-seconds vs deadline headroom, hysteresis + cooldown damped "
+    "— the external-metric a k8s HPA should track); refreshed per "
+    "scrape, frozen at the last-known value while the store is "
+    "unreadable",
+)
+AUTOSCALE_TOTAL = REGISTRY.counter(
+    "vrpms_autoscale_total",
+    "Elastic-fleet controller events (up|down = the recommendation "
+    "changed, frozen = one degraded observation — store unreadable, "
+    "last-known value served, churn_warm = a ring membership change "
+    "triggered inherited-tier pre-warm, scalein = a scale-in victim "
+    "was chosen and drained)",
+    labels=("event",),
+)
 WORKER_RESTARTS = REGISTRY.counter(
     "vrpms_sched_worker_restarts_total",
     "Watchdog worker restarts, by backend and reason (died|wedged)",
@@ -381,6 +398,7 @@ def set_compile_cache(cache_dir) -> None:
 _queue_depths = None
 _jobs_running = None
 _dist_depth = None
+_desired_replicas = None
 
 
 def set_dist_depth_provider(fn) -> None:
@@ -389,6 +407,14 @@ def set_dist_depth_provider(fn) -> None:
     scrape like the local queue-depth provider."""
     global _dist_depth
     _dist_depth = fn
+
+
+def set_desired_replicas_provider(fn) -> None:
+    """Register a callable returning the elastic-fleet controller's
+    desired replica count, or None to publish nothing (the autoscale
+    switch is off); refreshed per scrape (service.autoscale)."""
+    global _desired_replicas
+    _desired_replicas = fn
 
 
 def set_queue_depth_provider(fn) -> None:
@@ -423,6 +449,13 @@ def refresh_gauges() -> None:
     if _dist_depth is not None:
         try:
             DIST_QUEUE_DEPTH.set(_dist_depth())
+        except Exception:
+            pass
+    if _desired_replicas is not None:
+        try:
+            desired = _desired_replicas()
+            if desired is not None:
+                FLEET_DESIRED.set(desired)
         except Exception:
             pass
     try:

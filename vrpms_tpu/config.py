@@ -210,6 +210,34 @@ REGISTRY: dict[str, Var] = {
            "Max standing subscriptions one tenant may hold (QoS "
            "fairness for the control plane, next to the per-tenant "
            "job quota); 0 = unlimited."),
+        # -- elastic fleet autoscaling ---------------------------------
+        _v("VRPMS_AUTOSCALE", "switch", True,
+           "Elastic-fleet controller: publishes the desired replica "
+           "count (vrpms_fleet_desired_replicas gauge + the autoscale "
+           "block on /api/debug/fleet) from shared backlog x per-class "
+           "drain rate vs deadline headroom, enables POST "
+           "/api/admin/scalein victim selection, and pre-warms tiers a "
+           "replica inherits on ring membership churn. Off = no "
+           "controller runs and every pre-autoscale response stays "
+           "byte-identical."),
+        _v("VRPMS_AUTOSCALE_MIN", "int", 1,
+           "Floor of the desired-replica recommendation."),
+        _v("VRPMS_AUTOSCALE_MAX", "int", 0,
+           "Ceiling of the desired-replica recommendation; 0 = "
+           "unbounded."),
+        _v("VRPMS_AUTOSCALE_HEADROOM_S", "float", 30.0,
+           "Deadline headroom the fleet must drain the backlog within: "
+           "desired = ceil(backlog work-seconds / (headroom x per-"
+           "replica inflight)). Lower = more aggressive scale-up."),
+        _v("VRPMS_AUTOSCALE_COOLDOWN_S", "float", 60.0,
+           "How long a scale-DOWN signal must persist before the "
+           "recommendation drops (scale-up is immediate — deadlines "
+           "are at stake)."),
+        _v("VRPMS_AUTOSCALE_HYSTERESIS", "float", 0.25,
+           "Slack fraction a smaller fleet must keep before scale-down "
+           "is eligible: the backlog must fit in (1 - hysteresis) of "
+           "the smaller fleet's capacity, so a boundary wiggle never "
+           "flaps the signal."),
         _v("VRPMS_RING_VNODES", "int", 64,
            "Virtual nodes per replica on the consistent-hash ring."),
         _v("VRPMS_LEASE_S", "float", 15.0,

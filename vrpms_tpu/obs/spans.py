@@ -76,6 +76,7 @@ KNOWN_SPAN_NAMES = frozenset({
     "ckpt.write",       # one durable checkpoint write (background)
     "ckpt.resume",      # a requeued attempt seeded from a checkpoint
     "sub.generation",   # one standing-subscription re-solve launch
+    "fleet.scalein",    # scale-in victim selection + drain dispatch
     "read.federate",    # checkpoint-sourced incumbent overlay (non-owner)
     "read.relay",       # live-progress relay from the owning replica
     "store.read",       # table reads on the request path

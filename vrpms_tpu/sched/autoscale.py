@@ -1,0 +1,318 @@
+"""Elastic-fleet controller: how many replicas does the backlog need.
+
+ISSUE 18's policy layer. Every *signal* it consumes already exists —
+shared queue depth by class (PR 11's depth memo), per-class drain EWMAs
+(PR 12's ``QosPolicy.class_seconds``), per-replica claim mix and warmed
+tiers (PR 14's status docs) — and every *actuator* exists too
+(checkpoint-drain from PR 15, arc-weighted warmup from PR 11). This
+module closes the loop as pure arithmetic:
+
+    work_seconds   = sum over classes of depth_c x drain_seconds_c
+    raw            = ceil(work_seconds / (headroom_s x per_replica))
+    desired        = clamp(raw, [VRPMS_AUTOSCALE_MIN, VRPMS_AUTOSCALE_MAX])
+
+i.e. "the smallest fleet that drains today's backlog inside the
+deadline headroom, given each replica runs ``per_replica`` concurrent
+leases". Two dampers keep the signal actuator-safe:
+
+  * **hysteresis** — a downward move is only eligible when the smaller
+    fleet would still sit below ``1 - VRPMS_AUTOSCALE_HYSTERESIS`` of
+    its capacity, so a marginal backlog wiggle at the boundary cannot
+    flap the recommendation;
+  * **cooldown** — scale-UP applies immediately (deadlines are at
+    stake), scale-DOWN only after the down-signal has persisted for
+    ``VRPMS_AUTOSCALE_COOLDOWN_S`` seconds.
+
+The controller *fails open*: when the store is unreadable the inputs
+are ``None`` and :meth:`Controller.observe` freezes the last-known
+recommendation marked ``degraded`` — it never guesses from partial
+data and never touches the solve path.
+
+Also here, because they are pure functions of ring snapshots / status
+docs and the tests want them without HTTP:
+
+  * :func:`inherited_tokens` — which routing tokens a member owns on
+    the new ring but not the old one (exactly what churn-hardening
+    warmup must compile);
+  * :func:`moved_fraction` — fraction of slot space whose owner
+    changed between two rings (the ~1/N churn bound);
+  * :func:`choose_victim` — scale-in victim by claim-mix overlap:
+    drain the replica whose hot tiers the survivors already have warm.
+
+Stdlib-only besides :mod:`vrpms_tpu.config` and the sibling
+:mod:`vrpms_tpu.sched.ring`, like the rest of the sched package.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+from vrpms_tpu import config
+from vrpms_tpu.sched.ring import SLOTS, slot
+
+
+def enabled() -> bool:
+    """The one autoscale switch (``VRPMS_AUTOSCALE``): off runs no
+    controller, adds no fleet block, and keeps every pre-autoscale
+    response byte-identical."""
+    return config.enabled("VRPMS_AUTOSCALE")
+
+
+def work_seconds(depth, class_depths, class_seconds, job_seconds) -> float:
+    """Backlog expressed as drain work: each class's depth priced at
+    its observed per-job drain seconds. Jobs outside the per-class
+    split (or the whole backlog, when no split is readable) price at
+    the class-agnostic ``job_seconds`` EWMA."""
+    per_job = max(1e-3, float(job_seconds or 1.0))
+    total_depth = max(0, int(depth or 0))
+    if not class_depths:
+        return total_depth * per_job
+    secs = class_seconds or {}
+    total = 0.0
+    counted = 0
+    for cls, n in class_depths.items():
+        n = max(0, int(n or 0))
+        total += n * max(1e-3, float(secs.get(cls) or per_job))
+        counted += n
+    # depth memo and class split are separate reads; price any
+    # remainder the split missed at the class-agnostic rate
+    total += max(0, total_depth - counted) * per_job
+    return total
+
+
+def required_replicas(work_s: float, headroom_s: float, per_replica: int) -> int:
+    """The QoS-feasible minimum: smallest fleet whose combined lease
+    concurrency drains ``work_s`` seconds of backlog within the
+    deadline headroom. Always at least 1 — an idle fleet still serves."""
+    capacity = max(1e-3, float(headroom_s)) * max(1, int(per_replica))
+    return max(1, math.ceil(max(0.0, float(work_s)) / capacity))
+
+
+class Controller:
+    """Hysteresis + cooldown state machine over the raw recommendation.
+
+    One instance per process (the service layer owns the singleton).
+    ``observe(inputs, now)`` is the whole API: inputs is either a dict
+    of signals or ``None`` for "store unreadable", and the return value
+    is the JSON-safe recommendation block ``/api/debug/fleet`` and the
+    ``vrpms_fleet_desired_replicas`` gauge publish.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._desired: int | None = None  # guarded-by: _lock
+        self._degraded = False  # guarded-by: _lock
+        self._changed_at: float | None = None  # guarded-by: _lock
+        self._down_since: float | None = None  # guarded-by: _lock
+        self._last: dict = {}  # guarded-by: _lock
+
+    def _clamp(self, raw: int) -> int:
+        lo = max(1, int(config.get("VRPMS_AUTOSCALE_MIN")))
+        hi = int(config.get("VRPMS_AUTOSCALE_MAX"))
+        if hi > 0:
+            raw = min(raw, max(lo, hi))
+        return max(lo, raw)
+
+    def observe(self, inputs: dict | None, now: float) -> dict:
+        """Fold one observation into the recommendation.
+
+        ``inputs`` keys (all optional): ``depth`` (shared queue depth),
+        ``classDepths`` ({class: depth}), ``classSeconds`` ({class:
+        drain EWMA}), ``jobSeconds`` (class-agnostic EWMA),
+        ``members`` (live fleet size), ``perReplica`` (max concurrent
+        leases per replica). ``None`` inputs = store unreadable: the
+        last-known recommendation is frozen and marked degraded.
+        """
+        with self._lock:
+            if inputs is None:
+                self._degraded = True
+                self._down_since = None  # a blind down-signal never ages
+                if self._desired is None:
+                    self._desired = self._clamp(1)
+                rec = dict(
+                    self._last,
+                    desired=self._desired,
+                    degraded=True,
+                    decision="frozen",
+                )
+                self._last = rec
+                return dict(rec)
+
+            headroom = max(1e-3, float(config.get("VRPMS_AUTOSCALE_HEADROOM_S")))
+            cooldown = max(0.0, float(config.get("VRPMS_AUTOSCALE_COOLDOWN_S")))
+            hyst = min(0.9, max(0.0, float(config.get("VRPMS_AUTOSCALE_HYSTERESIS"))))
+            per_replica = max(1, int(inputs.get("perReplica") or 1))
+            work_s = work_seconds(
+                inputs.get("depth"),
+                inputs.get("classDepths"),
+                inputs.get("classSeconds"),
+                inputs.get("jobSeconds"),
+            )
+            raw = self._clamp(required_replicas(work_s, headroom, per_replica))
+
+            self._degraded = False
+            if self._desired is None:
+                self._desired = raw
+                self._changed_at = now
+                decision = "init"
+            elif raw > self._desired:
+                # deadlines are at stake: scale-up is immediate
+                self._desired = raw
+                self._changed_at = now
+                self._down_since = None
+                decision = "up"
+            elif raw < self._desired:
+                # hysteresis: the smaller fleet must keep slack, or a
+                # boundary wiggle would re-raise the signal next tick
+                fits = work_s <= (1.0 - hyst) * raw * headroom * per_replica
+                if not fits:
+                    self._down_since = None
+                    decision = "hold"
+                else:
+                    if self._down_since is None:
+                        self._down_since = now
+                    if now - self._down_since >= cooldown:
+                        self._desired = raw
+                        self._changed_at = now
+                        self._down_since = None
+                        decision = "down"
+                    else:
+                        decision = "cooldown"
+            else:
+                self._down_since = None
+                decision = "hold"
+
+            rec = {
+                "desired": self._desired,
+                "raw": raw,
+                "decision": decision,
+                "degraded": False,
+                "workSeconds": round(work_s, 4),
+                "headroomS": headroom,
+                "cooldownS": cooldown,
+                "hysteresis": hyst,
+                "perReplica": per_replica,
+                "members": max(0, int(inputs.get("members") or 0)),
+                "depth": max(0, int(inputs.get("depth") or 0)),
+                "classDepths": dict(inputs.get("classDepths") or {}),
+                "cooldownRemaining": (
+                    round(max(0.0, cooldown - (now - self._down_since)), 3)
+                    if self._down_since is not None
+                    else 0.0
+                ),
+                "changedAt": self._changed_at,
+            }
+            self._last = rec
+            return dict(rec)
+
+    def desired(self) -> int:
+        """Last published recommendation (gauge value); 1 before any
+        observation — a fleet that has seen nothing still serves."""
+        with self._lock:
+            return self._desired if self._desired is not None else 1
+
+    def last(self) -> dict:
+        """Last recommendation block (empty dict before first observe)."""
+        with self._lock:
+            return dict(self._last)
+
+
+# -- churn geometry ---------------------------------------------------------
+
+
+def inherited_tokens(old_ring, new_ring, member: str, tokens) -> list:
+    """Routing tokens `member` owns on `new_ring` that it did NOT own
+    on `old_ring` — exactly the tiers churn-hardening warmup must
+    compile. ``old_ring=None`` means the member is new: everything it
+    now owns is inherited. Order of `tokens` is preserved."""
+    out = []
+    for tok in tokens:
+        s = slot(tok)
+        if new_ring is None or new_ring.owner(s) != member:
+            continue
+        if old_ring is None or old_ring.owner(s) != member:
+            out.append(tok)
+    return out
+
+
+def moved_fraction(old_ring, new_ring) -> float:
+    """Fraction of the slot space whose owner differs between two ring
+    snapshots. Exact (walks the union of both rings' arc boundaries,
+    inside which ownership is constant on both sides) — the property
+    test asserts single-member churn moves ~1/N, the consistent-hash
+    guarantee FIFO sharding lacks."""
+    cuts = {0}
+    for r in (old_ring, new_ring):
+        for m in r.members:
+            for lo, hi in r.arcs(m):
+                cuts.add(lo % SLOTS)
+                cuts.add(hi % SLOTS)
+    bounds = sorted(cuts)
+    moved = 0
+    for i, lo in enumerate(bounds):
+        hi = bounds[i + 1] if i + 1 < len(bounds) else SLOTS
+        if hi > lo and old_ring.owner(lo) != new_ring.owner(lo):
+            moved += hi - lo
+    return moved / SLOTS
+
+
+# -- scale-in victim selection ----------------------------------------------
+
+
+def mix_tier(token) -> str | None:
+    """Map a claim-mix ring token (``vrp:NxNxV:tw..:het..:td..``) to
+    the warmed-tier key the warmup ledger uses (``NxV``); None for
+    tokens that don't parse (claim mix may hold legacy keys)."""
+    try:
+        shape = str(token).split(":")[1]
+        dims = shape.split("x")
+        if len(dims) < 2:
+            return None
+        int(dims[0]), int(dims[-1])  # both must be numeric
+        return f"{dims[0]}x{dims[-1]}"
+    except (IndexError, ValueError):
+        return None
+
+
+def choose_victim(docs: dict) -> tuple[str | None, dict]:
+    """Pick the scale-in victim from per-replica status docs: the
+    non-draining replica whose claim-mix weight is best covered by the
+    tiers the OTHER survivors already have warm — draining it re-homes
+    its hot tiers onto warm caches, so scale-in costs the fewest cold
+    compiles. Ties break toward fewer inflight jobs, then the lowest
+    replica id (deterministic everywhere). Returns ``(victim, scores)``
+    where scores maps each candidate to its coverage/inflight; victim
+    is None when fewer than two candidates exist (never drain the last
+    replica)."""
+    candidates = [
+        rid for rid, d in docs.items() if not (d or {}).get("draining")
+    ]
+    scores: dict = {}
+    if len(candidates) < 2:
+        return None, scores
+    for rid in candidates:
+        doc = docs.get(rid) or {}
+        survivors_warm = set()
+        for other in candidates:
+            if other == rid:
+                continue
+            survivors_warm.update((docs.get(other) or {}).get("tiersWarmed") or [])
+        mix = doc.get("claimMix") or {}
+        total = sum(float(w or 0.0) for w in mix.values())
+        covered = sum(
+            float(w or 0.0)
+            for tok, w in mix.items()
+            if mix_tier(tok) in survivors_warm
+        )
+        # an idle replica (no claim mix) is perfectly safe to drain
+        coverage = covered / total if total > 0 else 1.0
+        scores[rid] = {
+            "coverage": round(coverage, 4),
+            "inflight": max(0, int(doc.get("inflight") or 0)),
+        }
+    victim = sorted(
+        candidates,
+        key=lambda r: (-scores[r]["coverage"], scores[r]["inflight"], r),
+    )[0]
+    return victim, scores
