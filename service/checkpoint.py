@@ -102,6 +102,13 @@ class _Entry:
 
     # -- capture side (solver / worker threads) -----------------------------
     def due(self, sink) -> bool:
+        # Called from the solver seam at block boundaries. Under the
+        # pipelined driver (VRPMS_PIPELINE) the check may run at a
+        # LAUNCH gate, one in-flight block before the capture's offer
+        # lands — the cadence stays bounded (interval_s plus at most
+        # one block), it never double-fires for one publish (last_seq
+        # only advances in offer), and a capture is never lost: the
+        # final in-flight block is always drained and processed.
         now = time.monotonic()
         with self.lock:
             if self.closed:

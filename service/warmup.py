@@ -168,7 +168,12 @@ def warmup(spec: str, algorithms: tuple[str, ...] = ("sa",), log=True,
             # every timeLimit request runs); localSearchPool 32 compiles
             # the pool polish; iterationCount 512 keeps the block full-
             # size. _run_solver is the service's own timed dispatch, so
-            # the polish and final-eval programs warm too.
+            # the polish and final-eval programs warm too — and every
+            # timed solver (SA, GA, ACO alike) records its measured
+            # iteration rate into the shared hint cache
+            # (solvers.common.rate_put), so the first real solve of a
+            # warmed shape opens with a fitted block instead of the
+            # blind probe.
             opts = {
                 "seed": 0,
                 "population_size": pop,

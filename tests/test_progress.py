@@ -197,9 +197,18 @@ class TestSolverSeam:
             sunk = solve_sa(inst, key=7, params=p)
         assert bool(jnp.array_equal(plain.giant, sunk.giant))
         assert float(plain.cost) == float(sunk.cost)
-        # deadline path too (generous budget: same block decomposition)
+        # deadline path too (generous budget: same block decomposition).
+        # Identical decompositions need identical RATE-HINT state: the
+        # first deadline solve of a shape records a measured rate (and a
+        # cold-compile run records a badly understated one), which would
+        # let the second solve open fitted instead of probing — so pin
+        # both solves to an empty hint table.
+        from vrpms_tpu.solvers import common as solver_common
+
+        solver_common._SWEEP_RATE.clear()
         plain_d = solve_sa(inst, key=7, params=p, deadline_s=3600.0)
         sink = progress.ProgressSink(lower_bound=10.0)
+        solver_common._SWEEP_RATE.clear()
         with progress.attach(sink):
             sunk_d = solve_sa(inst, key=7, params=p, deadline_s=3600.0)
         assert bool(jnp.array_equal(plain_d.giant, sunk_d.giant))

@@ -290,6 +290,12 @@ REGISTRY: dict[str, Var] = {
         _v("VRPMS_ILS_TRACE", "str", None,
            "Truthy: print ILS round-by-round trace lines to stderr."),
         # -- solver + compile knobs ------------------------------------
+        _v("VRPMS_PIPELINE", "switch", True,
+           "Depth-1 pipelined block dispatch in the solver deadline "
+           "drivers: block k+1 launches while block k's results are "
+           "processed on host, so cancel/deadline/checkpoint react "
+           "within at most one in-flight block. Off restores the "
+           "serial loop exactly, including its sync points."),
         _v("VRPMS_TIERS", "str", "",
            "Shape-tier ladder spec (see core.tiers.parse_tiers; 'off' "
            "disables padding; malformed values are a boot error)."),

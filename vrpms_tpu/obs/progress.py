@@ -106,13 +106,21 @@ class ProgressSink:
         # block boundary and nothing else.
         self.ckpt = None
 
+    #: the pipelined driver reduces best-of-batch to ONE device-side
+    #: scalar before transfer; a plain sink only needs that min, so it
+    #: opts in to the cheap path (the fanout below overrides: it splits
+    #: per-row bests and must see the full array)
+    needs_array = False
+
     # -- solver side (device-owning thread) ---------------------------------
     def record(self, best, iters: int, evals_per_iter: float | None) -> None:
         """Block-boundary report — same contract as BlockTrace.record:
-        `best` is the array the deadline loop synced on (already
-        block_until_ready'd), its min is the incumbent cost. Publishes
-        a snapshot only when the incumbent improves (or on the first
-        block); telemetry failures never fail the solve."""
+        `best` is whatever the deadline loop synced on (already
+        block_until_ready'd) — a pre-reduced device scalar or host
+        float under the pipelined driver, the full per-chain best array
+        from the serial loop — and its min is the incumbent cost.
+        Publishes a snapshot only when the incumbent improves (or on
+        the first block); telemetry failures never fail the solve."""
         import numpy as np
 
         with self._lock:
@@ -121,7 +129,13 @@ class ProgressSink:
             )
             self._block += 1
         try:
-            best_cost = float(np.min(np.asarray(best)))
+            # host floats (and 0-d scalars) skip the array round trip —
+            # the common per-boundary case once the driver pre-reduces
+            best_cost = (
+                float(best)
+                if isinstance(best, (int, float))
+                else float(np.min(np.asarray(best)))
+            )
         except Exception:
             return  # keep eval accounting, skip the unreadable entry
         with self._new:
@@ -304,6 +318,11 @@ class ProgressFanout:
     sink is cancelled: one job's cancel must not kill its batch-mates'
     solve (a cancelled batched job simply gets its incumbent when the
     launch ends)."""
+
+    #: the fanout splits per-instance ROWS to member sinks, so the
+    #: pipelined driver must keep the full [K, B] sync array for it —
+    #: a scalar min across the batch would leak job A's cost to job B
+    needs_array = True
 
     def __init__(self, sinks: list):
         self._sinks = list(sinks)

@@ -43,9 +43,11 @@ class BlockTrace:
         self._evals = 0.0
 
     def record(self, best, iters: int, evals_per_iter: float | None) -> None:
-        """Append one block-boundary entry. `best` is whatever array the
-        solver's deadline loop syncs on (per-chain bests, a scalar
-        champion fitness, ...) — its min is the best cost; it has been
+        """Append one block-boundary entry. `best` is whatever the
+        solver's deadline loop synced on — a pre-reduced device scalar
+        or host float under the pipelined driver (VRPMS_PIPELINE), or
+        the full array (per-chain bests, a champion fitness, ...) from
+        the serial loop; its min is the best cost. It has been
         block_until_ready'd by the caller, so reading it is a transfer,
         not a wait. `evals_per_iter` None counts raw iterations."""
         import numpy as np
@@ -57,7 +59,12 @@ class BlockTrace:
             self.truncated = True
             return
         try:
-            best_cost = float(np.min(np.asarray(best)))
+            # host floats (and 0-d scalars) skip the array round trip
+            best_cost = (
+                float(best)
+                if isinstance(best, (int, float))
+                else float(np.min(np.asarray(best)))
+            )
         except Exception:
             # telemetry must never fail a solve: e.g. a multi-process
             # mesh's globally-sharded best array isn't fully addressable

@@ -29,7 +29,12 @@ import jax.numpy as jnp
 
 from vrpms_tpu.core.cost import CostWeights, resolve_eval_mode
 from vrpms_tpu.core.instance import Instance
-from vrpms_tpu.solvers.common import SolveResult, run_blocked
+from vrpms_tpu.solvers.common import (
+    SolveResult,
+    donate_safe_state,
+    maybe_donate_jit,
+    run_blocked,
+)
 from vrpms_tpu.solvers.sa import (
     SAParams,
     _rate_get,
@@ -105,9 +110,12 @@ def _batch_block_fn(n_block: int, mode: str):
     sharing it is a big part of the batched launch's amortization — and
     for INDEPENDENT instances, cross-request stream correlation changes
     no per-request result distribution.
+
+    On accelerators the stacked loop state (arg 0) is DONATED — see
+    sa._sa_block_fn; solve_sa_batch enters through donate_safe_state.
     """
 
-    @jax.jit
+    @maybe_donate_jit
     def run(state, key, binst, w, t0s, t1s, knns, start_it, horizon):
         from vrpms_tpu.moves.moves import (
             move_batch_from_params,
@@ -246,7 +254,9 @@ def solve_sa_batch(
     )
     n_iters = params.n_iters
     horizon = jnp.float32(n_iters)
-    state = (giants, costs, giants, costs)
+    # donate_safe_state: the four slots must donate DISTINCT buffers on
+    # accelerators (giants appears twice); identity on CPU
+    state = donate_safe_state((giants, costs, giants, costs))
 
     def step_block(st, nb, start):
         return _batch_block_fn(nb, mode)(

@@ -24,7 +24,14 @@ import jax.numpy as jnp
 from vrpms_tpu.core.cost import CostWeights, exact_cost
 from vrpms_tpu.core.instance import Instance
 from vrpms_tpu.core.split import greedy_split_giant
-from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
+from vrpms_tpu.solvers.common import (
+    SolveResult,
+    donate_safe_state,
+    maybe_donate_jit,
+    perm_fitness_fn,
+    rate_get,
+    rate_put,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -246,10 +253,12 @@ def _aco_block_fn(params: ACOParams, n_block: int):
     bounded retention; blocks compose so a deadline-driven solve can
     check the host clock between device-side blocks). Callers pass
     params with `n_iters` normalized to 0 — the block never reads it —
-    so requests differing only in iteration budget share one compile."""
+    so requests differing only in iteration budget share one compile.
+    On accelerators the loop state (arg 0) is DONATED — see
+    sa._sa_block_fn; callers enter through donate_safe_state."""
     from vrpms_tpu.core.cost import resolve_eval_mode
 
-    @jax.jit
+    @maybe_donate_jit
     def run(state, key, inst, w, start_it, knn_mask):
         hot = resolve_eval_mode("auto") != "gather"
 
@@ -356,7 +365,12 @@ def solve_aco(
     if init_perm is None:
         init_perm = jnp.arange(1, inst.n_customers + 1, dtype=jnp.int32)
     scale = CONTINUATION_DEPOSIT if (warm and continuation) else WARM_DEPOSIT
-    state = _aco_init_fn(block_params, pool, warm, scale)(inst, w, init_perm)
+    # donate_safe_state: distinct buffers for the donated colony state
+    # on accelerators (the init fn's pool slots tile the incumbent);
+    # identity on CPU
+    state = donate_safe_state(
+        _aco_init_fn(block_params, pool, warm, scale)(inst, w, init_perm)
+    )
     knn_mask = aco_knn_mask(inst, params.knn_k)
 
     def step_block(st, nb, start):
@@ -364,14 +378,24 @@ def solve_aco(
             st, key, inst, w, jnp.int32(start), knn_mask
         )
 
+    # measured colony iterations/s per shape — same first-block fit
+    # hint seam as SA/GA (warmup or a prior solve seeds it)
+    rate_key = ("aco", params.n_ants, inst.n_nodes, pool)
+    import time as _time
+
+    t_run = _time.monotonic()
     state, done = run_blocked(
         step_block, state, params.n_iters, 16, deadline_s, lambda st: st[2],
-        evals_per_iter=params.n_ants,
+        rate_hint=rate_get(rate_key), evals_per_iter=params.n_ants,
         # durable-checkpoint capture: the colony's global-best perm
         # split to a giant (only when the sink's checkpoint cadence is
         # due)
         incumbent=lambda st: greedy_split_giant(st[1], inst),
     )
+    if deadline_s is not None and done:
+        el = _time.monotonic() - t_run
+        if el > 0.05:
+            rate_put(rate_key, done / el)
 
     _, best_perm, _, pool_perms, pool_fits = state
     giant = greedy_split_giant(best_perm, inst)

@@ -182,8 +182,16 @@ def _enumerate_deadline(n: int, kind: str, inst: Instance, w, deadline_s: float)
     exhausted). At least one chunk always runs, so the result is the
     best over >= ~262k orders (or the whole space when smaller); when
     the deadline cuts enumeration short the result is best-so-far, NOT
-    exact — the caller reports the scored count via SolveResult.evals."""
+    exact — the caller reports the scored count via SolveResult.evals.
+
+    Under VRPMS_PIPELINE (default on) the chunk loop is depth-1
+    pipelined like common.run_blocked: chunk k+1 dispatches before
+    chunk k's reduction is synced, so the deadline/cancel check reacts
+    within at most one in-flight chunk. The carry chains through
+    asynchronously and every launched chunk is drained, so the result
+    equals the serial loop's over the same scored prefix."""
     from vrpms_tpu.obs.progress import cancel_requested
+    from vrpms_tpu.solvers.common import pipeline_enabled
 
     n_perms = math.factorial(n)
     n_batches = (n_perms + _BATCH - 1) // _BATCH
@@ -191,14 +199,31 @@ def _enumerate_deadline(n: int, kind: str, inst: Instance, w, deadline_s: float)
     run = _bf_chunk_fn(n, kind)
     t0 = time.monotonic()
     b = 0
+    if not pipeline_enabled():
+        while b < n_batches:
+            carry = run(carry, jnp.int32(b), inst, w)
+            jax.block_until_ready(carry[1])
+            b += _CHUNK_BATCHES
+            # chunk-granular cooperative cancel, same seam as the
+            # deadline (a cancelled enumeration is best-effort, never
+            # exact)
+            if time.monotonic() - t0 >= deadline_s or cancel_requested():
+                break
+        scored = min(b * _BATCH, n_perms)
+        return carry[0], scored, scored >= n_perms
+    prev = None  # the in-flight chunk's reduction to sync on
     while b < n_batches:
         carry = run(carry, jnp.int32(b), inst, w)
-        jax.block_until_ready(carry[1])
         b += _CHUNK_BATCHES
-        # chunk-granular cooperative cancel, same seam as the deadline
-        # (a cancelled enumeration is best-effort, never exact)
-        if time.monotonic() - t0 >= deadline_s or cancel_requested():
-            break
+        if prev is not None:
+            jax.block_until_ready(prev)
+            # clock/cancel observed on the last SYNCED chunk while the
+            # one just launched computes — reaction defers by ≤1 chunk,
+            # which the final drain below always completes and counts
+            if time.monotonic() - t0 >= deadline_s or cancel_requested():
+                break
+        prev = carry[1]
+    jax.block_until_ready(carry[1])
     scored = min(b * _BATCH, n_perms)
     return carry[0], scored, scored >= n_perms
 

@@ -1,12 +1,19 @@
-"""Shared result container for all solvers.
+"""Shared result container + the one block-driver loop for all solvers.
 
 Every solver — bf, local_search, sa, ga, aco — returns the same
 SolveResult so the service layer (the api->solver boundary the reference
 prescribes at README.md:31-33 but never wired) is algorithm-agnostic.
+
+This module also owns the deadline-aware block driver (`run_blocked`)
+every iterative solver composes its jitted blocks through, the
+measured-rate hint cache that lets a first block open fitted instead of
+probing, and the donation/pipelining helpers the chunked drivers share
+(see run_blocked's docstring for the VRPMS_PIPELINE contract).
 """
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -35,6 +42,128 @@ class SolveResult(NamedTuple):
     evals: jax.Array          # candidate evaluations performed (throughput metric)
     pool: jax.Array | None = None  # optional [K, L] elite tours (best first,
                                    # pool[0] == giant) for multi-start polish
+
+
+# ---------------------------------------------------------------------------
+# pipelining + donation helpers (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def pipeline_enabled() -> bool:
+    """The VRPMS_PIPELINE master switch (default on). Read per call so
+    tests and embedders can toggle at runtime; `off` restores the
+    serial driver loop exactly, including its per-block sync points."""
+    from vrpms_tpu import config
+
+    return config.enabled("VRPMS_PIPELINE")
+
+
+@lru_cache(maxsize=1)
+def donation_enabled() -> bool:
+    """Whether block jits donate their loop-state buffers. Only on
+    accelerators: XLA:CPU ignores donation (and jax warns per donated
+    call), and CPU-side tests rely on entry arrays staying readable.
+    Cached — the backend is fixed for the life of the process."""
+    return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+
+
+def maybe_donate_jit(fn):
+    """jit a solver block body with its loop state (argument 0) donated
+    on accelerators, so chained blocks update state in place instead of
+    double-buffering the chain/population arrays — the pipelined driver
+    otherwise holds two full copies of the loop state while block k+1
+    computes. A plain jit on CPU (donation is a no-op there)."""
+    if donation_enabled():
+        return jax.jit(fn, donate_argnums=(0,))
+    return jax.jit(fn)
+
+
+def donate_safe_state(state):
+    """Deep-copy a solver's ENTRY loop state when donation is active.
+
+    Two hazards make the copy necessary exactly once, at loop entry:
+    caller-owned seed arrays (warm-start pools, cached tours) must
+    survive the first block's donation, and the solvers' aliased state
+    tuples — SA's (giants, costs, giants, costs) — must donate four
+    DISTINCT buffers, not the same one twice. Identity (free) on CPU."""
+    if not donation_enabled():
+        return state
+    return jax.tree.map(jnp.copy, state)
+
+
+@lru_cache(maxsize=1)
+def _scalar_min_fn():
+    """Jitted best-of-batch reduction: the pipelined driver syncs on
+    this one device-side scalar per block boundary instead of pulling
+    the full per-chain best array to host just to take its min."""
+    return jax.jit(jnp.min)
+
+
+def _scalar_best(best):
+    """Reduce a sync payload to its scalar min on device; pass odd
+    payloads (host scalars, already-reduced values) through unchanged —
+    the record paths accept either."""
+    try:
+        return _scalar_min_fn()(best)
+    except Exception:
+        return best
+
+
+# ---------------------------------------------------------------------------
+# measured-rate hint cache (shared by SA/GA/ACO and the batched launch)
+# ---------------------------------------------------------------------------
+
+# (solver, shape...) -> measured iterations/s of the last deadline-
+# bounded run; run_blocked's first-block fit hint. Persisted alongside
+# the XLA compile cache: a FRESH process otherwise starts hint-less and
+# its first tight-deadline solve opens with a blind probe block (or,
+# pre-hint, overshot by a whole unshrunk block — measured: the cold
+# 30 s budget-series point ran 51 s while the warmed bench family holds
+# 10 s budgets to ~5%). Generalized out of solvers.sa so GA/ACO and
+# warmup seed the same cache (ISSUE 19 satellite).
+_SWEEP_RATE: dict = {}
+_RATE_LOADED = False
+
+
+def _rate_cache_path():
+    import os
+
+    from vrpms_tpu import config
+
+    return config.get("VRPMS_RATE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "vrpms_tpu_sweep_rates.json"
+    )
+
+
+def rate_get(key) -> float | None:
+    global _RATE_LOADED
+    if not _RATE_LOADED:
+        _RATE_LOADED = True
+        import json
+
+        try:
+            with open(_rate_cache_path()) as f:
+                for k, v in json.load(f).items():
+                    _SWEEP_RATE.setdefault(k, float(v))
+        except (OSError, ValueError):
+            pass
+    return _SWEEP_RATE.get("|".join(map(str, key)))
+
+
+def rate_put(key, rate: float) -> None:
+    _SWEEP_RATE["|".join(map(str, key))] = float(rate)
+    import json
+    import os
+
+    path = _rate_cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(_SWEEP_RATE, f)
+        os.replace(tmp, path)
+    except OSError:  # best-effort: a hint cache must never fail a solve
+        pass
 
 
 def run_blocked(
@@ -96,14 +225,34 @@ def run_blocked(
     the common case costs one attribute read per boundary; like the
     sink itself it only READS the already-synced state and never
     changes the trajectory.
-    """
-    import time
 
+    Pipelining (VRPMS_PIPELINE, default on): the timed driver is
+    depth-1 pipelined over JAX async dispatch — block k+1 is LAUNCHED
+    before block k's sync, so the host processes block k's results
+    (trace record, sink publish, checkpoint capture, cancel flag,
+    rate/deadline math) while k+1 computes on device. The device
+    computation sequence is unchanged (same step_block calls, offsets,
+    shapes — blocks compose exactly), so fixed-seed trajectories are
+    bit-identical with pipelining on or off; what changes is reaction
+    latency: cancel, the deadline, and checkpoint cadence are observed
+    at launch gates, deferring each by AT MOST the one in-flight block
+    (the fit-shrink prices launched-but-unsynced iterations into what
+    still fits the clock). The per-boundary transfer also shrinks to a
+    device-side scalar min of sync(state) — the full array crosses only
+    for sinks that declare `needs_array` (the batched fanout) or when
+    an incumbent capture is actually due. VRPMS_PIPELINE=off restores
+    the serial loop exactly, including its sync points.
+    """
     from vrpms_tpu.obs.progress import active_sink
     from vrpms_tpu.obs.trace import active_trace
 
     trace = active_trace()
     sink = active_sink()
+    pipelined = pipeline_enabled()
+    # a sink that consumes per-row bests (the batched fanout) opts out
+    # of the device-side scalar reduction; an unknown sink without the
+    # attribute conservatively keeps the full array
+    needs_array = sink is not None and getattr(sink, "needs_array", True)
     if deadline_s is None:
         if sink is not None and sink.cancelled:
             # cancelled before the single unbounded block launched: the
@@ -116,6 +265,8 @@ def run_blocked(
         state = step_block(state, n_total, 0)
         if (trace is not None or sink is not None) and n_total > 0:
             best = sync(state)
+            if pipelined and not needs_array:
+                best = _scalar_best(best)
             jax.block_until_ready(best)
             if trace is not None:
                 trace.record(best, n_total, evals_per_iter)
@@ -123,6 +274,27 @@ def run_blocked(
                 sink.record(best, n_total, evals_per_iter)
                 _maybe_capture(sink, incumbent, state)
         return state, n_total
+    if not pipelined:
+        return _run_serial(
+            step_block, state, n_total, block_size, deadline_s, sync,
+            rate_hint, evals_per_iter, incumbent, trace, sink,
+        )
+    return _run_pipelined(
+        step_block, state, n_total, block_size, deadline_s, sync,
+        rate_hint, evals_per_iter, incumbent, trace, sink, needs_array,
+    )
+
+
+def _run_serial(
+    step_block, state, n_total, block_size, deadline_s, sync,
+    rate_hint, evals_per_iter, incumbent, trace, sink,
+):
+    """The pre-pipeline timed driver, byte-for-byte (VRPMS_PIPELINE=off
+    contract): launch, sync, process, then launch again — the device
+    idles during every host-side boundary, but every check reacts
+    within the block that just finished."""
+    import time
+
     block = max(1, min(n_total, block_size))
     done = 0
     t_start = time.monotonic()
@@ -168,6 +340,174 @@ def run_blocked(
         if time.monotonic() - t_start >= deadline_s:
             break
     return state, done
+
+
+def _fit_block(
+    block, n_total, launched, done, t_start, t_sync, deadline_s, rate_hint,
+):
+    """Next-block sizing for the pipelined driver — the serial loop's
+    fit-shrink logic with in-flight work priced in: the measured rate
+    comes from iterations already SYNCED (done over the wall clock at
+    the last sync), and iterations launched-but-unsynced are subtracted
+    from what the remaining clock still fits. Returns 0 to stop (the
+    deadline math says nothing more fits and something already ran)."""
+    import time
+
+    nb = min(block, n_total - launched)
+    remaining_t = deadline_s - (time.monotonic() - t_start)
+    rate = None
+    if done:
+        measured = t_sync - t_start
+        if measured > 0:
+            rate = done / measured
+    elif rate_hint:
+        rate = 0.8 * rate_hint
+    if rate is not None:
+        if remaining_t <= 0 and (done or launched):
+            return 0
+        fit = int(rate * max(remaining_t, 0.0)) - (launched - done)
+        if fit < nb:
+            nb = (fit // 128) * 128
+            if nb < 128:
+                if done or launched:
+                    return 0
+                nb = min(128, n_total)  # a call always runs SOMETHING
+    elif nb > 128:
+        # no rate known: open with a small probe block to MEASURE (the
+        # serial opener's contract; under pipelining a second probe can
+        # launch before the first syncs — the decomposition differs but
+        # the composed trajectory does not)
+        nb = 128
+    return nb
+
+
+def _run_pipelined(
+    step_block, state, n_total, block_size, deadline_s, sync,
+    rate_hint, evals_per_iter, incumbent, trace, sink, needs_array,
+):
+    """Depth-1 pipelined timed driver (see run_blocked's contract).
+
+    Loop invariant: at most ONE launched-but-unprocessed block exists
+    (`prev`). Each turn first launches the next block — so the device
+    stays busy while the host works — then syncs and processes the
+    PREVIOUS block's results while the new one computes. Cancel and the
+    deadline are observable only at launch gates, so reaction defers by
+    at most the one in-flight block (which is always drained and
+    recorded before return: `done` counts every launched block).
+
+    Donation interplay: launching block k+1 donates block k's state
+    buffers, so everything processing needs — the synced best (scalar,
+    or a copy of the full array for fanout sinks) and, when a capture
+    is due, the incumbent tour — is extracted at launch time, before
+    the next launch can invalidate it. Without donation (CPU) the
+    incumbent is extracted at processing time instead, preserving the
+    serial capture cadence exactly.
+    """
+    import time
+
+    block = max(1, min(n_total, block_size))
+    launched = 0  # iterations dispatched to the device
+    done = 0      # iterations synced and processed
+    t_start = time.monotonic()
+    t_sync = [t_start]  # wall clock of the last processed sync
+    done_box = [0]
+    donated = donation_enabled()
+
+    def process(blk):
+        nb_p, best_p, state_p, inc_p = blk
+        jax.block_until_ready(best_p)
+        t_sync[0] = time.monotonic()
+        done_box[0] += nb_p
+        if trace is not None:
+            trace.record(best_p, nb_p, evals_per_iter)
+        if sink is not None:
+            sink.record(best_p, nb_p, evals_per_iter)
+            if not donated:
+                _maybe_capture(sink, incumbent, state_p)
+            elif inc_p is not None:
+                try:
+                    sink.offer_incumbent(inc_p)
+                except Exception:
+                    pass  # capture must never kill the device loop
+
+    prev = None  # in-flight block: (nb, best, state, incumbent|None)
+    while True:
+        done = done_box[0]
+        if (
+            prev is not None
+            and launched < n_total
+            and not done
+            and not rate_hint
+        ):
+            # No rate known and the measuring block is still in flight:
+            # DRAIN it before sizing the next launch, exactly like the
+            # serial opener — pipelining engages from the second
+            # boundary on, and the launch sequence (sizes + offsets)
+            # matches the serial loop's whenever the fit never shrinks,
+            # which is what keeps fixed-seed runs bit-identical across
+            # modes (the presampled move streams are drawn per block).
+            process(prev)
+            prev = None
+            done = done_box[0]
+        cur = None
+        if launched < n_total:
+            stop = False
+            if sink is not None and sink.cancelled:
+                sink.note_cancel_seen()
+                stop = True
+            elif launched and time.monotonic() - t_start >= deadline_s:
+                stop = True
+            if not stop:
+                nb = _fit_block(
+                    block, n_total, launched, done,
+                    t_start, t_sync[0], deadline_s, rate_hint,
+                )
+                if nb == 0 and prev is not None and not done:
+                    # The stop verdict rests on the derated HINT — no
+                    # block has synced yet. The serial loop can never
+                    # stop unmeasured (it breaks only `if done`), and a
+                    # stale hint from a compile-polluted run can under-
+                    # state the true rate by orders of magnitude, which
+                    # would end the solve at a fraction of its budget.
+                    # Drain the in-flight block and re-fit on the
+                    # MEASURED rate before accepting the stop.
+                    process(prev)
+                    prev = None
+                    done = done_box[0]
+                    nb = _fit_block(
+                        block, n_total, launched, done,
+                        t_start, t_sync[0], deadline_s, rate_hint,
+                    )
+                if nb > 0:
+                    new_state = step_block(state, nb, launched)
+                    best = sync(new_state)
+                    if not needs_array:
+                        best = _scalar_best(best)
+                    elif donated:
+                        # the NEXT launch donates new_state's buffers;
+                        # keep an independent copy of the full array
+                        best = jnp.copy(best)
+                    inc = None
+                    if donated and incumbent is not None and sink is not None:
+                        # pre-extract the champion tour while the state
+                        # is still valid; the cadence check runs one
+                        # block early, but the offer still lands at this
+                        # block's processing
+                        want = getattr(sink, "want_incumbent", None)
+                        try:
+                            if want is not None and want():
+                                inc = incumbent(new_state)
+                        except Exception:
+                            inc = None  # capture must never kill the loop
+                    cur = (nb, best, new_state, inc)
+                    state = new_state
+                    launched += nb
+        if prev is not None:
+            process(prev)
+        prev = cur
+        if prev is None:
+            break
+    return state, done_box[0]
 
 
 def _maybe_capture(sink, incumbent, state) -> None:

@@ -47,7 +47,14 @@ from vrpms_tpu.moves.moves import (
     reverse_segment,
     rotate_segment,
 )
-from vrpms_tpu.solvers.common import SolveResult, perm_fitness_fn
+from vrpms_tpu.solvers.common import (
+    SolveResult,
+    donate_safe_state,
+    maybe_donate_jit,
+    perm_fitness_fn,
+    rate_get,
+    rate_put,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -446,9 +453,12 @@ def _ga_block_fn(params: GAParams, n_block: int, mode: str):
     solve runs the whole budget as one block. Callers pass params with
     `generations` normalized to 0 (the block body never reads it), so
     requests differing only in iteration budget share one compile.
+
+    On accelerators the loop state (arg 0) is DONATED — see
+    sa._sa_block_fn; callers enter through donate_safe_state.
     """
 
-    @jax.jit
+    @maybe_donate_jit
     def run(state, key, inst, w, start_gen):
         fitness = perm_fitness_fn(inst, w, params.fleet_penalty, mode=mode)
         nrp = inst.perm_limit
@@ -518,7 +528,9 @@ def solve_ga(
     block_params = dataclasses.replace(params, generations=0)
     fits0 = _ga_init_fn(block_params, mode)(perms0, inst, w)
     champ0 = jnp.argmin(fits0)
-    state = (perms0, fits0, perms0[champ0], fits0[champ0])
+    # donate_safe_state: caller-owned init_perms must survive the first
+    # block's donation on accelerators; identity on CPU
+    state = donate_safe_state((perms0, fits0, perms0[champ0], fits0[champ0]))
 
     def step_block(st, nb, start):
         return _ga_block_fn(block_params, nb, mode)(
@@ -533,13 +545,25 @@ def solve_ga(
         if inst.n_real is not None
         else immigrants_for(params, perms0.shape[0], inst.n_customers)
     )
+    # measured generations/s per shape, fed back as run_blocked's
+    # first-block fit hint — a known same-tier rate (warmup or a prior
+    # solve) lets the first block open fitted instead of probing blind
+    rate_key = ("ga", perms0.shape[0], perms0.shape[1], mode)
+    import time as _time
+
+    t_run = _time.monotonic()
     state, done = run_blocked(
         step_block, state, params.generations, 32, deadline_s,
-        lambda st: st[3], evals_per_iter=gen_evals,
+        lambda st: st[3], rate_hint=rate_get(rate_key),
+        evals_per_iter=gen_evals,
         # durable-checkpoint capture: the best-so-far genome split to a
         # giant (only when the sink's checkpoint cadence is due)
         incumbent=lambda st: greedy_split_giant(st[2], inst),
     )
+    if deadline_s is not None and done:
+        el = _time.monotonic() - t_run
+        if el > 0.05:
+            rate_put(rate_key, done / el)
 
     perms, fits, best_perm, _ = state
     giant = greedy_split_giant(best_perm, inst)

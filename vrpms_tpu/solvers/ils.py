@@ -175,6 +175,14 @@ def ils_loop(
 
     anneal(key, init_giants, budget) -> SolveResult; a returned elite
     pool is polished whole, otherwise the champion alone.
+
+    Deadline/cancel granularity: each round's anneal runs under
+    common.run_blocked, whose pipelined driver (VRPMS_PIPELINE, default
+    on) defers deadline and cancel reaction by at most one in-flight
+    device block — the round budgets computed here (min_round_s,
+    fixed_tail, polish_reserve_s) already absorb that slack because a
+    block has always been the loop's overshoot unit; the round-boundary
+    cancel checks below are host-side and react immediately.
     """
     if params.rounds < 1:
         raise ValueError(f"ILSParams.rounds must be >= 1, got {params.rounds}")

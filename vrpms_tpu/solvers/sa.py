@@ -31,59 +31,18 @@ from vrpms_tpu.core.cost import (
 from vrpms_tpu.core.encoding import random_giant_batch
 from vrpms_tpu.core.instance import Instance, mean_duration
 from vrpms_tpu.moves import knn_move_batch, proposal_knn, random_move_batch
-from vrpms_tpu.solvers.common import SolveResult
+from vrpms_tpu.solvers.common import (
+    SolveResult,
+    donate_safe_state,
+    maybe_donate_jit,
+    rate_get as _rate_get,
+    rate_put as _rate_put,
+)
 
-
-# (batch, length, mode) -> measured anneal sweeps/s of the last
-# deadline-bounded run; run_blocked's first-block fit hint (see solve_sa).
-# Persisted alongside the XLA compile cache: a FRESH process otherwise
-# starts hint-less and its first tight-deadline solve overshoots by a
-# whole unshrunk block (measured: the cold 30 s budget-series point ran
-# 51 s while the warmed bench family holds 10 s budgets to ~5%).
-_SWEEP_RATE: dict = {}
-_RATE_LOADED = False
-
-
-def _rate_cache_path():
-    import os
-
-    from vrpms_tpu import config
-
-    return config.get("VRPMS_RATE_CACHE") or os.path.join(
-        os.path.expanduser("~"), ".cache", "vrpms_tpu_sweep_rates.json"
-    )
-
-
-def _rate_get(key) -> float | None:
-    global _RATE_LOADED
-    if not _RATE_LOADED:
-        _RATE_LOADED = True
-        import json
-        import os
-
-        try:
-            with open(_rate_cache_path()) as f:
-                for k, v in json.load(f).items():
-                    _SWEEP_RATE.setdefault(k, float(v))
-        except (OSError, ValueError):
-            pass
-    return _SWEEP_RATE.get("|".join(map(str, key)))
-
-
-def _rate_put(key, rate: float) -> None:
-    _SWEEP_RATE["|".join(map(str, key))] = float(rate)
-    import json
-    import os
-
-    path = _rate_cache_path()
-    try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = f"{path}.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(_SWEEP_RATE, f)
-        os.replace(tmp, path)
-    except OSError:  # best-effort: a hint cache must never fail a solve
-        pass
+# The measured sweeps/s hint cache lives in solvers.common now (ISSUE
+# 19 satellite: GA/ACO and the batched launch share it); the _rate_get/
+# _rate_put aliases above keep this module's historical seam — callers
+# (sched.batch) import them from here.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -353,9 +312,14 @@ def _sa_block_fn(n_block: int, mode: str):
     honor a wall-clock deadline — as several, checking the clock on the
     host between device-side blocks (SURVEY.md §5 failure-detection:
     a solve must be stoppable at a request deadline).
+
+    On accelerators the loop state (arg 0) is DONATED: chained blocks
+    update the chain/best arrays in place, so the pipelined driver
+    (common.run_blocked) never holds two full copies of the state while
+    a block is in flight. Callers enter through donate_safe_state.
     """
 
-    @jax.jit
+    @maybe_donate_jit
     def run(state, key, inst, w, t0, t1, knn, start_it, horizon):
         from vrpms_tpu.moves.moves import (
             move_batch_from_params,
@@ -497,7 +461,10 @@ def solve_sa(
         knn = proposal_knn(inst, params.knn_k) if params.knn_k > 0 else None
     t0j, t1j = jnp.float32(t0), jnp.float32(t1)
     horizon = jnp.float32(n_iters)
-    state = (giants, costs, giants, costs)
+    # donate_safe_state: under donation the four slots must be DISTINCT
+    # buffers (giants appears twice) and caller-owned init_giants must
+    # survive the first block; identity on CPU
+    state = donate_safe_state((giants, costs, giants, costs))
 
     def step_block(st, nb, start):
         return _sa_block_fn(nb, mode)(
