@@ -1,0 +1,292 @@
+"""Solve analytics: overhead gate + rollup correctness + store-down.
+
+    python -m benchmarks.analytics_overhead [--reps 8] [--iters 800]
+                                            [--customers 60] [--chains 64]
+                                            [--rtt-ms 25]
+                                            [--out benchmarks/records/...json]
+
+The solve-analytics acceptance bar (ISSUE 20), four phases:
+
+  1. **Overhead** — the paired design on the REAL request path
+     (service.solve.run_vrp bracketed by the exact per-request trace
+     lifecycle the HTTP layer runs), alternating VRPMS_ANALYTICS
+     on/off each rep. The flight-record store sits behind an RTT shim
+     (default 25 ms per batch write — the hosted store's real per-op
+     cost) so the measurement includes a realistically SLOW analytics
+     store; the exporter is a bounded background flusher, so
+     solves/sec must not care: gate < 1% overhead.
+  2. **Steady state** — after the on-arm drains, every offered flight
+     record must be accounted `ok`: gate zero dropped.
+  3. **Rollup correctness** — the captured records must be RIGHT, not
+     just cheap: the recorded padding occupancy must equal the value
+     recomputed by hand from the record's own tier label and the known
+     real instance size, and the debug-endpoint rollup aggregation
+     must reproduce it: gate exact (4-decimal) agreement.
+  4. **Store down** — the analytics store hard-fails; the same request
+     mix must serve 100% (export failures only tick the `failed`
+     counter) and the local ring must still hold the records: gate
+     100% served, local record present.
+
+Prints one JSON line on stdout (bench.py convention); diagnostics to
+stderr; `--out` also writes the committed record the CI gate asserts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def build_request(n_customers: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n = n_customers + 1
+    pts = rng.uniform(0, 100, size=(n, 2))
+    matrix = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1).tolist()
+    locations = [
+        {"id": i, "demand": 2 if i else 0} for i in range(n)
+    ]
+    n_vehicles = max(2, n_customers // 10)
+    cap = 2.0 * n_customers / n_vehicles * 1.3
+    params = {
+        "name": "analytics-overhead",
+        "description": "bench",
+        "auth": None,
+        "ignored_customers": [],
+        "completed_customers": [],
+        "capacities": [cap] * n_vehicles,
+        "start_times": [0.0] * n_vehicles,
+    }
+    return params, locations, matrix, n, n_vehicles
+
+
+class RttShim:
+    """The hosted store's per-op latency, applied to the flight-record
+    write path only — the background flusher pays it, requests must
+    not."""
+
+    def __init__(self, inner, rtt_s: float):
+        self.inner = inner
+        self.rtt_s = rtt_s
+        self.writes = 0
+
+    def put_flight_records(self, rows):
+        time.sleep(self.rtt_s)
+        self.writes += 1
+        return self.inner.put_flight_records(rows)
+
+
+class DownStore:
+    """A hard-down analytics store: every batch write fails."""
+
+    def put_flight_records(self, rows):
+        raise RuntimeError("injected: analytics store down")
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--reps", type=int, default=8,
+                        help="measured solve pairs (one per analytics state)")
+    parser.add_argument("--iters", type=int, default=800)
+    parser.add_argument("--customers", type=int, default=60)
+    parser.add_argument("--chains", type=int, default=64)
+    parser.add_argument("--rtt-ms", type=float, default=25.0,
+                        help="simulated store RTT per record batch write")
+    parser.add_argument("--down-requests", type=int, default=6,
+                        help="requests served during the store-down phase")
+    parser.add_argument("--out", default=None,
+                        help="also write the committed record here")
+    args = parser.parse_args()
+
+    os.environ["VRPMS_LOG"] = "off"  # isolate the analytics delta
+    os.environ["VRPMS_STORE"] = "memory"
+    os.environ["VRPMS_TRACING"] = "on"
+    os.environ["VRPMS_CACHE"] = "off"  # every rep pays a real solve
+    os.environ["VRPMS_ANALYTICS"] = "off"
+    import store
+    from service import obs as service_obs
+    from service.debug import analytics_rollup
+    from service.solve import run_vrp
+    from vrpms_tpu.obs import analytics, spans
+
+    def count(outcome: str) -> float:
+        return service_obs.ANALYTICS_TOTAL.labels(outcome=outcome).value
+
+    params, locations, matrix, n_real, v_real = build_request(
+        args.customers
+    )
+    opts = {
+        "seed": 1,
+        "iteration_count": args.iters,
+        "population_size": args.chains,
+    }
+
+    def one_solve(seed: int) -> float:
+        """One request-shaped solve under the current analytics state:
+        the exact per-request span lifecycle the service runs, so the
+        flight record's finish-seam capture is on the measured path."""
+        errors: list = []
+        t0 = time.perf_counter()
+        trace = spans.start_trace(None)
+        tokens = None
+        if trace is not None:
+            root = trace.span("POST /api/vrp/sa")
+            tokens = spans.activate(trace, root)
+        try:
+            result = run_vrp(
+                "sa", params, dict(opts, seed=seed), {}, locations, matrix,
+                errors, database=None,
+            )
+        finally:
+            if trace is not None:
+                trace.root().end()
+                spans.deactivate(tokens)
+                trace.finish()
+        elapsed = (time.perf_counter() - t0) * 1e3
+        assert result is not None and not errors, errors
+        return elapsed
+
+    shim = RttShim(store.get_database("vrp", None), args.rtt_ms / 1e3)
+    analytics.set_store_factory(lambda: shim)
+
+    print(
+        f"[analytics_overhead] warmup solve ({args.customers} customers, "
+        f"{args.chains}x{args.iters})",
+        file=sys.stderr,
+    )
+    one_solve(0)  # compile
+
+    # -- phase 1: paired on/off overhead ------------------------------------
+    on_ms, off_ms = [], []
+    for rep in range(args.reps):
+        pair = (("on", on_ms), ("off", off_ms))
+        if rep % 2:
+            pair = pair[::-1]
+        for state, sink in pair:
+            os.environ["VRPMS_ANALYTICS"] = state
+            sink.append(one_solve(rep + 1))
+    os.environ["VRPMS_ANALYTICS"] = "on"
+    assert analytics.flush(30.0), "exporter failed to drain"
+    overhead_pct = 100.0 * statistics.median(
+        (on - off) / off for on, off in zip(on_ms, off_ms)
+    )
+
+    # -- phase 2: steady-state accounting -----------------------------------
+    ok, dropped, failed = count("ok"), count("dropped"), count("failed")
+    offered = ok + dropped + failed
+    print(
+        f"[analytics_overhead] steady state: ok={ok:.0f} "
+        f"dropped={dropped:.0f} failed={failed:.0f} "
+        f"batchWrites={shim.writes}",
+        file=sys.stderr,
+    )
+
+    # -- phase 3: rollup correctness ----------------------------------------
+    # the record's occupancy must match a hand recomputation from its
+    # own tier label: compute occupancy = real work / padded work
+    docs = analytics.recent_records()
+    assert docs, "no flight records captured on the on-arm"
+    doc = docs[0]
+    shape = doc["tier"].split(":", 1)[1].split("x")
+    n_pad, v_pad = int(shape[0]), int(shape[1])
+    expect_occ = round((n_real + v_real) / (n_pad + v_pad), 4)
+    recorded_occ = doc["occupancy"]["compute"]
+    rollup = analytics_rollup(docs)
+    tier_row = next(
+        t for t in rollup["tiers"] if t["tier"] == doc["tier"]
+    )
+    rollup_occ = tier_row["meanOccupancy"]
+    rollup_correct = (
+        n_pad >= n_real
+        and v_pad >= v_real
+        and recorded_occ == expect_occ
+        and abs(rollup_occ - expect_occ) < 5e-4
+        and doc["deviceS"] > 0
+        and doc["evals"] > 0
+    )
+    print(
+        f"[analytics_overhead] rollup probe: tier={doc['tier']} "
+        f"recorded={recorded_occ} expected={expect_occ} "
+        f"rollupMean={rollup_occ}",
+        file=sys.stderr,
+    )
+
+    # -- phase 4: store down --------------------------------------------------
+    analytics.set_store_factory(lambda: DownStore())
+    served = 0
+    before = len(analytics.recent_records())
+    for i in range(args.down_requests):
+        errors: list = []
+        trace = spans.start_trace(None)
+        root = trace.span("POST /api/vrp/sa")
+        tokens = spans.activate(trace, root)
+        try:
+            result = run_vrp(
+                "sa", params, dict(opts, seed=100 + i), {}, locations,
+                matrix, errors, database=None,
+            )
+        finally:
+            trace.root().end()
+            spans.deactivate(tokens)
+            trace.finish()
+        if result is not None and not errors:
+            served += 1
+    analytics.flush(30.0)
+    down_failed = count("failed") - failed
+    local_records_ok = len(analytics.recent_records()) >= before + served
+    analytics.set_store_factory(None)
+    analytics.reset_analytics()
+
+    served_frac = served / max(1, args.down_requests)
+    gate = {
+        "overheadPct": round(overhead_pct, 3),
+        "overheadMax": 1.0,
+        "droppedSteadyState": int(dropped),
+        "offeredRecords": int(offered),
+        "okRecords": int(ok),
+        "rollupCorrect": bool(rollup_correct),
+        "recordedOccupancy": recorded_occ,
+        "expectedOccupancy": expect_occ,
+        "storeDownServed": served_frac,
+        "storeDownFailedRecords": int(down_failed),
+        "localRecordsServedWhileDown": bool(local_records_ok),
+        "pass": (
+            overhead_pct < 1.0
+            and dropped == 0
+            and failed == 0
+            and ok > 0
+            and rollup_correct
+            and served_frac == 1.0
+            and down_failed > 0
+            and local_records_ok
+        ),
+    }
+    line = {
+        "bench": "analytics_overhead",
+        "customers": args.customers,
+        "chains": args.chains,
+        "iters": args.iters,
+        "reps": args.reps,
+        "rttMs": args.rtt_ms,
+        "solve_ms_analytics_on": round(statistics.median(on_ms), 2),
+        "solve_ms_analytics_off": round(statistics.median(off_ms), 2),
+        "tier": doc["tier"],
+        "batchWrites": shim.writes,
+        "gate": gate,
+        "pass": gate["pass"],
+    }
+    print(json.dumps(line))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(line, f, indent=2)
+            f.write("\n")
+    return 0 if line["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

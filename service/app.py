@@ -26,6 +26,7 @@ from service import obs
 from service.api.index import handler as health_handler
 from vrpms_tpu import config
 from service.debug import (
+    AnalyticsHandler,
     FleetHandler,
     JobTimelineHandler,
     TraceDetailHandler,
@@ -87,11 +88,16 @@ _SUB_ROUTES = {"/api/subscriptions": SubscriptionsHandler}
 # route labels, VRPMS_AUTOSCALE consulted per request (off -> 404)
 _AUTOSCALE_ROUTES = {"/api/admin/scalein": ScaleInHandler}
 
+# and for the solve-analytics rollup: route label registered always,
+# VRPMS_ANALYTICS consulted per request (off -> 404)
+_ANALYTICS_ROUTES = {"/api/debug/analytics": AnalyticsHandler}
+
 # the request counter's route label values come from the route table —
 # an arbitrary 404 path can never mint a new series (service.obs)
 obs.KNOWN_ROUTES.update(ROUTES)
 obs.KNOWN_ROUTES.update(_SUB_ROUTES)
 obs.KNOWN_ROUTES.update(_AUTOSCALE_ROUTES)
+obs.KNOWN_ROUTES.update(_ANALYTICS_ROUTES)
 
 
 class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
@@ -120,6 +126,13 @@ class Router(obs.RequestObsMixin, BaseHTTPRequestHandler):
         if cls is None and path.startswith("/api/debug/traces/"):
             # parameterized route: /api/debug/traces/{traceId}
             cls = TraceDetailHandler
+        if path == "/api/debug/analytics":
+            # solve-analytics rollup (VRPMS_ANALYTICS-gated per request
+            # so a flip needs no restart; off -> plain 404, byte-
+            # identical to the pre-analytics service)
+            from vrpms_tpu.obs import analytics
+
+            cls = AnalyticsHandler if analytics.enabled() else None
         if path == "/api/admin/scalein":
             # elastic-fleet scale-in (VRPMS_AUTOSCALE-gated per request
             # so a flip needs no restart; off -> plain 404, byte-

@@ -50,7 +50,9 @@ import store
 from service import obs
 from service.helpers import respond_json
 from vrpms_tpu import config
+from vrpms_tpu.obs import analytics
 from vrpms_tpu.obs import export as trace_export
+from vrpms_tpu.obs import slo
 from vrpms_tpu.obs import spans
 
 
@@ -703,6 +705,66 @@ def _lineage_events(record: dict, job_id: str) -> tuple[list, list]:
     return events, list(reversed(chain))
 
 
+def _flight_for_job(record: dict, job_id: str) -> dict | None:
+    """The job's flight record: the local analytics ring first (this
+    replica solved it), then the shared flight table (a peer did).
+    Fail-open — a store miss or outage just means no economics event."""
+    doc = analytics.recent_for_job(job_id)
+    if doc is not None:
+        return doc
+    try:
+        rows = store.get_database(
+            record.get("problem") or "vrp", None
+        ).get_flight_records(limit=256)
+    except Exception:
+        rows = None
+    for row in rows or []:
+        if str(row.get("job_id")) == job_id:
+            return dict(row.get("doc") or {}) or None
+    return None
+
+
+def _economics_event(record: dict, job_id: str) -> dict | None:
+    """The timeline's closing "solve economics" entry: where the wall
+    time went (device vs host, overlap), how full the padded shapes
+    were, and what quality came out. None when no flight record exists
+    (analytics off for this job, trivial solve, or evicted)."""
+    doc = _flight_for_job(record, job_id)
+    if not doc:
+        return None
+    parts: list = []
+    if doc.get("deviceS") is not None:
+        parts.append(
+            f"device {doc['deviceS']}s / host {doc.get('hostS')}s"
+        )
+    ratio = doc.get("overlapRatio")
+    if ratio is not None:
+        parts.append(f"overlap {round(ratio * 100, 1)}%")
+    occ = (doc.get("occupancy") or {}).get("compute")
+    if occ is not None:
+        parts.append(
+            f"padding occupancy {round(occ * 100, 1)}%"
+            + (f" on tier {doc['tier']}" if doc.get("tier") else "")
+        )
+    batch = doc.get("batch") or {}
+    if batch.get("fill") is not None:
+        parts.append(
+            f"batch fill {batch.get('members')}/{batch.get('padded')}"
+        )
+    if doc.get("evalsPerSec") is not None:
+        parts.append(f"{doc['evalsPerSec']} evals/s")
+    if doc.get("cache"):
+        parts.append(f"cache {doc['cache']}")
+    if doc.get("gap") is not None:
+        parts.append(f"gap {doc['gap']}")
+    return {
+        "atMs": None,
+        "event": "solve.economics",
+        "detail": "solve economics: " + (", ".join(parts) or "recorded"),
+        "flight": doc,
+    }
+
+
 class JobTimelineHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
     """GET /api/jobs/{id}/timeline — the job's story as one ordered,
     human-readable event list, resolved across replicas via the trace
@@ -761,6 +823,13 @@ class JobTimelineHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             "replicas": merged["replicas"] if merged is not None else [],
             "timeline": build_timeline(record, merged),
         }
+        if analytics.enabled():
+            # analytics-era narration only: with VRPMS_ANALYTICS off
+            # the timeline stays byte-identical to the pre-analytics
+            # service
+            economics = _economics_event(record, job_id)
+            if economics is not None:
+                payload["timeline"] = payload["timeline"] + [economics]
         if config.enabled("VRPMS_SUBS") and record.get("resolvedFrom"):
             # subscription-era narration only: with VRPMS_SUBS off the
             # timeline stays byte-identical to the pre-subscription
@@ -858,6 +927,10 @@ class FleetHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             # cooldown state) — the block an HPA/external autoscaler
             # polls; fail-open, degraded-marked under a store outage
             fleet["autoscale"] = autoscale_mod.fleet_block()
+        if analytics.enabled():
+            # per-QoS-class deadline-met burn rates (fast/slow windows)
+            # — the alerting view next to the capacity view it explains
+            fleet["slo"] = slo.fleet_block()
         fleet["replicas"] = replicas
         drain = jobs_mod.drain_info()
         if drain is not None:
@@ -867,5 +940,192 @@ class FleetHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
             fleet["draining"] = drain
         payload: dict = {"success": True, "fleet": fleet}
         if degraded:
+            payload["degraded"] = True
+        respond_json(self, 200, payload)
+
+
+# ---------------------------------------------------------------------------
+# Solve analytics rollup
+# ---------------------------------------------------------------------------
+
+#: padding waste above this fraction earns a tier-ladder tuning hint
+WASTE_HINT_THRESHOLD = 0.35
+#: mean batch fill below this fraction earns a gather-window hint
+FILL_HINT_THRESHOLD = 0.5
+#: mean overlap ratio at or above this reads as a healthy pipeline
+OVERLAP_HEALTHY = 0.5
+#: flight rows scanned per store read (newest first)
+FLIGHT_SCAN_LIMIT = 512
+
+
+def _mean(values: list) -> float | None:
+    vals = [float(v) for v in values if v is not None]
+    return round(sum(vals) / len(vals), 4) if vals else None
+
+
+def _merged_flight_docs() -> tuple[list, bool]:
+    """Every known flight record, fleet-wide: the shared flight table
+    (each replica's exported rows) overlaid with this replica's local
+    ring — on (jobId, replica) conflict the LOCAL doc wins (it is the
+    live, untruncated truth). degraded=True means the store could not
+    be read and the rollup is local-only."""
+    by_key: dict = {}
+    try:
+        rows = _trace_db().get_flight_records(limit=FLIGHT_SCAN_LIMIT)
+    except Exception:
+        rows = None
+    degraded = rows is None
+    for row in rows or []:
+        doc = row.get("doc") or {}
+        if doc:
+            by_key[(str(row.get("job_id")), str(row.get("replica")))] = doc
+    for doc in analytics.recent_records():
+        by_key[(str(doc.get("jobId")), str(doc.get("replica")))] = doc
+    docs = sorted(
+        by_key.values(),
+        key=lambda d: d.get("finishedAt") or 0.0,
+        reverse=True,
+    )
+    return docs, degraded
+
+
+def analytics_rollup(docs: list) -> dict:
+    """Per-tier and per-algorithm hardware-efficiency aggregates over a
+    set of flight records, with tuning hints where a knob would help:
+    padding waste ranked worst-first -> tier-ladder hints, mean batch
+    fill -> gather-window hint, mean overlap -> pipeline health."""
+    tiers_map: dict = {}
+    algos: dict = {}
+    fills: list = []
+    overlaps: list = []
+    replicas: list = []
+    for doc in docs:
+        rep = doc.get("replica")
+        if rep and rep not in replicas:
+            replicas.append(rep)
+        tier = doc.get("tier")
+        if tier:
+            t = tiers_map.setdefault(
+                str(tier), {"occ": [], "gaps": [], "count": 0}
+            )
+            t["count"] += 1
+            t["occ"].append((doc.get("occupancy") or {}).get("compute"))
+            t["gaps"].append(doc.get("gap"))
+        algo = doc.get("algorithm")
+        if algo:
+            a = algos.setdefault(
+                str(algo),
+                {"gaps": [], "eps": [], "pis": [], "count": 0},
+            )
+            a["count"] += 1
+            a["gaps"].append(doc.get("gap"))
+            a["eps"].append(doc.get("evalsPerSec"))
+            a["pis"].append(doc.get("primalIntegral"))
+        fills.append((doc.get("batch") or {}).get("fill"))
+        overlaps.append(doc.get("overlapRatio"))
+    tier_rows = []
+    for tier, t in tiers_map.items():
+        occ = _mean(t["occ"])
+        row: dict = {
+            "tier": tier,
+            "solves": t["count"],
+            "meanOccupancy": occ,
+            "paddingWaste": (
+                None if occ is None else round(1.0 - occ, 4)
+            ),
+            "meanGap": _mean(t["gaps"]),
+        }
+        if row["paddingWaste"] is not None and (
+            row["paddingWaste"] > WASTE_HINT_THRESHOLD
+        ):
+            row["hint"] = (
+                f"{round(row['paddingWaste'] * 100, 1)}% of this "
+                "tier's padded compute is waste — consider an "
+                "intermediate ladder step below it "
+                "(vrpms_tpu.core.tiers)"
+            )
+        tier_rows.append(row)
+    # worst waste first: the tier an operator should re-ladder first
+    tier_rows.sort(key=lambda r: -(r["paddingWaste"] or 0.0))
+    algo_rows = [
+        {
+            "algorithm": algo,
+            "solves": a["count"],
+            "meanGap": _mean(a["gaps"]),
+            "meanEvalsPerSec": _mean(a["eps"]),
+            "meanPrimalIntegral": _mean(a["pis"]),
+        }
+        for algo, a in sorted(algos.items())
+    ]
+    mean_fill = _mean(fills)
+    batch: dict = {
+        "launches": sum(1 for f in fills if f is not None),
+        "meanFill": mean_fill,
+    }
+    if mean_fill is not None and mean_fill < FILL_HINT_THRESHOLD:
+        batch["hint"] = (
+            f"vmapped launches run {round(mean_fill * 100, 1)}% full "
+            "on average — widen VRPMS_SCHED_WINDOW_MS (or lower "
+            "VRPMS_SCHED_MAX_BATCH) so gather windows fill"
+        )
+    mean_overlap = _mean(overlaps)
+    pipeline: dict = {
+        "solves": sum(1 for r in overlaps if r is not None),
+        "meanOverlapRatio": mean_overlap,
+        "health": (
+            "unknown"
+            if mean_overlap is None
+            else ("good" if mean_overlap >= OVERLAP_HEALTHY else "poor")
+        ),
+    }
+    if pipeline["health"] == "poor":
+        pipeline["hint"] = (
+            "host bookkeeping rarely overlaps device compute — check "
+            "VRPMS_PIPELINE and per-block host costs"
+        )
+    return {
+        "records": len(docs),
+        "replicas": replicas,
+        "tiers": tier_rows,
+        "algorithms": algo_rows,
+        "batch": batch,
+        "pipeline": pipeline,
+    }
+
+
+class AnalyticsHandler(obs.RequestObsMixin, BaseHTTPRequestHandler):
+    """GET /api/debug/analytics — hardware-efficiency rollups over the
+    fleet's flight records: padding waste ranked by tier (tier-ladder
+    tuning), batch fill (gather-window tuning), pipeline overlap
+    health, per-algorithm quality, the regression sentinel's state, and
+    the SLO burn rates. Store-down degrades to this replica's local
+    ring, marked — never a 500."""
+
+    def do_GET(self):
+        obs.begin_request_obs(self, sample="header")
+        try:
+            self._rollup()
+        finally:
+            obs.end_request_obs(self)
+
+    def _rollup(self):
+        query = urllib.parse.parse_qs(self.path.partition("?")[2])
+        try:
+            limit = int(query.get("limit", [str(FLIGHT_SCAN_LIMIT)])[0])
+        except (TypeError, ValueError):
+            _bad_request(self, "'limit' must be an integer")
+            return
+        docs, degraded = _merged_flight_docs()
+        docs = docs[: max(1, limit)]
+        payload: dict = {
+            "success": True,
+            "analytics": analytics_rollup(docs),
+            "sentinel": analytics.get_sentinel().snapshot(),
+            "slo": slo.fleet_block(),
+            "queueDepth": analytics.queue_depth(),
+        }
+        if degraded:
+            # the store could not answer: this replica's ring only,
+            # other replicas' records may exist
             payload["degraded"] = True
         respond_json(self, 200, payload)

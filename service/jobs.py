@@ -63,6 +63,7 @@ from service.solve import (
     _mark_degraded,
     finish_tsp,
     finish_vrp,
+    flight_partial,
     prepare_request,
     run_tsp,
     run_vrp,
@@ -76,7 +77,9 @@ from vrpms_tpu.obs import (
     set_request_id,
     spans,
 )
+from vrpms_tpu.obs import analytics
 from vrpms_tpu.obs import export as trace_export
+from vrpms_tpu.obs import slo
 from vrpms_tpu.sched import (
     DONE,
     FAILED,
@@ -567,11 +570,15 @@ def _run_batched(jobs: list[Job]) -> None:
         # a sink (VRPMS_PROGRESS=off) -> attach nothing, keeping the
         # off switch's no-extra-host-work contract on the fast path
         sinks = [j.sink for j in jobs]
+        # one flight timer for the shared launch (ISSUE 20): device/host
+        # split and batch fill are launch-wide facts, attributed to
+        # every member's record below
+        ftimer = analytics.FlightTimer() if analytics.enabled() else None
         with progress.attach(
             progress.ProgressFanout(sinks)
             if any(s is not None for s in sinks)
             else None
-        ):
+        ), analytics.flight(ftimer):
             results = solve_sa_batch(
                 [p.inst for p in preps], seeds, params=params,
                 deadline_s=deadline,
@@ -598,10 +605,18 @@ def _run_batched(jobs: list[Job]) -> None:
         )
         try:
             obs.SOLVE_EVALS.observe(float(res.evals))
-            if prep.problem == "vrp":
-                job.result = finish_vrp(prep, res, None, {}, errors)
-            else:
-                job.result = finish_tsp(prep, res, None, {}, errors)
+            extras: dict = {}
+            if ftimer is not None:
+                extras["flight"] = flight_partial(
+                    ftimer, wall, int(res.evals)
+                )
+            # the job's own sink rides the contextvar through finish so
+            # the flight record sees its jobId, lower bound, and profile
+            with progress.attach(job.sink):
+                if prep.problem == "vrp":
+                    job.result = finish_vrp(prep, res, None, extras, errors)
+                else:
+                    job.result = finish_tsp(prep, res, None, extras, errors)
             _mark_cancelled(job)
         except Exception as e:
             log_event(
@@ -817,6 +832,17 @@ def _on_event(name: str, job: Job) -> None:
         ),
     )
     terminal = name in ("done", "failed", "expired", "crashed", "drained")
+    if terminal and name != "drained" and analytics.enabled():
+        # SLO accounting (ISSUE 20): one deadline-met outcome per
+        # terminal job. A job with no deadline cannot miss; any failure
+        # path is a miss; a drained job resumes on a peer, so it
+        # carries no verdict here.
+        deadline = getattr(job, "deadline_at", None)
+        met = name == "done" and (
+            deadline is None
+            or (job.finished_at or time.time()) <= float(deadline)
+        )
+        slo.note(getattr(job, "qos", None) or "standard", met)
     if terminal:
         # fairness bookkeeping: the tenant's quota slot frees the
         # moment the job is terminal, whatever path got it there
@@ -955,6 +981,10 @@ def shutdown_scheduler() -> int:
         _qos_policy = None  # fresh per-class drain EWMAs on rebuild
     with _tenant_lock:
         _tenant_active.clear()
+    # stop the analytics flusher and forget SLO windows: a rebuilt
+    # service re-reads the knobs and starts with clean burn rates
+    analytics.reset_analytics()
+    slo.reset_tracker()
     with _sched_lock:
         s, _scheduler = _scheduler, None
         if s is not None:

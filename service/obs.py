@@ -367,6 +367,64 @@ TRACE_EXPORT_QUEUE = REGISTRY.gauge(
     "background flusher (VRPMS_TRACE_EXPORT_QUEUE caps it; sustained "
     "depth near the cap precedes drops); refreshed per scrape",
 )
+_OCCUPANCY_BUCKETS = (
+    0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0,
+)
+PADDING_OCCUPANCY = REGISTRY.histogram(
+    "vrpms_padding_occupancy",
+    "Per-solve compute occupancy of the padded tier shape (real work "
+    "over padded work, 1.0 = no padding waste), labeled by tier; the "
+    "retained exemplar points at the worst-waste trace seen. Low "
+    "buckets dominating for a tier = the ladder rung above it is too "
+    "far — add an intermediate tier (VRPMS_TIERS)",
+    labels=("tier",),
+    buckets=_OCCUPANCY_BUCKETS,
+)
+BATCH_FILL = REGISTRY.histogram(
+    "vrpms_batch_fill",
+    "Micro-batch fill of vmapped launches (member jobs over the "
+    "power-of-two padded batch, 1.0 = no phantom members). Sustained "
+    "low fill = widen the gather window (VRPMS_SCHED_WINDOW_MS) or "
+    "lower VRPMS_SCHED_MAX_BATCH",
+    buckets=_OCCUPANCY_BUCKETS,
+)
+PIPELINE_OVERLAP = REGISTRY.histogram(
+    "vrpms_pipeline_overlap_ratio",
+    "Fraction of per-solve host bookkeeping hidden behind in-flight "
+    "device blocks (the VRPMS_PIPELINE driver; 0 = fully serial "
+    "boundaries). A drop after a deploy = pipeline health regression",
+    buckets=_OCCUPANCY_BUCKETS,
+)
+SLO_BURN = REGISTRY.gauge(
+    "vrpms_slo_burn_rate",
+    "Deadline-met SLO burn rate per QoS class and window (fast = 5 min, "
+    "slow = 1 h): observed miss fraction over the window divided by the "
+    "allowed miss budget (1 - VRPMS_SLO_TARGET). 1.0 = consuming "
+    "exactly the error budget; refreshed per scrape",
+    labels=("qos", "window"),
+)
+ANALYTICS_TOTAL = REGISTRY.counter(
+    "vrpms_analytics_total",
+    "Flight records offered to the durable analytics exporter, by "
+    "outcome (ok = batch-written to the store's flight_records seam, "
+    "dropped = queue overflow or an oversized document, failed = the "
+    "store write failed — single-attempt, fail-open). Every offered "
+    "record is accounted exactly once",
+    labels=("outcome",),
+)
+ANALYTICS_QUEUE = REGISTRY.gauge(
+    "vrpms_analytics_queue_depth",
+    "Flight records waiting in the bounded analytics export queue "
+    "(VRPMS_ANALYTICS_QUEUE caps it; sustained depth near the cap "
+    "precedes drops); refreshed per scrape",
+)
+ANALYTICS_REGRESSIONS = REGISTRY.counter(
+    "vrpms_analytics_regressions_total",
+    "Flight records whose rolling per-(tier, algorithm) quality or "
+    "efficiency EWMA sits past the committed baseline's tolerance "
+    "(benchmarks/records/analytics_baseline.json), by drifted metric",
+    labels=("metric",),
+)
 UPTIME = REGISTRY.gauge(
     "vrpms_uptime_seconds", "Seconds since service process start"
 )
@@ -474,6 +532,17 @@ def refresh_gauges() -> None:
         from vrpms_tpu.obs import export as trace_export
 
         TRACE_EXPORT_QUEUE.set(trace_export.queue_depth())
+    except Exception:
+        pass
+    try:
+        from vrpms_tpu.obs import analytics, slo
+
+        ANALYTICS_QUEUE.set(analytics.queue_depth())
+        for cls, windows in slo.burn_rates().items():
+            for window, stats in windows.items():
+                SLO_BURN.labels(qos=cls, window=window).set(
+                    stats["burnRate"]
+                )
     except Exception:
         pass
     jax_version = "unavailable"
@@ -748,6 +817,46 @@ def _wire_compile_obs() -> None:
         )
     except Exception:
         pass
+    try:
+        from vrpms_tpu.obs import analytics
+
+        analytics.set_observer(
+            lambda outcome, n: ANALYTICS_TOTAL.labels(outcome=outcome).inc(n)
+        )
+        analytics.set_record_observer(_record_flight)
+        analytics.set_regression_observer(
+            lambda metric: ANALYTICS_REGRESSIONS.labels(metric=metric).inc()
+        )
+    except Exception:
+        pass
+
+
+_worst_occupancy = 2.0  # sentinel above any real occupancy
+
+
+def _record_flight(doc: dict) -> None:
+    """Flight-record observer (vrpms_tpu.obs.analytics
+    .set_record_observer): one histogram observation per efficiency
+    signal the record carries. The occupancy exemplar attaches only
+    when the record sets a new worst waste, so the retained exemplar
+    always points at the worst-waste trace."""
+    global _worst_occupancy
+    occ = (doc.get("occupancy") or {}).get("compute")
+    tier = doc.get("tier")
+    if occ is not None and tier:
+        tid = None
+        if float(occ) <= _worst_occupancy:
+            _worst_occupancy = float(occ)
+            tid = doc.get("traceId")
+        PADDING_OCCUPANCY.labels(tier=str(tier)).observe(
+            float(occ), trace_id=tid
+        )
+    fill = (doc.get("batch") or {}).get("fill")
+    if fill is not None:
+        BATCH_FILL.observe(float(fill))
+    ratio = doc.get("overlapRatio")
+    if ratio is not None:
+        PIPELINE_OVERLAP.observe(float(ratio))
 
 
 def _record_progress(sink, snap: dict) -> None:

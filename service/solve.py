@@ -44,6 +44,7 @@ from vrpms_tpu import config
 from vrpms_tpu.core import decompose
 from vrpms_tpu.core import make_instance
 from vrpms_tpu.core import tiers
+from vrpms_tpu.obs import analytics
 from vrpms_tpu.obs import progress
 from vrpms_tpu.core.encoding import routes_from_giant
 from vrpms_tpu.core.split import greedy_split_giant
@@ -754,6 +755,87 @@ def _polish(res, inst, opts, w, t_start):
     return SolveResult(champ, cost, bd, evals), ran
 
 
+def flight_partial(timer, wall_s: float, evals: int,
+                   compile_s: float = 0.0) -> dict:
+    """The solver-side half of a flight record (ISSUE 20): wall clock,
+    throughput, the driver's device/host split, and the vmapped
+    launch's batch fill when the timer saw one. The finish seams merge
+    this with the request-side half (_offer_flight)."""
+    ratio = timer.overlap_ratio()
+    doc = {
+        "wallMs": round(wall_s * 1e3, 1),
+        "evals": int(evals),
+        "evalsPerSec": (
+            round(evals / wall_s, 1) if wall_s > 0 else None
+        ),
+        # 6 decimals: tiny tiers block for microseconds per launch and
+        # must still register a nonzero device share
+        "deviceS": round(timer.wait_s, 6),
+        "hostS": round(timer.overlap_s + timer.host_s, 6),
+        "overlapRatio": None if ratio is None else round(ratio, 4),
+        "blocks": timer.blocks,
+    }
+    if compile_s:
+        doc["compileS"] = round(compile_s, 3)
+    if timer.batch_members is not None and timer.batch_padded:
+        doc["batch"] = {
+            "members": int(timer.batch_members),
+            "padded": int(timer.batch_padded),
+            "maxBatch": max(1, int(config.get("VRPMS_SCHED_MAX_BATCH"))),
+            "fill": round(timer.batch_members / timer.batch_padded, 4),
+        }
+    return doc
+
+
+def _offer_flight(prep: Prepared, res, extras) -> None:
+    """Assemble the completed solve's flight record from the solver
+    partial (extras['flight']) plus everything only the finish seam
+    knows — tier shape + padding occupancy, final cost and gap vs the
+    sink's quick lower bound, the primal integral over the progress
+    profile, cache/warm outcome — and offer it to the analytics
+    exporter. Gated on VRPMS_ANALYTICS (one env read off); must never
+    fail or slow the solve it describes."""
+    if not analytics.enabled():
+        return
+    try:
+        sink = progress.active_sink()
+        job_id = getattr(sink, "job_id", None) or spans.current_trace_id()
+        if not job_id:
+            return  # nothing durable to key the record by
+        doc = dict((extras or {}).get("flight") or {})
+        doc["jobId"] = str(job_id)
+        doc["problem"] = prep.problem
+        doc["algorithm"] = prep.algorithm
+        if prep.inst is not None:
+            doc["tier"] = tiers.tier_label(prep.inst, prep.problem)
+            doc["occupancy"] = tiers.occupancy(prep.inst)
+        doc["cost"] = _as_float(res.cost)
+        lb = getattr(sink, "lower_bound", None)
+        if lb:
+            doc["lowerBound"] = round(float(lb), 6)
+            if lb > 0:
+                doc["gap"] = round((doc["cost"] - lb) / lb, 6)
+        if sink is not None:
+            pi = analytics.primal_integral(sink.profile())
+            if pi is not None:
+                doc["primalIntegral"] = pi
+        doc["cache"] = (
+            prep.cache.get("outcome") if prep.cache else None
+        )
+        doc["warmStart"] = prep.warm is not None
+        doc["qos"] = str(prep.opts.get("qos") or "standard")
+        doc["replica"] = analytics.replica_identity()
+        doc["traceId"] = spans.current_trace_id()
+        doc["finishedAt"] = time.time()
+        analytics.offer(doc)
+    except Exception as e:
+        log_event(
+            "analytics.assemble_error",
+            level="warn",
+            error=f"{type(e).__name__}: {e}",
+        )
+
+
 def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
                 extras=None, continuation=False):
     """Timed + optionally profiled dispatch; returns (res, stats|None).
@@ -773,8 +855,12 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
     compiles0, compile_s0 = compile_obs.snapshot_local()
     # the block-trace collector is installed ONLY under includeStats:
     # without it the solver loops pay one ContextVar read per block and
-    # the result stays byte-identical to the pre-telemetry contract
-    with _profiled(opts) as trace_dir, collect_blocks(include_stats) as btrace:
+    # the result stays byte-identical to the pre-telemetry contract.
+    # The flight timer (ISSUE 20) follows the same rule: installed only
+    # under VRPMS_ANALYTICS, one ContextVar read per solve otherwise.
+    ftimer = analytics.FlightTimer() if analytics.enabled() else None
+    with _profiled(opts) as trace_dir, collect_blocks(include_stats) as btrace, \
+            analytics.flight(ftimer):
         with spans.span(
             "solver.solve", algorithm=algorithm, problem=problem
         ) as solve_span:
@@ -809,6 +895,11 @@ def _run_solver(inst, algorithm, opts, ga_params, errors, problem, warm,
         obs.SOLVE_EVALS.observe(float(res.evals))
         if polished:
             obs.POLISH_SECONDS.observe(polish_s, trace_id=trace_id)
+    if res is not None and ftimer is not None and extras is not None:
+        extras["flight"] = flight_partial(
+            ftimer, wall_s, int(res.evals),
+            compile_s1 - compile_s0 if compiles1 > compiles0 else 0.0,
+        )
     if res is None or not include_stats:
         return res, None
     stats = {
@@ -1047,6 +1138,7 @@ def _finish_vrp(prep: Prepared, res, stats, extras, errors) -> dict:
                 better_than=lambda prev: _better_checkpoint(prev, "vrp", routes, chk_cost),
             )
     result = solution_cache.store_result(prep, result, routes, chk_cost)
+    _offer_flight(prep, res, extras)
     return _mark_degraded(prep, result)
 
 
@@ -1116,7 +1208,8 @@ def _solve_decomposed(prep: Prepared, errors) -> dict | None:
             return
         local = decompose._local_routes(res, int(plan.members[si].size) + 1)
         ckpt_handle.note_shard(si, local, float(res.cost))
-    with _device_ctx(opts.get("backend")):
+    ftimer = analytics.FlightTimer() if analytics.enabled() else None
+    with _device_ctx(opts.get("backend")), analytics.flight(ftimer):
         with spans.span(
             "decompose", shards=plan.n_shards, tier=plan.tier_n
         ) as dspan:
@@ -1269,6 +1362,43 @@ def _solve_decomposed(prep: Prepared, errors) -> dict | None:
                 better_than=lambda prev: _better_checkpoint(
                     prev, "vrp", routes_ids, chk_cost
                 ),
+            )
+    if ftimer is not None:
+        # the decomposed path's flight record: no monolithic Instance
+        # exists, so the tier names the shard ladder rung and occupancy
+        # is omitted; the gap references the plan's shard-sum bound
+        try:
+            doc = flight_partial(ftimer, wall_s, int(evals))
+            job_id = getattr(sink, "job_id", None) or trace_id
+            if job_id:
+                doc.update(
+                    jobId=str(job_id),
+                    problem=prep.problem,
+                    algorithm=prep.algorithm,
+                    tier=f"{prep.problem}:decomposed:{plan.tier_n}",
+                    cost=float(chk_cost),
+                    cache=None,
+                    warmStart=False,
+                    qos=str(opts.get("qos") or "standard"),
+                    replica=analytics.replica_identity(),
+                    traceId=trace_id,
+                    finishedAt=time.time(),
+                )
+                lb = plan.lower_bound
+                if lb:
+                    doc["lowerBound"] = round(float(lb), 6)
+                    if lb > 0:
+                        doc["gap"] = round((doc["cost"] - lb) / lb, 6)
+                if sink is not None:
+                    pi = analytics.primal_integral(sink.profile())
+                    if pi is not None:
+                        doc["primalIntegral"] = pi
+                analytics.offer(doc)
+        except Exception as e:
+            log_event(
+                "analytics.assemble_error",
+                level="warn",
+                error=f"{type(e).__name__}: {e}",
             )
     return _mark_degraded(prep, result)
 
@@ -1427,6 +1557,7 @@ def _finish_tsp(prep: Prepared, res, stats, extras, errors) -> dict:
                 better_than=lambda prev: _better_checkpoint(prev, "tsp", routes, chk_cost),
             )
     result = solution_cache.store_result(prep, result, routes, chk_cost)
+    _offer_flight(prep, res, extras)
     return _mark_degraded(prep, result)
 
 

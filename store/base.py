@@ -522,6 +522,47 @@ class Database:
             out.append(cur)
         return out
 
+    # -- durable flight records (solve analytics extension) -----------------
+    # One row per (job_id, replica): the completed solve's flight record
+    # (device/host split, padding + batch occupancy, evals/sec, cache
+    # outcome, gap, primal integral) as one bounded document, written by
+    # the analytics exporter's background flusher. Same inverted
+    # resilience policy as trace export: an outage drops records — it
+    # must never block, slow, or fail a solve — and reads distinguish
+    # "no rows" ([]) from "store unreachable" (None) so the federated
+    # /api/debug/analytics rollup degrades to local-only honestly.
+    def _put_flight_rows(self, rows: list):
+        raise NotImplementedError
+
+    def _fetch_flight_rows(self, limit: int) -> list:
+        raise NotImplementedError
+
+    def put_flight_records(self, rows: list) -> bool:
+        """Batch-write exported flight rows ({job_id, replica,
+        finished_at, tier, algorithm, doc}); one store call for the
+        whole batch. False on failure (the exporter counts the records
+        as failed)."""
+        if not rows:
+            return True
+        try:
+            self._put_flight_rows(rows)
+        except Exception as exc:
+            self._cache_warn("flight_write", exc)
+            return False
+        self._cache_recovered("flight_write")
+        return True
+
+    def get_flight_records(self, limit: int = 256) -> list | None:
+        """Newest-first flight rows across all replicas; [] when none,
+        None when the store could not be read (degraded marker)."""
+        try:
+            rows = self._fetch_flight_rows(max(1, int(limit)))
+        except Exception as exc:
+            self._cache_warn("flight_read", exc)
+            return None
+        self._cache_recovered("flight_read")
+        return list(rows or [])
+
     # -- durable solve checkpoints (crash-resume extension) -----------------
     # One row per (job id, attempt): a running solve's latest durable
     # incumbent — routes in original location ids, penalized cost,

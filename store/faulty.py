@@ -78,6 +78,12 @@ class _FaultyMixin(_InMemoryMixin):
         self._injector.apply("read")
         return super()._fetch_checkpoint(job_id)
 
+    def _fetch_flight_rows(self, limit):
+        # the federated analytics read; a plan that downs reads must
+        # degrade /api/debug/analytics to local-only, never a 500
+        self._injector.apply("read")
+        return super()._fetch_flight_rows(limit)
+
     def _list_trace_rows(self, limit):
         self._injector.apply("read")
         return super()._list_trace_rows(limit)
@@ -113,6 +119,13 @@ class _FaultyMixin(_InMemoryMixin):
         # the exporter's failed counter ticks once per batch's spans
         self._injector.apply("write")
         return super()._put_trace_rows(rows)
+
+    def _put_flight_rows(self, rows):
+        # one injection per exporter batch (ONE upsert on the real
+        # backend): a plan fails the whole batch or none — the
+        # analytics exporter's failed counter ticks once per record
+        self._injector.apply("write")
+        return super()._put_flight_rows(rows)
 
     def _upsert_checkpoint(self, job_id, attempt, state):
         # a failed checkpoint write must only ever increment

@@ -44,6 +44,7 @@ _tables: dict = {  # guarded-by: _lock
     "job_queue": {},
     "replicas": {},
     "trace_spans": {},
+    "flight_records": {},
     "checkpoints": {},
     "subscriptions": {},
 }
@@ -62,6 +63,7 @@ def reset():
         _tables["job_queue"].clear()
         _tables["replicas"].clear()
         _tables["trace_spans"].clear()
+        _tables["flight_records"].clear()
         _tables["checkpoints"].clear()
         _tables["subscriptions"].clear()
         _tokens.clear()
@@ -231,6 +233,30 @@ class _InMemoryMixin(Database):
             )}
             for row in reversed(rows[-max(1, int(limit)):])
         ]
+
+    # -- durable flight records: bounded per-(job, replica) rows ------------
+    # Same recency discipline as the trace rows: pop-to-refresh keeps
+    # insertion order equal to write recency, eviction drops the
+    # oldest-written row first (flight records are rollup evidence, not
+    # durable state — the Supabase backend pairs its table with a
+    # retention job instead, see store/schema.sql).
+    MAX_FLIGHT_ROWS = 2048
+
+    def _put_flight_rows(self, rows: list):
+        with _lock:
+            table = _tables["flight_records"]
+            for row in rows:
+                key = (str(row.get("job_id")), str(row.get("replica")))
+                table.pop(key, None)  # refresh insertion order
+                table[key] = dict(row)
+            while len(table) > self.MAX_FLIGHT_ROWS:
+                table.pop(next(iter(table)))
+
+    def _fetch_flight_rows(self, limit):
+        with _lock:
+            rows = list(_tables["flight_records"].values())
+        # newest-written first (the rollup wants the fresh tail)
+        return [dict(row) for row in reversed(rows[-max(1, int(limit)):])]
 
     # -- durable solve checkpoints: bounded per-(job, attempt) rows ---------
     # Insertion order is write recency; eviction drops the oldest-

@@ -767,6 +767,19 @@ class _ResilientMixin(Database):
     def _list_trace_rows(self, limit):
         return self._cache_call("_list_trace_rows", (limit,))
 
+    # -- flight-record primitives: the trace rows' exact policy -------------
+    # A flight record is rollup evidence, recomputable from nothing: a
+    # failed write is a dropped record (counted by the analytics
+    # exporter), a failed read degrades /api/debug/analytics to
+    # local-only with an honest marker. Single attempt, no retries, no
+    # degraded-cache fallback, no journal spooling; the per-call
+    # deadline and shared breaker still apply.
+    def _put_flight_rows(self, rows):
+        return self._cache_call("_put_flight_rows", (rows,))
+
+    def _fetch_flight_rows(self, limit):
+        return self._cache_call("_fetch_flight_rows", (limit,))
+
 
 class ResilientDatabaseVRP(_ResilientMixin, DatabaseVRP):
     pass

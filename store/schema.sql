@@ -182,6 +182,34 @@ create table if not exists trace_spans (
 create index if not exists trace_spans_updated_at
   on trace_spans (updated_at desc);
 
+-- Durable flight records (solve analytics; store/base.py flight seam,
+-- vrpms_tpu/obs/analytics.py): one row per (job_id, replica) holding
+-- the completed solve's flight record as one bounded jsonb document —
+-- device/host split and overlap ratio, padding + batch occupancy,
+-- evals/sec, compile seconds, cache outcome, cost/gap/primal integral
+-- (serialize_record bounds the doc, trimming the progress profile
+-- first). The summary columns (finished_at epoch seconds, tier,
+-- algorithm) exist for retention and grouped scans. Strictly
+-- best-effort: writes are single-attempt behind the shared circuit
+-- breaker (store/resilient.py) and an outage drops records, never
+-- blocks a solve. Rows accumulate with traffic: pair with a retention
+-- job, e.g. pg_cron:
+--   delete from flight_records where updated_at < now() - '7 days';
+-- (the in-memory backend bounds itself at store.memory
+-- MAX_FLIGHT_ROWS).
+create table if not exists flight_records (
+  job_id text not null,
+  replica text not null,
+  finished_at double precision,     -- solve finish, epoch seconds
+  tier text,                        -- padded tier label, e.g. vrp:64x8x1
+  algorithm text,
+  doc jsonb not null,               -- the flight record document
+  updated_at timestamptz not null default now(),
+  primary key (job_id, replica)     -- upsert: on_conflict="job_id,replica"
+);
+create index if not exists flight_records_updated_at
+  on flight_records (updated_at desc);
+
 -- Durable solve checkpoints (crash-resumable solves; store/base.py
 -- checkpoint seam, service/checkpoint.py): one row per (job id,
 -- attempt) holding the running solve's latest durable incumbent —

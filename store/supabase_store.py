@@ -185,6 +185,34 @@ class _SupabaseMixin(Database):
         )
         return list(result.data)
 
+    def _put_flight_rows(self, rows: list):
+        # one upsert for the whole analytics-exporter batch (K records
+        # = ONE network round trip); updated_at rides the payload for
+        # the retention job and the newest-first read
+        from datetime import datetime, timezone
+
+        now = datetime.now(timezone.utc).isoformat()
+        return (
+            self.client.table("flight_records")
+            .upsert(
+                [dict(row, updated_at=now) for row in rows],
+                on_conflict="job_id,replica",
+            )
+            .execute()
+        )
+
+    def _fetch_flight_rows(self, limit):
+        # newest-first full rows: the rollup reads the doc jsonb (it is
+        # compact by construction — serialize_record bounds it)
+        result = (
+            self.client.table("flight_records")
+            .select("*")
+            .order("updated_at", desc=True)
+            .limit(max(1, int(limit)))
+            .execute()
+        )
+        return list(result.data)
+
     def _fetch_checkpoint(self, job_id):
         # latest attempt wins: the resume path wants the newest durable
         # incumbent (an attempt-2 run that checkpointed supersedes the

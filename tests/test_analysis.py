@@ -29,6 +29,7 @@ from vrpms_tpu.analysis.config_rules import (
     UnknownVarRule,
 )
 from vrpms_tpu.analysis.contracts import (
+    DeadSpanRule,
     EnvelopeRule,
     MetricContractRule,
     SpanNameRule,
@@ -414,6 +415,56 @@ class TestServiceContracts:
         assert "solve" in KNOWN_SPAN_NAMES
         assert "store.resilient" in KNOWN_SPAN_NAMES
 
+    def test_dead_span_name_flagged(self, tmp_path):
+        rule = DeadSpanRule(registry=frozenset({"solve", "ghost.step"}))
+        report = lint(tmp_path, """
+            from vrpms_tpu.obs import spans
+
+            KNOWN_SPAN_NAMES = frozenset({"solve", "ghost.step"})
+
+            def work():
+                with spans.span("solve"):
+                    pass
+            """, [rule])
+        assert rules_of(report) == ["contract-span-dead"]
+        assert "ghost.step" in report.findings[0].message
+        # the finding anchors at the registry declaration line
+        assert report.findings[0].line == 4
+
+    def test_dead_span_silent_when_registry_site_unscanned(
+        self, tmp_path
+    ):
+        # a partial scan (one file, no KNOWN_SPAN_NAMES declaration)
+        # has not seen the emission universe — it must not call the
+        # whole registry dead (the CLI-on-a-tmp-tree case)
+        rule = DeadSpanRule(registry=frozenset({"solve", "ghost.step"}))
+        report = lint(tmp_path, """
+            def work():
+                return 1
+            """, [rule])
+        assert report.findings == []
+
+    def test_dead_span_clean_when_all_emitted(self, tmp_path):
+        rule = DeadSpanRule(registry=frozenset({"solve", "stitch"}))
+        report = lint(tmp_path, """
+            from vrpms_tpu.obs import spans
+
+            def work():
+                with spans.span("solve"):
+                    with spans.span_at("stitch", 0.0):
+                        pass
+            """, [rule])
+        assert report.findings == []
+
+    def test_dead_span_suppressed_at_registry_site(self, tmp_path):
+        rule = DeadSpanRule(registry=frozenset({"retired.step"}))
+        report = lint(tmp_path, """
+            # vrpms-lint: disable=contract-span-dead (dashboard keeps the retired name one release)
+            KNOWN_SPAN_NAMES = frozenset({"retired.step"})
+            """, [rule])
+        assert report.findings == []
+        assert [f.rule for f in report.suppressed] == ["contract-span-dead"]
+
 
 # ---------------------------------------------------------------------------
 # Config discipline
@@ -574,7 +625,8 @@ class TestRepoClean:
             "trace-traced-branch", "trace-jit-in-loop",
             "trace-unhashable-static", "contract-envelope",
             "contract-metric-once", "contract-metric-labels",
-            "contract-span-name", "config-env-read", "config-unknown-var",
+            "contract-span-name", "contract-span-dead", "config-env-read",
+            "config-unknown-var",
             "config-doc-sync", "dead-import", "dead-private-symbol",
         ):
             assert rule_id in listed, f"{rule_id} missing from --list-rules"

@@ -1,0 +1,780 @@
+"""Solve analytics tests: flight records, efficiency rollups, SLO burn
+rates, and the regression sentinel (ISSUE 20).
+
+Layers:
+
+  * TestOccupancyMath — padding occupancy vs hand-computed tier pads
+    and the tier label spelling;
+  * TestPrimalIntegral — the step-integral quality score's arithmetic;
+  * TestSloWindows — burn-rate window arithmetic with an injected
+    clock (fast window forgets, slow window remembers, budget math);
+  * TestExporterUnit — off builds nothing, round trip through the
+    store flight seam, bounded queue drops the OLDEST record
+    (counted), fail-open on a down store, oversized docs shed the
+    profile then drop;
+  * TestFlightSeam — the store seam itself: per-(job, replica) upsert,
+    bounded memory table, chaos injection;
+  * TestSentinel — baseline drift flags once per episode and ticks
+    the metric per drifted record;
+  * TestSolverByteIdentity — fixed-seed solver results are
+    bit-identical with a flight timer installed or absent;
+  * TestAnalyticsHTTP (slow) — the debug endpoint end to end:
+    off -> 404, a real solve emits a record whose occupancy matches
+    the known tier pad, federated rollup across two replica
+    identities, local-wins dedupe, store-down degrades (never 500s),
+    the timeline's solve-economics event, the fleet `slo` block, and
+    a deadline-miss moving the burn-rate gauge.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+import store
+import store.memory as mem
+from service import obs as service_obs
+from store.faulty import reset_faults
+from store.resilient import reset_resilience
+from vrpms_tpu.core import tiers
+from vrpms_tpu.core.instance import make_instance
+from vrpms_tpu.io.synth import synth_cvrp
+from vrpms_tpu.obs import analytics, progress, slo, spans
+from vrpms_tpu.solvers.sa import SAParams, solve_sa
+
+LADDER = tiers.TierLadder(
+    tiers.DEFAULT_N_TIERS, tiers.DEFAULT_V_TIERS, tiers.DEFAULT_T_TIERS
+)
+
+
+def _count(outcome: str) -> float:
+    return service_obs.ANALYTICS_TOTAL.labels(outcome=outcome).value
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch):
+    monkeypatch.setenv("VRPMS_STORE", "memory")
+    monkeypatch.delenv("VRPMS_QUEUE", raising=False)
+    monkeypatch.delenv("VRPMS_ANALYTICS", raising=False)
+    mem.reset()
+    reset_faults()
+    reset_resilience()
+    analytics.reset_analytics()
+    analytics.set_store_factory(None)
+    slo.reset_tracker()
+    # service.obs wires these at import; later suites must never have
+    # left stale observers behind
+    analytics.set_observer(
+        lambda outcome, n: service_obs.ANALYTICS_TOTAL.labels(
+            outcome=outcome
+        ).inc(n)
+    )
+    analytics.set_record_observer(service_obs._record_flight)
+    analytics.set_regression_observer(
+        lambda metric: service_obs.ANALYTICS_REGRESSIONS.labels(
+            metric=metric
+        ).inc()
+    )
+    spans.reset_ring()
+    yield
+    analytics.reset_analytics()
+    analytics.set_store_factory(None)
+    slo.reset_tracker()
+    mem.reset()
+    reset_faults()
+    spans.reset_ring()
+
+
+def _flight_doc(job_id=None, replica="r-local", tier="vrp:16x4x1",
+                occ=0.8, **extra):
+    doc = {
+        "jobId": job_id or uuid.uuid4().hex[:12],
+        "replica": replica,
+        "problem": "vrp",
+        "algorithm": "sa",
+        "tier": tier,
+        "occupancy": {"n": 0.81, "v": 0.75, "t": 1.0, "compute": occ},
+        "deviceS": 0.2,
+        "hostS": 0.05,
+        "overlapRatio": 0.6,
+        "blocks": 4,
+        "evals": 1000,
+        "evalsPerSec": 5000.0,
+        "wallMs": 250.0,
+        "gap": 0.1,
+        "finishedAt": 1000.0,
+    }
+    doc.update(extra)
+    return doc
+
+
+def _wait(cond, timeout=10.0, every=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# Occupancy math
+# ---------------------------------------------------------------------------
+
+
+class TestOccupancyMath:
+    def test_padded_occupancy_matches_hand_computation(self):
+        # 13 customers + depot pad to n-tier 16; 3 vehicles to v-tier 4
+        inst = synth_cvrp(13, 3, seed=0)
+        p = tiers.pad_instance(inst, LADDER)
+        assert p.durations.shape[-1] == 16
+        occ = tiers.occupancy(p)
+        assert occ == {
+            "n": round(13 / 16, 4),
+            "v": 0.75,
+            "t": 1.0,
+            "compute": round((13 + 3) / (16 + 4), 4),
+        }
+        assert tiers.tier_label(p) == "vrp:16x4x1"
+        assert tiers.tier_label(p, "tsp") == "tsp:16x4x1"
+
+    def test_unpadded_instance_is_fully_occupied(self):
+        inst = synth_cvrp(13, 3, seed=0)
+        occ = tiers.occupancy(inst)
+        assert occ == {"n": 1.0, "v": 1.0, "t": 1.0, "compute": 1.0}
+        assert tiers.tier_label(inst) == "vrp:13x3x1"
+
+    def test_slice_axis_reports_known_t_real(self):
+        d = np.ones((8, 10, 10))
+        np.einsum("tii->ti", d)[:] = 0.0
+        ti = make_instance(d, slice_axis="first")
+        p = tiers.pad_instance(ti, LADDER)
+        occ = tiers.occupancy(p, t_real=8)
+        assert occ["t"] == round(8 / p.durations.shape[0], 4)
+        # absent t_real the cyclic-tiled axis reads as fully occupied
+        assert tiers.occupancy(p)["t"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Primal integral
+# ---------------------------------------------------------------------------
+
+
+class TestPrimalIntegral:
+    def test_none_without_profile_or_gaps(self):
+        assert analytics.primal_integral(None) is None
+        assert analytics.primal_integral({}) is None
+        assert analytics.primal_integral(
+            {"improvements": [{"wallMs": 5.0, "bestCost": 10.0}]}
+        ) is None
+
+    def test_step_integral_hand_case(self):
+        profile = {"improvements": [
+            {"wallMs": 0.0, "gap": 0.6},
+            {"wallMs": 5.0, "gap": 0.2},
+            {"wallMs": 10.0, "gap": 0.2},
+        ]}
+        # 0.6 holds over [0, 5), 0.2 over [5, 10): (3 + 1) / 10
+        assert analytics.primal_integral(profile) == 0.4
+
+    def test_first_gap_charged_from_zero(self):
+        profile = {"improvements": [
+            {"wallMs": 10.0, "gap": 0.5},
+            {"wallMs": 20.0, "gap": 0.1},
+        ]}
+        assert analytics.primal_integral(profile) == 0.5
+
+    def test_single_instant_snapshot_returns_its_gap(self):
+        profile = {"improvements": [{"wallMs": 0.0, "gap": 0.3}]}
+        assert analytics.primal_integral(profile) == 0.3
+
+
+# ---------------------------------------------------------------------------
+# SLO window arithmetic
+# ---------------------------------------------------------------------------
+
+
+class TestSloWindows:
+    def test_burn_rate_budget_math(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_SLO_TARGET", "0.9")  # budget 0.1
+        now = [1000.0]
+        t = slo.SloTracker(clock=lambda: now[0])
+        t.note("interactive", True)
+        t.note("interactive", False)
+        rates = t.burn_rates()["interactive"]
+        # 1 miss of 2 = 0.5 miss fraction / 0.1 budget = burn 5.0
+        for window in ("fast", "slow"):
+            assert rates[window] == {
+                "burnRate": 5.0, "total": 2, "met": 1,
+            }
+
+    def test_fast_window_forgets_slow_window_remembers(self):
+        now = [1000.0]
+        t = slo.SloTracker(clock=lambda: now[0])
+        t.note("standard", False)
+        now[0] += 600.0  # past the 300 s fast window, inside the 1 h
+        t.note("standard", True)
+        rates = t.burn_rates()["standard"]
+        assert rates["fast"]["total"] == 1
+        assert rates["fast"]["burnRate"] == 0.0
+        assert rates["slow"]["total"] == 2
+        assert rates["slow"]["burnRate"] > 0.0
+
+    def test_empty_window_burns_zero_and_absent_class_missing(self):
+        now = [1000.0]
+        t = slo.SloTracker(clock=lambda: now[0])
+        t.note("batch", False)
+        now[0] += 7200.0  # everything aged out of both windows
+        rates = t.burn_rates()
+        assert rates["batch"]["slow"] == {
+            "burnRate": 0.0, "total": 0, "met": 0,
+        }
+        assert "interactive" not in rates
+
+    def test_outcome_cap_bounds_memory(self):
+        t = slo.SloTracker(clock=lambda: 1000.0)
+        for i in range(slo.MAX_OUTCOMES + 50):
+            t.note("standard", True)
+        assert len(t._outcomes["standard"]) == slo.MAX_OUTCOMES
+
+    def test_fleet_block_shape(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_SLO_TARGET", "0.95")
+        slo.note("standard", False)
+        block = slo.fleet_block()
+        assert block["objective"] == "deadline-met"
+        assert block["target"] == 0.95
+        assert block["windows"] == {"fast": 300.0, "slow": 3600.0}
+        assert block["classes"]["standard"]["fast"]["burnRate"] > 1.0
+
+    def test_module_burn_rates_empty_until_noted(self):
+        assert slo.burn_rates() == {}  # reading never builds a tracker
+
+
+# ---------------------------------------------------------------------------
+# Exporter unit layer
+# ---------------------------------------------------------------------------
+
+
+class TestExporterUnit:
+    def test_off_by_default_builds_nothing_and_writes_nothing(self):
+        analytics.offer(_flight_doc())
+        assert analytics._exporter is None
+        assert analytics.recent_records() == []
+        assert mem._tables["flight_records"] == {}
+        assert analytics.queue_depth() == 0  # reading builds nothing
+
+    def test_round_trip_through_store_seam(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_ANALYTICS", "on")
+        ok0 = _count("ok")
+        doc = _flight_doc(job_id="j-round")
+        analytics.offer(doc)
+        assert analytics.recent_for_job("j-round")["tier"] == "vrp:16x4x1"
+        assert analytics.flush(10.0)
+        rows = store.get_database("vrp", None).get_flight_records()
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["job_id"] == "j-round"
+        assert row["replica"] == "r-local"
+        assert row["tier"] == "vrp:16x4x1"
+        assert row["algorithm"] == "sa"
+        assert row["doc"]["evals"] == 1000
+        assert _count("ok") - ok0 == 1
+
+    def test_record_without_job_id_is_not_offered(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_ANALYTICS", "on")
+        analytics.offer({"tier": "vrp:16x4x1"})
+        assert analytics._exporter is None
+        assert analytics.recent_records() == []
+
+    def test_queue_overflow_drops_oldest(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_ANALYTICS", "on")
+        gate = threading.Event()
+        written: list = []
+
+        class SlowDB:
+            def put_flight_records(self, rows):
+                gate.wait(10)
+                written.extend(rows)
+                return True
+
+        analytics.set_store_factory(lambda: SlowDB())
+        dropped0 = _count("dropped")
+        exp = analytics.AnalyticsExporter(queue_cap=2, batch=1,
+                                          flush_s=0.01)
+        try:
+            for i in range(5):
+                exp.offer(_flight_doc(job_id=f"j{i}"))
+            # flusher holds one in flight; cap 2 -> at least 2 dropped
+            assert _wait(
+                lambda: _count("dropped") - dropped0 >= 2
+            ), _count("dropped")
+        finally:
+            gate.set()
+            exp.stop(2.0)
+        assert written  # the survivors were still written
+        # the newest evidence survived the drop-oldest policy
+        assert any(r["job_id"] == "j4" for r in written)
+
+    def test_store_failure_counts_failed_and_never_raises(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_ANALYTICS", "on")
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        failed0 = _count("failed")
+        analytics.offer(_flight_doc(job_id="j-fail"))  # must not raise
+        assert analytics.flush(10.0)
+        assert _count("failed") - failed0 == 1
+        assert analytics.queue_depth() == 0
+        # the process-local half survives the outage
+        assert analytics.recent_for_job("j-fail") is not None
+
+    def test_oversized_doc_sheds_profile_then_drops(self):
+        doc = _flight_doc(profile={
+            "improvements": [
+                {"wallMs": float(i), "bestCost": 1.0}
+                for i in range(4000)
+            ],
+        })
+        row = analytics.serialize_record(doc)
+        assert row is not None
+        assert "profile" not in row["doc"]
+        assert row["doc"]["truncated"] is True
+        # a core that is itself too big has nothing left to shed
+        big = _flight_doc(tier="x" * (analytics.MAX_ROW_BYTES + 1024))
+        assert analytics.serialize_record(big) is None
+
+
+# ---------------------------------------------------------------------------
+# Store flight seam
+# ---------------------------------------------------------------------------
+
+
+class TestFlightSeam:
+    def _row(self, job_id, replica, occ=0.8):
+        return analytics.serialize_record(
+            _flight_doc(job_id=job_id, replica=replica, occ=occ)
+        )
+
+    def test_rows_upsert_per_job_and_replica(self):
+        db = store.get_database("vrp", None)
+        assert db.put_flight_records([self._row("a", "r1")])
+        assert db.put_flight_records([self._row("a", "r1", occ=0.9)])
+        assert db.put_flight_records([self._row("a", "r2")])
+        rows = db.get_flight_records()
+        assert len(rows) == 2
+        mine = [r for r in rows if r["replica"] == "r1"]
+        assert mine[0]["doc"]["occupancy"]["compute"] == 0.9
+
+    def test_empty_batch_is_a_noop_success(self):
+        assert store.get_database("vrp", None).put_flight_records([])
+
+    def test_memory_table_stays_bounded(self):
+        db = store.get_database("vrp", None)
+        cap = mem._InMemoryMixin.MAX_FLIGHT_ROWS
+        mem._tables["flight_records"].update({
+            (f"j{i}", "a"): {"job_id": f"j{i}", "replica": "a"}
+            for i in range(cap)
+        })
+        db.put_flight_records([self._row("fresh", "a")])
+        assert len(mem._tables["flight_records"]) == cap
+        assert ("fresh", "a") in mem._tables["flight_records"]
+
+    def test_faulty_plan_injects(self, monkeypatch):
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        db = store.get_database("vrp", None)
+        assert db.put_flight_records([self._row("a", "r1")]) is False
+        assert db.get_flight_records() is None
+
+
+# ---------------------------------------------------------------------------
+# Regression sentinel
+# ---------------------------------------------------------------------------
+
+
+class TestSentinel:
+    BASELINE = {
+        "tiers": {"vrp:16x4x1|sa": {"gap": 0.1, "evalsPerSec": 5000.0}},
+        "tolerance": {"gap": 0.25, "evalsPerSec": 0.25},
+        "minSamples": 2,
+    }
+
+    def test_drift_flags_once_per_episode_and_ticks_metric(self):
+        reg0 = service_obs.ANALYTICS_REGRESSIONS.labels(
+            metric="gap"
+        ).value
+        s = analytics.RegressionSentinel(baseline=self.BASELINE)
+        for _ in range(4):
+            s.note(_flight_doc(gap=0.5))  # EWMA pulls far above 0.125
+        snap = s.snapshot()
+        assert snap["flagged"] == ["vrp:16x4x1|sa:gap"]
+        assert snap["baselineKeys"] == ["vrp:16x4x1|sa"]
+        # metric ticks per drifted record past min samples
+        assert service_obs.ANALYTICS_REGRESSIONS.labels(
+            metric="gap"
+        ).value - reg0 >= 2
+        # recovery clears the episode latch
+        for _ in range(30):
+            s.note(_flight_doc(gap=0.1))
+        assert s.snapshot()["flagged"] == []
+
+    def test_healthy_records_never_flag(self):
+        s = analytics.RegressionSentinel(baseline=self.BASELINE)
+        for _ in range(10):
+            s.note(_flight_doc(gap=0.1, evalsPerSec=5000.0))
+        assert s.snapshot()["flagged"] == []
+
+    def test_unknown_key_and_missing_baseline_inert(self):
+        s = analytics.RegressionSentinel(baseline=self.BASELINE)
+        s.note(_flight_doc(tier="vrp:999x1x1", gap=9.0))
+        assert s.snapshot()["flagged"] == []
+        inert = analytics.RegressionSentinel(baseline={})
+        inert.note(_flight_doc(gap=9.0))
+        assert inert.snapshot()["flagged"] == []
+
+    def test_committed_baseline_parses(self):
+        with open(analytics.BASELINE_PATH) as f:
+            baseline = json.load(f)
+        assert baseline["tiers"]
+        for entry in baseline["tiers"].values():
+            assert set(entry) <= {"gap", "evalsPerSec"}
+
+
+# ---------------------------------------------------------------------------
+# Solver byte identity
+# ---------------------------------------------------------------------------
+
+
+class TestSolverByteIdentity:
+    def test_timer_installed_vs_absent_identical(self):
+        rng = np.random.default_rng(3)
+        pts = rng.uniform(0, 100, size=(10, 2))
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        demands = np.concatenate([[0], rng.uniform(1, 4, size=9)])
+        inst = make_instance(d, demands=demands, capacities=[14, 14])
+        results = {}
+        for mode in ("timed", "bare"):
+            timer = analytics.FlightTimer() if mode == "timed" else None
+            sink = progress.ProgressSink(job_id=f"bi-{mode}")
+            with progress.attach(sink), analytics.flight(timer):
+                res = solve_sa(
+                    inst, key=0,
+                    params=SAParams(n_chains=16, n_iters=900),
+                    deadline_s=3600.0,
+                )
+            results[mode] = (res, sink.snapshot()["bestCost"])
+            if timer is not None:
+                # the drivers really fed the timer
+                assert timer.blocks >= 1
+                assert timer.wait_s > 0.0
+        timed, bare = results["timed"], results["bare"]
+        assert np.array_equal(
+            np.asarray(timed[0].giant), np.asarray(bare[0].giant)
+        )
+        assert float(timed[0].cost) == float(bare[0].cost)
+        assert float(timed[0].evals) == float(bare[0].evals)
+        assert timed[1] == bare[1]
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+
+
+def _seed_dataset(key, n, seed=11):
+    rng = np.random.default_rng(seed)
+    pts = rng.uniform(0, 100, size=(n, 2))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    mem.seed_locations(
+        key, [{"id": i, "demand": 2 if i else 0} for i in range(n)]
+    )
+    mem.seed_durations(key, d.tolist())
+
+
+def _solve_content(key, n, seed=1, **extra):
+    content = {
+        "problem": "vrp",
+        "algorithm": "sa",
+        "solutionName": f"an-{key}-{seed}",
+        "solutionDescription": "t",
+        "locationsKey": key,
+        "durationsKey": key,
+        "capacities": [2 * n] * 3,
+        "startTimes": [0, 0, 0],
+        "ignoredCustomers": [],
+        "completedCustomers": [],
+        "seed": seed,
+        "iterationCount": 200,
+        "populationSize": 8,
+    }
+    content.update(extra)
+    return content
+
+
+@pytest.fixture(scope="module")
+def server():
+    import os
+
+    os.environ["VRPMS_STORE"] = "memory"
+    from service import jobs as jobs_mod
+    from service.app import serve
+
+    jobs_mod.shutdown_scheduler()
+    srv = serve(port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    jobs_mod.shutdown_scheduler()
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _poll(base, job_id, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, resp = _get(base, f"/api/jobs/{job_id}")
+        assert status == 200, resp
+        if resp["job"]["status"] in ("done", "failed", "expired"):
+            return resp["job"]
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never finished")
+
+
+class TestAnalyticsHTTP:
+    @pytest.fixture(autouse=True)
+    def env(self, server, monkeypatch):
+        from service import jobs as jobs_mod
+
+        monkeypatch.setenv("VRPMS_ANALYTICS", "on")
+        _seed_dataset("an7", 7)
+        yield
+        jobs_mod.shutdown_scheduler()
+
+    def test_endpoint_404s_with_analytics_off(self, server, monkeypatch):
+        monkeypatch.setenv("VRPMS_ANALYTICS", "off")
+        # the router's plain unrouted 404, byte-identical to the
+        # pre-analytics service
+        try:
+            urllib.request.urlopen(
+                server + "/api/debug/analytics", timeout=30
+            )
+            raise AssertionError("expected a 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert e.read() == b"Not found"
+
+    def test_solve_emits_record_with_known_tier_pad(self, server):
+        status, resp = _post(
+            server, "/api/vrp/sa", _solve_content("an7", 7)
+        )
+        assert status == 200, resp
+        assert resp["success"] is True
+        recs = analytics.recent_records()
+        assert recs, "no flight record emitted"
+        doc = recs[0]
+        # 7 nodes pad to n-tier 8, 3 vehicles to v-tier 4
+        assert doc["tier"] == "vrp:8x4x1"
+        assert doc["occupancy"] == {
+            "n": round(7 / 8, 4),
+            "v": 0.75,
+            "t": 1.0,
+            "compute": round((7 + 3) / (8 + 4), 4),
+        }
+        assert doc["algorithm"] == "sa"
+        assert doc["deviceS"] > 0.0
+        assert doc["evals"] > 0
+        assert doc["replica"]
+        assert doc["cache"] in (
+            None, "miss", "exact", "near", "warm", "resolve",
+        )
+        # durable half: the row reaches the store flight seam
+        assert analytics.flush(10.0)
+        rows = store.get_database("vrp", None).get_flight_records()
+        assert any(r["job_id"] == doc["jobId"] for r in rows)
+
+    def test_off_switch_keeps_fixed_seed_response_byte_identical(
+        self, server, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_CACHE", "off")
+        responses = {}
+        for mode in ("off", "on"):
+            monkeypatch.setenv("VRPMS_ANALYTICS", mode)
+            status, resp = _post(
+                server, "/api/vrp/sa",
+                _solve_content("an7", 7, seed=17),
+            )
+            assert status == 200, resp
+            responses[mode] = resp
+        on, off = responses["on"], responses["off"]
+        # identical payloads modulo the per-request correlation ids
+        for r in (on, off):
+            r.pop("requestId", None)
+            r.pop("traceId", None)
+        assert on == off
+        # ...and off-mode left no analytics residue anywhere
+        monkeypatch.setenv("VRPMS_ANALYTICS", "off")
+        analytics.reset_analytics()
+        mem._tables["flight_records"].clear()
+        status, resp = _post(
+            server, "/api/vrp/sa", _solve_content("an7", 7, seed=18)
+        )
+        assert status == 200, resp
+        assert analytics._exporter is None
+        assert analytics.recent_records() == []
+        assert mem._tables["flight_records"] == {}
+
+    def test_rollup_federates_two_replicas_local_wins(self, server):
+        db = store.get_database("vrp", None)
+        # a peer's exported rows: one sharing (jobId, replica) with the
+        # local ring (stale occupancy — the local doc must win), one
+        # only the store knows
+        local = _flight_doc(job_id="j-shared", replica="r-here", occ=0.9)
+        analytics.offer(local)
+        stale = analytics.serialize_record(
+            _flight_doc(job_id="j-shared", replica="r-here", occ=0.1)
+        )
+        peer = analytics.serialize_record(
+            _flight_doc(
+                job_id="j-peer", replica="peer-1",
+                tier="vrp:128x8x1", occ=0.2, gap=0.4,
+            )
+        )
+        assert db.put_flight_records([stale, peer])
+        status, resp = _get(server, "/api/debug/analytics")
+        assert status == 200, resp
+        assert "degraded" not in resp
+        rollup = resp["analytics"]
+        assert rollup["records"] == 2
+        assert sorted(rollup["replicas"]) == ["peer-1", "r-here"]
+        by_tier = {t["tier"]: t for t in rollup["tiers"]}
+        # worst padding waste ranks first -> the tier-ladder hint
+        assert rollup["tiers"][0]["tier"] == "vrp:128x8x1"
+        assert rollup["tiers"][0]["paddingWaste"] == 0.8
+        assert "hint" in rollup["tiers"][0]
+        # local won the (job, replica) conflict: 0.9, not the stale 0.1
+        assert by_tier["vrp:16x4x1"]["meanOccupancy"] == 0.9
+        assert "hint" not in by_tier["vrp:16x4x1"]
+        algos = {a["algorithm"]: a for a in rollup["algorithms"]}
+        assert algos["sa"]["solves"] == 2
+        assert rollup["pipeline"]["meanOverlapRatio"] == 0.6
+        assert resp["sentinel"]["baselineKeys"]
+        assert resp["slo"]["objective"] == "deadline-met"
+
+    def test_rollup_store_down_degrades_never_500s(
+        self, server, monkeypatch
+    ):
+        analytics.offer(_flight_doc(job_id="j-local", replica="r-here"))
+        monkeypatch.setenv("VRPMS_STORE", "faulty:down")
+        status, resp = _get(server, "/api/debug/analytics")
+        assert status == 200, resp
+        assert resp["degraded"] is True
+        # the local ring still serves the rollup
+        assert resp["analytics"]["records"] == 1
+
+    def test_batch_fill_hint_when_launches_run_empty(self, server):
+        analytics.offer(_flight_doc(
+            job_id="j-b", replica="r-here",
+            batch={"members": 1, "padded": 8, "maxBatch": 16,
+                   "fill": 0.125},
+        ))
+        status, resp = _get(server, "/api/debug/analytics")
+        assert status == 200, resp
+        batch = resp["analytics"]["batch"]
+        assert batch["launches"] == 1
+        assert batch["meanFill"] == 0.125
+        assert "VRPMS_SCHED_WINDOW_MS" in batch["hint"]
+
+    def test_timeline_closes_with_solve_economics(self, server):
+        status, resp = _post(
+            server, "/api/jobs", _solve_content("an7", 7, seed=5)
+        )
+        assert status == 202, resp
+        job = _poll(server, resp["jobId"])
+        assert job["status"] == "done"
+        status, resp = _get(server, f"/api/jobs/{job['id']}/timeline")
+        assert status == 200, resp
+        econ = [
+            e for e in resp["timeline"] if e["event"] == "solve.economics"
+        ]
+        assert len(econ) == 1
+        flight = econ[0]["flight"]
+        assert flight["jobId"] == job["id"]
+        assert flight["tier"] == "vrp:8x4x1"
+        assert "solve economics:" in econ[0]["detail"]
+        # analytics off: the same surface stays byte-identical to the
+        # pre-analytics timeline (no economics event)
+        import os
+
+        os.environ["VRPMS_ANALYTICS"] = "off"
+        try:
+            status, resp = _get(
+                server, f"/api/jobs/{job['id']}/timeline"
+            )
+        finally:
+            os.environ["VRPMS_ANALYTICS"] = "on"
+        assert status == 200
+        assert not [
+            e for e in resp["timeline"] if e["event"] == "solve.economics"
+        ]
+
+    def test_deadline_miss_moves_burn_rate_and_fleet_slo(self, server):
+        # a 5 ms budget cannot cover a real solve: whatever terminal
+        # path the job takes (late done / expired / failed) it is a
+        # deadline miss for its class
+        status, resp = _post(
+            server, "/api/jobs",
+            _solve_content("an7", 7, seed=9, timeLimit=0.005,
+                           qos="interactive"),
+        )
+        assert status == 202, resp
+        _poll(server, resp["jobId"])
+        rates = slo.burn_rates()
+        assert rates["interactive"]["fast"]["burnRate"] > 0.0
+        assert rates["interactive"]["fast"]["total"] >= 1
+        # the gauge follows at scrape time
+        service_obs.refresh_gauges()
+        assert service_obs.SLO_BURN.labels(
+            qos="interactive", window="fast"
+        ).value > 0.0
+        # ...and the fleet rollup serves the same block
+        status, resp = _get(server, "/api/debug/fleet")
+        assert status == 200, resp
+        fleet_slo = resp["fleet"]["slo"]
+        assert fleet_slo["objective"] == "deadline-met"
+        assert (
+            fleet_slo["classes"]["interactive"]["fast"]["burnRate"] > 0.0
+        )
+
+    def test_fleet_has_no_slo_block_when_analytics_off(
+        self, server, monkeypatch
+    ):
+        monkeypatch.setenv("VRPMS_ANALYTICS", "off")
+        status, resp = _get(server, "/api/debug/fleet")
+        assert status == 200, resp
+        assert "slo" not in resp["fleet"]

@@ -31,6 +31,7 @@ from vrpms_tpu.analysis.config_rules import (
     UnknownVarRule,
 )
 from vrpms_tpu.analysis.contracts import (
+    DeadSpanRule,
     EnvelopeRule,
     MetricContractRule,
     SpanNameRule,
@@ -59,6 +60,7 @@ def default_rules() -> list:
         EnvelopeRule(),
         MetricContractRule(),
         SpanNameRule(),
+        DeadSpanRule(),
         EnvReadRule(),
         UnknownVarRule(),
         DocSyncRule(),

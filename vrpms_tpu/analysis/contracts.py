@@ -20,6 +20,11 @@ review:
     ``vrpms_tpu.obs.spans.KNOWN_SPAN_NAMES``, the span registry the
     dashboards and tests key on. Dynamic names (the HTTP root span) are
     out of scope.
+  * ``contract-span-dead`` — the inverse direction: every
+    ``KNOWN_SPAN_NAMES`` entry is still emitted by at least one literal
+    ``span()``/``span_at()`` call somewhere in the production tree. A
+    registered-but-never-emitted name is dead registry weight —
+    dashboards and waterfall tests key on a span that can never appear.
 """
 
 from __future__ import annotations
@@ -260,3 +265,68 @@ class SpanNameRule(Rule):
                     ),
                 ))
         return findings
+
+
+class DeadSpanRule(Rule):
+    """Project rule: flag KNOWN_SPAN_NAMES entries no scanned file
+    emits through a literal ``span()``/``span_at()`` call. Findings
+    anchor at the registry declaration (that is the line to fix —
+    delete the entry or re-emit the span). A scan that never saw the
+    declaration site stays silent: a partial scan has not seen the
+    emission universe, so it cannot honestly call a name dead."""
+
+    name = "contract-span-dead"
+
+    def __init__(self, registry=None):
+        self._registry = registry
+        self.reset()
+
+    def reset(self) -> None:
+        #: literal span names seen emitted anywhere in the scan
+        self.emitted: set = set()
+        #: (file, line) of the KNOWN_SPAN_NAMES assignment, if scanned
+        self.registry_site: tuple | None = None
+
+    @property
+    def registry(self):
+        if self._registry is None:
+            self._registry = _span_registry()
+        return self._registry
+
+    def collect(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id == "KNOWN_SPAN_NAMES":
+                        self.registry_site = (ctx.rel, node.lineno)
+        if ctx.rel.endswith("obs/spans.py"):
+            return  # the collector's own internals are not emissions
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node.func).split(".")[-1] not in (
+                "span", "span_at",
+            ):
+                continue
+            name = first_str_arg(node)
+            if name is not None:
+                self.emitted.add(name)
+
+    def finalize(self, project):
+        if self.registry_site is None:
+            return []
+        rel, line = self.registry_site
+        return [
+            Finding(
+                rule=self.name,
+                file=rel,
+                line=line,
+                message=(
+                    f"span name {name!r} is registered in "
+                    "KNOWN_SPAN_NAMES but no span()/span_at() call "
+                    "emits it — drop the entry or restore the emission"
+                ),
+            )
+            for name in sorted(set(self.registry) - self.emitted)
+        ]

@@ -287,6 +287,26 @@ REGISTRY: dict[str, Var] = {
         _v("VRPMS_TRACE_EXPORT_FLUSH_MS", "float", 50.0,
            "Idle wait between exporter flush rounds in milliseconds "
            "(a non-empty queue flushes immediately)."),
+        _v("VRPMS_ANALYTICS", "switch", False,
+           "Solve analytics: every completed solve emits a flight "
+           "record (device/host split, padding + batch occupancy, "
+           "evals/sec, cache outcome, gap, primal integral) exported "
+           "through the store's flight_records seam, rolled up on "
+           "GET /api/debug/analytics, with per-QoS-class SLO burn "
+           "rates and the regression sentinel. Off (the default) = "
+           "byte-identical responses."),
+        _v("VRPMS_ANALYTICS_QUEUE", "int", 256,
+           "Bounded flight-record export queue; overflow DROPS the "
+           "oldest record (counted "
+           "vrpms_analytics_total{outcome=dropped}), never blocks a "
+           "solve."),
+        _v("VRPMS_ANALYTICS_FLUSH_MS", "float", 50.0,
+           "Idle wait between analytics flusher rounds in milliseconds "
+           "(a non-empty queue flushes immediately)."),
+        _v("VRPMS_SLO_TARGET", "float", 0.99,
+           "Deadline-met SLO objective per QoS class: the burn rate is "
+           "the observed miss fraction over each window divided by the "
+           "allowed miss budget (1 - target)."),
         _v("VRPMS_ILS_TRACE", "str", None,
            "Truthy: print ILS round-by-round trace lines to stderr."),
         # -- solver + compile knobs ------------------------------------

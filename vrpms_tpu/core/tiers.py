@@ -297,6 +297,44 @@ def maybe_pad(inst: Instance) -> Instance:
     return inst if lad is None else pad_instance(inst, lad)
 
 
+def tier_label(inst: Instance, problem: str | None = None) -> str:
+    """Human/metric label for an instance's padded shape:
+    "<problem>:<N>x<V>x<T>" (the warmup-spec spelling). Unpadded
+    instances label their real shape — the tier they effectively are."""
+    shape = tuple(np.asarray(inst.durations).shape)
+    t, n = (shape[0], shape[1]) if len(shape) == 3 else (1, shape[0])
+    return f"{problem or 'vrp'}:{n}x{int(inst.n_vehicles)}x{t}"
+
+
+def occupancy(inst: Instance, t_real: int | None = None) -> dict:
+    """Padding occupancy of a (possibly tier-padded) instance: the real
+    fraction of each padded axis plus `compute`, the fraction of the
+    padded compute volume spent on real structure — 1 - compute is the
+    cost burned on phantoms. The compute model is the solver inner
+    loop's: work scales with the giant-tour length L = N + V (moves,
+    pricing scans are linear in L; the slice axis only selects rows, so
+    T contributes selection width, not volume — it rides along as its
+    own axis ratio and stays out of `compute`).
+
+    The padded Instance carries n_real/v_real as traced data; the slice
+    axis keeps no t_real (cyclic tiling is exact), so callers that know
+    the pre-pad T pass it — absent, the axis reports full occupancy."""
+    shape = tuple(np.asarray(inst.durations).shape)
+    t_pad, n_pad = (shape[0], shape[1]) if len(shape) == 3 else (1, shape[0])
+    v_pad = int(inst.n_vehicles)
+    n_real = n_pad if inst.n_real is None else int(inst.n_real)
+    v_real = v_pad if inst.v_real is None else int(inst.v_real)
+    t_r = t_pad if t_real is None else min(int(t_real), t_pad)
+    l_real = n_real + v_real
+    l_pad = n_pad + v_pad
+    return {
+        "n": round(n_real / max(1, n_pad), 4),
+        "v": round(v_real / max(1, v_pad), 4),
+        "t": round(t_r / max(1, t_pad), 4),
+        "compute": round(l_real / max(1, l_pad), 4),
+    }
+
+
 def pad_perm(perm, inst: Instance):
     """Extend a REAL customer permutation (ids 1..n_real-1) with the
     phantom ids at its tail — the warm-start seed adapter (a padded

@@ -1,0 +1,657 @@
+"""Solve analytics: per-solve flight records, durably exported.
+
+Every efficiency and quality signal the stack computes during a solve
+— device-vs-host time split and overlap ratio (the pipelined driver's
+per-block timings), padding occupancy over the tier shape, micro-batch
+fill, evals/sec, compile seconds, cache outcome, gap vs the quick
+lower bound, and the primal-integral quality score over the progress
+profile — used to die with the response. This module is the durable
+half: the service's finish seams assemble one compact *flight record*
+per completed solve and `offer` it here; a bounded queue + background
+flusher batch-writes records through the store's flight seam
+(store.base.put_flight_records — one row per (job_id, replica)), and a
+bounded local ring keeps the newest records for the federated
+GET /api/debug/analytics rollup and the per-job timeline's closing
+"solve economics" event.
+
+Capture rides a ContextVar `FlightTimer` the service installs around a
+solve ONLY when VRPMS_ANALYTICS is on: the solver drivers
+(solvers.common.run_blocked, sched.batch.solve_sa_batch) read it once
+and, with none active, pay a single ContextVar read — fixed-seed
+responses stay byte-identical with the switch off, the contract every
+obs subsystem honors.
+
+Failure policy mirrors the trace exporter (obs.export): queue overflow
+drops the OLDEST record (counted `dropped`), store failures count
+`failed` (single-attempt, fail-open), successes count `ok` — every
+record accounted exactly once via the observer seam
+(vrpms_analytics_total{outcome}).
+
+The regression sentinel compares rolling per-(tier, algorithm) EWMAs
+of gap and evals/sec against a committed baseline snapshot
+(benchmarks/records/analytics_baseline.json; absent = inert) and flags
+drift as a structured `analytics.regression` log event plus a counter
+tick — quality archaeology becomes a dashboard alert.
+
+Stdlib-only, like the rest of vrpms_tpu.obs: the store is reached
+through an injected factory, defaulting to a lazy `store.get_database`
+import on the flusher thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+
+from vrpms_tpu import config
+from vrpms_tpu.obs.logging import log_event
+
+#: hard bound on one flight-record row's serialized document — records
+#: are compact by construction, so an oversized one (a runaway profile)
+#: drops its `profile` block, then drops entirely
+MAX_ROW_BYTES = 32768
+
+#: newest flight records kept in-process for the local half of the
+#: federated rollup and the timeline's economics event
+RECENT_CAP = 256
+
+OK, DROPPED, FAILED = "ok", "dropped", "failed"
+
+
+def enabled() -> bool:
+    return config.enabled("VRPMS_ANALYTICS")
+
+
+# ---------------------------------------------------------------------------
+# FlightTimer: the solver-side capture slot
+# ---------------------------------------------------------------------------
+
+
+class FlightTimer:
+    """Per-solve accumulator the solver drivers write into.
+
+    Installed on a ContextVar by the service ONLY when analytics is on;
+    the drivers read `current_timer()` once per solve and skip every
+    timing call when it is None. Single-threaded by construction: one
+    solve owns one timer on one device-owning thread, so plain
+    attribute adds suffice.
+
+      * wait_s    — host seconds spent blocked in block_until_ready
+                    (the device-side share of the wall clock);
+      * overlap_s — host bookkeeping seconds that ran WHILE another
+                    block was in flight on device (the pipelined
+                    driver's hidden host work);
+      * host_s    — host bookkeeping seconds NOT overlapped (serial
+                    drains, the deadline-free path);
+      * blocks    — device dispatches observed;
+      * batch_members/batch_padded — the vmapped launch's real member
+                    count and its power-of-two padded size
+                    (sched.batch.solve_sa_batch fills these).
+    """
+
+    __slots__ = (
+        "wait_s", "overlap_s", "host_s", "blocks",
+        "batch_members", "batch_padded",
+    )
+
+    def __init__(self):
+        self.wait_s = 0.0
+        self.overlap_s = 0.0
+        self.host_s = 0.0
+        self.blocks = 0
+        self.batch_members = None
+        self.batch_padded = None
+
+    def note_wait(self, seconds: float) -> None:
+        self.wait_s += seconds
+        self.blocks += 1
+
+    def note_host(self, seconds: float, overlapped: bool) -> None:
+        if overlapped:
+            self.overlap_s += seconds
+        else:
+            self.host_s += seconds
+
+    def overlap_ratio(self) -> float | None:
+        """Fraction of observed host bookkeeping hidden behind device
+        compute; None when no bookkeeping was timed (nothing to
+        overlap — e.g. the deadline-free single-block path)."""
+        total = self.overlap_s + self.host_s
+        if total <= 0.0:
+            return None
+        return self.overlap_s / total
+
+
+_active: contextvars.ContextVar = contextvars.ContextVar(
+    "vrpms_flight_timer", default=None
+)
+
+
+def current_timer() -> FlightTimer | None:
+    """The solve's flight timer, if the service installed one — the
+    only call the solver hot path makes."""
+    return _active.get()
+
+
+@contextlib.contextmanager
+def flight(timer: FlightTimer | None):
+    """Install a timer for the duration of a solve; None yields without
+    installing, so callers need no branch."""
+    if timer is None:
+        yield None
+        return
+    token = _active.set(timer)
+    try:
+        yield timer
+    finally:
+        _active.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# Quality scores
+# ---------------------------------------------------------------------------
+
+
+def primal_integral(profile: dict | None) -> float | None:
+    """Time-normalized primal integral over a progress profile
+    (obs.progress.ProgressSink.profile()): the average optimality gap
+    held over the solve's observed wall clock — 0 is ideal (the final
+    incumbent found instantly), larger means quality arrived late.
+
+    The gap is a step function: each improvement's gap holds from its
+    wallMs to the next improvement's. The first snapshot's gap is
+    charged from t=0 (the pre-incumbent span has no better bound), and
+    the last holds to the final snapshot's wallMs. None when the
+    profile is absent or carries no gaps (no lower bound)."""
+    if not profile:
+        return None
+    imps = [
+        s for s in profile.get("improvements", ())
+        if s.get("gap") is not None and s.get("wallMs") is not None
+    ]
+    if not imps:
+        return None
+    end = float(imps[-1]["wallMs"])
+    if end <= 0.0:
+        return round(max(0.0, float(imps[-1]["gap"])), 6)
+    area = 0.0
+    prev_t = 0.0
+    prev_gap = max(0.0, float(imps[0]["gap"]))
+    for snap in imps:
+        t = float(snap["wallMs"])
+        area += prev_gap * max(0.0, t - prev_t)
+        prev_t = t
+        prev_gap = max(0.0, float(snap["gap"]))
+    return round(area / end, 6)
+
+
+# ---------------------------------------------------------------------------
+# Seams: metrics observers, store factory
+# ---------------------------------------------------------------------------
+
+_observer = None
+
+
+def set_observer(fn) -> None:
+    """fn(outcome: str, n_records: int) — service.obs wires the
+    vrpms_analytics_total counter in."""
+    global _observer
+    _observer = fn
+
+
+def _notify(outcome: str, n: int) -> None:
+    if n and _observer is not None:
+        try:
+            _observer(outcome, n)
+        except Exception:
+            pass  # telemetry about telemetry must never break either
+
+
+_record_observer = None
+
+
+def set_record_observer(fn) -> None:
+    """fn(doc: dict) — called once per offered flight record;
+    service.obs feeds the occupancy/fill/overlap histograms (with the
+    trace-id exemplar) from it."""
+    global _record_observer
+    _record_observer = fn
+
+
+def replica_identity() -> str:
+    """This process's identity on exported rows — the trace exporter's,
+    so flight rows and trace rows agree."""
+    from vrpms_tpu.obs import export
+
+    return export.replica_identity()
+
+
+_store_factory = None
+
+
+def set_store_factory(fn) -> None:
+    """fn() -> a store.base.Database (anything with put_flight_records).
+    Tests and benchmarks inject shims here; None restores the default
+    (the configured store, resolved lazily on the flusher thread)."""
+    global _store_factory
+    _store_factory = fn
+
+
+def _store():
+    if _store_factory is not None:
+        return _store_factory()
+    from store import get_database
+
+    return get_database("vrp", None)
+
+
+# ---------------------------------------------------------------------------
+# Serialization: one bounded row per (job, replica)
+# ---------------------------------------------------------------------------
+
+
+def serialize_record(doc: dict) -> dict | None:
+    """The store row for one flight record. Enforces the row byte bound
+    by shedding the `profile` block first; None means even the compact
+    core is oversized (caller counts the record dropped)."""
+    doc = dict(doc)
+    for strip in (None, "profile"):
+        if strip is not None:
+            if strip not in doc:
+                continue
+            doc.pop(strip, None)
+            doc["truncated"] = True
+        try:
+            size = len(json.dumps(doc))
+        except (TypeError, ValueError):
+            return None  # unserializable value snuck in: drop
+        if size <= MAX_ROW_BYTES:
+            return {
+                "job_id": str(doc.get("jobId")),
+                "replica": str(doc.get("replica")),
+                "finished_at": float(doc.get("finishedAt") or 0.0),
+                "tier": doc.get("tier"),
+                "algorithm": doc.get("algorithm"),
+                "doc": doc,
+            }
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The exporter: bounded queue + background batch flusher
+# ---------------------------------------------------------------------------
+
+
+class AnalyticsExporter:
+    """Bounded hand-off between the finish seams and the store — the
+    TraceExporter design (obs.export) applied to flight records.
+
+    `offer` is the solve-path half: one lock/append (plus an eviction
+    pop when full); serialization and store I/O happen on the flusher
+    thread. The flusher drains up to `batch` records per round into ONE
+    put_flight_records call, then idles `flush_s` (a fresh offer wakes
+    it immediately)."""
+
+    def __init__(self, queue_cap: int = 256, batch: int = 16,
+                 flush_s: float = 0.05):
+        self.queue_cap = max(1, int(queue_cap))
+        self.batch = max(1, int(batch))
+        self.flush_s = max(0.001, float(flush_s))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: collections.deque = collections.deque()  # guarded-by: _lock
+        self._busy = False  # guarded-by: _lock
+        self._halt = False  # guarded-by: _lock
+        self._warned = False  # guarded-by: _lock
+        # flusher-thread-only store handle, reused across rounds and
+        # keyed by the active selector so env flips rebuild it; dropped
+        # after any failed write so a broken client is never pinned
+        self._db = None
+        self._db_key = None
+        self._thread = threading.Thread(
+            target=self._run, name="vrpms-analytics", daemon=True
+        )
+        self._thread.start()
+
+    # -- solve-path side ----------------------------------------------------
+    def offer(self, doc: dict) -> None:
+        dropped = False
+        with self._lock:
+            if self._halt:
+                return
+            self._queue.append(doc)
+            if len(self._queue) > self.queue_cap:
+                # drop the OLDEST record, keep the newest evidence
+                self._queue.popleft()
+                dropped = True
+            self._cond.notify()
+        if dropped:
+            self._note_drop()
+
+    def _note_drop(self) -> None:
+        _notify(DROPPED, 1)
+        with self._lock:
+            warned, self._warned = self._warned, True
+        if not warned:
+            # one structured event per backlog episode, not per drop
+            log_event(
+                "analytics.dropping",
+                level="warn",
+                queue=self.queue_cap,
+                hint="raise VRPMS_ANALYTICS_QUEUE or check store "
+                "latency; flight records are being dropped",
+            )
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- flusher side -------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._halt:
+                    self._cond.wait(self.flush_s)
+                    if not self._queue and not self._halt:
+                        # idle tick: clear the backlog-warn latch so a
+                        # NEW backlog episode logs again
+                        self._warned = False
+                if self._halt and not self._queue:
+                    return
+                batch = [
+                    self._queue.popleft()
+                    for _ in range(min(self.batch, len(self._queue)))
+                ]
+                self._busy = True
+            try:
+                self._flush(batch)
+            finally:
+                with self._lock:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def _flush(self, batch: list) -> None:
+        rows, dropped = [], 0
+        for doc in batch:
+            try:
+                row = serialize_record(doc)
+            except Exception:
+                row = None
+            if row is None:
+                dropped += 1
+                continue
+            rows.append(row)
+        if dropped:
+            _notify(DROPPED, dropped)
+        if not rows:
+            return
+        try:
+            wrote = self._resolve_store().put_flight_records(rows)
+        except Exception:
+            wrote = False  # a factory/store constructor failure
+        if not wrote:
+            self._db = None  # fresh client next round
+        _notify(OK if wrote else FAILED, len(rows))
+
+    def _resolve_store(self):
+        """The flusher's cached store handle (flusher thread only)."""
+        key = (
+            _store_factory,
+            config.raw("VRPMS_STORE"),
+            config.get("SUPABASE_URL"),
+        )
+        if self._db is None or self._db_key != key:
+            self._db = _store()
+            self._db_key = key
+        return self._db
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until the queue is drained and no batch is in flight
+        (tests / benchmarks / shutdown); False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._queue or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.05))
+        return True
+
+    def stop(self, drain_s: float = 2.0) -> None:
+        self.flush(timeout=drain_s)
+        with self._lock:
+            self._halt = True
+            self._cond.notify_all()
+        self._thread.join(timeout=drain_s + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Process singleton + the local recent ring
+# ---------------------------------------------------------------------------
+
+_exporter_lock = threading.Lock()
+_exporter: AnalyticsExporter | None = None  # guarded-by: _exporter_lock
+
+_recent_lock = threading.Lock()
+_recent: collections.deque = collections.deque(
+    maxlen=RECENT_CAP
+)  # guarded-by: _recent_lock
+
+
+def get_exporter() -> AnalyticsExporter:
+    global _exporter
+    with _exporter_lock:
+        if _exporter is None:
+            _exporter = AnalyticsExporter(
+                queue_cap=config.get("VRPMS_ANALYTICS_QUEUE"),
+                batch=16,
+                flush_s=config.get("VRPMS_ANALYTICS_FLUSH_MS") / 1e3,
+            )
+        return _exporter
+
+
+def offer(doc: dict) -> None:
+    """The finish-seam hook: one completed solve's flight record. With
+    the switch off this is ONE env read. The local ring and the metric
+    observer see every offered record even when the durable write later
+    fails — the process-local half must survive store outages."""
+    if not enabled():
+        return
+    if not doc or not doc.get("jobId"):
+        return
+    with _recent_lock:
+        _recent.append(doc)
+    obs = _record_observer
+    if obs is not None:
+        try:
+            obs(doc)
+        except Exception:
+            pass  # instruments must never fail a solve
+    get_sentinel().note(doc)
+    get_exporter().offer(doc)
+
+
+def recent_records() -> list:
+    """Newest-first copy of the local flight-record ring."""
+    with _recent_lock:
+        return list(reversed(_recent))
+
+
+def recent_for_job(job_id: str) -> dict | None:
+    """This replica's flight record for a job, if still in the ring."""
+    with _recent_lock:
+        for doc in reversed(_recent):
+            if doc.get("jobId") == job_id:
+                return dict(doc)
+    return None
+
+
+def queue_depth() -> int:
+    """Exporter backlog for the scrape-time gauge (0 when no exporter
+    was ever built — scraping must not build one)."""
+    with _exporter_lock:
+        exp = _exporter
+    return exp.depth() if exp is not None else 0
+
+
+def flush(timeout: float = 10.0) -> bool:
+    """Drain the exporter if one exists (tests/benchmarks/shutdown)."""
+    with _exporter_lock:
+        exp = _exporter
+    return exp.flush(timeout) if exp is not None else True
+
+
+def reset_analytics() -> None:
+    """Stop and forget the exporter, ring, and sentinel state (tests;
+    knobs re-read on rebuild)."""
+    global _exporter, _sentinel
+    with _exporter_lock:
+        exp, _exporter = _exporter, None
+    if exp is not None:
+        exp.stop(drain_s=0.5)
+    with _recent_lock:
+        _recent.clear()
+    with _sentinel_lock:
+        _sentinel = None
+
+
+# ---------------------------------------------------------------------------
+# Regression sentinel: rolling quality vs the committed baseline
+# ---------------------------------------------------------------------------
+
+#: committed baseline snapshot the sentinel compares against; absent =
+#: the sentinel is inert (fresh checkouts flag nothing)
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    ))),
+    "benchmarks", "records", "analytics_baseline.json",
+)
+
+_regression_observer = None
+
+
+def set_regression_observer(fn) -> None:
+    """fn(kind: str) — service.obs wires the
+    vrpms_analytics_regressions_total counter in."""
+    global _regression_observer
+    _regression_observer = fn
+
+
+class RegressionSentinel:
+    """Rolling per-(tier, algorithm) EWMAs of gap and evals/sec,
+    compared against the committed baseline on every record. Drift
+    beyond the baseline's tolerance — after `minSamples` records for
+    that key — emits ONE `analytics.regression` structured event per
+    episode (the latch clears when the EWMA recovers) and ticks the
+    regression counter per flagged record."""
+
+    ALPHA = 0.2
+
+    def __init__(self, baseline: dict | None = None):
+        if baseline is None:
+            baseline = self._load()
+        self._baseline = (baseline or {}).get("tiers", {})
+        tol = (baseline or {}).get("tolerance", {})
+        self._tol_gap = float(tol.get("gap", 0.25))
+        self._tol_rate = float(tol.get("evalsPerSec", 0.25))
+        self._min_samples = int((baseline or {}).get("minSamples", 5))
+        self._lock = threading.Lock()
+        self._ewma: dict = {}  # guarded-by: _lock
+        self._flagged: set = set()  # guarded-by: _lock
+
+    @staticmethod
+    def _load() -> dict | None:
+        try:
+            with open(BASELINE_PATH) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def note(self, doc: dict) -> None:
+        if not self._baseline:
+            return
+        key = f"{doc.get('tier')}|{doc.get('algorithm')}"
+        base = self._baseline.get(key)
+        if base is None:
+            return
+        drifts = []
+        with self._lock:
+            state = self._ewma.setdefault(key, {"n": 0})
+            state["n"] += 1
+            for metric, tol, worse_is in (
+                ("gap", self._tol_gap, "higher"),
+                ("evalsPerSec", self._tol_rate, "lower"),
+            ):
+                val = doc.get(metric)
+                if val is None or base.get(metric) is None:
+                    continue
+                prev = state.get(metric)
+                ew = (
+                    float(val) if prev is None
+                    else (1 - self.ALPHA) * prev + self.ALPHA * float(val)
+                )
+                state[metric] = ew
+                if state["n"] < self._min_samples:
+                    continue
+                ref = float(base[metric])
+                if worse_is == "higher":
+                    drifted = ew > ref + tol * max(abs(ref), 1e-9)
+                else:
+                    drifted = ew < ref * (1 - tol)
+                episode = (key, metric)
+                if drifted:
+                    first = episode not in self._flagged
+                    self._flagged.add(episode)
+                    drifts.append((metric, ew, ref, first))
+                else:
+                    self._flagged.discard(episode)
+        for metric, ew, ref, first in drifts:
+            obs = _regression_observer
+            if obs is not None:
+                try:
+                    obs(metric)
+                except Exception:
+                    pass
+            if first:
+                log_event(
+                    "analytics.regression",
+                    level="warn",
+                    key=key,
+                    metric=metric,
+                    rolling=round(ew, 6),
+                    baseline=ref,
+                    hint="rolling solve quality/efficiency drifted past "
+                    "the committed baseline; compare recent deploys",
+                )
+
+    def snapshot(self) -> dict:
+        """Current EWMAs + flagged episodes (the debug endpoint)."""
+        with self._lock:
+            return {
+                "keys": {
+                    k: {m: round(v, 6) for m, v in st.items()}
+                    for k, st in self._ewma.items()
+                },
+                "flagged": sorted(
+                    f"{k}:{m}" for k, m in self._flagged
+                ),
+                "baselineKeys": sorted(self._baseline),
+            }
+
+
+_sentinel_lock = threading.Lock()
+_sentinel: RegressionSentinel | None = None  # guarded-by: _sentinel_lock
+
+
+def get_sentinel() -> RegressionSentinel:
+    global _sentinel
+    with _sentinel_lock:
+        if _sentinel is None:
+            _sentinel = RegressionSentinel()
+        return _sentinel

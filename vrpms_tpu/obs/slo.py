@@ -1,0 +1,141 @@
+"""Per-QoS-class SLO tracking: deadline-met objective, burn-rate windows.
+
+The QoS layer (sched.qos) stamps every job with a class and an absolute
+EDF deadline; the job observer already knows, at each terminal
+transition, whether the deadline was met. This module turns those
+booleans into the standard SRE alerting shape: a deadline-met SLO with
+a target (VRPMS_SLO_TARGET, default 99%) and TWO burn-rate windows —
+fast (5 min, pages on sharp regressions) and slow (1 h, catches slow
+bleeds) — per class.
+
+    burn rate = (observed miss fraction over the window)
+                / (allowed miss budget, 1 - target)
+
+A burn rate of 1.0 means the class is consuming exactly its error
+budget; >1 means the budget exhausts early. Exported as
+vrpms_slo_burn_rate{qos,window} gauges (service.obs refreshes at scrape
+time) and as the `slo` block on /api/debug/fleet.
+
+Bounded and stdlib-only: per-class outcome deques cap at MAX_OUTCOMES
+(oldest evicted — at that point the slow window is saturated with
+fresher evidence anyway). The clock is injectable for window-arithmetic
+tests. Like every obs subsystem, nothing here runs unless the service
+wiring calls in — VRPMS_ANALYTICS off never builds a tracker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from vrpms_tpu import config
+
+#: (name, seconds) — the fast window pages, the slow window trends
+WINDOWS = (("fast", 300.0), ("slow", 3600.0))
+
+#: per-class outcome cap; beyond it the oldest outcomes age out of the
+#: deque before they age out of the slow window (bounded memory wins)
+MAX_OUTCOMES = 4096
+
+
+def slo_target() -> float:
+    """The deadline-met objective, clamped to a meaningful (0, 1)."""
+    t = float(config.get("VRPMS_SLO_TARGET"))
+    return min(max(t, 0.0), 0.9999)
+
+
+class SloTracker:
+    """Per-QoS-class sliding-window deadline-met accounting."""
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._lock = threading.Lock()
+        # class -> deque[(ts, met: bool)], appended at terminal
+        # transitions, pruned lazily per read
+        self._outcomes: dict = {}  # guarded-by: _lock
+
+    def note(self, qos_class: str, met: bool) -> None:
+        """One terminal job outcome: was its deadline met? Jobs with no
+        deadline count as met — an unbounded request cannot miss."""
+        cls = str(qos_class or "standard")
+        now = self._clock()
+        with self._lock:
+            dq = self._outcomes.setdefault(cls, [])
+            dq.append((now, bool(met)))
+            if len(dq) > MAX_OUTCOMES:
+                del dq[: len(dq) - MAX_OUTCOMES]
+
+    def _window_stats(self, dq: list, now: float, span_s: float):
+        cutoff = now - span_s
+        total = met = 0
+        for ts, ok in reversed(dq):
+            if ts < cutoff:
+                break
+            total += 1
+            met += 1 if ok else 0
+        return total, met
+
+    def burn_rates(self) -> dict:
+        """{class: {window: {burnRate, total, met}}} over the live
+        windows; classes with no outcomes are absent. An empty window
+        burns 0 (no evidence is not a violation)."""
+        now = self._clock()
+        budget = max(1.0 - slo_target(), 1e-4)
+        out: dict = {}
+        with self._lock:
+            items = {c: list(dq) for c, dq in self._outcomes.items()}
+        for cls, dq in items.items():
+            per = {}
+            for name, span_s in WINDOWS:
+                total, met = self._window_stats(dq, now, span_s)
+                miss_frac = 0.0 if total == 0 else (total - met) / total
+                per[name] = {
+                    "burnRate": round(miss_frac / budget, 4),
+                    "total": total,
+                    "met": met,
+                }
+            out[cls] = per
+        return out
+
+    def fleet_block(self) -> dict:
+        """The `slo` block for /api/debug/fleet."""
+        return {
+            "objective": "deadline-met",
+            "target": slo_target(),
+            "windows": {name: span for name, span in WINDOWS},
+            "classes": self.burn_rates(),
+        }
+
+
+_lock = threading.Lock()
+_tracker: SloTracker | None = None  # guarded-by: _lock
+
+
+def get_tracker() -> SloTracker:
+    global _tracker
+    with _lock:
+        if _tracker is None:
+            _tracker = SloTracker()
+        return _tracker
+
+
+def note(qos_class: str, met: bool) -> None:
+    """Record one terminal outcome (no-op tracker build is cheap; the
+    caller gates on VRPMS_ANALYTICS so off-mode never reaches here)."""
+    get_tracker().note(qos_class, met)
+
+
+def burn_rates() -> dict:
+    with _lock:
+        t = _tracker
+    return t.burn_rates() if t is not None else {}
+
+
+def fleet_block() -> dict:
+    return get_tracker().fleet_block()
+
+
+def reset_tracker() -> None:
+    global _tracker
+    with _lock:
+        _tracker = None

@@ -223,6 +223,12 @@ def solve_sa_batch(
     # pad to a power of two with clones of the last instance: bounds the
     # compiled batched-program variants at log2(max_batch) per shape
     p = _pad_pow2(k)
+    from vrpms_tpu.obs.analytics import current_timer
+
+    _timer = current_timer()
+    if _timer is not None:  # flight record: batch fill = members/padded
+        _timer.batch_members = k
+        _timer.batch_padded = p
     padded = list(insts) + [insts[-1]] * (p - k)
     pad_seeds = [int(s) & 0x7FFFFFFF for s in seeds] + [0] * (p - k)
 

@@ -109,6 +109,25 @@ def _scalar_best(best):
         return best
 
 
+def _now() -> float:
+    import time
+
+    return time.perf_counter()
+
+
+def _timed_sync(timer, best) -> None:
+    """Block on a sync payload, attributing the blocked wall time to the
+    flight timer as device wait when one is installed (ISSUE 20). With
+    no timer this is exactly the bare block_until_ready the driver
+    always did — the analytics-off path adds zero work."""
+    if timer is None:
+        jax.block_until_ready(best)
+        return
+    t0 = _now()
+    jax.block_until_ready(best)
+    timer.note_wait(_now() - t0)
+
+
 # ---------------------------------------------------------------------------
 # measured-rate hint cache (shared by SA/GA/ACO and the batched launch)
 # ---------------------------------------------------------------------------
@@ -243,11 +262,13 @@ def run_blocked(
     an incumbent capture is actually due. VRPMS_PIPELINE=off restores
     the serial loop exactly, including its sync points.
     """
+    from vrpms_tpu.obs.analytics import current_timer
     from vrpms_tpu.obs.progress import active_sink
     from vrpms_tpu.obs.trace import active_trace
 
     trace = active_trace()
     sink = active_sink()
+    timer = current_timer()  # flight-record timing; None = zero cost
     pipelined = pipeline_enabled()
     # a sink that consumes per-row bests (the batched fanout) opts out
     # of the device-side scalar reduction; an unknown sink without the
@@ -263,31 +284,37 @@ def run_blocked(
             sink.note_cancel_seen()
             return state, 0
         state = step_block(state, n_total, 0)
-        if (trace is not None or sink is not None) and n_total > 0:
+        if (
+            trace is not None or sink is not None or timer is not None
+        ) and n_total > 0:
             best = sync(state)
             if pipelined and not needs_array:
                 best = _scalar_best(best)
-            jax.block_until_ready(best)
+            _timed_sync(timer, best)
+            t0 = _now() if timer is not None else 0.0
             if trace is not None:
                 trace.record(best, n_total, evals_per_iter)
             if sink is not None:
                 sink.record(best, n_total, evals_per_iter)
                 _maybe_capture(sink, incumbent, state)
+            if timer is not None:
+                timer.note_host(_now() - t0, overlapped=False)
         return state, n_total
     if not pipelined:
         return _run_serial(
             step_block, state, n_total, block_size, deadline_s, sync,
-            rate_hint, evals_per_iter, incumbent, trace, sink,
+            rate_hint, evals_per_iter, incumbent, trace, sink, timer,
         )
     return _run_pipelined(
         step_block, state, n_total, block_size, deadline_s, sync,
         rate_hint, evals_per_iter, incumbent, trace, sink, needs_array,
+        timer,
     )
 
 
 def _run_serial(
     step_block, state, n_total, block_size, deadline_s, sync,
-    rate_hint, evals_per_iter, incumbent, trace, sink,
+    rate_hint, evals_per_iter, incumbent, trace, sink, timer=None,
 ):
     """The pre-pipeline timed driver, byte-for-byte (VRPMS_PIPELINE=off
     contract): launch, sync, process, then launch again — the device
@@ -330,13 +357,18 @@ def _run_serial(
             nb = 128
         state = step_block(state, nb, done)
         best = sync(state)
-        jax.block_until_ready(best)
+        _timed_sync(timer, best)
         done += nb
+        t0 = _now() if timer is not None else 0.0
         if trace is not None:
             trace.record(best, nb, evals_per_iter)
         if sink is not None:
             sink.record(best, nb, evals_per_iter)
             _maybe_capture(sink, incumbent, state)
+        if timer is not None:
+            # serial boundaries never overlap device compute: the next
+            # block launches only after this bookkeeping finishes
+            timer.note_host(_now() - t0, overlapped=False)
         if time.monotonic() - t_start >= deadline_s:
             break
     return state, done
@@ -384,6 +416,7 @@ def _fit_block(
 def _run_pipelined(
     step_block, state, n_total, block_size, deadline_s, sync,
     rate_hint, evals_per_iter, incumbent, trace, sink, needs_array,
+    timer=None,
 ):
     """Depth-1 pipelined timed driver (see run_blocked's contract).
 
@@ -413,11 +446,12 @@ def _run_pipelined(
     done_box = [0]
     donated = donation_enabled()
 
-    def process(blk):
+    def process(blk, overlapped=False):
         nb_p, best_p, state_p, inc_p = blk
-        jax.block_until_ready(best_p)
+        _timed_sync(timer, best_p)
         t_sync[0] = time.monotonic()
         done_box[0] += nb_p
+        t0 = _now() if timer is not None else 0.0
         if trace is not None:
             trace.record(best_p, nb_p, evals_per_iter)
         if sink is not None:
@@ -429,6 +463,12 @@ def _run_pipelined(
                     sink.offer_incumbent(inc_p)
                 except Exception:
                     pass  # capture must never kill the device loop
+        if timer is not None:
+            # overlapped=True only when another block is already in
+            # flight behind this sync — that host work hides under
+            # device compute; the drains (opener, stop re-fit, final)
+            # run with an idle device
+            timer.note_host(_now() - t0, overlapped=overlapped)
 
     prev = None  # in-flight block: (nb, best, state, incumbent|None)
     while True:
@@ -503,7 +543,7 @@ def _run_pipelined(
                     state = new_state
                     launched += nb
         if prev is not None:
-            process(prev)
+            process(prev, overlapped=cur is not None)
         prev = cur
         if prev is None:
             break
